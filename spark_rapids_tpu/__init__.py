@@ -1,0 +1,21 @@
+"""spark-rapids-tpu: a TPU-native columnar SQL/ETL engine.
+
+From-scratch rebuild of the capability set of NVIDIA's RAPIDS Accelerator
+for Apache Spark (spark-rapids v0.3.0) with TPU-first architecture:
+plan override/tag/fallback/explain, HBM-resident Arrow-layout columnar
+batches, expressions compiled to XLA, sort-based segmented-reduce
+aggregation, total-order key-encoded sorts, ICI-collective shuffle, and a
+device->host->disk spill framework.  See SURVEY.md at the repo root for the
+full blueprint and reference mapping.
+"""
+
+import jax as _jax
+
+# SQL engines need exact int64/float64; enable before anything traces.
+_jax.config.update("jax_enable_x64", True)
+
+from spark_rapids_tpu.api.session import TpuSparkSession  # noqa: E402,F401
+from spark_rapids_tpu.api.column import Column, col, lit  # noqa: E402,F401
+from spark_rapids_tpu.api import functions  # noqa: E402,F401
+
+__version__ = "0.1.0"

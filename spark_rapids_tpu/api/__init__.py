@@ -1,0 +1,3 @@
+from spark_rapids_tpu.api.session import TpuSparkSession  # noqa: F401
+from spark_rapids_tpu.api.column import Column, col, lit  # noqa: F401
+from spark_rapids_tpu.api import functions  # noqa: F401

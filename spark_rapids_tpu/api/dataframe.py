@@ -1,0 +1,210 @@
+"""DataFrame API: the user surface over logical plans.
+
+Mirrors the PySpark DataFrame surface the reference accelerates, so a
+spark-rapids user can switch: select/filter/groupBy/agg/join/sort/limit/
+union/collect/explain, plus ``collect_device`` — the zero-copy
+``ColumnarRdd``-style handoff to ML frameworks (reference:
+ColumnarRdd.scala:49, north-star config #5).
+"""
+
+from __future__ import annotations
+
+from typing import Any, List, Optional, Sequence, Union
+
+import pyarrow as pa
+
+from spark_rapids_tpu.api.column import Column, _to_expr, col
+from spark_rapids_tpu.expr import ir
+from spark_rapids_tpu.plan import logical as lp
+from spark_rapids_tpu.plan.logical import SortOrder
+
+
+def _as_expr(c: Union[str, Column, ir.Expression]) -> ir.Expression:
+    if isinstance(c, str):
+        return ir.UnresolvedAttribute(c)
+    return _to_expr(c)
+
+
+class DataFrame:
+    def __init__(self, plan: lp.LogicalPlan, session: "TpuSparkSession"):
+        self.plan = plan
+        self.session = session
+
+    # -- transformations ---------------------------------------------------
+    def select(self, *cols) -> "DataFrame":
+        exprs = [_as_expr(c) for c in cols]
+        return DataFrame(lp.Project(self.plan, exprs), self.session)
+
+    def with_column(self, name: str, c: Column) -> "DataFrame":
+        exprs: List[ir.Expression] = []
+        replaced = False
+        for n in self.plan.schema.names:
+            if n == name:
+                exprs.append(ir.Alias(_as_expr(c), name))
+                replaced = True
+            else:
+                exprs.append(ir.UnresolvedAttribute(n))
+        if not replaced:
+            exprs.append(ir.Alias(_as_expr(c), name))
+        return DataFrame(lp.Project(self.plan, exprs), self.session)
+
+    withColumn = with_column
+
+    def filter(self, condition: Union[Column, ir.Expression]) -> "DataFrame":
+        return DataFrame(lp.Filter(self.plan, _as_expr(condition)),
+                         self.session)
+
+    where = filter
+
+    def group_by(self, *cols) -> "GroupedData":
+        return GroupedData(self, [_as_expr(c) for c in cols])
+
+    groupBy = group_by
+
+    def agg(self, *aggs) -> "DataFrame":
+        return GroupedData(self, []).agg(*aggs)
+
+    def sort(self, *cols, ascending: Optional[Any] = None) -> "DataFrame":
+        orders: List[SortOrder] = []
+        for i, c in enumerate(cols):
+            if isinstance(c, SortOrder):
+                orders.append(c)
+                continue
+            asc = True
+            if ascending is not None:
+                asc = ascending[i] if isinstance(ascending, (list, tuple)) \
+                    else bool(ascending)
+            orders.append(SortOrder(_as_expr(c), asc, None))
+        return DataFrame(lp.Sort(self.plan, orders), self.session)
+
+    orderBy = sort
+    order_by = sort
+
+    def limit(self, n: int) -> "DataFrame":
+        return DataFrame(lp.Limit(self.plan, n), self.session)
+
+    def union(self, other: "DataFrame") -> "DataFrame":
+        return DataFrame(lp.Union([self.plan, other.plan]), self.session)
+
+    unionAll = union
+
+    def join(self, other: "DataFrame", on=None, how: str = "inner"
+             ) -> "DataFrame":
+        how = {"left_outer": "left", "right_outer": "right",
+               "outer": "full", "full_outer": "full",
+               "leftsemi": "semi", "left_semi": "semi",
+               "leftanti": "anti", "left_anti": "anti",
+               "cross": "cross"}.get(how, how)
+        if on is None:
+            left_keys: List[str] = []
+            right_keys: List[str] = []
+        elif isinstance(on, str):
+            left_keys, right_keys = [on], [on]
+        elif isinstance(on, (list, tuple)):
+            left_keys = list(on)
+            right_keys = list(on)
+        else:
+            raise TypeError("join on must be a column name or list of names")
+        return DataFrame(lp.Join(self.plan, other.plan, left_keys,
+                                 right_keys, how), self.session)
+
+    crossJoin = lambda self, other: self.join(other, how="cross")  # noqa
+
+    def distinct(self) -> "DataFrame":
+        names = self.plan.schema.names
+        return DataFrame(
+            lp.Aggregate(self.plan,
+                         [ir.UnresolvedAttribute(n) for n in names], []),
+            self.session)
+
+    # -- properties --------------------------------------------------------
+    @property
+    def schema(self) -> lp.Schema:
+        return self.plan.schema
+
+    @property
+    def columns(self) -> List[str]:
+        return self.plan.schema.names
+
+    # -- actions -----------------------------------------------------------
+    def collect(self) -> pa.Table:
+        """Execute and return an Arrow table (the terminal device->host
+        transition, GpuBringBackToHost analog)."""
+        return self.session._execute(self.plan)
+
+    def to_pandas(self):
+        return self.collect().to_pandas()
+
+    toPandas = to_pandas
+
+    def collect_device(self):
+        """Execute and return device-resident batches — the ColumnarRdd /
+        ML-handoff path (reference: ColumnarRdd.scala:49,
+        InternalColumnarRddConverter.scala:579): jax arrays stay in HBM for
+        a downstream ML framework, no host round-trip."""
+        return self.session._execute_device(self.plan)
+
+    def count(self) -> int:
+        from spark_rapids_tpu.api import functions as F
+        t = self.agg(F.count("*").alias("count")).collect()
+        return t.column("count")[0].as_py()
+
+    def show(self, n: int = 20) -> None:
+        print(self.limit(n).collect().to_pandas().to_string(index=False))
+
+    def explain(self, mode: str = "physical") -> None:
+        print(self.explain_string(mode))
+
+    def explain_string(self, mode: str = "physical") -> str:
+        if mode == "logical":
+            return self.plan.tree_string()
+        result = self.session._plan_physical(self.plan)
+        if mode == "tpu":
+            return result.explain_string(all_=True)
+        return result.plan.tree_string()
+
+    def __repr__(self):
+        inner = ", ".join(f"{f.name}: {f.dtype.name}"
+                          for f in self.plan.schema.fields)
+        return f"DataFrame[{inner}]"
+
+
+class GroupedData:
+    def __init__(self, df: DataFrame, groupings: List[ir.Expression]):
+        self.df = df
+        self.groupings = groupings
+
+    def agg(self, *aggs) -> DataFrame:
+        agg_exprs = [_as_expr(a) for a in aggs]
+        return DataFrame(
+            lp.Aggregate(self.df.plan, self.groupings, agg_exprs),
+            self.df.session)
+
+    def _simple(self, fn, cols) -> DataFrame:
+        from spark_rapids_tpu.api import functions as F
+        if not cols:
+            cols = [f.name for f in self.df.plan.schema.fields
+                    if f.dtype.is_numeric]
+        builder = {"count": F.count, "sum": F.sum, "min": F.min,
+                   "max": F.max, "avg": F.avg}[fn]
+        if fn == "count":
+            return self.agg(F.count("*").alias("count"))
+        return self.agg(*[
+            builder(c).alias(f"{fn}({c})") for c in cols])
+
+    def count(self) -> DataFrame:
+        return self._simple("count", [])
+
+    def sum(self, *cols) -> DataFrame:
+        return self._simple("sum", cols)
+
+    def min(self, *cols) -> DataFrame:
+        return self._simple("min", cols)
+
+    def max(self, *cols) -> DataFrame:
+        return self._simple("max", cols)
+
+    def avg(self, *cols) -> DataFrame:
+        return self._simple("avg", cols)
+
+    mean = avg

@@ -1,0 +1,167 @@
+"""TpuSparkSession: the engine entry point.
+
+Plays the combined role of SparkSession + the reference's plugin bootstrap
+(reference: SQLPlugin.scala:28-31, Plugin.scala:111-212): holds the conf,
+initializes the device and concurrency semaphore, plans queries, and applies
+the TPU overrides.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Any, Dict, List, Optional, Sequence, Union
+
+import pyarrow as pa
+
+from spark_rapids_tpu import config as cfg
+from spark_rapids_tpu.config import RapidsTpuConf
+from spark_rapids_tpu.api.dataframe import DataFrame
+from spark_rapids_tpu.exec.base import PhysicalPlan
+from spark_rapids_tpu.exec.cpu import concat_tables
+from spark_rapids_tpu.mem import device as devmgr
+from spark_rapids_tpu.plan import logical as lp
+from spark_rapids_tpu.plan.overrides import (OverrideResult, TpuOverrides,
+                                             assert_is_on_tpu)
+from spark_rapids_tpu.plan.planner import plan_cpu
+
+
+class TpuSparkSession:
+    _active: Optional["TpuSparkSession"] = None
+    _lock = threading.Lock()
+
+    def __init__(self, conf: Optional[Dict[str, Any]] = None):
+        self.conf = RapidsTpuConf(conf)
+        devmgr.initialize(self.conf.get(cfg.CONCURRENT_TPU_TASKS))
+        with TpuSparkSession._lock:
+            TpuSparkSession._active = self
+        self._plan_listeners: List = []
+
+    # -- builder-compatible construction -----------------------------------
+    class Builder:
+        def __init__(self):
+            self._conf: Dict[str, Any] = {}
+
+        def config(self, key: str, value: Any) -> "TpuSparkSession.Builder":
+            self._conf[key] = value
+            return self
+
+        def getOrCreate(self) -> "TpuSparkSession":
+            return TpuSparkSession(self._conf)
+
+        get_or_create = getOrCreate
+
+    builder = Builder()
+
+    @classmethod
+    def active(cls) -> "TpuSparkSession":
+        if cls._active is None:
+            cls._active = TpuSparkSession()
+        return cls._active
+
+    # -- conf --------------------------------------------------------------
+    def set_conf(self, key: str, value: Any) -> None:
+        self.conf.set(key, value)
+
+    def get_conf(self, key: str, default: Any = None) -> Any:
+        return self.conf.get_raw(key, default)
+
+    # -- data sources ------------------------------------------------------
+    def create_dataframe(self, data, schema: Optional[Sequence[str]] = None,
+                         num_partitions: int = 1) -> DataFrame:
+        if isinstance(data, pa.Table):
+            table = data
+        elif hasattr(data, "to_dict") and hasattr(data, "columns"):
+            table = pa.Table.from_pandas(data)  # pandas DataFrame
+        elif isinstance(data, dict):
+            table = pa.table(data)
+        elif isinstance(data, list):
+            if schema is None:
+                raise ValueError("schema (column names) required for lists")
+            cols = list(zip(*data)) if data else [[] for _ in schema]
+            table = pa.table({n: list(c) for n, c in zip(schema, cols)})
+        else:
+            raise TypeError(f"cannot create DataFrame from {type(data)}")
+        return DataFrame(lp.InMemoryScan(table, num_partitions), self)
+
+    createDataFrame = create_dataframe
+
+    def range(self, start: int, end: Optional[int] = None, step: int = 1,
+              num_partitions: int = 1) -> DataFrame:
+        if end is None:
+            start, end = 0, start
+        return DataFrame(lp.Range(start, end, step, num_partitions), self)
+
+    @property
+    def read(self) -> "DataFrameReader":
+        return DataFrameReader(self)
+
+    # -- planning & execution ----------------------------------------------
+    def _plan_physical(self, plan: lp.LogicalPlan) -> OverrideResult:
+        cpu_plan = plan_cpu(plan, self.conf)
+        result = TpuOverrides.apply(cpu_plan, self.conf)
+        if self.conf.test_enabled:
+            assert_is_on_tpu(result.plan, self.conf.test_allowed_non_tpu)
+        for listener in self._plan_listeners:
+            listener(result)
+        return result
+
+    def _execute(self, plan: lp.LogicalPlan) -> pa.Table:
+        result = self._plan_physical(plan)
+        tables: List[pa.Table] = []
+        for it in result.plan.execute():
+            tables.extend(it)
+        return concat_tables(tables, result.plan.schema)
+
+    def _execute_device(self, plan: lp.LogicalPlan):
+        """ColumnarRdd-style handoff: device batches, no host round-trip."""
+        from spark_rapids_tpu.exec.tpu_basic import (DeviceToHostExec,
+                                                     HostToDeviceExec)
+        result = self._plan_physical(plan)
+        p = result.plan
+        if isinstance(p, DeviceToHostExec):
+            p = p.children[0]  # strip the terminal download
+        else:
+            p = HostToDeviceExec(p, self.conf.get(cfg.MIN_BUCKET_ROWS))
+        batches = []
+        for it in p.execute():
+            batches.extend(it)
+        return batches
+
+    # plan-capture hook for tests (ExecutionPlanCaptureCallback analog,
+    # reference: Plugin.scala:214-303)
+    def add_plan_listener(self, fn) -> None:
+        self._plan_listeners.append(fn)
+
+    def remove_plan_listener(self, fn) -> None:
+        self._plan_listeners.remove(fn)
+
+
+class DataFrameReader:
+    def __init__(self, session: TpuSparkSession):
+        self.session = session
+        self._options: Dict[str, Any] = {}
+
+    def option(self, key: str, value: Any) -> "DataFrameReader":
+        self._options[key] = value
+        return self
+
+    def _scan(self, fmt: str, paths) -> DataFrame:
+        from spark_rapids_tpu.io.readers import infer_schema
+        if isinstance(paths, str):
+            paths = [paths]
+        schema = infer_schema(fmt, list(paths), self._options)
+        return DataFrame(
+            lp.FileScan(fmt, list(paths), schema, self._options),
+            self.session)
+
+    def parquet(self, *paths) -> DataFrame:
+        return self._scan("parquet", list(paths))
+
+    def csv(self, *paths, header: bool = True, sep: str = ","
+            ) -> DataFrame:
+        self._options.setdefault("header", header)
+        self._options.setdefault("sep", sep)
+        return self._scan("csv", list(paths))
+
+    def orc(self, *paths) -> DataFrame:
+        return self._scan("orc", list(paths))

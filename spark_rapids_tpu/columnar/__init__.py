@@ -1,0 +1,8 @@
+from spark_rapids_tpu.columnar.batch import (  # noqa: F401
+    DeviceColumn,
+    DeviceBatch,
+    bucket_rows,
+    from_arrow,
+    to_arrow,
+    concat_batches,
+)

@@ -1,0 +1,347 @@
+"""HBM-resident columnar batches: the device data currency of the engine.
+
+TPU-native analog of the reference's ``GpuColumnVector``/``ColumnarBatch``
+(reference: sql-plugin/src/main/java/.../GpuColumnVector.java:40-576 wrapping a
+cudf device column, and Table<->ColumnarBatch conversions at
+GpuColumnVector.java:261,293).
+
+Design differences forced by TPU/XLA (see SURVEY.md §7 hard part #1):
+cudf tolerates dynamic row counts; XLA compiles per static shape.  So a
+``DeviceBatch`` carries
+
+  * ``capacity`` — the padded, power-of-two-bucketed physical row count that
+    XLA sees (bounds recompiles to O(log max_rows) shapes per schema), and
+  * ``num_rows`` — the true logical row count, held host-side.
+
+Rows in ``[num_rows, capacity)`` are padding: validity False, data zeroed.
+Kernels must treat ``row_mask()`` as the ground truth for "row exists".
+
+Strings are Arrow-var-len on host but fixed-width on device: a
+``uint8 [capacity, max_len]`` byte matrix plus an ``int32 [capacity]`` length
+vector (max_len itself is bucketed).  This is the TPU-friendly layout for the
+byte-tensor string kernels (SURVEY.md §7 hard part #3).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, replace
+from typing import List, Optional, Sequence, Tuple
+
+import numpy as np
+import pyarrow as pa
+
+import jax
+import jax.numpy as jnp
+
+from spark_rapids_tpu import dtypes as dt
+
+
+def bucket_rows(n: int, min_bucket: int = 16) -> int:
+    """Next power-of-two capacity >= n (>= min_bucket)."""
+    cap = max(int(min_bucket), 1)
+    n = max(int(n), 1)
+    while cap < n:
+        cap <<= 1
+    return cap
+
+
+def _bucket_strlen(n: int) -> int:
+    if n <= 0:
+        return 1
+    cap = 1
+    while cap < n:
+        cap <<= 1
+    return cap
+
+
+@jax.tree_util.register_pytree_node_class
+@dataclass(frozen=True)
+class DeviceColumn:
+    """One column: device buffers + validity. Analog of GpuColumnVector."""
+
+    dtype: dt.DType
+    data: jnp.ndarray              # [capacity] or [capacity, max_len] for string
+    validity: jnp.ndarray          # bool [capacity]
+    lengths: Optional[jnp.ndarray] = None  # int32 [capacity], strings only
+
+    # -- pytree protocol so columns/batches can cross jit boundaries --------
+    def tree_flatten(self):
+        if self.lengths is None:
+            return (self.data, self.validity), (self.dtype, False)
+        return (self.data, self.validity, self.lengths), (self.dtype, True)
+
+    @classmethod
+    def tree_unflatten(cls, aux, children):
+        dtype, has_len = aux
+        if has_len:
+            data, validity, lengths = children
+            return cls(dtype, data, validity, lengths)
+        data, validity = children
+        return cls(dtype, data, validity, None)
+
+    @property
+    def capacity(self) -> int:
+        return int(self.data.shape[0])
+
+    @property
+    def max_len(self) -> int:
+        assert self.dtype.is_string
+        return int(self.data.shape[1])
+
+    def nbytes(self) -> int:
+        n = self.data.size * self.data.dtype.itemsize + self.validity.size
+        if self.lengths is not None:
+            n += self.lengths.size * 4
+        return int(n)
+
+    def gather(self, indices: jnp.ndarray, valid: jnp.ndarray) -> "DeviceColumn":
+        """Row gather; `valid` masks rows whose source index is meaningful."""
+        data = jnp.take(self.data, indices, axis=0)
+        validity = jnp.take(self.validity, indices, axis=0) & valid
+        lengths = None
+        if self.lengths is not None:
+            lengths = jnp.where(valid, jnp.take(self.lengths, indices), 0)
+            data = jnp.where(valid[:, None], data, 0)
+        else:
+            data = jnp.where(_bcast(valid, data), data, 0)
+        return DeviceColumn(self.dtype, data, validity, lengths)
+
+
+def _bcast(mask: jnp.ndarray, like: jnp.ndarray) -> jnp.ndarray:
+    if like.ndim == 2:
+        return mask[:, None]
+    return mask
+
+
+@jax.tree_util.register_pytree_node_class
+class DeviceBatch:
+    """A batch of device columns with a host-side logical row count."""
+
+    def __init__(self, names: Sequence[str], columns: Sequence[DeviceColumn],
+                 num_rows):
+        self.names: List[str] = list(names)
+        self.columns: List[DeviceColumn] = list(columns)
+        # num_rows may be a host int OR a traced jnp scalar (inside jit);
+        # host-side code that needs a concrete count calls int(batch.num_rows)
+        self.num_rows = int(num_rows) if isinstance(
+            num_rows, (int, np.integer)) else num_rows
+        if self.columns:
+            caps = {c.capacity for c in self.columns}
+            assert len(caps) == 1, f"ragged capacities {caps}"
+            self._capacity = caps.pop()
+        else:
+            self._capacity = bucket_rows(int(num_rows))
+
+    # num_rows travels as a leaf so jit does NOT specialize on it — only on
+    # capacity/schema (the XLA static-shape bucketing contract)
+    def tree_flatten(self):
+        leaves = tuple(self.columns) + (
+            jnp.asarray(self.num_rows, dtype=jnp.int32),)
+        return leaves, (tuple(self.names), self._capacity)
+
+    @classmethod
+    def tree_unflatten(cls, aux, children):
+        names, capacity = aux
+        *cols, num_rows = children
+        b = cls.__new__(cls)
+        b.names = list(names)
+        b.columns = list(cols)
+        b.num_rows = num_rows
+        b._capacity = capacity
+        return b
+
+    # ----------------------------------------------------------------------
+    @property
+    def capacity(self) -> int:
+        return self._capacity
+
+    @property
+    def num_cols(self) -> int:
+        return len(self.columns)
+
+    @property
+    def dtypes(self) -> List[dt.DType]:
+        return [c.dtype for c in self.columns]
+
+    def schema_key(self) -> Tuple:
+        """Hashable (schema, shape-bucket) key — the XLA compile-cache key."""
+        return (tuple(self.names),
+                tuple(c.dtype.id for c in self.columns),
+                self._capacity,
+                tuple(c.max_len if c.dtype.is_string else 0
+                      for c in self.columns))
+
+    def column(self, name: str) -> DeviceColumn:
+        return self.columns[self.names.index(name)]
+
+    def row_mask(self) -> jnp.ndarray:
+        return jnp.arange(self._capacity) < self.num_rows
+
+    def nbytes(self) -> int:
+        return sum(c.nbytes() for c in self.columns)
+
+    def with_columns(self, names: Sequence[str],
+                     columns: Sequence[DeviceColumn]) -> "DeviceBatch":
+        return DeviceBatch(names, columns, self.num_rows)
+
+    def select(self, names: Sequence[str]) -> "DeviceBatch":
+        return DeviceBatch(names, [self.column(n) for n in names],
+                           self.num_rows)
+
+    def __repr__(self) -> str:
+        cols = ", ".join(f"{n}:{c.dtype.name}" for n, c in
+                         zip(self.names, self.columns))
+        return (f"DeviceBatch(rows={int(self.num_rows)}/{self._capacity}, "
+                f"[{cols}])")
+
+
+# ---------------------------------------------------------------------------
+# Host (Arrow) <-> device conversion.  Analog of HostColumnarToGpu /
+# GpuColumnarToRowExec device<->host copies (reference:
+# HostColumnarToGpu.scala:30-291, GpuColumnarToRowExec.scala:38-306).
+# ---------------------------------------------------------------------------
+
+def _np_column_from_arrow(arr: pa.ChunkedArray | pa.Array,
+                          dtype: dt.DType, capacity: int
+                          ) -> Tuple[np.ndarray, np.ndarray, Optional[np.ndarray]]:
+    if isinstance(arr, pa.ChunkedArray):
+        arr = arr.combine_chunks()
+    n = len(arr)
+    validity = np.zeros(capacity, dtype=np.bool_)
+    validity[:n] = ~np.asarray(arr.is_null())
+
+    if dtype.is_string:
+        py = arr.to_pylist()
+        blens = [len(s.encode("utf-8")) if s is not None else 0 for s in py]
+        max_len = _bucket_strlen(max(blens, default=0))
+        data = np.zeros((capacity, max_len), dtype=np.uint8)
+        lengths = np.zeros(capacity, dtype=np.int32)
+        for i, s in enumerate(py):
+            if s is None:
+                continue
+            b = s.encode("utf-8")
+            lengths[i] = len(b)
+            if b:
+                data[i, :len(b)] = np.frombuffer(b, dtype=np.uint8)
+        return data, validity, lengths
+
+    np_dtype = dtype.to_np()
+    data = np.zeros(capacity, dtype=np_dtype)
+    if pa.types.is_timestamp(arr.type):
+        arr = arr.cast(pa.timestamp("us"))
+        vals = arr.to_numpy(zero_copy_only=False)
+        ints = vals.astype("datetime64[us]").astype(np.int64)
+        ints = np.where(validity[:n], ints, 0)
+        data[:n] = ints
+    elif pa.types.is_date32(arr.type):
+        vals = arr.to_numpy(zero_copy_only=False)
+        ints = vals.astype("datetime64[D]").astype(np.int64).astype(np.int32)
+        ints = np.where(validity[:n], ints, 0)
+        data[:n] = ints
+    else:
+        vals = arr.fill_null(_zero_value(dtype)).to_numpy(zero_copy_only=False)
+        data[:n] = vals.astype(np_dtype, copy=False)
+    return data, validity, None
+
+
+def _zero_value(dtype: dt.DType):
+    if dtype.is_bool:
+        return False
+    if dtype.is_floating:
+        return 0.0
+    return 0
+
+
+def from_arrow(table: pa.Table, min_bucket: int = 16,
+               capacity: Optional[int] = None) -> DeviceBatch:
+    """Upload an Arrow table into a padded DeviceBatch."""
+    n = table.num_rows
+    cap = capacity or bucket_rows(n, min_bucket)
+    names, cols = [], []
+    for field_, col in zip(table.schema, table.columns):
+        dtype = dt.from_arrow(field_.type)
+        if dtype is None:
+            raise TypeError(f"unsupported Arrow type {field_.type} "
+                            f"for column {field_.name}")
+        if dtype == dt.NULL:
+            dtype = dt.BOOL  # void columns materialize as all-null bool
+        data, validity, lengths = _np_column_from_arrow(col, dtype, cap)
+        names.append(field_.name)
+        cols.append(DeviceColumn(
+            dtype,
+            jnp.asarray(data),
+            jnp.asarray(validity),
+            jnp.asarray(lengths) if lengths is not None else None))
+    return DeviceBatch(names, cols, n)
+
+
+def to_arrow(batch: DeviceBatch) -> pa.Table:
+    """Download a DeviceBatch back to an Arrow table (strips padding)."""
+    n = int(batch.num_rows)
+    arrays, fields = [], []
+    for name, col in zip(batch.names, batch.columns):
+        validity = np.asarray(col.validity[:n])
+        mask = ~validity
+        if col.dtype.is_string:
+            data = np.asarray(col.data[:n])
+            lengths = np.asarray(col.lengths[:n])
+            py = []
+            for i in range(n):
+                if not validity[i]:
+                    py.append(None)
+                else:
+                    py.append(bytes(data[i, :lengths[i]]).decode(
+                        "utf-8", errors="replace"))
+            arr = pa.array(py, type=pa.string())
+        elif col.dtype.id == dt.TypeId.TIMESTAMP_US:
+            ints = np.asarray(col.data[:n]).astype("datetime64[us]")
+            arr = pa.array(ints, type=pa.timestamp("us", tz="UTC"),
+                           mask=mask)
+        elif col.dtype.id == dt.TypeId.DATE32:
+            days = np.asarray(col.data[:n]).astype("datetime64[D]")
+            arr = pa.array(days, type=pa.date32(), mask=mask)
+        else:
+            arr = pa.array(np.asarray(col.data[:n]), mask=mask)
+        arrays.append(arr)
+        fields.append(pa.field(name, arr.type))
+    return pa.Table.from_arrays(arrays, schema=pa.schema(fields))
+
+
+def concat_batches(batches: Sequence[DeviceBatch],
+                   min_bucket: int = 16) -> DeviceBatch:
+    """Device-side concatenation (analog of Table.concatenate used by
+    GpuCoalesceBatches, reference: GpuCoalesceBatches.scala:40-711)."""
+    batches = [b for b in batches if int(b.num_rows) > 0] or list(batches[:1])
+    if len(batches) == 1:
+        return batches[0]
+    total = sum(int(b.num_rows) for b in batches)
+    cap = bucket_rows(total, min_bucket)
+    names = batches[0].names
+    out_cols: List[DeviceColumn] = []
+    for ci, name in enumerate(names):
+        dtype = batches[0].columns[ci].dtype
+        if dtype.is_string:
+            max_len = max(b.columns[ci].max_len for b in batches)
+            datas, vals, lens = [], [], []
+            for b in batches:
+                c = b.columns[ci]
+                d = c.data[:int(b.num_rows)]
+                if c.max_len < max_len:
+                    d = jnp.pad(d, ((0, 0), (0, max_len - c.max_len)))
+                datas.append(d)
+                vals.append(c.validity[:int(b.num_rows)])
+                lens.append(c.lengths[:int(b.num_rows)])
+            data = jnp.concatenate(datas, axis=0)
+            data = jnp.pad(data, ((0, cap - total), (0, 0)))
+            validity = jnp.pad(jnp.concatenate(vals), (0, cap - total))
+            lengths = jnp.pad(jnp.concatenate(lens), (0, cap - total))
+            out_cols.append(DeviceColumn(dtype, data, validity, lengths))
+        else:
+            data = jnp.concatenate([b.columns[ci].data[:int(b.num_rows)]
+                                    for b in batches])
+            data = jnp.pad(data, (0, cap - total))
+            validity = jnp.pad(
+                jnp.concatenate([b.columns[ci].validity[:int(b.num_rows)]
+                                 for b in batches]), (0, cap - total))
+            out_cols.append(DeviceColumn(dtype, data, validity, None))
+    return DeviceBatch(names, out_cols, total)

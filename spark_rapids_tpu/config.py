@@ -1,0 +1,296 @@
+"""Typed, self-documenting configuration registry.
+
+TPU-native analog of the reference's ``RapidsConf`` system
+(reference: sql-plugin/.../RapidsConf.scala:269-281 — ``ConfEntry`` registry with
+typed builders, defaults, and doc generation via ``RapidsConf.main`` emitting
+docs/configs.md).
+
+Keys live under ``spark.rapids.tpu.*``.  Per-operator enable keys are derived
+automatically from exec/expression class names (reference:
+GpuOverrides.scala:131-139) — see :mod:`spark_rapids_tpu.plan.overrides`.
+"""
+
+from __future__ import annotations
+
+import threading
+from dataclasses import dataclass, field
+from typing import Any, Callable, Dict, List, Optional
+
+_REGISTRY: Dict[str, "ConfEntry"] = {}
+_REGISTRY_LOCK = threading.Lock()
+
+
+@dataclass(frozen=True)
+class ConfEntry:
+    """One typed configuration key with default + documentation.
+
+    Mirrors reference ``ConfEntry``/``ConfBuilder`` (RapidsConf.scala:180-281).
+    """
+
+    key: str
+    default: Any
+    doc: str
+    value_type: type
+    internal: bool = False
+    # converter applied to raw (string or typed) values at lookup time
+    converter: Optional[Callable[[Any], Any]] = None
+
+    def get(self, conf: "RapidsTpuConf") -> Any:
+        raw = conf._settings.get(self.key, self.default)
+        if raw is None:
+            return None
+        if self.converter is not None:
+            return self.converter(raw)
+        if self.value_type is bool and isinstance(raw, str):
+            return raw.strip().lower() in ("true", "1", "yes")
+        if self.value_type in (int, float) and isinstance(raw, str):
+            return self.value_type(raw)
+        return raw
+
+
+def _register(entry: ConfEntry) -> ConfEntry:
+    with _REGISTRY_LOCK:
+        if entry.key in _REGISTRY:
+            raise ValueError(f"duplicate conf key {entry.key}")
+        _REGISTRY[entry.key] = entry
+    return entry
+
+
+def conf(key: str, default: Any, doc: str, value_type: type = str,
+         internal: bool = False,
+         converter: Optional[Callable[[Any], Any]] = None) -> ConfEntry:
+    return _register(ConfEntry(key=key, default=default, doc=doc,
+                               value_type=value_type, internal=internal,
+                               converter=converter))
+
+
+# ---------------------------------------------------------------------------
+# Core keys (subset mirrors reference RapidsConf.scala; grows with features)
+# ---------------------------------------------------------------------------
+
+SQL_ENABLED = conf(
+    "spark.rapids.tpu.sql.enabled", True,
+    "Enable or disable TPU acceleration of SQL operators entirely.", bool)
+
+EXPLAIN = conf(
+    "spark.rapids.tpu.sql.explain", "NONE",
+    "Explain why parts of a query were or were not placed on the TPU: "
+    "NONE, NOT_ON_TPU, ALL. (reference: RapidsConf.scala:747, "
+    "GpuOverrides.scala:2054-2060)")
+
+INCOMPATIBLE_OPS = conf(
+    "spark.rapids.tpu.sql.incompatibleOps.enabled", False,
+    "Enable operators that produce results that differ from Spark in corner "
+    "cases (e.g. float aggregation ordering). (reference: RapidsConf.scala:424)",
+    bool)
+
+HAS_NANS = conf(
+    "spark.rapids.tpu.sql.hasNans", True,
+    "Assume floating point data may contain NaNs; disables some ops unless "
+    "false. (reference: RapidsConf.scala:431)", bool)
+
+VARIABLE_FLOAT_AGG = conf(
+    "spark.rapids.tpu.sql.variableFloatAgg.enabled", False,
+    "Allow float/double aggregations whose result may vary run-to-run due to "
+    "reduction ordering. (reference: RapidsConf.scala:437)", bool)
+
+IMPROVED_FLOAT_OPS = conf(
+    "spark.rapids.tpu.sql.improvedFloatOps.enabled", False,
+    "Enable float ops that are more accurate than Spark's but differ bit-wise.",
+    bool)
+
+BATCH_SIZE_BYTES = conf(
+    "spark.rapids.tpu.sql.batchSizeBytes", 2 << 30,
+    "Target size in bytes for coalesced columnar batches handed to one XLA "
+    "program invocation. (reference: RapidsConf.scala:364)", int)
+
+BATCH_SIZE_ROWS = conf(
+    "spark.rapids.tpu.sql.batchSizeRows", 1 << 21,
+    "Soft cap on rows per coalesced batch.", int)
+
+MIN_BUCKET_ROWS = conf(
+    "spark.rapids.tpu.sql.shape.minBucketRows", 16,
+    "Smallest padded row-capacity bucket. Batches are padded up to "
+    "power-of-two buckets so XLA recompiles are bounded (TPU static-shape "
+    "requirement; no reference analog — cudf tolerates dynamic shapes).", int)
+
+CONCURRENT_TPU_TASKS = conf(
+    "spark.rapids.tpu.sql.concurrentTpuTasks", 2,
+    "Number of tasks that may hold the TPU semaphore concurrently. "
+    "(reference: GpuSemaphore.scala:101, RapidsConf.scala)", int)
+
+TEST_ENABLED = conf(
+    "spark.rapids.tpu.sql.test.enabled", False,
+    "Test mode: assert that every supported operator actually ran on the TPU. "
+    "(reference: RapidsConf.scala:607-621, assertIsOnTheGpu)", bool)
+
+TEST_ALLOWED_NON_TPU = conf(
+    "spark.rapids.tpu.sql.test.allowedNonTpu", "",
+    "Comma-separated exec/expr class names allowed to stay on CPU in test "
+    "mode.")
+
+ALLOW_INCOMPAT_UTC_ONLY = conf(
+    "spark.rapids.tpu.sql.castStringToTimestamp.enabled", False,
+    "Enable string-to-timestamp casts (UTC only).", bool)
+
+MAX_READER_BATCH_SIZE_ROWS = conf(
+    "spark.rapids.tpu.sql.reader.batchSizeRows", 1 << 21,
+    "Max rows a file reader emits per batch. (reference: RapidsConf.scala:378)",
+    int)
+
+MAX_READER_BATCH_SIZE_BYTES = conf(
+    "spark.rapids.tpu.sql.reader.batchSizeBytes", 2 << 30,
+    "Max bytes a file reader emits per batch.", int)
+
+PARQUET_READER_TYPE = conf(
+    "spark.rapids.tpu.sql.format.parquet.reader.type", "AUTO",
+    "Parquet reader strategy: AUTO, PERFILE, COALESCING, MULTITHREADED. "
+    "(reference: RapidsConf.scala:513)")
+
+PARQUET_MULTITHREAD_READ_NUM_THREADS = conf(
+    "spark.rapids.tpu.sql.format.parquet.multiThreadedRead.numThreads", 20,
+    "Thread pool size for the MULTITHREADED cloud reader. "
+    "(reference: RapidsConf.scala:540)", int)
+
+CLOUD_SCHEMES = conf(
+    "spark.rapids.tpu.cloudSchemes", "gs,s3,s3a,s3n,wasbs,abfs",
+    "URI schemes treated as high-latency cloud stores (selects the "
+    "MULTITHREADED reader under AUTO).")
+
+MEM_POOL_FRACTION = conf(
+    "spark.rapids.tpu.memory.pool.fraction", 0.9,
+    "Fraction of free HBM the arena manages for columnar batches. "
+    "(reference: GpuDeviceManager.scala:196-262 RMM pool init)", float)
+
+MEM_SPILL_ENABLED = conf(
+    "spark.rapids.tpu.memory.spill.enabled", True,
+    "Enable device->host->disk spill of registered batches under memory "
+    "pressure. (reference: RapidsBufferCatalog.scala:128-142)", bool)
+
+MEM_HOST_SPILL_LIMIT = conf(
+    "spark.rapids.tpu.memory.host.spillStorageSize", 8 << 30,
+    "Bytes of host memory used to cache spilled device batches before "
+    "falling through to disk.", int)
+
+MEM_SPILL_DIR = conf(
+    "spark.rapids.tpu.memory.spill.dir", "",
+    "Directory for the disk spill tier (defaults to a temp dir).")
+
+SHUFFLE_TRANSPORT = conf(
+    "spark.rapids.tpu.shuffle.transport", "local",
+    "Shuffle transport implementation: 'local' (in-process Arrow IPC store, "
+    "the default-path analog) or 'ici' (device-resident all_to_all over a "
+    "jax Mesh; reference: shuffle-plugin UCX transport).")
+
+SHUFFLE_COMPRESSION_CODEC = conf(
+    "spark.rapids.tpu.shuffle.compression.codec", "none",
+    "Codec for serialized shuffle partitions: none, lz4 (pyarrow IPC "
+    "compression), zstd. (reference: TableCompressionCodec.scala:41)")
+
+SHUFFLE_PARTITIONS = conf(
+    "spark.rapids.tpu.sql.shuffle.partitions", 8,
+    "Default number of shuffle partitions (spark.sql.shuffle.partitions "
+    "analog).", int)
+
+ENABLE_FLOAT_SORT = conf(
+    "spark.rapids.tpu.sql.sort.float.enabled", True,
+    "Enable sorting on float columns (NaN ordering matches Spark: NaN sorts "
+    "greatest).", bool)
+
+UDF_COMPILER_ENABLED = conf(
+    "spark.rapids.tpu.sql.udfCompiler.enabled", True,
+    "Compile Python UDF bytecode into the expression IR so UDFs run on TPU. "
+    "(reference: udf-compiler Plugin.scala:29-34)", bool)
+
+METRICS_ENABLED = conf(
+    "spark.rapids.tpu.metrics.enabled", True,
+    "Collect per-operator metrics (totalTime, numOutputRows/Batches, "
+    "peakDevMemory). (reference: GpuExec.scala:27-56)", bool)
+
+
+class RapidsTpuConf:
+    """Accessor over a settings map; analog of ``new RapidsConf(conf)``."""
+
+    def __init__(self, settings: Optional[Dict[str, Any]] = None):
+        self._settings: Dict[str, Any] = dict(settings or {})
+
+    def get(self, entry: ConfEntry) -> Any:
+        return entry.get(self)
+
+    def get_raw(self, key: str, default: Any = None) -> Any:
+        return self._settings.get(key, default)
+
+    def set(self, key: str, value: Any) -> "RapidsTpuConf":
+        self._settings[key] = value
+        return self
+
+    def is_operator_enabled(self, key: str, incompat: bool,
+                            disabled_by_default: bool) -> bool:
+        """Per-operator kill-switch lookup (reference: GpuOverrides.scala:131)."""
+        raw = self._settings.get(key)
+        if raw is not None:
+            if isinstance(raw, str):
+                return raw.strip().lower() in ("true", "1", "yes")
+            return bool(raw)
+        if disabled_by_default:
+            return False
+        if incompat:
+            return self.get(INCOMPATIBLE_OPS)
+        return True
+
+    # -- convenience properties used widely ---------------------------------
+    @property
+    def sql_enabled(self) -> bool:
+        return self.get(SQL_ENABLED)
+
+    @property
+    def explain(self) -> str:
+        return str(self.get(EXPLAIN)).upper()
+
+    @property
+    def batch_size_bytes(self) -> int:
+        return self.get(BATCH_SIZE_BYTES)
+
+    @property
+    def shuffle_partitions(self) -> int:
+        return self.get(SHUFFLE_PARTITIONS)
+
+    @property
+    def test_enabled(self) -> bool:
+        return self.get(TEST_ENABLED)
+
+    @property
+    def test_allowed_non_tpu(self) -> List[str]:
+        raw = self.get(TEST_ALLOWED_NON_TPU) or ""
+        return [s.strip() for s in raw.split(",") if s.strip()]
+
+
+def registered_entries() -> List[ConfEntry]:
+    with _REGISTRY_LOCK:
+        return sorted(_REGISTRY.values(), key=lambda e: e.key)
+
+
+def generate_docs() -> str:
+    """Emit markdown docs for all keys.
+
+    Analog of ``RapidsConf.main`` -> docs/configs.md ("Generated by
+    RapidsConf.help. DO NOT EDIT!", reference RapidsConf.scala:885).
+    """
+    lines = [
+        "# spark-rapids-tpu Configuration",
+        "",
+        "<!-- Generated by spark_rapids_tpu.config.generate_docs. DO NOT EDIT! -->",
+        "",
+        "| Name | Default | Description |",
+        "|---|---|---|",
+    ]
+    for e in registered_entries():
+        if e.internal:
+            continue
+        doc = e.doc.replace("|", "\\|")
+        lines.append(f"| `{e.key}` | {e.default!r} | {doc} |")
+    return "\n".join(lines) + "\n"
+
+
+if __name__ == "__main__":  # python -m spark_rapids_tpu.config > docs/configs.md
+    print(generate_docs(), end="")

@@ -1,0 +1,187 @@
+"""Logical SQL dtypes and the dtype bridge: SQL <-> Arrow <-> JAX/XLA.
+
+TPU analog of the reference's cudf<->Spark type map
+(reference: sql-plugin/src/main/java/com/nvidia/spark/rapids/GpuColumnVector.java:153-197)
+and the central type-support gate ``isSupportedType``
+(reference: GpuOverrides.scala:459-504 — no decimal/binary/calendar-interval/
+nested by default; timestamps UTC-only, GpuOverrides.scala:490).
+
+On TPU, device columns are jax arrays:
+  * numeric/bool/date/timestamp -> 1-D array of the mapped jnp dtype
+  * string -> (uint8 [rows, max_len] byte matrix, int32 [rows] lengths)
+
+Timestamps are int64 microseconds since epoch UTC; dates are int32 days since
+epoch — identical to Arrow's ``timestamp[us, UTC]`` / ``date32`` physical
+layout, so host<->device conversion is a reinterpret, not a convert.
+"""
+
+from __future__ import annotations
+
+import enum
+from dataclasses import dataclass
+from typing import Optional
+
+import numpy as np
+import pyarrow as pa
+
+
+class TypeId(enum.Enum):
+    BOOL = "boolean"
+    INT8 = "tinyint"
+    INT16 = "smallint"
+    INT32 = "int"
+    INT64 = "bigint"
+    FLOAT32 = "float"
+    FLOAT64 = "double"
+    STRING = "string"
+    DATE32 = "date"
+    TIMESTAMP_US = "timestamp"
+    NULL = "void"
+
+
+@dataclass(frozen=True)
+class DType:
+    id: TypeId
+
+    @property
+    def name(self) -> str:
+        return self.id.value
+
+    # -- classification -----------------------------------------------------
+    @property
+    def is_numeric(self) -> bool:
+        return self.id in (TypeId.INT8, TypeId.INT16, TypeId.INT32,
+                           TypeId.INT64, TypeId.FLOAT32, TypeId.FLOAT64)
+
+    @property
+    def is_integral(self) -> bool:
+        return self.id in (TypeId.INT8, TypeId.INT16, TypeId.INT32, TypeId.INT64)
+
+    @property
+    def is_floating(self) -> bool:
+        return self.id in (TypeId.FLOAT32, TypeId.FLOAT64)
+
+    @property
+    def is_string(self) -> bool:
+        return self.id == TypeId.STRING
+
+    @property
+    def is_temporal(self) -> bool:
+        return self.id in (TypeId.DATE32, TypeId.TIMESTAMP_US)
+
+    @property
+    def is_bool(self) -> bool:
+        return self.id == TypeId.BOOL
+
+    # -- physical mapping ----------------------------------------------------
+    def to_np(self) -> np.dtype:
+        """Numpy/JAX physical dtype of the data buffer."""
+        return _NP_MAP[self.id]
+
+    def to_arrow(self) -> pa.DataType:
+        return _ARROW_MAP[self.id]
+
+    @property
+    def byte_width(self) -> int:
+        if self.id == TypeId.STRING:
+            return 16  # planning estimate; actual is data-dependent
+        return _NP_MAP[self.id].itemsize
+
+    def __repr__(self) -> str:
+        return f"DType({self.id.value})"
+
+
+BOOL = DType(TypeId.BOOL)
+INT8 = DType(TypeId.INT8)
+INT16 = DType(TypeId.INT16)
+INT32 = DType(TypeId.INT32)
+INT64 = DType(TypeId.INT64)
+FLOAT32 = DType(TypeId.FLOAT32)
+FLOAT64 = DType(TypeId.FLOAT64)
+STRING = DType(TypeId.STRING)
+DATE32 = DType(TypeId.DATE32)
+TIMESTAMP_US = DType(TypeId.TIMESTAMP_US)
+NULL = DType(TypeId.NULL)
+
+ALL_TYPES = [BOOL, INT8, INT16, INT32, INT64, FLOAT32, FLOAT64, STRING,
+             DATE32, TIMESTAMP_US]
+
+_NP_MAP = {
+    TypeId.BOOL: np.dtype(np.bool_),
+    TypeId.INT8: np.dtype(np.int8),
+    TypeId.INT16: np.dtype(np.int16),
+    TypeId.INT32: np.dtype(np.int32),
+    TypeId.INT64: np.dtype(np.int64),
+    TypeId.FLOAT32: np.dtype(np.float32),
+    TypeId.FLOAT64: np.dtype(np.float64),
+    TypeId.STRING: np.dtype(np.uint8),   # byte matrix payload
+    TypeId.DATE32: np.dtype(np.int32),
+    TypeId.TIMESTAMP_US: np.dtype(np.int64),
+    TypeId.NULL: np.dtype(np.bool_),
+}
+
+_ARROW_MAP = {
+    TypeId.BOOL: pa.bool_(),
+    TypeId.INT8: pa.int8(),
+    TypeId.INT16: pa.int16(),
+    TypeId.INT32: pa.int32(),
+    TypeId.INT64: pa.int64(),
+    TypeId.FLOAT32: pa.float32(),
+    TypeId.FLOAT64: pa.float64(),
+    TypeId.STRING: pa.string(),
+    TypeId.DATE32: pa.date32(),
+    TypeId.TIMESTAMP_US: pa.timestamp("us", tz="UTC"),
+    TypeId.NULL: pa.null(),
+}
+
+
+def from_arrow(t: pa.DataType) -> Optional[DType]:
+    """Map an Arrow type to a logical DType; None if unsupported.
+
+    The None path is the analog of ``isSupportedType`` returning false
+    (reference: GpuOverrides.scala:459-504): decimal, binary, nested, and
+    non-UTC timestamps are unsupported and force CPU fallback.
+    """
+    if pa.types.is_boolean(t):
+        return BOOL
+    if pa.types.is_int8(t):
+        return INT8
+    if pa.types.is_int16(t):
+        return INT16
+    if pa.types.is_int32(t):
+        return INT32
+    if pa.types.is_int64(t):
+        return INT64
+    if pa.types.is_float32(t):
+        return FLOAT32
+    if pa.types.is_float64(t):
+        return FLOAT64
+    if pa.types.is_string(t) or pa.types.is_large_string(t):
+        return STRING
+    if pa.types.is_date32(t):
+        return DATE32
+    if pa.types.is_timestamp(t):
+        if t.unit == "us" and t.tz in (None, "UTC"):
+            return TIMESTAMP_US
+        return None  # non-UTC / non-us timestamps unsupported (UTC-only rule)
+    if pa.types.is_null(t):
+        return NULL
+    return None
+
+
+# numeric promotion ladder for binary arithmetic (Spark's semantics)
+_PROMOTE_ORDER = [INT8, INT16, INT32, INT64, FLOAT32, FLOAT64]
+
+
+def promote(a: DType, b: DType) -> DType:
+    """Binary-op result type for two numeric types (Spark promotion rules)."""
+    if a == b:
+        return a
+    if not (a.is_numeric and b.is_numeric):
+        raise TypeError(f"cannot promote {a} and {b}")
+    # int64 + float32 -> float64 in Spark (to preserve precision-ish)
+    pair = {a.id, b.id}
+    if TypeId.FLOAT32 in pair and TypeId.INT64 in pair:
+        return FLOAT64
+    ia, ib = _PROMOTE_ORDER.index(a), _PROMOTE_ORDER.index(b)
+    return _PROMOTE_ORDER[max(ia, ib)]

@@ -1,0 +1,118 @@
+"""Physical plan contracts.
+
+Analog of ``trait GpuExec extends SparkPlan`` (reference: GpuExec.scala:58-102:
+``supportsColumnar=true``, ``doExecuteColumnar(): RDD[ColumnarBatch]``, and the
+batching contracts ``coalesceAfter``/``childrenCoalesceGoal``/``outputBatching``)
+plus the CoalesceGoal machinery (reference: GpuCoalesceBatches.scala:94-130).
+
+Execution model: ``execute()`` returns one Python iterator per partition.
+CPU execs yield ``pyarrow.Table`` batches; TPU execs yield ``DeviceBatch``.
+The planner guarantees the currencies never mix without an explicit
+transition exec (HostToDeviceExec / DeviceToHostExec — the
+GpuRowToColumnar/GpuColumnarToRow analogs).
+"""
+
+from __future__ import annotations
+
+import time
+from dataclasses import dataclass, field
+from typing import Any, Dict, Iterator, List, Optional, Tuple
+
+from spark_rapids_tpu.plan.logical import Schema
+
+
+# ---------------------------------------------------------------------------
+# Coalesce goals (reference: GpuCoalesceBatches.scala:94-130)
+# ---------------------------------------------------------------------------
+
+class CoalesceGoal:
+    pass
+
+
+@dataclass(frozen=True)
+class TargetSize(CoalesceGoal):
+    bytes: int
+
+
+class RequireSingleBatch(CoalesceGoal):
+    """Operator needs its whole input in one batch (total sort, hash-join
+    build side, final agg without partials; reference: GpuSortExec.scala:76)."""
+
+
+REQUIRE_SINGLE_BATCH = RequireSingleBatch()
+
+
+# ---------------------------------------------------------------------------
+# Metrics (reference: GpuMetricNames, GpuExec.scala:27-56)
+# ---------------------------------------------------------------------------
+
+@dataclass
+class Metrics:
+    num_output_rows: int = 0
+    num_output_batches: int = 0
+    total_time_ns: int = 0
+    peak_dev_memory: int = 0
+    extra: Dict[str, float] = field(default_factory=dict)
+
+
+class PhysicalPlan:
+    """Base physical node."""
+
+    children: Tuple["PhysicalPlan", ...] = ()
+
+    def __init__(self):
+        self.metrics = Metrics()
+
+    @property
+    def schema(self) -> Schema:
+        raise NotImplementedError
+
+    @property
+    def is_tpu(self) -> bool:
+        return False
+
+    def execute(self) -> List[Iterator[Any]]:
+        """One iterator of batches per partition."""
+        raise NotImplementedError
+
+    # batching contracts -----------------------------------------------------
+    def children_coalesce_goal(self) -> List[Optional[CoalesceGoal]]:
+        return [None] * len(self.children)
+
+    def output_batching(self) -> Optional[CoalesceGoal]:
+        return None
+
+    # display ---------------------------------------------------------------
+    def simple_string(self) -> str:
+        return type(self).__name__
+
+    def tree_string(self, indent: int = 0) -> str:
+        pad = "  " * indent
+        lines = [f"{pad}{'*' if self.is_tpu else ' '}{self.simple_string()}"]
+        for c in self.children:
+            lines.append(c.tree_string(indent + 1))
+        return "\n".join(lines)
+
+    def foreach(self, fn) -> None:
+        fn(self)
+        for c in self.children:
+            c.foreach(fn)
+
+
+class TpuExec(PhysicalPlan):
+    """Marker base for device-side execs (GpuExec analog)."""
+
+    @property
+    def is_tpu(self) -> bool:
+        return True
+
+
+def timed(metrics: Metrics):
+    class _T:
+        def __enter__(self):
+            self.t0 = time.perf_counter_ns()
+            return self
+
+        def __exit__(self, *a):
+            metrics.total_time_ns += time.perf_counter_ns() - self.t0
+    return _T()

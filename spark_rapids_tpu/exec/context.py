@@ -1,0 +1,34 @@
+"""Per-task evaluation context: partition id + running row offset.
+
+Analog of the TaskContext the reference's GpuSparkPartitionID /
+GpuMonotonicallyIncreasingID read (reference: GpuSparkPartitionID.scala,
+GpuMonotonicallyIncreasingID.scala).
+
+CPU execs set concrete ints.  TPU execs set *tracers* inside their jitted
+kernel (the kernel takes pid/offset as traced arguments), so one compiled
+kernel serves every partition — the context var only ever holds values for
+the duration of a single evaluate() call.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import contextvars
+from typing import Any, Tuple
+
+_CTX: contextvars.ContextVar[Tuple[Any, Any]] = contextvars.ContextVar(
+    "spark_rapids_tpu_eval_ctx", default=(0, 0))
+
+
+def get() -> Tuple[Any, Any]:
+    """(partition_id, row_offset) — ints on CPU, possibly tracers on TPU."""
+    return _CTX.get()
+
+
+@contextlib.contextmanager
+def task_context(partition_id, row_offset):
+    token = _CTX.set((partition_id, row_offset))
+    try:
+        yield
+    finally:
+        _CTX.reset(token)

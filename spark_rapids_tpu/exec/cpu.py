@@ -1,0 +1,432 @@
+"""CPU physical execs — the engine's "stock Spark" execution path.
+
+In the reference, unsupported operators stay as Spark's own CPU execs
+(reference: RapidsMeta.scala:605-624 convertIfNeeded keeps original nodes).
+We are standalone, so these execs play that role: a complete, independent
+columnar CPU engine over pyarrow, used (a) as the fallback target for
+anything the TPU path can't run, and (b) as the oracle side of the parity
+test harness (reference: SparkQueryCompareTestSuite).
+
+Batch currency: ``pyarrow.Table``.
+"""
+
+from __future__ import annotations
+
+import itertools
+from typing import Any, Iterator, List, Optional, Sequence
+
+import numpy as np
+import pyarrow as pa
+import pyarrow.compute as pc
+
+from spark_rapids_tpu import dtypes as dt
+from spark_rapids_tpu.expr import eval_cpu, ir
+from spark_rapids_tpu.plan.logical import Field, Schema, SortOrder
+from spark_rapids_tpu.exec.base import PhysicalPlan
+
+
+def _empty_table(schema: Schema) -> pa.Table:
+    return pa.Table.from_arrays(
+        [pa.array([], type=f.dtype.to_arrow()) for f in schema.fields],
+        names=schema.names)
+
+
+def concat_tables(tables: List[pa.Table], schema: Schema) -> pa.Table:
+    if not tables:
+        return _empty_table(schema)
+    if len(tables) == 1:
+        return tables[0]
+    # no schema promotion: batches of one plan share a schema, and joins
+    # legitimately produce duplicate column names that unification rejects
+    return pa.concat_tables(tables)
+
+
+class CpuScanExec(PhysicalPlan):
+    def __init__(self, table: pa.Table, num_partitions: int = 1,
+                 max_batch_rows: int = 1 << 20):
+        super().__init__()
+        self.table = table
+        self.num_partitions = max(1, num_partitions)
+        self.max_batch_rows = max_batch_rows
+        self._schema = Schema.from_arrow(table.schema)
+
+    @property
+    def schema(self) -> Schema:
+        return self._schema
+
+    def execute(self) -> List[Iterator[pa.Table]]:
+        n = self.table.num_rows
+        per = (n + self.num_partitions - 1) // self.num_partitions or 1
+
+        def part(i: int) -> Iterator[pa.Table]:
+            lo = min(i * per, n)
+            hi = min(lo + per, n)
+            chunk = self.table.slice(lo, hi - lo)
+            for off in range(0, max(chunk.num_rows, 1), self.max_batch_rows):
+                yield chunk.slice(off, self.max_batch_rows)
+                if chunk.num_rows == 0:
+                    break
+        return [part(i) for i in range(self.num_partitions)]
+
+    def simple_string(self) -> str:
+        return f"CpuScanExec(rows={self.table.num_rows})"
+
+
+class CpuRangeExec(PhysicalPlan):
+    def __init__(self, start: int, end: int, step: int, num_partitions: int):
+        super().__init__()
+        self.start, self.end, self.step = start, end, step
+        self.num_partitions = max(1, num_partitions)
+        self._schema = Schema([Field("id", dt.INT64, False)])
+
+    @property
+    def schema(self) -> Schema:
+        return self._schema
+
+    def execute(self) -> List[Iterator[pa.Table]]:
+        vals = np.arange(self.start, self.end, self.step, dtype=np.int64)
+        per = (len(vals) + self.num_partitions - 1) // self.num_partitions or 1
+
+        def part(i):
+            chunk = vals[i * per:(i + 1) * per]
+            yield pa.Table.from_arrays([pa.array(chunk)], names=["id"])
+        return [part(i) for i in range(self.num_partitions)]
+
+
+class CpuProjectExec(PhysicalPlan):
+    def __init__(self, child: PhysicalPlan, exprs: Sequence[ir.Expression],
+                 schema: Schema):
+        super().__init__()
+        self.children = (child,)
+        self.exprs = list(exprs)
+        self._schema = schema
+
+    @property
+    def schema(self) -> Schema:
+        return self._schema
+
+    def execute(self):
+        from spark_rapids_tpu.exec import context
+
+        def run(pid, it):
+            offset = 0
+            for t in it:
+                with context.task_context(pid, offset):
+                    arrays = [eval_cpu.to_arrow_array(
+                        eval_cpu.evaluate(e, t)) for e in self.exprs]
+                offset += t.num_rows
+                yield pa.Table.from_arrays(arrays, names=self._schema.names)
+        return [run(pid, it) for pid, it in
+                enumerate(self.children[0].execute())]
+
+
+class CpuFilterExec(PhysicalPlan):
+    def __init__(self, child: PhysicalPlan, condition: ir.Expression):
+        super().__init__()
+        self.children = (child,)
+        self.condition = condition
+
+    @property
+    def schema(self) -> Schema:
+        return self.children[0].schema
+
+    def execute(self):
+        def run(it):
+            for t in it:
+                v = eval_cpu.evaluate(self.condition, t)
+                mask = v.data.astype(bool) & v.valid
+                yield t.filter(pa.array(mask))
+        return [run(it) for it in self.children[0].execute()]
+
+
+class CpuUnionExec(PhysicalPlan):
+    def __init__(self, children: Sequence[PhysicalPlan]):
+        super().__init__()
+        self.children = tuple(children)
+
+    @property
+    def schema(self) -> Schema:
+        return self.children[0].schema
+
+    def execute(self):
+        parts: List[Iterator[pa.Table]] = []
+        for c in self.children:
+            parts.extend(c.execute())
+        return parts
+
+
+class CpuLimitExec(PhysicalPlan):
+    """Global limit: concatenates partitions in order and takes n rows."""
+
+    def __init__(self, child: PhysicalPlan, n: int):
+        super().__init__()
+        self.children = (child,)
+        self.n = n
+
+    @property
+    def schema(self) -> Schema:
+        return self.children[0].schema
+
+    def execute(self):
+        def run():
+            remaining = self.n
+            for it in self.children[0].execute():
+                for t in it:
+                    if remaining <= 0:
+                        return
+                    take = min(remaining, t.num_rows)
+                    remaining -= take
+                    yield t.slice(0, take)
+        return [run()]
+
+
+def _gather_single(child: PhysicalPlan, schema: Schema) -> pa.Table:
+    tables = []
+    for it in child.execute():
+        tables.extend(list(it))
+    return concat_tables(tables, schema)
+
+
+class CpuSortExec(PhysicalPlan):
+    def __init__(self, child: PhysicalPlan, orders: Sequence[SortOrder]):
+        super().__init__()
+        self.children = (child,)
+        self.orders = list(orders)
+
+    @property
+    def schema(self) -> Schema:
+        return self.children[0].schema
+
+    def execute(self):
+        def run():
+            t = _gather_single(self.children[0], self.schema)
+            key_names = []
+            key_arrays = []
+            sort_keys = []
+            for i, o in enumerate(self.orders):
+                name = f"__sort_{i}"
+                v = eval_cpu.evaluate(o.expr, t)
+                key_names.append(name)
+                key_arrays.append(eval_cpu.to_arrow_array(v))
+                sort_keys.append((name, "ascending" if o.ascending
+                                  else "descending"))
+            keyed = t
+            for n_, a in zip(key_names, key_arrays):
+                keyed = keyed.append_column(n_, a)
+            # Spark: nulls_first default matches ascending; arrow option is
+            # global so sort per-key from least significant using stable sort
+            idx = np.arange(t.num_rows)
+            for (name, order), o in zip(reversed(sort_keys),
+                                        reversed(self.orders)):
+                col = keyed.column(name).combine_chunks()
+                sub = col.take(pa.array(idx))
+                order_idx = pc.sort_indices(
+                    sub, sort_keys=[("", order)],
+                    null_placement="at_start" if o.nulls_first_resolved
+                    else "at_end")
+                idx = idx[np.asarray(order_idx)]
+            yield t.take(pa.array(idx))
+        return [run()]
+
+
+_AGG_MAP = {
+    ir.Sum: "sum",
+    ir.Min: "min",
+    ir.Max: "max",
+    ir.Average: "mean",
+}
+
+
+class CpuHashAggregateExec(PhysicalPlan):
+    def __init__(self, child: PhysicalPlan,
+                 groupings: Sequence[ir.Expression],
+                 aggregates: Sequence[ir.Expression],
+                 schema: Schema):
+        super().__init__()
+        self.children = (child,)
+        self.groupings = list(groupings)
+        self.aggregates = list(aggregates)
+        self._schema = schema
+
+    @property
+    def schema(self) -> Schema:
+        return self._schema
+
+    def _agg_arrays(self, t: pa.Table) -> pa.Table:
+        """Project grouping keys and agg inputs with temp names."""
+        arrays, names = [], []
+        for i, g in enumerate(self.groupings):
+            arrays.append(eval_cpu.to_arrow_array(eval_cpu.evaluate(g, t)))
+            names.append(f"__k{i}")
+        for i, a in enumerate(self.aggregates):
+            child = a.child
+            if child is None:
+                col = pa.array(np.ones(t.num_rows, dtype=np.int64))
+            else:
+                col = eval_cpu.to_arrow_array(eval_cpu.evaluate(child, t))
+            arrays.append(col)
+            names.append(f"__a{i}")
+        return pa.Table.from_arrays(arrays, names=names)
+
+    def execute(self):
+        def run():
+            t = _gather_single(self.children[0], self.children[0].schema)
+            proj = self._agg_arrays(t)
+            key_names = [f"__k{i}" for i in range(len(self.groupings))]
+            aggs = []
+            out_names_in_result = []
+            count_modes = {}
+            for i, a in enumerate(self.aggregates):
+                if isinstance(a, ir.Count):
+                    mode = "all" if a.child is None else "only_valid"
+                    count_modes[f"__a{i}"] = mode
+                    aggs.append((f"__a{i}", "count",
+                                 pc.CountOptions(mode=mode)))
+                    out_names_in_result.append(f"__a{i}_count")
+                elif isinstance(a, ir.First):
+                    aggs.append((f"__a{i}", "first", pc.ScalarAggregateOptions(
+                        skip_nulls=a.ignore_nulls)))
+                    out_names_in_result.append(f"__a{i}_first")
+                elif isinstance(a, ir.Last):
+                    aggs.append((f"__a{i}", "last", pc.ScalarAggregateOptions(
+                        skip_nulls=a.ignore_nulls)))
+                    out_names_in_result.append(f"__a{i}_last")
+                else:
+                    fn = _AGG_MAP[type(a)]
+                    aggs.append((f"__a{i}", fn))
+                    out_names_in_result.append(f"__a{i}_{fn}")
+
+            if key_names:
+                res = proj.group_by(key_names, use_threads=False).aggregate(
+                    aggs)
+            else:
+                # global aggregation (always exactly one output row)
+                cols, names2 = [], []
+                for (col_name, fn, *opt), oname in zip(aggs,
+                                                       out_names_in_result):
+                    c = proj.column(col_name).combine_chunks()
+                    options = opt[0] if opt else None
+                    if fn == "count":
+                        val = pc.count(c, mode=count_modes.get(
+                            col_name, "only_valid"))
+                    elif fn == "first":
+                        cc = c.drop_null() if (options and
+                                               options.skip_nulls) else c
+                        val = cc[0] if len(cc) else pa.scalar(None, c.type)
+                    elif fn == "last":
+                        cc = c.drop_null() if (options and
+                                               options.skip_nulls) else c
+                        val = cc[-1] if len(cc) else pa.scalar(None, c.type)
+                    else:
+                        val = getattr(pc, fn)(c)
+                    cols.append(pa.array([val.as_py()],
+                                         type=getattr(val, "type", None)))
+                    names2.append(oname)
+                res = pa.Table.from_arrays(cols, names=names2)
+
+            # assemble final output: keys then aggs with target dtypes
+            out_arrays = []
+            for i in range(len(self.groupings)):
+                out_arrays.append(res.column(f"__k{i}") if key_names else None)
+            for i, a in enumerate(self.aggregates):
+                col = res.column(out_names_in_result[i])
+                tgt = self._schema.fields[len(self.groupings) + i].dtype
+                col = col.cast(tgt.to_arrow())
+                out_arrays.append(col)
+            arrays = [a for a in out_arrays if a is not None]
+            yield pa.Table.from_arrays(arrays, names=self._schema.names)
+        return [run()]
+
+
+class CpuExpandExec(PhysicalPlan):
+    def __init__(self, child: PhysicalPlan,
+                 projections: Sequence[Sequence[ir.Expression]],
+                 schema: Schema):
+        super().__init__()
+        self.children = (child,)
+        self.projections = projections
+        self._schema = schema
+
+    @property
+    def schema(self) -> Schema:
+        return self._schema
+
+    def execute(self):
+        def run(it):
+            for t in it:
+                for proj in self.projections:
+                    arrays = [eval_cpu.to_arrow_array(
+                        eval_cpu.evaluate(e, t)) for e in proj]
+                    yield pa.Table.from_arrays(arrays,
+                                               names=self._schema.names)
+        return [run(it) for it in self.children[0].execute()]
+
+
+class CpuJoinExec(PhysicalPlan):
+    """Hash join via pyarrow Table.join (+ cross join by replication)."""
+
+    _HOW_MAP = {
+        "inner": "inner",
+        "left": "left outer",
+        "right": "right outer",
+        "full": "full outer",
+        "semi": "left semi",
+        "anti": "left anti",
+    }
+
+    def __init__(self, left: PhysicalPlan, right: PhysicalPlan,
+                 left_keys: Sequence[str], right_keys: Sequence[str],
+                 how: str, condition: Optional[ir.Expression],
+                 schema: Schema):
+        super().__init__()
+        self.children = (left, right)
+        self.left_keys, self.right_keys = list(left_keys), list(right_keys)
+        self.how = how
+        self.condition = condition
+        self._schema = schema
+
+    @property
+    def schema(self) -> Schema:
+        return self._schema
+
+    def _exec_cross(self, lt: pa.Table, rt: pa.Table) -> pa.Table:
+        li = np.repeat(np.arange(lt.num_rows), rt.num_rows)
+        ri = np.tile(np.arange(rt.num_rows), lt.num_rows)
+        left = lt.take(pa.array(li))
+        right = rt.take(pa.array(ri))
+        arrays = list(left.columns) + list(right.columns)
+        return pa.Table.from_arrays(arrays, names=self._schema.names)
+
+    def execute(self):
+        def run():
+            lt = _gather_single(self.children[0], self.children[0].schema)
+            rt = _gather_single(self.children[1], self.children[1].schema)
+            if self.how == "cross":
+                out = self._exec_cross(lt, rt)
+            else:
+                # rename to positional names to avoid collisions; duplicate
+                # right keys so they survive arrow's key coalescing
+                ln = [f"__l{i}" for i in range(lt.num_columns)]
+                rn = [f"__r{i}" for i in range(rt.num_columns)]
+                lt2 = lt.rename_columns(ln)
+                rt2 = rt.rename_columns(rn)
+                lk = [f"__l{lt.column_names.index(k)}" for k in self.left_keys]
+                rk = [f"__r{rt.column_names.index(k)}" for k in
+                      self.right_keys]
+                joined = lt2.join(
+                    rt2, keys=lk, right_keys=rk,
+                    join_type=self._HOW_MAP[self.how],
+                    coalesce_keys=False, use_threads=False)
+                if self.how in ("semi", "anti"):
+                    out = pa.Table.from_arrays(
+                        [joined.column(n) for n in ln],
+                        names=self._schema.names)
+                else:
+                    out = pa.Table.from_arrays(
+                        [joined.column(n) for n in ln + rn],
+                        names=self._schema.names)
+            if self.condition is not None:
+                v = eval_cpu.evaluate(self.condition, out)
+                out = out.filter(pa.array(v.data.astype(bool) & v.valid))
+            yield out
+        return [run()]

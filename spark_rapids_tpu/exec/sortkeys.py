@@ -1,0 +1,129 @@
+"""Total-order sort-key encoding for device columns.
+
+cudf's ``Table.orderBy``/``groupBy`` sort natively on any column type
+(reference: GpuSortExec.scala:51-265, aggregate.scala).  XLA has only numeric
+sorts, so every column is *encoded* into one or more unsigned integer keys
+whose ascending numeric order equals the column's SQL order:
+
+  * ints/dates/timestamps: sign-bit flip -> uint64
+  * floats: IEEE total-order transform (negatives bit-flipped), after
+    canonicalizing NaN and -0.0 (Spark: NaN greatest, NaN==NaN, -0.0==0.0)
+  * bools: 0/1
+  * strings: bytes packed big-endian into uint64 words (exact lexicographic,
+    zero-padded) + length tiebreaker
+  * nulls: a leading 0/1 key implementing NULLS FIRST/LAST
+  * descending: bitwise complement of each key
+
+``jnp.lexsort`` over the resulting key stack is then an exact multi-column
+SQL sort.  The same encoding gives grouping adjacency for the sort-based
+hash-aggregate and the sort-merge join.
+"""
+
+from __future__ import annotations
+
+from typing import List
+
+import numpy as np
+
+import jax.numpy as jnp
+
+from spark_rapids_tpu import dtypes as dt
+from spark_rapids_tpu.expr.eval_tpu import ColVal
+
+_SIGN64 = np.uint64(0x8000000000000000)
+
+
+def _int_key(data: jnp.ndarray) -> jnp.ndarray:
+    u = data.astype(jnp.int64).view(jnp.uint64)
+    return u ^ _SIGN64
+
+
+def _float_key(data: jnp.ndarray, is32: bool) -> jnp.ndarray:
+    x = data
+    # canonicalize: -0.0 -> 0.0, NaN -> canonical quiet NaN (positive)
+    x = jnp.where(x == 0.0, jnp.zeros_like(x), x)
+    x = jnp.where(jnp.isnan(x), jnp.array(np.nan, dtype=x.dtype), x)
+    if is32:
+        bits = x.view(jnp.int32).astype(jnp.int64)
+        bits = bits << 32  # keep ordering in the top bits
+    else:
+        bits = x.view(jnp.int64)
+    u = bits.view(jnp.uint64)
+    neg = bits < 0
+    return jnp.where(neg, ~u, u ^ _SIGN64)
+
+
+def encode_keys(v: ColVal, ascending: bool = True,
+                nulls_first: bool = True) -> List[jnp.ndarray]:
+    """Encode one column into uint64 keys, most-significant first."""
+    keys: List[jnp.ndarray] = []
+    null_key = jnp.where(v.validity,
+                         jnp.uint64(1 if nulls_first else 0),
+                         jnp.uint64(0 if nulls_first else 1))
+    keys.append(null_key)
+
+    d = v.dtype
+    if d.is_string:
+        w = v.data.shape[1]
+        for word_start in range(0, w, 8):
+            word = jnp.zeros(v.data.shape[0], dtype=jnp.uint64)
+            for k in range(8):
+                j = word_start + k
+                if j < w:
+                    byte = v.data[:, j].astype(jnp.uint64)
+                    word = word | (byte << (8 * (7 - k)))
+            keys.append(word)
+        keys.append(v.lengths.astype(jnp.uint64))
+    elif d.is_floating:
+        keys.append(_float_key(v.data, d.id == dt.TypeId.FLOAT32))
+    elif d.is_bool:
+        keys.append(v.data.astype(jnp.uint64))
+    else:
+        keys.append(_int_key(v.data))
+
+    if not ascending:
+        keys = [keys[0]] + [~k for k in keys[1:]]
+        # null placement key already encodes nulls_first; invert only values
+    # null rows: zero out value keys so equal nulls tie deterministically
+    for i in range(1, len(keys)):
+        keys[i] = jnp.where(v.validity, keys[i], jnp.uint64(0))
+    return keys
+
+
+def lexsort_indices(key_groups: List[List[jnp.ndarray]],
+                    row_mask: jnp.ndarray) -> jnp.ndarray:
+    """Stable sort indices; padding rows always sort to the end.
+
+    key_groups: per sort column (primary first), the encode_keys output.
+    """
+    flat: List[jnp.ndarray] = []
+    for group in key_groups:
+        flat.extend(group)
+    # jnp.lexsort: LAST key is primary -> feed least-significant first,
+    # padding key (most significant of all) last
+    pad_key = (~row_mask).astype(jnp.uint8)
+    stacked = list(reversed(flat)) + [pad_key]
+    return jnp.lexsort(tuple(stacked))
+
+
+def group_boundaries(key_groups: List[List[jnp.ndarray]],
+                     order: jnp.ndarray,
+                     row_mask: jnp.ndarray) -> jnp.ndarray:
+    """After sorting with `order`, mark rows that start a new key group.
+
+    Null keys compare equal (SQL GROUP BY semantics).  Padding rows always
+    start their own group so they never merge into the last real group.
+    """
+    n = order.shape[0]
+    sorted_mask = jnp.take(row_mask, order)
+    new_group = jnp.zeros((n,), dtype=jnp.bool_).at[0].set(True)
+    for group in key_groups:
+        for k in group:
+            ks = jnp.take(k, order)
+            diff = jnp.concatenate(
+                [jnp.ones((1,), dtype=jnp.bool_), ks[1:] != ks[:-1]])
+            new_group = new_group | diff
+    prev_mask = jnp.concatenate(
+        [jnp.ones((1,), dtype=jnp.bool_), sorted_mask[:-1]])
+    new_group = new_group | (sorted_mask != prev_mask)
+    return new_group

@@ -1,0 +1,475 @@
+"""TPU hash-aggregate exec.
+
+Analog of ``GpuHashAggregateExec`` (reference: aggregate.scala:302-997):
+per-batch *update* aggregation, buffered partial results, concat, *merge*
+aggregation, then a final projection — the exact three-phase flow of the
+reference (see comments at aggregate.scala:326-421), with cudf's
+``Table.groupBy.aggregate`` replaced by a TPU-friendly sort-based segmented
+reduction:
+
+  1. encode grouping keys to total-order uint64 keys (exec/sortkeys.py)
+  2. one stable ``jnp.lexsort`` brings equal keys adjacent
+  3. group boundaries -> segment ids; ``jax.ops.segment_{sum,min,max}``
+     computes every aggregate in fixed-shape space
+  4. group count is the only host sync (the new batch's num_rows)
+
+Aggregate functions follow the reference's update/merge pair structure
+(reference: AggregateFunctions.scala:531 — each ``CudfAggregate`` declares
+updateAggregate and mergeAggregate).  NaN/-0.0 key canonicalization matches
+Spark's NormalizeFloatingNumbers semantics (parity-critical).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import List, Optional, Sequence, Tuple
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from spark_rapids_tpu import dtypes as dt
+from spark_rapids_tpu.columnar.batch import (DeviceBatch, DeviceColumn,
+                                             bucket_rows, concat_batches)
+from spark_rapids_tpu.exec.base import PhysicalPlan, TpuExec, timed
+from spark_rapids_tpu.exec import sortkeys
+from spark_rapids_tpu.expr import eval_tpu, ir
+from spark_rapids_tpu.expr.eval_tpu import ColVal
+from spark_rapids_tpu.plan.logical import Schema
+
+_BIG = np.int64(1 << 62)
+
+
+@dataclass
+class _SortedCtx:
+    """Sorted-space context shared by all aggregate updates in one kernel."""
+
+    order: jnp.ndarray        # sorted row order (original indices)
+    seg_sorted: jnp.ndarray   # group id per sorted row
+    seg_orig: jnp.ndarray     # group id per original row
+    cap: int
+    row_mask: jnp.ndarray     # original-space "row exists"
+    n_groups: jnp.ndarray     # scalar
+
+
+def _seg_sum(x, seg, cap):
+    return jax.ops.segment_sum(x, seg, num_segments=cap)
+
+
+def _seg_min(x, seg, cap):
+    return jax.ops.segment_min(x, seg, num_segments=cap)
+
+
+def _seg_max(x, seg, cap):
+    return jax.ops.segment_max(x, seg, num_segments=cap)
+
+
+class _AggSpec:
+    """update/merge/finalize triple for one aggregate function."""
+
+    n_buffers = 1
+
+    def __init__(self, agg: ir.AggregateExpression):
+        self.agg = agg
+
+    def update(self, v: Optional[ColVal], ctx: _SortedCtx
+               ) -> List[Tuple[jnp.ndarray, jnp.ndarray]]:
+        raise NotImplementedError
+
+    def merge(self, bufs: List[DeviceColumn], ctx: _SortedCtx
+              ) -> List[Tuple[jnp.ndarray, jnp.ndarray]]:
+        raise NotImplementedError
+
+    def finalize(self, bufs: List[DeviceColumn]) -> ColVal:
+        raise NotImplementedError
+
+    def buffer_dtypes(self) -> List[dt.DType]:
+        raise NotImplementedError
+
+
+class _CountSpec(_AggSpec):
+    def buffer_dtypes(self):
+        return [dt.INT64]
+
+    def update(self, v, ctx):
+        if v is None:  # COUNT(*)
+            ones = ctx.row_mask.astype(jnp.int64)
+        else:
+            ones = (v.validity & ctx.row_mask).astype(jnp.int64)
+        c = _seg_sum(ones, ctx.seg_orig, ctx.cap)
+        return [(c, jnp.ones((ctx.cap,), dtype=jnp.bool_))]
+
+    def merge(self, bufs, ctx):
+        c = _seg_sum(jnp.where(ctx.row_mask, bufs[0].data, 0),
+                     ctx.seg_orig, ctx.cap)
+        return [(c, jnp.ones((ctx.cap,), dtype=jnp.bool_))]
+
+    def finalize(self, bufs):
+        return ColVal(dt.INT64, bufs[0].data,
+                      jnp.ones_like(bufs[0].validity))
+
+
+class _SumSpec(_AggSpec):
+    n_buffers = 2  # sum, valid-input count
+
+    def buffer_dtypes(self):
+        return [self.agg.dtype, dt.INT64]
+
+    def _sum(self, data, validity, ctx):
+        tgt = self.agg.dtype.to_np()
+        x = jnp.where(validity & ctx.row_mask, data.astype(tgt), 0)
+        s = _seg_sum(x, ctx.seg_orig, ctx.cap)
+        c = _seg_sum((validity & ctx.row_mask).astype(jnp.int64),
+                     ctx.seg_orig, ctx.cap)
+        return [(s, c > 0), (c, jnp.ones((ctx.cap,), dtype=jnp.bool_))]
+
+    def update(self, v, ctx):
+        return self._sum(v.data, v.validity, ctx)
+
+    def merge(self, bufs, ctx):
+        tgt = self.agg.dtype.to_np()
+        x = jnp.where(bufs[0].validity & ctx.row_mask,
+                      bufs[0].data.astype(tgt), 0)
+        s = _seg_sum(x, ctx.seg_orig, ctx.cap)
+        c = _seg_sum(jnp.where(ctx.row_mask, bufs[1].data, 0),
+                     ctx.seg_orig, ctx.cap)
+        return [(s, c > 0), (c, jnp.ones((ctx.cap,), dtype=jnp.bool_))]
+
+    def finalize(self, bufs):
+        return ColVal(self.agg.dtype, bufs[0].data, bufs[0].validity)
+
+
+class _MinMaxSpec(_AggSpec):
+    def __init__(self, agg, is_min: bool):
+        super().__init__(agg)
+        self.is_min = is_min
+
+    def buffer_dtypes(self):
+        return [self.agg.dtype]
+
+    def _reduce(self, data, validity, ctx):
+        d = self.agg.dtype
+        tgt = d.to_np()
+        considered = validity & ctx.row_mask
+        if d.is_floating:
+            isnan = jnp.isnan(data)
+            non_nan = considered & ~isnan
+            fill = np.array(np.inf if self.is_min else -np.inf, dtype=tgt)
+            x = jnp.where(non_nan, data, fill)
+            red = _seg_min(x, ctx.seg_orig, ctx.cap) if self.is_min \
+                else _seg_max(x, ctx.seg_orig, ctx.cap)
+            has_non_nan = _seg_sum(non_nan.astype(jnp.int32),
+                                   ctx.seg_orig, ctx.cap) > 0
+            has_nan = _seg_sum((considered & isnan).astype(jnp.int32),
+                               ctx.seg_orig, ctx.cap) > 0
+            has_any = has_non_nan | has_nan
+            nan = np.array(np.nan, dtype=tgt)
+            if self.is_min:
+                # Spark: NaN is greatest -> min prefers non-NaN
+                val = jnp.where(has_non_nan, red, nan)
+            else:
+                # max: any NaN wins
+                val = jnp.where(has_nan, nan, red)
+            return [(jnp.where(has_any, val, 0), has_any)]
+        if d.is_string:
+            raise NotImplementedError("min/max over strings on TPU")
+        if d.is_bool:
+            x = jnp.where(considered, data,
+                          jnp.array(not self.is_min, dtype=bool))
+            red = _seg_min(x.astype(jnp.int32), ctx.seg_orig, ctx.cap) \
+                if self.is_min else _seg_max(x.astype(jnp.int32),
+                                             ctx.seg_orig, ctx.cap)
+            has = _seg_sum(considered.astype(jnp.int32),
+                           ctx.seg_orig, ctx.cap) > 0
+            return [(red.astype(bool) & has, has)]
+        info = np.iinfo(tgt)
+        fill = np.array(info.max if self.is_min else info.min, dtype=tgt)
+        x = jnp.where(considered, data.astype(tgt), fill)
+        red = _seg_min(x, ctx.seg_orig, ctx.cap) if self.is_min \
+            else _seg_max(x, ctx.seg_orig, ctx.cap)
+        has = _seg_sum(considered.astype(jnp.int32), ctx.seg_orig,
+                       ctx.cap) > 0
+        return [(jnp.where(has, red, 0), has)]
+
+    def update(self, v, ctx):
+        return self._reduce(v.data, v.validity, ctx)
+
+    def merge(self, bufs, ctx):
+        return self._reduce(bufs[0].data, bufs[0].validity, ctx)
+
+    def finalize(self, bufs):
+        return ColVal(self.agg.dtype, bufs[0].data, bufs[0].validity)
+
+
+class _AverageSpec(_AggSpec):
+    n_buffers = 2  # sum f64, count i64
+
+    def buffer_dtypes(self):
+        return [dt.FLOAT64, dt.INT64]
+
+    def update(self, v, ctx):
+        considered = v.validity & ctx.row_mask
+        x = jnp.where(considered, v.data.astype(jnp.float64), 0.0)
+        s = _seg_sum(x, ctx.seg_orig, ctx.cap)
+        c = _seg_sum(considered.astype(jnp.int64), ctx.seg_orig, ctx.cap)
+        ones = jnp.ones((ctx.cap,), dtype=jnp.bool_)
+        return [(s, ones), (c, ones)]
+
+    def merge(self, bufs, ctx):
+        s = _seg_sum(jnp.where(ctx.row_mask, bufs[0].data, 0.0),
+                     ctx.seg_orig, ctx.cap)
+        c = _seg_sum(jnp.where(ctx.row_mask, bufs[1].data, 0),
+                     ctx.seg_orig, ctx.cap)
+        ones = jnp.ones((ctx.cap,), dtype=jnp.bool_)
+        return [(s, ones), (c, ones)]
+
+    def finalize(self, bufs):
+        c = bufs[1].data
+        nz = c > 0
+        avg = jnp.where(nz, bufs[0].data / jnp.where(nz, c, 1), 0.0)
+        return ColVal(dt.FLOAT64, avg, nz)
+
+
+class _FirstLastSpec(_AggSpec):
+    n_buffers = 2  # value, found-flag
+
+    def __init__(self, agg, is_first: bool):
+        super().__init__(agg)
+        self.is_first = is_first
+        self.ignore_nulls = agg.ignore_nulls
+
+    def buffer_dtypes(self):
+        return [self.agg.dtype, dt.BOOL]
+
+    def _pick(self, data, validity, considered, ctx):
+        """In sorted space, pick first/last considered row per group.
+
+        Stable lexsort preserves input order within a group, so 'first in
+        sorted order' == 'first in input/partial order'.
+        """
+        i = jnp.arange(ctx.cap, dtype=jnp.int64)
+        considered_s = jnp.take(considered, ctx.order)
+        if self.is_first:
+            pos = jnp.where(considered_s, i, _BIG)
+            win = _seg_min(pos, ctx.seg_sorted, ctx.cap)
+            found = win < _BIG
+        else:
+            pos = jnp.where(considered_s, i, -1)
+            win = _seg_max(pos, ctx.seg_sorted, ctx.cap)
+            found = win >= 0
+        j = jnp.clip(win, 0, ctx.cap - 1)
+        orig = jnp.take(ctx.order, j)  # original row index of the winner
+        val = jnp.take(data, orig, axis=0)
+        vvalid = jnp.take(validity, orig) & found
+        if data.ndim == 2:
+            val = jnp.where(found[:, None], val, 0)
+        else:
+            val = jnp.where(found, val, 0)
+        return [(val, vvalid), (found, jnp.ones_like(found))]
+
+    def update(self, v, ctx):
+        considered = ctx.row_mask & (v.validity if self.ignore_nulls
+                                     else jnp.ones_like(v.validity))
+        return self._pick(v.data, v.validity, considered, ctx)
+
+    def merge(self, bufs, ctx):
+        considered = ctx.row_mask & bufs[1].data.astype(bool)
+        if self.ignore_nulls:
+            considered = considered & bufs[0].validity
+        return self._pick(bufs[0].data, bufs[0].validity, considered, ctx)
+
+    def finalize(self, bufs):
+        return ColVal(self.agg.dtype, bufs[0].data, bufs[0].validity)
+
+
+def make_spec(agg: ir.AggregateExpression) -> _AggSpec:
+    if isinstance(agg, ir.Count):
+        return _CountSpec(agg)
+    if isinstance(agg, ir.Sum):
+        return _SumSpec(agg)
+    if isinstance(agg, ir.Min):
+        return _MinMaxSpec(agg, True)
+    if isinstance(agg, ir.Max):
+        return _MinMaxSpec(agg, False)
+    if isinstance(agg, ir.Average):
+        return _AverageSpec(agg)
+    if isinstance(agg, ir.First):
+        return _FirstLastSpec(agg, True)
+    if isinstance(agg, ir.Last):
+        return _FirstLastSpec(agg, False)
+    raise NotImplementedError(type(agg).__name__)
+
+
+class TpuHashAggregateExec(TpuExec):
+    def __init__(self, child: PhysicalPlan,
+                 groupings: Sequence[ir.Expression],
+                 aggregates: Sequence[ir.AggregateExpression],
+                 schema: Schema):
+        super().__init__()
+        self.children = (child,)
+        self.groupings = list(groupings)
+        self.aggregates = list(aggregates)
+        self.specs = [make_spec(a) for a in self.aggregates]
+        self._schema = schema
+        self._update_kernel = None
+        self._merge_kernel = None
+
+    @property
+    def schema(self) -> Schema:
+        return self._schema
+
+    # ------------------------------------------------------------------
+    def _sorted_ctx(self, key_vals: List[ColVal],
+                    batch: DeviceBatch) -> _SortedCtx:
+        cap = batch.capacity
+        row_mask = batch.row_mask()
+        if not self.groupings:
+            # global aggregation: one group holding every row
+            zeros = jnp.zeros((cap,), dtype=jnp.int32)
+            return _SortedCtx(order=jnp.arange(cap), seg_sorted=zeros,
+                              seg_orig=zeros, cap=cap, row_mask=row_mask,
+                              n_groups=jnp.int32(1))
+        groups = [sortkeys.encode_keys(v, True, True) for v in key_vals]
+        order = sortkeys.lexsort_indices(groups, row_mask)
+        new_group = sortkeys.group_boundaries(groups, order, row_mask)
+        seg_sorted = jnp.cumsum(new_group.astype(jnp.int32)) - 1
+        seg_orig = jnp.zeros((cap,), dtype=jnp.int32).at[order].set(
+            seg_sorted)
+        sorted_mask = jnp.take(row_mask, order)
+        n_groups = jnp.sum((new_group & sorted_mask).astype(jnp.int32))
+        return _SortedCtx(order=order, seg_sorted=seg_sorted,
+                          seg_orig=seg_orig, cap=cap, row_mask=row_mask,
+                          n_groups=n_groups)
+
+    def _gather_keys(self, key_vals: List[ColVal],
+                     ctx: _SortedCtx) -> List[DeviceColumn]:
+        """Representative key row per group (first sorted row)."""
+        if not self.groupings:
+            return []
+        i = jnp.arange(ctx.cap, dtype=jnp.int64)
+        first_sorted_pos = _seg_min(i, ctx.seg_sorted, ctx.cap)
+        j = jnp.clip(first_sorted_pos, 0, ctx.cap - 1)
+        orig = jnp.take(ctx.order, j)
+        group_exists = jnp.arange(ctx.cap) < ctx.n_groups
+        out = []
+        for v in key_vals:
+            col = v.to_column().gather(orig, group_exists)
+            out.append(col)
+        return out
+
+    def _update_impl(self, batch: DeviceBatch) -> DeviceBatch:
+        key_vals = [eval_tpu.evaluate(g, batch) for g in self.groupings]
+        # normalize float keys (NaN/-0.0) for Spark grouping semantics
+        key_vals = [self._normalize(v) for v in key_vals]
+        ctx = self._sorted_ctx(key_vals, batch)
+        cols = self._gather_keys(key_vals, ctx)
+        names = [f"__k{i}" for i in range(len(cols))]
+        for ai, (agg, spec) in enumerate(zip(self.aggregates, self.specs)):
+            v = eval_tpu.evaluate(agg.child, batch) \
+                if agg.child is not None else None
+            bufs = spec.update(v, ctx)
+            for bi, ((data, valid), bdt) in enumerate(
+                    zip(bufs, spec.buffer_dtypes())):
+                group_exists = jnp.arange(ctx.cap) < ctx.n_groups
+                cols.append(DeviceColumn(
+                    bdt, jnp.where(group_exists, data.astype(bdt.to_np()), 0)
+                    if data.ndim == 1 else data,
+                    valid & group_exists, None))
+                names.append(f"__a{ai}_{bi}")
+        return DeviceBatch(names, cols, ctx.n_groups)
+
+    def _merge_impl(self, batch: DeviceBatch) -> DeviceBatch:
+        nk = len(self.groupings)
+        key_cols = batch.columns[:nk]
+        key_vals = [ColVal(c.dtype, c.data, c.validity, c.lengths)
+                    for c in key_cols]
+        ctx = self._sorted_ctx(key_vals, batch)
+        cols = self._gather_keys(key_vals, ctx)
+        names = list(batch.names[:nk])
+        off = nk
+        for ai, spec in enumerate(self.specs):
+            bufs = batch.columns[off:off + spec.n_buffers]
+            off += spec.n_buffers
+            merged = spec.merge(bufs, ctx)
+            for bi, ((data, valid), bdt) in enumerate(
+                    zip(merged, spec.buffer_dtypes())):
+                group_exists = jnp.arange(ctx.cap) < ctx.n_groups
+                cols.append(DeviceColumn(
+                    bdt, jnp.where(group_exists,
+                                   data.astype(bdt.to_np()), 0)
+                    if data.ndim == 1 else data,
+                    valid & group_exists, None))
+                names.append(f"__a{ai}_{bi}")
+        return DeviceBatch(names, cols, ctx.n_groups)
+
+    def _final_impl(self, batch: DeviceBatch) -> DeviceBatch:
+        nk = len(self.groupings)
+        cols = list(batch.columns[:nk])
+        off = nk
+        for spec in self.specs:
+            bufs = batch.columns[off:off + spec.n_buffers]
+            off += spec.n_buffers
+            cols.append(spec.finalize(bufs).to_column())
+        return DeviceBatch(self._schema.names, cols, batch.num_rows)
+
+    @staticmethod
+    def _normalize(v: ColVal) -> ColVal:
+        if v.dtype.is_floating:
+            x = jnp.where(jnp.isnan(v.data),
+                          jnp.array(np.nan, dtype=v.data.dtype), v.data)
+            x = jnp.where(x == 0.0, jnp.zeros_like(x), x)
+            return ColVal(v.dtype, x, v.validity, v.lengths)
+        return v
+
+    # ------------------------------------------------------------------
+    def execute(self):
+        if self._update_kernel is None:
+            self._update_kernel = jax.jit(self._update_impl)
+            self._merge_kernel = jax.jit(self._merge_impl)
+            self._final_kernel = jax.jit(self._final_impl)
+
+        def run():
+            partials: List[DeviceBatch] = []
+            for it in self.children[0].execute():
+                for b in it:
+                    if int(b.num_rows) == 0 and self.groupings:
+                        continue
+                    with timed(self.metrics):
+                        partials.append(self._update_kernel(b))
+            if not partials:
+                if self.groupings:
+                    return  # grouped agg over empty input -> no rows
+                # global agg over empty input -> one row (count=0, sum=null)
+                empty = _make_empty_buffer_batch(self)
+                yield self._final_kernel(empty)
+                return
+            if len(partials) == 1:
+                merged = partials[0]
+            else:
+                whole = concat_batches(partials)
+                with timed(self.metrics):
+                    merged = self._merge_kernel(whole)
+            out = self._final_kernel(merged)
+            self.metrics.num_output_rows += int(out.num_rows)
+            yield out
+        return [run()]
+
+
+def _make_empty_buffer_batch(exec_: TpuHashAggregateExec) -> DeviceBatch:
+    """Buffer-layout batch for a global aggregate over zero rows."""
+    cap = 16
+    cols, names = [], []
+    for ai, spec in enumerate(exec_.specs):
+        for bi, bdt in enumerate(spec.buffer_dtypes()):
+            data = jnp.zeros((cap,), dtype=bdt.to_np())
+            # count buffers are valid-0; value buffers are null
+            valid = jnp.zeros((cap,), dtype=jnp.bool_)
+            if bdt == dt.INT64 and isinstance(
+                    exec_.specs[ai], (_CountSpec, _SumSpec, _AverageSpec)) \
+                    and bi == (0 if isinstance(exec_.specs[ai], _CountSpec)
+                               else 1):
+                valid = jnp.zeros((cap,), dtype=jnp.bool_).at[0].set(True)
+            cols.append(DeviceColumn(bdt, data, valid, None))
+            names.append(f"__a{ai}_{bi}")
+    return DeviceBatch(names, cols, 1)

@@ -1,0 +1,346 @@
+"""Basic TPU execs: transitions, project, filter, range, union, limit,
+coalesce, expand.
+
+Reference analogs:
+  * HostToDeviceExec / DeviceToHostExec — GpuRowToColumnarExec /
+    GpuColumnarToRowExec / HostColumnarToGpu (reference:
+    GpuRowToColumnarExec.scala:430-736, GpuColumnarToRowExec.scala:38-306)
+  * TpuProjectExec / TpuFilterExec — basicPhysicalOperators.scala:64,132
+  * TpuRangeExec — basicPhysicalOperators.scala:187 (ColumnVector.sequence)
+  * TpuUnionExec / TpuCoalesceExec — basicPhysicalOperators.scala:308,346
+  * TpuLocalLimit/GlobalLimit — limit.scala
+  * TpuCoalesceBatchesExec — GpuCoalesceBatches.scala:40-711
+  * TpuExpandExec — GpuExpandExec.scala:67
+
+Each exec jit-compiles its kernel once per (schema, capacity-bucket); the
+bucketed static shapes bound XLA recompiles (SURVEY.md §7 hard part #1).
+The filter's "mask -> stable argsort -> gather" compaction is the XLA
+equivalent of cudf's stream-compaction ``Table.filter``.
+"""
+
+from __future__ import annotations
+
+from typing import Iterator, List, Optional, Sequence
+
+import numpy as np
+import pyarrow as pa
+
+import jax
+import jax.numpy as jnp
+
+from spark_rapids_tpu import dtypes as dt
+from spark_rapids_tpu.columnar.batch import (DeviceBatch, DeviceColumn,
+                                             bucket_rows, concat_batches,
+                                             from_arrow, to_arrow)
+from spark_rapids_tpu.exec.base import (CoalesceGoal, PhysicalPlan,
+                                        RequireSingleBatch, TargetSize,
+                                        TpuExec, timed)
+from spark_rapids_tpu.exec.cpu import concat_tables, _empty_table
+from spark_rapids_tpu.expr import eval_tpu, ir
+from spark_rapids_tpu.mem.device import tpu_semaphore
+from spark_rapids_tpu.plan.logical import Field, Schema
+
+
+class HostToDeviceExec(TpuExec):
+    """Upload host Arrow batches into padded DeviceBatches."""
+
+    def __init__(self, child: PhysicalPlan, min_bucket: int = 16):
+        super().__init__()
+        self.children = (child,)
+        self.min_bucket = min_bucket
+
+    @property
+    def schema(self) -> Schema:
+        return self.children[0].schema
+
+    def execute(self):
+        def run(it):
+            for t in it:
+                with tpu_semaphore():
+                    with timed(self.metrics):
+                        b = from_arrow(t, self.min_bucket)
+                    self.metrics.num_output_rows += t.num_rows
+                    self.metrics.num_output_batches += 1
+                    yield b
+        return [run(it) for it in self.children[0].execute()]
+
+
+class DeviceToHostExec(PhysicalPlan):
+    """Download DeviceBatches to host Arrow (the terminal transition,
+    GpuBringBackToHost analog)."""
+
+    def __init__(self, child: PhysicalPlan):
+        super().__init__()
+        self.children = (child,)
+
+    @property
+    def schema(self) -> Schema:
+        return self.children[0].schema
+
+    def execute(self):
+        def run(it):
+            for b in it:
+                yield to_arrow(b)
+        return [run(it) for it in self.children[0].execute()]
+
+
+class TpuProjectExec(TpuExec):
+    def __init__(self, child: PhysicalPlan, exprs: Sequence[ir.Expression],
+                 schema: Schema):
+        super().__init__()
+        self.children = (child,)
+        self.exprs = list(exprs)
+        self._schema = schema
+        self._kernel = None
+
+    @property
+    def schema(self) -> Schema:
+        return self._schema
+
+    def _impl(self, batch: DeviceBatch, pid, offset) -> DeviceBatch:
+        from spark_rapids_tpu.exec import context
+        # pid/offset are tracers here: one compiled kernel serves every
+        # partition (partition-dependent exprs read them via the context)
+        with context.task_context(pid, offset):
+            cols = [eval_tpu.evaluate(e, batch).to_column()
+                    for e in self.exprs]
+        return DeviceBatch(self._schema.names, cols, batch.num_rows)
+
+    def execute(self):
+        if self._kernel is None:
+            self._kernel = jax.jit(self._impl)
+
+        needs_ctx = any(
+            ir.collect(e, lambda n: isinstance(
+                n, (ir.SparkPartitionID, ir.MonotonicallyIncreasingID)))
+            for e in self.exprs)
+
+        def run(pid, it):
+            offset = 0
+            for b in it:
+                with timed(self.metrics):
+                    out = self._kernel(b, jnp.int32(pid),
+                                       jnp.int64(offset))
+                if needs_ctx:
+                    # row-offset tracking costs one host sync per batch;
+                    # only pay it when a partition-dependent expr exists
+                    offset += int(b.num_rows)
+                self.metrics.num_output_batches += 1
+                yield out
+        return [run(pid, it) for pid, it in
+                enumerate(self.children[0].execute())]
+
+
+def compact(batch: DeviceBatch, keep: jnp.ndarray) -> DeviceBatch:
+    """Stream compaction: stable-partition kept rows to the front.
+
+    XLA formulation of cudf's boolean-mask ``Table.filter``: one stable
+    argsort of the inverted mask + gathers (sorts lower to an on-chip
+    bitonic/radix network).
+    """
+    keep = keep & batch.row_mask()
+    count = jnp.sum(keep.astype(jnp.int32))
+    order = jnp.argsort(~keep, stable=True)
+    valid = jnp.arange(batch.capacity) < count
+    cols = [c.gather(order, valid) for c in batch.columns]
+    return DeviceBatch(batch.names, cols, count)
+
+
+class TpuFilterExec(TpuExec):
+    def __init__(self, child: PhysicalPlan, condition: ir.Expression):
+        super().__init__()
+        self.children = (child,)
+        self.condition = condition
+        self._kernel = None
+
+    @property
+    def schema(self) -> Schema:
+        return self.children[0].schema
+
+    def _impl(self, batch: DeviceBatch) -> DeviceBatch:
+        v = eval_tpu.evaluate(self.condition, batch)
+        return compact(batch, v.data.astype(jnp.bool_) & v.validity)
+
+    def execute(self):
+        if self._kernel is None:
+            self._kernel = jax.jit(self._impl)
+
+        def run(it):
+            for b in it:
+                with timed(self.metrics):
+                    out = self._kernel(b)
+                yield out
+        return [run(it) for it in self.children[0].execute()]
+
+
+class TpuRangeExec(TpuExec):
+    def __init__(self, start: int, end: int, step: int, num_partitions: int,
+                 max_batch_rows: int = 1 << 22):
+        super().__init__()
+        self.start, self.end, self.step = start, end, step
+        self.num_partitions = max(1, num_partitions)
+        self.max_batch_rows = max_batch_rows
+        self._schema = Schema([Field("id", dt.INT64, False)])
+
+    @property
+    def schema(self) -> Schema:
+        return self._schema
+
+    def execute(self):
+        total = max(0, -(-(self.end - self.start) // self.step)
+                    if self.step != 0 else 0)
+        per = (total + self.num_partitions - 1) // self.num_partitions or 1
+
+        def part(i):
+            lo = min(i * per, total)
+            hi = min(lo + per, total)
+            for off in range(lo, max(hi, lo + 1), self.max_batch_rows):
+                n = min(self.max_batch_rows, hi - off)
+                if n <= 0 and off != lo:
+                    break
+                n = max(n, 0)
+                cap = bucket_rows(n)
+                first = self.start + off * self.step
+                data = first + jnp.arange(cap, dtype=jnp.int64) * self.step
+                valid = jnp.arange(cap) < n
+                data = jnp.where(valid, data, 0)
+                col = DeviceColumn(dt.INT64, data, valid, None)
+                yield DeviceBatch(["id"], [col], n)
+                if hi == lo:
+                    break
+        return [part(i) for i in range(self.num_partitions)]
+
+
+class TpuUnionExec(TpuExec):
+    def __init__(self, children: Sequence[PhysicalPlan]):
+        super().__init__()
+        self.children = tuple(children)
+
+    @property
+    def schema(self) -> Schema:
+        return self.children[0].schema
+
+    def execute(self):
+        parts = []
+        for c in self.children:
+            # unify column names to the union schema
+            names = self.schema.names
+
+            def run(it, names=names):
+                for b in it:
+                    yield DeviceBatch(names, b.columns, b.num_rows)
+            for it in c.execute():
+                parts.append(run(it))
+        return parts
+
+
+class TpuGlobalLimitExec(TpuExec):
+    def __init__(self, child: PhysicalPlan, n: int):
+        super().__init__()
+        self.children = (child,)
+        self.n = n
+
+    @property
+    def schema(self) -> Schema:
+        return self.children[0].schema
+
+    def execute(self):
+        def run():
+            remaining = self.n
+            for it in self.children[0].execute():
+                for b in it:
+                    if remaining <= 0:
+                        return
+                    rows = int(b.num_rows)
+                    take = min(remaining, rows)
+                    remaining -= take
+                    if take == rows:
+                        yield b
+                    else:
+                        yield DeviceBatch(b.names, b.columns, take)
+        return [run()]
+
+
+class TpuCoalesceBatchesExec(TpuExec):
+    """Goal-driven batch concatenation (GpuCoalesceBatches analog)."""
+
+    def __init__(self, child: PhysicalPlan, goal: CoalesceGoal):
+        super().__init__()
+        self.children = (child,)
+        self.goal = goal
+
+    @property
+    def schema(self) -> Schema:
+        return self.children[0].schema
+
+    def _emit(self, pending: List[DeviceBatch]) -> Optional[DeviceBatch]:
+        if not pending:
+            return None
+        out = concat_batches(pending)
+        return out
+
+    def execute(self):
+        target = self.goal.bytes if isinstance(self.goal, TargetSize) \
+            else None
+
+        def run(it):
+            pending: List[DeviceBatch] = []
+            pending_bytes = 0
+            for b in it:
+                if int(b.num_rows) == 0 and pending:
+                    continue
+                pending.append(b)
+                pending_bytes += b.nbytes()
+                if target is not None and pending_bytes >= target:
+                    out = self._emit(pending)
+                    pending, pending_bytes = [], 0
+                    if out is not None:
+                        self.metrics.num_output_batches += 1
+                        yield out
+            out = self._emit(pending)
+            if out is not None:
+                self.metrics.num_output_batches += 1
+                yield out
+        if isinstance(self.goal, RequireSingleBatch):
+            # single batch across ALL partitions
+            def run_all():
+                batches: List[DeviceBatch] = []
+                for it in self.children[0].execute():
+                    batches.extend(it)
+                if not batches:
+                    return
+                yield concat_batches(batches)
+            return [run_all()]
+        return [run(it) for it in self.children[0].execute()]
+
+
+class TpuExpandExec(TpuExec):
+    def __init__(self, child: PhysicalPlan,
+                 projections: Sequence[Sequence[ir.Expression]],
+                 schema: Schema):
+        super().__init__()
+        self.children = (child,)
+        self.projections = projections
+        self._schema = schema
+        self._kernels = None
+
+    @property
+    def schema(self) -> Schema:
+        return self._schema
+
+    def execute(self):
+        if self._kernels is None:
+            def mk(proj):
+                def impl(batch):
+                    cols = [eval_tpu.evaluate(e, batch).to_column()
+                            for e in proj]
+                    return DeviceBatch(self._schema.names, cols,
+                                       batch.num_rows)
+                return jax.jit(impl)
+            self._kernels = [mk(p) for p in self.projections]
+
+        def run(it):
+            for b in it:
+                for k in self._kernels:
+                    yield k(b)
+        return [run(it) for it in self.children[0].execute()]

@@ -1,0 +1,73 @@
+"""TPU sort exec.
+
+Analog of ``GpuSortExec``/``GpuColumnarBatchSorter`` (reference:
+GpuSortExec.scala:51-265 — ``Table.orderBy`` on a single coalesced batch with
+``RequireSingleBatch`` for total sort, GpuSortExec.scala:76).  The cudf
+orderBy becomes: encode each sort column into total-order uint64 keys
+(exec/sortkeys.py), one ``jnp.lexsort``, then a row gather.
+"""
+
+from __future__ import annotations
+
+from typing import List, Sequence
+
+import jax
+import jax.numpy as jnp
+
+from spark_rapids_tpu.columnar.batch import DeviceBatch, concat_batches
+from spark_rapids_tpu.exec.base import (PhysicalPlan, REQUIRE_SINGLE_BATCH,
+                                        TpuExec, timed)
+from spark_rapids_tpu.exec import sortkeys
+from spark_rapids_tpu.expr import eval_tpu
+from spark_rapids_tpu.plan.logical import Schema, SortOrder
+
+
+def sorted_indices(batch: DeviceBatch, orders: Sequence[SortOrder]):
+    groups = []
+    for o in orders:
+        v = eval_tpu.evaluate(o.expr, batch)
+        groups.append(sortkeys.encode_keys(v, o.ascending,
+                                           o.nulls_first_resolved))
+    return sortkeys.lexsort_indices(groups, batch.row_mask())
+
+
+class TpuSortExec(TpuExec):
+    """Total sort: requires its whole input as one batch (like the
+    reference's out-of-core-less sort; spill integration comes via the
+    coalesce/spill framework)."""
+
+    def __init__(self, child: PhysicalPlan, orders: Sequence[SortOrder]):
+        super().__init__()
+        self.children = (child,)
+        self.orders = list(orders)
+        self._kernel = None
+
+    @property
+    def schema(self) -> Schema:
+        return self.children[0].schema
+
+    def children_coalesce_goal(self):
+        return [REQUIRE_SINGLE_BATCH]
+
+    def _impl(self, batch: DeviceBatch) -> DeviceBatch:
+        order = sorted_indices(batch, self.orders)
+        valid = jnp.arange(batch.capacity) < batch.num_rows
+        cols = [c.gather(order, valid) for c in batch.columns]
+        return DeviceBatch(batch.names, cols, batch.num_rows)
+
+    def execute(self):
+        if self._kernel is None:
+            self._kernel = jax.jit(self._impl)
+
+        def run():
+            batches: List[DeviceBatch] = []
+            for it in self.children[0].execute():
+                batches.extend(it)
+            if not batches:
+                return
+            whole = concat_batches(batches)
+            with timed(self.metrics):
+                out = self._kernel(whole)
+            self.metrics.num_output_rows += int(out.num_rows)
+            yield out
+        return [run()]

@@ -1,0 +1,1 @@
+from spark_rapids_tpu.expr import ir  # noqa: F401

@@ -1,0 +1,917 @@
+"""Expression IR: the engine's Catalyst-expression analog.
+
+The reference wraps Spark Catalyst expressions in ``GpuExpression`` shape-class
+bases (reference: sql-plugin/.../GpuExpressions.scala:63-230 —
+GpuUnaryExpression/GpuBinaryExpression/CudfUnaryExpression) and registers ~150
+per-class replacement rules (reference: GpuOverrides.scala:586-1714).
+
+Here the IR *is* the expression tree (we are standalone — there is no Catalyst
+above us).  Two independent evaluators consume it:
+
+  * :mod:`spark_rapids_tpu.expr.eval_tpu` — jax/XLA, device columnar
+  * :mod:`spark_rapids_tpu.expr.eval_cpu` — pyarrow.compute, host columnar
+    (the CPU-fallback execution path AND the parity oracle for tests)
+
+Null semantics follow Spark SQL: most ops propagate null; AND/OR use
+three-valued logic; division by zero yields null; NaN handling follows Spark's
+"NaN is greatest, NaN == NaN" total order in comparisons/sorts.
+"""
+
+from __future__ import annotations
+
+import datetime as _dt
+from typing import Any, Iterable, List, Optional, Sequence, Tuple
+
+from spark_rapids_tpu import dtypes as dt
+
+
+class Expression:
+    """Base IR node. After ``bind``, every node has .dtype and .nullable."""
+
+    children: Tuple["Expression", ...] = ()
+    dtype: Optional[dt.DType] = None
+    nullable: bool = True
+
+    def with_children(self, children: Sequence["Expression"]) -> "Expression":
+        clone = self.__class__.__new__(self.__class__)
+        clone.__dict__.update(self.__dict__)
+        clone.children = tuple(children)
+        return clone
+
+    # resolution ------------------------------------------------------------
+    def resolve(self) -> None:
+        """Compute dtype/nullable from resolved children. Override."""
+        raise NotImplementedError(type(self).__name__)
+
+    @property
+    def name(self) -> str:
+        return type(self).__name__
+
+    def sql(self) -> str:
+        args = ", ".join(c.sql() for c in self.children)
+        return f"{self.name}({args})"
+
+    def __repr__(self) -> str:
+        return self.sql()
+
+
+# ---------------------------------------------------------------------------
+# Leaves
+# ---------------------------------------------------------------------------
+
+class Literal(Expression):
+    def __init__(self, value: Any, dtype: Optional[dt.DType] = None):
+        self.value = value
+        self.dtype = dtype if dtype is not None else infer_literal_type(value)
+        self.nullable = value is None
+
+    def resolve(self) -> None:
+        pass
+
+    def sql(self) -> str:
+        return repr(self.value)
+
+
+def infer_literal_type(value: Any) -> dt.DType:
+    if value is None:
+        return dt.NULL
+    if isinstance(value, bool):
+        return dt.BOOL
+    if isinstance(value, int):
+        return dt.INT32 if -(2 ** 31) <= value < 2 ** 31 else dt.INT64
+    if isinstance(value, float):
+        return dt.FLOAT64
+    if isinstance(value, str):
+        return dt.STRING
+    if isinstance(value, _dt.datetime):
+        return dt.TIMESTAMP_US
+    if isinstance(value, _dt.date):
+        return dt.DATE32
+    raise TypeError(f"cannot infer literal type for {value!r}")
+
+
+class UnresolvedAttribute(Expression):
+    """API-level column reference, replaced by BoundReference at bind time."""
+
+    def __init__(self, name_: str):
+        self.attr_name = name_
+
+    def resolve(self) -> None:
+        raise RuntimeError(f"unresolved attribute '{self.attr_name}'")
+
+    def sql(self) -> str:
+        return self.attr_name
+
+
+class BoundReference(Expression):
+    """Column bound to an ordinal in the input batch.
+
+    Analog of GpuBoundReference (reference: GpuBoundAttribute.scala).
+    """
+
+    def __init__(self, ordinal: int, dtype: dt.DType, nullable: bool = True,
+                 name_: str = ""):
+        self.ordinal = ordinal
+        self.dtype = dtype
+        self.nullable = nullable
+        self.ref_name = name_
+
+    def resolve(self) -> None:
+        pass
+
+    def sql(self) -> str:
+        return self.ref_name or f"input[{self.ordinal}]"
+
+
+class Alias(Expression):
+    def __init__(self, child: Expression, alias: str):
+        self.children = (child,)
+        self.alias = alias
+
+    def resolve(self) -> None:
+        self.dtype = self.children[0].dtype
+        self.nullable = self.children[0].nullable
+
+    def sql(self) -> str:
+        return f"{self.children[0].sql()} AS {self.alias}"
+
+
+def output_name(e: Expression) -> str:
+    if isinstance(e, Alias):
+        return e.alias
+    if isinstance(e, UnresolvedAttribute):
+        return e.attr_name
+    if isinstance(e, BoundReference) and e.ref_name:
+        return e.ref_name
+    return e.sql()
+
+
+# ---------------------------------------------------------------------------
+# Shape-class bases (GpuUnaryExpression / GpuBinaryExpression analogs)
+# ---------------------------------------------------------------------------
+
+class UnaryExpression(Expression):
+    def __init__(self, child: Expression):
+        self.children = (child,)
+
+    @property
+    def child(self) -> Expression:
+        return self.children[0]
+
+
+class BinaryExpression(Expression):
+    def __init__(self, left: Expression, right: Expression):
+        self.children = (left, right)
+
+    @property
+    def left(self) -> Expression:
+        return self.children[0]
+
+    @property
+    def right(self) -> Expression:
+        return self.children[1]
+
+
+# ---------------------------------------------------------------------------
+# Arithmetic (reference: org/.../rapids/arithmetic.scala)
+# ---------------------------------------------------------------------------
+
+class _NumericBinary(BinaryExpression):
+    def resolve(self) -> None:
+        l, r = self.left.dtype, self.right.dtype
+        if not (l.is_numeric and r.is_numeric):
+            raise TypeError(f"{self.name} requires numeric args, got {l},{r}")
+        self.dtype = dt.promote(l, r)
+        self.nullable = self.left.nullable or self.right.nullable
+
+
+class Add(_NumericBinary):
+    pass
+
+
+class Subtract(_NumericBinary):
+    pass
+
+
+class Multiply(_NumericBinary):
+    pass
+
+
+class Divide(BinaryExpression):
+    """Spark `/`: always double; x/0 -> null."""
+
+    def resolve(self) -> None:
+        self.dtype = dt.FLOAT64
+        self.nullable = True
+
+
+class IntegralDivide(BinaryExpression):
+    """Spark `div`: long division; x div 0 -> null."""
+
+    def resolve(self) -> None:
+        self.dtype = dt.INT64
+        self.nullable = True
+
+
+class Remainder(_NumericBinary):
+    def resolve(self) -> None:
+        super().resolve()
+        self.nullable = True  # x % 0 -> null
+
+
+class Pmod(_NumericBinary):
+    def resolve(self) -> None:
+        super().resolve()
+        self.nullable = True
+
+
+class UnaryMinus(UnaryExpression):
+    def resolve(self) -> None:
+        self.dtype = self.child.dtype
+        self.nullable = self.child.nullable
+
+
+class UnaryPositive(UnaryExpression):
+    def resolve(self) -> None:
+        self.dtype = self.child.dtype
+        self.nullable = self.child.nullable
+
+
+class Abs(UnaryExpression):
+    def resolve(self) -> None:
+        self.dtype = self.child.dtype
+        self.nullable = self.child.nullable
+
+
+# ---------------------------------------------------------------------------
+# Predicates & logic (reference: org/.../rapids/predicates.scala)
+# ---------------------------------------------------------------------------
+
+class _Comparison(BinaryExpression):
+    def resolve(self) -> None:
+        self.dtype = dt.BOOL
+        self.nullable = self.left.nullable or self.right.nullable
+
+
+class EqualTo(_Comparison):
+    pass
+
+
+class LessThan(_Comparison):
+    pass
+
+
+class LessThanOrEqual(_Comparison):
+    pass
+
+
+class GreaterThan(_Comparison):
+    pass
+
+
+class GreaterThanOrEqual(_Comparison):
+    pass
+
+
+class And(BinaryExpression):
+    def resolve(self) -> None:
+        self.dtype = dt.BOOL
+        self.nullable = self.left.nullable or self.right.nullable
+
+
+class Or(BinaryExpression):
+    def resolve(self) -> None:
+        self.dtype = dt.BOOL
+        self.nullable = self.left.nullable or self.right.nullable
+
+
+class Not(UnaryExpression):
+    def resolve(self) -> None:
+        self.dtype = dt.BOOL
+        self.nullable = self.child.nullable
+
+
+class In(Expression):
+    """value IN (literals...). Analog of GpuInSet (GpuInSet.scala:98)."""
+
+    def __init__(self, value: Expression, items: Sequence[Any]):
+        self.children = (value,)
+        self.items = tuple(items)
+
+    def resolve(self) -> None:
+        self.dtype = dt.BOOL
+        self.nullable = (self.children[0].nullable or
+                         any(i is None for i in self.items))
+
+    def sql(self) -> str:
+        return f"{self.children[0].sql()} IN {self.items}"
+
+
+# ---------------------------------------------------------------------------
+# Null handling (reference: nullExpressions.scala)
+# ---------------------------------------------------------------------------
+
+class IsNull(UnaryExpression):
+    def resolve(self) -> None:
+        self.dtype = dt.BOOL
+        self.nullable = False
+
+
+class IsNotNull(UnaryExpression):
+    def resolve(self) -> None:
+        self.dtype = dt.BOOL
+        self.nullable = False
+
+
+class IsNan(UnaryExpression):
+    def resolve(self) -> None:
+        self.dtype = dt.BOOL
+        self.nullable = False
+
+
+class Coalesce(Expression):
+    def __init__(self, *exprs: Expression):
+        self.children = tuple(exprs)
+
+    def resolve(self) -> None:
+        dtypes = [c.dtype for c in self.children if c.dtype != dt.NULL]
+        self.dtype = dtypes[0] if dtypes else dt.NULL
+        self.nullable = all(c.nullable for c in self.children)
+
+
+class NaNvl(BinaryExpression):
+    def resolve(self) -> None:
+        self.dtype = dt.promote(self.left.dtype, self.right.dtype) \
+            if self.left.dtype != self.right.dtype else self.left.dtype
+        self.nullable = self.left.nullable or self.right.nullable
+
+
+# ---------------------------------------------------------------------------
+# Conditionals (reference: conditionalExpressions.scala — GpuIf/GpuCaseWhen,
+# side-effect-free whole-column eval of all branches + ifElse merge)
+# ---------------------------------------------------------------------------
+
+class If(Expression):
+    def __init__(self, pred: Expression, t: Expression, f: Expression):
+        self.children = (pred, t, f)
+
+    def resolve(self) -> None:
+        _, t, f = self.children
+        self.dtype = t.dtype if t.dtype != dt.NULL else f.dtype
+        self.nullable = t.nullable or f.nullable
+
+
+class CaseWhen(Expression):
+    """branches: [(cond, value), ...], else_value optional."""
+
+    def __init__(self, branches: Sequence[Tuple[Expression, Expression]],
+                 else_value: Optional[Expression] = None):
+        self.n_branches = len(branches)
+        flat: List[Expression] = []
+        for c, v in branches:
+            flat.extend((c, v))
+        if else_value is not None:
+            flat.append(else_value)
+        self.has_else = else_value is not None
+        self.children = tuple(flat)
+
+    def branches(self) -> List[Tuple[Expression, Expression]]:
+        return [(self.children[2 * i], self.children[2 * i + 1])
+                for i in range(self.n_branches)]
+
+    def else_value(self) -> Optional[Expression]:
+        return self.children[-1] if self.has_else else None
+
+    def resolve(self) -> None:
+        vals = [v for _, v in self.branches()]
+        if self.has_else:
+            vals.append(self.children[-1])
+        dtypes = [v.dtype for v in vals if v.dtype != dt.NULL]
+        self.dtype = dtypes[0] if dtypes else dt.NULL
+        self.nullable = (not self.has_else) or any(v.nullable for v in vals)
+
+
+# ---------------------------------------------------------------------------
+# Math (reference: org/.../rapids/mathExpressions.scala)
+# ---------------------------------------------------------------------------
+
+class _DoubleUnary(UnaryExpression):
+    def resolve(self) -> None:
+        self.dtype = dt.FLOAT64
+        self.nullable = True  # domain errors -> null in Spark for some
+
+
+class Sqrt(_DoubleUnary):
+    pass
+
+
+class Exp(_DoubleUnary):
+    pass
+
+
+class Log(_DoubleUnary):
+    pass
+
+
+class Log2(_DoubleUnary):
+    pass
+
+
+class Log10(_DoubleUnary):
+    pass
+
+
+class Log1p(_DoubleUnary):
+    pass
+
+
+class Expm1(_DoubleUnary):
+    pass
+
+
+class Sin(_DoubleUnary):
+    pass
+
+
+class Cos(_DoubleUnary):
+    pass
+
+
+class Tan(_DoubleUnary):
+    pass
+
+
+class Sinh(_DoubleUnary):
+    pass
+
+
+class Cosh(_DoubleUnary):
+    pass
+
+
+class Tanh(_DoubleUnary):
+    pass
+
+
+class Asin(_DoubleUnary):
+    pass
+
+
+class Acos(_DoubleUnary):
+    pass
+
+
+class Atan(_DoubleUnary):
+    pass
+
+
+class Cbrt(_DoubleUnary):
+    pass
+
+
+class ToDegrees(_DoubleUnary):
+    pass
+
+
+class ToRadians(_DoubleUnary):
+    pass
+
+
+class Rint(_DoubleUnary):
+    pass
+
+
+class Signum(_DoubleUnary):
+    pass
+
+
+class Ceil(UnaryExpression):
+    def resolve(self) -> None:
+        self.dtype = dt.INT64
+        self.nullable = self.child.nullable
+
+
+class Floor(UnaryExpression):
+    def resolve(self) -> None:
+        self.dtype = dt.INT64
+        self.nullable = self.child.nullable
+
+
+class Pow(BinaryExpression):
+    def resolve(self) -> None:
+        self.dtype = dt.FLOAT64
+        self.nullable = self.left.nullable or self.right.nullable
+
+
+class Atan2(BinaryExpression):
+    def resolve(self) -> None:
+        self.dtype = dt.FLOAT64
+        self.nullable = self.left.nullable or self.right.nullable
+
+
+class ShiftLeft(BinaryExpression):
+    def resolve(self) -> None:
+        self.dtype = self.left.dtype
+        self.nullable = self.left.nullable or self.right.nullable
+
+
+class ShiftRight(BinaryExpression):
+    def resolve(self) -> None:
+        self.dtype = self.left.dtype
+        self.nullable = self.left.nullable or self.right.nullable
+
+
+class ShiftRightUnsigned(BinaryExpression):
+    def resolve(self) -> None:
+        self.dtype = self.left.dtype
+        self.nullable = self.left.nullable or self.right.nullable
+
+
+# ---------------------------------------------------------------------------
+# Cast (reference: GpuCast.scala:190-861)
+# ---------------------------------------------------------------------------
+
+class Cast(UnaryExpression):
+    def __init__(self, child: Expression, to: dt.DType, ansi: bool = False):
+        super().__init__(child)
+        self.to = to
+        self.ansi = ansi
+
+    def resolve(self) -> None:
+        self.dtype = self.to
+        # string->numeric etc. can produce null on malformed input
+        self.nullable = self.child.nullable or self.child.dtype.is_string
+
+    def sql(self) -> str:
+        return f"CAST({self.child.sql()} AS {self.to.name})"
+
+
+# ---------------------------------------------------------------------------
+# Strings (reference: org/.../rapids/stringFunctions.scala)
+# ---------------------------------------------------------------------------
+
+class Upper(UnaryExpression):
+    def resolve(self) -> None:
+        self.dtype = dt.STRING
+        self.nullable = self.child.nullable
+
+
+class Lower(UnaryExpression):
+    def resolve(self) -> None:
+        self.dtype = dt.STRING
+        self.nullable = self.child.nullable
+
+
+class Length(UnaryExpression):
+    def resolve(self) -> None:
+        self.dtype = dt.INT32
+        self.nullable = self.child.nullable
+
+
+class Substring(Expression):
+    """1-based start like Spark substring(str, pos, len)."""
+
+    def __init__(self, s: Expression, pos: Expression, length: Expression):
+        self.children = (s, pos, length)
+
+    def resolve(self) -> None:
+        self.dtype = dt.STRING
+        self.nullable = any(c.nullable for c in self.children)
+
+
+class StartsWith(BinaryExpression):
+    def resolve(self) -> None:
+        self.dtype = dt.BOOL
+        self.nullable = self.left.nullable or self.right.nullable
+
+
+class EndsWith(BinaryExpression):
+    def resolve(self) -> None:
+        self.dtype = dt.BOOL
+        self.nullable = self.left.nullable or self.right.nullable
+
+
+class Contains(BinaryExpression):
+    def resolve(self) -> None:
+        self.dtype = dt.BOOL
+        self.nullable = self.left.nullable or self.right.nullable
+
+
+class Like(BinaryExpression):
+    """SQL LIKE with % and _ wildcards; pattern must be a literal."""
+
+    def resolve(self) -> None:
+        self.dtype = dt.BOOL
+        self.nullable = self.left.nullable or self.right.nullable
+
+
+class Concat(Expression):
+    def __init__(self, *parts: Expression):
+        self.children = tuple(parts)
+
+    def resolve(self) -> None:
+        self.dtype = dt.STRING
+        self.nullable = any(c.nullable for c in self.children)
+
+
+class StringTrim(UnaryExpression):
+    def resolve(self) -> None:
+        self.dtype = dt.STRING
+        self.nullable = self.child.nullable
+
+
+class StringTrimLeft(UnaryExpression):
+    def resolve(self) -> None:
+        self.dtype = dt.STRING
+        self.nullable = self.child.nullable
+
+
+class StringTrimRight(UnaryExpression):
+    def resolve(self) -> None:
+        self.dtype = dt.STRING
+        self.nullable = self.child.nullable
+
+
+class StringLocate(Expression):
+    """locate(substr, str, start) -> 1-based position or 0."""
+
+    def __init__(self, substr: Expression, s: Expression, start: Expression):
+        self.children = (substr, s, start)
+
+    def resolve(self) -> None:
+        self.dtype = dt.INT32
+        self.nullable = any(c.nullable for c in self.children)
+
+
+class StringReplace(Expression):
+    def __init__(self, s: Expression, search: Expression, replace: Expression):
+        self.children = (s, search, replace)
+
+    def resolve(self) -> None:
+        self.dtype = dt.STRING
+        self.nullable = any(c.nullable for c in self.children)
+
+
+class InitCap(UnaryExpression):
+    def resolve(self) -> None:
+        self.dtype = dt.STRING
+        self.nullable = self.child.nullable
+
+
+class LPad(Expression):
+    def __init__(self, s: Expression, length: Expression, pad: Expression):
+        self.children = (s, length, pad)
+
+    def resolve(self) -> None:
+        self.dtype = dt.STRING
+        self.nullable = any(c.nullable for c in self.children)
+
+
+class RPad(Expression):
+    def __init__(self, s: Expression, length: Expression, pad: Expression):
+        self.children = (s, length, pad)
+
+    def resolve(self) -> None:
+        self.dtype = dt.STRING
+        self.nullable = any(c.nullable for c in self.children)
+
+
+# ---------------------------------------------------------------------------
+# Date/time (reference: org/.../rapids/datetimeExpressions.scala; UTC only)
+# ---------------------------------------------------------------------------
+
+class _TemporalField(UnaryExpression):
+    def resolve(self) -> None:
+        self.dtype = dt.INT32
+        self.nullable = self.child.nullable
+
+
+class Year(_TemporalField):
+    pass
+
+
+class Month(_TemporalField):
+    pass
+
+
+class DayOfMonth(_TemporalField):
+    pass
+
+
+class DayOfYear(_TemporalField):
+    pass
+
+
+class DayOfWeek(_TemporalField):
+    pass
+
+
+class WeekOfYear(_TemporalField):
+    pass
+
+
+class Quarter(_TemporalField):
+    pass
+
+
+class Hour(_TemporalField):
+    pass
+
+
+class Minute(_TemporalField):
+    pass
+
+
+class Second(_TemporalField):
+    pass
+
+
+class DateAdd(BinaryExpression):
+    def resolve(self) -> None:
+        self.dtype = dt.DATE32
+        self.nullable = self.left.nullable or self.right.nullable
+
+
+class DateSub(BinaryExpression):
+    def resolve(self) -> None:
+        self.dtype = dt.DATE32
+        self.nullable = self.left.nullable or self.right.nullable
+
+
+class DateDiff(BinaryExpression):
+    def resolve(self) -> None:
+        self.dtype = dt.INT32
+        self.nullable = self.left.nullable or self.right.nullable
+
+
+class UnixTimestampFromTs(UnaryExpression):
+    """timestamp -> seconds since epoch (int64)."""
+
+    def resolve(self) -> None:
+        self.dtype = dt.INT64
+        self.nullable = self.child.nullable
+
+
+# ---------------------------------------------------------------------------
+# Hash & misc (reference: HashFunctions.scala, GpuMurmur3Hash,
+# GpuSparkPartitionID, GpuMonotonicallyIncreasingID, GpuRand)
+# ---------------------------------------------------------------------------
+
+class Murmur3Hash(Expression):
+    """Spark-compatible murmur3_x86_32 over child columns; seed 42."""
+
+    def __init__(self, children: Sequence[Expression], seed: int = 42):
+        self.children = tuple(children)
+        self.seed = seed
+
+    def resolve(self) -> None:
+        self.dtype = dt.INT32
+        self.nullable = False
+
+
+class SparkPartitionID(Expression):
+    def resolve(self) -> None:
+        self.dtype = dt.INT32
+        self.nullable = False
+
+
+class MonotonicallyIncreasingID(Expression):
+    def resolve(self) -> None:
+        self.dtype = dt.INT64
+        self.nullable = False
+
+
+class Rand(Expression):
+    def __init__(self, seed: Optional[int] = None):
+        self.seed = seed if seed is not None else 0
+
+    def resolve(self) -> None:
+        self.dtype = dt.FLOAT64
+        self.nullable = False
+
+
+class KnownFloatingPointNormalized(UnaryExpression):
+    """NaN/-0.0 canonicalization marker (reference: NormalizeFloatingNumbers,
+    FloatUtils.scala — parity-critical for agg/join keys)."""
+
+    def resolve(self) -> None:
+        self.dtype = self.child.dtype
+        self.nullable = self.child.nullable
+
+
+# ---------------------------------------------------------------------------
+# Aggregate functions (reference: org/.../rapids/AggregateFunctions.scala —
+# each is an update/merge CudfAggregate pair + final projection)
+# ---------------------------------------------------------------------------
+
+class AggregateExpression(Expression):
+    """Base for aggregate functions; evaluated by the aggregate exec, never
+    by the row-wise evaluators."""
+
+    def __init__(self, child: Optional[Expression]):
+        self.children = (child,) if child is not None else ()
+
+    @property
+    def child(self) -> Optional[Expression]:
+        return self.children[0] if self.children else None
+
+
+class Count(AggregateExpression):
+    def resolve(self) -> None:
+        self.dtype = dt.INT64
+        self.nullable = False
+
+
+class Sum(AggregateExpression):
+    def resolve(self) -> None:
+        c = self.child.dtype
+        self.dtype = dt.FLOAT64 if c.is_floating else dt.INT64
+        self.nullable = True
+
+
+class Min(AggregateExpression):
+    def resolve(self) -> None:
+        self.dtype = self.child.dtype
+        self.nullable = True
+
+
+class Max(AggregateExpression):
+    def resolve(self) -> None:
+        self.dtype = self.child.dtype
+        self.nullable = True
+
+
+class Average(AggregateExpression):
+    def resolve(self) -> None:
+        self.dtype = dt.FLOAT64
+        self.nullable = True
+
+
+class First(AggregateExpression):
+    def __init__(self, child: Expression, ignore_nulls: bool = False):
+        super().__init__(child)
+        self.ignore_nulls = ignore_nulls
+
+    def resolve(self) -> None:
+        self.dtype = self.child.dtype
+        self.nullable = True
+
+
+class Last(AggregateExpression):
+    def __init__(self, child: Expression, ignore_nulls: bool = False):
+        super().__init__(child)
+        self.ignore_nulls = ignore_nulls
+
+    def resolve(self) -> None:
+        self.dtype = self.child.dtype
+        self.nullable = True
+
+
+# ---------------------------------------------------------------------------
+# Binding & traversal
+# ---------------------------------------------------------------------------
+
+def transform(e: Expression, fn) -> Expression:
+    """Bottom-up transform."""
+    new_children = [transform(c, fn) for c in e.children]
+    if new_children != list(e.children):
+        e = e.with_children(new_children)
+    out = fn(e)
+    return out if out is not None else e
+
+
+def bind(e: Expression, names: Sequence[str],
+         dtypes: Sequence[dt.DType],
+         nullables: Optional[Sequence[bool]] = None) -> Expression:
+    """Replace UnresolvedAttribute with BoundReference and resolve types
+    bottom-up.  Analog of GpuBindReferences (GpuBoundAttribute.scala)."""
+    nullables = nullables if nullables is not None else [True] * len(names)
+
+    def _bind(node: Expression) -> Expression:
+        if isinstance(node, UnresolvedAttribute):
+            if node.attr_name not in names:
+                raise KeyError(f"column '{node.attr_name}' not in "
+                               f"{list(names)}")
+            i = list(names).index(node.attr_name)
+            return BoundReference(i, dtypes[i], nullables[i], node.attr_name)
+        node.resolve()
+        return node
+
+    return transform(e, _bind)
+
+
+def collect(e: Expression, pred) -> List[Expression]:
+    out = []
+
+    def walk(n: Expression):
+        if pred(n):
+            out.append(n)
+        for c in n.children:
+            walk(c)
+
+    walk(e)
+    return out
+
+
+def has_aggregates(e: Expression) -> bool:
+    return bool(collect(e, lambda n: isinstance(n, AggregateExpression)))

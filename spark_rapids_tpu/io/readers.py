@@ -1,0 +1,161 @@
+"""File scan layer: Parquet / CSV / ORC readers behind a strategy SPI.
+
+Reference analog: L8 (SURVEY.md) — ``GpuParquetScan.scala`` parses footers on
+CPU, reassembles column chunks into one host buffer, then decodes on-device
+via ``Table.readParquet``.  Three strategies (reference:
+GpuParquetScan.scala:824,1145; RapidsConf.scala:513,540):
+
+  * PERFILE      — one read per file
+  * COALESCING   — many small files glued into one host read per batch
+  * MULTITHREADED— thread-pool prefetch for high-latency (cloud) stores
+
+Here decode happens on host via Arrow C++ behind the same reader interface,
+exactly the fallback position SURVEY.md §7 phase 3 prescribes; a Pallas
+device decoder can swap in behind ``_read_one`` without touching callers.
+The strategy selection and row-group batching structure is preserved.
+"""
+
+from __future__ import annotations
+
+import concurrent.futures as cf
+from typing import Iterator, List, Optional
+from urllib.parse import urlparse
+
+import pyarrow as pa
+import pyarrow.csv as pacsv
+import pyarrow.orc as paorc
+import pyarrow.parquet as papq
+
+from spark_rapids_tpu import config as cfg
+from spark_rapids_tpu.config import RapidsTpuConf
+from spark_rapids_tpu.exec.base import PhysicalPlan
+from spark_rapids_tpu.plan.logical import FileScan, Schema
+
+
+def infer_schema(fmt: str, paths: List[str],
+                 options: Optional[dict] = None) -> Schema:
+    options = options or {}
+    if fmt == "parquet":
+        return Schema.from_arrow(papq.read_schema(paths[0]))
+    if fmt == "orc":
+        return Schema.from_arrow(paorc.ORCFile(paths[0]).schema)
+    if fmt == "csv":
+        t = _read_csv(paths[0], options)
+        return Schema.from_arrow(t.schema)
+    raise ValueError(f"unknown format {fmt}")
+
+
+def _read_csv(path: str, options: dict) -> pa.Table:
+    read_opts = pacsv.ReadOptions(
+        autogenerate_column_names=not options.get("header", True))
+    parse_opts = pacsv.ParseOptions(
+        delimiter=options.get("sep", ","))
+    convert_opts = pacsv.ConvertOptions(
+        null_values=[options.get("nullValue", "")],
+        strings_can_be_null=True)
+    return pacsv.read_csv(path, read_options=read_opts,
+                          parse_options=parse_opts,
+                          convert_options=convert_opts)
+
+
+def _normalize(t: pa.Table, schema: Schema) -> pa.Table:
+    """Cast to the scan schema (timestamps to us/UTC etc.)."""
+    target = pa.schema([pa.field(f.name, f.dtype.to_arrow(), f.nullable)
+                        for f in schema.fields])
+    cols = []
+    for f in target:
+        col = t.column(f.name) if f.name in t.column_names else None
+        if col is None:
+            cols.append(pa.nulls(t.num_rows, f.type))
+        else:
+            cols.append(col.cast(f.type))
+    return pa.Table.from_arrays(cols, schema=target)
+
+
+class CpuFileScanExec(PhysicalPlan):
+    """v1-style file scan exec (GpuFileSourceScanExec analog)."""
+
+    def __init__(self, scan: FileScan, conf: RapidsTpuConf):
+        super().__init__()
+        self.scan = scan
+        self.conf = conf
+        self._schema = scan.schema
+        self.columns = scan.options.get("columns")
+        self.reader_type = self._select_reader_type()
+        self.max_rows = conf.get(cfg.MAX_READER_BATCH_SIZE_ROWS)
+
+    def _select_reader_type(self) -> str:
+        rt = str(self.conf.get(cfg.PARQUET_READER_TYPE)).upper()
+        if rt != "AUTO":
+            return rt
+        cloud = {s.strip() for s in
+                 str(self.conf.get(cfg.CLOUD_SCHEMES)).split(",")}
+        schemes = {urlparse(p).scheme for p in self.scan.paths}
+        if schemes & cloud:
+            return "MULTITHREADED"
+        if len(self.scan.paths) > 4:
+            return "COALESCING"
+        return "PERFILE"
+
+    @property
+    def schema(self) -> Schema:
+        return self._schema
+
+    def _read_one(self, path: str) -> pa.Table:
+        fmt = self.scan.fmt
+        if fmt == "parquet":
+            t = papq.read_table(path, columns=self.columns)
+        elif fmt == "orc":
+            t = paorc.ORCFile(path).read(columns=self.columns)
+        elif fmt == "csv":
+            t = _read_csv(path, self.scan.options)
+            if self.columns:
+                t = t.select(self.columns)
+        else:
+            raise ValueError(fmt)
+        schema = self._schema if not self.columns else Schema(
+            [self._schema.field(c) for c in self.columns])
+        return _normalize(t, schema)
+
+    def _batches(self, t: pa.Table) -> Iterator[pa.Table]:
+        for off in range(0, max(t.num_rows, 1), self.max_rows):
+            yield t.slice(off, self.max_rows)
+            if t.num_rows == 0:
+                break
+
+    def execute(self) -> List[Iterator[pa.Table]]:
+        paths = self.scan.paths
+        if self.reader_type == "MULTITHREADED":
+            nthreads = self.conf.get(
+                cfg.PARQUET_MULTITHREAD_READ_NUM_THREADS)
+
+            def run_all():
+                with cf.ThreadPoolExecutor(max_workers=nthreads) as pool:
+                    for fut in [pool.submit(self._read_one, p)
+                                for p in paths]:
+                        yield from self._batches(fut.result())
+            return [run_all()]
+        if self.reader_type == "COALESCING":
+            def run_all():
+                pending: List[pa.Table] = []
+                pending_rows = 0
+                for p in paths:
+                    t = self._read_one(p)
+                    pending.append(t)
+                    pending_rows += t.num_rows
+                    if pending_rows >= self.max_rows:
+                        yield from self._batches(
+                            pa.concat_tables(pending))
+                        pending, pending_rows = [], 0
+                if pending:
+                    yield from self._batches(pa.concat_tables(pending))
+            return [run_all()]
+
+        # PERFILE: one partition per file
+        def part(p):
+            yield from self._batches(self._read_one(p))
+        return [part(p) for p in paths]
+
+    def simple_string(self) -> str:
+        return (f"CpuFileScanExec({self.scan.fmt}, "
+                f"files={len(self.scan.paths)}, {self.reader_type})")

@@ -1,0 +1,76 @@
+"""Device manager + task concurrency gate.
+
+Analogs:
+  * ``TpuDeviceManager`` — GpuDeviceManager.initializeGpuAndMemory
+    (reference: GpuDeviceManager.scala:31-307): one accelerator per executor,
+    memory pool sizing.  On TPU, XLA owns the HBM allocator; our arena
+    accounting (mem/spill.py) tracks registered batch bytes on top of it and
+    triggers spill when over budget.
+  * ``tpu_semaphore`` — GpuSemaphore.acquireIfNecessary
+    (reference: GpuSemaphore.scala:27-161): bounds how many tasks
+    concurrently build device working sets.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import threading
+from typing import Optional
+
+_LOCK = threading.Lock()
+_SEM: Optional[threading.Semaphore] = None
+_SLOTS = 2
+
+
+def initialize(concurrent_tasks: int) -> None:
+    global _SEM, _SLOTS
+    with _LOCK:
+        _SLOTS = max(1, int(concurrent_tasks))
+        _SEM = threading.BoundedSemaphore(_SLOTS)
+
+
+def _get() -> threading.Semaphore:
+    global _SEM
+    with _LOCK:
+        if _SEM is None:
+            _SEM = threading.BoundedSemaphore(_SLOTS)
+        return _SEM
+
+
+@contextlib.contextmanager
+def tpu_semaphore():
+    sem = _get()
+    sem.acquire()
+    try:
+        yield
+    finally:
+        sem.release()
+
+
+class TpuDeviceManager:
+    """Holds device handles + memory budget (XLA owns the real allocator)."""
+
+    _instance: Optional["TpuDeviceManager"] = None
+
+    def __init__(self, pool_fraction: float = 0.9):
+        import jax
+        self.devices = jax.devices()
+        self.default_device = self.devices[0]
+        self.pool_fraction = pool_fraction
+        stats = {}
+        try:
+            stats = self.default_device.memory_stats() or {}
+        except Exception:
+            pass
+        limit = stats.get("bytes_limit")
+        self.hbm_budget = int(limit * pool_fraction) if limit else 8 << 30
+
+    @classmethod
+    def get(cls) -> "TpuDeviceManager":
+        if cls._instance is None:
+            cls._instance = TpuDeviceManager()
+        return cls._instance
+
+    @property
+    def platform(self) -> str:
+        return self.default_device.platform

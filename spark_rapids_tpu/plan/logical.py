@@ -1,0 +1,297 @@
+"""Logical plans produced by the DataFrame API.
+
+Role analog: Spark's Catalyst logical plans, which sit *above* the reference
+plugin (the reference only rewrites physical plans; reference:
+SURVEY.md L3, GpuOverrides.scala:2047).  We are standalone, so we own this
+layer too — it stays deliberately thin: resolution here, optimization and
+device placement in the physical planner/overrides.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import List, Optional, Sequence, Tuple
+
+import pyarrow as pa
+
+from spark_rapids_tpu import dtypes as dt
+from spark_rapids_tpu.expr import ir
+
+
+@dataclass(frozen=True)
+class Field:
+    name: str
+    dtype: dt.DType
+    nullable: bool = True
+
+
+class Schema:
+    def __init__(self, fields: Sequence[Field]):
+        self.fields = list(fields)
+
+    @property
+    def names(self) -> List[str]:
+        return [f.name for f in self.fields]
+
+    @property
+    def dtypes(self) -> List[dt.DType]:
+        return [f.dtype for f in self.fields]
+
+    @property
+    def nullables(self) -> List[bool]:
+        return [f.nullable for f in self.fields]
+
+    def field(self, name: str) -> Field:
+        for f in self.fields:
+            if f.name == name:
+                return f
+        raise KeyError(name)
+
+    def __len__(self) -> int:
+        return len(self.fields)
+
+    def __repr__(self) -> str:
+        inner = ", ".join(f"{f.name}:{f.dtype.name}" for f in self.fields)
+        return f"Schema({inner})"
+
+    @staticmethod
+    def from_arrow(schema: pa.Schema) -> "Schema":
+        fields = []
+        for f in schema:
+            d = dt.from_arrow(f.type)
+            if d is None:
+                raise TypeError(f"unsupported Arrow type {f.type} for "
+                                f"column {f.name}")
+            fields.append(Field(f.name, d if d != dt.NULL else dt.BOOL,
+                                f.nullable))
+        return Schema(fields)
+
+
+class LogicalPlan:
+    children: Tuple["LogicalPlan", ...] = ()
+
+    @property
+    def schema(self) -> Schema:
+        raise NotImplementedError
+
+    def bind(self, e: ir.Expression) -> ir.Expression:
+        """Bind an expression against the *child* schema."""
+        s = self.children[0].schema if self.children else self.schema
+        return ir.bind(e, s.names, s.dtypes, s.nullables)
+
+    def tree_string(self, indent: int = 0) -> str:
+        pad = "  " * indent
+        lines = [f"{pad}{self.simple_string()}"]
+        for c in self.children:
+            lines.append(c.tree_string(indent + 1))
+        return "\n".join(lines)
+
+    def simple_string(self) -> str:
+        return type(self).__name__
+
+
+class InMemoryScan(LogicalPlan):
+    def __init__(self, table: pa.Table, num_partitions: int = 1):
+        self.table = table
+        self.num_partitions = max(1, num_partitions)
+        self._schema = Schema.from_arrow(table.schema)
+
+    @property
+    def schema(self) -> Schema:
+        return self._schema
+
+    def simple_string(self) -> str:
+        return (f"InMemoryScan(rows={self.table.num_rows}, "
+                f"parts={self.num_partitions})")
+
+
+class FileScan(LogicalPlan):
+    """Parquet/CSV/ORC file scan. Schema inferred from footer/header."""
+
+    def __init__(self, fmt: str, paths: Sequence[str], schema: Schema,
+                 options: Optional[dict] = None):
+        self.fmt = fmt
+        self.paths = list(paths)
+        self._schema = schema
+        self.options = dict(options or {})
+
+    @property
+    def schema(self) -> Schema:
+        return self._schema
+
+    def simple_string(self) -> str:
+        return f"FileScan({self.fmt}, files={len(self.paths)})"
+
+
+class Project(LogicalPlan):
+    def __init__(self, child: LogicalPlan, exprs: Sequence[ir.Expression]):
+        self.children = (child,)
+        self.exprs = [self.bind(e) for e in exprs]
+        self._schema = Schema([
+            Field(ir.output_name(raw), b.dtype, b.nullable)
+            for raw, b in zip(exprs, self.exprs)])
+
+    @property
+    def schema(self) -> Schema:
+        return self._schema
+
+
+class Filter(LogicalPlan):
+    def __init__(self, child: LogicalPlan, condition: ir.Expression):
+        self.children = (child,)
+        self.condition = self.bind(condition)
+        if self.condition.dtype != dt.BOOL:
+            raise TypeError("filter condition must be boolean")
+
+    @property
+    def schema(self) -> Schema:
+        return self.children[0].schema
+
+
+@dataclass(frozen=True)
+class SortOrder:
+    expr: ir.Expression
+    ascending: bool = True
+    nulls_first: Optional[bool] = None  # default: first if asc, last if desc
+
+    @property
+    def nulls_first_resolved(self) -> bool:
+        if self.nulls_first is None:
+            return self.ascending
+        return self.nulls_first
+
+
+class Sort(LogicalPlan):
+    def __init__(self, child: LogicalPlan, orders: Sequence[SortOrder]):
+        self.children = (child,)
+        self.orders = [SortOrder(self.bind(o.expr), o.ascending,
+                                 o.nulls_first) for o in orders]
+
+    @property
+    def schema(self) -> Schema:
+        return self.children[0].schema
+
+
+class Aggregate(LogicalPlan):
+    def __init__(self, child: LogicalPlan,
+                 groupings: Sequence[ir.Expression],
+                 aggregates: Sequence[ir.Expression]):
+        self.children = (child,)
+        self.groupings = [self.bind(g) for g in groupings]
+        self.raw_groupings = list(groupings)
+        self.aggregates = [self.bind(a) for a in aggregates]
+        self.raw_aggregates = list(aggregates)
+        fields = []
+        for raw, b in zip(groupings, self.groupings):
+            fields.append(Field(ir.output_name(raw), b.dtype, b.nullable))
+        for raw, b in zip(aggregates, self.aggregates):
+            fields.append(Field(ir.output_name(raw), b.dtype, b.nullable))
+        self._schema = Schema(fields)
+
+    @property
+    def schema(self) -> Schema:
+        return self._schema
+
+
+class Limit(LogicalPlan):
+    def __init__(self, child: LogicalPlan, n: int):
+        self.children = (child,)
+        self.n = int(n)
+
+    @property
+    def schema(self) -> Schema:
+        return self.children[0].schema
+
+    def simple_string(self) -> str:
+        return f"Limit({self.n})"
+
+
+class Union(LogicalPlan):
+    def __init__(self, children: Sequence[LogicalPlan]):
+        self.children = tuple(children)
+        s0 = children[0].schema
+        for c in children[1:]:
+            if c.schema.dtypes != s0.dtypes:
+                raise TypeError("UNION requires matching schemas")
+
+    @property
+    def schema(self) -> Schema:
+        return self.children[0].schema
+
+
+class Join(LogicalPlan):
+    """Equi-join on named key pairs; how in inner/left/right/full/semi/anti,
+    cross for cartesian."""
+
+    def __init__(self, left: LogicalPlan, right: LogicalPlan,
+                 left_keys: Sequence[str], right_keys: Sequence[str],
+                 how: str = "inner",
+                 condition: Optional[ir.Expression] = None):
+        self.children = (left, right)
+        self.left_keys = list(left_keys)
+        self.right_keys = list(right_keys)
+        self.how = how
+        lf, rf = left.schema.fields, right.schema.fields
+        if how in ("semi", "anti"):
+            self._schema = Schema(lf)
+        else:
+            nullable_l = how in ("right", "full")
+            nullable_r = how in ("left", "full")
+            self._schema = Schema(
+                [Field(f.name, f.dtype, f.nullable or nullable_l)
+                 for f in lf] +
+                [Field(f.name, f.dtype, f.nullable or nullable_r)
+                 for f in rf])
+        self.condition = None
+        if condition is not None:
+            if how not in ("inner", "cross"):
+                raise NotImplementedError(
+                    f"join condition is only supported for inner/cross "
+                    f"joins, not {how}")
+            # bind against the joined output (left fields then right fields)
+            joined = Schema(lf + rf)
+            self.condition = ir.bind(condition, joined.names,
+                                     joined.dtypes, joined.nullables)
+
+    @property
+    def schema(self) -> Schema:
+        return self._schema
+
+    def simple_string(self) -> str:
+        return (f"Join({self.how}, {list(zip(self.left_keys, self.right_keys))})")
+
+
+class Range(LogicalPlan):
+    """spark.range analog (reference: GpuRangeExec,
+    basicPhysicalOperators.scala:187)."""
+
+    def __init__(self, start: int, end: int, step: int = 1,
+                 num_partitions: int = 1):
+        self.start, self.end, self.step = start, end, step
+        self.num_partitions = max(1, num_partitions)
+        self._schema = Schema([Field("id", dt.INT64, False)])
+
+    @property
+    def schema(self) -> Schema:
+        return self._schema
+
+    def simple_string(self) -> str:
+        return f"Range({self.start}, {self.end}, {self.step})"
+
+
+class Expand(LogicalPlan):
+    """N projections per input row (rollup/cube building block; reference:
+    GpuExpandExec.scala:67)."""
+
+    def __init__(self, child: LogicalPlan,
+                 projections: Sequence[Sequence[ir.Expression]],
+                 names: Sequence[str]):
+        self.children = (child,)
+        self.projections = [[self.bind(e) for e in p] for p in projections]
+        p0 = self.projections[0]
+        self._schema = Schema([
+            Field(n, b.dtype, True) for n, b in zip(names, p0)])
+
+    @property
+    def schema(self) -> Schema:
+        return self._schema

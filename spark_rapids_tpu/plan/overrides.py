@@ -1,0 +1,353 @@
+"""Plan-override layer: wrap -> tag -> convert, with explain and CPU fallback.
+
+This is the TPU analog of the heart of the reference design (reference:
+GpuOverrides.scala:2047-2066 apply; RapidsMeta.scala:66-306 the meta tree;
+``willNotWorkOnGpu`` reason recording at RapidsMeta.scala:132,194-230;
+``convertIfNeeded`` at RapidsMeta.scala:605-624; per-class ReplacementRule
+registry at GpuOverrides.scala:65-277).
+
+Flow, identical to the reference:
+  1. the CPU physical plan (our "stock Spark" plan) is wrapped in a meta tree
+  2. tagging walks the tree recording ``will_not_work_on_tpu`` reasons:
+     per-op kill-switch confs (auto-derived key
+     ``spark.rapids.tpu.sql.exec.<SparkName>`` /
+     ``...sql.expression.<Name>``, reference: GpuOverrides.scala:131-139),
+     unsupported dtypes (reference: isSupportedType GpuOverrides.scala:459),
+     unsupported expressions, incompat ops gated behind
+     ``incompatibleOps.enabled``
+  3. conversion replaces only fully-supported nodes with Tpu execs and
+     inserts HostToDevice/DeviceToHost transitions at currency boundaries
+     (the GpuTransitionOverrides role, GpuTransitionOverrides.scala:454-481)
+  4. ``explain`` renders the per-node decisions
+     (``spark.rapids.tpu.sql.explain=NOT_ON_TPU|ALL``)
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Callable, Dict, List, Optional, Type
+
+from spark_rapids_tpu import config as cfg
+from spark_rapids_tpu import dtypes as dt
+from spark_rapids_tpu.config import RapidsTpuConf
+from spark_rapids_tpu.exec import cpu as cpux
+from spark_rapids_tpu.exec import tpu_basic as tpub
+from spark_rapids_tpu.exec.base import PhysicalPlan
+from spark_rapids_tpu.exec.tpu_aggregate import TpuHashAggregateExec
+from spark_rapids_tpu.exec.tpu_sort import TpuSortExec
+from spark_rapids_tpu.expr import eval_tpu, ir
+
+
+# ---------------------------------------------------------------------------
+# Expression support checks
+# ---------------------------------------------------------------------------
+
+_LITERAL_ARG_EXPRS = {
+    ir.StartsWith: "string search needle must be a literal",
+    ir.EndsWith: "string search needle must be a literal",
+    ir.Contains: "string search needle must be a literal",
+    ir.Like: "LIKE pattern must be a literal",
+}
+
+
+_TPU_AGG_FNS = (ir.Count, ir.Sum, ir.Min, ir.Max, ir.Average, ir.First,
+                ir.Last)
+
+
+def _check_expr_node(e: ir.Expression, conf: RapidsTpuConf
+                     ) -> Optional[str]:
+    """Return a fallback reason if this single node can't run on TPU."""
+    if isinstance(e, ir.AggregateExpression):
+        # aggregates are evaluated by the aggregate exec's update/merge
+        # specs, not the row-wise evaluator
+        if not isinstance(e, _TPU_AGG_FNS):
+            return (f"aggregate {type(e).__name__} is not supported on TPU")
+    elif not eval_tpu.supported_on_tpu(type(e)):
+        return f"expression {type(e).__name__} is not supported on TPU"
+    key = f"spark.rapids.tpu.sql.expression.{type(e).__name__}"
+    if not conf.is_operator_enabled(key, incompat=False,
+                                   disabled_by_default=False):
+        return f"expression {type(e).__name__} disabled by {key}"
+    if type(e) in _LITERAL_ARG_EXPRS:
+        if not isinstance(e.children[1], ir.Literal):
+            return _LITERAL_ARG_EXPRS[type(e)]
+    if isinstance(e, ir.Like):
+        pat = e.children[1]
+        if isinstance(pat, ir.Literal) and pat.value is not None:
+            p = pat.value
+            core = p.strip("%")
+            if "_" in p or "%" in core:
+                return f"LIKE pattern '{p}' not supported on TPU yet"
+    if isinstance(e, ir.StringLocate):
+        if not isinstance(e.children[0], ir.Literal) or \
+           not isinstance(e.children[2], ir.Literal):
+            return "locate substr/start must be literals"
+    if isinstance(e, (ir.LPad, ir.RPad)):
+        if not isinstance(e.children[1], ir.Literal) or \
+           not isinstance(e.children[2], ir.Literal):
+            return "pad length/fill must be literals"
+    if isinstance(e, ir.Cast):
+        src = e.children[0].dtype
+        if src is not None:
+            if src.is_string and not e.to.is_integral:
+                return f"cast string->{e.to.name} not supported on TPU yet"
+            if e.to.is_string:
+                return f"cast {src.name}->string not supported on TPU yet"
+    if isinstance(e, (ir.Min, ir.Max)) and e.child is not None and \
+            e.child.dtype is not None and e.child.dtype.is_string:
+        return "min/max over strings not supported on TPU yet"
+    if isinstance(e, (ir.First, ir.Last)) and e.child is not None and \
+            e.child.dtype is not None and e.child.dtype.is_string:
+        return "first/last over strings not supported on TPU yet"
+    if isinstance(e, (ir.Sum, ir.Average)) and e.child is not None and \
+            e.child.dtype is not None and e.child.dtype.is_floating:
+        if not conf.get(cfg.VARIABLE_FLOAT_AGG) and \
+           not conf.get(cfg.INCOMPATIBLE_OPS):
+            return ("float/double aggregation order differs from Spark; "
+                    "enable spark.rapids.tpu.sql.variableFloatAgg.enabled")
+    return None
+
+
+def check_exprs(exprs: List[ir.Expression], conf: RapidsTpuConf
+                ) -> List[str]:
+    reasons: List[str] = []
+
+    def walk(e: ir.Expression):
+        r = _check_expr_node(e, conf)
+        if r:
+            reasons.append(r)
+        for c in e.children:
+            walk(c)
+    for e in exprs:
+        walk(e)
+    return reasons
+
+
+# ---------------------------------------------------------------------------
+# Exec replacement rules
+# ---------------------------------------------------------------------------
+
+@dataclass
+class ExecRule:
+    spark_name: str                      # key used for kill-switch + explain
+    description: str
+    exprs_of: Callable[[PhysicalPlan], List[ir.Expression]]
+    convert: Callable[[PhysicalPlan, List[PhysicalPlan]], PhysicalPlan]
+    extra_tag: Optional[Callable[[PhysicalPlan, RapidsTpuConf],
+                                 List[str]]] = None
+    incompat: bool = False
+    disabled_by_default: bool = False
+
+
+def _no_exprs(n: PhysicalPlan) -> List[ir.Expression]:
+    return []
+
+
+_EXEC_RULES: Dict[Type[PhysicalPlan], ExecRule] = {}
+
+
+def register_exec_rule(cpu_cls: Type[PhysicalPlan], rule: ExecRule) -> None:
+    _EXEC_RULES[cpu_cls] = rule
+
+
+def _sort_unsupported_types(n: cpux.CpuSortExec, conf) -> List[str]:
+    out = []
+    for o in n.orders:
+        if o.expr.dtype is not None and o.expr.dtype.is_floating and \
+                not conf.get(cfg.ENABLE_FLOAT_SORT):
+            out.append("float sort disabled")
+    return out
+
+
+register_exec_rule(cpux.CpuScanExec, ExecRule(
+    "InMemoryScan", "in-memory table scan feeding the device",
+    _no_exprs,
+    # scan stays on CPU; the host->device transition makes it device-feeding
+    convert=lambda n, ch: n))
+
+register_exec_rule(cpux.CpuProjectExec, ExecRule(
+    "ProjectExec", "TPU projection (bound-expression columnar eval)",
+    lambda n: list(n.exprs),
+    convert=lambda n, ch: tpub.TpuProjectExec(ch[0], n.exprs, n.schema)))
+
+register_exec_rule(cpux.CpuFilterExec, ExecRule(
+    "FilterExec", "TPU filter (mask + stream compaction)",
+    lambda n: [n.condition],
+    convert=lambda n, ch: tpub.TpuFilterExec(ch[0], n.condition)))
+
+register_exec_rule(cpux.CpuRangeExec, ExecRule(
+    "RangeExec", "TPU range generation",
+    _no_exprs,
+    convert=lambda n, ch: tpub.TpuRangeExec(
+        n.start, n.end, n.step, n.num_partitions)))
+
+register_exec_rule(cpux.CpuUnionExec, ExecRule(
+    "UnionExec", "TPU union (partition concatenation)",
+    _no_exprs,
+    convert=lambda n, ch: tpub.TpuUnionExec(ch)))
+
+register_exec_rule(cpux.CpuLimitExec, ExecRule(
+    "GlobalLimitExec", "TPU global limit",
+    _no_exprs,
+    convert=lambda n, ch: tpub.TpuGlobalLimitExec(ch[0], n.n)))
+
+register_exec_rule(cpux.CpuSortExec, ExecRule(
+    "SortExec", "TPU total sort (total-order key encode + lexsort)",
+    lambda n: [o.expr for o in n.orders],
+    convert=lambda n, ch: TpuSortExec(ch[0], n.orders),
+    extra_tag=_sort_unsupported_types))
+
+register_exec_rule(cpux.CpuHashAggregateExec, ExecRule(
+    "HashAggregateExec",
+    "TPU hash aggregate (sort-based segmented reduction)",
+    lambda n: list(n.groupings) + list(n.aggregates),
+    convert=lambda n, ch: TpuHashAggregateExec(
+        ch[0], n.groupings, n.aggregates, n.schema)))
+
+register_exec_rule(cpux.CpuExpandExec, ExecRule(
+    "ExpandExec", "TPU expand (N projections per row)",
+    lambda n: [e for p in n.projections for e in p],
+    convert=lambda n, ch: tpub.TpuExpandExec(ch[0], n.projections, n.schema)))
+
+
+# ---------------------------------------------------------------------------
+# Meta tree
+# ---------------------------------------------------------------------------
+
+@dataclass
+class ExecMeta:
+    node: PhysicalPlan
+    rule: Optional[ExecRule]
+    children: List["ExecMeta"] = field(default_factory=list)
+    reasons: List[str] = field(default_factory=list)
+
+    def will_not_work_on_tpu(self, reason: str) -> None:
+        if reason not in self.reasons:
+            self.reasons.append(reason)
+
+    @property
+    def can_run_on_tpu(self) -> bool:
+        return self.rule is not None and not self.reasons
+
+    def explain_lines(self, all_: bool, depth: int = 0) -> List[str]:
+        name = self.rule.spark_name if self.rule else \
+            type(self.node).__name__
+        pad = "  " * depth
+        lines = []
+        if self.can_run_on_tpu:
+            if all_:
+                lines.append(f"{pad}*Exec <{name}> will run on TPU")
+        else:
+            why = "; ".join(self.reasons) or "no TPU replacement rule"
+            lines.append(f"{pad}!Exec <{name}> cannot run on TPU because "
+                         f"{why}")
+        for c in self.children:
+            lines.extend(c.explain_lines(all_, depth + 1))
+        return lines
+
+
+def _supported_schema_reasons(node: PhysicalPlan) -> List[str]:
+    out = []
+    for f in node.schema.fields:
+        if f.dtype not in dt.ALL_TYPES:
+            out.append(f"unsupported type {f.dtype} for column {f.name}")
+    return out
+
+
+def wrap_and_tag(node: PhysicalPlan, conf: RapidsTpuConf) -> ExecMeta:
+    rule = _EXEC_RULES.get(type(node))
+    meta = ExecMeta(node, rule)
+    meta.children = [wrap_and_tag(c, conf) for c in node.children]
+    if rule is None:
+        meta.will_not_work_on_tpu(
+            f"no TPU replacement for {type(node).__name__}")
+        return meta
+    if not conf.sql_enabled:
+        meta.will_not_work_on_tpu("TPU SQL acceleration is disabled")
+        return meta
+    key = f"spark.rapids.tpu.sql.exec.{rule.spark_name}"
+    if not conf.is_operator_enabled(key, rule.incompat,
+                                   rule.disabled_by_default):
+        meta.will_not_work_on_tpu(f"disabled by {key}")
+    for r in _supported_schema_reasons(node):
+        meta.will_not_work_on_tpu(r)
+    for r in check_exprs(rule.exprs_of(node), conf):
+        meta.will_not_work_on_tpu(r)
+    if rule.extra_tag is not None:
+        for r in rule.extra_tag(node, conf):
+            meta.will_not_work_on_tpu(r)
+    return meta
+
+
+# ---------------------------------------------------------------------------
+# Conversion with transition insertion
+# ---------------------------------------------------------------------------
+
+def _convert(meta: ExecMeta, conf: RapidsTpuConf) -> PhysicalPlan:
+    """Bottom-up conversion; returns a plan whose output currency is device
+    (TpuExec) or host (PhysicalPlan)."""
+    children = [_convert(c, conf) for c in meta.children]
+
+    # a CPU scan feeding a TPU subtree is handled by the parent transition;
+    # scans themselves never convert (device decode arrives with the io layer)
+    if meta.can_run_on_tpu and not isinstance(meta.node, cpux.CpuScanExec):
+        # device inputs required
+        min_bucket = conf.get(cfg.MIN_BUCKET_ROWS)
+        dev_children = [
+            c if c.is_tpu else tpub.HostToDeviceExec(c, min_bucket)
+            for c in children]
+        return meta.rule.convert(meta.node, dev_children)
+
+    # CPU node: host inputs required
+    host_children = [
+        c if not c.is_tpu else tpub.DeviceToHostExec(c)
+        for c in children]
+    node = meta.node
+    if host_children and tuple(host_children) != tuple(node.children):
+        node.children = tuple(host_children)
+    return node
+
+
+class TpuOverrides:
+    """The ColumnarRule analog: apply() rewrites the CPU physical plan."""
+
+    @staticmethod
+    def apply(cpu_plan: PhysicalPlan, conf: RapidsTpuConf
+              ) -> "OverrideResult":
+        meta = wrap_and_tag(cpu_plan, conf)
+        plan = _convert(meta, conf)
+        if plan.is_tpu:
+            plan = tpub.DeviceToHostExec(plan)
+        explain = conf.explain
+        if explain in ("NOT_ON_TPU", "ALL"):
+            lines = meta.explain_lines(all_=(explain == "ALL"))
+            if lines:
+                print("\n".join(lines))
+        return OverrideResult(plan, meta)
+
+
+@dataclass
+class OverrideResult:
+    plan: PhysicalPlan
+    meta: ExecMeta
+
+    def explain_string(self, all_: bool = True) -> str:
+        return "\n".join(self.meta.explain_lines(all_))
+
+
+def assert_is_on_tpu(plan: PhysicalPlan, allowed_non_tpu: List[str]) -> None:
+    """Test-mode assertion (reference: GpuTransitionOverrides.scala:389-446
+    assertIsOnTheGpu gated by spark.rapids.sql.test.enabled)."""
+    always_ok = {"CpuScanExec", "CpuFileScanExec", "HostToDeviceExec",
+                 "DeviceToHostExec"}
+    bad: List[str] = []
+
+    def visit(n: PhysicalPlan):
+        name = type(n).__name__
+        if not n.is_tpu and name not in always_ok and \
+                name not in allowed_non_tpu:
+            bad.append(name)
+    plan.foreach(visit)
+    if bad:
+        raise AssertionError(
+            f"plan contains CPU nodes not allowed in test mode: {bad}")

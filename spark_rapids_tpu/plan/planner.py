@@ -1,0 +1,62 @@
+"""Physical planner: logical plan -> CPU physical plan.
+
+Plays the role Spark's query planner plays above the reference plugin: it
+produces the "stock" CPU physical plan that TpuOverrides then rewrites
+(reference call stack: SURVEY.md §3.1).
+"""
+
+from __future__ import annotations
+
+from spark_rapids_tpu.config import RapidsTpuConf
+from spark_rapids_tpu import config as cfg
+from spark_rapids_tpu.exec import cpu as cpux
+from spark_rapids_tpu.exec.base import PhysicalPlan
+from spark_rapids_tpu.plan import logical as lp
+
+
+def plan_cpu(node: lp.LogicalPlan, conf: RapidsTpuConf) -> PhysicalPlan:
+    if isinstance(node, lp.InMemoryScan):
+        return cpux.CpuScanExec(node.table, node.num_partitions,
+                                conf.get(cfg.MAX_READER_BATCH_SIZE_ROWS))
+    if isinstance(node, lp.FileScan):
+        from spark_rapids_tpu.io.readers import CpuFileScanExec
+        return CpuFileScanExec(node, conf)
+    if isinstance(node, lp.Project):
+        child = plan_cpu(node.children[0], conf)
+        return cpux.CpuProjectExec(child, node.exprs, node.schema)
+    if isinstance(node, lp.Filter):
+        child = plan_cpu(node.children[0], conf)
+        return cpux.CpuFilterExec(child, node.condition)
+    if isinstance(node, lp.Sort):
+        child = plan_cpu(node.children[0], conf)
+        return cpux.CpuSortExec(child, node.orders)
+    if isinstance(node, lp.Aggregate):
+        child = plan_cpu(node.children[0], conf)
+        from spark_rapids_tpu.expr import ir
+        aggs = []
+        for a in node.aggregates:
+            inner = a.children[0] if isinstance(a, ir.Alias) else a
+            if not isinstance(inner, ir.AggregateExpression):
+                raise NotImplementedError(
+                    "aggregate expressions must be plain aggregate "
+                    "functions (optionally aliased) for now")
+            aggs.append(inner)
+        return cpux.CpuHashAggregateExec(child, node.groupings, aggs,
+                                         node.schema)
+    if isinstance(node, lp.Limit):
+        child = plan_cpu(node.children[0], conf)
+        return cpux.CpuLimitExec(child, node.n)
+    if isinstance(node, lp.Union):
+        return cpux.CpuUnionExec([plan_cpu(c, conf) for c in node.children])
+    if isinstance(node, lp.Join):
+        left = plan_cpu(node.children[0], conf)
+        right = plan_cpu(node.children[1], conf)
+        return cpux.CpuJoinExec(left, right, node.left_keys, node.right_keys,
+                                node.how, node.condition, node.schema)
+    if isinstance(node, lp.Range):
+        return cpux.CpuRangeExec(node.start, node.end, node.step,
+                                 node.num_partitions)
+    if isinstance(node, lp.Expand):
+        child = plan_cpu(node.children[0], conf)
+        return cpux.CpuExpandExec(child, node.projections, node.schema)
+    raise NotImplementedError(f"planner: {type(node).__name__}")

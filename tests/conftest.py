@@ -1,0 +1,32 @@
+"""Test bootstrap: force a hermetic 8-virtual-device CPU platform.
+
+The driver's bench runs on the real TPU chip; tests run anywhere.  The
+virtual device count lets sharding/collective tests exercise a real
+``jax.sharding.Mesh`` without hardware (SURVEY.md §4 implication: ~95% of
+the system verifiable on a single host).
+"""
+
+import os
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8").strip()
+
+# the axon sitecustomize force-registers the tunneled TPU backend (with
+# remote compilation) ahead of CPU regardless of JAX_PLATFORMS; override
+# the config again after import so tests are hermetic and fast
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
+import pytest  # noqa: E402
+
+
+@pytest.fixture()
+def session():
+    from spark_rapids_tpu import TpuSparkSession
+    return TpuSparkSession({
+        "spark.rapids.tpu.sql.variableFloatAgg.enabled": True,
+    })

@@ -1,0 +1,119 @@
+"""Dual-session parity harness.
+
+Analog of the reference's public correctness gate (reference:
+integration_tests/src/main/python/asserts.py:267-313
+``assert_gpu_and_cpu_are_equal_collect`` running each query under a CPU and
+a GPU session and deep-comparing rows with float tolerance; and
+SparkQueryCompareTestSuite.scala:153-161 withCpuSparkSession/
+withGpuSparkSession).
+
+Here: the same DataFrame function runs once with TPU acceleration off
+(pure CPU/pyarrow engine) and once with it on; results deep-compare with
+float ULP tolerance.  ``assert_tpu_fallback`` is the
+``assert_gpu_fallback_collect`` analog using the plan-capture listener.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Callable, List, Optional
+
+import numpy as np
+import pyarrow as pa
+
+from spark_rapids_tpu import TpuSparkSession
+
+
+def _sort_table(t: pa.Table) -> pa.Table:
+    if t.num_rows == 0 or t.num_columns == 0:
+        return t
+    # order by string repr of every column for a deterministic comparison
+    keys = list(zip(*[[str(v) for v in col.to_pylist()]
+                      for col in t.columns]))
+    idx = sorted(range(t.num_rows), key=lambda i: keys[i])
+    return t.take(pa.array(idx, type=pa.int64()))
+
+
+def _values_equal(a, b, approx_float: bool) -> bool:
+    if a is None or b is None:
+        return a is None and b is None
+    if isinstance(a, float) and isinstance(b, float):
+        if math.isnan(a) or math.isnan(b):
+            return math.isnan(a) and math.isnan(b)
+        if approx_float:
+            return math.isclose(a, b, rel_tol=1e-9, abs_tol=1e-11)
+        return a == b
+    return a == b
+
+
+def assert_tables_equal(cpu: pa.Table, tpu: pa.Table,
+                        ignore_order: bool = False,
+                        approx_float: bool = True) -> None:
+    assert cpu.num_rows == tpu.num_rows, \
+        f"row count: cpu={cpu.num_rows} tpu={tpu.num_rows}"
+    assert cpu.column_names == tpu.column_names, \
+        f"columns: cpu={cpu.column_names} tpu={tpu.column_names}"
+    if ignore_order:
+        cpu, tpu = _sort_table(cpu), _sort_table(tpu)
+    for ci, name in enumerate(cpu.column_names):
+        ca = cpu.column(ci).to_pylist()
+        ta = tpu.column(ci).to_pylist()
+        for i, (x, y) in enumerate(zip(ca, ta)):
+            assert _values_equal(x, y, approx_float), \
+                (f"column {name}[{ci}] row {i}: cpu={x!r} tpu={y!r}\n"
+                 f"cpu table:\n{cpu.to_pandas()}\n"
+                 f"tpu table:\n{tpu.to_pandas()}")
+
+
+_BASE_CONF = {
+    "spark.rapids.tpu.sql.variableFloatAgg.enabled": True,
+}
+
+
+def with_cpu_session(fn: Callable, conf: Optional[dict] = None):
+    c = dict(_BASE_CONF)
+    c.update(conf or {})
+    c["spark.rapids.tpu.sql.enabled"] = False
+    return fn(TpuSparkSession(c))
+
+
+def with_tpu_session(fn: Callable, conf: Optional[dict] = None):
+    c = dict(_BASE_CONF)
+    c.update(conf or {})
+    c["spark.rapids.tpu.sql.enabled"] = True
+    return fn(TpuSparkSession(c))
+
+
+def assert_tpu_and_cpu_are_equal_collect(
+        fn: Callable, conf: Optional[dict] = None,
+        ignore_order: bool = False, approx_float: bool = True) -> None:
+    """fn(session) -> DataFrame; runs on both engines and compares."""
+    cpu = with_cpu_session(lambda s: fn(s).collect(), conf)
+    tpu = with_tpu_session(lambda s: fn(s).collect(), conf)
+    assert_tables_equal(cpu, tpu, ignore_order, approx_float)
+
+
+def collect_plans(session: TpuSparkSession):
+    """Capture override results (ExecutionPlanCaptureCallback analog)."""
+    captured: List = []
+    session.add_plan_listener(captured.append)
+    return captured
+
+
+def assert_tpu_fallback(fn: Callable, fallback_exec: str,
+                        conf: Optional[dict] = None) -> None:
+    """Assert the query ran but a specific exec fell back to CPU
+    (assert_gpu_fallback_collect analog)."""
+    c = dict(_BASE_CONF)
+    c.update(conf or {})
+    s = TpuSparkSession(c)
+    captured = collect_plans(s)
+    fn(s).collect()
+    assert captured, "no plan captured"
+    found = []
+
+    def visit(n):
+        found.append(type(n).__name__)
+    captured[-1].plan.foreach(visit)
+    assert fallback_exec in found, \
+        f"expected CPU fallback exec {fallback_exec} in plan, got {found}"

@@ -1,0 +1,242 @@
+"""Expression-family parity suites (reference analog:
+arithmetic_ops_test.py 459 LoC, string_test, date_time_test, cast ops)."""
+
+import pyarrow as pa
+import pytest
+
+from spark_rapids_tpu import col, lit, functions as F
+from tests.parity import assert_tpu_and_cpu_are_equal_collect
+from tests.data_gen import (gen_df, byte_gen, short_gen, int_gen, long_gen,
+                            float_gen, double_gen, boolean_gen, string_gen,
+                            date_gen, timestamp_gen, StringGen, IntGen)
+
+
+# -- arithmetic -------------------------------------------------------------
+
+@pytest.mark.parametrize("op", ["add", "sub", "mul", "div", "mod", "pmod"])
+def test_arithmetic_parity(op):
+    def q(s):
+        df = gen_df(s, [int_gen, long_gen], ["a", "b"], n=200)
+        c = {"add": col("a") + col("b"), "sub": col("a") - col("b"),
+             "mul": col("a") * col("b"), "div": col("a") / col("b"),
+             "mod": col("a") % col("b"),
+             "pmod": F.pmod(col("a"), col("b"))}[op]
+        return df.select(c.alias("r"))
+    assert_tpu_and_cpu_are_equal_collect(q)
+
+
+def test_float_arithmetic():
+    assert_tpu_and_cpu_are_equal_collect(
+        lambda s: gen_df(s, [double_gen, double_gen], ["a", "b"], n=200)
+        .select((col("a") + col("b")).alias("s"),
+                (col("a") * col("b")).alias("p"),
+                (col("a") / col("b")).alias("d"),
+                F.abs(col("a")).alias("ab"),
+                (-col("a")).alias("n")))
+
+
+def test_comparison_nan_total_order():
+    assert_tpu_and_cpu_are_equal_collect(
+        lambda s: gen_df(s, [double_gen, double_gen], ["a", "b"], n=200)
+        .select((col("a") < col("b")).alias("lt"),
+                (col("a") <= col("b")).alias("le"),
+                (col("a") == col("b")).alias("eq"),
+                (col("a") > col("b")).alias("gt"),
+                (col("a") >= col("b")).alias("ge")))
+
+
+def test_logic_three_valued():
+    assert_tpu_and_cpu_are_equal_collect(
+        lambda s: gen_df(s, [boolean_gen, boolean_gen], ["p", "q"], n=150)
+        .select((col("p") & col("q")).alias("and_"),
+                (col("p") | col("q")).alias("or_"),
+                (~col("p")).alias("not_")))
+
+
+def test_in_set():
+    assert_tpu_and_cpu_are_equal_collect(
+        lambda s: gen_df(s, [int_gen], ["a"], n=150)
+        .select(col("a").isin(1, 2, 0, -1).alias("r")))
+
+
+def test_null_funcs():
+    assert_tpu_and_cpu_are_equal_collect(
+        lambda s: gen_df(s, [double_gen, double_gen], ["a", "b"], n=150)
+        .select(col("a").is_null().alias("n"),
+                col("a").is_not_null().alias("nn"),
+                F.isnan(col("a")).alias("nan"),
+                F.coalesce(col("a"), col("b"), lit(0.0)).alias("c"),
+                F.nanvl(col("a"), col("b")).alias("nv")))
+
+
+# -- math -------------------------------------------------------------------
+
+def test_math_unary():
+    assert_tpu_and_cpu_are_equal_collect(
+        lambda s: gen_df(s, [double_gen], ["a"], n=150)
+        .select(F.sqrt(F.abs(col("a"))).alias("sq"),
+                F.exp(col("a") / lit(1e6)).alias("ex"),
+                F.log(F.abs(col("a")) + lit(1.0)).alias("lg"),
+                F.sin(col("a")).alias("sn"),
+                F.floor(col("a") / lit(1e3)).alias("fl"),
+                F.ceil(col("a") / lit(1e3)).alias("ce"),
+                F.signum(col("a")).alias("sg")))
+
+
+def test_shift_ops():
+    assert_tpu_and_cpu_are_equal_collect(
+        lambda s: gen_df(s, [IntGen(32), IntGen(32, lo=0, hi=31)],
+                         ["a", "n"], n=120)
+        .select(F.shiftleft(col("a"), col("n")).alias("sl"),
+                F.shiftright(col("a"), col("n")).alias("sr"),
+                F.shiftrightunsigned(col("a"), col("n")).alias("sru")))
+
+
+# -- cast -------------------------------------------------------------------
+
+@pytest.mark.parametrize("src,to", [
+    ("int", "bigint"), ("bigint", "int"), ("int", "double"),
+    ("double", "int"), ("double", "float"), ("int", "boolean"),
+    ("boolean", "int"), ("bigint", "double"),
+])
+def test_numeric_casts(src, to):
+    gens = {"int": int_gen, "bigint": long_gen, "double": double_gen,
+            "boolean": boolean_gen}
+
+    def q(s):
+        g = gens.get(src, int_gen)
+        return gen_df(s, [g], ["a"], n=150).select(
+            col("a").cast(to).alias("r"))
+    assert_tpu_and_cpu_are_equal_collect(q)
+
+
+def test_cast_string_to_int():
+    def q(s):
+        df = s.create_dataframe({"a": ["1", "-42", " 12 ", "+7", "x", "",
+                                       None, "999999999999", "1.5"]})
+        return df.select(col("a").cast("bigint").alias("r"))
+    assert_tpu_and_cpu_are_equal_collect(q)
+
+
+def test_cast_date_timestamp():
+    assert_tpu_and_cpu_are_equal_collect(
+        lambda s: gen_df(s, [date_gen], ["d"], n=100)
+        .select(col("d").cast("timestamp").alias("ts")))
+    assert_tpu_and_cpu_are_equal_collect(
+        lambda s: gen_df(s, [timestamp_gen], ["t"], n=100)
+        .select(col("t").cast("date").alias("d")))
+
+
+# -- strings ----------------------------------------------------------------
+
+def test_string_basics():
+    assert_tpu_and_cpu_are_equal_collect(
+        lambda s: gen_df(s, [string_gen], ["s"], n=150)
+        .select(F.upper(col("s")).alias("u"),
+                F.lower(col("s")).alias("l"),
+                F.length(col("s")).alias("n"),
+                F.trim(col("s")).alias("t"),
+                F.ltrim(col("s")).alias("lt"),
+                F.rtrim(col("s")).alias("rt"),
+                F.initcap(col("s")).alias("ic")))
+
+
+def test_string_predicates():
+    assert_tpu_and_cpu_are_equal_collect(
+        lambda s: gen_df(s, [StringGen(max_len=8)], ["s"], n=150)
+        .select(col("s").startswith("a").alias("sw"),
+                col("s").endswith("b").alias("ew"),
+                col("s").contains("ab").alias("ct"),
+                col("s").like("%a%").alias("lk"),
+                col("s").like("a%").alias("lk2"),
+                (col("s") == lit("abc")).alias("eq")))
+
+
+def test_string_ordering():
+    assert_tpu_and_cpu_are_equal_collect(
+        lambda s: gen_df(s, [StringGen(max_len=6), StringGen(max_len=6)],
+                         ["a", "b"], n=150)
+        .select((col("a") < col("b")).alias("lt"),
+                (col("a") >= col("b")).alias("ge")))
+
+
+def test_substring_concat():
+    assert_tpu_and_cpu_are_equal_collect(
+        lambda s: gen_df(s, [StringGen(max_len=10)], ["s"], n=150)
+        .select(col("s").substr(2, 3).alias("s23"),
+                col("s").substr(-2, 2).alias("sn2"),
+                F.concat(col("s"), lit("-"), col("s")).alias("cc")))
+
+
+def test_pad_locate():
+    def q(s):
+        df = s.create_dataframe(
+            {"s": ["a", "abc", "abcdef", "", None, " x "]})
+        return df.select(F.lpad(col("s"), 5, "*").alias("lp"),
+                         F.rpad(col("s"), 5, "xy").alias("rp"),
+                         F.lpad(col("s"), 2, "*").alias("lp2"),
+                         F.lpad(col("s"), -1, "*").alias("lpneg"),
+                         F.rpad(col("s"), 0, "z").alias("rp0"),
+                         F.locate("b", col("s")).alias("loc"))
+    assert_tpu_and_cpu_are_equal_collect(q)
+
+
+# -- temporal ---------------------------------------------------------------
+
+def test_date_fields():
+    assert_tpu_and_cpu_are_equal_collect(
+        lambda s: gen_df(s, [date_gen], ["d"], n=200)
+        .select(F.year(col("d")).alias("y"),
+                F.month(col("d")).alias("m"),
+                F.dayofmonth(col("d")).alias("dom"),
+                F.dayofyear(col("d")).alias("doy"),
+                F.dayofweek(col("d")).alias("dow"),
+                F.weekofyear(col("d")).alias("woy"),
+                F.quarter(col("d")).alias("q")))
+
+
+def test_timestamp_fields():
+    assert_tpu_and_cpu_are_equal_collect(
+        lambda s: gen_df(s, [timestamp_gen], ["t"], n=200)
+        .select(F.year(col("t")).alias("y"),
+                F.month(col("t")).alias("m"),
+                F.hour(col("t")).alias("h"),
+                F.minute(col("t")).alias("mi"),
+                F.second(col("t")).alias("sec"),
+                F.unix_timestamp(col("t")).alias("ut")))
+
+
+def test_date_arith():
+    assert_tpu_and_cpu_are_equal_collect(
+        lambda s: gen_df(s, [date_gen, IntGen(32, lo=-1000, hi=1000)],
+                         ["d", "n"], n=150)
+        .select(F.date_add(col("d"), col("n")).alias("da"),
+                F.date_sub(col("d"), col("n")).alias("ds"),
+                F.datediff(col("d"), F.date_add(col("d"), col("n")))
+                .alias("dd")))
+
+
+# -- hash / ids -------------------------------------------------------------
+
+def test_murmur3_hash_parity():
+    assert_tpu_and_cpu_are_equal_collect(
+        lambda s: gen_df(s, [int_gen, long_gen, string_gen, double_gen],
+                         ["a", "b", "s", "d"], n=200)
+        .select(F.hash(col("a"), col("b"), col("s"), col("d")).alias("h")))
+
+
+def test_partition_ids():
+    assert_tpu_and_cpu_are_equal_collect(
+        lambda s: gen_df(s, [int_gen], ["a"], n=100, num_partitions=4)
+        .select(col("a"), F.spark_partition_id().alias("pid"),
+                F.monotonically_increasing_id().alias("mid")))
+
+
+def test_conditional_case_when():
+    assert_tpu_and_cpu_are_equal_collect(
+        lambda s: gen_df(s, [int_gen, string_gen], ["a", "s"], n=150)
+        .select(F.when(col("a") > 0, lit("pos"))
+                .when(col("a") < 0, lit("neg"))
+                .otherwise(lit("zero")).alias("sign"),
+                F.if_(col("a").is_null(), lit(-1),
+                      col("a")).alias("nvl")))
