@@ -1,0 +1,106 @@
+"""Benchmark: TPC-DS q6-class pipeline (filter -> hash aggregate).
+
+Prints ONE JSON line:
+  {"metric": ..., "value": N, "unit": ..., "vs_baseline": N}
+
+value        = TPU steady-state throughput (million rows/s) of the fused
+               filter+group-by-aggregate kernel over HBM-resident data
+vs_baseline  = speedup over the engine's own CPU (pyarrow) execution of the
+               same query — the "stock Spark CPU" role in the reference's
+               GPU-vs-CPU framing (reference: docs/FAQ.md 3-7x typical).
+"""
+
+import json
+import sys
+import time
+
+import numpy as np
+import pyarrow as pa
+
+
+def main() -> None:
+    import spark_rapids_tpu  # noqa: F401 (x64)
+    import jax
+    import jax.numpy as jnp
+    from spark_rapids_tpu import TpuSparkSession, col, functions as F
+    from spark_rapids_tpu.columnar.batch import from_arrow
+    from spark_rapids_tpu.exec.tpu_aggregate import (
+        finalize_aggregate, make_spec, update_aggregate)
+    from spark_rapids_tpu.exec.tpu_basic import compact
+    from spark_rapids_tpu.expr import eval_tpu, ir
+    from spark_rapids_tpu.plan.logical import Schema
+
+    n = int(sys.argv[1]) if len(sys.argv) > 1 else 1 << 21  # 2M rows
+    rng = np.random.default_rng(42)
+    table = pa.table({
+        "k": pa.array(rng.integers(0, 1000, n), type=pa.int32()),
+        "price": pa.array(rng.uniform(0, 300, n)),
+        "qty": pa.array(rng.integers(1, 100, n), type=pa.int64()),
+    })
+
+    # ---- CPU baseline: same query through the CPU engine ------------------
+    cpu = TpuSparkSession({"spark.rapids.tpu.sql.enabled": False,
+                           "spark.rapids.tpu.sql.variableFloatAgg.enabled":
+                           True})
+
+    def query(s):
+        return (s.create_dataframe(table)
+                .filter(col("price") > 150.0)
+                .group_by("k")
+                .agg(F.count("*").alias("cnt"),
+                     F.sum("qty").alias("qty_sum"),
+                     F.avg("price").alias("price_avg")))
+
+    query(cpu).collect()  # warm
+    t0 = time.perf_counter()
+    cpu_iters = 3
+    for _ in range(cpu_iters):
+        query(cpu).collect()
+    cpu_time = (time.perf_counter() - t0) / cpu_iters
+
+    # ---- TPU kernel: fused filter + update-agg + finalize -----------------
+    schema = Schema.from_arrow(table.schema)
+
+    def b(e):
+        return ir.bind(e, schema.names, schema.dtypes, schema.nullables)
+
+    cond = b(ir.GreaterThan(ir.UnresolvedAttribute("price"),
+                            ir.Literal(150.0)))
+    groupings = [b(ir.UnresolvedAttribute("k"))]
+    aggregates = []
+    for a in [ir.Count(None), ir.Sum(b(ir.UnresolvedAttribute("qty"))),
+              ir.Average(b(ir.UnresolvedAttribute("price")))]:
+        a.resolve()
+        aggregates.append(a)
+    specs = [make_spec(a) for a in aggregates]
+
+    def step(batch):
+        v = eval_tpu.evaluate(cond, batch)
+        filtered = compact(batch, v.data.astype(jnp.bool_) & v.validity)
+        partial = update_aggregate(filtered, groupings, aggregates, specs)
+        return finalize_aggregate(partial, 1, specs,
+                                  ["k", "cnt", "qty_sum", "price_avg"])
+
+    batch = from_arrow(table)
+    fn = jax.jit(step)
+    out = fn(batch)
+    jax.block_until_ready(jax.tree_util.tree_leaves(out))  # compile+warm
+    iters = 5
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        out = fn(batch)
+    jax.block_until_ready(jax.tree_util.tree_leaves(out))
+    tpu_time = (time.perf_counter() - t0) / iters
+
+    mrows_per_s = (n / tpu_time) / 1e6
+    print(json.dumps({
+        "metric": "q6-class filter+hash-agg throughput (2M rows, "
+                  "1000 groups)",
+        "value": round(mrows_per_s, 3),
+        "unit": "Mrows/s",
+        "vs_baseline": round(cpu_time / tpu_time, 3),
+    }))
+
+
+if __name__ == "__main__":
+    main()

@@ -13,7 +13,7 @@ Batch currency: ``pyarrow.Table``.
 from __future__ import annotations
 
 import itertools
-from typing import Any, Iterator, List, Optional, Sequence
+from typing import Any, Iterator, List, Optional, Sequence, Tuple
 
 import numpy as np
 import pyarrow as pa
@@ -362,6 +362,38 @@ class CpuExpandExec(PhysicalPlan):
         return [run(it) for it in self.children[0].execute()]
 
 
+def _cast_join_keys(t: pa.Table, keys: List[str], dtypes) -> pa.Table:
+    for k, d in zip(keys, dtypes):
+        i = t.column_names.index(k)
+        col = t.column(k)
+        if col.type != d.to_arrow():
+            t = t.set_column(i, k, col.cast(d.to_arrow()))
+    return t
+
+
+def _normalize_float_join_keys(t: pa.Table, keys: List[str]
+                               ) -> Tuple[pa.Table, List[str]]:
+    """Replace float key columns with canonicalized bit-pattern columns."""
+    out_keys = []
+    for k in keys:
+        col = t.column(k).combine_chunks()
+        if pa.types.is_floating(col.type):
+            mask = np.asarray(col.is_null())
+            vals = col.fill_null(0.0).to_numpy(zero_copy_only=False)
+            vals = np.where(vals == 0.0, 0.0, vals)
+            vals = np.where(np.isnan(vals), np.nan, vals)  # canonical NaN
+            if col.type == pa.float32():
+                bits = vals.astype(np.float32).view(np.int32)
+            else:
+                bits = vals.astype(np.float64).view(np.int64)
+            name = f"{k}__bits"
+            t = t.append_column(name, pa.array(bits, mask=mask))
+            out_keys.append(name)
+        else:
+            out_keys.append(k)
+    return t, out_keys
+
+
 class CpuJoinExec(PhysicalPlan):
     """Hash join via pyarrow Table.join (+ cross join by replication)."""
 
@@ -377,13 +409,14 @@ class CpuJoinExec(PhysicalPlan):
     def __init__(self, left: PhysicalPlan, right: PhysicalPlan,
                  left_keys: Sequence[str], right_keys: Sequence[str],
                  how: str, condition: Optional[ir.Expression],
-                 schema: Schema):
+                 schema: Schema, key_dtypes: Optional[List] = None):
         super().__init__()
         self.children = (left, right)
         self.left_keys, self.right_keys = list(left_keys), list(right_keys)
         self.how = how
         self.condition = condition
         self._schema = schema
+        self.key_dtypes = key_dtypes
 
     @property
     def schema(self) -> Schema:
@@ -413,6 +446,15 @@ class CpuJoinExec(PhysicalPlan):
                 lk = [f"__l{lt.column_names.index(k)}" for k in self.left_keys]
                 rk = [f"__r{rt.column_names.index(k)}" for k in
                       self.right_keys]
+                # promote mismatched numeric key pairs to the common type
+                # (Spark's implicit cast before key comparison)
+                if self.key_dtypes is not None:
+                    lt2 = _cast_join_keys(lt2, lk, self.key_dtypes)
+                    rt2 = _cast_join_keys(rt2, rk, self.key_dtypes)
+                # Spark joins NaN==NaN and -0.0==0.0 (NormalizeFloatingNumbers);
+                # arrow's join does not, so float keys join on canonical bits
+                lt2, lk = _normalize_float_join_keys(lt2, lk)
+                rt2, rk = _normalize_float_join_keys(rt2, rk)
                 joined = lt2.join(
                     rt2, keys=lk, right_keys=rk,
                     join_type=self._HOW_MAP[self.how],

@@ -301,6 +301,120 @@ def make_spec(agg: ir.AggregateExpression) -> _AggSpec:
     raise NotImplementedError(type(agg).__name__)
 
 
+# ---------------------------------------------------------------------------
+# Pure kernel functions (shared by the exec and the ICI distributed path)
+# ---------------------------------------------------------------------------
+
+def normalize_key(v: ColVal) -> ColVal:
+    """NaN/-0.0 canonicalization for grouping keys (Spark
+    NormalizeFloatingNumbers semantics)."""
+    if v.dtype.is_floating:
+        x = jnp.where(jnp.isnan(v.data),
+                      jnp.array(np.nan, dtype=v.data.dtype), v.data)
+        x = jnp.where(x == 0.0, jnp.zeros_like(x), x)
+        return ColVal(v.dtype, x, v.validity, v.lengths)
+    return v
+
+
+def sorted_group_ctx(key_vals: List[ColVal],
+                     batch: DeviceBatch) -> _SortedCtx:
+    """Sort rows so equal keys are adjacent; build segment ids."""
+    cap = batch.capacity
+    row_mask = batch.row_mask()
+    if not key_vals:
+        # global aggregation: one group holding every row
+        zeros = jnp.zeros((cap,), dtype=jnp.int32)
+        return _SortedCtx(order=jnp.arange(cap), seg_sorted=zeros,
+                          seg_orig=zeros, cap=cap, row_mask=row_mask,
+                          n_groups=jnp.int32(1))
+    groups = [sortkeys.encode_keys(v, True, True) for v in key_vals]
+    order = sortkeys.lexsort_indices(groups, row_mask)
+    new_group = sortkeys.group_boundaries(groups, order, row_mask)
+    seg_sorted = jnp.cumsum(new_group.astype(jnp.int32)) - 1
+    seg_orig = jnp.zeros((cap,), dtype=jnp.int32).at[order].set(seg_sorted)
+    sorted_mask = jnp.take(row_mask, order)
+    n_groups = jnp.sum((new_group & sorted_mask).astype(jnp.int32))
+    return _SortedCtx(order=order, seg_sorted=seg_sorted,
+                      seg_orig=seg_orig, cap=cap, row_mask=row_mask,
+                      n_groups=n_groups)
+
+
+def gather_group_keys(key_vals: List[ColVal],
+                      ctx: _SortedCtx) -> List[DeviceColumn]:
+    """Representative key row per group (first sorted row)."""
+    if not key_vals:
+        return []
+    i = jnp.arange(ctx.cap, dtype=jnp.int64)
+    first_sorted_pos = _seg_min(i, ctx.seg_sorted, ctx.cap)
+    j = jnp.clip(first_sorted_pos, 0, ctx.cap - 1)
+    orig = jnp.take(ctx.order, j)
+    group_exists = jnp.arange(ctx.cap) < ctx.n_groups
+    return [v.to_column().gather(orig, group_exists) for v in key_vals]
+
+
+def _append_buffers(cols, names, bufs_per_spec, specs, ctx):
+    for ai, (spec, bufs) in enumerate(zip(specs, bufs_per_spec)):
+        for bi, ((data, valid), bdt) in enumerate(
+                zip(bufs, spec.buffer_dtypes())):
+            group_exists = jnp.arange(ctx.cap) < ctx.n_groups
+            cols.append(DeviceColumn(
+                bdt, jnp.where(group_exists, data.astype(bdt.to_np()), 0)
+                if data.ndim == 1 else data,
+                valid & group_exists, None))
+            names.append(f"__a{ai}_{bi}")
+
+
+def update_aggregate(batch: DeviceBatch,
+                     groupings: Sequence[ir.Expression],
+                     aggregates: Sequence[ir.AggregateExpression],
+                     specs: Sequence[_AggSpec]) -> DeviceBatch:
+    """Per-batch update phase: groupBy().aggregate(updateAggs) analog."""
+    key_vals = [normalize_key(eval_tpu.evaluate(g, batch))
+                for g in groupings]
+    ctx = sorted_group_ctx(key_vals, batch)
+    cols = gather_group_keys(key_vals, ctx)
+    names = [f"__k{i}" for i in range(len(cols))]
+    bufs_per_spec = []
+    for agg, spec in zip(aggregates, specs):
+        v = eval_tpu.evaluate(agg.child, batch) \
+            if agg.child is not None else None
+        bufs_per_spec.append(spec.update(v, ctx))
+    _append_buffers(cols, names, bufs_per_spec, specs, ctx)
+    return DeviceBatch(names, cols, ctx.n_groups)
+
+
+def merge_aggregate(batch: DeviceBatch, n_keys: int,
+                    specs: Sequence[_AggSpec]) -> DeviceBatch:
+    """Merge phase over concatenated partials: mergeAggs analog."""
+    key_cols = batch.columns[:n_keys]
+    key_vals = [ColVal(c.dtype, c.data, c.validity, c.lengths)
+                for c in key_cols]
+    ctx = sorted_group_ctx(key_vals, batch)
+    cols = gather_group_keys(key_vals, ctx)
+    names = list(batch.names[:n_keys])
+    bufs_per_spec = []
+    off = n_keys
+    for spec in specs:
+        bufs = batch.columns[off:off + spec.n_buffers]
+        off += spec.n_buffers
+        bufs_per_spec.append(spec.merge(bufs, ctx))
+    _append_buffers(cols, names, bufs_per_spec, specs, ctx)
+    return DeviceBatch(names, cols, ctx.n_groups)
+
+
+def finalize_aggregate(batch: DeviceBatch, n_keys: int,
+                       specs: Sequence[_AggSpec],
+                       out_names: Sequence[str]) -> DeviceBatch:
+    """Final projection from buffer columns to output columns."""
+    cols = list(batch.columns[:n_keys])
+    off = n_keys
+    for spec in specs:
+        bufs = batch.columns[off:off + spec.n_buffers]
+        off += spec.n_buffers
+        cols.append(spec.finalize(bufs).to_column())
+    return DeviceBatch(list(out_names), cols, batch.num_rows)
+
+
 class TpuHashAggregateExec(TpuExec):
     def __init__(self, child: PhysicalPlan,
                  groupings: Sequence[ir.Expression],
@@ -319,108 +433,16 @@ class TpuHashAggregateExec(TpuExec):
     def schema(self) -> Schema:
         return self._schema
 
-    # ------------------------------------------------------------------
-    def _sorted_ctx(self, key_vals: List[ColVal],
-                    batch: DeviceBatch) -> _SortedCtx:
-        cap = batch.capacity
-        row_mask = batch.row_mask()
-        if not self.groupings:
-            # global aggregation: one group holding every row
-            zeros = jnp.zeros((cap,), dtype=jnp.int32)
-            return _SortedCtx(order=jnp.arange(cap), seg_sorted=zeros,
-                              seg_orig=zeros, cap=cap, row_mask=row_mask,
-                              n_groups=jnp.int32(1))
-        groups = [sortkeys.encode_keys(v, True, True) for v in key_vals]
-        order = sortkeys.lexsort_indices(groups, row_mask)
-        new_group = sortkeys.group_boundaries(groups, order, row_mask)
-        seg_sorted = jnp.cumsum(new_group.astype(jnp.int32)) - 1
-        seg_orig = jnp.zeros((cap,), dtype=jnp.int32).at[order].set(
-            seg_sorted)
-        sorted_mask = jnp.take(row_mask, order)
-        n_groups = jnp.sum((new_group & sorted_mask).astype(jnp.int32))
-        return _SortedCtx(order=order, seg_sorted=seg_sorted,
-                          seg_orig=seg_orig, cap=cap, row_mask=row_mask,
-                          n_groups=n_groups)
-
-    def _gather_keys(self, key_vals: List[ColVal],
-                     ctx: _SortedCtx) -> List[DeviceColumn]:
-        """Representative key row per group (first sorted row)."""
-        if not self.groupings:
-            return []
-        i = jnp.arange(ctx.cap, dtype=jnp.int64)
-        first_sorted_pos = _seg_min(i, ctx.seg_sorted, ctx.cap)
-        j = jnp.clip(first_sorted_pos, 0, ctx.cap - 1)
-        orig = jnp.take(ctx.order, j)
-        group_exists = jnp.arange(ctx.cap) < ctx.n_groups
-        out = []
-        for v in key_vals:
-            col = v.to_column().gather(orig, group_exists)
-            out.append(col)
-        return out
-
     def _update_impl(self, batch: DeviceBatch) -> DeviceBatch:
-        key_vals = [eval_tpu.evaluate(g, batch) for g in self.groupings]
-        # normalize float keys (NaN/-0.0) for Spark grouping semantics
-        key_vals = [self._normalize(v) for v in key_vals]
-        ctx = self._sorted_ctx(key_vals, batch)
-        cols = self._gather_keys(key_vals, ctx)
-        names = [f"__k{i}" for i in range(len(cols))]
-        for ai, (agg, spec) in enumerate(zip(self.aggregates, self.specs)):
-            v = eval_tpu.evaluate(agg.child, batch) \
-                if agg.child is not None else None
-            bufs = spec.update(v, ctx)
-            for bi, ((data, valid), bdt) in enumerate(
-                    zip(bufs, spec.buffer_dtypes())):
-                group_exists = jnp.arange(ctx.cap) < ctx.n_groups
-                cols.append(DeviceColumn(
-                    bdt, jnp.where(group_exists, data.astype(bdt.to_np()), 0)
-                    if data.ndim == 1 else data,
-                    valid & group_exists, None))
-                names.append(f"__a{ai}_{bi}")
-        return DeviceBatch(names, cols, ctx.n_groups)
+        return update_aggregate(batch, self.groupings, self.aggregates,
+                                self.specs)
 
     def _merge_impl(self, batch: DeviceBatch) -> DeviceBatch:
-        nk = len(self.groupings)
-        key_cols = batch.columns[:nk]
-        key_vals = [ColVal(c.dtype, c.data, c.validity, c.lengths)
-                    for c in key_cols]
-        ctx = self._sorted_ctx(key_vals, batch)
-        cols = self._gather_keys(key_vals, ctx)
-        names = list(batch.names[:nk])
-        off = nk
-        for ai, spec in enumerate(self.specs):
-            bufs = batch.columns[off:off + spec.n_buffers]
-            off += spec.n_buffers
-            merged = spec.merge(bufs, ctx)
-            for bi, ((data, valid), bdt) in enumerate(
-                    zip(merged, spec.buffer_dtypes())):
-                group_exists = jnp.arange(ctx.cap) < ctx.n_groups
-                cols.append(DeviceColumn(
-                    bdt, jnp.where(group_exists,
-                                   data.astype(bdt.to_np()), 0)
-                    if data.ndim == 1 else data,
-                    valid & group_exists, None))
-                names.append(f"__a{ai}_{bi}")
-        return DeviceBatch(names, cols, ctx.n_groups)
+        return merge_aggregate(batch, len(self.groupings), self.specs)
 
     def _final_impl(self, batch: DeviceBatch) -> DeviceBatch:
-        nk = len(self.groupings)
-        cols = list(batch.columns[:nk])
-        off = nk
-        for spec in self.specs:
-            bufs = batch.columns[off:off + spec.n_buffers]
-            off += spec.n_buffers
-            cols.append(spec.finalize(bufs).to_column())
-        return DeviceBatch(self._schema.names, cols, batch.num_rows)
-
-    @staticmethod
-    def _normalize(v: ColVal) -> ColVal:
-        if v.dtype.is_floating:
-            x = jnp.where(jnp.isnan(v.data),
-                          jnp.array(np.nan, dtype=v.data.dtype), v.data)
-            x = jnp.where(x == 0.0, jnp.zeros_like(x), x)
-            return ColVal(v.dtype, x, v.validity, v.lengths)
-        return v
+        return finalize_aggregate(batch, len(self.groupings), self.specs,
+                                  self._schema.names)
 
     # ------------------------------------------------------------------
     def execute(self):

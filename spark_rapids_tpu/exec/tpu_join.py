@@ -1,0 +1,359 @@
+"""TPU equi-join execs.
+
+Reference analog: ``GpuShuffledHashJoinExec``/``GpuBroadcastHashJoinExec``
+build one hash table from the build side and probe per stream batch via
+``Table.onColumns(keys).innerJoin/leftJoin/fullJoin`` (reference:
+shims/spark300/.../GpuHashJoin.scala:193-326); SortMergeJoin is *replaced by*
+the shuffled hash join (reference: shims/spark300/.../GpuSortMergeJoinExec.scala).
+
+On TPU, the hash table becomes a sort: both sides' keys are encoded into
+total-order words (exec/sortkeys.py), one stable lexsort of the combined
+rows groups equal keys together with build rows ahead of stream rows, and
+segment arithmetic yields each stream row's contiguous build-match range.
+The data-dependent output size (SURVEY.md §7 hard part #1) is handled with
+the two-pass count-then-emit pattern: pass 1 computes the exact match
+count (one scalar host sync), the host picks a power-of-two output bucket,
+pass 2 re-runs the (cached) emit kernel at that static capacity.
+
+SQL semantics: null join keys never match (a key group shares one null
+pattern, so null-key groups are simply masked); float keys are normalized
+(NaN==NaN, -0.0==0.0) to match Spark's NormalizeFloatingNumbers behavior.
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional, Sequence, Tuple
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from spark_rapids_tpu import dtypes as dt
+from spark_rapids_tpu.columnar.batch import (DeviceBatch, DeviceColumn,
+                                             bucket_rows, concat_batches)
+from spark_rapids_tpu.exec import sortkeys
+from spark_rapids_tpu.exec.base import (PhysicalPlan, REQUIRE_SINGLE_BATCH,
+                                        TpuExec, timed)
+from spark_rapids_tpu.exec.tpu_basic import compact
+from spark_rapids_tpu.exec.tpu_aggregate import normalize_key
+from spark_rapids_tpu.expr import eval_tpu, ir
+from spark_rapids_tpu.expr.eval_tpu import ColVal
+from spark_rapids_tpu.plan.logical import Schema
+
+_BIG = np.int64(1 << 62)
+
+
+def _gather(child: PhysicalPlan) -> Optional[DeviceBatch]:
+    """Coalesce all of a child's partitions into one batch (build-side
+    RequireSingleBatch, reference: GpuHashJoin build side)."""
+    batches = []
+    for it in child.execute():
+        batches.extend(it)
+    return concat_batches(batches) if batches else None
+
+
+def _key_vals(batch: DeviceBatch, key_names: Sequence[str]) -> List[ColVal]:
+    out = []
+    for k in key_names:
+        c = batch.column(k)
+        out.append(normalize_key(ColVal(c.dtype, c.data, c.validity,
+                                        c.lengths)))
+    return out
+
+
+def _concat_colvals(a: ColVal, b: ColVal) -> ColVal:
+    """Concatenate two key columns (for the combined build+stream space).
+
+    Mismatched numeric key pairs are promoted to the common type before
+    comparison (Spark's implicit cast), never truncated to one side's type.
+    """
+    if a.dtype.is_string:
+        wa, wb = a.data.shape[1], b.data.shape[1]
+        w = max(wa, wb)
+        da = jnp.pad(a.data, ((0, 0), (0, w - wa)))
+        db = jnp.pad(b.data, ((0, 0), (0, w - wb)))
+        return ColVal(a.dtype, jnp.concatenate([da, db]),
+                      jnp.concatenate([a.validity, b.validity]),
+                      jnp.concatenate([a.lengths, b.lengths]))
+    out_dt = a.dtype if a.dtype == b.dtype else dt.promote(a.dtype, b.dtype)
+    tgt = out_dt.to_np()
+    merged = ColVal(out_dt,
+                    jnp.concatenate([a.data.astype(tgt),
+                                     b.data.astype(tgt)]),
+                    jnp.concatenate([a.validity, b.validity]))
+    # re-normalize: an int->float promotion can introduce nothing new, but
+    # float inputs promoted from float32 need canonical NaN/-0.0 again
+    return normalize_key(merged)
+
+
+class _JoinCtx:
+    """Combined sorted space over build+stream rows."""
+
+    def __init__(self, build: DeviceBatch, stream: DeviceBatch,
+                 build_keys: Sequence[str], stream_keys: Sequence[str]):
+        self.cap_b = build.capacity
+        self.cap_s = stream.capacity
+        cap = self.cap_b + self.cap_s
+        self.cap = cap
+        bk = _key_vals(build, build_keys)
+        sk = _key_vals(stream, stream_keys)
+        combined = [_concat_colvals(b, s) for b, s in zip(bk, sk)]
+        exists = jnp.concatenate([build.row_mask(), stream.row_mask()])
+        side = jnp.concatenate([
+            jnp.zeros((self.cap_b,), dtype=jnp.uint64),
+            jnp.ones((self.cap_s,), dtype=jnp.uint64)])
+
+        key_groups = [sortkeys.encode_keys(v, True, True) for v in combined]
+        # side as the least-significant tiebreak: build rows lead each group
+        order = sortkeys.lexsort_indices(key_groups + [[side]], exists)
+        new_group = sortkeys.group_boundaries(key_groups, order, exists)
+        seg = jnp.cumsum(new_group.astype(jnp.int32)) - 1
+
+        self.order = order
+        self.seg = seg
+        sorted_exists = jnp.take(exists, order)
+        sorted_side = jnp.take(side, order)
+        null_key = jnp.zeros((cap,), dtype=jnp.bool_)
+        for v in combined:
+            null_key = null_key | ~v.validity
+        self.sorted_null_key = jnp.take(null_key, order)
+        self.is_build = sorted_exists & (sorted_side == 0)
+        self.is_stream = sorted_exists & (sorted_side == 1)
+        pos = jnp.arange(cap, dtype=jnp.int64)
+
+        match_build = self.is_build & ~self.sorted_null_key
+        self.b_count = jax.ops.segment_sum(
+            match_build.astype(jnp.int64), seg, num_segments=cap)
+        self.build_start = jax.ops.segment_min(
+            jnp.where(match_build, pos, _BIG), seg, num_segments=cap)
+        match_stream = self.is_stream & ~self.sorted_null_key
+        self.s_count = jax.ops.segment_sum(
+            match_stream.astype(jnp.int64), seg, num_segments=cap)
+
+        # per sorted-row match count (stream rows only)
+        self.m = jnp.where(self.is_stream & ~self.sorted_null_key,
+                           jnp.take(self.b_count, seg), 0)
+
+
+def _pairs_layout(ctx: _JoinCtx, outer: bool):
+    """Per-sorted-row emission count + inclusive cumsum."""
+    m_out = ctx.m
+    if outer:
+        m_out = jnp.where(ctx.is_stream, jnp.maximum(ctx.m, 1), 0)
+    else:
+        m_out = jnp.where(ctx.is_stream, ctx.m, 0)
+    incl = jnp.cumsum(m_out)
+    return m_out, incl
+
+
+def _count_kernel(build, stream, build_keys, stream_keys, how):
+    ctx = _JoinCtx(build, stream, build_keys, stream_keys)
+    outer = how in ("left", "right", "full")
+    m_out, incl = _pairs_layout(ctx, outer)
+    total = incl[-1]
+    if how == "full":
+        unmatched_build = ctx.is_build & \
+            (jnp.take(ctx.s_count, ctx.seg) == 0)
+        total = total + jnp.sum(unmatched_build.astype(jnp.int64))
+    return total
+
+
+def _emit_kernel(build, stream, build_keys, stream_keys, how, out_cap,
+                 build_names, stream_names, build_first_in_output):
+    """Pass 2: materialize the joined batch at static capacity out_cap."""
+    ctx = _JoinCtx(build, stream, build_keys, stream_keys)
+    outer = how in ("left", "right", "full")
+    m_out, incl = _pairs_layout(ctx, outer)
+    total_pairs = incl[-1]
+
+    k = jnp.arange(out_cap, dtype=jnp.int64)
+    r = jnp.searchsorted(incl, k, side="right")  # sorted pos of stream row
+    r = jnp.clip(r, 0, ctx.cap - 1)
+    prev = jnp.take(incl, r) - jnp.take(m_out, r)
+    j = k - prev
+    valid_pair = k < total_pairs
+
+    stream_orig = jnp.take(ctx.order, r) - ctx.cap_b
+    stream_orig = jnp.clip(stream_orig, 0, ctx.cap_s - 1)
+    has_match = jnp.take(ctx.m, r) > 0
+    bpos = jnp.clip(jnp.take(ctx.build_start, jnp.take(ctx.seg, r)) + j,
+                    0, ctx.cap - 1)
+    build_orig = jnp.clip(jnp.take(ctx.order, bpos), 0, ctx.cap_b - 1)
+
+    stream_valid = valid_pair
+    build_valid = valid_pair & has_match
+
+    if how == "full":
+        # append unmatched build rows after the pairs
+        unmatched = ctx.is_build & (jnp.take(ctx.s_count, ctx.seg) == 0)
+        u_order = jnp.argsort(~unmatched, stable=True)
+        u_count = jnp.sum(unmatched.astype(jnp.int64))
+        tail_idx = jnp.clip(k - total_pairs, 0, ctx.cap - 1)
+        in_tail = (k >= total_pairs) & (k < total_pairs + u_count)
+        tail_sorted_pos = jnp.take(u_order, tail_idx)
+        tail_build_orig = jnp.clip(
+            jnp.take(ctx.order, tail_sorted_pos), 0, ctx.cap_b - 1)
+        build_orig = jnp.where(in_tail, tail_build_orig, build_orig)
+        build_valid = build_valid | in_tail
+        stream_valid = valid_pair  # tail rows have null stream side
+        total_out = total_pairs + u_count
+    else:
+        total_out = total_pairs
+
+    s_cols = [c.gather(stream_orig, stream_valid) for c in stream.columns]
+    b_cols = [c.gather(build_orig, build_valid) for c in build.columns]
+    if build_first_in_output:
+        names = list(build_names) + list(stream_names)
+        cols = b_cols + s_cols
+    else:
+        names = list(stream_names) + list(build_names)
+        cols = s_cols + b_cols
+    return DeviceBatch(names, cols, total_out)
+
+
+def _semi_kernel(build, stream, build_keys, stream_keys, anti: bool):
+    ctx = _JoinCtx(build, stream, build_keys, stream_keys)
+    # scatter per-sorted-row match count back to original stream rows
+    m_orig = jnp.zeros((ctx.cap,), dtype=jnp.int64).at[ctx.order].set(ctx.m)
+    m_stream = m_orig[ctx.cap_b:]
+    keep = (m_stream == 0) if anti else (m_stream > 0)
+    return compact(stream, keep)
+
+
+class TpuShuffledHashJoinExec(TpuExec):
+    """Equi-join exec; build side gathered to a single batch like the
+    reference's build side (GpuHashJoin build on single coalesced batch)."""
+
+    def __init__(self, left: PhysicalPlan, right: PhysicalPlan,
+                 left_keys: Sequence[str], right_keys: Sequence[str],
+                 how: str, condition: Optional[ir.Expression],
+                 schema: Schema):
+        super().__init__()
+        self.children = (left, right)
+        self.left_keys = list(left_keys)
+        self.right_keys = list(right_keys)
+        self.how = how
+        self.condition = condition
+        self._schema = schema
+        self._kernels = {}
+
+    @property
+    def schema(self) -> Schema:
+        return self._schema
+
+    def execute(self):
+        def run():
+            left = _gather(self.children[0])
+            right = _gather(self.children[1])
+            if left is None or right is None:
+                return
+            how = self.how
+            # rename columns positionally to dodge duplicate-name lookups
+            lnames = [f"__l{i}" for i in range(left.num_cols)]
+            rnames = [f"__r{i}" for i in range(right.num_cols)]
+            lkeys = [lnames[left.names.index(k)] for k in self.left_keys]
+            rkeys = [rnames[right.names.index(k)] for k in self.right_keys]
+            left = DeviceBatch(lnames, left.columns, left.num_rows)
+            right = DeviceBatch(rnames, right.columns, right.num_rows)
+
+            if how in ("semi", "anti"):
+                key = ("semi", left.schema_key(), right.schema_key())
+                if key not in self._kernels:
+                    self._kernels[key] = jax.jit(
+                        lambda b, s: _semi_kernel(b, s, rkeys, lkeys,
+                                                  how == "anti"))
+                with timed(self.metrics):
+                    out = self._kernels[key](right, left)
+                self.metrics.num_output_rows += int(out.num_rows)
+                self.metrics.num_output_batches += 1
+                yield DeviceBatch(self._schema.names, out.columns,
+                                  out.num_rows)
+                return
+
+            if how == "right":
+                # right outer == left outer with sides swapped
+                build, stream = left, right
+                bkeys, skeys = lkeys, rkeys
+                emit_how = "left"
+                build_first = True
+            else:
+                build, stream = right, left
+                bkeys, skeys = rkeys, lkeys
+                emit_how = how
+                build_first = False
+
+            ckey = ("count", emit_how, build.schema_key(),
+                    stream.schema_key())
+            if ckey not in self._kernels:
+                self._kernels[ckey] = jax.jit(
+                    lambda b, s: _count_kernel(b, s, bkeys, skeys,
+                                               emit_how))
+            with timed(self.metrics):
+                total = int(self._kernels[ckey](build, stream))
+            out_cap = bucket_rows(total)
+            ekey = ("emit", emit_how, out_cap, build.schema_key(),
+                    stream.schema_key())
+            if ekey not in self._kernels:
+                self._kernels[ekey] = jax.jit(
+                    lambda b, s: _emit_kernel(
+                        b, s, bkeys, skeys, emit_how, out_cap,
+                        build.names, stream.names, build_first))
+            with timed(self.metrics):
+                out = self._kernels[ekey](build, stream)
+            out = DeviceBatch(self._schema.names, out.columns, out.num_rows)
+            if self.condition is not None:
+                v = eval_tpu.evaluate(self.condition, out)
+                out = compact(out, v.data.astype(jnp.bool_) & v.validity)
+            self.metrics.num_output_rows += int(out.num_rows)
+            yield out
+        return [run()]
+
+
+class TpuBroadcastNestedLoopJoinExec(TpuExec):
+    """Cross join (+ optional condition), GpuBroadcastNestedLoopJoinExec /
+    GpuCartesianProductExec analog (reference:
+    GpuBroadcastNestedLoopJoinExec.scala:311 — Table.crossJoin + filter)."""
+
+    def __init__(self, left: PhysicalPlan, right: PhysicalPlan,
+                 condition: Optional[ir.Expression], schema: Schema):
+        super().__init__()
+        self.children = (left, right)
+        self.condition = condition
+        self._schema = schema
+        self._kernels = {}
+
+    @property
+    def schema(self) -> Schema:
+        return self._schema
+
+    def execute(self):
+        def run():
+            left, right = _gather(self.children[0]), _gather(self.children[1])
+            if left is None or right is None:
+                return
+            nl, nr = int(left.num_rows), int(right.num_rows)
+            out_cap = bucket_rows(nl * nr)
+            key = (out_cap, left.schema_key(), right.schema_key())
+            if key not in self._kernels:
+                def impl(l, r):
+                    total = l.num_rows * r.num_rows
+                    k = jnp.arange(out_cap, dtype=jnp.int64)
+                    li = jnp.clip(k // jnp.maximum(r.num_rows, 1), 0,
+                                  l.capacity - 1)
+                    ri = jnp.clip(k % jnp.maximum(r.num_rows, 1), 0,
+                                  r.capacity - 1)
+                    valid = k < total
+                    cols = [c.gather(li, valid) for c in l.columns] + \
+                        [c.gather(ri, valid) for c in r.columns]
+                    out = DeviceBatch(self._schema.names, cols, total)
+                    if self.condition is not None:
+                        v = eval_tpu.evaluate(self.condition, out)
+                        out = compact(out, v.data.astype(jnp.bool_) &
+                                      v.validity)
+                    return out
+                self._kernels[key] = jax.jit(impl)
+            with timed(self.metrics):
+                out = self._kernels[key](left, right)
+            yield out
+        return [run()]

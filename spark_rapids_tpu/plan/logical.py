@@ -232,6 +232,20 @@ class Join(LogicalPlan):
         self.right_keys = list(right_keys)
         self.how = how
         lf, rf = left.schema.fields, right.schema.fields
+        # Spark promotes mismatched numeric key pairs to a common type
+        # before comparing; record the promoted dtype per key pair
+        self.key_dtypes = []
+        for lk, rk in zip(self.left_keys, self.right_keys):
+            ld = left.schema.field(lk).dtype
+            rd = right.schema.field(rk).dtype
+            if ld == rd:
+                self.key_dtypes.append(ld)
+            elif ld.is_numeric and rd.is_numeric:
+                self.key_dtypes.append(dt.promote(ld, rd))
+            else:
+                raise TypeError(
+                    f"join key type mismatch: {lk}:{ld.name} vs "
+                    f"{rk}:{rd.name}")
         if how in ("semi", "anti"):
             self._schema = Schema(lf)
         else:

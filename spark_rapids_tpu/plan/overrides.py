@@ -210,6 +210,32 @@ register_exec_rule(cpux.CpuExpandExec, ExecRule(
     convert=lambda n, ch: tpub.TpuExpandExec(ch[0], n.projections, n.schema)))
 
 
+def _convert_join(n: cpux.CpuJoinExec, ch):
+    from spark_rapids_tpu.exec.tpu_join import (
+        TpuBroadcastNestedLoopJoinExec, TpuShuffledHashJoinExec)
+    if n.how == "cross":
+        return TpuBroadcastNestedLoopJoinExec(ch[0], ch[1], n.condition,
+                                              n.schema)
+    return TpuShuffledHashJoinExec(ch[0], ch[1], n.left_keys, n.right_keys,
+                                   n.how, n.condition, n.schema)
+
+
+def _tag_join(n: cpux.CpuJoinExec, conf) -> List[str]:
+    out = []
+    if n.how != "cross" and not n.left_keys:
+        out.append("non-equi join without keys requires nested loop "
+                   "(only cross supported on TPU)")
+    return out
+
+
+register_exec_rule(cpux.CpuJoinExec, ExecRule(
+    "ShuffledHashJoinExec",
+    "TPU equi-join (sort-merge over total-order keys, two-pass sizing)",
+    lambda n: [n.condition] if n.condition is not None else [],
+    convert=_convert_join,
+    extra_tag=_tag_join))
+
+
 # ---------------------------------------------------------------------------
 # Meta tree
 # ---------------------------------------------------------------------------

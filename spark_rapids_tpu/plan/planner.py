@@ -52,7 +52,8 @@ def plan_cpu(node: lp.LogicalPlan, conf: RapidsTpuConf) -> PhysicalPlan:
         left = plan_cpu(node.children[0], conf)
         right = plan_cpu(node.children[1], conf)
         return cpux.CpuJoinExec(left, right, node.left_keys, node.right_keys,
-                                node.how, node.condition, node.schema)
+                                node.how, node.condition, node.schema,
+                                node.key_dtypes)
     if isinstance(node, lp.Range):
         return cpux.CpuRangeExec(node.start, node.end, node.step,
                                  node.num_partitions)

@@ -1,0 +1,247 @@
+"""ICI shuffle: device-resident partition exchange over a jax Mesh.
+
+This is the TPU-native replacement for the reference's accelerated shuffle
+data plane (reference: shuffle-plugin UCX transport, UCX.scala:53-533;
+RapidsCachingWriter keeping map-output batches in the device store,
+RapidsShuffleInternalManager.scala:90-155).  Where the reference moves
+device buffers peer-to-peer over RDMA with bounce-buffer windowing, here
+partitions never leave HBM at all: a ``shard_map`` region hash-partitions
+rows on-device and swaps the buckets with one ``lax.all_to_all`` over the
+ICI mesh axis — the collective formulation SURVEY.md §2g/§5 prescribes.
+
+The flagship composite op is the distributed hash aggregate:
+
+  local update-agg  ->  murmur3 pmod bucketize  ->  all_to_all  ->
+  compact  ->  merge-agg  ->  final projection
+
+which is exactly the reference's partial-agg / shuffle / final-agg stage
+pair (aggregate.scala + GpuShuffleExchangeExec) fused into one SPMD step
+XLA can schedule end-to-end.  Static shapes: each device sends exactly
+``capacity`` candidate slots per peer; true counts travel as a tiny int
+vector alongside (the scalar-prefetch idiom).
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import List, Optional, Sequence, Tuple
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from spark_rapids_tpu import dtypes as dt
+from spark_rapids_tpu.columnar.batch import DeviceBatch, DeviceColumn
+from spark_rapids_tpu.exec.tpu_aggregate import (finalize_aggregate,
+                                                 make_spec, merge_aggregate,
+                                                 update_aggregate)
+from spark_rapids_tpu.exec.tpu_basic import compact
+from spark_rapids_tpu.expr import ir
+from spark_rapids_tpu.expr.eval_tpu import ColVal, hash_colval
+from spark_rapids_tpu.plan.logical import Schema
+
+
+def partition_targets(key_vals: Sequence[ColVal], n_parts: int,
+                      seed: int = 42) -> jnp.ndarray:
+    """Spark-compatible murmur3 pmod partition ids
+    (GpuHashPartitioning analog, reference: GpuHashPartitioning.scala:29)."""
+    cap = key_vals[0].data.shape[0]
+    h = jnp.full((cap,), np.int32(seed), dtype=jnp.int32)
+    for v in key_vals:
+        h = hash_colval(v, h)
+    m = h % np.int32(n_parts)
+    return jnp.where(m < 0, m + n_parts, m)
+
+
+def bucketize(batch: DeviceBatch, target: jnp.ndarray, n_parts: int
+              ) -> Tuple[List[DeviceColumn], jnp.ndarray]:
+    """Slice a batch into n_parts contiguous buckets (stacked on a new
+    leading axis).  The XLA analog of cudf contiguous_split used by
+    GpuPartitioning.sliceInternalOnGpu (reference: GpuPartitioning.scala:45).
+
+    Returns columns whose arrays have shape [n_parts, cap, ...] plus a
+    per-bucket row count [n_parts].
+    """
+    cap = batch.capacity
+    exists = batch.row_mask()
+    t = jnp.where(exists, target, n_parts)  # park padding out of range
+    counts = jnp.zeros((n_parts,), dtype=jnp.int32).at[t].add(
+        exists.astype(jnp.int32), mode="drop")
+    offsets = jnp.concatenate(
+        [jnp.zeros((1,), jnp.int32), jnp.cumsum(counts)[:-1]])
+    order = jnp.argsort(t, stable=True)  # groups rows by target, padding last
+    sorted_t = jnp.take(t, order)
+    rank = jnp.arange(cap, dtype=jnp.int32) - jnp.take(
+        offsets, jnp.clip(sorted_t, 0, n_parts - 1))
+    flat_pos = jnp.where(sorted_t < n_parts,
+                         sorted_t * cap + jnp.clip(rank, 0, cap - 1),
+                         n_parts * cap)  # padding -> dropped
+    gather_idx = jnp.zeros((n_parts * cap,), dtype=jnp.int32).at[
+        flat_pos].set(order.astype(jnp.int32), mode="drop")
+    slot = jnp.arange(n_parts * cap) % cap
+    valid = slot < jnp.repeat(counts, cap)
+    out_cols = []
+    for c in batch.columns:
+        g = c.gather(gather_idx, valid)
+        data = g.data.reshape((n_parts, cap) + g.data.shape[1:])
+        validity = g.validity.reshape((n_parts, cap))
+        lengths = g.lengths.reshape((n_parts, cap)) \
+            if g.lengths is not None else None
+        out_cols.append(DeviceColumn(c.dtype, data, validity, lengths))
+    return out_cols, counts
+
+
+def exchange(stacked_cols: List[DeviceColumn], counts: jnp.ndarray,
+             axis: str) -> Tuple[List[DeviceColumn], jnp.ndarray]:
+    """One tiled all_to_all per buffer: bucket d of device s lands on
+    device d as block s.  (The whole UCX client/server/bounce-buffer
+    machinery of the reference collapses into this collective.)"""
+    def a2a(x):
+        return lax.all_to_all(x, axis, split_axis=0, concat_axis=0,
+                              tiled=True)
+    out_cols = []
+    for c in stacked_cols:
+        out_cols.append(DeviceColumn(
+            c.dtype, a2a(c.data), a2a(c.validity),
+            a2a(c.lengths) if c.lengths is not None else None))
+    return out_cols, a2a(counts)
+
+
+def reassemble(names: Sequence[str], stacked_cols: List[DeviceColumn],
+               counts_recv: jnp.ndarray) -> DeviceBatch:
+    """Flatten received blocks and compact valid rows to the front."""
+    n_parts = counts_recv.shape[0]
+    cap = stacked_cols[0].validity.shape[1]
+    slot = jnp.arange(n_parts * cap) % cap
+    valid = slot < jnp.repeat(counts_recv, cap)
+    flat_cols = []
+    for c in stacked_cols:
+        data = c.data.reshape((n_parts * cap,) + c.data.shape[2:])
+        validity = c.validity.reshape((n_parts * cap,))
+        lengths = c.lengths.reshape((n_parts * cap,)) \
+            if c.lengths is not None else None
+        flat_cols.append(DeviceColumn(c.dtype, data, validity, lengths))
+    # rows arrive block-strided; compact the `valid` rows to the front so
+    # the result satisfies the DeviceBatch row_mask contract
+    count = jnp.sum(valid.astype(jnp.int32))
+    order = jnp.argsort(~valid, stable=True)
+    cvalid = jnp.arange(n_parts * cap) < count
+    cols = [c.gather(order, cvalid) for c in flat_cols]
+    return DeviceBatch(names, cols, count)
+
+
+def make_distributed_agg_step(mesh: Mesh, axis: str,
+                              schema: Schema,
+                              groupings: Sequence[ir.Expression],
+                              aggregates: Sequence[ir.AggregateExpression],
+                              out_names: Sequence[str]):
+    """Build the jitted SPMD step: sharded input columns -> per-device
+    aggregated output shard.
+
+    Inputs are global arrays sharded on the leading (row) axis over
+    ``axis``; ``local_rows`` is an [n_devices] vector of true per-shard row
+    counts.  Output shards hold disjoint group subsets (hash-partitioned),
+    exactly like the reference's final-aggregate stage after a hash
+    exchange.
+    """
+    specs = [make_spec(a) for a in aggregates]
+    nk = len(groupings)
+    n_dev = mesh.shape[axis]
+    names = schema.names
+    dtypes = schema.dtypes
+
+    def local_step(cols_leaves, local_rows):
+        cols = _leaves_to_cols(cols_leaves, dtypes)
+        batch = DeviceBatch(names, cols, local_rows[0])
+        partial = update_aggregate(batch, groupings, aggregates, specs)
+        key_vals = [ColVal(c.dtype, c.data, c.validity, c.lengths)
+                    for c in partial.columns[:nk]]
+        target = partition_targets(key_vals, n_dev) if nk else \
+            jnp.zeros((partial.capacity,), dtype=jnp.int32)
+        stacked, counts = bucketize(partial, target, n_dev)
+        stacked, counts_recv = exchange(stacked, counts, axis)
+        received = reassemble(partial.names, stacked, counts_recv)
+        merged = merge_aggregate(received, nk, specs)
+        final = finalize_aggregate(merged, nk, specs, out_names)
+        out_leaves = _cols_to_leaves(final.columns)
+        return out_leaves, jnp.reshape(
+            jnp.asarray(final.num_rows, dtype=jnp.int32), (1,))
+
+    in_specs = (_col_specs(dtypes, P(axis)), P(axis))
+    out_dtypes = _probe_out_dtypes(schema, groupings, aggregates, out_names)
+    out_specs = (_col_specs(out_dtypes, P(axis)), P(axis))
+
+    step = jax.shard_map(local_step, mesh=mesh, in_specs=in_specs,
+                         out_specs=out_specs, check_vma=False)
+    return jax.jit(step), out_dtypes
+
+
+def _probe_out_dtypes(schema, groupings, aggregates, out_names):
+    for g in groupings:
+        g.resolve() if g.dtype is None else None
+    key_dts = [g.dtype for g in groupings]
+    agg_dts = [a.dtype for a in aggregates]
+    return key_dts + agg_dts
+
+
+def _col_specs(dtypes, spec):
+    out = []
+    for d in dtypes:
+        if d.is_string:
+            out.append((spec, spec, spec))
+        else:
+            out.append((spec, spec))
+    return tuple(out)
+
+
+def _cols_to_leaves(cols: Sequence[DeviceColumn]):
+    leaves = []
+    for c in cols:
+        if c.lengths is not None:
+            leaves.append((c.data, c.validity, c.lengths))
+        else:
+            leaves.append((c.data, c.validity))
+    return tuple(leaves)
+
+
+def _leaves_to_cols(leaves, dtypes):
+    cols = []
+    for leaf, d in zip(leaves, dtypes):
+        if len(leaf) == 3:
+            cols.append(DeviceColumn(d, leaf[0], leaf[1], leaf[2]))
+        else:
+            cols.append(DeviceColumn(d, leaf[0], leaf[1], None))
+    return cols
+
+
+def shard_batch(batch: DeviceBatch, mesh: Mesh, axis: str
+                ) -> Tuple[Tuple, jnp.ndarray]:
+    """Distribute a host-built DeviceBatch's rows round-robin-contiguously
+    across the mesh: returns (sharded column leaves, per-shard row counts).
+
+    The capacity must divide evenly by the device count; rows are laid out
+    so shard i holds rows [i*local_cap, (i+1)*local_cap).
+    """
+    n_dev = mesh.shape[axis]
+    cap = batch.capacity
+    assert cap % n_dev == 0, f"capacity {cap} not divisible by {n_dev}"
+    local_cap = cap // n_dev
+    total = int(batch.num_rows)
+    # per-shard true row counts for the contiguous layout
+    counts = np.clip(total - np.arange(n_dev) * local_cap, 0, local_cap)
+    counts = jnp.asarray(counts, dtype=jnp.int32)
+    sharding = NamedSharding(mesh, P(axis))
+    leaves = []
+    for c in batch.columns:
+        data = jax.device_put(c.data, sharding)
+        validity = jax.device_put(c.validity, sharding)
+        if c.lengths is not None:
+            leaves.append((data, validity,
+                           jax.device_put(c.lengths, sharding)))
+        else:
+            leaves.append((data, validity))
+    counts = jax.device_put(counts, sharding)
+    return tuple(leaves), counts

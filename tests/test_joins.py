@@ -47,6 +47,58 @@ def test_inner_join_result(session):
     assert out.column(3).to_pylist() == [200, 300]
 
 
+def test_join_runs_on_tpu(session):
+    from tests.parity import collect_plans
+    captured = collect_plans(session)
+    l = session.create_dataframe({"k": [1, 2], "v": [10, 20]})
+    r = session.create_dataframe({"k": [2, 3], "w": [1, 2]})
+    l.join(r, on="k").collect()
+    names = []
+    captured[-1].plan.foreach(lambda n: names.append(type(n).__name__))
+    assert "TpuShuffledHashJoinExec" in names, names
+    l.join(r, how="cross").collect()
+    names = []
+    captured[-1].plan.foreach(lambda n: names.append(type(n).__name__))
+    assert "TpuBroadcastNestedLoopJoinExec" in names, names
+
+
+def test_join_with_condition():
+    def q(s):
+        l = s.create_dataframe({"k": [1, 1, 2], "v": [5, 30, 20]})
+        r = s.create_dataframe({"k": [1, 2], "w": [10, 15]})
+        return l.join(r, on="k").filter(col("v") > col("w"))
+    assert_tpu_and_cpu_are_equal_collect(q, ignore_order=True)
+
+
+def test_join_float_keys_nan():
+    def q(s):
+        nan = float("nan")
+        l = s.create_dataframe({"k": [1.0, nan, -0.0, 2.0],
+                                "v": [1, 2, 3, 4]})
+        r = s.create_dataframe({"k": [nan, 0.0, 2.0], "w": [10, 20, 30]})
+        return l.join(r, on="k")
+    # Spark joins NaN==NaN and -0.0==0.0 after normalization
+    assert_tpu_and_cpu_are_equal_collect(q, ignore_order=True)
+
+
+def test_join_mixed_numeric_key_dtypes():
+    """Spark promotes int/double key pairs to double before comparing;
+    1.5 must not truncate-match 1."""
+    def q(s):
+        l = s.create_dataframe({"k": [1.5, 2.0], "v": [1, 2]})
+        r = s.create_dataframe({"k": [1, 2], "w": [10, 20]})
+        return l.join(r, on="k")
+    assert_tpu_and_cpu_are_equal_collect(q, ignore_order=True)
+
+
+def test_join_incompatible_key_dtypes_error(session):
+    import pytest as _pt
+    l = session.create_dataframe({"k": ["a"], "v": [1]})
+    r = session.create_dataframe({"k": [1], "w": [2]})
+    with _pt.raises(TypeError):
+        l.join(r, on="k")
+
+
 def test_join_null_keys_dont_match(session):
     l = session.create_dataframe({"k": [1, None], "v": [10, 20]})
     r = session.create_dataframe({"k": [1, None], "w": [100, 200]})
