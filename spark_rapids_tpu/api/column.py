@@ -131,6 +131,11 @@ class Column:
 
     astype = cast
 
+    # windowing ------------------------------------------------------------
+    def over(self, spec) -> "Column":
+        return Column(ir.WindowExpression(
+            self.expr, spec._partition_by, spec._order_by, spec._frame))
+
     # sort orders ----------------------------------------------------------
     def asc(self):
         from spark_rapids_tpu.plan.logical import SortOrder

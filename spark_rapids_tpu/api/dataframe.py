@@ -33,20 +33,41 @@ class DataFrame:
     # -- transformations ---------------------------------------------------
     def select(self, *cols) -> "DataFrame":
         exprs = [_as_expr(c) for c in cols]
-        return DataFrame(lp.Project(self.plan, exprs), self.session)
+        # window expressions compute in a Window node below the projection
+        wins: List[ir.WindowExpression] = []
+
+        def repl(node):
+            if isinstance(node, ir.WindowExpression):
+                name = f"__w{len(wins)}"
+                wins.append(node)
+                return ir.UnresolvedAttribute(name)
+            return None
+
+        plain = [ir.transform(e, repl) for e in exprs]
+        child = self.plan
+        if wins:
+            child = lp.Window(child, wins,
+                              [f"__w{i}" for i in range(len(wins))])
+            # preserve user-facing output names
+            plain = [p if isinstance(p, ir.Alias) or
+                     not isinstance(p, ir.UnresolvedAttribute) or
+                     not p.attr_name.startswith("__w")
+                     else ir.Alias(p, ir.output_name(orig))
+                     for p, orig in zip(plain, exprs)]
+        return DataFrame(lp.Project(child, plain), self.session)
 
     def with_column(self, name: str, c: Column) -> "DataFrame":
-        exprs: List[ir.Expression] = []
+        cols: List = []
         replaced = False
         for n in self.plan.schema.names:
             if n == name:
-                exprs.append(ir.Alias(_as_expr(c), name))
+                cols.append(Column(ir.Alias(_as_expr(c), name)))
                 replaced = True
             else:
-                exprs.append(ir.UnresolvedAttribute(n))
+                cols.append(Column(ir.UnresolvedAttribute(n)))
         if not replaced:
-            exprs.append(ir.Alias(_as_expr(c), name))
-        return DataFrame(lp.Project(self.plan, exprs), self.session)
+            cols.append(Column(ir.Alias(_as_expr(c), name)))
+        return self.select(*cols)
 
     withColumn = with_column
 

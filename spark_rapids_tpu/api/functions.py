@@ -228,6 +228,28 @@ def avg(c) -> Column:
 mean = avg
 
 
+# -- window functions -------------------------------------------------------
+
+def row_number() -> Column:
+    return Column(ir.RowNumber())
+
+
+def rank() -> Column:
+    return Column(ir.Rank())
+
+
+def dense_rank() -> Column:
+    return Column(ir.DenseRank())
+
+
+def lead(c, offset: int = 1, default=None) -> Column:
+    return Column(ir.Lead(_c(c), offset, default))
+
+
+def lag(c, offset: int = 1, default=None) -> Column:
+    return Column(ir.Lag(_c(c), offset, default))
+
+
 def first(c, ignorenulls: bool = False) -> Column:
     return Column(ir.First(_c(c), ignorenulls))
 

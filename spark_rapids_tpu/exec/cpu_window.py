@@ -1,0 +1,261 @@
+"""CPU window exec — the oracle/fallback for window functions.
+
+Deliberately a direct row-loop interpretation of SQL window semantics
+(partition slices, peer groups, frame bounds), independent of the TPU
+path's segmented-scan formulation, so parity tests cross-check two very
+different algorithms (same philosophy as eval_cpu vs eval_tpu).
+"""
+
+from __future__ import annotations
+
+import functools
+import math
+from typing import Any, List, Optional, Sequence, Tuple
+
+import pyarrow as pa
+
+from spark_rapids_tpu.exec.base import PhysicalPlan
+from spark_rapids_tpu.exec.cpu import _gather_single
+from spark_rapids_tpu.expr import eval_cpu, ir
+from spark_rapids_tpu.plan.logical import Schema
+
+
+def _vals(v: eval_cpu.CpuVal) -> List[Any]:
+    out = []
+    for i in range(len(v.data)):
+        out.append(v.data[i] if v.valid[i] else None)
+    return out
+
+
+def _cmp_scalar(a, b, asc: bool, nulls_first: bool) -> int:
+    def rank(x):
+        if x is None:
+            return (0 if nulls_first else 2, 0)
+        if isinstance(x, float) and math.isnan(x):
+            return (1, 1)
+        return (1, 0)
+    ra, rb = rank(a), rank(b)
+    if ra[0] != rb[0]:
+        return -1 if ra[0] < rb[0] else 1
+    if ra[0] == 1:  # both non-null
+        if ra[1] != rb[1]:  # NaN greatest within values
+            c = -1 if ra[1] < rb[1] else 1
+        elif a == b:
+            c = 0
+        else:
+            c = -1 if a < b else 1
+        return c if asc else -c
+    return 0
+
+
+def _order_cmp(keys_a, keys_b, dirs) -> int:
+    for (a, b), (asc, nf) in zip(zip(keys_a, keys_b), dirs):
+        c = _cmp_scalar(a, b, asc, nf)
+        if c != 0:
+            return c
+    return 0
+
+
+class CpuWindowExec(PhysicalPlan):
+    def __init__(self, child: PhysicalPlan,
+                 window_exprs: Sequence[ir.WindowExpression],
+                 out_names: Sequence[str], schema: Schema):
+        super().__init__()
+        self.children = (child,)
+        self.window_exprs = list(window_exprs)
+        self.out_names = list(out_names)
+        self._schema = schema
+
+    @property
+    def schema(self) -> Schema:
+        return self._schema
+
+    def execute(self):
+        def run():
+            t = _gather_single(self.children[0], self.children[0].schema)
+            n = t.num_rows
+            result_cols = {name: None for name in self.out_names}
+            final_order = list(range(n))
+
+            # group exprs sharing (partition, order) into one pass
+            groups = {}
+            for name, we in zip(self.out_names, self.window_exprs):
+                sig = (tuple(e.sql() for e in we.partition_exprs),
+                       tuple(e.sql() for e in we.order_exprs),
+                       we.order_dirs)
+                groups.setdefault(sig, []).append((name, we))
+
+            for (_, _, dirs), items in groups.items():
+                we0 = items[0][1]
+                pvals = [_vals(eval_cpu.evaluate(e, t))
+                         for e in we0.partition_exprs]
+                ovals = [_vals(eval_cpu.evaluate(e, t))
+                         for e in we0.order_exprs]
+
+                def key_of(i):
+                    return tuple(p[i] for p in pvals), \
+                        tuple(o[i] for o in ovals)
+
+                def cmp(i, j):
+                    pa_, oa = key_of(i)
+                    pb, ob = key_of(j)
+                    c = _order_cmp(pa_, pb, [(True, True)] * len(pa_))
+                    if c != 0:
+                        return c
+                    return _order_cmp(oa, ob, dirs or ())
+
+                order = sorted(range(n), key=functools.cmp_to_key(cmp))
+                final_order = order
+
+                # partition slices and peer groups in sorted space
+                parts: List[Tuple[int, int]] = []
+                ps = 0
+                for i in range(1, n + 1):
+                    if i == n or _order_cmp(
+                            key_of(order[i])[0], key_of(order[ps])[0],
+                            [(True, True)] * len(pvals)) != 0:
+                        parts.append((ps, i))
+                        ps = i
+
+                for name, we in items:
+                    out_sorted = self._compute(we, t, order, parts, dirs)
+                    col = [None] * n
+                    for si, orig in enumerate(order):
+                        col[orig] = out_sorted[si]
+                    result_cols[name] = col
+
+            # emit in last pass's sorted order (Spark emits sorted)
+            arrays = [t.column(i).take(pa.array(final_order))
+                      for i in range(t.num_columns)]
+            for name, we in zip(self.out_names, self.window_exprs):
+                vals = [result_cols[name][orig] for orig in final_order]
+                arrays.append(pa.array(vals, type=we.dtype.to_arrow()))
+            yield pa.Table.from_arrays(
+                arrays, names=list(t.column_names) + self.out_names)
+        return [run()]
+
+    # ------------------------------------------------------------------
+    def _compute(self, we: ir.WindowExpression, t, order, parts, dirs):
+        n = len(order)
+        fn = we.function
+        frame = we.frame
+        self._range_dirs = we.order_dirs
+        ovals = [_vals(eval_cpu.evaluate(e, t)) for e in we.order_exprs]
+
+        def peers(ps, pe, i):
+            """peer range [qs, qe) of sorted index i within [ps, pe)."""
+            def same(a, b):
+                return _order_cmp(
+                    tuple(o[order[a]] for o in ovals),
+                    tuple(o[order[b]] for o in ovals), dirs or ()) == 0
+            qs = i
+            while qs > ps and same(qs - 1, i):
+                qs -= 1
+            qe = i + 1
+            while qe < pe and same(qe, i):
+                qe += 1
+            return qs, qe
+
+        out = [None] * n
+        if isinstance(fn, (ir.RowNumber, ir.Rank, ir.DenseRank)):
+            for ps, pe in parts:
+                dense = 0
+                for i in range(ps, pe):
+                    qs, qe = peers(ps, pe, i)
+                    if i == qs:
+                        dense += 1
+                    if isinstance(fn, ir.RowNumber):
+                        out[i] = i - ps + 1
+                    elif isinstance(fn, ir.Rank):
+                        out[i] = qs - ps + 1
+                    else:
+                        out[i] = dense
+            return out
+
+        if isinstance(fn, (ir.Lead, ir.Lag)):
+            src = _vals(eval_cpu.evaluate(fn.children[0], t))
+            off = fn.offset if isinstance(fn, ir.Lead) else -fn.offset
+            for ps, pe in parts:
+                for i in range(ps, pe):
+                    j = i + off
+                    if ps <= j < pe:
+                        out[i] = src[order[j]]
+                    else:
+                        out[i] = fn.default
+            return out
+
+        if isinstance(fn, ir.AggregateExpression):
+            src = _vals(eval_cpu.evaluate(fn.child, t)) \
+                if fn.child is not None else [1] * t.num_rows
+            for ps, pe in parts:
+                for i in range(ps, pe):
+                    a, b = self._bounds(frame, ps, pe, i, peers, ovals,
+                                        order)
+                    window = [src[order[j]] for j in range(a, b + 1)] \
+                        if b >= a else []
+                    out[i] = _agg_py(fn, window)
+            return out
+
+        raise NotImplementedError(type(fn).__name__)
+
+    def _bounds(self, frame, ps, pe, i, peers, ovals, order):
+        if frame.kind == "rows":
+            a = ps if frame.start is None else max(ps, i + frame.start)
+            b = pe - 1 if frame.end is None else min(pe - 1, i + frame.end)
+            return a, b
+        # range
+        if frame.start is None and frame.end == 0:
+            qs, qe = peers(ps, pe, i)
+            return ps, qe - 1
+        if frame.start is None and frame.end is None:
+            return ps, pe - 1
+        # numeric range offsets over a single order column; under DESC
+        # ordering "preceding" means larger values, so bounds flip
+        v = ovals[0][order[i]]
+        if v is None:
+            qs, qe = peers(ps, pe, i)
+            return qs, qe - 1  # null orders by itself: frame = its peers
+        ascending = True
+        if getattr(self, "_range_dirs", None):
+            ascending = self._range_dirs[0][0]
+        if ascending:
+            lo = v + frame.start if frame.start is not None else None
+            hi = v + frame.end if frame.end is not None else None
+        else:
+            lo = v - frame.end if frame.end is not None else None
+            hi = v - frame.start if frame.start is not None else None
+        a, b = pe, ps - 1
+        for j in range(ps, pe):
+            w = ovals[0][order[j]]
+            if w is None:
+                continue
+            if (lo is None or w >= lo) and (hi is None or w <= hi):
+                a = min(a, j)
+                b = max(b, j)
+        return a, b
+
+
+def _agg_py(fn: ir.AggregateExpression, window: List[Any]):
+    non_null = [v for v in window if v is not None and
+                not (isinstance(v, float) and math.isnan(v))]
+    nans = [v for v in window if isinstance(v, float) and math.isnan(v)]
+    if isinstance(fn, ir.Count):
+        if fn.child is None:
+            return len(window)
+        return len(non_null) + len(nans)
+    if isinstance(fn, ir.Sum):
+        vals = non_null + nans
+        return sum(vals) if vals else None
+    if isinstance(fn, ir.Average):
+        # Spark averages in double space (no integral overflow)
+        vals = [float(v) for v in non_null] + nans
+        return (sum(vals) / len(vals)) if vals else None
+    if isinstance(fn, ir.Min):
+        if nans and not non_null:
+            return float("nan")
+        return min(non_null) if non_null else None
+    if isinstance(fn, ir.Max):
+        if nans:
+            return float("nan")
+        return max(non_null) if non_null else None
+    raise NotImplementedError(type(fn).__name__)

@@ -1,0 +1,318 @@
+"""TPU window exec.
+
+Analog of ``GpuWindowExec``/``GpuWindowExpression`` (reference:
+GpuWindowExec.scala:92, GpuWindowExpression.scala:171-834 — cudf
+``groupBy.aggregateWindows`` for row frames and
+``aggregateWindowsOverTimeRanges`` for range frames; fns:
+count/sum/min/max/row_number/lead/lag).
+
+TPU formulation: one total-order lexsort by (partition keys, order keys)
+turns every window primitive into segment arithmetic over sorted rows —
+partition/peer boundaries from key-change detection, ranking functions
+from positions, frame aggregates from prefix sums (sum/count/avg over
+arbitrary row frames via prefix differences) and segmented associative
+scans (running min/max).  This is the "segmented scan kernels" plan of
+SURVEY.md §2d.  Bounded-start min/max and finite range offsets fall back
+to CPU (tagged in overrides) until a sparse-table kernel lands.
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional, Sequence, Tuple
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from spark_rapids_tpu import dtypes as dt
+from spark_rapids_tpu.columnar.batch import DeviceBatch, DeviceColumn, \
+    concat_batches
+from spark_rapids_tpu.exec import sortkeys
+from spark_rapids_tpu.exec.base import (PhysicalPlan, REQUIRE_SINGLE_BATCH,
+                                        TpuExec, timed)
+from spark_rapids_tpu.exec.tpu_aggregate import normalize_key
+from spark_rapids_tpu.expr import eval_tpu, ir
+from spark_rapids_tpu.expr.eval_tpu import ColVal
+from spark_rapids_tpu.plan.logical import Schema
+
+
+def _seg_scan(op, x, seg):
+    """Segmented inclusive scan (standard associative formulation)."""
+    def combine(a, b):
+        va, sa = a
+        vb, sb = b
+        return jnp.where(sa == sb, op(va, vb), vb), sb
+    v, _ = lax.associative_scan(combine, (x, seg))
+    return v
+
+
+def _boundaries_to_seg(new_flag: jnp.ndarray) -> jnp.ndarray:
+    return jnp.cumsum(new_flag.astype(jnp.int32)) - 1
+
+
+class _WinCtx:
+    """Sorted-space context for one (partition, order) spec."""
+
+    def __init__(self, batch: DeviceBatch,
+                 part_exprs, order_exprs, order_dirs):
+        cap = batch.capacity
+        self.cap = cap
+        row_mask = batch.row_mask()
+        pvals = [normalize_key(eval_tpu.evaluate(e, batch))
+                 for e in part_exprs]
+        ovals = [normalize_key(eval_tpu.evaluate(e, batch))
+                 for e in order_exprs]
+        pgroups = [sortkeys.encode_keys(v, True, True) for v in pvals]
+        ogroups = [sortkeys.encode_keys(v, asc, nf)
+                   for v, (asc, nf) in zip(ovals, order_dirs)]
+        self.order = sortkeys.lexsort_indices(pgroups + ogroups, row_mask)
+        new_part = sortkeys.group_boundaries(pgroups, self.order, row_mask)
+        new_peer = sortkeys.group_boundaries(pgroups + ogroups, self.order,
+                                             row_mask)
+        self.part_seg = _boundaries_to_seg(new_part)
+        self.peer_seg = _boundaries_to_seg(new_peer)
+        self.new_peer = new_peer
+        pos = jnp.arange(cap, dtype=jnp.int64)
+        self.pos = pos
+        self.part_start = jnp.take(
+            jax.ops.segment_min(pos, self.part_seg, num_segments=cap),
+            self.part_seg)
+        self.part_end = jnp.take(
+            jax.ops.segment_max(pos, self.part_seg, num_segments=cap),
+            self.part_seg)
+        self.peer_start = jnp.take(
+            jax.ops.segment_min(pos, self.peer_seg, num_segments=cap),
+            self.peer_seg)
+        self.peer_end = jnp.take(
+            jax.ops.segment_max(pos, self.peer_seg, num_segments=cap),
+            self.peer_seg)
+        self.sorted_exists = jnp.take(row_mask, self.order)
+
+    def sorted_val(self, v: ColVal) -> ColVal:
+        c = v.to_column().gather(self.order, self.sorted_exists)
+        return ColVal(c.dtype, c.data, c.validity, c.lengths)
+
+
+def _frame_bounds(ctx: _WinCtx, frame: ir.WindowFrame):
+    """Inclusive sorted-position bounds [a, b] per row."""
+    if frame.kind == "rows":
+        a = ctx.part_start if frame.start is None else \
+            jnp.maximum(ctx.part_start, ctx.pos + frame.start)
+        b = ctx.part_end if frame.end is None else \
+            jnp.minimum(ctx.part_end, ctx.pos + frame.end)
+        return a, b
+    if frame.start is None and frame.end == 0:
+        return ctx.part_start, ctx.peer_end
+    if frame.start is None and frame.end is None:
+        return ctx.part_start, ctx.part_end
+    raise NotImplementedError("finite range offsets on TPU")
+
+
+def _prefix(x: jnp.ndarray) -> jnp.ndarray:
+    return jnp.concatenate([jnp.zeros((1,), x.dtype), jnp.cumsum(x)])
+
+
+def _window_agg(fn: ir.AggregateExpression, ctx: _WinCtx,
+                frame: ir.WindowFrame, batch: DeviceBatch) -> ColVal:
+    if fn.child is not None:
+        v = ctx.sorted_val(eval_tpu.evaluate(fn.child, batch))
+        valid = v.validity & ctx.sorted_exists
+        data = v.data
+    else:
+        valid = ctx.sorted_exists
+        data = jnp.ones((ctx.cap,), dtype=jnp.int64)
+    a, b = _frame_bounds(ctx, frame)
+    a = jnp.clip(a, 0, ctx.cap - 1)
+    b = jnp.clip(b, -1, ctx.cap - 1)
+
+    nonempty = b >= a
+
+    if isinstance(fn, ir.Count):
+        ones = valid.astype(jnp.int64)
+        P = _prefix(ones)
+        out = jnp.take(P, b + 1) - jnp.take(P, a)
+        out = jnp.where(nonempty, out, 0)  # empty frame -> count 0
+        return ColVal(dt.INT64, out, jnp.ones((ctx.cap,), jnp.bool_))
+
+    if isinstance(fn, (ir.Sum, ir.Average)):
+        tgt = jnp.float64 if (fn.dtype.is_floating or
+                              isinstance(fn, ir.Average)) else jnp.int64
+        is_float = fn.dtype.is_floating or isinstance(fn, ir.Average)
+        x = jnp.where(valid, data.astype(tgt), 0)
+        if is_float and data.dtype.kind == "f":
+            # a NaN would poison every downstream prefix difference;
+            # sum the non-NaN part and re-inject NaN per frame
+            isnan = jnp.isnan(data) & valid
+            x = jnp.where(isnan, 0.0, x)
+            nanP = _prefix(isnan.astype(jnp.int64))
+            frame_has_nan = (jnp.take(nanP, b + 1) - jnp.take(nanP, a)) > 0
+        else:
+            frame_has_nan = jnp.zeros((ctx.cap,), dtype=jnp.bool_)
+        P = _prefix(x)
+        s = jnp.take(P, b + 1) - jnp.take(P, a)
+        cnt = _prefix(valid.astype(jnp.int64))
+        c = jnp.maximum(jnp.take(cnt, b + 1) - jnp.take(cnt, a), 0)
+        c = jnp.where(nonempty, c, 0)
+        if is_float:
+            s = jnp.where(frame_has_nan, jnp.float64(np.nan), s)
+        if isinstance(fn, ir.Average):
+            nz = c > 0
+            return ColVal(dt.FLOAT64,
+                          jnp.where(nz, s / jnp.where(nz, c, 1), 0.0), nz)
+        return ColVal(fn.dtype, s.astype(fn.dtype.to_np()), c > 0)
+
+    if isinstance(fn, (ir.Min, ir.Max)):
+        # prefix-only frames (a == part_start): running segmented scan,
+        # indexed at b
+        is_min = isinstance(fn, ir.Min)
+        d = fn.dtype
+        tgt = d.to_np()
+        if d.is_floating:
+            isnan = jnp.isnan(data)
+            fill = np.array(np.inf if is_min else -np.inf, dtype=tgt)
+            x = jnp.where(valid & ~isnan, data.astype(tgt), fill)
+            run = _seg_scan(jnp.minimum if is_min else jnp.maximum, x,
+                            ctx.part_seg)
+            any_nonnan = _seg_scan(jnp.logical_or, valid & ~isnan,
+                                   ctx.part_seg)
+            any_nan = _seg_scan(jnp.logical_or, valid & isnan,
+                                ctx.part_seg)
+            run_b = jnp.take(run, b)
+            nonnan_b = jnp.take(any_nonnan, b)
+            nan_b = jnp.take(any_nan, b)
+            nanv = np.array(np.nan, dtype=tgt)
+            if is_min:
+                val = jnp.where(nonnan_b, run_b, nanv)
+            else:
+                val = jnp.where(nan_b, nanv, run_b)
+            has = nonnan_b | nan_b
+            return ColVal(d, jnp.where(has, val, 0), has & (b >= a))
+        if d.is_bool:
+            x = jnp.where(valid, data, not is_min)
+            run = _seg_scan(jnp.logical_and if is_min else jnp.logical_or,
+                            x, ctx.part_seg)
+            hasv = _seg_scan(jnp.logical_or, valid, ctx.part_seg)
+            return ColVal(d, jnp.take(run, b),
+                          jnp.take(hasv, b) & (b >= a))
+        info = np.iinfo(tgt)
+        fill = np.array(info.max if is_min else info.min, dtype=tgt)
+        x = jnp.where(valid, data.astype(tgt), fill)
+        run = _seg_scan(jnp.minimum if is_min else jnp.maximum, x,
+                        ctx.part_seg)
+        hasv = _seg_scan(jnp.logical_or, valid, ctx.part_seg)
+        out = jnp.take(run, b)
+        has = jnp.take(hasv, b) & (b >= a)
+        return ColVal(d, jnp.where(has, out, 0), has)
+
+    raise NotImplementedError(type(fn).__name__)
+
+
+def _window_value(we: ir.WindowExpression, ctx: _WinCtx,
+                  batch: DeviceBatch) -> ColVal:
+    fn = we.function
+    if isinstance(fn, ir.RowNumber):
+        out = (ctx.pos - ctx.part_start + 1).astype(jnp.int32)
+        return ColVal(dt.INT32, out, ctx.sorted_exists)
+    if isinstance(fn, ir.Rank):
+        out = (ctx.peer_start - ctx.part_start + 1).astype(jnp.int32)
+        return ColVal(dt.INT32, out, ctx.sorted_exists)
+    if isinstance(fn, ir.DenseRank):
+        c = jnp.cumsum(ctx.new_peer.astype(jnp.int32))
+        out = c - jnp.take(c, jnp.clip(ctx.part_start, 0, ctx.cap - 1)) + 1
+        return ColVal(dt.INT32, out.astype(jnp.int32), ctx.sorted_exists)
+    if isinstance(fn, (ir.Lead, ir.Lag)):
+        src = ctx.sorted_val(eval_tpu.evaluate(fn.children[0], batch))
+        off = fn.offset if isinstance(fn, ir.Lead) else -fn.offset
+        tgt = ctx.pos + off
+        in_part = (tgt >= ctx.part_start) & (tgt <= ctx.part_end)
+        j = jnp.clip(tgt, 0, ctx.cap - 1)
+        col = src.to_column().gather(j, in_part & ctx.sorted_exists)
+        if fn.default is not None:
+            dflt = eval_tpu._const(batch, fn.default, src.dtype)
+            use_d = ~in_part & ctx.sorted_exists
+            if src.dtype.is_string:
+                w = max(col.data.shape[1], dflt.data.shape[1])
+                cd = jnp.pad(col.data, ((0, 0), (0, w - col.data.shape[1])))
+                dd = jnp.pad(dflt.data,
+                             ((0, 0), (0, w - dflt.data.shape[1])))
+                data = jnp.where(use_d[:, None], dd, cd)
+                lengths = jnp.where(use_d, dflt.lengths, col.lengths)
+                return ColVal(src.dtype, data,
+                              jnp.where(use_d, dflt.validity, col.validity),
+                              lengths)
+            data = jnp.where(use_d, dflt.data, col.data)
+            return ColVal(src.dtype, data,
+                          jnp.where(use_d, dflt.validity, col.validity))
+        return ColVal(src.dtype, col.data, col.validity, col.lengths)
+    if isinstance(fn, ir.AggregateExpression):
+        return _window_agg(fn, ctx, we.frame, batch)
+    raise NotImplementedError(type(fn).__name__)
+
+
+class TpuWindowExec(TpuExec):
+    def __init__(self, child: PhysicalPlan,
+                 window_exprs: Sequence[ir.WindowExpression],
+                 out_names: Sequence[str], schema: Schema):
+        super().__init__()
+        self.children = (child,)
+        self.window_exprs = list(window_exprs)
+        self.out_names = list(out_names)
+        self._schema = schema
+        self._kernel = None
+
+    @property
+    def schema(self) -> Schema:
+        return self._schema
+
+    def children_coalesce_goal(self):
+        return [REQUIRE_SINGLE_BATCH]
+
+    def _impl(self, batch: DeviceBatch) -> DeviceBatch:
+        # group window exprs sharing a (partition, order) spec per sort pass
+        groups = {}
+        for name, we in zip(self.out_names, self.window_exprs):
+            sig = (tuple(e.sql() for e in we.partition_exprs),
+                   tuple(e.sql() for e in we.order_exprs), we.order_dirs)
+            groups.setdefault(sig, []).append((name, we))
+        new_cols = {}
+        last_order = None
+        for (_, _, dirs), items in groups.items():
+            we0 = items[0][1]
+            ctx = _WinCtx(batch, we0.partition_exprs, we0.order_exprs,
+                          we0.order_dirs)
+            last_order = ctx
+            for name, we in items:
+                v = _window_value(we, ctx, batch)
+                # scatter back to original row order
+                inv = jnp.zeros((ctx.cap,), dtype=jnp.int64).at[
+                    ctx.order].set(jnp.arange(ctx.cap, dtype=jnp.int64))
+                col = v.to_column().gather(inv, batch.row_mask())
+                new_cols[name] = col
+        # emit in the last spec's sorted order (Spark emits sorted)
+        ctx = last_order
+        cols = [c.gather(ctx.order, ctx.sorted_exists)
+                for c in batch.columns]
+        for name in self.out_names:
+            c = new_cols[name]
+            cols.append(c.gather(ctx.order, ctx.sorted_exists))
+        return DeviceBatch(list(batch.names) + self.out_names, cols,
+                           batch.num_rows)
+
+    def execute(self):
+        if self._kernel is None:
+            self._kernel = jax.jit(self._impl)
+
+        def run():
+            batches: List[DeviceBatch] = []
+            for it in self.children[0].execute():
+                batches.extend(it)
+            if not batches:
+                return
+            whole = concat_batches(batches)
+            with timed(self.metrics):
+                out = self._kernel(whole)
+            self.metrics.num_output_rows += int(out.num_rows)
+            yield out
+        return [run()]

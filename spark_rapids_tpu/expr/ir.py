@@ -868,6 +868,129 @@ class Last(AggregateExpression):
 
 
 # ---------------------------------------------------------------------------
+# Window functions (reference: GpuWindowExec.scala:92,
+# GpuWindowExpression.scala:171-834 — count/sum/min/max/row_number/lead/lag
+# over row frames and range frames)
+# ---------------------------------------------------------------------------
+
+class WindowFrame:
+    """Frame spec. bounds: int offset, or None for UNBOUNDED;
+    kind: 'rows' or 'range'. Defaults follow Spark: with ORDER BY ->
+    RANGE UNBOUNDED PRECEDING..CURRENT ROW; without -> whole partition."""
+
+    def __init__(self, kind: str = "rows",
+                 start: Optional[int] = None, end: Optional[int] = 0):
+        self.kind = kind
+        self.start = start  # None = unbounded preceding
+        self.end = end      # None = unbounded following; 0 = current row
+
+    @property
+    def is_unbounded_whole(self) -> bool:
+        return self.start is None and self.end is None
+
+    @property
+    def is_unbounded_to_current(self) -> bool:
+        return self.start is None and self.end == 0
+
+    def __repr__(self):
+        def b(v, side):
+            if v is None:
+                return f"UNBOUNDED {side}"
+            if v == 0:
+                return "CURRENT ROW"
+            return f"{abs(v)} {'PRECEDING' if v < 0 else 'FOLLOWING'}"
+        return (f"{self.kind.upper()} BETWEEN {b(self.start, 'PRECEDING')} "
+                f"AND {b(self.end, 'FOLLOWING')}")
+
+
+class WindowFunction(Expression):
+    """Base for ranking/offset window functions (not plain aggregates)."""
+
+
+class RowNumber(WindowFunction):
+    def resolve(self) -> None:
+        self.dtype = dt.INT32
+        self.nullable = False
+
+
+class Rank(WindowFunction):
+    def resolve(self) -> None:
+        self.dtype = dt.INT32
+        self.nullable = False
+
+
+class DenseRank(WindowFunction):
+    def resolve(self) -> None:
+        self.dtype = dt.INT32
+        self.nullable = False
+
+
+class Lead(WindowFunction):
+    def __init__(self, child: Expression, offset: int = 1,
+                 default: Optional[Any] = None):
+        self.children = (child,)
+        self.offset = offset
+        self.default = default
+
+    def resolve(self) -> None:
+        self.dtype = self.children[0].dtype
+        self.nullable = True
+
+
+class Lag(WindowFunction):
+    def __init__(self, child: Expression, offset: int = 1,
+                 default: Optional[Any] = None):
+        self.children = (child,)
+        self.offset = offset
+        self.default = default
+
+    def resolve(self) -> None:
+        self.dtype = self.children[0].dtype
+        self.nullable = True
+
+
+class WindowExpression(Expression):
+    """function OVER (PARTITION BY ... ORDER BY ... frame)."""
+
+    def __init__(self, function: Expression,
+                 partition_by: Sequence[Expression],
+                 order_by: Sequence = (),
+                 frame: Optional[WindowFrame] = None):
+        self.n_partition = len(partition_by)
+        # store directions separately; expressions live in children so
+        # binding rewrites them (SortOrder objects would go stale)
+        self.order_dirs = tuple(
+            (o.ascending, o.nulls_first_resolved) for o in order_by)
+        order_exprs = [o.expr for o in order_by]
+        self.children = (function, *partition_by, *order_exprs)
+        if frame is None:
+            if self.order_dirs:
+                frame = WindowFrame("range", None, 0)
+            else:
+                frame = WindowFrame("rows", None, None)
+        self.frame = frame
+
+    @property
+    def function(self) -> Expression:
+        return self.children[0]
+
+    @property
+    def partition_exprs(self) -> Tuple[Expression, ...]:
+        return self.children[1:1 + self.n_partition]
+
+    @property
+    def order_exprs(self) -> Tuple[Expression, ...]:
+        return self.children[1 + self.n_partition:]
+
+    def resolve(self) -> None:
+        self.dtype = self.function.dtype
+        self.nullable = self.function.nullable
+
+    def sql(self) -> str:
+        return (f"{self.function.sql()} OVER (...)")
+
+
+# ---------------------------------------------------------------------------
 # Binding & traversal
 # ---------------------------------------------------------------------------
 

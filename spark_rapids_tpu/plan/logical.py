@@ -293,6 +293,44 @@ class Range(LogicalPlan):
         return f"Range({self.start}, {self.end}, {self.step})"
 
 
+class Window(LogicalPlan):
+    """Append window-expression columns (GpuWindowExec analog).
+
+    Output = child columns + one column per window expression, in the
+    (partition, order)-sorted row order like Spark's WindowExec.
+    """
+
+    def __init__(self, child: LogicalPlan,
+                 window_exprs: Sequence[ir.Expression],
+                 names: Sequence[str]):
+        self.children = (child,)
+        self.window_exprs = [self.bind(e) for e in window_exprs]
+        self.out_names = list(names)
+        for e in self.window_exprs:
+            if not isinstance(e, ir.WindowExpression):
+                raise TypeError("Window node requires WindowExpression")
+            fr = e.frame
+            finite_range = fr.kind == "range" and not (
+                fr.start is None and fr.end in (0, None))
+            if finite_range:
+                # Spark: range frames with offsets need exactly one
+                # numeric/temporal ORDER BY column
+                oe = e.order_exprs
+                if len(oe) != 1 or oe[0].dtype is None or not (
+                        oe[0].dtype.is_numeric or oe[0].dtype.is_temporal):
+                    raise TypeError(
+                        "RANGE frame with offsets requires exactly one "
+                        "numeric or temporal ORDER BY column")
+        self._schema = Schema(
+            list(child.schema.fields) +
+            [Field(n, e.dtype, e.nullable)
+             for n, e in zip(self.out_names, self.window_exprs)])
+
+    @property
+    def schema(self) -> Schema:
+        return self._schema
+
+
 class Expand(LogicalPlan):
     """N projections per input row (rollup/cube building block; reference:
     GpuExpandExec.scala:67)."""

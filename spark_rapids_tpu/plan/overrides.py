@@ -210,6 +210,59 @@ register_exec_rule(cpux.CpuExpandExec, ExecRule(
     convert=lambda n, ch: tpub.TpuExpandExec(ch[0], n.projections, n.schema)))
 
 
+def _tag_window(n, conf) -> List[str]:
+    out = []
+    for we in n.window_exprs:
+        fn = we.function
+        fr = we.frame
+        finite_range = fr.kind == "range" and not (
+            fr.start is None and fr.end in (0, None))
+        if finite_range:
+            out.append("finite RANGE frame offsets not supported on TPU "
+                       "yet")
+        if isinstance(fn, (ir.Min, ir.Max)) and fr.start is not None:
+            out.append("bounded-start min/max window frames not supported "
+                       "on TPU yet")
+        if isinstance(fn, ir.AggregateExpression):
+            if not isinstance(fn, (ir.Count, ir.Sum, ir.Average, ir.Min,
+                                   ir.Max)):
+                out.append(f"window aggregate {type(fn).__name__} not "
+                           f"supported on TPU")
+            if fn.child is not None and fn.child.dtype is not None and \
+                    fn.child.dtype.is_string:
+                out.append("string window aggregates not supported on TPU")
+        elif not isinstance(fn, (ir.RowNumber, ir.Rank, ir.DenseRank,
+                                 ir.Lead, ir.Lag)):
+            out.append(f"window function {type(fn).__name__} not "
+                       f"supported on TPU")
+    return out
+
+
+def _register_window_rule():
+    from spark_rapids_tpu.exec.cpu_window import CpuWindowExec
+    from spark_rapids_tpu.exec.tpu_window import TpuWindowExec
+    def _win_exprs(n) -> List[ir.Expression]:
+        # check partition/order exprs and the function's inputs; the
+        # window function node itself is vetted by _tag_window
+        out: List[ir.Expression] = []
+        for we in n.window_exprs:
+            out.extend(we.partition_exprs)
+            out.extend(we.order_exprs)
+            out.extend(we.function.children)
+        return out
+
+    register_exec_rule(CpuWindowExec, ExecRule(
+        "WindowExec",
+        "TPU window functions (lexsort + segmented scans/prefix sums)",
+        _win_exprs,
+        convert=lambda n, ch: TpuWindowExec(ch[0], n.window_exprs,
+                                            n.out_names, n.schema),
+        extra_tag=_tag_window))
+
+
+_register_window_rule()
+
+
 def _convert_join(n: cpux.CpuJoinExec, ch):
     from spark_rapids_tpu.exec.tpu_join import (
         TpuBroadcastNestedLoopJoinExec, TpuShuffledHashJoinExec)

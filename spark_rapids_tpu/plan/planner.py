@@ -60,4 +60,9 @@ def plan_cpu(node: lp.LogicalPlan, conf: RapidsTpuConf) -> PhysicalPlan:
     if isinstance(node, lp.Expand):
         child = plan_cpu(node.children[0], conf)
         return cpux.CpuExpandExec(child, node.projections, node.schema)
+    if isinstance(node, lp.Window):
+        from spark_rapids_tpu.exec.cpu_window import CpuWindowExec
+        child = plan_cpu(node.children[0], conf)
+        return CpuWindowExec(child, node.window_exprs, node.out_names,
+                             node.schema)
     raise NotImplementedError(f"planner: {type(node).__name__}")

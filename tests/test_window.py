@@ -1,0 +1,145 @@
+"""Window function parity suite (reference analog: WindowFunctionSuite,
+window_function_test.py)."""
+
+import pytest
+
+from spark_rapids_tpu import col, lit, functions as F
+from spark_rapids_tpu.api.window import Window
+from tests.parity import (assert_tpu_and_cpu_are_equal_collect,
+                          collect_plans)
+from tests.data_gen import (gen_df, int_key_gen, int_gen, long_gen,
+                            double_gen, IntGen, StringGen)
+
+
+def _w():
+    return Window.partition_by("k").order_by("o")
+
+
+def test_row_number_rank():
+    assert_tpu_and_cpu_are_equal_collect(
+        lambda s: gen_df(s, [int_key_gen, IntGen(32, lo=0, hi=9), long_gen],
+                         ["k", "o", "v"], n=200)
+        .select("k", "o", "v",
+                F.row_number().over(_w()).alias("rn"),
+                F.rank().over(_w()).alias("rk"),
+                F.dense_rank().over(_w()).alias("dr")),
+        ignore_order=True)
+
+
+def test_window_runs_on_tpu(session):
+    captured = collect_plans(session)
+    df = session.create_dataframe({"k": [1, 1, 2], "o": [1, 2, 1],
+                                   "v": [10, 20, 30]})
+    df.select("k", F.row_number().over(_w()).alias("rn")).collect()
+    names = []
+    captured[-1].plan.foreach(lambda n: names.append(type(n).__name__))
+    assert "TpuWindowExec" in names, names
+
+
+def test_lead_lag():
+    assert_tpu_and_cpu_are_equal_collect(
+        lambda s: gen_df(s, [int_key_gen, IntGen(32, lo=0, hi=50),
+                             long_gen], ["k", "o", "v"], n=150)
+        .select("k", "o",
+                F.lead("v").over(_w()).alias("ld"),
+                F.lag("v", 2).over(_w()).alias("lg"),
+                F.lead("v", 1, -99).over(_w()).alias("ldd")),
+        ignore_order=True)
+
+
+def test_running_aggregates():
+    assert_tpu_and_cpu_are_equal_collect(
+        lambda s: gen_df(s, [int_key_gen, IntGen(32, lo=0, hi=50),
+                             long_gen], ["k", "o", "v"], n=150)
+        .select("k", "o", "v",
+                F.sum("v").over(_w()).alias("rsum"),
+                F.count("v").over(_w()).alias("rcnt"),
+                F.min("v").over(_w()).alias("rmin"),
+                F.max("v").over(_w()).alias("rmax")),
+        ignore_order=True)
+
+
+def test_whole_partition_agg():
+    w = Window.partition_by("k")
+    assert_tpu_and_cpu_are_equal_collect(
+        lambda s: gen_df(s, [int_key_gen, long_gen], ["k", "v"], n=150)
+        .select("k", "v",
+                F.sum("v").over(w).alias("psum"),
+                F.avg("v").over(w).alias("pavg"),
+                F.count("*").over(w).alias("pcnt")),
+        ignore_order=True)
+
+
+def test_sliding_row_frame_sum():
+    w = Window.partition_by("k").order_by("o").rows_between(-2, 2)
+    assert_tpu_and_cpu_are_equal_collect(
+        lambda s: gen_df(s, [int_key_gen, IntGen(32, lo=0, hi=60),
+                             long_gen], ["k", "o", "v"], n=150)
+        .select("k", "o",
+                F.sum("v").over(w).alias("ssum"),
+                F.count("v").over(w).alias("scnt"),
+                F.avg("v").over(w).alias("savg")),
+        ignore_order=True)
+
+
+def test_rows_unbounded_following():
+    w = Window.partition_by("k").order_by("o").rows_between(
+        0, Window.unbounded_following)
+    assert_tpu_and_cpu_are_equal_collect(
+        lambda s: gen_df(s, [int_key_gen, IntGen(32, lo=0, hi=60),
+                             long_gen], ["k", "o", "v"], n=120)
+        .select("k", "o", F.sum("v").over(w).alias("tailsum")),
+        ignore_order=True)
+
+
+def test_range_current_row_peers():
+    """Default RANGE frame includes peer rows (ties in the order key)."""
+    def q(s):
+        df = s.create_dataframe({
+            "k": [1, 1, 1, 1, 2, 2],
+            "o": [1, 2, 2, 3, 1, 1],
+            "v": [10, 20, 30, 40, 5, 7],
+        })
+        return df.select("k", "o", "v",
+                         F.sum("v").over(_w()).alias("rsum"))
+    assert_tpu_and_cpu_are_equal_collect(q, ignore_order=True)
+
+
+def test_window_desc_order():
+    w = Window.partition_by("k").order_by(col("o").desc())
+    assert_tpu_and_cpu_are_equal_collect(
+        lambda s: gen_df(s, [int_key_gen, IntGen(32, lo=0, hi=20),
+                             long_gen], ["k", "o", "v"], n=120)
+        .select("k", "o", F.row_number().over(w).alias("rn"),
+                F.sum("v").over(w).alias("rsum")),
+        ignore_order=True)
+
+
+def test_window_float_agg():
+    assert_tpu_and_cpu_are_equal_collect(
+        lambda s: gen_df(s, [int_key_gen, IntGen(32, lo=0, hi=50),
+                             double_gen], ["k", "o", "v"], n=120)
+        .select("k", "o", F.min("v").over(_w()).alias("rmin"),
+                F.max("v").over(_w()).alias("rmax")),
+        ignore_order=True)
+
+
+def test_finite_range_falls_back():
+    w = Window.partition_by("k").order_by("o").range_between(-5, 5)
+
+    def q(s):
+        return gen_df(s, [int_key_gen, IntGen(32, lo=0, hi=60), long_gen],
+                      ["k", "o", "v"], n=100).select(
+            "k", "o", F.sum("v").over(w).alias("rsum"))
+    # falls back to CPU but stays correct
+    assert_tpu_and_cpu_are_equal_collect(q, ignore_order=True)
+
+
+def test_no_partition_window():
+    w = Window.order_by("o")
+    assert_tpu_and_cpu_are_equal_collect(
+        lambda s: gen_df(s, [IntGen(32, lo=0, hi=30), long_gen],
+                         ["o", "v"], n=100)
+        .select("o", F.row_number().over(w).alias("rn"),
+                F.sum("v").over(w).alias("rsum")),
+        ignore_order=True)
