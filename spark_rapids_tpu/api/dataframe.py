@@ -147,6 +147,11 @@ class DataFrame:
     def columns(self) -> List[str]:
         return self.plan.schema.names
 
+    @property
+    def write(self):
+        from spark_rapids_tpu.io.writers import DataFrameWriter
+        return DataFrameWriter(self)
+
     # -- actions -----------------------------------------------------------
     def collect(self) -> pa.Table:
         """Execute and return an Arrow table (the terminal device->host

@@ -32,6 +32,14 @@ class TpuSparkSession:
     def __init__(self, conf: Optional[Dict[str, Any]] = None):
         self.conf = RapidsTpuConf(conf)
         devmgr.initialize(self.conf.get(cfg.CONCURRENT_TPU_TASKS))
+        from spark_rapids_tpu.mem import spill
+        if self.conf.get(cfg.MEM_SPILL_ENABLED):
+            spill.init_catalog(
+                self.conf.get(cfg.MEM_DEVICE_LIMIT),
+                self.conf.get(cfg.MEM_HOST_SPILL_LIMIT),
+                self.conf.get(cfg.MEM_SPILL_DIR) or None)
+        else:
+            spill.disable_catalog()
         with TpuSparkSession._lock:
             TpuSparkSession._active = self
         self._plan_listeners: List = []
@@ -146,13 +154,27 @@ class DataFrameReader:
         return self
 
     def _scan(self, fmt: str, paths) -> DataFrame:
-        from spark_rapids_tpu.io.readers import infer_schema
+        from spark_rapids_tpu.io.readers import (expand_paths, infer_schema,
+                                                 _partition_fields)
+        from spark_rapids_tpu.plan.logical import Field, Schema
         if isinstance(paths, str):
             paths = [paths]
-        schema = infer_schema(fmt, list(paths), self._options)
+        files, part_values = expand_paths(fmt, list(paths))
+        if not files:
+            raise FileNotFoundError(f"no {fmt} files under {paths}")
+        schema = infer_schema(fmt, files, self._options)
+        pfields = _partition_fields(part_values)
+        if pfields:
+            schema = Schema(list(schema.fields) +
+                            [Field(k, d, True) for k, d in pfields])
+        if self._options.get("columns"):
+            schema = Schema([schema.field(c)
+                             for c in self._options["columns"]])
+        opts = dict(self._options)
+        opts["part_values"] = part_values
+        opts["part_fields"] = pfields
         return DataFrame(
-            lp.FileScan(fmt, list(paths), schema, self._options),
-            self.session)
+            lp.FileScan(fmt, files, schema, opts), self.session)
 
     def parquet(self, *paths) -> DataFrame:
         return self._scan("parquet", list(paths))

@@ -162,6 +162,12 @@ MEM_POOL_FRACTION = conf(
     "Fraction of free HBM the arena manages for columnar batches. "
     "(reference: GpuDeviceManager.scala:196-262 RMM pool init)", float)
 
+MEM_DEVICE_LIMIT = conf(
+    "spark.rapids.tpu.memory.device.batchStorageSize", 4 << 30,
+    "Bytes of HBM budget for registered spillable batches; exceeding it "
+    "triggers synchronous device->host spill (RMM pool + event-handler "
+    "analog).", int)
+
 MEM_SPILL_ENABLED = conf(
     "spark.rapids.tpu.memory.spill.enabled", True,
     "Enable device->host->disk spill of registered batches under memory "

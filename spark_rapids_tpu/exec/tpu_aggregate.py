@@ -452,30 +452,56 @@ class TpuHashAggregateExec(TpuExec):
             self._final_kernel = jax.jit(self._final_impl)
 
         def run():
-            partials: List[DeviceBatch] = []
-            for it in self.children[0].execute():
-                for b in it:
-                    if int(b.num_rows) == 0 and self.groupings:
-                        continue
+            from spark_rapids_tpu.mem import spill as spillmod
+            catalog = spillmod.get_catalog() if spillmod.is_enabled() \
+                else None
+            # buffered partials stay spillable between update and merge
+            # (reference: aggregate.scala buffers partial results;
+            # SpillableColumnarBatch keeps them evictable)
+            partials: List = []
+            try:
+                for it in self.children[0].execute():
+                    for b in it:
+                        if int(b.num_rows) == 0 and self.groupings:
+                            continue
+                        with timed(self.metrics):
+                            partial = self._update_kernel(b)
+                        partials.append(
+                            catalog.register(partial) if catalog is not None
+                            else _UnspillableHandle(partial))
+                if not partials:
+                    if self.groupings:
+                        return  # grouped agg over empty input -> no rows
+                    # global agg over empty -> one row (count=0, sum=null)
+                    empty = _make_empty_buffer_batch(self)
+                    yield self._final_kernel(empty)
+                    return
+                if len(partials) == 1:
+                    merged = partials[0].get()
+                else:
+                    whole = concat_batches([p.get() for p in partials])
                     with timed(self.metrics):
-                        partials.append(self._update_kernel(b))
-            if not partials:
-                if self.groupings:
-                    return  # grouped agg over empty input -> no rows
-                # global agg over empty input -> one row (count=0, sum=null)
-                empty = _make_empty_buffer_batch(self)
-                yield self._final_kernel(empty)
-                return
-            if len(partials) == 1:
-                merged = partials[0]
-            else:
-                whole = concat_batches(partials)
-                with timed(self.metrics):
-                    merged = self._merge_kernel(whole)
-            out = self._final_kernel(merged)
-            self.metrics.num_output_rows += int(out.num_rows)
-            yield out
+                        merged = self._merge_kernel(whole)
+                out = self._final_kernel(merged)
+                self.metrics.num_output_rows += int(out.num_rows)
+                yield out
+            finally:
+                for p in partials:
+                    p.close()
         return [run()]
+
+
+class _UnspillableHandle:
+    """Plain batch holder used when the spill catalog is disabled."""
+
+    def __init__(self, batch: DeviceBatch):
+        self._batch = batch
+
+    def get(self) -> DeviceBatch:
+        return self._batch
+
+    def close(self) -> None:
+        self._batch = None
 
 
 def _make_empty_buffer_batch(exec_: TpuHashAggregateExec) -> DeviceBatch:

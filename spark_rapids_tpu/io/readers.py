@@ -18,6 +18,7 @@ The strategy selection and row-group batching structure is preserved.
 from __future__ import annotations
 
 import concurrent.futures as cf
+import os
 from typing import Iterator, List, Optional
 from urllib.parse import urlparse
 
@@ -30,6 +31,70 @@ from spark_rapids_tpu import config as cfg
 from spark_rapids_tpu.config import RapidsTpuConf
 from spark_rapids_tpu.exec.base import PhysicalPlan
 from spark_rapids_tpu.plan.logical import FileScan, Schema
+
+
+_EXTS = {"parquet": (".parquet", ".parq"), "csv": (".csv",),
+         "orc": (".orc",)}
+
+
+def expand_paths(fmt: str, paths: List[str]):
+    """Expand directories into part files + Hive partition values.
+
+    Reference analog: partition discovery + partition-value columns
+    appended by ColumnarPartitionReaderWithPartitionValues.
+    """
+    import glob
+    exts = _EXTS[fmt]
+    files: List[str] = []
+    part_values: List[dict] = []
+    for p in paths:
+        if os.path.isdir(p):
+            hits = sorted(
+                f for f in glob.glob(
+                    os.path.join(glob.escape(p), "**", "*"),
+                    recursive=True)
+                if os.path.isfile(f) and (
+                    f.endswith(exts) or "part-" in os.path.basename(f))
+                and not os.path.basename(f).startswith(("_", ".")))
+            for f in hits:
+                rel = os.path.relpath(os.path.dirname(f), p)
+                pv = {}
+                if rel != ".":
+                    for seg in rel.split(os.sep):
+                        if "=" in seg:
+                            k, v = seg.split("=", 1)
+                            pv[k] = None if \
+                                v == "__HIVE_DEFAULT_PARTITION__" else v
+                files.append(f)
+                part_values.append(pv)
+        else:
+            files.append(p)
+            part_values.append({})
+    return files, part_values
+
+
+def _partition_fields(part_values: List[dict]):
+    """Infer partition column types (int64 if every value parses)."""
+    from spark_rapids_tpu import dtypes as dt
+    keys: List[str] = []
+    for pv in part_values:
+        for k in pv:
+            if k not in keys:
+                keys.append(k)
+    fields = []
+    for k in keys:
+        vals = [pv.get(k) for pv in part_values]
+        all_int = all(v is None or _is_int(v) for v in vals) and \
+            any(v is not None for v in vals)
+        fields.append((k, dt.INT64 if all_int else dt.STRING))
+    return fields
+
+
+def _is_int(s: str) -> bool:
+    # strict digits only: int() would also accept '1_2' and ' 7 ', which
+    # must stay strings lest the partition value silently change
+    import re
+    return isinstance(s, str) and re.fullmatch(r"[+-]?\d+", s) is not None
 
 
 def infer_schema(fmt: str, paths: List[str],
@@ -101,18 +166,42 @@ class CpuFileScanExec(PhysicalPlan):
     def schema(self) -> Schema:
         return self._schema
 
-    def _read_one(self, path: str) -> pa.Table:
+    def _read_one(self, file_index: int) -> pa.Table:
+        path = self.scan.paths[file_index]
         fmt = self.scan.fmt
+        part_fields = dict(self.scan.options.get("part_fields") or [])
+        if self.columns:
+            # only materialize partition columns the projection keeps
+            part_fields = {k: d for k, d in part_fields.items()
+                           if k in self.columns}
+        file_cols = self.columns
+        if file_cols:
+            file_cols = [c for c in file_cols if c not in part_fields]
         if fmt == "parquet":
-            t = papq.read_table(path, columns=self.columns)
+            t = papq.read_table(path, columns=file_cols)
         elif fmt == "orc":
-            t = paorc.ORCFile(path).read(columns=self.columns)
+            t = paorc.ORCFile(path).read(columns=file_cols)
         elif fmt == "csv":
             t = _read_csv(path, self.scan.options)
-            if self.columns:
-                t = t.select(self.columns)
+            if file_cols:
+                t = t.select(file_cols)
         else:
             raise ValueError(fmt)
+        # append Hive partition-value columns for this file
+        # (ColumnarPartitionReaderWithPartitionValues analog)
+        pv_list = self.scan.options.get("part_values") or []
+        pv = pv_list[file_index] if file_index < len(pv_list) else {}
+        for k, d in part_fields.items():
+            if k in t.column_names:
+                # the partition value wins over a same-named file column
+                t = t.drop_columns([k])
+            raw = pv.get(k)
+            if raw is None:
+                col = pa.nulls(t.num_rows, d.to_arrow())
+            else:
+                val = int(raw) if d.to_arrow() == pa.int64() else raw
+                col = pa.array([val] * t.num_rows, type=d.to_arrow())
+            t = t.append_column(k, col)
         schema = self._schema if not self.columns else Schema(
             [self._schema.field(c) for c in self.columns])
         return _normalize(t, schema)
@@ -124,23 +213,23 @@ class CpuFileScanExec(PhysicalPlan):
                 break
 
     def execute(self) -> List[Iterator[pa.Table]]:
-        paths = self.scan.paths
+        indices = list(range(len(self.scan.paths)))
         if self.reader_type == "MULTITHREADED":
             nthreads = self.conf.get(
                 cfg.PARQUET_MULTITHREAD_READ_NUM_THREADS)
 
             def run_all():
                 with cf.ThreadPoolExecutor(max_workers=nthreads) as pool:
-                    for fut in [pool.submit(self._read_one, p)
-                                for p in paths]:
+                    for fut in [pool.submit(self._read_one, i)
+                                for i in indices]:
                         yield from self._batches(fut.result())
             return [run_all()]
         if self.reader_type == "COALESCING":
             def run_all():
                 pending: List[pa.Table] = []
                 pending_rows = 0
-                for p in paths:
-                    t = self._read_one(p)
+                for i in indices:
+                    t = self._read_one(i)
                     pending.append(t)
                     pending_rows += t.num_rows
                     if pending_rows >= self.max_rows:
@@ -152,9 +241,9 @@ class CpuFileScanExec(PhysicalPlan):
             return [run_all()]
 
         # PERFILE: one partition per file
-        def part(p):
-            yield from self._batches(self._read_one(p))
-        return [part(p) for p in paths]
+        def part(i):
+            yield from self._batches(self._read_one(i))
+        return [part(i) for i in indices]
 
     def simple_string(self) -> str:
         return (f"CpuFileScanExec({self.scan.fmt}, "

@@ -1,0 +1,356 @@
+"""Spill framework: Device -> Host -> Disk tiered batch storage.
+
+Reference analog (SURVEY.md §2b): ``RapidsBufferCatalog`` chaining
+Device/Host/Disk stores (RapidsBufferCatalog.scala:34-210,
+RapidsBufferStore.scala:40-351), priority-ordered synchronous spill on
+allocation failure (DeviceMemoryEventHandler.scala:42-70,
+SpillPriorities.scala), and ``SpillableColumnarBatch`` handles that let
+operators hold batches that remain spillable
+(SpillableColumnarBatch.scala:169).
+
+TPU adaptation: XLA owns the HBM allocator, so instead of an RMM callback
+the catalog enforces a *budget*: every registered batch counts toward a
+device-bytes ceiling, and crossing it (or an explicit ``spill_to_fit``)
+synchronously spills lowest-priority buffers device->host->disk.  The host
+tier stages its numpy copies inside the native HostArena
+(mem/host_arena.py); overflowing the host budget falls through to disk
+(.npz files under the spill dir, RapidsDiskStore analog).
+"""
+
+from __future__ import annotations
+
+import enum
+import heapq
+import itertools
+import os
+import tempfile
+import threading
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Tuple
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from spark_rapids_tpu import dtypes as dt
+from spark_rapids_tpu.columnar.batch import DeviceBatch, DeviceColumn
+from spark_rapids_tpu.mem.host_arena import HostArena
+
+
+class StorageTier(enum.IntEnum):
+    DEVICE = 0
+    HOST = 1
+    DISK = 2
+
+
+# spill priorities (reference: SpillPriorities.scala)
+ACTIVE_ON_DECK_PRIORITY = 1 << 40
+ACTIVE_BATCHING_PRIORITY = 1 << 30
+INPUT_FROM_SHUFFLE_PRIORITY = 0
+OUTPUT_FOR_SHUFFLE_PRIORITY = -(1 << 30)
+
+
+@dataclass
+class _HostPayload:
+    """Host copy of a batch: numpy arrays (arena-backed when possible)."""
+
+    names: List[str]
+    dtypes: List[dt.DType]
+    num_rows: int
+    arrays: List[Tuple[np.ndarray, np.ndarray, Optional[np.ndarray]]]
+    allocations: List = field(default_factory=list)
+
+    def nbytes(self) -> int:
+        total = 0
+        for d, v, l in self.arrays:
+            total += d.nbytes + v.nbytes + (l.nbytes if l is not None else 0)
+        return total
+
+    def close(self):
+        self.arrays = []
+        for a in self.allocations:
+            a.close()
+        self.allocations = []
+
+
+class _Buffer:
+    def __init__(self, buffer_id: int, batch: DeviceBatch, priority: int):
+        self.id = buffer_id
+        self.priority = priority
+        self.tier = StorageTier.DEVICE
+        self.device_batch: Optional[DeviceBatch] = batch
+        self.host: Optional[_HostPayload] = None
+        self.disk_path: Optional[str] = None
+        self.size = batch.nbytes()
+        self.meta = (list(batch.names), [c.dtype for c in batch.columns],
+                     int(batch.num_rows))
+        self.lock = threading.Lock()
+        self.closed = False
+
+
+class BufferCatalog:
+    """Singleton-ish catalog managing registered spillable batches."""
+
+    def __init__(self, device_budget: int = 4 << 30,
+                 host_budget: int = 8 << 30,
+                 spill_dir: Optional[str] = None,
+                 host_arena: Optional[HostArena] = None):
+        self.device_budget = device_budget
+        self.host_budget = host_budget
+        self.spill_dir = spill_dir or tempfile.mkdtemp(
+            prefix="rapids_tpu_spill_")
+        self.host_arena = host_arena or HostArena(
+            min(host_budget, 1 << 30))
+        self._buffers: Dict[int, _Buffer] = {}
+        self._ids = itertools.count()
+        self._lock = threading.Lock()
+        self.device_bytes = 0
+        self.host_bytes = 0
+        self.spilled_device_bytes = 0  # metrics (memoryBytesSpilled analog)
+        self.spilled_disk_bytes = 0
+
+    # -- registration ------------------------------------------------------
+    def register(self, batch: DeviceBatch,
+                 priority: int = ACTIVE_BATCHING_PRIORITY
+                 ) -> "SpillableBatch":
+        with self._lock:
+            bid = next(self._ids)
+            buf = _Buffer(bid, batch, priority)
+            self._buffers[bid] = buf
+            self.device_bytes += buf.size
+        self._maybe_spill()
+        return SpillableBatch(self, bid)
+
+    # -- spill logic -------------------------------------------------------
+    def _spill_candidates(self) -> List[_Buffer]:
+        with self._lock:
+            cands = [b for b in self._buffers.values()
+                     if b.tier == StorageTier.DEVICE and not b.closed]
+        # lowest priority spills first (reference: HashedPriorityQueue order)
+        return sorted(cands, key=lambda b: b.priority)
+
+    def _maybe_spill(self) -> None:
+        if self.device_bytes <= self.device_budget:
+            return
+        need = self.device_bytes - self.device_budget
+        self.spill_to_fit(need)
+
+    def spill_to_fit(self, bytes_needed: int) -> int:
+        """Synchronously spill device buffers until bytes_needed freed
+        (DeviceMemoryEventHandler.onAllocFailure analog)."""
+        freed = 0
+        for buf in self._spill_candidates():
+            if freed >= bytes_needed:
+                break
+            freed += self._spill_one(buf)
+        return freed
+
+    def _spill_one(self, buf: _Buffer) -> int:
+        with buf.lock:
+            if buf.tier != StorageTier.DEVICE or buf.closed:
+                return 0
+            batch = buf.device_batch
+            payload = _device_to_host(batch, self.host_arena)
+            buf.host = payload
+            buf.device_batch = None
+            buf.tier = StorageTier.HOST
+            size = buf.size
+        with self._lock:
+            self.device_bytes -= size
+            self.host_bytes += payload.nbytes()
+            self.spilled_device_bytes += size
+        self._maybe_spill_host()
+        return size
+
+    def _maybe_spill_host(self) -> None:
+        while self.host_bytes > self.host_budget:
+            with self._lock:
+                cands = [b for b in self._buffers.values()
+                         if b.tier == StorageTier.HOST and not b.closed]
+            if not cands:
+                return
+            victim = min(cands, key=lambda b: b.priority)
+            self._spill_to_disk(victim)
+
+    def _spill_to_disk(self, buf: _Buffer) -> None:
+        with buf.lock:
+            if buf.tier != StorageTier.HOST or buf.closed:
+                return
+            path = os.path.join(self.spill_dir, f"buf_{buf.id}.npz")
+            arrays = {}
+            for i, (d, v, l) in enumerate(buf.host.arrays):
+                arrays[f"d{i}"] = d
+                arrays[f"v{i}"] = v
+                if l is not None:
+                    arrays[f"l{i}"] = l
+            np.savez(path, **arrays)
+            nbytes = buf.host.nbytes()
+            buf.host.close()
+            buf.host = None
+            buf.disk_path = path
+            buf.tier = StorageTier.DISK
+        with self._lock:
+            self.host_bytes -= nbytes
+            self.spilled_disk_bytes += nbytes
+
+    # -- access ------------------------------------------------------------
+    def acquire(self, buffer_id: int) -> DeviceBatch:
+        """Materialize the batch on device (unspilling as needed)."""
+        buf = self._buffers[buffer_id]
+        with buf.lock:
+            assert not buf.closed, "buffer already closed"
+            if buf.tier == StorageTier.DEVICE:
+                return buf.device_batch
+            if buf.tier == StorageTier.DISK:
+                self._disk_to_host_locked(buf)
+            batch = _host_to_device(buf.host, buf.meta)
+            # promote back to device tier
+            nbytes = buf.host.nbytes()
+            buf.host.close()
+            buf.host = None
+            buf.device_batch = batch
+            buf.tier = StorageTier.DEVICE
+        with self._lock:
+            self.host_bytes -= nbytes
+            self.device_bytes += buf.size
+        self._maybe_spill()
+        return batch
+
+    def _disk_to_host_locked(self, buf: _Buffer) -> None:
+        names, dtypes, num_rows = buf.meta
+        loaded = np.load(buf.disk_path)
+        arrays = []
+        for i, d in enumerate(dtypes):
+            arrays.append((loaded[f"d{i}"], loaded[f"v{i}"],
+                           loaded[f"l{i}"] if f"l{i}" in loaded else None))
+        buf.host = _HostPayload(names, dtypes, num_rows, arrays)
+        os.unlink(buf.disk_path)
+        buf.disk_path = None
+        buf.tier = StorageTier.HOST
+        with self._lock:
+            self.host_bytes += buf.host.nbytes()
+
+    def tier_of(self, buffer_id: int) -> StorageTier:
+        return self._buffers[buffer_id].tier
+
+    def release(self, buffer_id: int) -> None:
+        buf = self._buffers.pop(buffer_id, None)
+        if buf is None:
+            return
+        with buf.lock:
+            buf.closed = True
+            if buf.tier == StorageTier.DEVICE:
+                with self._lock:
+                    self.device_bytes -= buf.size
+            elif buf.tier == StorageTier.HOST:
+                with self._lock:
+                    self.host_bytes -= buf.host.nbytes()
+                buf.host.close()
+            elif buf.disk_path and os.path.exists(buf.disk_path):
+                os.unlink(buf.disk_path)
+            buf.device_batch = None
+
+
+class SpillableBatch:
+    """Operator-held handle to a batch that remains spillable
+    (SpillableColumnarBatch analog)."""
+
+    def __init__(self, catalog: BufferCatalog, buffer_id: int):
+        self._catalog = catalog
+        self._id = buffer_id
+        self._closed = False
+
+    def get(self) -> DeviceBatch:
+        return self._catalog.acquire(self._id)
+
+    @property
+    def tier(self) -> StorageTier:
+        return self._catalog.tier_of(self._id)
+
+    def close(self) -> None:
+        if not self._closed:
+            self._catalog.release(self._id)
+            self._closed = True
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *a):
+        self.close()
+
+
+# ---------------------------------------------------------------------------
+# device <-> host payload conversion
+# ---------------------------------------------------------------------------
+
+def _device_to_host(batch: DeviceBatch, arena: HostArena) -> _HostPayload:
+    arrays = []
+    allocations = []
+    for c in batch.columns:
+        d = np.asarray(c.data)
+        v = np.asarray(c.validity)
+        l = np.asarray(c.lengths) if c.lengths is not None else None
+        # stage through the native arena when a block fits (pinned-pool
+        # analog); otherwise keep the plain numpy copy
+        alloc = arena.alloc(d.nbytes)
+        if alloc is not None:
+            staged = alloc.as_numpy(d.dtype, d.shape)
+            np.copyto(staged, d)
+            d = staged
+            allocations.append(alloc)
+        arrays.append((d, v, l))
+    return _HostPayload(list(batch.names),
+                        [c.dtype for c in batch.columns],
+                        int(batch.num_rows), arrays, allocations)
+
+
+def _host_to_device(payload: _HostPayload, meta) -> DeviceBatch:
+    names, dtypes, num_rows = meta
+    cols = []
+    for (d, v, l), dty in zip(payload.arrays, dtypes):
+        cols.append(DeviceColumn(
+            dty, jnp.asarray(d), jnp.asarray(v),
+            jnp.asarray(l) if l is not None else None))
+    return DeviceBatch(names, cols, num_rows)
+
+
+# ---------------------------------------------------------------------------
+# process-wide catalog (GpuShuffleEnv-style executor singleton; reference:
+# GpuShuffleEnv.scala:26-108, RapidsBufferCatalog.init)
+# ---------------------------------------------------------------------------
+
+_GLOBAL: Optional[BufferCatalog] = None
+_GLOBAL_ENABLED = True
+_GLOBAL_LOCK = threading.Lock()
+
+
+def init_catalog(device_budget: int, host_budget: int,
+                 spill_dir: Optional[str] = None) -> BufferCatalog:
+    global _GLOBAL, _GLOBAL_ENABLED
+    with _GLOBAL_LOCK:
+        _GLOBAL = BufferCatalog(device_budget, host_budget,
+                                spill_dir or None)
+        _GLOBAL_ENABLED = True
+        return _GLOBAL
+
+
+def disable_catalog() -> None:
+    """spark.rapids.tpu.memory.spill.enabled=false: operators hold batches
+    directly, nothing is registered or spilled."""
+    global _GLOBAL_ENABLED
+    with _GLOBAL_LOCK:
+        _GLOBAL_ENABLED = False
+
+
+def is_enabled() -> bool:
+    with _GLOBAL_LOCK:
+        return _GLOBAL_ENABLED
+
+
+def get_catalog() -> BufferCatalog:
+    global _GLOBAL
+    with _GLOBAL_LOCK:
+        if _GLOBAL is None:
+            _GLOBAL = BufferCatalog()
+        return _GLOBAL

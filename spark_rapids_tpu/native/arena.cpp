@@ -1,0 +1,144 @@
+// Host staging arena: first-fit allocator with free-list coalescing.
+//
+// Native analog of the reference's RMM arena + AddressSpaceAllocator
+// (reference: GpuDeviceManager.scala:196-262 RMM ARENA init;
+// sql-plugin/.../AddressSpaceAllocator.scala — first-fit allocator inside a
+// pinned bounce buffer).  On TPU, XLA owns HBM, so the arena manages *host*
+// staging memory: spill destinations, shuffle serialization buffers, and IO
+// reassembly buffers all sub-allocate from one big mapping instead of
+// churning malloc.  Exposed through a C ABI consumed via ctypes
+// (mem/host_arena.py).
+//
+// Thread-safe; alloc failure returns nullptr so Python can trigger a spill
+// (DeviceMemoryEventHandler analog) and retry.
+
+#include <cstddef>
+#include <cstdint>
+#include <cstdlib>
+#include <cstring>
+#include <map>
+#include <mutex>
+#include <new>
+
+namespace {
+
+struct Arena {
+  uint8_t* base = nullptr;
+  size_t size = 0;
+  // free blocks: offset -> length (kept coalesced)
+  std::map<size_t, size_t> free_blocks;
+  // live allocations: offset -> length
+  std::map<size_t, size_t> live;
+  size_t allocated_bytes = 0;
+  size_t peak_bytes = 0;
+  size_t alignment = 64;
+  std::mutex mu;
+};
+
+size_t align_up(size_t v, size_t a) { return (v + a - 1) & ~(a - 1); }
+
+}  // namespace
+
+extern "C" {
+
+Arena* arena_create(size_t size, size_t alignment) {
+  auto* a = new (std::nothrow) Arena();
+  if (!a) return nullptr;
+  a->base = static_cast<uint8_t*>(std::malloc(size));
+  if (!a->base) {
+    delete a;
+    return nullptr;
+  }
+  a->size = size;
+  if (alignment >= 8 && (alignment & (alignment - 1)) == 0)
+    a->alignment = alignment;
+  a->free_blocks[0] = size;
+  return a;
+}
+
+void arena_destroy(Arena* a) {
+  if (!a) return;
+  std::free(a->base);
+  delete a;
+}
+
+// Returns pointer into the arena, or nullptr when no block fits
+// (caller should spill and retry — the RMM alloc-failure callback shape).
+void* arena_alloc(Arena* a, size_t size) {
+  if (!a || size == 0) return nullptr;
+  size = align_up(size, a->alignment);
+  std::lock_guard<std::mutex> lock(a->mu);
+  for (auto it = a->free_blocks.begin(); it != a->free_blocks.end(); ++it) {
+    if (it->second >= size) {  // first fit
+      size_t off = it->first;
+      size_t remain = it->second - size;
+      a->free_blocks.erase(it);
+      if (remain > 0) a->free_blocks[off + size] = remain;
+      a->live[off] = size;
+      a->allocated_bytes += size;
+      if (a->allocated_bytes > a->peak_bytes)
+        a->peak_bytes = a->allocated_bytes;
+      return a->base + off;
+    }
+  }
+  return nullptr;
+}
+
+int arena_free(Arena* a, void* ptr) {
+  if (!a || !ptr) return -1;
+  size_t off = static_cast<uint8_t*>(ptr) - a->base;
+  std::lock_guard<std::mutex> lock(a->mu);
+  auto it = a->live.find(off);
+  if (it == a->live.end()) return -1;  // double free / bad pointer
+  size_t len = it->second;
+  a->live.erase(it);
+  a->allocated_bytes -= len;
+  // insert into free list and coalesce with neighbours
+  auto ins = a->free_blocks.emplace(off, len).first;
+  if (ins != a->free_blocks.begin()) {
+    auto prev = std::prev(ins);
+    if (prev->first + prev->second == ins->first) {
+      prev->second += ins->second;
+      a->free_blocks.erase(ins);
+      ins = prev;
+    }
+  }
+  auto next = std::next(ins);
+  if (next != a->free_blocks.end() &&
+      ins->first + ins->second == next->first) {
+    ins->second += next->second;
+    a->free_blocks.erase(next);
+  }
+  return 0;
+}
+
+size_t arena_allocated(Arena* a) {
+  if (!a) return 0;
+  std::lock_guard<std::mutex> lock(a->mu);
+  return a->allocated_bytes;
+}
+
+size_t arena_peak(Arena* a) {
+  if (!a) return 0;
+  std::lock_guard<std::mutex> lock(a->mu);
+  return a->peak_bytes;
+}
+
+size_t arena_capacity(Arena* a) { return a ? a->size : 0; }
+
+size_t arena_largest_free(Arena* a) {
+  if (!a) return 0;
+  std::lock_guard<std::mutex> lock(a->mu);
+  size_t best = 0;
+  for (auto& kv : a->free_blocks)
+    if (kv.second > best) best = kv.second;
+  return best;
+}
+
+int arena_num_live(Arena* a) {
+  if (!a) return 0;
+  std::lock_guard<std::mutex> lock(a->mu);
+  return static_cast<int>(a->live.size());
+}
+
+}  // extern "C"
