@@ -228,6 +228,38 @@ def avg(c) -> Column:
 mean = avg
 
 
+# -- UDFs -------------------------------------------------------------------
+
+def udf(f=None, returnType="string"):
+    """Create a user-defined function.
+
+    The function's bytecode is translated to the expression IR when possible
+    (so it runs on TPU like any built-in expression); otherwise it becomes a
+    row-wise ``PythonUDF`` that executes on CPU — mirroring the reference's
+    udf-compiler with CPU-UDF fallback (udf-compiler/.../Plugin.scala:36-94).
+    The result is cast to ``returnType`` in both paths, like PySpark.
+
+    Supports all PySpark call forms: ``udf(f)``, ``udf(f, "long")``,
+    ``@udf``, ``@udf("long")``, ``@udf(returnType="long")``.
+    """
+    from spark_rapids_tpu.api.column import _TYPE_NAMES
+    if isinstance(f, (str, dt.DType)):  # @udf("long") decorator form
+        return lambda fn: udf(fn, f)
+    if f is None:
+        return lambda fn: udf(fn, returnType)
+    rt = _TYPE_NAMES[returnType] if isinstance(returnType, str) \
+        else returnType
+
+    def wrapper(*cols) -> Column:
+        # compilation is attempted at bind time, when argument dtypes are
+        # known (ir._try_compile_python_udf); until then this is a row-wise
+        # Python UDF node
+        return Column(ir.PythonUDF(f, [_c(c) for c in cols], rt,
+                                   try_compile=True))
+    wrapper.__name__ = getattr(f, "__name__", "udf")
+    return wrapper
+
+
 # -- window functions -------------------------------------------------------
 
 def row_number() -> Column:

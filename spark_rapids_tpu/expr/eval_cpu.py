@@ -453,6 +453,11 @@ def _cast(e, table):
     src, tgt = c.dtype, e.to
     if src == tgt:
         return CpuVal(tgt, c.data, c.valid)
+    if src == dt.NULL:
+        n = len(c.data)
+        data = np.array([""] * n, dtype=object) if tgt.is_string \
+            else np.zeros(n, dtype=tgt.to_np())
+        return CpuVal(tgt, data, np.zeros(n, dtype=bool))
     if src.is_string and tgt.is_integral:
         n = len(c.data)
         out = np.zeros(n, dtype=tgt.to_np())
@@ -856,6 +861,64 @@ def _rand(e: ir.Rand, table):
                   np.ones(table.num_rows, dtype=bool))
 
 
+def _py_value(v: CpuVal, i: int) -> Any:
+    """Row i of a CpuVal as the Python value a UDF would receive."""
+    if not v.valid[i]:
+        return None
+    if v.dtype.is_string:
+        return str(v.data[i])
+    if v.dtype.id == dt.TypeId.DATE32:
+        return (np.datetime64(0, "D") +
+                np.timedelta64(int(v.data[i]), "D")).astype(object)
+    if v.dtype.id == dt.TypeId.TIMESTAMP_US:
+        return (np.datetime64(0, "us") +
+                np.timedelta64(int(v.data[i]), "us")).astype(object)
+    if v.dtype.is_bool:
+        return bool(v.data[i])
+    if v.dtype.is_floating:
+        return float(v.data[i])
+    return int(v.data[i])
+
+
+def _python_udf(e: "ir.PythonUDF", table):
+    args = [evaluate(c, table) for c in e.children]
+    n = table.num_rows
+    rt = e.return_type
+    valid = np.ones(n, dtype=bool)
+    if rt.is_string:
+        data: np.ndarray = np.empty(n, dtype=object)
+    else:
+        data = np.zeros(n, dtype=rt.to_np())
+    for i in range(n):
+        # PySpark semantics: null inputs are passed to the function as None
+        # (so None-aware UDFs behave identically here and when compiled to
+        # IR `is None` checks); a UDF that cannot handle None raises, as it
+        # would under PySpark
+        out = e.func(*[_py_value(a, i) for a in args])
+        if out is None:
+            valid[i] = False
+            if rt.is_string:
+                data[i] = ""
+        elif rt.is_string:
+            data[i] = str(out)
+        else:
+            # a result that does not fit the declared type becomes null,
+            # matching PySpark's per-row coercion behavior rather than
+            # failing the job
+            try:
+                if rt.id == dt.TypeId.DATE32:
+                    data[i] = (np.datetime64(out, "D") -
+                               np.datetime64(0, "D")).astype(np.int64)
+                elif rt.id == dt.TypeId.TIMESTAMP_US:
+                    data[i] = (np.datetime64(out, "us") -
+                               np.datetime64(0, "us")).astype(np.int64)
+                else:
+                    data[i] = out
+            except (OverflowError, ValueError, TypeError):
+                valid[i] = False
+    return CpuVal(rt, data, valid)
+
+
 _DISPATCH = {
     ir.Literal: _lit,
     ir.BoundReference: _bound,
@@ -947,6 +1010,7 @@ _DISPATCH = {
     ir.DateDiff: _datediff,
     ir.UnixTimestampFromTs: _unix_ts,
     ir.Murmur3Hash: _murmur3,
+    ir.PythonUDF: _python_udf,
     ir.KnownFloatingPointNormalized: _knownfloat,
     ir.SparkPartitionID: _partition_id,
     ir.MonotonicallyIncreasingID: _monotonic_id,

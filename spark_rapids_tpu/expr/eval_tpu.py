@@ -519,6 +519,8 @@ def _eval_cast(e, batch):
     src, tgt = c.dtype, e.to
     if src == tgt:
         return ColVal(tgt, c.data, c.validity, c.lengths)
+    if src == dt.NULL:
+        return _const(batch, None, tgt)
     if src.is_string and tgt.is_integral:
         return _cast_string_to_int(c, tgt)
     if src.is_string:

@@ -351,13 +351,43 @@ class NaNvl(BinaryExpression):
 # side-effect-free whole-column eval of all branches + ifElse merge)
 # ---------------------------------------------------------------------------
 
+def _common_branch_type(dtypes: List[dt.DType]) -> dt.DType:
+    """Coerce conditional-branch result types (Spark's analysis-time
+    TypeCoercion/findWiderTypeForTwo for If/CaseWhen): equal types pass
+    through, numerics promote, string absorbs numerics (Spark renders the
+    numeric branch as a string), anything else is an analysis error."""
+    non_null = [d for d in dtypes if d != dt.NULL]
+    if not non_null:
+        return dt.NULL
+    if any(d.is_string for d in non_null):
+        if all(d.is_string or d.is_numeric for d in non_null):
+            return dt.STRING
+        raise TypeError(f"incompatible IF/CASE branch types {non_null}")
+    out = non_null[0]
+    for d in non_null[1:]:
+        out = dt.promote(out, d)  # identity for equal types
+    return out
+
+
+def _coerce_branch(v: Expression, target: dt.DType) -> Expression:
+    """Wrap a branch value in a resolved Cast when its type is narrower than
+    the coerced branch type (the evaluators then see uniform branch types)."""
+    if v.dtype == target or target == dt.NULL:
+        return v
+    c = Cast(v, target)
+    c.resolve()
+    return c
+
+
 class If(Expression):
     def __init__(self, pred: Expression, t: Expression, f: Expression):
         self.children = (pred, t, f)
 
     def resolve(self) -> None:
-        _, t, f = self.children
-        self.dtype = t.dtype if t.dtype != dt.NULL else f.dtype
+        pred, t, f = self.children
+        self.dtype = _common_branch_type([t.dtype, f.dtype])
+        self.children = (pred, _coerce_branch(t, self.dtype),
+                         _coerce_branch(f, self.dtype))
         self.nullable = t.nullable or f.nullable
 
 
@@ -386,8 +416,14 @@ class CaseWhen(Expression):
         vals = [v for _, v in self.branches()]
         if self.has_else:
             vals.append(self.children[-1])
-        dtypes = [v.dtype for v in vals if v.dtype != dt.NULL]
-        self.dtype = dtypes[0] if dtypes else dt.NULL
+        self.dtype = _common_branch_type([v.dtype for v in vals])
+        new_children = list(self.children)
+        for i in range(self.n_branches):
+            new_children[2 * i + 1] = _coerce_branch(self.children[2 * i + 1],
+                                                     self.dtype)
+        if self.has_else:
+            new_children[-1] = _coerce_branch(self.children[-1], self.dtype)
+        self.children = tuple(new_children)
         self.nullable = (not self.has_else) or any(v.nullable for v in vals)
 
 
@@ -799,6 +835,34 @@ class KnownFloatingPointNormalized(UnaryExpression):
         self.nullable = self.child.nullable
 
 
+class PythonUDF(Expression):
+    """Row-wise Python UDF — the CPU fallback when the UDF compiler cannot
+    translate the function's bytecode into IR (the reference keeps the
+    original ScalaUDF on CPU in the same case, udf-compiler/.../Plugin.scala:
+    36-94).  Evaluated only by eval_cpu; the planner tags any node containing
+    one as not-on-TPU."""
+
+    def __init__(self, func, children: Sequence[Expression],
+                 return_type: dt.DType, name_: str = "",
+                 try_compile: bool = False):
+        self.func = func
+        self.children = tuple(children)
+        self.return_type = return_type
+        self.udf_name = name_ or getattr(func, "__name__", "udf")
+        # when True, ``bind`` attempts bytecode->IR compilation once the
+        # argument dtypes are known (the reference compiles at plan time via
+        # a resolution rule, udf-compiler/.../Plugin.scala:36-94)
+        self.try_compile = try_compile
+
+    def resolve(self) -> None:
+        self.dtype = self.return_type
+        self.nullable = True
+
+    def sql(self) -> str:
+        args = ", ".join(c.sql() for c in self.children)
+        return f"{self.udf_name}({args})"
+
+
 # ---------------------------------------------------------------------------
 # Aggregate functions (reference: org/.../rapids/AggregateFunctions.scala —
 # each is an update/merge CudfAggregate pair + final projection)
@@ -1017,10 +1081,36 @@ def bind(e: Expression, names: Sequence[str],
                                f"{list(names)}")
             i = list(names).index(node.attr_name)
             return BoundReference(i, dtypes[i], nullables[i], node.attr_name)
+        if isinstance(node, PythonUDF) and node.try_compile:
+            compiled = _try_compile_python_udf(node)
+            if compiled is not None:
+                return compiled
         node.resolve()
         return node
 
     return transform(e, _bind)
+
+
+def _try_compile_python_udf(node: "PythonUDF") -> Optional[Expression]:
+    """Bind-time UDF compilation: the node's children are already bound, so
+    argument dtypes are known and the compiled tree can be fully resolved —
+    any compile or type-resolution failure keeps the row-wise CPU UDF."""
+    try:
+        from spark_rapids_tpu import config as cfg
+        from spark_rapids_tpu.api.session import TpuSparkSession
+        s = TpuSparkSession._active
+        if s is not None and not s.conf.get(cfg.UDF_COMPILER_ENABLED):
+            return None
+    except ImportError:
+        pass
+    from spark_rapids_tpu.udf import compiler
+    try:
+        compiled = compiler.compile_udf(node.func, list(node.children))
+        out = Cast(compiled, node.return_type)
+        transform(out, lambda n: n.resolve())
+        return out
+    except Exception:
+        return None
 
 
 def collect(e: Expression, pred) -> List[Expression]:

@@ -1,0 +1,8 @@
+"""UDF support: bytecode→IR compiler + row-wise CPU fallback.
+
+Reference analog: the ``udf-compiler`` module (bytecode → Catalyst) and
+``GpuScalaUDF`` bridge (udf-compiler/.../GpuScalaUDF.scala:28).
+"""
+
+from spark_rapids_tpu.udf.compiler import (UdfCompileError,  # noqa: F401
+                                           compile_udf)

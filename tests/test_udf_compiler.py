@@ -1,0 +1,266 @@
+"""UDF compiler tests (reference analog: udf-compiler OpcodeSuite, 2,287 LoC
+of bytecode-translation cases, and udf_test.py fallback behavior)."""
+
+import math
+
+import pyarrow as pa
+import pytest
+
+from spark_rapids_tpu import col, functions as F
+from spark_rapids_tpu import dtypes as dt
+from spark_rapids_tpu.expr import ir
+from spark_rapids_tpu.udf import UdfCompileError, compile_udf
+from tests.parity import assert_tpu_and_cpu_are_equal_collect
+from tests.data_gen import (gen_df, int_gen, long_gen, double_gen,
+                            string_gen, boolean_gen)
+
+
+def _compiles(f, nargs=1):
+    args = [ir.UnresolvedAttribute(f"a{i}") for i in range(nargs)]
+    return compile_udf(f, args)
+
+
+# -- translation unit tests -------------------------------------------------
+
+def test_compiles_arithmetic():
+    e = _compiles(lambda x, y: (x + y) * 2 - x / y, nargs=2)
+    assert isinstance(e, ir.Subtract)
+
+
+def test_compiles_conditional():
+    e = _compiles(lambda x: x * 2 if x > 0 else -x)
+    assert isinstance(e, ir.If)
+
+
+def test_compiles_math_calls():
+    e = _compiles(lambda x: math.sqrt(x) + abs(x))
+    assert isinstance(e, ir.Add)
+    assert isinstance(e.children[0], ir.Sqrt)
+    assert isinstance(e.children[1], ir.Abs)
+
+
+def test_compiles_str_methods():
+    e = _compiles(lambda s: s.upper())
+    assert isinstance(e, ir.Upper)
+    e = _compiles(lambda s: s.strip().lower())
+    assert isinstance(e, ir.Lower)
+
+
+def test_compiles_is_none():
+    e = _compiles(lambda x: x is None)
+    assert isinstance(e, ir.IsNull)
+    e = _compiles(lambda x: x is not None)
+    assert isinstance(e, ir.Not)
+
+
+def test_compiles_in_tuple():
+    e = _compiles(lambda x: x in (1, 2, 3))
+    assert isinstance(e, ir.In)
+    assert e.items == (1, 2, 3)
+
+
+def test_loop_raises():
+    def f(x):
+        t = 0
+        for i in range(3):
+            t += x
+        return t
+    with pytest.raises(UdfCompileError):
+        _compiles(f)
+
+
+def test_unknown_call_raises():
+    with pytest.raises(UdfCompileError):
+        _compiles(lambda x: hash(x))
+
+
+# -- end-to-end parity: compiled UDFs run on TPU and match CPU --------------
+
+def test_udf_arithmetic_parity():
+    plus = F.udf(lambda a, b: a * 2 + b, returnType="long")
+    assert_tpu_and_cpu_are_equal_collect(
+        lambda s: gen_df(s, [int_gen, int_gen], ["a", "b"], n=200)
+        .select(plus(col("a"), col("b")).alias("r")))
+
+
+def test_udf_conditional_parity():
+    clamp = F.udf(lambda x: 0.0 if x < 0.0 else x, returnType="double")
+    assert_tpu_and_cpu_are_equal_collect(
+        lambda s: gen_df(s, [double_gen], ["a"], n=200)
+        .select(clamp(col("a")).alias("r")))
+
+
+def test_udf_boolean_ops_parity():
+    pred = F.udf(lambda a, b: a > 0 and b > 0, returnType="boolean")
+    assert_tpu_and_cpu_are_equal_collect(
+        lambda s: gen_df(s, [int_gen, long_gen], ["a", "b"], n=200)
+        .select(pred(col("a"), col("b")).alias("r")))
+
+
+def test_udf_string_parity():
+    shout = F.udf(lambda s: s.strip().upper(), returnType="string")
+    assert_tpu_and_cpu_are_equal_collect(
+        lambda s: gen_df(s, [string_gen], ["a"], n=150)
+        .select(shout(col("a")).alias("r")))
+
+
+def test_udf_none_branch_parity():
+    pos = F.udf(lambda x: None if x > 10 else x % 3, returnType="int")
+    assert_tpu_and_cpu_are_equal_collect(
+        lambda s: gen_df(s, [int_gen], ["a"], n=200)
+        .select(pos(col("a")).alias("r")))
+
+
+def _bound(column, names=("a",), dtypes=(dt.INT64,)):
+    """Bind a Column's expr against a schema (triggers UDF compilation)."""
+    return ir.bind(column.expr, list(names), list(dtypes),
+                   [True] * len(names))
+
+
+def test_udf_python_mod_semantics():
+    # Python % floors (== Spark pmod); the compiled IR must match what the
+    # row-wise Python function computes, including negative operands
+    m = F.udf(lambda x: x % 7, returnType="long")
+    assert not isinstance(_bound(m(col("a"))), ir.PythonUDF)  # compiled
+    assert_tpu_and_cpu_are_equal_collect(
+        lambda s: s.create_dataframe(pa.table(
+            {"a": pa.array([-15, -7, -1, 0, 1, 7, 15, None],
+                           type=pa.int64())}))
+        .select(m(col("a")).alias("r")))
+
+
+def test_udf_floordiv_python_semantics():
+    fd = F.udf(lambda x: x // 4, returnType="long")
+    assert not isinstance(_bound(fd(col("a"))), ir.PythonUDF)
+    assert_tpu_and_cpu_are_equal_collect(
+        lambda s: s.create_dataframe(pa.table(
+            {"a": pa.array([-9, -8, -1, 0, 1, 8, 9, None],
+                           type=pa.int64())}))
+        .select(fd(col("a")).alias("r")))
+
+
+# -- fallback: uncompilable UDFs still execute (on CPU) ---------------------
+
+def test_uncompilable_udf_falls_back_and_runs():
+    def weird(x):
+        if x is None:  # fallback passes None through, PySpark-style
+            return None
+        total = 0
+        for i in range(3):
+            total += x
+        return total
+    u = F.udf(weird, returnType="long")
+    assert isinstance(_bound(u(col("a")), ("a",), (dt.INT32,)),
+                      ir.PythonUDF)
+    assert_tpu_and_cpu_are_equal_collect(
+        lambda s: gen_df(s, [int_gen], ["a"], n=100)
+        .select(u(col("a")).alias("r")))
+
+
+def test_untypeable_constant_falls_back():
+    import decimal
+    scale = decimal.Decimal("1.5")
+    u = F.udf(lambda x: float(x) if x is not None and x > 0
+              else float(scale), returnType="double")
+    assert isinstance(_bound(u(col("a")), ("a",), (dt.INT32,)),
+                      ir.PythonUDF)
+    assert_tpu_and_cpu_are_equal_collect(
+        lambda s: gen_df(s, [int_gen], ["a"], n=50)
+        .select(u(col("a")).alias("r")))
+
+
+def test_decorator_forms():
+    @F.udf
+    def s1(x):
+        return x.upper()
+
+    @F.udf("long")
+    def p1(x):
+        return x + 1
+
+    @F.udf(returnType="long")
+    def p2(x):
+        return x * 2
+    assert_tpu_and_cpu_are_equal_collect(
+        lambda s: gen_df(s, [string_gen, int_gen], ["s", "a"], n=80)
+        .select(s1(col("s")).alias("u"), p1(col("a")).alias("p"),
+                p2(col("a")).alias("q")))
+
+
+def test_return_type_cast_applied_when_compiled():
+    # declared returnType governs the output schema even on the compiled
+    # path (the reference udf-compiler casts to the declared type too)
+    u = F.udf(lambda x: x + 1, returnType="double")
+
+    def q(s):
+        return (s.create_dataframe(pa.table(
+            {"a": pa.array([1, 2, None], type=pa.int32())}))
+            .select(u(col("a")).alias("r")))
+    from spark_rapids_tpu import TpuSparkSession
+    out = q(TpuSparkSession({})).collect()
+    assert out.schema.field("r").type == pa.float64()
+    assert out.column("r").to_pylist() == [2.0, 3.0, None]
+
+
+def test_mixed_branch_types_promote():
+    # `0 if x < 1.0 else x` over double: int literal branch must promote to
+    # double, not truncate the else branch
+    u = F.udf(lambda x: 0 if x < 1.0 else x, returnType="double")
+    assert_tpu_and_cpu_are_equal_collect(
+        lambda s: s.create_dataframe(pa.table(
+            {"a": pa.array([0.25, 1.5, -3.75, None])}))
+        .select(u(col("a")).alias("r")))
+    from spark_rapids_tpu import TpuSparkSession
+    out = (TpuSparkSession({}).create_dataframe(
+        pa.table({"a": pa.array([1.5])}))
+        .select(u(col("a")).alias("r")).collect())
+    assert out.column("r").to_pylist() == [1.5]
+
+
+def test_python_udf_null_handling():
+    # force the row-wise fallback path explicitly (len() would compile)
+    pu = ir.PythonUDF(lambda x: None if x is None else len(x) * 10,
+                      [ir.UnresolvedAttribute("a")], dt.INT32)
+    from spark_rapids_tpu.api.column import Column
+    assert_tpu_and_cpu_are_equal_collect(
+        lambda s: gen_df(s, [string_gen], ["a"], n=100)
+        .select(Column(pu).alias("r")))
+
+
+def test_mixed_string_numeric_branches():
+    # string/numeric branches coerce to string (Spark TypeCoercion), so
+    # this compiles — and the results match PySpark's str rendering
+    u = F.udf(lambda x: "neg" if x is not None and x < 0 else x,
+              returnType="string")
+    assert not isinstance(_bound(u(col("a")), ("a",), (dt.INT64,)),
+                          ir.PythonUDF)
+    from spark_rapids_tpu import TpuSparkSession
+    out = (TpuSparkSession({}).create_dataframe(
+        pa.table({"a": pa.array([-5, 2, None], type=pa.int64())}))
+        .select(u(col("a")).alias("r")).collect())
+    assert out.column("r").to_pylist() == ["neg", "2", None]
+
+
+def test_truthiness_condition_falls_back():
+    # `if s:` on a string is Python truthiness, which the compiler refuses;
+    # the fallback evaluates it row-wise
+    u = F.udf(lambda s: 1 if s else 0, returnType="long")
+    assert isinstance(_bound(u(col("a")), ("a",), (dt.STRING,)),
+                      ir.PythonUDF)
+    from spark_rapids_tpu import TpuSparkSession
+    out = (TpuSparkSession({}).create_dataframe(
+        pa.table({"a": pa.array(["x", "", None])}))
+        .select(u(col("a")).alias("r")).collect())
+    assert out.column("r").to_pylist() == [1, 0, 0]
+
+
+def test_out_of_range_result_becomes_null():
+    # force the row-wise fallback; an out-of-range result nulls that row
+    pu = ir.PythonUDF(lambda x: 2 ** 40 if x is not None and x > 0 else x,
+                      [ir.UnresolvedAttribute("a")], dt.INT32)
+    from spark_rapids_tpu.api.column import Column
+    from spark_rapids_tpu import TpuSparkSession
+    out = (TpuSparkSession({}).create_dataframe(
+        pa.table({"a": pa.array([3, -1, None], type=pa.int32())}))
+        .select(Column(pu).alias("r")).collect())
+    assert out.column("r").to_pylist() == [None, -1, None]
