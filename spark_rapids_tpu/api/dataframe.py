@@ -126,10 +126,45 @@ class DataFrame:
             right_keys = list(on)
         else:
             raise TypeError("join on must be a column name or list of names")
+        hint = None
+        if getattr(other, "_broadcast_hint", False):
+            hint = "broadcast_right"
+        elif getattr(self, "_broadcast_hint", False):
+            hint = "broadcast_left"
         return DataFrame(lp.Join(self.plan, other.plan, left_keys,
-                                 right_keys, how), self.session)
+                                 right_keys, how, hint=hint), self.session)
 
     crossJoin = lambda self, other: self.join(other, how="cross")  # noqa
+
+    def repartition(self, num_partitions: int, *cols) -> "DataFrame":
+        """Hash exchange on cols, or round-robin without cols
+        (GpuShuffleExchangeExec + GpuHashPartitioning/
+        GpuRoundRobinPartitioning analog)."""
+        if cols:
+            return DataFrame(lp.Repartition(
+                self.plan, "hash", num_partitions,
+                exprs=[_as_expr(c) for c in cols]), self.session)
+        return DataFrame(lp.Repartition(self.plan, "roundrobin",
+                                        num_partitions), self.session)
+
+    def repartition_by_range(self, num_partitions: int, *cols
+                             ) -> "DataFrame":
+        """Range exchange (GpuRangePartitioning analog)."""
+        orders = [c if isinstance(c, SortOrder)
+                  else SortOrder(_as_expr(c), True, None) for c in cols]
+        return DataFrame(lp.Repartition(self.plan, "range", num_partitions,
+                                        orders=orders), self.session)
+
+    repartitionByRange = repartition_by_range
+
+    def coalesce(self, num_partitions: int) -> "DataFrame":
+        """Reduce the partition count without a full shuffle
+        (GpuCoalesceExec analog; single exchange when n == 1)."""
+        if num_partitions == 1:
+            return DataFrame(lp.Repartition(self.plan, "single", 1),
+                             self.session)
+        return DataFrame(lp.Repartition(self.plan, "roundrobin",
+                                        num_partitions), self.session)
 
     def distinct(self) -> "DataFrame":
         names = self.plan.schema.names

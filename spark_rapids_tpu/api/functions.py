@@ -288,3 +288,12 @@ def first(c, ignorenulls: bool = False) -> Column:
 
 def last(c, ignorenulls: bool = False) -> Column:
     return Column(ir.Last(_c(c), ignorenulls))
+
+
+def broadcast(df):
+    """Broadcast hint: mark df as the build side of its next join
+    (pyspark functions.broadcast analog; drives BroadcastHashJoinExec
+    selection like Spark's ResolvedHint)."""
+    out = df.__class__(df.plan, df.session)
+    out._broadcast_hint = True
+    return out

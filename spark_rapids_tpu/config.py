@@ -193,6 +193,11 @@ SHUFFLE_COMPRESSION_CODEC = conf(
     "Codec for serialized shuffle partitions: none, lz4 (pyarrow IPC "
     "compression), zstd. (reference: TableCompressionCodec.scala:41)")
 
+AUTO_BROADCAST_THRESHOLD = conf(
+    "spark.rapids.tpu.sql.autoBroadcastJoinThreshold", 10 << 20,
+    "Max estimated byte size of a join side to broadcast it "
+    "(spark.sql.autoBroadcastJoinThreshold analog; -1 disables).", int)
+
 SHUFFLE_PARTITIONS = conf(
     "spark.rapids.tpu.sql.shuffle.partitions", 8,
     "Default number of shuffle partitions (spark.sql.shuffle.partitions "

@@ -472,3 +472,32 @@ class CpuJoinExec(PhysicalPlan):
                 out = out.filter(pa.array(v.data.astype(bool) & v.valid))
             yield out
         return [run()]
+
+
+class CpuShuffledHashJoinExec(CpuJoinExec):
+    """Equi-join planned with both sides exchanged on their keys
+    (ShuffledHashJoinExec analog; SortMergeJoin is replaced by this,
+    reference: shims/spark300/.../GpuSortMergeJoinExec.scala)."""
+
+
+class CpuBroadcastHashJoinExec(CpuJoinExec):
+    """Equi-join with one side small enough to broadcast (reference:
+    GpuBroadcastHashJoinExec).  build_side in {"left", "right"}."""
+
+    def __init__(self, *args, build_side: str = "right", **kwargs):
+        super().__init__(*args, **kwargs)
+        self.build_side = build_side
+
+
+class CpuBroadcastNestedLoopJoinExec(CpuJoinExec):
+    """Cross join (+ condition) with a broadcast side (reference:
+    GpuBroadcastNestedLoopJoinExec.scala:311)."""
+
+    def __init__(self, *args, build_side: str = "right", **kwargs):
+        super().__init__(*args, **kwargs)
+        self.build_side = build_side
+
+
+class CpuCartesianProductExec(CpuJoinExec):
+    """Partition-pairwise cross join (reference:
+    GpuCartesianProductExec.scala:304)."""

@@ -221,9 +221,25 @@ def _semi_kernel(build, stream, build_keys, stream_keys, anti: bool):
     return compact(stream, keep)
 
 
-class TpuShuffledHashJoinExec(TpuExec):
-    """Equi-join exec; build side gathered to a single batch like the
-    reference's build side (GpuHashJoin build on single coalesced batch)."""
+
+class _BroadcastBuildMixin:
+    """Caches the one-time gather of the broadcast (build) side."""
+
+    def _init_build(self, build_side: str) -> None:
+        self.build_side = build_side
+        self._built = None
+        self._build_done = False
+
+    def _build(self):
+        if not self._build_done:
+            side = 1 if self.build_side == "right" else 0
+            self._built = _gather(self.children[side])
+            self._build_done = True
+        return self._built
+
+
+class _HashJoinBase(TpuExec):
+    """Shared probe machinery for shuffled and broadcast hash joins."""
 
     def __init__(self, left: PhysicalPlan, right: PhysicalPlan,
                  left_keys: Sequence[str], right_keys: Sequence[str],
@@ -242,78 +258,149 @@ class TpuShuffledHashJoinExec(TpuExec):
     def schema(self) -> Schema:
         return self._schema
 
-    def execute(self):
-        def run():
-            left = _gather(self.children[0])
-            right = _gather(self.children[1])
-            if left is None or right is None:
-                return
-            how = self.how
-            # rename columns positionally to dodge duplicate-name lookups
-            lnames = [f"__l{i}" for i in range(left.num_cols)]
-            rnames = [f"__r{i}" for i in range(right.num_cols)]
-            lkeys = [lnames[left.names.index(k)] for k in self.left_keys]
-            rkeys = [rnames[right.names.index(k)] for k in self.right_keys]
-            left = DeviceBatch(lnames, left.columns, left.num_rows)
-            right = DeviceBatch(rnames, right.columns, right.num_rows)
+    def _join_pair(self, left: DeviceBatch, right: DeviceBatch,
+                   build_side: str = "right"):
+        """Join two single batches; yields 0 or 1 output batches."""
+        how = self.how
+        # rename columns positionally to dodge duplicate-name lookups
+        lnames = [f"__l{i}" for i in range(left.num_cols)]
+        rnames = [f"__r{i}" for i in range(right.num_cols)]
+        lkeys = [lnames[left.names.index(k)] for k in self.left_keys]
+        rkeys = [rnames[right.names.index(k)] for k in self.right_keys]
+        left = DeviceBatch(lnames, left.columns, left.num_rows)
+        right = DeviceBatch(rnames, right.columns, right.num_rows)
 
-            if how in ("semi", "anti"):
-                key = ("semi", left.schema_key(), right.schema_key())
-                if key not in self._kernels:
-                    self._kernels[key] = jax.jit(
-                        lambda b, s: _semi_kernel(b, s, rkeys, lkeys,
-                                                  how == "anti"))
-                with timed(self.metrics):
-                    out = self._kernels[key](right, left)
-                self.metrics.num_output_rows += int(out.num_rows)
-                self.metrics.num_output_batches += 1
-                yield DeviceBatch(self._schema.names, out.columns,
-                                  out.num_rows)
-                return
-
-            if how == "right":
-                # right outer == left outer with sides swapped
-                build, stream = left, right
-                bkeys, skeys = lkeys, rkeys
-                emit_how = "left"
-                build_first = True
-            else:
-                build, stream = right, left
-                bkeys, skeys = rkeys, lkeys
-                emit_how = how
-                build_first = False
-
-            ckey = ("count", emit_how, build.schema_key(),
-                    stream.schema_key())
-            if ckey not in self._kernels:
-                self._kernels[ckey] = jax.jit(
-                    lambda b, s: _count_kernel(b, s, bkeys, skeys,
-                                               emit_how))
+        if how in ("semi", "anti"):
+            key = ("semi", how, left.schema_key(), right.schema_key())
+            if key not in self._kernels:
+                self._kernels[key] = jax.jit(
+                    lambda b, s: _semi_kernel(b, s, rkeys, lkeys,
+                                              how == "anti"))
             with timed(self.metrics):
-                total = int(self._kernels[ckey](build, stream))
-            out_cap = bucket_rows(total)
-            ekey = ("emit", emit_how, out_cap, build.schema_key(),
-                    stream.schema_key())
-            if ekey not in self._kernels:
-                self._kernels[ekey] = jax.jit(
-                    lambda b, s: _emit_kernel(
-                        b, s, bkeys, skeys, emit_how, out_cap,
-                        build.names, stream.names, build_first))
-            with timed(self.metrics):
-                out = self._kernels[ekey](build, stream)
-            out = DeviceBatch(self._schema.names, out.columns, out.num_rows)
-            if self.condition is not None:
-                v = eval_tpu.evaluate(self.condition, out)
-                out = compact(out, v.data.astype(jnp.bool_) & v.validity)
+                out = self._kernels[key](right, left)
             self.metrics.num_output_rows += int(out.num_rows)
-            yield out
-        return [run()]
+            self.metrics.num_output_batches += 1
+            yield DeviceBatch(self._schema.names, out.columns,
+                              out.num_rows)
+            return
+
+        if build_side == "left" or how == "right":
+            # right outer == left outer with sides swapped
+            build, stream = left, right
+            bkeys, skeys = lkeys, rkeys
+            emit_how = "left" if how == "right" else how
+            build_first = True
+        else:
+            build, stream = right, left
+            bkeys, skeys = rkeys, lkeys
+            emit_how = how
+            build_first = False
+
+        ckey = ("count", emit_how, build.schema_key(),
+                stream.schema_key())
+        if ckey not in self._kernels:
+            self._kernels[ckey] = jax.jit(
+                lambda b, s: _count_kernel(b, s, bkeys, skeys,
+                                           emit_how))
+        with timed(self.metrics):
+            total = int(self._kernels[ckey](build, stream))
+        out_cap = bucket_rows(total)
+        ekey = ("emit", emit_how, out_cap, build.schema_key(),
+                stream.schema_key())
+        if ekey not in self._kernels:
+            self._kernels[ekey] = jax.jit(
+                lambda b, s: _emit_kernel(
+                    b, s, bkeys, skeys, emit_how, out_cap,
+                    build.names, stream.names, build_first))
+        with timed(self.metrics):
+            out = self._kernels[ekey](build, stream)
+        out = DeviceBatch(self._schema.names, out.columns, out.num_rows)
+        if self.condition is not None:
+            v = eval_tpu.evaluate(self.condition, out)
+            out = compact(out, v.data.astype(jnp.bool_) & v.validity)
+        self.metrics.num_output_rows += int(out.num_rows)
+        self.metrics.num_output_batches += 1
+        yield out
 
 
-class TpuBroadcastNestedLoopJoinExec(TpuExec):
-    """Cross join (+ optional condition), GpuBroadcastNestedLoopJoinExec /
-    GpuCartesianProductExec analog (reference:
-    GpuBroadcastNestedLoopJoinExec.scala:311 — Table.crossJoin + filter)."""
+def _gather_partition(it) -> Optional[DeviceBatch]:
+    batches = [b for b in it if int(b.num_rows)]
+    return concat_batches(batches) if batches else None
+
+
+class TpuShuffledHashJoinExec(_HashJoinBase):
+    """Equi-join over co-partitioned children (hash exchanges inserted by
+    the planner); each partition pair joins independently with the build
+    partition coalesced to one batch, like the reference's build side
+    (GpuHashJoin build on single coalesced batch).  Also accepts
+    single-partition children (the degenerate pre-exchange shape)."""
+
+    def execute(self):
+        lits = self.children[0].execute()
+        rits = self.children[1].execute()
+        assert len(lits) == len(rits), \
+            f"join children not co-partitioned: {len(lits)} vs {len(rits)}"
+
+        def run(lit, rit):
+            left = _gather_partition(lit)
+            right = _gather_partition(rit)
+            if left is None or right is None:
+                if self.how in ("left", "semi", "anti") and left is not None:
+                    right = _empty_like(self.children[1].schema)
+                elif self.how in ("right", "full") and \
+                        (left is not None or right is not None):
+                    left = left if left is not None else \
+                        _empty_like(self.children[0].schema)
+                    right = right if right is not None else \
+                        _empty_like(self.children[1].schema)
+                else:
+                    return
+            yield from self._join_pair(left, right)
+
+        return [run(l, r) for l, r in zip(lits, rits)]
+
+
+class TpuBroadcastHashJoinExec(_BroadcastBuildMixin, _HashJoinBase):
+    """Equi-join with the build side broadcast: gathered once across all
+    its partitions, then probed per stream batch so the stream side stays
+    partitioned (reference: GpuBroadcastHashJoinExec — broadcast host
+    batch -> device once per task, then probe per batch)."""
+
+    def __init__(self, *args, build_side: str = "right"):
+        super().__init__(*args)
+        self._init_build(build_side)
+
+    def execute(self):
+        stream_side = 0 if self.build_side == "right" else 1
+        sits = self.children[stream_side].execute()
+
+        def run(sit):
+            build = self._build()
+            for sb in sit:
+                if not int(sb.num_rows):
+                    continue
+                b = build if build is not None else \
+                    _empty_like(self.children[1 - stream_side].schema)
+                if self.build_side == "right":
+                    yield from self._join_pair(sb, b, "right")
+                else:
+                    yield from self._join_pair(b, sb, "left")
+
+        return [run(it) for it in sits]
+
+
+def _empty_like(schema: Schema) -> DeviceBatch:
+    """A 0-row device batch (for outer joins against an empty side)."""
+    from spark_rapids_tpu.columnar.batch import from_arrow
+    import pyarrow as pa
+    t = pa.Table.from_arrays(
+        [pa.array([], type=f.dtype.to_arrow()) for f in schema.fields],
+        names=schema.names)
+    return from_arrow(t)
+
+
+class _NestedLoopBase(TpuExec):
+    """Shared cross-product kernel (Table.crossJoin + filter analog)."""
 
     def __init__(self, left: PhysicalPlan, right: PhysicalPlan,
                  condition: Optional[ir.Expression], schema: Schema):
@@ -327,33 +414,95 @@ class TpuBroadcastNestedLoopJoinExec(TpuExec):
     def schema(self) -> Schema:
         return self._schema
 
+    def _cross_pair(self, left: DeviceBatch, right: DeviceBatch):
+        nl, nr = int(left.num_rows), int(right.num_rows)
+        if nl == 0 or nr == 0:
+            return
+        out_cap = bucket_rows(nl * nr)
+        key = (out_cap, left.schema_key(), right.schema_key())
+        if key not in self._kernels:
+            def impl(l, r):
+                total = l.num_rows * r.num_rows
+                k = jnp.arange(out_cap, dtype=jnp.int64)
+                li = jnp.clip(k // jnp.maximum(r.num_rows, 1), 0,
+                              l.capacity - 1)
+                ri = jnp.clip(k % jnp.maximum(r.num_rows, 1), 0,
+                              r.capacity - 1)
+                valid = k < total
+                cols = [c.gather(li, valid) for c in l.columns] + \
+                    [c.gather(ri, valid) for c in r.columns]
+                out = DeviceBatch(self._schema.names, cols, total)
+                if self.condition is not None:
+                    v = eval_tpu.evaluate(self.condition, out)
+                    out = compact(out, v.data.astype(jnp.bool_) &
+                                  v.validity)
+                return out
+            self._kernels[key] = jax.jit(impl)
+        with timed(self.metrics):
+            out = self._kernels[key](left, right)
+        self.metrics.num_output_rows += int(out.num_rows)
+        self.metrics.num_output_batches += 1
+        yield out
+
+
+class TpuBroadcastNestedLoopJoinExec(_BroadcastBuildMixin, _NestedLoopBase):
+    """Cross join (+ optional condition) with one side broadcast
+    (reference: GpuBroadcastNestedLoopJoinExec.scala:311).  The stream
+    side keeps its partitioning; the build side is gathered once."""
+
+    def __init__(self, left: PhysicalPlan, right: PhysicalPlan,
+                 condition: Optional[ir.Expression], schema: Schema,
+                 build_side: str = "right"):
+        super().__init__(left, right, condition, schema)
+        self._init_build(build_side)
+
     def execute(self):
-        def run():
-            left, right = _gather(self.children[0]), _gather(self.children[1])
+        stream_side = 0 if self.build_side == "right" else 1
+        sits = self.children[stream_side].execute()
+
+        def run(sit):
+            build = self._build()
+            if build is None:
+                return
+            for sb in sit:
+                if not int(sb.num_rows):
+                    continue
+                if stream_side == 0:
+                    yield from self._cross_pair(sb, build)
+                else:
+                    yield from self._cross_pair(build, sb)
+
+        return [run(it) for it in sits]
+
+
+class TpuCartesianProductExec(_NestedLoopBase):
+    """Partition-pairwise cross join: output partition (i, j) crosses left
+    partition i with right partition j (reference:
+    GpuCartesianProductExec.scala:304 — pairwise cross join with
+    serialized-batch RDD)."""
+
+    def execute(self):
+        lits = self.children[0].execute()
+        rits = self.children[1].execute()
+        # right partitions are iterated once per left partition: gather
+        # each right partition lazily and cache (the serialized-batch
+        # broadcast-to-all-pairs role)
+        rcache: dict = {}
+
+        def right_batch(j: int, rit) -> Optional[DeviceBatch]:
+            if j not in rcache:
+                rcache[j] = _gather_partition(rit)
+            return rcache[j]
+
+        def run(i, lit, j, rit):
+            left = _gather_partition(lit) if (i, "l") not in rcache else \
+                rcache[(i, "l")]
+            rcache[(i, "l")] = left
+            right = right_batch(j, rit)
             if left is None or right is None:
                 return
-            nl, nr = int(left.num_rows), int(right.num_rows)
-            out_cap = bucket_rows(nl * nr)
-            key = (out_cap, left.schema_key(), right.schema_key())
-            if key not in self._kernels:
-                def impl(l, r):
-                    total = l.num_rows * r.num_rows
-                    k = jnp.arange(out_cap, dtype=jnp.int64)
-                    li = jnp.clip(k // jnp.maximum(r.num_rows, 1), 0,
-                                  l.capacity - 1)
-                    ri = jnp.clip(k % jnp.maximum(r.num_rows, 1), 0,
-                                  r.capacity - 1)
-                    valid = k < total
-                    cols = [c.gather(li, valid) for c in l.columns] + \
-                        [c.gather(ri, valid) for c in r.columns]
-                    out = DeviceBatch(self._schema.names, cols, total)
-                    if self.condition is not None:
-                        v = eval_tpu.evaluate(self.condition, out)
-                        out = compact(out, v.data.astype(jnp.bool_) &
-                                      v.validity)
-                    return out
-                self._kernels[key] = jax.jit(impl)
-            with timed(self.metrics):
-                out = self._kernels[key](left, right)
-            yield out
-        return [run()]
+            yield from self._cross_pair(left, right)
+
+        return [run(i, lit, j, rit)
+                for i, lit in enumerate(lits)
+                for j, rit in enumerate(rits)]

@@ -226,11 +226,21 @@ class Join(LogicalPlan):
     def __init__(self, left: LogicalPlan, right: LogicalPlan,
                  left_keys: Sequence[str], right_keys: Sequence[str],
                  how: str = "inner",
-                 condition: Optional[ir.Expression] = None):
+                 condition: Optional[ir.Expression] = None,
+                 hint: Optional[str] = None):
         self.children = (left, right)
         self.left_keys = list(left_keys)
         self.right_keys = list(right_keys)
         self.how = how
+        # "broadcast_left"/"broadcast_right" (functions.broadcast analog)
+        self.hint = hint
+        if how != "cross" and not self.left_keys and how != "inner":
+            # a keyless outer/semi/anti join is a nested-loop join with
+            # outer semantics we don't implement; refusing beats silently
+            # computing a cross product
+            raise NotImplementedError(
+                f"{how} join without keys is not supported; only "
+                f"inner/cross joins may omit join keys")
         lf, rf = left.schema.fields, right.schema.fields
         # Spark promotes mismatched numeric key pairs to a common type
         # before comparing; record the promoted dtype per key pair
@@ -347,3 +357,59 @@ class Expand(LogicalPlan):
     @property
     def schema(self) -> Schema:
         return self._schema
+
+
+class Repartition(LogicalPlan):
+    """Explicit exchange: df.repartition(n[, cols]) / repartitionByRange /
+    coalesce.  kind in {"hash", "range", "roundrobin", "single"}.
+
+    Planned as a ShuffleExchangeExec (reference:
+    GpuShuffleExchangeExec.scala:143 + the four partitionings §2d)."""
+
+    def __init__(self, child: LogicalPlan, kind: str, num_partitions: int,
+                 exprs: Sequence[ir.Expression] = (),
+                 orders: Sequence[SortOrder] = ()):
+        self.children = (child,)
+        self.kind = kind
+        self.num_partitions = max(1, int(num_partitions))
+        self.exprs = [self.bind(e) for e in exprs]
+        self.orders = [SortOrder(self.bind(o.expr), o.ascending,
+                                 o.nulls_first) for o in orders]
+
+    @property
+    def schema(self) -> Schema:
+        return self.children[0].schema
+
+    def simple_string(self) -> str:
+        return f"Repartition({self.kind}, n={self.num_partitions})"
+
+
+def size_estimate(node: LogicalPlan) -> int:
+    """Rough plan-size statistic in bytes, for broadcast-join selection
+    (the role of Spark's plan statistics feeding
+    spark.sql.autoBroadcastJoinThreshold)."""
+    import os
+    if isinstance(node, InMemoryScan):
+        return node.table.nbytes
+    if isinstance(node, FileScan):
+        total = 0
+        for p in node.paths:
+            try:
+                total += os.path.getsize(p)
+            except OSError:
+                return 1 << 62
+        # parquet/orc are compressed on disk; assume 3x in-memory growth
+        return total * (3 if node.fmt in ("parquet", "orc") else 1)
+    if isinstance(node, Range):
+        step = node.step if node.step else 1
+        n = (node.end - node.start + step + (-1 if step > 0 else 1)) // step
+        return max(0, n) * 8
+    if isinstance(node, Filter):
+        return size_estimate(node.children[0]) // 2
+    if isinstance(node, (Aggregate, Limit)):
+        return size_estimate(node.children[0]) // 2
+    if isinstance(node, Join):
+        return sum(size_estimate(c) for c in node.children)
+    if not node.children:
+        return 1 << 62
+    return max(size_estimate(c) for c in node.children)

@@ -132,7 +132,8 @@ class ExecRule:
     spark_name: str                      # key used for kill-switch + explain
     description: str
     exprs_of: Callable[[PhysicalPlan], List[ir.Expression]]
-    convert: Callable[[PhysicalPlan, List[PhysicalPlan]], PhysicalPlan]
+    convert: Callable[[PhysicalPlan, List[PhysicalPlan], RapidsTpuConf],
+                      PhysicalPlan]
     extra_tag: Optional[Callable[[PhysicalPlan, RapidsTpuConf],
                                  List[str]]] = None
     incompat: bool = False
@@ -163,51 +164,51 @@ register_exec_rule(cpux.CpuScanExec, ExecRule(
     "InMemoryScan", "in-memory table scan feeding the device",
     _no_exprs,
     # scan stays on CPU; the host->device transition makes it device-feeding
-    convert=lambda n, ch: n))
+    convert=lambda n, ch, conf: n))
 
 register_exec_rule(cpux.CpuProjectExec, ExecRule(
     "ProjectExec", "TPU projection (bound-expression columnar eval)",
     lambda n: list(n.exprs),
-    convert=lambda n, ch: tpub.TpuProjectExec(ch[0], n.exprs, n.schema)))
+    convert=lambda n, ch, conf: tpub.TpuProjectExec(ch[0], n.exprs, n.schema)))
 
 register_exec_rule(cpux.CpuFilterExec, ExecRule(
     "FilterExec", "TPU filter (mask + stream compaction)",
     lambda n: [n.condition],
-    convert=lambda n, ch: tpub.TpuFilterExec(ch[0], n.condition)))
+    convert=lambda n, ch, conf: tpub.TpuFilterExec(ch[0], n.condition)))
 
 register_exec_rule(cpux.CpuRangeExec, ExecRule(
     "RangeExec", "TPU range generation",
     _no_exprs,
-    convert=lambda n, ch: tpub.TpuRangeExec(
+    convert=lambda n, ch, conf: tpub.TpuRangeExec(
         n.start, n.end, n.step, n.num_partitions)))
 
 register_exec_rule(cpux.CpuUnionExec, ExecRule(
     "UnionExec", "TPU union (partition concatenation)",
     _no_exprs,
-    convert=lambda n, ch: tpub.TpuUnionExec(ch)))
+    convert=lambda n, ch, conf: tpub.TpuUnionExec(ch)))
 
 register_exec_rule(cpux.CpuLimitExec, ExecRule(
     "GlobalLimitExec", "TPU global limit",
     _no_exprs,
-    convert=lambda n, ch: tpub.TpuGlobalLimitExec(ch[0], n.n)))
+    convert=lambda n, ch, conf: tpub.TpuGlobalLimitExec(ch[0], n.n)))
 
 register_exec_rule(cpux.CpuSortExec, ExecRule(
     "SortExec", "TPU total sort (total-order key encode + lexsort)",
     lambda n: [o.expr for o in n.orders],
-    convert=lambda n, ch: TpuSortExec(ch[0], n.orders),
+    convert=lambda n, ch, conf: TpuSortExec(ch[0], n.orders),
     extra_tag=_sort_unsupported_types))
 
 register_exec_rule(cpux.CpuHashAggregateExec, ExecRule(
     "HashAggregateExec",
     "TPU hash aggregate (sort-based segmented reduction)",
     lambda n: list(n.groupings) + list(n.aggregates),
-    convert=lambda n, ch: TpuHashAggregateExec(
+    convert=lambda n, ch, conf: TpuHashAggregateExec(
         ch[0], n.groupings, n.aggregates, n.schema)))
 
 register_exec_rule(cpux.CpuExpandExec, ExecRule(
     "ExpandExec", "TPU expand (N projections per row)",
     lambda n: [e for p in n.projections for e in p],
-    convert=lambda n, ch: tpub.TpuExpandExec(ch[0], n.projections, n.schema)))
+    convert=lambda n, ch, conf: tpub.TpuExpandExec(ch[0], n.projections, n.schema)))
 
 
 def _tag_window(n, conf) -> List[str]:
@@ -255,7 +256,7 @@ def _register_window_rule():
         "WindowExec",
         "TPU window functions (lexsort + segmented scans/prefix sums)",
         _win_exprs,
-        convert=lambda n, ch: TpuWindowExec(ch[0], n.window_exprs,
+        convert=lambda n, ch, conf: TpuWindowExec(ch[0], n.window_exprs,
                                             n.out_names, n.schema),
         extra_tag=_tag_window))
 
@@ -263,7 +264,7 @@ def _register_window_rule():
 _register_window_rule()
 
 
-def _convert_join(n: cpux.CpuJoinExec, ch):
+def _convert_join(n: cpux.CpuJoinExec, ch, conf):
     from spark_rapids_tpu.exec.tpu_join import (
         TpuBroadcastNestedLoopJoinExec, TpuShuffledHashJoinExec)
     if n.how == "cross":
@@ -281,12 +282,90 @@ def _tag_join(n: cpux.CpuJoinExec, conf) -> List[str]:
     return out
 
 
+def _join_exprs(n: cpux.CpuJoinExec) -> List[ir.Expression]:
+    return [n.condition] if n.condition is not None else []
+
+
 register_exec_rule(cpux.CpuJoinExec, ExecRule(
     "ShuffledHashJoinExec",
     "TPU equi-join (sort-merge over total-order keys, two-pass sizing)",
-    lambda n: [n.condition] if n.condition is not None else [],
+    _join_exprs,
     convert=_convert_join,
     extra_tag=_tag_join))
+
+
+def _register_join_strategy_rules():
+    from spark_rapids_tpu.exec.tpu_join import (
+        TpuBroadcastHashJoinExec, TpuBroadcastNestedLoopJoinExec,
+        TpuCartesianProductExec, TpuShuffledHashJoinExec)
+
+    register_exec_rule(cpux.CpuShuffledHashJoinExec, ExecRule(
+        "ShuffledHashJoinExec",
+        "TPU partitioned equi-join over co-partitioned exchanges",
+        _join_exprs,
+        convert=lambda n, ch, conf: TpuShuffledHashJoinExec(
+            ch[0], ch[1], n.left_keys, n.right_keys, n.how, n.condition,
+            n.schema),
+        extra_tag=_tag_join))
+
+    register_exec_rule(cpux.CpuBroadcastHashJoinExec, ExecRule(
+        "BroadcastHashJoinExec",
+        "TPU broadcast equi-join (build side gathered once, stream side "
+        "stays partitioned)",
+        _join_exprs,
+        convert=lambda n, ch, conf: TpuBroadcastHashJoinExec(
+            ch[0], ch[1], n.left_keys, n.right_keys, n.how, n.condition,
+            n.schema, build_side=n.build_side),
+        extra_tag=_tag_join))
+
+    register_exec_rule(cpux.CpuBroadcastNestedLoopJoinExec, ExecRule(
+        "BroadcastNestedLoopJoinExec",
+        "TPU broadcast nested-loop join (cross product + filter)",
+        _join_exprs,
+        convert=lambda n, ch, conf: TpuBroadcastNestedLoopJoinExec(
+            ch[0], ch[1], n.condition, n.schema,
+            build_side=n.build_side)))
+
+    register_exec_rule(cpux.CpuCartesianProductExec, ExecRule(
+        "CartesianProductExec",
+        "TPU partition-pairwise cartesian product",
+        _join_exprs,
+        convert=lambda n, ch, conf: TpuCartesianProductExec(
+            ch[0], ch[1], n.condition, n.schema)))
+
+
+_register_join_strategy_rules()
+
+
+def _tag_exchange(n, conf) -> List[str]:
+    from spark_rapids_tpu.shuffle import exchange as ex
+    out = []
+    if isinstance(n.partitioning, ex.RangePartitioning):
+        for o in n.partitioning.orders:
+            if o.expr.dtype is not None and o.expr.dtype.is_floating and \
+                    not conf.get(cfg.ENABLE_FLOAT_SORT):
+                out.append("float range partitioning disabled")
+    return out
+
+
+def _register_exchange_rule():
+    from spark_rapids_tpu.shuffle import exchange as ex
+
+    register_exec_rule(ex.CpuShuffleExchangeExec, ExecRule(
+        "ShuffleExchangeExec",
+        "TPU shuffle exchange (on-device partition slicing; local Arrow-IPC "
+        "or device-resident data plane)",
+        lambda n: n.partitioning.exprs(),
+        convert=_make_tpu_exchange,
+        extra_tag=_tag_exchange))
+
+
+def _make_tpu_exchange(n, ch, conf):
+    from spark_rapids_tpu.shuffle.exchange import TpuShuffleExchangeExec
+    return TpuShuffleExchangeExec(ch[0], n.partitioning, conf)
+
+
+_register_exchange_rule()
 
 
 # ---------------------------------------------------------------------------
@@ -375,7 +454,7 @@ def _convert(meta: ExecMeta, conf: RapidsTpuConf) -> PhysicalPlan:
         dev_children = [
             c if c.is_tpu else tpub.HostToDeviceExec(c, min_bucket)
             for c in children]
-        return meta.rule.convert(meta.node, dev_children)
+        return meta.rule.convert(meta.node, dev_children, conf)
 
     # CPU node: host inputs required
     host_children = [

@@ -49,11 +49,20 @@ def plan_cpu(node: lp.LogicalPlan, conf: RapidsTpuConf) -> PhysicalPlan:
     if isinstance(node, lp.Union):
         return cpux.CpuUnionExec([plan_cpu(c, conf) for c in node.children])
     if isinstance(node, lp.Join):
-        left = plan_cpu(node.children[0], conf)
-        right = plan_cpu(node.children[1], conf)
-        return cpux.CpuJoinExec(left, right, node.left_keys, node.right_keys,
-                                node.how, node.condition, node.schema,
-                                node.key_dtypes)
+        return _plan_join(node, conf)
+    if isinstance(node, lp.Repartition):
+        from spark_rapids_tpu.shuffle import exchange as ex
+        child = plan_cpu(node.children[0], conf)
+        n = node.num_partitions
+        if node.kind == "hash":
+            part = ex.HashPartitioning(n, node.exprs)
+        elif node.kind == "range":
+            part = ex.RangePartitioning(n, node.orders)
+        elif node.kind == "single":
+            part = ex.SinglePartitioning(n)
+        else:
+            part = ex.RoundRobinPartitioning(n)
+        return ex.CpuShuffleExchangeExec(child, part)
     if isinstance(node, lp.Range):
         return cpux.CpuRangeExec(node.start, node.end, node.step,
                                  node.num_partitions)
@@ -66,3 +75,78 @@ def plan_cpu(node: lp.LogicalPlan, conf: RapidsTpuConf) -> PhysicalPlan:
         return CpuWindowExec(child, node.window_exprs, node.out_names,
                              node.schema)
     raise NotImplementedError(f"planner: {type(node).__name__}")
+
+
+def _plan_join(node, conf: RapidsTpuConf):
+    """Join strategy selection (the role Spark's JoinSelection strategy +
+    EnsureRequirements play above the reference plugin).
+
+    broadcast-hash when a side is hinted or estimated under
+    spark.rapids.tpu.sql.autoBroadcastJoinThreshold (Spark build-side
+    validity rules), else shuffled-hash with a hash exchange inserted on
+    both sides; cross joins become broadcast-nested-loop (small side) or
+    a partitionwise cartesian product.
+    """
+    from spark_rapids_tpu.shuffle import exchange as ex
+    from spark_rapids_tpu.expr import ir
+
+    left = plan_cpu(node.children[0], conf)
+    right = plan_cpu(node.children[1], conf)
+    threshold = conf.get(cfg.AUTO_BROADCAST_THRESHOLD)
+    lsize = lp.size_estimate(node.children[0])
+    rsize = lp.size_estimate(node.children[1])
+    args = (node.left_keys, node.right_keys, node.how, node.condition,
+            node.schema, node.key_dtypes)
+
+    if node.how == "cross" or not node.left_keys:
+        small = min(lsize, rsize)
+        if node.hint == "broadcast_left" or (
+                node.hint is None and small <= threshold and lsize <= rsize):
+            return cpux.CpuBroadcastNestedLoopJoinExec(
+                left, right, *args, build_side="left")
+        if node.hint == "broadcast_right" or (
+                node.hint is None and small <= threshold):
+            return cpux.CpuBroadcastNestedLoopJoinExec(
+                left, right, *args, build_side="right")
+        return cpux.CpuCartesianProductExec(left, right, *args)
+
+    # Spark build-side validity: inner/cross either; left/semi/anti build
+    # right only; right outer build left only; full outer no broadcast
+    can_build_right = node.how in ("inner", "left", "semi", "anti")
+    can_build_left = node.how in ("inner", "right")
+    build = None
+    if node.hint == "broadcast_right" and can_build_right:
+        build = "right"
+    elif node.hint == "broadcast_left" and can_build_left:
+        build = "left"
+    elif can_build_right and rsize <= threshold and \
+            (not can_build_left or rsize <= lsize):
+        build = "right"
+    elif can_build_left and lsize <= threshold:
+        build = "left"
+    if build is not None:
+        return cpux.CpuBroadcastHashJoinExec(left, right, *args,
+                                             build_side=build)
+
+    n = conf.shuffle_partitions
+
+    def bound_keys(side_plan, names):
+        s = side_plan.schema
+        out = []
+        for k, kd in zip(names, node.key_dtypes):
+            e = ir.bind(ir.UnresolvedAttribute(k), s.names, s.dtypes,
+                        s.nullables)
+            if e.dtype != kd:
+                # both sides must hash the promoted key type identically
+                e = ir.Cast(e, kd)
+                e.resolve()
+            out.append(e)
+        return out
+
+    lex = ex.CpuShuffleExchangeExec(
+        left, ex.HashPartitioning(n, bound_keys(node.children[0],
+                                                node.left_keys)))
+    rex = ex.CpuShuffleExchangeExec(
+        right, ex.HashPartitioning(n, bound_keys(node.children[1],
+                                                 node.right_keys)))
+    return cpux.CpuShuffledHashJoinExec(lex, rex, *args)

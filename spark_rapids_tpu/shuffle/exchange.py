@@ -1,0 +1,467 @@
+"""Shuffle exchange execs + the four partitionings.
+
+Reference analogs:
+  * ``GpuShuffleExchangeExec`` (reference:
+    org/.../execution/GpuShuffleExchangeExec.scala:143) — partitions each
+    batch on-device, then moves slices through a shuffle data plane.
+  * The four partitionings — ``GpuHashPartitioning`` (murmur3 pmod,
+    GpuHashPartitioning.scala:29), ``GpuRangePartitioning`` (sampled bounds,
+    GpuRangePartitioning.scala:169), ``GpuRoundRobinPartitioning``
+    (GpuRoundRobinPartitioning.scala:97), ``GpuSinglePartitioning``
+    (GpuSinglePartitioning.scala:61), sliced on device exactly like
+    ``GpuPartitioning.sliceInternalOnGpu`` (GpuPartitioning.scala:45).
+  * The local block store + Arrow IPC serializer is the default data plane
+    (Spark sort-shuffle + GpuColumnarBatchSerializer analog); the reader
+    side concatenates slices per output partition, the
+    ``ShuffleCoalesceExec`` role (ShuffleCoalesceExec.scala:199).
+
+TPU-first departures from the reference:
+  * Slicing is one reorder + contiguous ranges (a stable argsort by target
+    partition), not N cudf ``contiguous_split`` buffers — XLA keeps it one
+    fused gather.
+  * Range partitioning needs no reservoir sampling (reference:
+    SamplingUtils.scala:120): the exchange materializes its input anyway,
+    so bounds come from an exact rank — a total-order lexsort rank split
+    into even spans, with each equal-key group snapped to one partition
+    (segment-head cohesion). Exactly balanced, same contract as Spark's
+    RangePartitioner (equal keys co-located, partitions ordered).
+"""
+
+from __future__ import annotations
+
+import itertools
+from dataclasses import dataclass
+from typing import Any, Dict, Iterator, List, Optional, Sequence, Tuple
+
+import numpy as np
+import pyarrow as pa
+
+import jax
+import jax.numpy as jnp
+
+from spark_rapids_tpu import config as cfg
+from spark_rapids_tpu import dtypes as dt
+from spark_rapids_tpu.columnar.batch import (DeviceBatch, DeviceColumn,
+                                             bucket_rows, concat_batches,
+                                             from_arrow, to_arrow)
+from spark_rapids_tpu.exec import sortkeys
+from spark_rapids_tpu.exec.base import PhysicalPlan, TpuExec, timed
+from spark_rapids_tpu.exec.cpu import concat_tables, _empty_table
+from spark_rapids_tpu.expr import eval_cpu, eval_tpu, ir
+from spark_rapids_tpu.expr.eval_tpu import ColVal
+from spark_rapids_tpu.plan.logical import Schema, SortOrder
+from spark_rapids_tpu.shuffle.serializer import (deserialize_table,
+                                                 get_codec, serialize_table)
+
+
+# ---------------------------------------------------------------------------
+# Partitioning specs
+# ---------------------------------------------------------------------------
+
+@dataclass
+class Partitioning:
+    num_partitions: int
+
+    def exprs(self) -> List[ir.Expression]:
+        return []
+
+
+@dataclass
+class SinglePartitioning(Partitioning):
+    pass
+
+
+@dataclass
+class HashPartitioning(Partitioning):
+    keys: List[ir.Expression] = None
+
+    def exprs(self) -> List[ir.Expression]:
+        return list(self.keys)
+
+
+@dataclass
+class RoundRobinPartitioning(Partitioning):
+    pass
+
+
+@dataclass
+class RangePartitioning(Partitioning):
+    orders: List[SortOrder] = None
+
+    def exprs(self) -> List[ir.Expression]:
+        return [o.expr for o in self.orders]
+
+
+# ---------------------------------------------------------------------------
+# Device-side target computation
+# ---------------------------------------------------------------------------
+
+def hash_targets(batch: DeviceBatch, keys: Sequence[ir.Expression],
+                 n_parts: int) -> jnp.ndarray:
+    """Spark murmur3(seed=42) pmod targets (GpuHashPartitioning analog)."""
+    from spark_rapids_tpu.expr.eval_tpu import hash_colval
+    cap = batch.capacity
+    h = jnp.full((cap,), np.int32(42), dtype=jnp.int32)
+    for k in keys:
+        v = eval_tpu.evaluate(k, batch)
+        h = hash_colval(v, h)
+    m = h % np.int32(n_parts)
+    return jnp.where(m < 0, m + n_parts, m).astype(jnp.int32)
+
+
+def range_targets(batch: DeviceBatch, orders: Sequence[SortOrder],
+                  n_parts: int) -> jnp.ndarray:
+    """Exact-rank range targets with equal-key group cohesion."""
+    exists = batch.row_mask()
+    key_groups = []
+    for o in orders:
+        v = eval_tpu.evaluate(o.expr, batch)
+        key_groups.append(sortkeys.encode_keys(
+            v, o.ascending, o.nulls_first_resolved))
+    order = sortkeys.lexsort_indices(key_groups, exists)
+    cap = batch.capacity
+    n = batch.num_rows
+    # rank r of sorted position -> span r*n_parts//n; group cohesion: every
+    # row of an equal-key group takes the group head's span
+    new_group = sortkeys.group_boundaries(key_groups, order, exists)
+    seg = jnp.cumsum(new_group.astype(jnp.int32)) - 1
+    pos = jnp.arange(cap, dtype=jnp.int64)
+    head_pos = jax.ops.segment_min(
+        jnp.where(jnp.take(exists, order), pos, np.int64(1 << 62)), seg,
+        num_segments=cap)
+    span = (jnp.take(head_pos, seg) * n_parts) // jnp.maximum(n, 1)
+    span = jnp.clip(span, 0, n_parts - 1).astype(jnp.int32)
+    # scatter back to original row order
+    target = jnp.zeros((cap,), dtype=jnp.int32).at[order].set(span)
+    return target
+
+
+def round_robin_targets(batch: DeviceBatch, n_parts: int,
+                        start: jnp.ndarray) -> jnp.ndarray:
+    cap = batch.capacity
+    return ((jnp.arange(cap, dtype=jnp.int32) + start.astype(jnp.int32))
+            % np.int32(n_parts))
+
+
+def partition_batch(batch: DeviceBatch, target: jnp.ndarray, n_parts: int
+                    ) -> Tuple[DeviceBatch, jnp.ndarray]:
+    """Reorder rows so each output partition is one contiguous span.
+
+    Returns (reordered batch, per-partition counts).  One stable argsort —
+    the XLA formulation of cudf contiguous_split
+    (GpuPartitioning.sliceInternalOnGpu analog).
+    """
+    cap = batch.capacity
+    exists = batch.row_mask()
+    t = jnp.where(exists, target, n_parts)  # padding parks after all spans
+    counts = jnp.zeros((n_parts,), dtype=jnp.int32).at[t].add(
+        exists.astype(jnp.int32), mode="drop")
+    order = jnp.argsort(t, stable=True)
+    cols = [c.gather(order, jnp.take(exists, order))
+            for c in batch.columns]
+    return DeviceBatch(batch.names, cols, batch.num_rows), counts
+
+
+def slice_span(batch: DeviceBatch, offset: jnp.ndarray, count: jnp.ndarray,
+               out_cap: int) -> DeviceBatch:
+    """Extract rows [offset, offset+count) into a fresh bucketed batch."""
+    idx = offset + jnp.arange(out_cap, dtype=jnp.int32)
+    valid = jnp.arange(out_cap, dtype=jnp.int32) < count
+    idx = jnp.clip(idx, 0, batch.capacity - 1)
+    cols = [c.gather(idx, valid) for c in batch.columns]
+    return DeviceBatch(batch.names, cols, count)
+
+
+# ---------------------------------------------------------------------------
+# Local shuffle block store (default data plane)
+# ---------------------------------------------------------------------------
+
+class ShuffleBlockStore:
+    """In-process map-output store of serialized Arrow slices.
+
+    Plays the role of Spark's sort-shuffle files + block manager for the
+    default path (one executor); blocks are keyed (map_idx, reduce_idx)
+    like shuffle block ids.
+    """
+
+    def __init__(self, codec_name: str):
+        self.codec = get_codec(codec_name)
+        self._blocks: Dict[Tuple[int, int], bytes] = {}
+        self.bytes_written = 0
+
+    def put(self, map_idx: int, reduce_idx: int, table: pa.Table) -> None:
+        if table.num_rows == 0:
+            return
+        data = serialize_table(table, self.codec)
+        self.bytes_written += len(data)
+        self._blocks[(map_idx, reduce_idx)] = data
+
+    def fetch(self, reduce_idx: int) -> List[pa.Table]:
+        out = []
+        for (m, r), data in sorted(self._blocks.items()):
+            if r == reduce_idx:
+                out.append(deserialize_table(data))
+        return out
+
+
+# ---------------------------------------------------------------------------
+# Execs
+# ---------------------------------------------------------------------------
+
+class CpuShuffleExchangeExec(PhysicalPlan):
+    """Host-side exchange (the stock-Spark role for fallback parity)."""
+
+    def __init__(self, child: PhysicalPlan, partitioning: Partitioning):
+        super().__init__()
+        self.children = (child,)
+        self.partitioning = partitioning
+
+    @property
+    def schema(self) -> Schema:
+        return self.children[0].schema
+
+    def _targets(self, table: pa.Table, start: int) -> np.ndarray:
+        p = self.partitioning
+        n = table.num_rows
+        if isinstance(p, SinglePartitioning):
+            return np.zeros(n, dtype=np.int64)
+        if isinstance(p, RoundRobinPartitioning):
+            # `start` carries the running row offset so the round-robin
+            # wheel keeps turning across input batches
+            return (np.arange(n, dtype=np.int64) + start) % p.num_partitions
+        if isinstance(p, HashPartitioning):
+            h = eval_cpu.evaluate(ir.Murmur3Hash(list(p.keys), 42), table)
+            m = np.asarray(h.data, dtype=np.int64) % p.num_partitions
+            return np.where(m < 0, m + p.num_partitions, m)
+        if isinstance(p, RangePartitioning):
+            # same exact-rank + group-cohesion contract as the device path
+            import pyarrow.compute as pc
+            vals = [eval_cpu.evaluate(o.expr, table) for o in p.orders]
+            # stable multi-key order built least-significant-key-first
+            # (identical technique to CpuSortExec)
+            order = np.arange(n)
+            for v, o in zip(reversed(vals), reversed(p.orders)):
+                arr = eval_cpu.to_arrow_array(v).take(pa.array(order))
+                oi = pc.sort_indices(
+                    arr,
+                    sort_keys=[("", "ascending" if o.ascending
+                                else "descending")],
+                    null_placement="at_start" if o.nulls_first_resolved
+                    else "at_end")
+                order = order[np.asarray(oi)]
+
+            # vectorized equal-key group heads over the sorted order:
+            # adjacent-row equality per key (nulls equal, NaN==NaN,
+            # -0.0==0.0), then a prefix-max of new-group positions
+            same = np.ones(n, dtype=bool)
+            for v in vals:
+                sv = v.data[order]
+                sm = v.valid[order]
+                if np.issubdtype(np.asarray(v.data).dtype, np.floating):
+                    x = sv.astype(np.float64)
+                    x = np.where(x == 0.0, 0.0, x)  # fold -0.0
+                    eq = (x[1:] == x[:-1]) | (np.isnan(x[1:]) &
+                                              np.isnan(x[:-1]))
+                else:
+                    eq = sv[1:] == sv[:-1]
+                pair_eq = np.concatenate(
+                    [[True], (sm[1:] & sm[:-1] & eq) |
+                     (~sm[1:] & ~sm[:-1])])
+                same &= pair_eq
+            pos = np.arange(n, dtype=np.int64)
+            heads = np.maximum.accumulate(np.where(same, 0, pos))
+            heads[0] = 0
+            span = (heads * p.num_partitions) // max(n, 1)
+            target = np.zeros(n, dtype=np.int64)
+            target[order] = np.clip(span, 0, p.num_partitions - 1)
+            return target
+        raise NotImplementedError(type(p).__name__)
+
+    def execute(self):
+        n_parts = self.partitioning.num_partitions
+        state = {"slices": None}
+
+        def input_batches():
+            """(map_idx, table) pairs; range partitioning needs the global
+            rank, so its whole input coalesces into one logical map task."""
+            if isinstance(self.partitioning, RangePartitioning):
+                all_t = []
+                for it in self.children[0].execute():
+                    all_t.extend(t for t in it if t.num_rows)
+                t = concat_tables(all_t, self.schema)
+                if t.num_rows:
+                    yield 0, t
+                return
+            for m, it in enumerate(self.children[0].execute()):
+                for t in it:
+                    if t.num_rows:
+                        yield m, t
+
+        def materialize():
+            if state["slices"] is not None:
+                return state["slices"]
+            slices: List[List[pa.Table]] = [[] for _ in range(n_parts)]
+            rows_seen = 0
+            for m, t in input_batches():
+                tgt = self._targets(t, rows_seen)
+                rows_seen += t.num_rows
+                order = np.argsort(tgt, kind="stable")
+                sorted_t = t.take(pa.array(order))
+                counts = np.bincount(tgt, minlength=n_parts)
+                off = 0
+                for pidx in range(n_parts):
+                    c = int(counts[pidx])
+                    if c:
+                        slices[pidx].append(sorted_t.slice(off, c))
+                    off += c
+            state["slices"] = slices
+            return slices
+
+        def reader(pidx: int) -> Iterator[pa.Table]:
+            parts = materialize()[pidx]
+            out = concat_tables(parts, self.schema)
+            self.metrics.num_output_rows += out.num_rows
+            yield out
+
+        return [reader(p) for p in range(n_parts)]
+
+
+class TpuShuffleExchangeExec(TpuExec):
+    """Device-side exchange.
+
+    transport='device': slices stay HBM-resident, handed to readers as
+    DeviceBatches (the RapidsShuffleManager device-store analog for one
+    process, RapidsShuffleInternalManager.scala:90-155).
+    transport='local': each slice is downloaded, Arrow-IPC-serialized with
+    the configured codec into the block store, and re-uploaded on read (the
+    default sort-shuffle path analog, honest about the host round trip).
+    """
+
+    def __init__(self, child: PhysicalPlan, partitioning: Partitioning,
+                 conf_obj):
+        super().__init__()
+        self.children = (child,)
+        self.partitioning = partitioning
+        self.transport = str(conf_obj.get(cfg.SHUFFLE_TRANSPORT))
+        self.codec_name = str(conf_obj.get(cfg.SHUFFLE_COMPRESSION_CODEC))
+        self.min_bucket = conf_obj.get(cfg.MIN_BUCKET_ROWS)
+        self._kernels: Dict[Any, Any] = {}
+
+    @property
+    def schema(self) -> Schema:
+        return self.children[0].schema
+
+    def _target_fn(self):
+        """(batch, start) -> per-row target partition ids; `start` is the
+        running row offset (only round-robin consumes it, as a traced
+        operand so one compiled kernel serves every batch)."""
+        p = self.partitioning
+        if isinstance(p, SinglePartitioning):
+            return lambda b, st: jnp.zeros((b.capacity,), dtype=jnp.int32)
+        if isinstance(p, RoundRobinPartitioning):
+            return lambda b, st: round_robin_targets(b, p.num_partitions,
+                                                     st)
+        if isinstance(p, HashPartitioning):
+            return lambda b, st: hash_targets(b, p.keys, p.num_partitions)
+        if isinstance(p, RangePartitioning):
+            return lambda b, st: range_targets(b, p.orders,
+                                               p.num_partitions)
+        raise NotImplementedError(type(p).__name__)
+
+    def _partition_one(self, batch: DeviceBatch, rows_seen: int
+                       ) -> Tuple[DeviceBatch, np.ndarray]:
+        n_parts = self.partitioning.num_partitions
+        key = ("part", batch.schema_key())
+        if key not in self._kernels:
+            tf = self._target_fn()
+            self._kernels[key] = jax.jit(
+                lambda b, st: partition_batch(b, tf(b, st), n_parts))
+        with timed(self.metrics):
+            reordered, counts = self._kernels[key](
+                batch, jnp.asarray(rows_seen, dtype=jnp.int32))
+        return reordered, np.asarray(counts)
+
+    def _slice(self, reordered: DeviceBatch, offset: int, count: int
+               ) -> DeviceBatch:
+        out_cap = bucket_rows(count, self.min_bucket)
+        key = ("slice", out_cap, reordered.schema_key())
+        if key not in self._kernels:
+            self._kernels[key] = jax.jit(
+                lambda b, o, c: slice_span(b, o, c, out_cap))
+        return self._kernels[key](reordered,
+                                  jnp.asarray(offset, dtype=jnp.int32),
+                                  jnp.asarray(count, dtype=jnp.int32))
+
+    def execute(self):
+        n_parts = self.partitioning.num_partitions
+        state = {"done": False, "store": None, "dev_slices": None}
+
+        def materialize():
+            if state["done"]:
+                return
+            host = self.transport == "local"
+            store = ShuffleBlockStore(self.codec_name) if host else None
+            dev_slices: List[List[DeviceBatch]] = \
+                [[] for _ in range(n_parts)]
+
+            def input_batches():
+                # range partitioning needs the global rank: coalesce the
+                # whole input into one batch (same contract as total sort)
+                if isinstance(self.partitioning, RangePartitioning):
+                    all_b = []
+                    for it in self.children[0].execute():
+                        all_b.extend(b for b in it if int(b.num_rows))
+                    if all_b:
+                        yield concat_batches(all_b)
+                    return
+                for it in self.children[0].execute():
+                    for b in it:
+                        if int(b.num_rows):
+                            yield b
+
+            m = 0
+            rows_seen = 0
+            for batch in input_batches():
+                reordered, counts = self._partition_one(batch, rows_seen)
+                rows_seen += int(batch.num_rows)
+                off = 0
+                for pidx in range(n_parts):
+                    c = int(counts[pidx])
+                    if c:
+                        s = self._slice(reordered, off, c)
+                        if host:
+                            store.put(m, pidx, to_arrow(s))
+                        else:
+                            dev_slices[pidx].append(s)
+                    off += c
+                m += 1
+            state["store"] = store
+            state["dev_slices"] = dev_slices
+            state["done"] = True
+            if store is not None:
+                self.metrics.extra["bytes_written"] = store.bytes_written
+
+        def reader(pidx: int) -> Iterator[DeviceBatch]:
+            materialize()
+            if self.transport == "local":
+                tables = state["store"].fetch(pidx)
+                if not tables:
+                    return
+                # ShuffleCoalesce: concat host-serialized slices, upload once
+                t = concat_tables(tables, self.schema)
+                with timed(self.metrics):
+                    b = from_arrow(t, self.min_bucket)
+                self.metrics.num_output_rows += t.num_rows
+                self.metrics.num_output_batches += 1
+                yield b
+            else:
+                slices = state["dev_slices"][pidx]
+                if not slices:
+                    return
+                with timed(self.metrics):
+                    b = concat_batches(slices)
+                self.metrics.num_output_rows += int(b.num_rows)
+                self.metrics.num_output_batches += 1
+                yield b
+
+        return [reader(p) for p in range(n_parts)]

@@ -177,9 +177,29 @@ def _translate_call(callable_obj: Any, receiver: Any,
 
 # BINARY_OP oparg -> builder (CPython Include/opcode_ids / _operator docs).
 # In-place variants (oparg >= 13) reuse the same semantics.
+def _resolve_all(e: ir.Expression) -> None:
+    for c in e.children:
+        _resolve_all(c)
+    if e.dtype is None:
+        e.resolve()
+
+
 def _floordiv(a: ir.Expression, b: ir.Expression) -> ir.Expression:
-    # Python // floors; Spark's IntegralDivide truncates toward zero, so
-    # build floor(a / b) instead (Divide promotes to double).
+    # Python // floors. For integer operands stay in the integer domain:
+    # a - pmod(a, b) is exactly divisible by b (Python % == Spark pmod for
+    # all sign combos), so IntegralDivide's truncation is exact and values
+    # beyond 2^53 are not corrupted by a float64 round-trip. Overflow at
+    # INT64_MIN-adjacent inputs wraps like Spark arithmetic does.
+    try:
+        _resolve_all(a)
+        _resolve_all(b)
+        int_int = a.dtype is not None and b.dtype is not None and \
+            a.dtype.is_integral and b.dtype.is_integral
+    except Exception:
+        int_int = False
+    if int_int:
+        return ir.IntegralDivide(ir.Subtract(a, ir.Pmod(a, b)), b)
+    # float operands: Python returns the floored float
     return ir.Floor(ir.Divide(a, b))
 
 

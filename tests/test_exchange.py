@@ -1,0 +1,252 @@
+"""Shuffle exchange + partitioning + join-strategy tests.
+
+Reference analogs: GpuPartitioningSuite, repartition integration tests,
+and the join-strategy selection Spark performs above the plugin
+(broadcast vs shuffled hash vs nested loop vs cartesian).
+"""
+
+import numpy as np
+import pyarrow as pa
+import pytest
+
+from spark_rapids_tpu import TpuSparkSession, col, functions as F
+from spark_rapids_tpu.exec import cpu as cpux
+from spark_rapids_tpu.shuffle.serializer import (deserialize_table,
+                                                 get_codec, serialize_table)
+from tests.parity import assert_tpu_and_cpu_are_equal_collect
+from tests.data_gen import gen_df, int_key_gen, long_gen, double_gen, \
+    string_key_gen
+
+SHUF = {"spark.rapids.tpu.sql.shuffle.partitions": 4}
+NO_BCAST = {"spark.rapids.tpu.sql.autoBroadcastJoinThreshold": -1,
+            **SHUF}
+
+
+# ---------------------------------------------------------------------------
+# Serializer / codec SPI
+# ---------------------------------------------------------------------------
+
+@pytest.mark.parametrize("codec", ["none", "copy", "lz4", "zstd"])
+def test_serializer_roundtrip(codec):
+    t = pa.table({"a": [1, 2, None, 4], "s": ["x", None, "zzz", ""]})
+    data = serialize_table(t, get_codec(codec))
+    out = deserialize_table(data)
+    assert out.equals(t)
+
+
+def test_unknown_codec_raises():
+    with pytest.raises(ValueError):
+        get_codec("snappy")
+
+
+# ---------------------------------------------------------------------------
+# Repartition parity (each partitioning kind, device + host planes)
+# ---------------------------------------------------------------------------
+
+@pytest.mark.parametrize("transport", ["local", "device"])
+def test_repartition_hash_parity(transport):
+    def q(s):
+        df = gen_df(s, [int_key_gen, long_gen, string_key_gen],
+                    ["k", "v", "s"], n=100, seed=3)
+        return df.repartition(4, "k")
+    assert_tpu_and_cpu_are_equal_collect(
+        q, ignore_order=True,
+        conf={**SHUF, "spark.rapids.tpu.shuffle.transport": transport})
+
+
+@pytest.mark.parametrize("codec", ["lz4", "zstd"])
+def test_repartition_codec_parity(codec):
+    def q(s):
+        df = gen_df(s, [int_key_gen, long_gen], ["k", "v"], n=80, seed=4)
+        return df.repartition(3, "k")
+    assert_tpu_and_cpu_are_equal_collect(
+        q, ignore_order=True,
+        conf={**SHUF, "spark.rapids.tpu.shuffle.transport": "local",
+              "spark.rapids.tpu.shuffle.compression.codec": codec})
+
+
+def test_repartition_roundrobin_parity():
+    def q(s):
+        df = gen_df(s, [int_key_gen, long_gen], ["k", "v"], n=50, seed=5)
+        return df.repartition(5)
+    assert_tpu_and_cpu_are_equal_collect(q, ignore_order=True, conf=SHUF)
+
+
+def test_repartition_range_parity():
+    def q(s):
+        df = gen_df(s, [int_key_gen, double_gen], ["k", "v"], n=90, seed=6)
+        return df.repartition_by_range(4, "k")
+    assert_tpu_and_cpu_are_equal_collect(q, ignore_order=True, conf=SHUF)
+
+
+def test_coalesce_single_parity():
+    def q(s):
+        df = gen_df(s, [int_key_gen, long_gen], ["k", "v"], n=30, seed=7)
+        return df.coalesce(1)
+    assert_tpu_and_cpu_are_equal_collect(q, ignore_order=True, conf=SHUF)
+
+
+# ---------------------------------------------------------------------------
+# Partitioning properties (key co-location, ordered ranges)
+# ---------------------------------------------------------------------------
+
+def _partition_tables(session, df):
+    res = session._plan_physical(df.plan)
+    return [list(it) for it in res.plan.execute()]
+
+
+def test_hash_partition_colocation():
+    s = TpuSparkSession(SHUF)
+    df = gen_df(s, [int_key_gen, long_gen], ["k", "v"], n=120, seed=8)
+    parts = _partition_tables(s, df.repartition(4, "k"))
+    assert len(parts) == 4
+    seen = {}
+    total = 0
+    for pidx, tables in enumerate(parts):
+        for t in tables:
+            total += t.num_rows
+            for k in t.column("k").to_pylist():
+                if k in seen:
+                    assert seen[k] == pidx, \
+                        f"key {k} split across partitions"
+                seen[k] = pidx
+    assert total == 120
+
+
+def test_range_partition_ordering():
+    s = TpuSparkSession(SHUF)
+    df = gen_df(s, [int_key_gen, long_gen], ["k", "v"], n=100, seed=9)
+    parts = _partition_tables(s, df.repartition_by_range(4, "k"))
+    prev_max = None
+    seen_parts = {}
+    for pidx, tables in enumerate(parts):
+        vals = [k for t in tables for k in t.column("k").to_pylist()]
+        for k in vals:
+            if k in seen_parts:
+                assert seen_parts[k] == pidx
+            seen_parts[k] = pidx
+        # nulls sort first (ascending default) and land in the lowest
+        # occupied partition; drop them from the numeric range check
+        vals = [k for k in vals if k is not None]
+        if not vals:
+            continue
+        if prev_max is not None:
+            assert min(vals) >= prev_max, \
+                f"partition {pidx} overlaps previous range"
+        prev_max = max(vals)
+
+
+# ---------------------------------------------------------------------------
+# Join strategies
+# ---------------------------------------------------------------------------
+
+@pytest.mark.parametrize("how", ["inner", "left", "right", "full",
+                                 "semi", "anti"])
+def test_shuffled_join_parity(how):
+    def q(s):
+        l = gen_df(s, [int_key_gen, long_gen], ["k", "lv"], n=70, seed=11)
+        r = (gen_df(s, [int_key_gen, long_gen], ["j", "rv"], n=50, seed=12)
+             .select(col("j").alias("k"), "rv"))
+        return l.join(r, on="k", how=how)
+    assert_tpu_and_cpu_are_equal_collect(q, ignore_order=True,
+                                         conf=NO_BCAST)
+
+
+@pytest.mark.parametrize("how", ["inner", "left", "semi", "anti"])
+def test_broadcast_join_parity(how):
+    def q(s):
+        l = gen_df(s, [int_key_gen, long_gen], ["k", "lv"], n=70, seed=13)
+        r = (gen_df(s, [int_key_gen, long_gen], ["j", "rv"], n=20, seed=14)
+             .select(col("j").alias("k"), "rv"))
+        return l.join(F.broadcast(r), on="k", how=how)
+    assert_tpu_and_cpu_are_equal_collect(q, ignore_order=True, conf=SHUF)
+
+
+def test_broadcast_left_right_outer():
+    # right outer can only build left
+    def q(s):
+        l = gen_df(s, [int_key_gen, long_gen], ["k", "lv"], n=20, seed=15)
+        r = (gen_df(s, [int_key_gen, long_gen], ["j", "rv"], n=60, seed=16)
+             .select(col("j").alias("k"), "rv"))
+        return F.broadcast(l).join(r, on="k", how="right")
+    assert_tpu_and_cpu_are_equal_collect(q, ignore_order=True, conf=SHUF)
+
+
+def test_cartesian_parity():
+    def q(s):
+        l = gen_df(s, [int_key_gen], ["a"], n=15, seed=17)
+        r = gen_df(s, [int_key_gen], ["b"], n=11, seed=18)
+        return l.join(r, how="cross")
+    assert_tpu_and_cpu_are_equal_collect(q, ignore_order=True,
+                                         conf=NO_BCAST)
+
+
+def test_join_strategy_selection():
+    from spark_rapids_tpu.plan import planner
+    from spark_rapids_tpu.config import RapidsTpuConf
+    import spark_rapids_tpu.plan.logical as lp
+
+    s = TpuSparkSession(SHUF)
+    big = s.create_dataframe(
+        pa.table({"k": list(range(100)), "v": list(range(100))}))
+    small = s.create_dataframe(pa.table({"k": [1, 2], "w": [7, 8]}))
+
+    conf = RapidsTpuConf(SHUF)
+    p = planner.plan_cpu(big.join(small, on="k").plan, conf)
+    assert isinstance(p, cpux.CpuBroadcastHashJoinExec)
+    assert p.build_side == "right"
+
+    conf_nb = RapidsTpuConf(NO_BCAST)
+    p = planner.plan_cpu(big.join(small, on="k").plan, conf_nb)
+    assert isinstance(p, cpux.CpuShuffledHashJoinExec)
+    from spark_rapids_tpu.shuffle.exchange import CpuShuffleExchangeExec
+    assert isinstance(p.children[0], CpuShuffleExchangeExec)
+
+    # full outer never broadcasts
+    p = planner.plan_cpu(big.join(small, on="k", how="full").plan, conf)
+    assert isinstance(p, cpux.CpuShuffledHashJoinExec)
+
+    # cross: small side broadcast -> BNLJ; disabled -> cartesian
+    p = planner.plan_cpu(big.join(small, how="cross").plan, conf)
+    assert isinstance(p, cpux.CpuBroadcastNestedLoopJoinExec)
+    p = planner.plan_cpu(big.join(small, how="cross").plan, conf_nb)
+    assert isinstance(p, cpux.CpuCartesianProductExec)
+
+
+def test_mismatched_key_types_shuffled():
+    def q(s):
+        l = s.create_dataframe(pa.table(
+            {"k": pa.array([1, 2, 3, 4, None], type=pa.int32()),
+             "v": [1.0, 2.0, 3.0, 4.0, 5.0]}))
+        r = s.create_dataframe(pa.table(
+            {"k": pa.array([2, 3, 5, None], type=pa.int64()),
+             "w": ["a", "b", "c", "d"]}))
+        return l.join(r, on="k", how="full")
+    assert_tpu_and_cpu_are_equal_collect(q, ignore_order=True,
+                                         conf=NO_BCAST)
+
+
+def test_exchange_runs_on_tpu():
+    """Exchange + partitioned join must actually convert to TPU execs."""
+    from tests.parity import collect_plans
+    s = TpuSparkSession(NO_BCAST)
+    captured = collect_plans(s)
+    l = gen_df(s, [int_key_gen, long_gen], ["k", "lv"], n=40, seed=19)
+    r = (gen_df(s, [int_key_gen, long_gen], ["j", "rv"], n=30, seed=20)
+         .select(col("j").alias("k"), "rv"))
+    out = l.join(r, on="k").collect()
+    assert out.num_rows > 0
+    names = []
+    captured[-1].plan.foreach(lambda n: names.append(type(n).__name__))
+    assert "TpuShuffledHashJoinExec" in names, names
+    assert "TpuShuffleExchangeExec" in names, names
+
+    captured2 = collect_plans(TpuSparkSession(SHUF))
+    s2 = TpuSparkSession(SHUF)
+    captured2 = collect_plans(s2)
+    l2 = s2.create_dataframe(pa.table({"k": [1, 2], "v": [10, 20]}))
+    r2 = s2.create_dataframe(pa.table({"k": [2, 3], "w": [1, 2]}))
+    l2.join(r2, on="k").collect()
+    names2 = []
+    captured2[-1].plan.foreach(lambda n: names2.append(type(n).__name__))
+    assert "TpuBroadcastHashJoinExec" in names2, names2

@@ -55,7 +55,8 @@ def test_join_runs_on_tpu(session):
     l.join(r, on="k").collect()
     names = []
     captured[-1].plan.foreach(lambda n: names.append(type(n).__name__))
-    assert "TpuShuffledHashJoinExec" in names, names
+    # tiny right side -> broadcast hash join strategy
+    assert "TpuBroadcastHashJoinExec" in names, names
     l.join(r, how="cross").collect()
     names = []
     captured[-1].plan.foreach(lambda n: names.append(type(n).__name__))
