@@ -25,6 +25,13 @@ class Column:
         self.expr = expr
 
     # naming ---------------------------------------------------------------
+    def getItem(self, key) -> "Column":
+        """col[key]: array ordinal (0-based) or map key lookup."""
+        return Column(ir.GetItem(self.expr, _to_expr(key)))
+
+    get_item = getItem
+    __getitem__ = getItem
+
     def alias(self, name: str) -> "Column":
         return Column(ir.Alias(self.expr, name))
 

@@ -33,6 +33,41 @@ class DataFrame:
     # -- transformations ---------------------------------------------------
     def select(self, *cols) -> "DataFrame":
         exprs = [_as_expr(c) for c in cols]
+
+        # generators (explode/posexplode) compute in a Generate node below
+        # the projection (the Spark analyzer's ExtractGenerator role)
+        gens = [e for e in exprs
+                if isinstance(e, ir.Generator) or
+                (isinstance(e, ir.Alias) and
+                 isinstance(e.children[0], ir.Generator))]
+        if len(gens) > 1:
+            raise ValueError("only one generator (explode/posexplode) is "
+                             "allowed per select")
+        if gens and any(ir.collect(e, lambda n: isinstance(
+                n, ir.WindowExpression)) for e in exprs):
+            raise ValueError("a generator and a window expression cannot "
+                             "share one select; explode first, then window "
+                             "over the result")
+        if gens:
+            g = gens[0]
+            alias = None
+            if isinstance(g, ir.Alias):
+                alias, g = g.alias, g.children[0]
+            if isinstance(g, ir.PosExplode):
+                out_names = ["pos", alias or "col"]
+            else:
+                out_names = [alias or "col"]
+            child = lp.Generate(self.plan, g, out_names)
+            plain = []
+            for e in exprs:
+                inner = e.children[0] if isinstance(e, ir.Alias) else e
+                if inner is g:
+                    for n in out_names:
+                        plain.append(ir.UnresolvedAttribute(n))
+                else:
+                    plain.append(e)
+            return DataFrame(lp.Project(child, plain), self.session)
+
         # window expressions compute in a Window node below the projection
         wins: List[ir.WindowExpression] = []
 
@@ -158,13 +193,11 @@ class DataFrame:
     repartitionByRange = repartition_by_range
 
     def coalesce(self, num_partitions: int) -> "DataFrame":
-        """Reduce the partition count without a full shuffle
-        (GpuCoalesceExec analog; single exchange when n == 1)."""
-        if num_partitions == 1:
-            return DataFrame(lp.Repartition(self.plan, "single", 1),
-                             self.session)
-        return DataFrame(lp.Repartition(self.plan, "roundrobin",
-                                        num_partitions), self.session)
+        """Reduce the partition count by merging contiguous partitions —
+        no shuffle, and never increases the count (GpuCoalesceExec analog,
+        reference: basicPhysicalOperators.scala:346)."""
+        return DataFrame(lp.CoalescePartitions(self.plan, num_partitions),
+                         self.session)
 
     def distinct(self) -> "DataFrame":
         names = self.plan.schema.names

@@ -297,3 +297,43 @@ def broadcast(df):
     out = df.__class__(df.plan, df.session)
     out._broadcast_hint = True
     return out
+
+
+# -- complex types -----------------------------------------------------------
+
+def explode(c) -> Column:
+    return Column(ir.Explode(_c(c)))
+
+
+def explode_outer(c) -> Column:
+    return Column(ir.Explode(_c(c), outer=True))
+
+
+def posexplode(c) -> Column:
+    return Column(ir.PosExplode(_c(c)))
+
+
+def posexplode_outer(c) -> Column:
+    return Column(ir.PosExplode(_c(c), outer=True))
+
+
+def size(c) -> Column:
+    return Column(ir.Size(_c(c)))
+
+
+def array(*cols) -> Column:
+    return Column(ir.CreateArray(*[_c(c) for c in cols]))
+
+
+def array_contains(c, value) -> Column:
+    return Column(ir.ArrayContains(_c(c), _to_expr(value)))
+
+
+def sort_array(c, asc: bool = True) -> Column:
+    return Column(ir.SortArray(_c(c), asc))
+
+
+def element_at(c, extraction) -> Column:
+    """Arrays: 1-based index, negative counts from the end (Spark
+    element_at); maps: key lookup."""
+    return Column(ir.ElementAt(_c(c), _to_expr(extraction)))

@@ -57,27 +57,36 @@ def _bucket_strlen(n: int) -> int:
 @jax.tree_util.register_pytree_node_class
 @dataclass(frozen=True)
 class DeviceColumn:
-    """One column: device buffers + validity. Analog of GpuColumnVector."""
+    """One column: device buffers + validity. Analog of GpuColumnVector.
+
+    STRING and LIST share the var-len layout: a padded 2-D payload
+    ``[capacity, max_len]`` + per-row ``lengths``; LIST additionally
+    carries ``elem_validity`` (null elements inside a list)."""
 
     dtype: dt.DType
-    data: jnp.ndarray              # [capacity] or [capacity, max_len] for string
+    data: jnp.ndarray              # [capacity] or [capacity, max_len]
     validity: jnp.ndarray          # bool [capacity]
-    lengths: Optional[jnp.ndarray] = None  # int32 [capacity], strings only
+    lengths: Optional[jnp.ndarray] = None  # int32 [capacity], string/list
+    elem_validity: Optional[jnp.ndarray] = None  # bool [cap, max_len], list
 
     # -- pytree protocol so columns/batches can cross jit boundaries --------
     def tree_flatten(self):
-        if self.lengths is None:
-            return (self.data, self.validity), (self.dtype, False)
-        return (self.data, self.validity, self.lengths), (self.dtype, True)
+        leaves = [self.data, self.validity]
+        if self.lengths is not None:
+            leaves.append(self.lengths)
+        if self.elem_validity is not None:
+            leaves.append(self.elem_validity)
+        return tuple(leaves), (self.dtype, self.lengths is not None,
+                               self.elem_validity is not None)
 
     @classmethod
     def tree_unflatten(cls, aux, children):
-        dtype, has_len = aux
-        if has_len:
-            data, validity, lengths = children
-            return cls(dtype, data, validity, lengths)
-        data, validity = children
-        return cls(dtype, data, validity, None)
+        dtype, has_len, has_ev = aux
+        it = iter(children)
+        data, validity = next(it), next(it)
+        lengths = next(it) if has_len else None
+        ev = next(it) if has_ev else None
+        return cls(dtype, data, validity, lengths, ev)
 
     @property
     def capacity(self) -> int:
@@ -85,13 +94,15 @@ class DeviceColumn:
 
     @property
     def max_len(self) -> int:
-        assert self.dtype.is_string
+        assert self.dtype.has_lengths
         return int(self.data.shape[1])
 
     def nbytes(self) -> int:
         n = self.data.size * self.data.dtype.itemsize + self.validity.size
         if self.lengths is not None:
             n += self.lengths.size * 4
+        if self.elem_validity is not None:
+            n += self.elem_validity.size
         return int(n)
 
     def gather(self, indices: jnp.ndarray, valid: jnp.ndarray) -> "DeviceColumn":
@@ -99,12 +110,16 @@ class DeviceColumn:
         data = jnp.take(self.data, indices, axis=0)
         validity = jnp.take(self.validity, indices, axis=0) & valid
         lengths = None
+        ev = None
         if self.lengths is not None:
             lengths = jnp.where(valid, jnp.take(self.lengths, indices), 0)
             data = jnp.where(valid[:, None], data, 0)
         else:
             data = jnp.where(_bcast(valid, data), data, 0)
-        return DeviceColumn(self.dtype, data, validity, lengths)
+        if self.elem_validity is not None:
+            ev = jnp.take(self.elem_validity, indices, axis=0) & \
+                valid[:, None]
+        return DeviceColumn(self.dtype, data, validity, lengths, ev)
 
 
 def _bcast(mask: jnp.ndarray, like: jnp.ndarray) -> jnp.ndarray:
@@ -166,9 +181,9 @@ class DeviceBatch:
     def schema_key(self) -> Tuple:
         """Hashable (schema, shape-bucket) key — the XLA compile-cache key."""
         return (tuple(self.names),
-                tuple(c.dtype.id for c in self.columns),
+                tuple(c.dtype.name for c in self.columns),
                 self._capacity,
-                tuple(c.max_len if c.dtype.is_string else 0
+                tuple(c.max_len if c.dtype.has_lengths else 0
                       for c in self.columns))
 
     def column(self, name: str) -> DeviceColumn:
@@ -203,12 +218,35 @@ class DeviceBatch:
 
 def _np_column_from_arrow(arr: pa.ChunkedArray | pa.Array,
                           dtype: dt.DType, capacity: int
-                          ) -> Tuple[np.ndarray, np.ndarray, Optional[np.ndarray]]:
+                          ) -> Tuple[np.ndarray, np.ndarray,
+                                     Optional[np.ndarray],
+                                     Optional[np.ndarray]]:
     if isinstance(arr, pa.ChunkedArray):
         arr = arr.combine_chunks()
     n = len(arr)
     validity = np.zeros(capacity, dtype=np.bool_)
     validity[:n] = ~np.asarray(arr.is_null())
+
+    if dtype.is_list:
+        # padded [capacity, max_len] element payload + lengths + element
+        # validity (the device mirror of Arrow's offsets+values+nulls)
+        py = arr.to_pylist()
+        lens = [len(v) if v is not None else 0 for v in py]
+        max_len = _bucket_strlen(max(lens, default=0))
+        el_np = dtype.element.to_np()
+        data = np.zeros((capacity, max_len), dtype=el_np)
+        ev = np.zeros((capacity, max_len), dtype=np.bool_)
+        lengths = np.zeros(capacity, dtype=np.int32)
+        for i, v in enumerate(py):
+            if v is None:
+                continue
+            lengths[i] = len(v)
+            for j, x in enumerate(v):
+                if x is None:
+                    continue  # null element: ev stays False, data stays 0
+                ev[i, j] = True
+                data[i, j] = x
+        return data, validity, lengths, ev
 
     if dtype.is_string:
         py = arr.to_pylist()
@@ -223,7 +261,7 @@ def _np_column_from_arrow(arr: pa.ChunkedArray | pa.Array,
             lengths[i] = len(b)
             if b:
                 data[i, :len(b)] = np.frombuffer(b, dtype=np.uint8)
-        return data, validity, lengths
+        return data, validity, lengths, None
 
     np_dtype = dtype.to_np()
     data = np.zeros(capacity, dtype=np_dtype)
@@ -241,7 +279,7 @@ def _np_column_from_arrow(arr: pa.ChunkedArray | pa.Array,
     else:
         vals = arr.fill_null(_zero_value(dtype)).to_numpy(zero_copy_only=False)
         data[:n] = vals.astype(np_dtype, copy=False)
-    return data, validity, None
+    return data, validity, None, None
 
 
 def _zero_value(dtype: dt.DType):
@@ -265,13 +303,14 @@ def from_arrow(table: pa.Table, min_bucket: int = 16,
                             f"for column {field_.name}")
         if dtype == dt.NULL:
             dtype = dt.BOOL  # void columns materialize as all-null bool
-        data, validity, lengths = _np_column_from_arrow(col, dtype, cap)
+        data, validity, lengths, ev = _np_column_from_arrow(col, dtype, cap)
         names.append(field_.name)
         cols.append(DeviceColumn(
             dtype,
             jnp.asarray(data),
             jnp.asarray(validity),
-            jnp.asarray(lengths) if lengths is not None else None))
+            jnp.asarray(lengths) if lengths is not None else None,
+            jnp.asarray(ev) if ev is not None else None))
     return DeviceBatch(names, cols, n)
 
 
@@ -293,6 +332,20 @@ def to_arrow(batch: DeviceBatch) -> pa.Table:
                     py.append(bytes(data[i, :lengths[i]]).decode(
                         "utf-8", errors="replace"))
             arr = pa.array(py, type=pa.string())
+        elif col.dtype.is_list:
+            data = np.asarray(col.data[:n])
+            lengths = np.asarray(col.lengths[:n])
+            ev = np.asarray(col.elem_validity[:n]) \
+                if col.elem_validity is not None else \
+                np.ones(data.shape, dtype=bool)
+            py = []
+            for i in range(n):
+                if not validity[i]:
+                    py.append(None)
+                else:
+                    py.append([data[i, j].item() if ev[i, j] else None
+                               for j in range(lengths[i])])
+            arr = pa.array(py, type=col.dtype.to_arrow())
         elif col.dtype.id == dt.TypeId.TIMESTAMP_US:
             ints = np.asarray(col.data[:n]).astype("datetime64[us]")
             arr = pa.array(ints, type=pa.timestamp("us", tz="UTC"),
@@ -320,22 +373,38 @@ def concat_batches(batches: Sequence[DeviceBatch],
     out_cols: List[DeviceColumn] = []
     for ci, name in enumerate(names):
         dtype = batches[0].columns[ci].dtype
-        if dtype.is_string:
+        if dtype.has_lengths:
             max_len = max(b.columns[ci].max_len for b in batches)
-            datas, vals, lens = [], [], []
+            has_ev = any(b.columns[ci].elem_validity is not None
+                         for b in batches)
+            datas, vals, lens, evs = [], [], [], []
             for b in batches:
                 c = b.columns[ci]
-                d = c.data[:int(b.num_rows)]
+                nb = int(b.num_rows)
+                d = c.data[:nb]
                 if c.max_len < max_len:
                     d = jnp.pad(d, ((0, 0), (0, max_len - c.max_len)))
                 datas.append(d)
-                vals.append(c.validity[:int(b.num_rows)])
-                lens.append(c.lengths[:int(b.num_rows)])
+                vals.append(c.validity[:nb])
+                lens.append(c.lengths[:nb])
+                if has_ev:
+                    e = c.elem_validity if c.elem_validity is not None \
+                        else jnp.ones((c.capacity, c.max_len),
+                                      dtype=jnp.bool_)
+                    e = e[:nb]
+                    if c.max_len < max_len:
+                        e = jnp.pad(e, ((0, 0), (0, max_len - c.max_len)))
+                    evs.append(e)
             data = jnp.concatenate(datas, axis=0)
             data = jnp.pad(data, ((0, cap - total), (0, 0)))
             validity = jnp.pad(jnp.concatenate(vals), (0, cap - total))
             lengths = jnp.pad(jnp.concatenate(lens), (0, cap - total))
-            out_cols.append(DeviceColumn(dtype, data, validity, lengths))
+            ev = None
+            if has_ev:
+                ev = jnp.pad(jnp.concatenate(evs, axis=0),
+                             ((0, cap - total), (0, 0)))
+            out_cols.append(DeviceColumn(dtype, data, validity, lengths,
+                                         ev))
         else:
             data = jnp.concatenate([b.columns[ci].data[:int(b.num_rows)]
                                     for b in batches])

@@ -36,15 +36,24 @@ class TypeId(enum.Enum):
     STRING = "string"
     DATE32 = "date"
     TIMESTAMP_US = "timestamp"
+    LIST = "array"
+    MAP = "map"
     NULL = "void"
 
 
 @dataclass(frozen=True)
 class DType:
     id: TypeId
+    # element type for LIST; (key, value) live in element/value for MAP
+    element: Optional["DType"] = None
+    value: Optional["DType"] = None
 
     @property
     def name(self) -> str:
+        if self.id == TypeId.LIST:
+            return f"array<{self.element.name}>"
+        if self.id == TypeId.MAP:
+            return f"map<{self.element.name},{self.value.name}>"
         return self.id.value
 
     # -- classification -----------------------------------------------------
@@ -73,22 +82,58 @@ class DType:
     def is_bool(self) -> bool:
         return self.id == TypeId.BOOL
 
+    @property
+    def is_list(self) -> bool:
+        return self.id == TypeId.LIST
+
+    @property
+    def is_map(self) -> bool:
+        return self.id == TypeId.MAP
+
+    @property
+    def is_nested(self) -> bool:
+        return self.id in (TypeId.LIST, TypeId.MAP)
+
+    @property
+    def has_lengths(self) -> bool:
+        """Device layout uses (2-D padded payload, per-row lengths)."""
+        return self.id == TypeId.STRING or self.id == TypeId.LIST
+
     # -- physical mapping ----------------------------------------------------
     def to_np(self) -> np.dtype:
-        """Numpy/JAX physical dtype of the data buffer."""
+        """Numpy/JAX physical dtype of the data buffer (the padded element
+        payload for STRING/LIST)."""
+        if self.id == TypeId.LIST:
+            return self.element.to_np()
         return _NP_MAP[self.id]
 
     def to_arrow(self) -> pa.DataType:
+        if self.id == TypeId.LIST:
+            return pa.list_(self.element.to_arrow())
+        if self.id == TypeId.MAP:
+            return pa.map_(self.element.to_arrow(), self.value.to_arrow())
         return _ARROW_MAP[self.id]
 
     @property
     def byte_width(self) -> int:
         if self.id == TypeId.STRING:
             return 16  # planning estimate; actual is data-dependent
+        if self.id == TypeId.LIST:
+            return self.element.byte_width * 8
+        if self.id == TypeId.MAP:
+            return (self.element.byte_width + self.value.byte_width) * 8
         return _NP_MAP[self.id].itemsize
 
     def __repr__(self) -> str:
-        return f"DType({self.id.value})"
+        return f"DType({self.name})"
+
+
+def list_of(element: DType) -> DType:
+    return DType(TypeId.LIST, element=element)
+
+
+def map_of(key: DType, value: DType) -> DType:
+    return DType(TypeId.MAP, element=key, value=value)
 
 
 BOOL = DType(TypeId.BOOL)
@@ -166,7 +211,30 @@ def from_arrow(t: pa.DataType) -> Optional[DType]:
         return None  # non-UTC / non-us timestamps unsupported (UTC-only rule)
     if pa.types.is_null(t):
         return NULL
+    if pa.types.is_list(t) or pa.types.is_large_list(t):
+        el = from_arrow(t.value_type)
+        if el is None or el.is_nested:
+            return None  # only one nesting level (reference rejects deeper)
+        return list_of(el)
+    if pa.types.is_map(t):
+        k = from_arrow(t.key_type)
+        v = from_arrow(t.item_type)
+        if k is None or v is None or k.is_nested or v.is_nested:
+            return None
+        return map_of(k, v)
     return None
+
+
+def device_supported(d: DType) -> bool:
+    """Can this dtype live in a DeviceBatch?  Lists of fixed-width
+    primitives share the string layout (padded 2-D payload + lengths);
+    lists of strings and maps are host-only (CPU fallback)."""
+    if d.is_map:
+        return False
+    if d.is_list:
+        return d.element is not None and (d.element.is_numeric or
+                                          d.element.is_bool)
+    return d in ALL_TYPES
 
 
 # numeric promotion ladder for binary arithmetic (Spark's semantics)

@@ -268,11 +268,47 @@ class CpuHashAggregateExec(PhysicalPlan):
             names.append(f"__a{i}")
         return pa.Table.from_arrays(arrays, names=names)
 
+    @staticmethod
+    def _hashable(v):
+        """Nested value -> hashable group key (NaN==NaN, -0.0==0.0)."""
+        if isinstance(v, list):
+            return tuple(CpuHashAggregateExec._hashable(x) for x in v)
+        if isinstance(v, tuple):
+            return tuple(CpuHashAggregateExec._hashable(x) for x in v)
+        if isinstance(v, float):
+            if v != v:
+                return "__NaN__"
+            if v == 0.0:
+                return 0.0
+        return v
+
     def execute(self):
         def run():
             t = _gather_single(self.children[0], self.children[0].schema)
             proj = self._agg_arrays(t)
             key_names = [f"__k{i}" for i in range(len(self.groupings))]
+
+            # arrow group_by cannot key on nested types; substitute a dense
+            # surrogate id per distinct nested value, map back afterwards
+            # (Spark supports grouping on arrays)
+            nested_originals = {}
+            for i, g in enumerate(self.groupings):
+                if g.dtype is None or not g.dtype.is_nested:
+                    continue
+                cname = f"__k{i}"
+                arr = proj.column(cname)
+                py = arr.to_pylist()
+                seen, originals = {}, []
+                sur = np.empty(len(py), dtype=np.int64)
+                for r, v in enumerate(py):
+                    k = self._hashable(v)
+                    if k not in seen:
+                        seen[k] = len(seen)
+                        originals.append(v)
+                    sur[r] = seen[k]
+                proj = proj.set_column(
+                    proj.column_names.index(cname), cname, pa.array(sur))
+                nested_originals[i] = (originals, arr.type)
             aggs = []
             out_names_in_result = []
             count_modes = {}
@@ -327,7 +363,16 @@ class CpuHashAggregateExec(PhysicalPlan):
             # assemble final output: keys then aggs with target dtypes
             out_arrays = []
             for i in range(len(self.groupings)):
-                out_arrays.append(res.column(f"__k{i}") if key_names else None)
+                if not key_names:
+                    out_arrays.append(None)
+                    continue
+                kcol = res.column(f"__k{i}")
+                if i in nested_originals:
+                    originals, ktype = nested_originals[i]
+                    ids = kcol.to_pylist()
+                    kcol = pa.chunked_array([pa.array(
+                        [originals[s] for s in ids], type=ktype)])
+                out_arrays.append(kcol)
             for i, a in enumerate(self.aggregates):
                 col = res.column(out_names_in_result[i])
                 tgt = self._schema.fields[len(self.groupings) + i].dtype

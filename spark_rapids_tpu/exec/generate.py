@@ -1,0 +1,177 @@
+"""Generate execs: explode/posexplode over array columns.
+
+Reference analog: ``GpuGenerateExec`` (reference: GpuGenerateExec.scala:101
+— per-row list explode via cudf).  On TPU the data-dependent output size
+uses the same two-pass count-then-emit pattern as the join: per-row
+emission counts -> inclusive cumsum -> searchsorted maps each output slot
+back to its source row and element ordinal, all masked gathers at a static
+bucketed capacity.
+"""
+
+from __future__ import annotations
+
+from typing import Iterator, List, Optional
+
+import numpy as np
+import pyarrow as pa
+
+import jax
+import jax.numpy as jnp
+
+from spark_rapids_tpu import dtypes as dt
+from spark_rapids_tpu.columnar.batch import (DeviceBatch, DeviceColumn,
+                                             bucket_rows)
+from spark_rapids_tpu.exec.base import PhysicalPlan, TpuExec, timed
+from spark_rapids_tpu.expr import eval_cpu, eval_tpu, ir
+from spark_rapids_tpu.plan.logical import Schema
+
+
+class CpuGenerateExec(PhysicalPlan):
+    def __init__(self, child: PhysicalPlan, generator: ir.Generator,
+                 schema: Schema):
+        super().__init__()
+        self.children = (child,)
+        self.generator = generator
+        self._schema = schema
+
+    @property
+    def schema(self) -> Schema:
+        return self._schema
+
+    def execute(self):
+        gen = self.generator
+        outer = gen.outer
+        with_pos = isinstance(gen, ir.PosExplode)
+        el = gen.children[0].dtype.element
+
+        def run(it) -> Iterator[pa.Table]:
+            for t in it:
+                v = eval_cpu.evaluate(gen.children[0], t)
+                n = t.num_rows
+                counts = np.zeros(n, dtype=np.int64)
+                for i in range(n):
+                    c = len(v.data[i]) if v.valid[i] else 0
+                    counts[i] = max(c, 1) if outer else c
+                row_idx = np.repeat(np.arange(n), counts)
+                base = t.take(pa.array(row_idx))
+                pos: List[Optional[int]] = []
+                elems: List = []
+                for i in range(n):
+                    lst = v.data[i] if v.valid[i] else None
+                    c = len(lst) if lst is not None else 0
+                    if c == 0:
+                        if outer:
+                            pos.append(None)
+                            elems.append(None)
+                        continue
+                    for j in range(c):
+                        pos.append(j)
+                        elems.append(lst[j])
+                arrays = list(base.columns)
+                names = list(base.column_names)
+                if with_pos:
+                    arrays.append(pa.array(pos, type=pa.int32()))
+                    names.append(self._schema.names[len(names)])
+                arrays.append(pa.array(elems, type=el.to_arrow()))
+                names.append(self._schema.names[len(names)])
+                out = pa.Table.from_arrays(arrays, names=names)
+                self.metrics.num_output_rows += out.num_rows
+                yield out
+
+        return [run(it) for it in self.children[0].execute()]
+
+
+def _generate_kernel(batch: DeviceBatch, gen: ir.Generator, out_cap: int,
+                     schema: Schema, with_pos: bool, outer: bool
+                     ) -> DeviceBatch:
+    v = eval_tpu.evaluate(gen.children[0], batch)
+    counts = jnp.where(v.validity, v.lengths, 0).astype(jnp.int64)
+    if outer:
+        counts = jnp.where(batch.row_mask(), jnp.maximum(counts, 1), 0)
+    incl = jnp.cumsum(counts)
+    total = incl[-1]
+
+    k = jnp.arange(out_cap, dtype=jnp.int64)
+    r = jnp.searchsorted(incl, k, side="right")
+    r = jnp.clip(r, 0, batch.capacity - 1)
+    j = k - (jnp.take(incl, r) - jnp.take(counts, r))
+    valid_out = k < total
+
+    cols = [c.gather(r, valid_out) for c in batch.columns]
+    names = list(batch.names)
+
+    eff_len = jnp.where(v.validity, v.lengths, 0)
+    if with_pos:
+        # outer rows emitted for an empty/null array carry null pos
+        from_empty = jnp.take(eff_len, r) == 0
+        pos_valid = valid_out & ~from_empty if outer else valid_out
+        pos = jnp.where(pos_valid, j, 0).astype(jnp.int32)
+        cols.append(DeviceColumn(dt.INT32, pos, pos_valid))
+        names.append(schema.names[len(names)])
+
+    max_len = v.data.shape[1]
+    jj = jnp.clip(j, 0, max_len - 1).astype(jnp.int32)
+    elem_rows = jnp.take(v.data, r, axis=0)
+    elem = jnp.take_along_axis(elem_rows, jj[:, None], axis=1)[:, 0]
+    ev = jnp.take(v.elem_validity, r, axis=0) \
+        if v.elem_validity is not None else \
+        jnp.ones(elem_rows.shape, dtype=jnp.bool_)
+    elem_ok = jnp.take_along_axis(ev, jj[:, None], axis=1)[:, 0]
+    in_list = j < jnp.take(eff_len, r)
+    elem_valid = valid_out & in_list & elem_ok
+    el = gen.children[0].dtype.element
+    cols.append(DeviceColumn(
+        el, jnp.where(elem_valid, elem, 0).astype(el.to_np()), elem_valid))
+    names.append(schema.names[len(names)])
+    return DeviceBatch(names, cols, total)
+
+
+class TpuGenerateExec(TpuExec):
+    """Two-pass explode: count on device (one scalar sync), emit at the
+    bucketed static capacity."""
+
+    def __init__(self, child: PhysicalPlan, generator: ir.Generator,
+                 schema: Schema):
+        super().__init__()
+        self.children = (child,)
+        self.generator = generator
+        self._schema = schema
+        self._kernels = {}
+
+    @property
+    def schema(self) -> Schema:
+        return self._schema
+
+    def execute(self):
+        gen = self.generator
+        with_pos = isinstance(gen, ir.PosExplode)
+        outer = gen.outer
+
+        def count_fn(b):
+            v = eval_tpu.evaluate(gen.children[0], b)
+            counts = jnp.where(v.validity, v.lengths, 0).astype(jnp.int64)
+            if outer:
+                counts = jnp.where(b.row_mask(), jnp.maximum(counts, 1), 0)
+            return jnp.sum(counts)
+
+        def run(it) -> Iterator[DeviceBatch]:
+            for b in it:
+                ckey = ("count", b.schema_key())
+                if ckey not in self._kernels:
+                    self._kernels[ckey] = jax.jit(count_fn)
+                with timed(self.metrics):
+                    total = int(self._kernels[ckey](b))
+                out_cap = bucket_rows(total)
+                ekey = ("emit", out_cap, b.schema_key())
+                if ekey not in self._kernels:
+                    self._kernels[ekey] = jax.jit(
+                        lambda bb: _generate_kernel(
+                            bb, gen, out_cap, self._schema, with_pos,
+                            outer))
+                with timed(self.metrics):
+                    out = self._kernels[ekey](b)
+                self.metrics.num_output_rows += int(out.num_rows)
+                self.metrics.num_output_batches += 1
+                yield out
+
+        return [run(it) for it in self.children[0].execute()]

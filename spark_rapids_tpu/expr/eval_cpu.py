@@ -44,6 +44,10 @@ def evaluate(e: ir.Expression, table: pa.Table) -> CpuVal:
 
 def to_arrow_array(v: CpuVal) -> pa.Array:
     mask = ~v.valid
+    if v.dtype.is_nested:
+        py = [None if not v.valid[i] else v.data[i]
+              for i in range(len(v.data))]
+        return pa.array(py, type=v.dtype.to_arrow())
     if v.dtype.is_string:
         py = [None if mask[i] else v.data[i] for i in range(len(v.data))]
         return pa.array(py, type=pa.string())
@@ -61,6 +65,12 @@ def from_arrow_array(arr, dtype: dt.DType) -> CpuVal:
         arr = arr.combine_chunks()
     n = len(arr)
     valid = ~np.asarray(arr.is_null())
+    if dtype.is_nested:
+        py = arr.to_pylist()
+        data = np.empty(n, dtype=object)
+        for i, v in enumerate(py):
+            data[i] = v
+        return CpuVal(dtype, data, valid)
     if dtype.is_string:
         data = np.array([s if s is not None else "" for s in arr.to_pylist()],
                         dtype=object)
@@ -919,6 +929,146 @@ def _python_udf(e: "ir.PythonUDF", table):
     return CpuVal(rt, data, valid)
 
 
+
+# ---------------------------------------------------------------------------
+# complex types (reference: complexTypeExtractors.scala, collectionOps)
+# ---------------------------------------------------------------------------
+
+def _size(e: ir.Size, table):
+    v = evaluate(e.children[0], table)
+    n = len(v.data)
+    out = np.full(n, -1, dtype=np.int32)   # Spark 3.0 legacy: size(null)=-1
+    for i in range(n):
+        if v.valid[i]:
+            out[i] = len(v.data[i])
+    return CpuVal(dt.INT32, out, np.ones(n, dtype=bool))
+
+
+def _get_array_item(e: ir.GetArrayItem, table):
+    v = evaluate(e.children[0], table)
+    o = evaluate(e.children[1], table)
+    el = e.dtype
+    n = len(v.data)
+    valid = np.zeros(n, dtype=bool)
+    if el.is_string or el.is_nested:
+        data = np.empty(n, dtype=object)
+        data[:] = "" if el.is_string else None
+    else:
+        data = np.zeros(n, dtype=el.to_np())
+    for i in range(n):
+        if not (v.valid[i] and o.valid[i]):
+            continue
+        idx = int(o.data[i])
+        lst = v.data[i]
+        if 0 <= idx < len(lst) and lst[idx] is not None:
+            x = lst[idx]
+            if el.id == dt.TypeId.DATE32 and not isinstance(x, (int, np.integer)):
+                x = (np.datetime64(x, "D") - np.datetime64(0, "D")).astype(int)
+            if el.id == dt.TypeId.TIMESTAMP_US and not isinstance(x, (int, np.integer)):
+                x = (np.datetime64(x, "us") - np.datetime64(0, "us")).astype(int)
+            data[i] = x
+            valid[i] = True
+    return CpuVal(el, data, valid)
+
+
+def _get_map_value(e: ir.GetMapValue, table):
+    v = evaluate(e.children[0], table)
+    k = evaluate(e.children[1], table)
+    val_t = e.dtype
+    n = len(v.data)
+    valid = np.zeros(n, dtype=bool)
+    if val_t.is_string or val_t.is_nested:
+        data = np.empty(n, dtype=object)
+        data[:] = "" if val_t.is_string else None
+    else:
+        data = np.zeros(n, dtype=val_t.to_np())
+    for i in range(n):
+        if not (v.valid[i] and k.valid[i]):
+            continue
+        for kk, vv in (v.data[i] or []):
+            if kk == k.data[i] and vv is not None:
+                data[i] = vv
+                valid[i] = True
+                break
+    return CpuVal(val_t, data, valid)
+
+
+def _element_at(e: ir.ElementAt, table):
+    v = evaluate(e.children[0], table)
+    o = evaluate(e.children[1], table)
+    el = e.dtype
+    n = len(v.data)
+    valid = np.zeros(n, dtype=bool)
+    if el.is_string or el.is_nested:
+        data = np.empty(n, dtype=object)
+        data[:] = "" if el.is_string else None
+    else:
+        data = np.zeros(n, dtype=el.to_np())
+    for i in range(n):
+        if not (v.valid[i] and o.valid[i]):
+            continue
+        k = int(o.data[i])
+        lst = v.data[i]
+        idx = k - 1 if k > 0 else (len(lst) + k if k < 0 else -1)
+        if 0 <= idx < len(lst) and lst[idx] is not None:
+            data[i] = lst[idx]
+            valid[i] = True
+    return CpuVal(el, data, valid)
+
+
+def _array_contains(e: ir.ArrayContains, table):
+    v = evaluate(e.children[0], table)
+    x = evaluate(e.children[1], table)
+    n = len(v.data)
+    data = np.zeros(n, dtype=bool)
+    valid = np.zeros(n, dtype=bool)
+    for i in range(n):
+        if not (v.valid[i] and x.valid[i]):
+            continue
+        lst = v.data[i]
+        if x.data[i] in [y for y in lst if y is not None]:
+            data[i] = True
+            valid[i] = True
+        elif any(y is None for y in lst):
+            valid[i] = False   # 3-valued: unknown
+        else:
+            valid[i] = True
+    return CpuVal(dt.BOOL, data, valid)
+
+
+def _create_array(e: ir.CreateArray, table):
+    vals = [evaluate(c, table) for c in e.children]
+    n = table.num_rows
+    el = e.dtype.element
+    data = np.empty(n, dtype=object)
+    for i in range(n):
+        row = []
+        for v in vals:
+            if not v.valid[i]:
+                row.append(None)
+            else:
+                x = v.data[i]
+                row.append(x.item() if isinstance(x, np.generic) else x)
+        data[i] = row
+    return CpuVal(e.dtype, data, np.ones(n, dtype=bool))
+
+
+def _sort_array(e: ir.SortArray, table):
+    v = evaluate(e.children[0], table)
+    n = len(v.data)
+    data = np.empty(n, dtype=object)
+    for i in range(n):
+        if not v.valid[i]:
+            data[i] = None
+            continue
+        lst = v.data[i]
+        nulls = [x for x in lst if x is None]
+        rest = sorted([x for x in lst if x is not None],
+                      reverse=not e.ascending)
+        data[i] = (nulls + rest) if e.ascending else (rest + nulls)
+    return CpuVal(v.dtype, data, v.valid.copy())
+
+
 _DISPATCH = {
     ir.Literal: _lit,
     ir.BoundReference: _bound,
@@ -1015,4 +1165,11 @@ _DISPATCH = {
     ir.SparkPartitionID: _partition_id,
     ir.MonotonicallyIncreasingID: _monotonic_id,
     ir.Rand: _rand,
+    ir.Size: _size,
+    ir.GetArrayItem: _get_array_item,
+    ir.GetMapValue: _get_map_value,
+    ir.ArrayContains: _array_contains,
+    ir.ElementAt: _element_at,
+    ir.CreateArray: _create_array,
+    ir.SortArray: _sort_array,
 }

@@ -35,15 +35,18 @@ from spark_rapids_tpu.expr import ir
 
 @dataclass
 class ColVal:
-    """Evaluated column value: data + validity (+ lengths for strings)."""
+    """Evaluated column value: data + validity (+ lengths for string/list,
+    elem_validity for list)."""
 
     dtype: dt.DType
     data: jnp.ndarray
     validity: jnp.ndarray
     lengths: Optional[jnp.ndarray] = None
+    elem_validity: Optional[jnp.ndarray] = None
 
     def to_column(self) -> DeviceColumn:
-        return DeviceColumn(self.dtype, self.data, self.validity, self.lengths)
+        return DeviceColumn(self.dtype, self.data, self.validity,
+                            self.lengths, self.elem_validity)
 
 
 def evaluate(e: ir.Expression, batch: DeviceBatch) -> ColVal:
@@ -53,7 +56,8 @@ def evaluate(e: ir.Expression, batch: DeviceBatch) -> ColVal:
         raise NotImplementedError(f"TPU eval for {type(e).__name__}")
     v = fn(e, batch)
     # padding rows are never valid
-    v = ColVal(v.dtype, v.data, v.validity & batch.row_mask(), v.lengths)
+    v = ColVal(v.dtype, v.data, v.validity & batch.row_mask(), v.lengths,
+               v.elem_validity)
     return v
 
 
@@ -111,7 +115,7 @@ def _eval_literal(e: ir.Literal, batch: DeviceBatch) -> ColVal:
 
 def _eval_bound(e: ir.BoundReference, batch: DeviceBatch) -> ColVal:
     c = batch.columns[e.ordinal]
-    return ColVal(c.dtype, c.data, c.validity, c.lengths)
+    return ColVal(c.dtype, c.data, c.validity, c.lengths, c.elem_validity)
 
 
 def _eval_alias(e: ir.Alias, batch: DeviceBatch) -> ColVal:
@@ -1112,6 +1116,88 @@ def _eval_rand(e: ir.Rand, batch):
                   jnp.ones((batch.capacity,), dtype=jnp.bool_))
 
 
+
+
+# ---------------------------------------------------------------------------
+# complex types: list columns are (padded [cap, max_len] payload, lengths,
+# elem_validity) — the same fixed-width device layout as strings, so these
+# kernels are masked gathers/reductions XLA fuses (reference:
+# complexTypeExtractors.scala on cudf list columns)
+# ---------------------------------------------------------------------------
+
+def _eval_size(e: ir.Size, batch: DeviceBatch) -> ColVal:
+    v = evaluate(e.children[0], batch)
+    out = jnp.where(v.validity, v.lengths.astype(jnp.int32),
+                    np.int32(-1))   # Spark 3.0 legacy: size(null) = -1
+    return ColVal(dt.INT32, out,
+                  jnp.ones((batch.capacity,), dtype=jnp.bool_))
+
+
+def _eval_get_array_item(e: ir.GetArrayItem, batch: DeviceBatch) -> ColVal:
+    v = evaluate(e.children[0], batch)
+    o = evaluate(e.children[1], batch)
+    idx = o.data.astype(jnp.int32)
+    in_range = (idx >= 0) & (idx < v.lengths) & v.validity & o.validity
+    safe = jnp.clip(idx, 0, v.data.shape[1] - 1)
+    data = jnp.take_along_axis(v.data, safe[:, None], axis=1)[:, 0]
+    ev = jnp.take_along_axis(v.elem_validity, safe[:, None], axis=1)[:, 0] \
+        if v.elem_validity is not None else jnp.ones_like(in_range)
+    valid = in_range & ev
+    el = e.dtype
+    return ColVal(el, jnp.where(valid, data, 0).astype(el.to_np()), valid)
+
+
+def _eval_array_contains(e: ir.ArrayContains, batch: DeviceBatch) -> ColVal:
+    v = evaluate(e.children[0], batch)
+    x = evaluate(e.children[1], batch)
+    max_len = v.data.shape[1]
+    slot = jnp.arange(max_len)[None, :] < v.lengths[:, None]
+    ev = v.elem_validity if v.elem_validity is not None else \
+        jnp.ones(v.data.shape, dtype=jnp.bool_)
+    live = slot & ev
+    # compare in the promoted type so fractional probes never truncate
+    # (matches the CPU engine: 2.5 vs array<int> finds nothing)
+    el = v.dtype.element
+    if el != x.dtype and el.is_numeric and x.dtype.is_numeric:
+        cmp_np = dt.promote(el, x.dtype).to_np()
+    else:
+        cmp_np = v.data.dtype
+    eq = (v.data.astype(cmp_np) == x.data.astype(cmp_np)[:, None]) & live
+    found = jnp.any(eq, axis=1)
+    has_null_elem = jnp.any(slot & ~ev, axis=1)
+    valid = v.validity & x.validity & (found | ~has_null_elem)
+    return ColVal(dt.BOOL, found & v.validity & x.validity, valid)
+
+
+def _eval_element_at(e: ir.ElementAt, batch: DeviceBatch) -> ColVal:
+    v = evaluate(e.children[0], batch)
+    o = evaluate(e.children[1], batch)
+    k = o.data.astype(jnp.int32)
+    idx = jnp.where(k > 0, k - 1, v.lengths.astype(jnp.int32) + k)
+    in_range = (k != 0) & (idx >= 0) & (idx < v.lengths) & \
+        v.validity & o.validity
+    safe = jnp.clip(idx, 0, v.data.shape[1] - 1)
+    data = jnp.take_along_axis(v.data, safe[:, None], axis=1)[:, 0]
+    ev = jnp.take_along_axis(v.elem_validity, safe[:, None], axis=1)[:, 0] \
+        if v.elem_validity is not None else jnp.ones_like(in_range)
+    valid = in_range & ev
+    el = e.dtype
+    return ColVal(el, jnp.where(valid, data, 0).astype(el.to_np()), valid)
+
+
+def _eval_create_array(e: ir.CreateArray, batch: DeviceBatch) -> ColVal:
+    el = e.dtype.element
+    np_dt = el.to_np()
+    vals = [evaluate(c, batch) for c in e.children]
+    data = jnp.stack([v.data.astype(np_dt) for v in vals], axis=1)
+    ev = jnp.stack([v.validity for v in vals], axis=1)
+    n = len(vals)
+    lengths = jnp.full((batch.capacity,), n, dtype=jnp.int32)
+    return ColVal(e.dtype, data,
+                  jnp.ones((batch.capacity,), dtype=jnp.bool_),
+                  lengths, ev)
+
+
 # ---------------------------------------------------------------------------
 # dispatch table
 # ---------------------------------------------------------------------------
@@ -1120,6 +1206,11 @@ _DISPATCH = {
     ir.Literal: _eval_literal,
     ir.BoundReference: _eval_bound,
     ir.Alias: _eval_alias,
+    ir.Size: _eval_size,
+    ir.GetArrayItem: _eval_get_array_item,
+    ir.ArrayContains: _eval_array_contains,
+    ir.ElementAt: _eval_element_at,
+    ir.CreateArray: _eval_create_array,
     ir.Add: _eval_add,
     ir.Subtract: _eval_sub,
     ir.Multiply: _eval_mul,

@@ -1081,6 +1081,19 @@ def bind(e: Expression, names: Sequence[str],
                                f"{list(names)}")
             i = list(names).index(node.attr_name)
             return BoundReference(i, dtypes[i], nullables[i], node.attr_name)
+        if isinstance(node, GetItem):
+            base = node.children[0]
+            repl = GetMapValue(base, node.children[1]) \
+                if base.dtype is not None and base.dtype.is_map \
+                else GetArrayItem(base, node.children[1])
+            repl.resolve()
+            return repl
+        if isinstance(node, ElementAt):
+            base = node.children[0]
+            if base.dtype is not None and base.dtype.is_map:
+                repl = GetMapValue(base, node.children[1])
+                repl.resolve()
+                return repl
         if isinstance(node, PythonUDF) and node.try_compile:
             compiled = _try_compile_python_udf(node)
             if compiled is not None:
@@ -1128,3 +1141,139 @@ def collect(e: Expression, pred) -> List[Expression]:
 
 def has_aggregates(e: Expression) -> bool:
     return bool(collect(e, lambda n: isinstance(n, AggregateExpression)))
+
+
+# ---------------------------------------------------------------------------
+# Complex types (reference: complexTypeExtractors.scala — GetArrayItem,
+# GetMapValue; collectionOperations — Size; CreateArray; GpuGenerateExec's
+# explode/posexplode generators, GpuGenerateExec.scala:101)
+# ---------------------------------------------------------------------------
+
+class Size(UnaryExpression):
+    """size(array|map). Spark 3.0 default (legacy sizeOfNull): null -> -1."""
+
+    def resolve(self) -> None:
+        self.dtype = dt.INT32
+        self.nullable = False  # null input yields -1, not null
+
+
+class GetArrayItem(Expression):
+    """array[ordinal] (0-based); null for out-of-range / null input."""
+
+    def __init__(self, child: Expression, ordinal: Expression):
+        self.children = (child, ordinal)
+
+    def resolve(self) -> None:
+        self.dtype = self.children[0].dtype.element
+        self.nullable = True
+
+
+class GetMapValue(Expression):
+    """map[key]; null when absent. CPU-only (reference limits GPU maps to
+    string->string literal-key lookups)."""
+
+    def __init__(self, child: Expression, key: Expression):
+        self.children = (child, key)
+
+    def resolve(self) -> None:
+        self.dtype = self.children[0].dtype.value
+        self.nullable = True
+
+
+class ArrayContains(Expression):
+    """array_contains(arr, value): 3-valued like Spark (null if the value
+    is not found but the array has null elements, or inputs are null)."""
+
+    def __init__(self, child: Expression, value: Expression):
+        self.children = (child, value)
+
+    def resolve(self) -> None:
+        self.dtype = dt.BOOL
+        self.nullable = True
+
+
+class CreateArray(Expression):
+    """array(e1, e2, ...) of a common element type."""
+
+    def __init__(self, *exprs: Expression):
+        self.children = tuple(exprs)
+
+    def resolve(self) -> None:
+        dtypes = [c.dtype for c in self.children if c.dtype != dt.NULL]
+        if not dtypes:
+            el = dt.NULL
+        else:
+            el = dtypes[0]
+            for d in dtypes[1:]:
+                if d != el:
+                    el = dt.promote(el, d)
+        self.dtype = dt.list_of(el)
+        self.nullable = False
+
+
+class SortArray(Expression):
+    """sort_array(arr, asc): nulls first when ascending, last otherwise."""
+
+    def __init__(self, child: Expression, ascending: bool = True):
+        self.children = (child,)
+        self.ascending = ascending
+
+    def resolve(self) -> None:
+        self.dtype = self.children[0].dtype
+        self.nullable = self.children[0].nullable
+
+
+class ElementAt(Expression):
+    """element_at(array, i): 1-based, negative counts from the end, 0 ->
+    null (Spark raises; we stay non-ANSI-lenient).  element_at(map, key)
+    resolves to GetMapValue at bind."""
+
+    def __init__(self, child: Expression, key: Expression):
+        self.children = (child, key)
+
+    def resolve(self) -> None:
+        self.dtype = self.children[0].dtype.element
+        self.nullable = True
+
+
+class GetItem(Expression):
+    """Unresolved col[key]: bind() rewrites to GetArrayItem or GetMapValue
+    based on the child's resolved type (UnresolvedExtractValue analog)."""
+
+    def __init__(self, child: Expression, key: Expression):
+        self.children = (child, key)
+
+    def resolve(self) -> None:  # pragma: no cover - replaced at bind
+        self.dtype = None
+        self.nullable = True
+
+
+class Generator(Expression):
+    """Base for row-multiplying expressions; consumed by the Generate
+    plan node, never evaluated row-wise."""
+
+
+class Explode(Generator):
+    """explode(array): one output row per element.  ``outer`` keeps rows
+    whose array is null/empty (with a null element), matching Spark's
+    explode_outer."""
+
+    def __init__(self, child: Expression, outer: bool = False):
+        self.children = (child,)
+        self.outer = outer
+
+    def resolve(self) -> None:
+        self.dtype = self.children[0].dtype.element
+        self.nullable = True
+
+
+class PosExplode(Generator):
+    """posexplode(array): (pos, col) per element."""
+
+    def __init__(self, child: Expression, outer: bool = False):
+        self.children = (child,)
+        self.outer = outer
+
+    def resolve(self) -> None:
+        self.dtype = self.children[0].dtype.element
+        self.nullable = True

@@ -413,3 +413,52 @@ def size_estimate(node: LogicalPlan) -> int:
     if not node.children:
         return 1 << 62
     return max(size_estimate(c) for c in node.children)
+
+
+class Generate(LogicalPlan):
+    """Row-generating node for explode/posexplode (reference:
+    GpuGenerateExec.scala:101 — per-row list explode).
+
+    Output = child columns + generated columns (``pos`` first for
+    posexplode, then the element column)."""
+
+    def __init__(self, child: LogicalPlan, generator: ir.Generator,
+                 out_names: Sequence[str]):
+        self.children = (child,)
+        g = self.bind(generator)
+        if g.children[0].dtype is None or not g.children[0].dtype.is_list:
+            raise TypeError("explode/posexplode requires an array column")
+        self.generator = g
+        self.out_names = list(out_names)
+        gen_fields = []
+        if isinstance(g, ir.PosExplode):
+            gen_fields.append(Field(self.out_names[0], dt.INT32, False))
+            gen_fields.append(Field(self.out_names[1],
+                                    g.children[0].dtype.element, True))
+        else:
+            gen_fields.append(Field(self.out_names[0],
+                                    g.children[0].dtype.element, True))
+        self._schema = Schema(list(child.schema.fields) + gen_fields)
+
+    @property
+    def schema(self) -> Schema:
+        return self._schema
+
+    def simple_string(self) -> str:
+        return f"Generate({type(self.generator).__name__})"
+
+
+class CoalescePartitions(LogicalPlan):
+    """df.coalesce(n): merge contiguous partitions without a shuffle
+    (reference: GpuCoalesceExec, basicPhysicalOperators.scala:346)."""
+
+    def __init__(self, child: LogicalPlan, num_partitions: int):
+        self.children = (child,)
+        self.num_partitions = max(1, int(num_partitions))
+
+    @property
+    def schema(self) -> Schema:
+        return self.children[0].schema
+
+    def simple_string(self) -> str:
+        return f"CoalescePartitions({self.num_partitions})"

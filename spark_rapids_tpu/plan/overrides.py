@@ -157,6 +157,16 @@ def _sort_unsupported_types(n: cpux.CpuSortExec, conf) -> List[str]:
         if o.expr.dtype is not None and o.expr.dtype.is_floating and \
                 not conf.get(cfg.ENABLE_FLOAT_SORT):
             out.append("float sort disabled")
+    out.extend(_nested_key_reasons((o.expr for o in n.orders), "sort"))
+    return out
+
+
+def _nested_key_reasons(exprs, role: str) -> List[str]:
+    out = []
+    for e in exprs:
+        if e is not None and e.dtype is not None and e.dtype.is_nested:
+            out.append(f"nested type {e.dtype.name} not supported as a "
+                       f"{role} key on TPU")
     return out
 
 
@@ -203,7 +213,8 @@ register_exec_rule(cpux.CpuHashAggregateExec, ExecRule(
     "TPU hash aggregate (sort-based segmented reduction)",
     lambda n: list(n.groupings) + list(n.aggregates),
     convert=lambda n, ch, conf: TpuHashAggregateExec(
-        ch[0], n.groupings, n.aggregates, n.schema)))
+        ch[0], n.groupings, n.aggregates, n.schema),
+    extra_tag=lambda n, conf: _nested_key_reasons(n.groupings, "grouping")))
 
 register_exec_rule(cpux.CpuExpandExec, ExecRule(
     "ExpandExec", "TPU expand (N projections per row)",
@@ -214,6 +225,11 @@ register_exec_rule(cpux.CpuExpandExec, ExecRule(
 def _tag_window(n, conf) -> List[str]:
     out = []
     for we in n.window_exprs:
+        out.extend(_nested_key_reasons(we.partition_exprs,
+                                       "window partition"))
+        out.extend(_nested_key_reasons(we.order_exprs, "window order"))
+        out.extend(_nested_key_reasons(we.function.children,
+                                       "window input"))
         fn = we.function
         fr = we.frame
         finite_range = fr.kind == "range" and not (
@@ -279,6 +295,10 @@ def _tag_join(n: cpux.CpuJoinExec, conf) -> List[str]:
     if n.how != "cross" and not n.left_keys:
         out.append("non-equi join without keys requires nested loop "
                    "(only cross supported on TPU)")
+    for kd in (n.key_dtypes or []):
+        if kd is not None and kd.is_nested:
+            out.append(f"nested type {kd.name} not supported as a join "
+                       f"key on TPU")
     return out
 
 
@@ -337,6 +357,30 @@ def _register_join_strategy_rules():
 _register_join_strategy_rules()
 
 
+def _register_generate_rule():
+    from spark_rapids_tpu.exec.generate import (CpuGenerateExec,
+                                                TpuGenerateExec)
+
+    def _tag_generate(n, conf) -> List[str]:
+        out = []
+        d = n.generator.children[0].dtype
+        if d is None or not d.is_list or not dt.device_supported(d):
+            out.append(f"generator input type "
+                       f"{d.name if d else '?'} not supported on TPU")
+        return out
+
+    register_exec_rule(CpuGenerateExec, ExecRule(
+        "GenerateExec",
+        "TPU explode/posexplode (two-pass count-then-emit element gather)",
+        lambda n: list(n.generator.children),
+        convert=lambda n, ch, conf: TpuGenerateExec(ch[0], n.generator,
+                                                    n.schema),
+        extra_tag=_tag_generate))
+
+
+_register_generate_rule()
+
+
 def _tag_exchange(n, conf) -> List[str]:
     from spark_rapids_tpu.shuffle import exchange as ex
     out = []
@@ -345,11 +389,19 @@ def _tag_exchange(n, conf) -> List[str]:
             if o.expr.dtype is not None and o.expr.dtype.is_floating and \
                     not conf.get(cfg.ENABLE_FLOAT_SORT):
                 out.append("float range partitioning disabled")
+    out.extend(_nested_key_reasons(n.partitioning.exprs(), "partitioning"))
     return out
 
 
 def _register_exchange_rule():
     from spark_rapids_tpu.shuffle import exchange as ex
+
+    register_exec_rule(ex.CpuCoalescePartitionsExec, ExecRule(
+        "CoalesceExec",
+        "TPU partition coalesce (iterator regrouping, no data movement)",
+        _no_exprs,
+        convert=lambda n, ch, conf: ex.TpuCoalescePartitionsExec(
+            ch[0], n.num_partitions)))
 
     register_exec_rule(ex.CpuShuffleExchangeExec, ExecRule(
         "ShuffleExchangeExec",
@@ -407,7 +459,7 @@ class ExecMeta:
 def _supported_schema_reasons(node: PhysicalPlan) -> List[str]:
     out = []
     for f in node.schema.fields:
-        if f.dtype not in dt.ALL_TYPES:
+        if not dt.device_supported(f.dtype):
             out.append(f"unsupported type {f.dtype} for column {f.name}")
     return out
 

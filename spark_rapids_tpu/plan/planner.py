@@ -63,12 +63,21 @@ def plan_cpu(node: lp.LogicalPlan, conf: RapidsTpuConf) -> PhysicalPlan:
         else:
             part = ex.RoundRobinPartitioning(n)
         return ex.CpuShuffleExchangeExec(child, part)
+    if isinstance(node, lp.CoalescePartitions):
+        from spark_rapids_tpu.shuffle.exchange import \
+            CpuCoalescePartitionsExec
+        child = plan_cpu(node.children[0], conf)
+        return CpuCoalescePartitionsExec(child, node.num_partitions)
     if isinstance(node, lp.Range):
         return cpux.CpuRangeExec(node.start, node.end, node.step,
                                  node.num_partitions)
     if isinstance(node, lp.Expand):
         child = plan_cpu(node.children[0], conf)
         return cpux.CpuExpandExec(child, node.projections, node.schema)
+    if isinstance(node, lp.Generate):
+        from spark_rapids_tpu.exec.generate import CpuGenerateExec
+        child = plan_cpu(node.children[0], conf)
+        return CpuGenerateExec(child, node.generator, node.schema)
     if isinstance(node, lp.Window):
         from spark_rapids_tpu.exec.cpu_window import CpuWindowExec
         child = plan_cpu(node.children[0], conf)

@@ -465,3 +465,45 @@ class TpuShuffleExchangeExec(TpuExec):
                 yield b
 
         return [reader(p) for p in range(n_parts)]
+
+
+class CpuCoalescePartitionsExec(PhysicalPlan):
+    """Merge contiguous input partitions into at most n output partitions
+    by chaining their iterators — no shuffle, no data movement
+    (GpuCoalesceExec analog)."""
+
+    def __init__(self, child: PhysicalPlan, num_partitions: int):
+        super().__init__()
+        self.children = (child,)
+        self.num_partitions = num_partitions
+
+    @property
+    def schema(self) -> Schema:
+        return self.children[0].schema
+
+    def execute(self):
+        its = self.children[0].execute()
+        n = min(self.num_partitions, len(its)) or 1
+        groups = np.array_split(np.arange(len(its)), n)
+        return [itertools.chain.from_iterable(its[i] for i in g)
+                for g in groups if len(g)]
+
+
+class TpuCoalescePartitionsExec(TpuExec):
+    """Device-currency twin of CpuCoalescePartitionsExec."""
+
+    def __init__(self, child: PhysicalPlan, num_partitions: int):
+        super().__init__()
+        self.children = (child,)
+        self.num_partitions = num_partitions
+
+    @property
+    def schema(self) -> Schema:
+        return self.children[0].schema
+
+    def execute(self):
+        its = self.children[0].execute()
+        n = min(self.num_partitions, len(its)) or 1
+        groups = np.array_split(np.arange(len(its)), n)
+        return [itertools.chain.from_iterable(its[i] for i in g)
+                for g in groups if len(g)]
