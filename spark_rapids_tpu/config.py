@@ -185,8 +185,10 @@ MEM_SPILL_DIR = conf(
 SHUFFLE_TRANSPORT = conf(
     "spark.rapids.tpu.shuffle.transport", "local",
     "Shuffle transport implementation: 'local' (in-process Arrow IPC store, "
-    "the default-path analog) or 'ici' (device-resident all_to_all over a "
-    "jax Mesh; reference: shuffle-plugin UCX transport).")
+    "the default-path analog), 'device' (HBM-resident slices, one process), "
+    "'manager' (accelerated TpuShuffleManager: device-resident catalog + "
+    "tag-matched client/server transport), or 'ici' (device-resident "
+    "all_to_all over a jax Mesh; reference: shuffle-plugin UCX transport).")
 
 SHUFFLE_COMPRESSION_CODEC = conf(
     "spark.rapids.tpu.shuffle.compression.codec", "none",

@@ -1,0 +1,224 @@
+"""Shuffle buffer catalogs: device-resident map output + received buffers.
+
+Reference analog (SURVEY.md §2f): ``ShuffleBufferCatalog.scala:50-232``
+(shuffleId -> bufferIds mapping over RapidsBufferCatalog, so cached map
+output stays spillable in the device store) and
+``ShuffleReceivedBufferCatalog.scala:119`` with ``TempSpillBufferId``
+(:49) for reducer-side received buffers.
+
+Batches are held as ``SpillableBatch`` handles in the global spill
+catalog (mem/spill.py), so shuffle data competes with operator data for
+HBM under the same priority-ordered spill policy
+(INPUT_FROM_SHUFFLE_PRIORITY — spills first).
+"""
+
+from __future__ import annotations
+
+import itertools
+import threading
+from dataclasses import dataclass
+from typing import Dict, List, Optional, Tuple
+
+import pyarrow as pa
+
+from spark_rapids_tpu.columnar.batch import DeviceBatch, to_arrow
+from spark_rapids_tpu.mem import spill
+from spark_rapids_tpu.shuffle import meta as wire
+from spark_rapids_tpu.shuffle.serializer import (deserialize_table,
+                                                 get_codec, serialize_table)
+
+
+def _dtype_code(d) -> str:
+    return getattr(d, "code", str(d))
+
+
+_ARROW_TYPE_CODES = {
+    "timestamp[us]": pa.timestamp("us"),
+    "timestamp[us, tz=UTC]": pa.timestamp("us", tz="UTC"),
+    "date32[day]": pa.date32(),
+    "large_string": pa.large_string(),
+}
+
+
+def _parse_arrow_type(code: str) -> pa.DataType:
+    """Inverse of ``str(pa.DataType)`` for the types the engine supports
+    (GpuColumnVector.java:153-197 type-map analog)."""
+    if code in _ARROW_TYPE_CODES:
+        return _ARROW_TYPE_CODES[code]
+    try:
+        return pa.type_for_alias(code)
+    except ValueError:
+        if code.startswith("list<item: ") and code.endswith(">"):
+            return pa.list_(_parse_arrow_type(code[len("list<item: "):-1]))
+        raise ValueError(f"unsupported wire dtype {code!r}")
+
+
+def build_table_meta(buffer_id: int, batch_rows: int,
+                     table: pa.Table, payload_size: int,
+                     codec: int = wire.CODEC_UNCOMPRESSED,
+                     uncompressed_size: Optional[int] = None
+                     ) -> wire.TableMeta:
+    """MetaUtils.buildTableMeta analog (MetaUtils.scala:48)."""
+    cols = [wire.ColumnMeta(f.name, str(f.type), f.nullable,
+                            table.column(i).null_count)
+            for i, f in enumerate(table.schema)]
+    bm = wire.BufferMeta(buffer_id, uncompressed_size or payload_size,
+                         payload_size, codec)
+    return wire.TableMeta(batch_rows, cols, bm)
+
+
+def build_degenerate_table_meta(table: pa.Table) -> wire.TableMeta:
+    """0-row / 0-col batches ship as metadata only
+    (MetaUtils.buildDegenerateTableMeta MetaUtils.scala:145)."""
+    cols = [wire.ColumnMeta(f.name, str(f.type), f.nullable, 0)
+            for f in table.schema]
+    return wire.TableMeta(table.num_rows, cols, None)
+
+
+@dataclass
+class ShuffleBlock:
+    """One map-output slice for one reduce partition."""
+    buffer_id: int
+    shuffle_id: int
+    map_id: int
+    reduce_id: int
+    table_meta: wire.TableMeta
+    spillable: Optional[spill.SpillableBatch]   # device-resident path
+    host_table: Optional[pa.Table]              # degenerate / host fallback
+    payload: Optional[bytes] = None             # cached wire bytes
+
+
+class ShuffleBufferCatalog:
+    """Mapper-side: shuffle block registry over the spill catalog."""
+
+    def __init__(self, codec_name: str = "none"):
+        self._ids = itertools.count(1)
+        self._blocks: Dict[int, ShuffleBlock] = {}
+        self._by_shuffle: Dict[int, List[int]] = {}
+        self._lock = threading.Lock()
+        self.codec_name = codec_name
+
+    def register_batch(self, shuffle_id: int, map_id: int, reduce_id: int,
+                       batch: DeviceBatch) -> ShuffleBlock:
+        """RapidsCachingWriter.write analog
+        (RapidsShuffleInternalManager.scala:90-155): the batch stays in the
+        device store, registered spillable at shuffle priority."""
+        table = to_arrow(batch)
+        bid = next(self._ids)
+        if table.num_rows == 0 or table.num_columns == 0:
+            tm = build_degenerate_table_meta(table)
+            blk = ShuffleBlock(bid, shuffle_id, map_id, reduce_id, tm,
+                               None, table)
+        else:
+            # the wire payload is serialized once here and cached; remote
+            # fetches reuse it instead of re-encoding per request
+            payload = self.serialize_block_table(table)
+            tm = build_table_meta(bid, table.num_rows, table, len(payload),
+                                  wire.codec_id(self.codec_name)
+                                  if self.codec_name != "none"
+                                  else wire.CODEC_UNCOMPRESSED)
+            sp = None
+            if spill.is_enabled():
+                sp = spill.get_catalog().register(
+                    batch, priority=spill.INPUT_FROM_SHUFFLE_PRIORITY)
+                blk = ShuffleBlock(bid, shuffle_id, map_id, reduce_id, tm,
+                                   sp, None, payload)
+            else:
+                blk = ShuffleBlock(bid, shuffle_id, map_id, reduce_id, tm,
+                                   None, table, payload)
+        with self._lock:
+            self._blocks[bid] = blk
+            self._by_shuffle.setdefault(shuffle_id, []).append(bid)
+        return blk
+
+    def serialize_block_table(self, table: pa.Table) -> bytes:
+        return serialize_table(table, get_codec(self.codec_name))
+
+    def blocks_for(self, shuffle_id: int, reduce_id: int,
+                   map_ids: Optional[List[int]] = None) -> List[ShuffleBlock]:
+        with self._lock:
+            ids = self._by_shuffle.get(shuffle_id, [])
+            out = []
+            for bid in ids:
+                b = self._blocks[bid]
+                if b.reduce_id != reduce_id:
+                    continue
+                if map_ids and b.map_id not in map_ids:
+                    continue
+                out.append(b)
+            return out
+
+    def get_block(self, buffer_id: int) -> ShuffleBlock:
+        with self._lock:
+            return self._blocks[buffer_id]
+
+    def block_payload(self, buffer_id: int) -> bytes:
+        """Wire payload for a block: the cached bytes from registration,
+        or re-encoded from the (possibly unspilled) batch."""
+        blk = self.get_block(buffer_id)
+        if blk.payload is not None:
+            return blk.payload
+        if blk.host_table is not None:
+            return self.serialize_block_table(blk.host_table)
+        batch = blk.spillable.get()
+        return self.serialize_block_table(to_arrow(batch))
+
+    def unregister_shuffle(self, shuffle_id: int) -> None:
+        """ShuffleManager.unregisterShuffle analog — frees device store."""
+        with self._lock:
+            ids = self._by_shuffle.pop(shuffle_id, [])
+            blocks = [self._blocks.pop(b) for b in ids if b in self._blocks]
+        for b in blocks:
+            if b.spillable is not None:
+                b.spillable.close()
+
+
+@dataclass
+class ReceivedBuffer:
+    temp_id: int
+    table_meta: wire.TableMeta
+    data: bytes
+
+
+class ShuffleReceivedBufferCatalog:
+    """Reducer-side catalog of fetched buffers awaiting materialization
+    (ShuffleReceivedBufferCatalog.scala:119; temp ids TempSpillBufferId
+    :49)."""
+
+    def __init__(self):
+        self._ids = itertools.count(1)
+        self._received: Dict[int, ReceivedBuffer] = {}
+        self._lock = threading.Lock()
+
+    def add(self, table_meta: wire.TableMeta, data: bytes) -> int:
+        with self._lock:
+            tid = next(self._ids)
+            self._received[tid] = ReceivedBuffer(tid, table_meta, data)
+            return tid
+
+    def materialize(self, temp_id: int) -> pa.Table:
+        """Decode the received payload into a host table and drop it.
+        Degenerate blocks (no payload) are rebuilt from metadata alone,
+        as the reference does (MetaUtils.scala:145)."""
+        with self._lock:
+            rb = self._received.pop(temp_id)
+        if rb.table_meta.is_degenerate:
+            if not rb.table_meta.columns and rb.table_meta.num_rows:
+                # pyarrow cannot represent a zero-column table with rows;
+                # fail loudly rather than silently dropping the row count
+                raise NotImplementedError(
+                    f"zero-column block with {rb.table_meta.num_rows} rows "
+                    "cannot be materialized as a pyarrow table")
+            fields = [pa.field(c.name, _parse_arrow_type(c.dtype_code),
+                               c.nullable)
+                      for c in rb.table_meta.columns]
+            schema = pa.schema(fields)
+            return pa.table(
+                {f.name: pa.array([], type=f.type) for f in fields},
+                schema=schema)
+        return deserialize_table(rb.data)
+
+    @property
+    def pending(self) -> int:
+        with self._lock:
+            return len(self._received)

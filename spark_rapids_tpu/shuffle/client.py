@@ -1,0 +1,204 @@
+"""Accelerated-shuffle client: fetch metadata, receive buffer windows.
+
+Reference analog (SURVEY.md §2f): ``RapidsShuffleClient.scala:96-483`` —
+``doFetch`` (:196) requests TableMetas, then ``issueBufferReceives``
+(:293) walks a ``BufferReceiveState`` (BufferReceiveState.scala:222) of
+bounce-buffer windows, reassembling each block and registering it in the
+received-buffer catalog.  The state machine is driven purely by
+transaction callbacks, which is what makes it unit-testable with a fake
+transport (RapidsShuffleClientSuite pattern, SURVEY.md §4.2).
+"""
+
+from __future__ import annotations
+
+import itertools
+import threading
+from typing import Callable, List, Optional
+
+from spark_rapids_tpu.shuffle import meta as wire
+from spark_rapids_tpu.shuffle.catalogs import ShuffleReceivedBufferCatalog
+from spark_rapids_tpu.shuffle.transport import (BounceBufferManager,
+                                                ClientConnection,
+                                                InflightLimiter,
+                                                Transaction,
+                                                TransactionStatus,
+                                                WindowedBlockIterator)
+
+_tags = itertools.count(0x7100_0000)
+
+
+def _once(fn):
+    """Exactly-once completion guard for the fetch's done callback."""
+    fired = [False]
+    lock = threading.Lock()
+
+    def wrapper(arg):
+        with lock:
+            if fired[0]:
+                return
+            fired[0] = True
+        fn(arg)
+    return wrapper
+
+
+class ShuffleClientException(Exception):
+    pass
+
+
+class BufferReceiveState:
+    """Receiver side of the window stream: knows every block's wire size
+    from its TableMeta, walks the same WindowedBlockIterator as the
+    sender, and splits each received window back into per-block payloads
+    (reference: BufferReceiveState.scala:222)."""
+
+    def __init__(self, table_metas: List[wire.TableMeta], window_size: int):
+        self.table_metas = table_metas
+        self.window_size = window_size
+        sizes = [tm.buffer_meta.compressed_size for tm in table_metas]
+        self._iter = WindowedBlockIterator(sizes, window_size)
+        self._bufs = [bytearray() for _ in table_metas]
+        self._completed = [False] * len(table_metas)
+
+    def has_next(self) -> bool:
+        return self._iter.has_next()
+
+    def consume_window(self, data: bytes) -> List[int]:
+        """Feed one received window; returns indices of blocks that just
+        completed."""
+        ranges = next(self._iter)
+        expect = sum(r.range_size for r in ranges)
+        if len(data) != expect:
+            raise ShuffleClientException(
+                f"short window: got {len(data)}, expected {expect}")
+        done: List[int] = []
+        off = 0
+        for r in ranges:
+            self._bufs[r.block] += data[off:off + r.range_size]
+            off += r.range_size
+            size = self.table_metas[r.block].buffer_meta.compressed_size
+            if len(self._bufs[r.block]) == size:
+                self._completed[r.block] = True
+                done.append(r.block)
+        return done
+
+    def payload(self, block: int) -> bytes:
+        assert self._completed[block]
+        return bytes(self._bufs[block])
+
+
+class RapidsShuffleClient:
+    """Per-peer fetch driver."""
+
+    def __init__(self, connection: ClientConnection,
+                 received_catalog: ShuffleReceivedBufferCatalog,
+                 bounce_window: int = 1 << 20,
+                 recv_bounce: Optional[BounceBufferManager] = None,
+                 inflight: Optional[InflightLimiter] = None):
+        self.connection = connection
+        self.received = received_catalog
+        self.bounce_window = bounce_window
+        self.recv_bounce = recv_bounce
+        self.inflight = inflight
+
+    def do_fetch(self, shuffle_id: int, reduce_id: int,
+                 map_ids: Optional[List[int]],
+                 on_batch: Callable[[int], None],
+                 on_done: Callable[[Optional[str]], None]) -> None:
+        """Fetch all of this peer's blocks for (shuffle, reduce).
+
+        ``on_batch(temp_id)`` fires per arrived block (already in the
+        received catalog); ``on_done(error)`` fires once at the end with
+        None on success (reference: RapidsShuffleFetchHandler).
+        """
+        on_done = _once(on_done)
+        req = wire.MetadataRequest(shuffle_id, reduce_id, map_ids or [])
+
+        def on_meta(tx: Transaction) -> None:
+            if tx.status != TransactionStatus.SUCCESS:
+                on_done(f"metadata fetch failed: {tx.error_message}")
+                return
+            try:
+                resp = wire.MetadataResponse.unpack(tx.payload)
+            except Exception as e:  # malformed frame = fetch failure
+                on_done(f"bad metadata response: {e}")
+                return
+            self._issue_buffer_receives(resp.tables, on_batch, on_done)
+
+        self.connection.request(req.pack(), on_meta)
+
+    # -- phase 2: buffer receives -----------------------------------------
+    def _issue_buffer_receives(self, tables: List[wire.TableMeta],
+                               on_batch, on_done) -> None:
+        """issueBufferReceives analog (RapidsShuffleClient.scala:293)."""
+        # degenerate batches carry no payload: complete immediately
+        real: List[wire.TableMeta] = []
+        for tm in tables:
+            if tm.is_degenerate:
+                on_batch(self.received.add(tm, b""))
+            else:
+                real.append(tm)
+        if not real:
+            on_done(None)
+            return
+
+        state = BufferReceiveState(real, self.bounce_window)
+        tag = next(_tags)
+        pending: dict = {"tx": None}
+
+        def post_receive() -> None:
+            if not state.has_next():
+                on_done(None)
+                return
+            if self.inflight is not None:
+                self.inflight.acquire(self.bounce_window)
+            bounce = (self.recv_bounce.acquire() if self.recv_bounce
+                      else None)
+
+            def on_window(tx: Transaction) -> None:
+                # resources are released on EVERY completion path —
+                # success, error, or cancellation after a failed transfer
+                if bounce is not None:
+                    bounce.close()
+                if self.inflight is not None:
+                    self.inflight.release(self.bounce_window)
+                if tx.status == TransactionStatus.CANCELLED:
+                    return
+                try:
+                    if tx.status != TransactionStatus.SUCCESS:
+                        on_done(f"buffer receive failed: {tx.error_message}")
+                        return
+                    for idx in state.consume_window(tx.payload):
+                        tm = real[idx]
+                        on_batch(self.received.add(tm, state.payload(idx)))
+                except ShuffleClientException as e:
+                    on_done(str(e))
+                    return
+                post_receive()
+
+            pending["tx"] = self.connection.receive(
+                tag, self.bounce_window, on_window)
+
+        def abort(message: str) -> None:
+            """Fail the fetch and cancel the outstanding receive so its
+            bounce buffer and inflight budget are returned to the pools."""
+            on_done(message)
+            tx = pending["tx"]
+            if tx is not None and tx.status == TransactionStatus.IN_PROGRESS:
+                tx.complete(TransactionStatus.CANCELLED)
+
+        # post the first window's receive BEFORE asking the server to
+        # stream, so no window can race past an unposted receive
+        post_receive()
+        xfer = wire.TransferRequest(
+            tag, self.bounce_window,
+            [tm.buffer_meta.buffer_id for tm in real])
+
+        def on_xfer(tx: Transaction) -> None:
+            if tx.status != TransactionStatus.SUCCESS:
+                abort(f"transfer request failed: {tx.error_message}")
+                return
+            resp = wire.TransferResponse.unpack(tx.payload)
+            if resp.error_code != 0:
+                abort(f"server refused transfer: {resp.error_code}")
+
+        self.connection.request(xfer.pack(), on_xfer)

@@ -335,6 +335,11 @@ class TpuShuffleExchangeExec(TpuExec):
     transport='local': each slice is downloaded, Arrow-IPC-serialized with
     the configured codec into the block store, and re-uploaded on read (the
     default sort-shuffle path analog, honest about the host round trip).
+    transport='manager': slices are written through the accelerated
+    TpuShuffleManager — device-resident ShuffleBufferCatalog on simulated
+    executors, fetched back over the transport SPI's tag-matched
+    client/server protocol (the full RapidsShuffleManager data plane,
+    RapidsShuffleInternalManager.scala:90-186).
     """
 
     def __init__(self, child: PhysicalPlan, partitioning: Partitioning,
@@ -342,6 +347,7 @@ class TpuShuffleExchangeExec(TpuExec):
         super().__init__()
         self.children = (child,)
         self.partitioning = partitioning
+        self.conf_obj = conf_obj
         self.transport = str(conf_obj.get(cfg.SHUFFLE_TRANSPORT))
         self.codec_name = str(conf_obj.get(cfg.SHUFFLE_COMPRESSION_CODEC))
         self.min_bucket = conf_obj.get(cfg.MIN_BUCKET_ROWS)
@@ -392,15 +398,26 @@ class TpuShuffleExchangeExec(TpuExec):
                                   jnp.asarray(offset, dtype=jnp.int32),
                                   jnp.asarray(count, dtype=jnp.int32))
 
+    # two simulated executors: map task m lands on exec-(m % 2), so every
+    # read exercises both the local-catalog and the remote-fetch paths
+    _MANAGER_EXECUTORS = 2
+
     def execute(self):
         n_parts = self.partitioning.num_partitions
-        state = {"done": False, "store": None, "dev_slices": None}
+        state = {"done": False, "store": None, "dev_slices": None,
+                 "mgr": None, "sid": None, "reads_left": n_parts}
 
         def materialize():
             if state["done"]:
                 return
             host = self.transport == "local"
+            mgr_mode = self.transport == "manager"
             store = ShuffleBlockStore(self.codec_name) if host else None
+            if mgr_mode:
+                from spark_rapids_tpu.shuffle.manager import \
+                    get_shuffle_manager
+                state["mgr"] = get_shuffle_manager(self.conf_obj)
+                state["sid"] = state["mgr"].new_shuffle_id()
             dev_slices: List[List[DeviceBatch]] = \
                 [[] for _ in range(n_parts)]
 
@@ -425,15 +442,22 @@ class TpuShuffleExchangeExec(TpuExec):
                 reordered, counts = self._partition_one(batch, rows_seen)
                 rows_seen += int(batch.num_rows)
                 off = 0
+                map_parts: List[Optional[DeviceBatch]] = [None] * n_parts
                 for pidx in range(n_parts):
                     c = int(counts[pidx])
                     if c:
                         s = self._slice(reordered, off, c)
                         if host:
                             store.put(m, pidx, to_arrow(s))
+                        elif mgr_mode:
+                            map_parts[pidx] = s
                         else:
                             dev_slices[pidx].append(s)
                     off += c
+                if mgr_mode:
+                    state["mgr"].write_map_output(
+                        f"exec-{m % self._MANAGER_EXECUTORS}",
+                        state["sid"], m, map_parts)
                 m += 1
             state["store"] = store
             state["dev_slices"] = dev_slices
@@ -443,7 +467,30 @@ class TpuShuffleExchangeExec(TpuExec):
 
         def reader(pidx: int) -> Iterator[DeviceBatch]:
             materialize()
-            if self.transport == "local":
+            if self.transport == "manager":
+                # reducer pidx runs "on" exec-(pidx % N): its local blocks
+                # come straight from the device catalog, the rest arrive
+                # via the tag-matched transport protocol
+                try:
+                    tables = list(state["mgr"].read_partition(
+                        f"exec-{pidx % self._MANAGER_EXECUTORS}",
+                        state["sid"], pidx))
+                    tables = [t for t in tables if t.num_rows]
+                    if not tables:
+                        return
+                    t = concat_tables(tables, self.schema)
+                    with timed(self.metrics):
+                        b = from_arrow(t, self.min_bucket)
+                    self.metrics.num_output_rows += t.num_rows
+                    self.metrics.num_output_batches += 1
+                finally:
+                    # last reducer out frees the device-resident blocks
+                    # (ShuffleManager.unregisterShuffle analog)
+                    state["reads_left"] -= 1
+                    if state["reads_left"] == 0:
+                        state["mgr"].unregister_shuffle(state["sid"])
+                yield b
+            elif self.transport == "local":
                 tables = state["store"].fetch(pidx)
                 if not tables:
                     return

@@ -1,0 +1,109 @@
+"""Reducer-side shuffle iterator over local + remote blocks.
+
+Reference analog (SURVEY.md §2f): ``RapidsShuffleIterator.scala:49-365``
+— splits block locations into local (served straight from the catalog)
+and remote (fetched via transport clients), acquires the device semaphore
+per produced batch, and surfaces failures as fetch-failed / timeout
+exceptions so the scheduler can re-run the map stage
+(RapidsShuffleExceptions.scala:21-32).
+"""
+
+from __future__ import annotations
+
+import queue
+import threading
+from dataclasses import dataclass
+from typing import Dict, Iterator, List, Optional, Tuple
+
+import pyarrow as pa
+
+from spark_rapids_tpu.columnar.batch import to_arrow
+from spark_rapids_tpu.mem.device import tpu_semaphore
+from spark_rapids_tpu.shuffle.catalogs import (ShuffleBufferCatalog,
+                                               ShuffleReceivedBufferCatalog)
+from spark_rapids_tpu.shuffle.client import RapidsShuffleClient
+from spark_rapids_tpu.shuffle.serializer import deserialize_table
+
+
+class RapidsShuffleFetchFailedException(Exception):
+    """Reference: RapidsShuffleFetchFailedException — a Spark
+    FetchFailedException, so the map stage is retried."""
+
+
+class RapidsShuffleTimeoutException(Exception):
+    """Reference: RapidsShuffleTimeoutException
+    (RapidsShuffleIterator.scala:188,345-361)."""
+
+
+@dataclass
+class RemoteSource:
+    peer_executor_id: str
+    client: RapidsShuffleClient
+    map_ids: Optional[List[int]] = None
+
+
+class RapidsShuffleIterator:
+    """Yields host tables for one reduce partition, mixing local catalog
+    hits with remote transport fetches."""
+
+    def __init__(self, shuffle_id: int, reduce_id: int,
+                 local_catalog: Optional[ShuffleBufferCatalog],
+                 remotes: List[RemoteSource],
+                 received_catalog: ShuffleReceivedBufferCatalog,
+                 timeout_s: float = 30.0):
+        self.shuffle_id = shuffle_id
+        self.reduce_id = reduce_id
+        self.local_catalog = local_catalog
+        self.remotes = remotes
+        self.received = received_catalog
+        self.timeout_s = timeout_s
+
+    def __iter__(self) -> Iterator[pa.Table]:
+        # local blocks: straight from the device store
+        # (RapidsCachingReader local path, RapidsCachingReader.scala:170)
+        if self.local_catalog is not None:
+            for blk in self.local_catalog.blocks_for(self.shuffle_id,
+                                                     self.reduce_id):
+                with tpu_semaphore():
+                    if blk.host_table is not None:
+                        yield blk.host_table
+                    else:
+                        yield to_arrow(blk.spillable.get())
+
+        # remote blocks: async fetch per peer, drain a completion queue
+        if not self.remotes:
+            return
+        q: "queue.Queue[Tuple[str, Optional[int], Optional[str]]]" = \
+            queue.Queue()
+        outstanding = len(self.remotes)
+
+        for src in self.remotes:
+            def make_cbs(peer: str):
+                def on_batch(temp_id: int) -> None:
+                    q.put(("batch", temp_id, None))
+
+                def on_done(err: Optional[str]) -> None:
+                    q.put(("done", None, err))
+                return on_batch, on_done
+
+            on_batch, on_done = make_cbs(src.peer_executor_id)
+            src.client.do_fetch(self.shuffle_id, self.reduce_id,
+                                src.map_ids, on_batch, on_done)
+
+        while outstanding > 0:
+            try:
+                kind, temp_id, err = q.get(timeout=self.timeout_s)
+            except queue.Empty:
+                raise RapidsShuffleTimeoutException(
+                    f"shuffle {self.shuffle_id} reduce {self.reduce_id}: "
+                    f"no progress for {self.timeout_s}s "
+                    f"({outstanding} peers outstanding)")
+            if kind == "done":
+                outstanding -= 1
+                if err is not None:
+                    raise RapidsShuffleFetchFailedException(
+                        f"shuffle {self.shuffle_id} reduce "
+                        f"{self.reduce_id}: {err}")
+            else:
+                with tpu_semaphore():
+                    yield self.received.materialize(temp_id)

@@ -1,0 +1,173 @@
+"""In-process loopback transport with UCX-style tag matching.
+
+Reference analog: the UCX transport (``shuffle-plugin/.../UCX.scala:53-533``)
+provides (a) a request/response control channel (the TCP management
+handshake + active messages, UCX.scala:192-246) and (b) tag-matched buffer
+sends/receives (UCX.scala:247-311).  This implementation provides the same
+two surfaces over in-process queues, so every state machine above the SPI
+(client, server, iterator, manager) runs unmodified; a C++ DCN/socket
+transport slots in behind the same interfaces.  Sends posted before their
+matching receive are queued, exactly UCX's expected-tag semantics.
+"""
+
+from __future__ import annotations
+
+import threading
+from collections import deque
+from typing import Callable, Dict, Optional, Tuple
+
+from spark_rapids_tpu.shuffle.transport import (ClientConnection,
+                                                ServerConnection,
+                                                ShuffleTransport,
+                                                Transaction,
+                                                TransactionStatus)
+
+_registry_lock = threading.Lock()
+_servers: Dict[str, "LocalServerConnection"] = {}
+# client endpoints are keyed (client_executor_id, server_executor_id): one
+# executor holds one connection PER peer, and a server streaming to peer P
+# must find the P->self connection's channel
+_endpoints: Dict[Tuple[str, str], "LocalClientConnection"] = {}
+
+
+def reset_registry() -> None:
+    with _registry_lock:
+        _servers.clear()
+        _endpoints.clear()
+
+
+class _TagChannel:
+    """Tag-matched rendezvous: unmatched sends and unmatched receives
+    queue until their counterpart arrives.
+
+    Completions are dispatched through a trampoline: a callback that
+    triggers another send/receive on this channel enqueues the new
+    completion instead of nesting a stack frame, so streaming thousands
+    of windows stays at constant stack depth (the reference's progress
+    thread gives UCX the same property, UCX.scala:140)."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._pending_sends: Dict[int, deque] = {}
+        self._pending_recvs: Dict[int, deque] = {}
+        self._completions: deque = deque()
+        self._draining = False
+
+    def _dispatch(self, completions) -> None:
+        with self._lock:
+            self._completions.extend(completions)
+            if self._draining:
+                return
+            self._draining = True
+        try:
+            while True:
+                with self._lock:
+                    if not self._completions:
+                        return
+                    tx, status, payload = self._completions.popleft()
+                tx.complete(status, payload=payload)
+        finally:
+            with self._lock:
+                self._draining = False
+
+    def send(self, tag: int, data: bytes, tx: Transaction) -> None:
+        recv = None
+        with self._lock:
+            q = self._pending_recvs.get(tag)
+            if q:
+                recv = q.popleft()
+            else:
+                self._pending_sends.setdefault(tag, deque()).append(
+                    (data, tx))
+        if recv is not None:
+            rtx, _nbytes = recv
+            self._dispatch([(tx, TransactionStatus.SUCCESS, None),
+                            (rtx, TransactionStatus.SUCCESS, data)])
+
+    def receive(self, tag: int, nbytes: int, tx: Transaction) -> None:
+        send = None
+        with self._lock:
+            q = self._pending_sends.get(tag)
+            if q:
+                send = q.popleft()
+            else:
+                self._pending_recvs.setdefault(tag, deque()).append(
+                    (tx, nbytes))
+        if send is not None:
+            data, stx = send
+            self._dispatch([(stx, TransactionStatus.SUCCESS, None),
+                            (tx, TransactionStatus.SUCCESS, data)])
+
+
+class LocalClientConnection(ClientConnection):
+    def __init__(self, local_executor_id: str, peer_executor_id: str):
+        self.local_executor_id = local_executor_id
+        self.peer_executor_id = peer_executor_id
+        self.channel = _TagChannel()
+        with _registry_lock:
+            _endpoints[(local_executor_id, peer_executor_id)] = self
+
+    def request(self, data: bytes, cb) -> Transaction:
+        tx = Transaction()
+        tx.start(cb)
+        with _registry_lock:
+            server = _servers.get(self.peer_executor_id)
+        if server is None or server.handler is None:
+            tx.complete(TransactionStatus.ERROR,
+                        error=f"no server at {self.peer_executor_id}")
+            return tx
+        try:
+            resp = server.handler(data, self.local_executor_id)
+        except Exception as e:
+            tx.complete(TransactionStatus.ERROR, error=str(e))
+            return tx
+        tx.complete(TransactionStatus.SUCCESS, payload=resp)
+        return tx
+
+    def receive(self, tag: int, nbytes: int, cb) -> Transaction:
+        tx = Transaction(tag)
+        tx.start(cb)
+        self.channel.receive(tag, nbytes, tx)
+        return tx
+
+
+class LocalServerConnection(ServerConnection):
+    def __init__(self, executor_id: str):
+        self.executor_id = executor_id
+        self.handler: Optional[Callable] = None
+        with _registry_lock:
+            _servers[executor_id] = self
+
+    def register_request_handler(self, handler) -> None:
+        self.handler = handler
+
+    def send(self, peer_executor_id: str, tag: int, data: bytes,
+             cb) -> Transaction:
+        tx = Transaction(tag)
+        tx.start(cb)
+        with _registry_lock:
+            ep = _endpoints.get((peer_executor_id, self.executor_id))
+        if ep is None:
+            tx.complete(TransactionStatus.ERROR,
+                        error=f"no endpoint at {peer_executor_id}")
+            return tx
+        ep.channel.send(tag, data, tx)
+        return tx
+
+
+class LocalShuffleTransport(ShuffleTransport):
+    """Default transport for single-host runs and tests; loadable via
+    ``make_transport`` just like the UCX plugin is
+    (RapidsShuffleTransport.scala:542-576)."""
+
+    def make_client(self, peer_executor_id: str) -> LocalClientConnection:
+        return LocalClientConnection(self.executor_id, peer_executor_id)
+
+    def server(self) -> LocalServerConnection:
+        return LocalServerConnection(self.executor_id)
+
+    def shutdown(self) -> None:
+        with _registry_lock:
+            _servers.pop(self.executor_id, None)
+            for key in [k for k in _endpoints if k[0] == self.executor_id]:
+                _endpoints.pop(key)

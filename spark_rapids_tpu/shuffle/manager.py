@@ -1,0 +1,185 @@
+"""Accelerated shuffle manager: executor env, caching writer/reader.
+
+Reference analog (SURVEY.md §2f):
+* ``GpuShuffleEnv`` (GpuShuffleEnv.scala:26-108) — executor-singleton
+  wiring of catalogs + transport; here ``ShuffleEnv`` plays that role per
+  simulated executor.
+* ``RapidsCachingWriter`` (RapidsShuffleInternalManager.scala:73-192) —
+  map output batches stay in the device store, registered in the
+  ShuffleBufferCatalog; the "rapids=<port>" MapStatus topology string
+  becomes the executor id carried in ``MapOutputInfo``.
+* ``RapidsCachingReader`` (RapidsCachingReader.scala:170) — local blocks
+  from the catalog, remote via transport clients, assembled by
+  ``RapidsShuffleIterator``.
+* ``RapidsShuffleInternalManagerBase`` (:200-374) — falls through to the
+  default serialized path when the accelerated manager is disabled (the
+  exec layer does that via config).
+"""
+
+from __future__ import annotations
+
+import itertools
+import threading
+from dataclasses import dataclass, field
+from typing import Dict, Iterator, List, Optional
+
+import pyarrow as pa
+
+from spark_rapids_tpu.columnar.batch import DeviceBatch
+from spark_rapids_tpu.config import (SHUFFLE_COMPRESSION_CODEC,
+                                     RapidsTpuConf)
+from spark_rapids_tpu.shuffle.catalogs import (ShuffleBufferCatalog,
+                                               ShuffleReceivedBufferCatalog)
+from spark_rapids_tpu.shuffle.client import RapidsShuffleClient
+from spark_rapids_tpu.shuffle.iterator import (RapidsShuffleIterator,
+                                               RemoteSource)
+from spark_rapids_tpu.shuffle.server import ShuffleServer
+from spark_rapids_tpu.shuffle.transport import (BounceBufferManager,
+                                                InflightLimiter,
+                                                ShuffleTransport,
+                                                make_transport)
+
+
+@dataclass
+class MapOutputInfo:
+    """Which executor holds a map task's output (MapStatus topology
+    analog, RapidsShuffleInternalManager.scala:163-186)."""
+    shuffle_id: int
+    map_id: int
+    executor_id: str
+
+
+class ShuffleEnv:
+    """Per-executor shuffle wiring (GpuShuffleEnv analog)."""
+
+    def __init__(self, executor_id: str, conf: RapidsTpuConf,
+                 transport: Optional[ShuffleTransport] = None):
+        self.executor_id = executor_id
+        self.conf = conf
+        codec = conf.get(SHUFFLE_COMPRESSION_CODEC)
+        self.catalog = ShuffleBufferCatalog(codec_name=codec)
+        self.received = ShuffleReceivedBufferCatalog()
+        if transport is None:
+            transport = make_transport(
+                "spark_rapids_tpu.shuffle.local.LocalShuffleTransport",
+                executor_id, conf)
+        self.transport = transport
+        self.send_bounce = BounceBufferManager(
+            f"{executor_id}-send", buffer_size=1 << 20, num_buffers=4)
+        self.recv_bounce = BounceBufferManager(
+            f"{executor_id}-recv", buffer_size=1 << 20, num_buffers=4)
+        self.inflight = InflightLimiter(max_bytes=64 << 20)
+        self.server = ShuffleServer(executor_id, self.catalog,
+                                    transport.server(), self.send_bounce)
+        self._clients: Dict[str, RapidsShuffleClient] = {}
+        self._lock = threading.Lock()
+
+    def client_for(self, peer_executor_id: str) -> RapidsShuffleClient:
+        with self._lock:
+            c = self._clients.get(peer_executor_id)
+            if c is None:
+                c = RapidsShuffleClient(
+                    self.transport.make_client(peer_executor_id),
+                    self.received, bounce_window=1 << 20,
+                    recv_bounce=self.recv_bounce, inflight=self.inflight)
+                self._clients[peer_executor_id] = c
+            return c
+
+    def close(self) -> None:
+        self.transport.shutdown()
+
+
+class TpuShuffleManager:
+    """Tracks map-output locations across executors and hands out
+    writers/readers — the ShuffleManager role, minus Spark's driver."""
+
+    def __init__(self, conf: RapidsTpuConf):
+        self.conf = conf
+        self._envs: Dict[str, ShuffleEnv] = {}
+        self._map_outputs: Dict[int, List[MapOutputInfo]] = {}
+        self._shuffle_ids = itertools.count(1)
+        self._lock = threading.Lock()
+
+    def register_executor(self, executor_id: str,
+                          transport: Optional[ShuffleTransport] = None
+                          ) -> ShuffleEnv:
+        with self._lock:
+            env = self._envs.get(executor_id)
+            if env is None:
+                env = ShuffleEnv(executor_id, self.conf, transport)
+                self._envs[executor_id] = env
+            return env
+
+    def new_shuffle_id(self) -> int:
+        return next(self._shuffle_ids)
+
+    # -- writer ------------------------------------------------------------
+    def write_map_output(self, executor_id: str, shuffle_id: int,
+                         map_id: int,
+                         partitions: List[Optional[DeviceBatch]]) -> None:
+        """RapidsCachingWriter.write analog: one device batch per reduce
+        partition stays HBM-resident in the executor's catalog."""
+        env = self.register_executor(executor_id)
+        for reduce_id, batch in enumerate(partitions):
+            if batch is None:
+                continue
+            env.catalog.register_batch(shuffle_id, map_id, reduce_id, batch)
+        with self._lock:
+            self._map_outputs.setdefault(shuffle_id, []).append(
+                MapOutputInfo(shuffle_id, map_id, executor_id))
+
+    # -- reader ------------------------------------------------------------
+    def read_partition(self, executor_id: str, shuffle_id: int,
+                       reduce_id: int,
+                       timeout_s: float = 30.0) -> Iterator[pa.Table]:
+        """RapidsCachingReader analog: local catalog + remote fetches."""
+        env = self.register_executor(executor_id)
+        with self._lock:
+            infos = list(self._map_outputs.get(shuffle_id, []))
+        peers: Dict[str, List[int]] = {}
+        for info in infos:
+            if info.executor_id != executor_id:
+                peers.setdefault(info.executor_id, []).append(info.map_id)
+        remotes = [RemoteSource(peer, env.client_for(peer), map_ids)
+                   for peer, map_ids in sorted(peers.items())]
+        local = env.catalog if any(
+            i.executor_id == executor_id for i in infos) else None
+        return iter(RapidsShuffleIterator(
+            shuffle_id, reduce_id, local, remotes, env.received,
+            timeout_s=timeout_s))
+
+    def unregister_shuffle(self, shuffle_id: int) -> None:
+        with self._lock:
+            self._map_outputs.pop(shuffle_id, None)
+            envs = list(self._envs.values())
+        for env in envs:
+            env.catalog.unregister_shuffle(shuffle_id)
+
+    def close(self) -> None:
+        with self._lock:
+            envs = list(self._envs.values())
+            self._envs.clear()
+        for env in envs:
+            env.close()
+
+
+_global_manager: Optional[TpuShuffleManager] = None
+_global_lock = threading.Lock()
+
+
+def get_shuffle_manager(conf: RapidsTpuConf) -> TpuShuffleManager:
+    """Process-wide manager (the executor-singleton GpuShuffleEnv idiom,
+    GpuShuffleEnv.scala:26)."""
+    global _global_manager
+    with _global_lock:
+        if _global_manager is None:
+            _global_manager = TpuShuffleManager(conf)
+        return _global_manager
+
+
+def reset_shuffle_manager() -> None:
+    global _global_manager
+    with _global_lock:
+        if _global_manager is not None:
+            _global_manager.close()
+        _global_manager = None

@@ -1,0 +1,249 @@
+"""Shuffle wire metadata: table/column/buffer descriptors + control frames.
+
+Reference analog (SURVEY.md §2f): the FlatBuffers schemas in
+``sql-plugin/src/main/format/*.fbs`` (ShuffleMetadata request/response,
+TableMeta/ColumnMeta/BufferMeta, codec descriptors, TransferRequest/
+TransferResponse) and their builder/parser ``MetaUtils.scala:33-527``,
+including degenerate (0-row / 0-col) batch metadata
+(``MetaUtils.buildDegenerateTableMeta`` MetaUtils.scala:145).
+
+The encoding here is a versioned little-endian struct layout rather than
+FlatBuffers (the flatbuffers runtime is not in this image); it is
+language-neutral and self-describing the same way — a C++ peer can parse
+it with a 40-line reader.  All multi-byte fields are ``<`` little-endian.
+"""
+
+from __future__ import annotations
+
+import struct
+from dataclasses import dataclass, field
+from typing import List, Optional
+
+MAGIC = 0x54505553  # "TPUS"
+VERSION = 1
+
+# codec ids on the wire (reference: CodecType in ShuffleMetadata fbs)
+CODEC_UNCOMPRESSED = 0
+CODEC_COPY = 1
+CODEC_LZ4 = 2
+CODEC_ZSTD = 3
+
+_CODEC_NAMES = {CODEC_UNCOMPRESSED: "none", CODEC_COPY: "copy",
+                CODEC_LZ4: "lz4", CODEC_ZSTD: "zstd"}
+_CODEC_IDS = {v: k for k, v in _CODEC_NAMES.items()}
+
+
+def codec_name(codec_id: int) -> str:
+    return _CODEC_NAMES[codec_id]
+
+
+def codec_id(name: str) -> int:
+    return _CODEC_IDS[name]
+
+
+def _pack_str(s: str) -> bytes:
+    b = s.encode("utf-8")
+    return struct.pack("<I", len(b)) + b
+
+
+def _unpack_str(buf: memoryview, off: int):
+    (n,) = struct.unpack_from("<I", buf, off)
+    off += 4
+    return bytes(buf[off:off + n]).decode("utf-8"), off + n
+
+
+@dataclass
+class ColumnMeta:
+    """Per-column descriptor (reference: ColumnMeta table in the fbs)."""
+    name: str
+    dtype_code: str       # spark_rapids_tpu.dtypes code, e.g. "int32"
+    nullable: bool
+    null_count: int
+
+    def pack(self) -> bytes:
+        return (_pack_str(self.name) + _pack_str(self.dtype_code) +
+                struct.pack("<BQ", int(self.nullable), self.null_count))
+
+    @staticmethod
+    def unpack(buf: memoryview, off: int):
+        name, off = _unpack_str(buf, off)
+        code, off = _unpack_str(buf, off)
+        nullable, null_count = struct.unpack_from("<BQ", buf, off)
+        return ColumnMeta(name, code, bool(nullable), null_count), off + 9
+
+
+@dataclass
+class BufferMeta:
+    """Physical buffer descriptor (reference: BufferMeta in the fbs):
+    identity + codec + sizes, enough for the receiver to size its bounce
+    windows and decompress."""
+    buffer_id: int
+    uncompressed_size: int
+    compressed_size: int
+    codec: int = CODEC_UNCOMPRESSED
+
+    def pack(self) -> bytes:
+        return struct.pack("<QQQI", self.buffer_id, self.uncompressed_size,
+                           self.compressed_size, self.codec)
+
+    @staticmethod
+    def unpack(buf: memoryview, off: int):
+        bid, usz, csz, codec = struct.unpack_from("<QQQI", buf, off)
+        return BufferMeta(bid, usz, csz, codec), off + 28
+
+
+@dataclass
+class TableMeta:
+    """One shuffle block = one table (reference: TableMeta, built by
+    MetaUtils.buildTableMeta MetaUtils.scala:48).  ``buffer_meta`` is None
+    for degenerate batches (0 rows or 0 columns), which ship as metadata
+    only (MetaUtils.scala:145)."""
+    num_rows: int
+    columns: List[ColumnMeta]
+    buffer_meta: Optional[BufferMeta]
+
+    @property
+    def is_degenerate(self) -> bool:
+        return self.buffer_meta is None
+
+    def pack(self) -> bytes:
+        out = [struct.pack("<QI", self.num_rows, len(self.columns))]
+        out += [c.pack() for c in self.columns]
+        if self.buffer_meta is None:
+            out.append(struct.pack("<B", 0))
+        else:
+            out.append(struct.pack("<B", 1))
+            out.append(self.buffer_meta.pack())
+        return b"".join(out)
+
+    @staticmethod
+    def unpack(buf: memoryview, off: int):
+        num_rows, ncols = struct.unpack_from("<QI", buf, off)
+        off += 12
+        cols = []
+        for _ in range(ncols):
+            c, off = ColumnMeta.unpack(buf, off)
+            cols.append(c)
+        (has_buf,) = struct.unpack_from("<B", buf, off)
+        off += 1
+        bm = None
+        if has_buf:
+            bm, off = BufferMeta.unpack(buf, off)
+        return TableMeta(num_rows, cols, bm), off
+
+
+# ---------------------------------------------------------------------------
+# Control frames (reference: MetadataRequest/MetadataResponse,
+# TransferRequest/TransferResponse tables in ShuffleMetadata.fbs)
+# ---------------------------------------------------------------------------
+
+FRAME_META_REQ = 1
+FRAME_META_RESP = 2
+FRAME_XFER_REQ = 3
+FRAME_XFER_RESP = 4
+
+
+def _header(frame_type: int) -> bytes:
+    return struct.pack("<IHH", MAGIC, VERSION, frame_type)
+
+
+def _check_header(buf: memoryview, expect: int) -> int:
+    magic, version, ftype = struct.unpack_from("<IHH", buf, 0)
+    if magic != MAGIC:
+        raise ValueError(f"bad magic 0x{magic:x}")
+    if version != VERSION:
+        raise ValueError(f"unsupported wire version {version}")
+    if ftype != expect:
+        raise ValueError(f"expected frame {expect}, got {ftype}")
+    return 8
+
+
+@dataclass
+class MetadataRequest:
+    """Reducer asks a mapper executor for TableMetas of its blocks."""
+    shuffle_id: int
+    reduce_id: int
+    map_ids: List[int] = field(default_factory=list)
+
+    def pack(self) -> bytes:
+        out = [_header(FRAME_META_REQ),
+               struct.pack("<III", self.shuffle_id, self.reduce_id,
+                           len(self.map_ids))]
+        out += [struct.pack("<I", m) for m in self.map_ids]
+        return b"".join(out)
+
+    @staticmethod
+    def unpack(data: bytes) -> "MetadataRequest":
+        buf = memoryview(data)
+        off = _check_header(buf, FRAME_META_REQ)
+        sid, rid, n = struct.unpack_from("<III", buf, off)
+        off += 12
+        maps = list(struct.unpack_from(f"<{n}I", buf, off)) if n else []
+        return MetadataRequest(sid, rid, maps)
+
+
+@dataclass
+class MetadataResponse:
+    tables: List[TableMeta]
+
+    def pack(self) -> bytes:
+        out = [_header(FRAME_META_RESP), struct.pack("<I", len(self.tables))]
+        out += [t.pack() for t in self.tables]
+        return b"".join(out)
+
+    @staticmethod
+    def unpack(data: bytes) -> "MetadataResponse":
+        buf = memoryview(data)
+        off = _check_header(buf, FRAME_META_RESP)
+        (n,) = struct.unpack_from("<I", buf, off)
+        off += 4
+        tables = []
+        for _ in range(n):
+            t, off = TableMeta.unpack(buf, off)
+            tables.append(t)
+        return MetadataResponse(tables)
+
+
+@dataclass
+class TransferRequest:
+    """Reducer asks the server to stream these buffers to its receive tag
+    (reference: TransferRequest with per-buffer tags).  ``window_size``
+    is the bounce-window both sides iterate with, so the sender's
+    BufferSendState and the receiver's BufferReceiveState walk identical
+    WindowedBlockIterator sequences."""
+    receive_tag: int
+    window_size: int
+    buffer_ids: List[int]
+
+    def pack(self) -> bytes:
+        out = [_header(FRAME_XFER_REQ),
+               struct.pack("<QQI", self.receive_tag, self.window_size,
+                           len(self.buffer_ids))]
+        out += [struct.pack("<Q", b) for b in self.buffer_ids]
+        return b"".join(out)
+
+    @staticmethod
+    def unpack(data: bytes) -> "TransferRequest":
+        buf = memoryview(data)
+        off = _check_header(buf, FRAME_XFER_REQ)
+        tag, window, n = struct.unpack_from("<QQI", buf, off)
+        off += 20
+        ids = [struct.unpack_from("<Q", buf, off + 8 * i)[0]
+               for i in range(n)]
+        return TransferRequest(tag, window, ids)
+
+
+@dataclass
+class TransferResponse:
+    """Server acknowledges which buffers it will stream (0 = all ok)."""
+    error_code: int = 0
+
+    def pack(self) -> bytes:
+        return _header(FRAME_XFER_RESP) + struct.pack("<I", self.error_code)
+
+    @staticmethod
+    def unpack(data: bytes) -> "TransferResponse":
+        buf = memoryview(data)
+        off = _check_header(buf, FRAME_XFER_RESP)
+        (code,) = struct.unpack_from("<I", buf, off)
+        return TransferResponse(code)

@@ -1,0 +1,109 @@
+"""Accelerated-shuffle server: serves metadata + streams buffers.
+
+Reference analog (SURVEY.md §2f): ``RapidsShuffleServer.scala:71-446`` —
+``doHandleTransferRequest`` (:368) streams requested buffers through send
+bounce buffers via ``BufferSendState`` (BufferSendState.scala:236), which
+windows many blocks through a fixed staging buffer.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Callable, Dict, List, Optional
+
+from spark_rapids_tpu.shuffle import meta as wire
+from spark_rapids_tpu.shuffle.catalogs import ShuffleBufferCatalog
+from spark_rapids_tpu.shuffle.transport import (BounceBufferManager,
+                                                ServerConnection,
+                                                Transaction,
+                                                TransactionStatus,
+                                                WindowedBlockIterator)
+
+
+class BufferSendState:
+    """Walks the requested buffers' payloads window-by-window through one
+    send bounce buffer (reference: BufferSendState.scala:236).  Each
+    ``next_window`` returns the exact bytes for one window; the server
+    sends them tagged and ordered."""
+
+    def __init__(self, payloads: List[bytes], window_size: int,
+                 bounce_mgr: Optional[BounceBufferManager] = None):
+        self.payloads = payloads
+        self.window_size = window_size
+        self._iter = WindowedBlockIterator([len(p) for p in payloads],
+                                           window_size)
+        self._bounce_mgr = bounce_mgr
+        self.windows_sent = 0
+        self.bytes_sent = 0
+
+    def has_next(self) -> bool:
+        return self._iter.has_next()
+
+    def next_window(self) -> bytes:
+        ranges = next(self._iter)
+        bounce = (self._bounce_mgr.acquire() if self._bounce_mgr else None)
+        try:
+            out = bytearray()
+            for r in ranges:
+                out += self.payloads[r.block][
+                    r.range_start:r.range_start + r.range_size]
+            self.windows_sent += 1
+            self.bytes_sent += len(out)
+            return bytes(out)
+        finally:
+            if bounce is not None:
+                bounce.close()
+
+
+class ShuffleServer:
+    """Handles MetadataRequest / TransferRequest control frames."""
+
+    def __init__(self, executor_id: str, catalog: ShuffleBufferCatalog,
+                 connection: ServerConnection,
+                 send_bounce: Optional[BounceBufferManager] = None):
+        self.executor_id = executor_id
+        self.catalog = catalog
+        self.connection = connection
+        self.send_bounce = send_bounce
+        connection.register_request_handler(self.handle_request)
+
+    # -- control-frame dispatch -------------------------------------------
+    def handle_request(self, data: bytes, peer_executor_id: str) -> bytes:
+        import struct
+        (_, _, ftype) = struct.unpack_from("<IHH", data, 0)
+        if ftype == wire.FRAME_META_REQ:
+            return self._handle_metadata(wire.MetadataRequest.unpack(data))
+        if ftype == wire.FRAME_XFER_REQ:
+            return self._handle_transfer(
+                wire.TransferRequest.unpack(data), peer_executor_id)
+        raise ValueError(f"unknown frame type {ftype}")
+
+    def _handle_metadata(self, req: wire.MetadataRequest) -> bytes:
+        blocks = self.catalog.blocks_for(req.shuffle_id, req.reduce_id,
+                                         req.map_ids or None)
+        return wire.MetadataResponse([b.table_meta for b in blocks]).pack()
+
+    def _handle_transfer(self, req: wire.TransferRequest,
+                         peer_executor_id: str) -> bytes:
+        """doHandleTransferRequest analog
+        (RapidsShuffleServer.scala:368): materialize payloads (unspilling
+        if needed), stream windows to the peer's tagged receives."""
+        try:
+            payloads = [self.catalog.block_payload(bid)
+                        for bid in req.buffer_ids]
+        except KeyError:
+            return wire.TransferResponse(error_code=1).pack()
+        state = BufferSendState(payloads, req.window_size, self.send_bounce)
+
+        def send_next(_tx: Optional[Transaction]) -> None:
+            if _tx is not None and _tx.status != TransactionStatus.SUCCESS:
+                return  # receiver vanished; stop streaming
+            if not state.has_next():
+                return
+            data = state.next_window()
+            tx = self.connection.send(peer_executor_id, req.receive_tag,
+                                      data, send_next)
+
+        # kick off the stream; subsequent windows chain off completions
+        send_next(None)
+        return wire.TransferResponse(error_code=0).pack()

@@ -1,0 +1,302 @@
+"""Shuffle transport SPI: connections, transactions, bounce buffers.
+
+Reference analog (SURVEY.md §2f): ``RapidsShuffleTransport.scala:38-578``
+— the pluggable transport abstraction the UCX plugin implements.  The SPI
+is retained so an ICI/DCN C++ transport, the in-process loopback used by
+tests, or a socket transport can sit behind the same client/server state
+machines (the reference's load-bearing design: the whole protocol is
+unit-testable with fake transports, RapidsShuffleTestHelper.scala:26-120).
+
+Pieces, with their reference counterparts:
+
+* ``Transaction`` / ``TransactionStatus``  — RapidsShuffleTransport.scala:270-335
+* ``ClientConnection`` / ``ServerConnection`` — tag-matched send/recv surface
+* ``BounceBufferManager``  — fixed pool of fixed-size staging buffers
+  (BounceBufferManager.scala:166); on TPU these are host staging windows
+  for DCN hops (pure-ICI paths don't need them, SURVEY.md §2f note)
+* ``WindowedBlockIterator`` — maps many (offset,size) blocks onto bounce
+  windows (WindowedBlockIterator.scala:179)
+* ``InflightLimiter`` — bounds in-flight receive bytes
+  (UCXShuffleTransport.scala:323-346)
+* ``make_transport`` — reflective loading by class name
+  (RapidsShuffleTransport.makeTransport :542-576)
+"""
+
+from __future__ import annotations
+
+import enum
+import importlib
+import threading
+import time
+from dataclasses import dataclass, field
+from typing import Callable, Dict, List, Optional, Sequence, Tuple
+
+
+class TransactionStatus(enum.Enum):
+    NOT_STARTED = 0
+    IN_PROGRESS = 1
+    SUCCESS = 2
+    ERROR = 3
+    CANCELLED = 4
+
+
+@dataclass
+class TransactionStats:
+    """Reference: TransactionStats (tx time, throughput)
+    RapidsShuffleTransport.scala:282-287."""
+    start_time: float = 0.0
+    end_time: float = 0.0
+    bytes_moved: int = 0
+
+    @property
+    def tx_time_ms(self) -> float:
+        return max(0.0, (self.end_time - self.start_time) * 1000.0)
+
+    @property
+    def throughput_mbps(self) -> float:
+        dt = max(self.end_time - self.start_time, 1e-9)
+        return self.bytes_moved / dt / 1e6
+
+
+class Transaction:
+    """One async request/response or buffer send/receive.
+
+    Callbacks fire exactly once when the transaction completes; the
+    client/server state machines are driven entirely from them (the
+    reference tests invoke them directly — we keep that property).
+    """
+
+    def __init__(self, tag: int = 0):
+        self.tag = tag
+        self.status = TransactionStatus.NOT_STARTED
+        self.error_message: Optional[str] = None
+        self.stats = TransactionStats()
+        self.payload: Optional[bytes] = None   # response body, if any
+        self._cb: Optional[Callable[["Transaction"], None]] = None
+        self._done = threading.Event()
+        self._complete_lock = threading.Lock()
+
+    def start(self, cb: Optional[Callable[["Transaction"], None]]) -> None:
+        self.status = TransactionStatus.IN_PROGRESS
+        self.stats.start_time = time.monotonic()
+        self._cb = cb
+
+    def complete(self, status: TransactionStatus,
+                 payload: Optional[bytes] = None,
+                 error: Optional[str] = None) -> None:
+        with self._complete_lock:
+            if self._done.is_set():
+                return  # first completion wins (e.g. cancel vs late data)
+            self.status = status
+            self.payload = payload
+            self.error_message = error
+            self.stats.end_time = time.monotonic()
+            if payload is not None:
+                self.stats.bytes_moved += len(payload)
+            self._done.set()
+            cb, self._cb = self._cb, None
+        if cb is not None:
+            cb(self)
+
+    def wait(self, timeout: Optional[float] = None) -> bool:
+        return self._done.wait(timeout)
+
+
+class ClientConnection:
+    """Reducer-side connection to one mapper executor."""
+
+    def request(self, data: bytes,
+                cb: Callable[[Transaction], None]) -> Transaction:
+        """Send a control frame; the transaction completes with the
+        server's response frame in ``payload``."""
+        raise NotImplementedError
+
+    def receive(self, tag: int, nbytes: int,
+                cb: Callable[[Transaction], None]) -> Transaction:
+        """Post a tagged receive for ``nbytes`` of buffer data."""
+        raise NotImplementedError
+
+
+class ServerConnection:
+    """Mapper-side connection surface."""
+
+    def send(self, peer_executor_id: str, tag: int, data: bytes,
+             cb: Callable[[Transaction], None]) -> Transaction:
+        """Send buffer bytes to a peer's tagged receive."""
+        raise NotImplementedError
+
+    def register_request_handler(
+            self, handler: Callable[[bytes, str], bytes]) -> None:
+        """Install the control-frame handler. The transport MUST invoke it
+        as ``handler(frame_bytes, peer_executor_id)`` — the peer id is how
+        the server addresses its streaming sends back to the requester."""
+        raise NotImplementedError
+
+
+class ShuffleTransport:
+    """Transport factory SPI (reference: RapidsShuffleTransport trait)."""
+
+    def __init__(self, executor_id: str, conf=None):
+        self.executor_id = executor_id
+        self.conf = conf
+
+    def make_client(self, peer_executor_id: str) -> ClientConnection:
+        raise NotImplementedError
+
+    def server(self) -> ServerConnection:
+        raise NotImplementedError
+
+    def shutdown(self) -> None:
+        pass
+
+
+def make_transport(class_name: str, executor_id: str,
+                   conf=None) -> ShuffleTransport:
+    """Reflectively instantiate a transport implementation
+    (reference: RapidsShuffleTransport.makeTransport :542-576)."""
+    mod_name, _, cls_name = class_name.rpartition(".")
+    cls = getattr(importlib.import_module(mod_name), cls_name)
+    t = cls(executor_id, conf)
+    if not isinstance(t, ShuffleTransport):
+        raise TypeError(f"{class_name} is not a ShuffleTransport")
+    return t
+
+
+# ---------------------------------------------------------------------------
+# Bounce buffers
+# ---------------------------------------------------------------------------
+
+class BounceBuffer:
+    def __init__(self, index: int, size: int, mgr: "BounceBufferManager"):
+        self.index = index
+        self.data = bytearray(size)
+        self._mgr = mgr
+
+    @property
+    def size(self) -> int:
+        return len(self.data)
+
+    def close(self) -> None:
+        self._mgr.release(self)
+
+
+class BounceBufferManager:
+    """Fixed pool of fixed-size host staging buffers
+    (reference: BounceBufferManager.scala:166).  Acquire blocks until a
+    buffer frees, mirroring the reference's bounded-staging behavior.
+    Allocation is backed by the native host arena when available."""
+
+    def __init__(self, name: str, buffer_size: int, num_buffers: int):
+        self.name = name
+        self.buffer_size = buffer_size
+        self._free: List[BounceBuffer] = [
+            BounceBuffer(i, buffer_size, self) for i in range(num_buffers)]
+        self._lock = threading.Condition()
+        self.num_buffers = num_buffers
+
+    def acquire(self, timeout: Optional[float] = None
+                ) -> Optional[BounceBuffer]:
+        with self._lock:
+            if not self._free and not self._lock.wait_for(
+                    lambda: bool(self._free), timeout):
+                return None
+            return self._free.pop()
+
+    def try_acquire(self) -> Optional[BounceBuffer]:
+        with self._lock:
+            return self._free.pop() if self._free else None
+
+    def release(self, buf: BounceBuffer) -> None:
+        with self._lock:
+            self._free.append(buf)
+            self._lock.notify()
+
+    @property
+    def available(self) -> int:
+        with self._lock:
+            return len(self._free)
+
+
+class InflightLimiter:
+    """Bounds bytes in flight (reference:
+    UCXShuffleTransport.scala:323-346)."""
+
+    def __init__(self, max_bytes: int):
+        self.max_bytes = max_bytes
+        self._inflight = 0
+        self._cv = threading.Condition()
+
+    def acquire(self, nbytes: int, timeout: Optional[float] = None) -> bool:
+        nbytes = min(nbytes, self.max_bytes)  # single huge buffer still goes
+        with self._cv:
+            ok = self._cv.wait_for(
+                lambda: self._inflight + nbytes <= self.max_bytes, timeout)
+            if not ok:
+                return False
+            self._inflight += nbytes
+            return True
+
+    def release(self, nbytes: int) -> None:
+        nbytes = min(nbytes, self.max_bytes)
+        with self._cv:
+            self._inflight = max(0, self._inflight - nbytes)
+            self._cv.notify_all()
+
+
+# ---------------------------------------------------------------------------
+# Windowed block iterator
+# ---------------------------------------------------------------------------
+
+@dataclass(frozen=True)
+class BlockRange:
+    """A contiguous range of one logical block mapped into the current
+    window: (block index, offset within block, length)."""
+    block: int
+    range_start: int
+    range_size: int
+
+    @property
+    def is_complete_for(self) -> bool:
+        return True
+
+
+class WindowedBlockIterator:
+    """Maps N variable-size blocks onto fixed-size windows
+    (reference: WindowedBlockIterator.scala:179).
+
+    Given block sizes [b0, b1, ...] and a window of W bytes, each ``next``
+    yields the list of (block, start, size) ranges that fill the next
+    window; a block larger than W spans several windows.
+    """
+
+    def __init__(self, block_sizes: Sequence[int], window_size: int):
+        if window_size <= 0:
+            raise ValueError("window_size must be positive")
+        self.block_sizes = list(block_sizes)
+        self.window_size = window_size
+        self._block = 0
+        self._offset = 0
+
+    def __iter__(self):
+        return self
+
+    def has_next(self) -> bool:
+        return self._block < len(self.block_sizes)
+
+    def __next__(self) -> List[BlockRange]:
+        if not self.has_next():
+            raise StopIteration
+        out: List[BlockRange] = []
+        remaining = self.window_size
+        while remaining > 0 and self._block < len(self.block_sizes):
+            bsize = self.block_sizes[self._block]
+            left = bsize - self._offset
+            take = min(left, remaining)
+            if take > 0:
+                out.append(BlockRange(self._block, self._offset, take))
+            remaining -= take
+            self._offset += take
+            if self._offset >= bsize:
+                self._block += 1
+                self._offset = 0
+        return out
