@@ -171,6 +171,27 @@ class DataFrame:
 
     crossJoin = lambda self, other: self.join(other, how="cross")  # noqa
 
+    def map_in_pandas(self, fn, schema) -> "DataFrame":
+        """fn(pdf) -> pdf per batch, via an Arrow IPC worker process
+        (GpuMapInPandasExec analog)."""
+        return DataFrame(lp.MapInPandas(self.plan, fn, schema),
+                         self.session)
+
+    def window_in_pandas(self, partition_by, fn, args, out_name: str,
+                         out_type="double") -> "DataFrame":
+        """Unbounded-frame pandas window UDF: fn(*series) -> scalar per
+        partition, broadcast to its rows (GpuWindowInPandasExec analog)."""
+        from spark_rapids_tpu.api.column import _TYPE_NAMES
+        out_dtype = _TYPE_NAMES[out_type] if isinstance(out_type, str) \
+            else out_type
+        keys = [partition_by] if isinstance(partition_by, str) \
+            else list(partition_by)
+        return DataFrame(
+            lp.WindowInPandas(self.plan, keys, fn,
+                              [_as_expr(a) for a in args], out_name,
+                              out_dtype),
+            self.session)
+
     def repartition(self, num_partitions: int, *cols) -> "DataFrame":
         """Hash exchange on cols, or round-robin without cols
         (GpuShuffleExchangeExec + GpuHashPartitioning/
@@ -302,3 +323,58 @@ class GroupedData:
         return self._simple("avg", cols)
 
     mean = avg
+
+    # -- pandas-UDF entry points (reference: SURVEY.md §2d python execs) ---
+    def _key_names(self) -> List[str]:
+        names = []
+        for g in self.groupings:
+            if isinstance(g, ir.UnresolvedAttribute):
+                names.append(g.attr_name)
+            elif isinstance(g, ir.BoundReference) and g.ref_name:
+                names.append(g.ref_name)
+            else:
+                raise TypeError(
+                    "pandas group operations require plain column "
+                    "grouping keys")
+        return names
+
+    def apply_in_pandas(self, fn, schema) -> DataFrame:
+        """fn(group_pdf) -> pdf per group
+        (GpuFlatMapGroupsInPandasExec analog)."""
+        return DataFrame(
+            lp.FlatMapGroupsInPandas(self.df.plan, self._key_names(), fn,
+                                     schema),
+            self.df.session)
+
+    def agg_in_pandas(self, fn, args, out_name: str,
+                      out_type="double") -> DataFrame:
+        """fn(*series) -> scalar per group
+        (GpuAggregateInPandasExec analog)."""
+        from spark_rapids_tpu.api.column import _TYPE_NAMES
+        out_dtype = _TYPE_NAMES[out_type] if isinstance(out_type, str) \
+            else out_type
+        return DataFrame(
+            lp.AggregateInPandas(self.df.plan, self._key_names(), fn,
+                                 [_as_expr(a) for a in args], out_name,
+                                 out_dtype),
+            self.df.session)
+
+    def cogroup(self, other: "GroupedData") -> "CoGroupedData":
+        """PySpark df.groupBy(k).cogroup(df2.groupBy(k)) analog."""
+        return CoGroupedData(self, other)
+
+
+class CoGroupedData:
+    def __init__(self, left: GroupedData, right: GroupedData):
+        self.left = left
+        self.right = right
+
+    def apply_in_pandas(self, fn, schema) -> DataFrame:
+        """fn(left_pdf, right_pdf) -> pdf per co-grouped key
+        (GpuFlatMapCoGroupsInPandasExec analog)."""
+        return DataFrame(
+            lp.CoGroupedMapInPandas(
+                self.left.df.plan, self.right.df.plan,
+                self.left._key_names(), self.right._key_names(), fn,
+                schema),
+            self.left.df.session)

@@ -260,6 +260,30 @@ def udf(f=None, returnType="string"):
     return wrapper
 
 
+def pandas_udf(f=None, returnType="double"):
+    """Create a vectorized (series -> series) pandas UDF.
+
+    Evaluated in a Python worker process over Arrow IPC — the
+    GpuArrowEvalPythonExec path (reference:
+    GpuArrowEvalPythonExec.scala:422-435, python/rapids/worker.py).
+    Supports ``pandas_udf(f)``, ``pandas_udf(f, "long")``, ``@pandas_udf``,
+    ``@pandas_udf("long")`` call forms like PySpark.
+    """
+    from spark_rapids_tpu.api.column import _TYPE_NAMES
+    if isinstance(f, (str, dt.DType)):
+        return lambda fn: pandas_udf(fn, f)
+    if f is None:
+        return lambda fn: pandas_udf(fn, returnType)
+    rt = _TYPE_NAMES[returnType] if isinstance(returnType, str) \
+        else returnType
+
+    def wrapper(*cols) -> Column:
+        return Column(ir.PythonUDF(f, [_c(c) for c in cols], rt,
+                                   vectorized=True))
+    wrapper.__name__ = getattr(f, "__name__", "pandas_udf")
+    return wrapper
+
+
 # -- window functions -------------------------------------------------------
 
 def row_number() -> Column:

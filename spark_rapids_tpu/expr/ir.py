@@ -844,7 +844,7 @@ class PythonUDF(Expression):
 
     def __init__(self, func, children: Sequence[Expression],
                  return_type: dt.DType, name_: str = "",
-                 try_compile: bool = False):
+                 try_compile: bool = False, vectorized: bool = False):
         self.func = func
         self.children = tuple(children)
         self.return_type = return_type
@@ -853,6 +853,10 @@ class PythonUDF(Expression):
         # argument dtypes are known (the reference compiles at plan time via
         # a resolution rule, udf-compiler/.../Plugin.scala:36-94)
         self.try_compile = try_compile
+        # when True this is a pandas (series->series) UDF: the planner
+        # extracts it into an ArrowEvalPython exec that feeds a worker
+        # process over Arrow IPC (GpuArrowEvalPythonExec analog)
+        self.vectorized = vectorized
 
     def resolve(self) -> None:
         self.dtype = self.return_type

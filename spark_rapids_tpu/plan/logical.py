@@ -462,3 +462,107 @@ class CoalescePartitions(LogicalPlan):
 
     def simple_string(self) -> str:
         return f"CoalescePartitions({self.num_partitions})"
+
+
+# ---------------------------------------------------------------------------
+# Pandas-UDF nodes (reference: SURVEY.md §2d Pandas/Python execs,
+# sql-plugin/.../execution/python/*)
+# ---------------------------------------------------------------------------
+
+def _parse_udf_schema(schema) -> Schema:
+    """Accept a Schema, a pyarrow.Schema, or a list of (name, DType)."""
+    if isinstance(schema, Schema):
+        return schema
+    if isinstance(schema, pa.Schema):
+        return Schema.from_arrow(schema)
+    return Schema([Field(n, d, True) for n, d in schema])
+
+
+class MapInPandas(LogicalPlan):
+    """df.map_in_pandas(fn, schema) — GpuMapInPandasExec analog."""
+
+    def __init__(self, child: LogicalPlan, fn, schema):
+        self.children = (child,)
+        self.fn = fn
+        self._schema = _parse_udf_schema(schema)
+
+    @property
+    def schema(self) -> Schema:
+        return self._schema
+
+
+class FlatMapGroupsInPandas(LogicalPlan):
+    """group_by(keys).apply_in_pandas(fn, schema) —
+    GpuFlatMapGroupsInPandasExec analog."""
+
+    def __init__(self, child: LogicalPlan, keys: Sequence[str], fn, schema):
+        self.children = (child,)
+        for k in keys:
+            child.schema.field(k)  # raises KeyError if missing
+        self.keys = list(keys)
+        self.fn = fn
+        self._schema = _parse_udf_schema(schema)
+
+    @property
+    def schema(self) -> Schema:
+        return self._schema
+
+
+class CoGroupedMapInPandas(LogicalPlan):
+    """cogroup(...).apply_in_pandas(fn, schema) —
+    GpuFlatMapCoGroupsInPandasExec analog."""
+
+    def __init__(self, left: LogicalPlan, right: LogicalPlan,
+                 left_keys: Sequence[str], right_keys: Sequence[str],
+                 fn, schema):
+        if len(left_keys) != len(right_keys):
+            raise ValueError("cogroup key lists must have equal length")
+        self.children = (left, right)
+        self.left_keys = list(left_keys)
+        self.right_keys = list(right_keys)
+        self.fn = fn
+        self._schema = _parse_udf_schema(schema)
+
+    @property
+    def schema(self) -> Schema:
+        return self._schema
+
+
+class AggregateInPandas(LogicalPlan):
+    """group_by(keys).agg_in_pandas(fn, args, name, dtype) —
+    GpuAggregateInPandasExec analog."""
+
+    def __init__(self, child: LogicalPlan, keys: Sequence[str], fn,
+                 args: Sequence[ir.Expression], out_name: str,
+                 out_dtype: dt.DType):
+        self.children = (child,)
+        self.keys = list(keys)
+        self.fn = fn
+        self.args = [self.bind(a) for a in args]
+        self.out_field = Field(out_name, out_dtype, True)
+        self._schema = Schema(
+            [child.schema.field(k) for k in self.keys] + [self.out_field])
+
+    @property
+    def schema(self) -> Schema:
+        return self._schema
+
+
+class WindowInPandas(LogicalPlan):
+    """Unbounded-frame pandas window UDF — GpuWindowInPandasExec analog."""
+
+    def __init__(self, child: LogicalPlan, part_keys: Sequence[str], fn,
+                 args: Sequence[ir.Expression], out_name: str,
+                 out_dtype: dt.DType):
+        self.children = (child,)
+        for k in part_keys:
+            child.schema.field(k)
+        self.part_keys = list(part_keys)
+        self.fn = fn
+        self.args = [self.bind(a) for a in args]
+        self.out_field = Field(out_name, out_dtype, True)
+        self._schema = Schema(list(child.schema.fields) + [self.out_field])
+
+    @property
+    def schema(self) -> Schema:
+        return self._schema

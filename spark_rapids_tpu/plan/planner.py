@@ -23,10 +23,10 @@ def plan_cpu(node: lp.LogicalPlan, conf: RapidsTpuConf) -> PhysicalPlan:
         return CpuFileScanExec(node, conf)
     if isinstance(node, lp.Project):
         child = plan_cpu(node.children[0], conf)
-        return cpux.CpuProjectExec(child, node.exprs, node.schema)
+        return _plan_project(node, child, conf)
     if isinstance(node, lp.Filter):
         child = plan_cpu(node.children[0], conf)
-        return cpux.CpuFilterExec(child, node.condition)
+        return _plan_filter(node, child, conf)
     if isinstance(node, lp.Sort):
         child = plan_cpu(node.children[0], conf)
         return cpux.CpuSortExec(child, node.orders)
@@ -83,7 +83,109 @@ def plan_cpu(node: lp.LogicalPlan, conf: RapidsTpuConf) -> PhysicalPlan:
         child = plan_cpu(node.children[0], conf)
         return CpuWindowExec(child, node.window_exprs, node.out_names,
                              node.schema)
+    if isinstance(node, lp.MapInPandas):
+        from spark_rapids_tpu.pyworker.execs import CpuMapInPandasExec
+        child = plan_cpu(node.children[0], conf)
+        return CpuMapInPandasExec(child, node.fn, node.schema)
+    if isinstance(node, lp.FlatMapGroupsInPandas):
+        from spark_rapids_tpu.pyworker.execs import \
+            CpuFlatMapGroupsInPandasExec
+        child = plan_cpu(node.children[0], conf)
+        return CpuFlatMapGroupsInPandasExec(child, node.keys, node.fn,
+                                            node.schema)
+    if isinstance(node, lp.CoGroupedMapInPandas):
+        from spark_rapids_tpu.pyworker.execs import \
+            CpuFlatMapCoGroupsInPandasExec
+        return CpuFlatMapCoGroupsInPandasExec(
+            plan_cpu(node.children[0], conf),
+            plan_cpu(node.children[1], conf),
+            node.left_keys, node.right_keys, node.fn, node.schema)
+    if isinstance(node, lp.AggregateInPandas):
+        from spark_rapids_tpu.pyworker.execs import CpuAggregateInPandasExec
+        child = plan_cpu(node.children[0], conf)
+        return CpuAggregateInPandasExec(child, node.keys, node.fn,
+                                        node.args, node.out_field)
+    if isinstance(node, lp.WindowInPandas):
+        from spark_rapids_tpu.pyworker.execs import CpuWindowInPandasExec
+        child = plan_cpu(node.children[0], conf)
+        return CpuWindowInPandasExec(child, node.part_keys, node.fn,
+                                     node.args, node.out_field)
     raise NotImplementedError(f"planner: {type(node).__name__}")
+
+
+def _is_pandas_udf(x) -> bool:
+    from spark_rapids_tpu.expr import ir
+    return isinstance(x, ir.PythonUDF) and getattr(x, "vectorized", False)
+
+
+def _extract_pandas_udfs(exprs, child: PhysicalPlan):
+    """ExtractPythonUDFs-rule analog: peel vectorized PythonUDFs out of
+    ``exprs`` into ArrowEvalPython execs below, innermost-first in waves
+    (so chained pandas UDFs each get their own eval stage, like Spark's
+    batched extraction above GpuArrowEvalPythonExec).
+
+    Returns (rewritten_exprs, new_child).
+    """
+    from spark_rapids_tpu.expr import ir
+    from spark_rapids_tpu.pyworker.execs import CpuArrowEvalPythonExec
+
+    counter = [0]
+    while True:
+        # innermost wave = vectorized UDFs with no vectorized descendant
+        wave: list = []
+
+        def visit(x):
+            has_nested = False
+            for c in x.children:
+                has_nested |= visit(c)
+            me = _is_pandas_udf(x)
+            if me and not has_nested and not any(y is x for y in wave):
+                wave.append(x)
+            return me or has_nested
+
+        found_any = False
+        for e in exprs:
+            found_any |= visit(e)
+        if not found_any:
+            return exprs, child
+        base_n = len(child.schema)
+        names = []
+        for _u in wave:
+            names.append(f"_pandas_udf_{counter[0]}")
+            counter[0] += 1
+        child = CpuArrowEvalPythonExec(child, list(zip(names, wave)))
+
+        def replace(x):
+            for i, u in enumerate(wave):
+                if x is u:
+                    return ir.BoundReference(base_n + i, u.return_type,
+                                             True, name_=names[i])
+            return None
+
+        exprs = [ir.transform(e, replace) for e in exprs]
+
+
+def _plan_project(node: lp.Project, child: PhysicalPlan,
+                  conf: RapidsTpuConf) -> PhysicalPlan:
+    """Extract vectorized (pandas) PythonUDFs out of projections into
+    ArrowEvalPython execs below the project."""
+    exprs, child = _extract_pandas_udfs(node.exprs, child)
+    return cpux.CpuProjectExec(child, exprs, node.schema)
+
+
+def _plan_filter(node: lp.Filter, child: PhysicalPlan,
+                 conf: RapidsTpuConf) -> PhysicalPlan:
+    """Filter conditions may contain pandas UDFs too: extract them below
+    the filter, then drop the eval columns with a project so the output
+    schema is unchanged."""
+    from spark_rapids_tpu.expr import ir
+    (cond,), eval_child = _extract_pandas_udfs([node.condition], child)
+    if eval_child is child:
+        return cpux.CpuFilterExec(child, node.condition)
+    filt = cpux.CpuFilterExec(eval_child, cond)
+    keep = [ir.BoundReference(i, f.dtype, f.nullable, name_=f.name)
+            for i, f in enumerate(child.schema.fields)]
+    return cpux.CpuProjectExec(filt, keep, child.schema)
 
 
 def _plan_join(node, conf: RapidsTpuConf):

@@ -1,0 +1,9 @@
+"""Python worker layer: pandas UDFs over Arrow IPC worker processes.
+
+Reference analog (SURVEY.md L9): GPU batches are written as Arrow IPC
+directly to the Python worker socket (GpuArrowEvalPythonExec.scala:422-435)
+and read back (:357); a daemon/worker pair initializes device memory in
+the Python process (python/rapids/worker.py:22-60); and
+``PythonWorkerSemaphore`` bounds concurrent workers on the device
+(python/PythonWorkerSemaphore.scala:41).
+"""

@@ -1,0 +1,437 @@
+"""Pandas-UDF physical execs over Arrow IPC worker processes.
+
+Reference analog (SURVEY.md §2d "Pandas/Python execs (×7)"):
+``GpuArrowEvalPythonExec`` (658 LoC), ``GpuMapInPandasExec``,
+``GpuFlatMapGroupsInPandasExec``, ``GpuFlatMapCoGroupsInPandasExec``,
+``GpuAggregateInPandasExec``, ``GpuWindowInPandasExec`` under
+``sql-plugin/.../execution/python/``.  Shared plumbing:
+``RebatchingRoundoffIterator`` (match the UDF's requested batch rows) and
+``BatchQueue`` (pair inputs with worker outputs)
+(GpuArrowEvalPythonExec.scala:58,178).
+
+These are host-currency execs (pyarrow tables in/out).  The device path
+is the transitions the planner already inserts: a TPU subtree ends in
+DeviceToHostExec, the exec streams Arrow IPC to the worker — the same
+wire the reference puts directly on the socket from device memory
+(Table.writeArrowIPCChunked, GpuArrowEvalPythonExec.scala:422-435) — and
+the next TPU subtree re-uploads.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Iterator, List, Optional, Sequence, Tuple
+
+import numpy as np
+import pyarrow as pa
+
+from spark_rapids_tpu import dtypes as dt
+from spark_rapids_tpu.exec.base import PhysicalPlan, timed
+from spark_rapids_tpu.expr import eval_cpu, ir
+from spark_rapids_tpu.plan.logical import Field, Schema
+from spark_rapids_tpu.pyworker.pool import borrowed_worker
+
+
+# ---------------------------------------------------------------------------
+# Shared plumbing
+# ---------------------------------------------------------------------------
+
+class RebatchingRoundoffIterator:
+    """Re-slice an input stream into batches of exactly ``target_rows``
+    (except the final remainder) —
+    GpuArrowEvalPythonExec.scala:58 RebatchingRoundoffIterator."""
+
+    def __init__(self, it: Iterator[pa.Table], target_rows: int):
+        self._it = it
+        self.target_rows = max(int(target_rows), 1)
+        self._pending: List[pa.Table] = []
+        self._pending_rows = 0
+
+    def __iter__(self):
+        return self
+
+    def __next__(self) -> pa.Table:
+        while self._pending_rows < self.target_rows:
+            try:
+                t = next(self._it)
+            except StopIteration:
+                if self._pending_rows == 0:
+                    raise
+                out = pa.concat_tables(self._pending)
+                self._pending, self._pending_rows = [], 0
+                return out
+            if t.num_rows:
+                self._pending.append(t)
+                self._pending_rows += t.num_rows
+        whole = pa.concat_tables(self._pending)
+        out = whole.slice(0, self.target_rows)
+        rest = whole.slice(self.target_rows)
+        self._pending = [rest] if rest.num_rows else []
+        self._pending_rows = rest.num_rows
+        return out
+
+
+class BatchQueue:
+    """Pairs each input batch with the worker's output for it
+    (GpuArrowEvalPythonExec.scala:178)."""
+
+    def __init__(self):
+        self._q: List[pa.Table] = []
+
+    def push(self, t: pa.Table) -> None:
+        self._q.append(t)
+
+    def pop_pair(self, result: pa.Table) -> Tuple[pa.Table, pa.Table]:
+        inp = self._q.pop(0)
+        if inp.num_rows != result.num_rows:
+            raise ValueError(
+                f"python worker returned {result.num_rows} rows for a "
+                f"{inp.num_rows}-row batch")
+        return inp, result
+
+
+def _cast_result(col: pa.ChunkedArray | pa.Array,
+                 want: dt.DType) -> pa.Array:
+    arr = col.combine_chunks() if isinstance(col, pa.ChunkedArray) else col
+    target = want.to_arrow()
+    if arr.type != target:
+        arr = arr.cast(target)
+    return arr
+
+
+def _schema_to_arrow(schema: Schema) -> pa.Schema:
+    return pa.schema([pa.field(f.name, f.dtype.to_arrow(), f.nullable)
+                      for f in schema.fields])
+
+
+def _conform(t: pa.Table, schema: Schema) -> pa.Table:
+    """Cast/rename a worker result to the declared output schema."""
+    if t.num_columns != len(schema):
+        raise ValueError(
+            f"python worker returned {t.num_columns} columns, declared "
+            f"schema has {len(schema)}")
+    cols = [_cast_result(t.column(i), f.dtype)
+            for i, f in enumerate(schema.fields)]
+    return pa.table(dict(zip(schema.names, cols)),
+                    schema=_schema_to_arrow(schema))
+
+
+def _eval_args(args: Sequence[ir.Expression], t: pa.Table) -> pa.Table:
+    cols = {}
+    for i, e in enumerate(args):
+        v = eval_cpu.evaluate(e, t)
+        cols[f"_a{i}"] = eval_cpu.to_arrow_array(v)
+    return pa.table(cols) if cols else t.select([])
+
+
+# ---------------------------------------------------------------------------
+# ArrowEvalPython: scalar pandas UDFs inside projections
+# ---------------------------------------------------------------------------
+
+class CpuArrowEvalPythonExec(PhysicalPlan):
+    """GpuArrowEvalPythonExec analog: evaluates vectorized PythonUDFs via
+    the worker, emitting child output + one column per UDF."""
+
+    def __init__(self, child: PhysicalPlan,
+                 udfs: List[Tuple[str, ir.PythonUDF]],
+                 batch_rows: int = 10_000):
+        super().__init__()
+        self.children = (child,)
+        self.udfs = udfs
+        self.batch_rows = batch_rows
+        base = child.schema
+        self._schema = Schema(
+            list(base.fields) +
+            [Field(name, u.return_type, True) for name, u in udfs])
+
+    @property
+    def schema(self) -> Schema:
+        return self._schema
+
+    def execute(self):
+        def run(it) -> Iterator[pa.Table]:
+            rebatch = RebatchingRoundoffIterator(it, self.batch_rows)
+            queue = BatchQueue()
+            for t in rebatch:
+                queue.push(t)
+                out_cols = []
+                for name, u in self.udfs:
+                    with borrowed_worker("series", u.func) as w:
+                        args = _eval_args(list(u.children), t)
+                        res = w.run_table(args)
+                    out_cols.append(
+                        (name, _cast_result(res.column(0), u.return_type)))
+                inp, _ = queue.pop_pair(
+                    pa.table({n: c for n, c in out_cols})
+                    if out_cols else t)
+                merged = inp
+                for n, c in out_cols:
+                    merged = merged.append_column(
+                        pa.field(n, c.type, True), c)
+                self.metrics.num_output_rows += merged.num_rows
+                self.metrics.num_output_batches += 1
+                yield merged
+        return [run(it) for it in self.children[0].execute()]
+
+
+# ---------------------------------------------------------------------------
+# MapInPandas
+# ---------------------------------------------------------------------------
+
+class CpuMapInPandasExec(PhysicalPlan):
+    """GpuMapInPandasExec analog: fn(pdf) -> pdf per batch."""
+
+    def __init__(self, child: PhysicalPlan, fn: Callable, schema: Schema,
+                 batch_rows: int = 10_000):
+        super().__init__()
+        self.children = (child,)
+        self.fn = fn
+        self._schema = schema
+        self.batch_rows = batch_rows
+
+    @property
+    def schema(self) -> Schema:
+        return self._schema
+
+    def execute(self):
+        def run(it) -> Iterator[pa.Table]:
+            rebatch = RebatchingRoundoffIterator(it, self.batch_rows)
+            with borrowed_worker("table", self.fn) as w:
+                for t in rebatch:
+                    out = _conform(w.run_table(t), self._schema)
+                    self.metrics.num_output_rows += out.num_rows
+                    self.metrics.num_output_batches += 1
+                    yield out
+        return [run(it) for it in self.children[0].execute()]
+
+
+# ---------------------------------------------------------------------------
+# Grouped execs
+# ---------------------------------------------------------------------------
+
+def _collect_partition(it: Iterator[pa.Table]) -> Optional[pa.Table]:
+    parts = [t for t in it if t.num_rows]
+    if not parts:
+        return None
+    return pa.concat_tables(parts)
+
+
+def _group_slices(t: pa.Table, keys: Sequence[str]
+                  ) -> Iterator[Tuple[tuple, pa.Table]]:
+    """Stable group iteration: sort by keys, emit contiguous slices."""
+    import pyarrow.compute as pc
+    # group contiguity only needs nulls sorted together; placement is
+    # irrelevant, so the deprecated null_placement option is not used
+    idx = pc.sort_indices(t, sort_keys=[(k, "ascending") for k in keys])
+    s = t.take(idx)
+    key_cols = [np.asarray(s.column(k).to_pandas(), dtype=object)
+                for k in keys]
+    n = s.num_rows
+    start = 0
+    for i in range(1, n + 1):
+        if i == n or any(
+                not _key_eq(col[i], col[i - 1]) for col in key_cols):
+            key = tuple(col[start] for col in key_cols)
+            yield key, s.slice(start, i - start)
+            start = i
+
+
+def _key_eq(a, b) -> bool:
+    if a is None or b is None:
+        return a is None and b is None
+    if isinstance(a, float) and isinstance(b, float):
+        return a == b or (np.isnan(a) and np.isnan(b))
+    return a == b
+
+
+class CpuFlatMapGroupsInPandasExec(PhysicalPlan):
+    """GpuFlatMapGroupsInPandasExec analog: fn(group_pdf) -> pdf."""
+
+    def __init__(self, child: PhysicalPlan, keys: List[str], fn: Callable,
+                 schema: Schema):
+        super().__init__()
+        self.children = (child,)
+        self.keys = keys
+        self.fn = fn
+        self._schema = schema
+
+    @property
+    def schema(self) -> Schema:
+        return self._schema
+
+    def execute(self):
+        def run() -> Iterator[pa.Table]:
+            parts = []
+            for it in self.children[0].execute():
+                g = _collect_partition(it)
+                if g is not None:
+                    parts.append(g)
+            if not parts:
+                return
+            whole = pa.concat_tables(parts)
+            outs = []
+            with borrowed_worker("table", self.fn) as w:
+                for _key, grp in _group_slices(whole, self.keys):
+                    outs.append(_conform(w.run_table(grp), self._schema))
+            if outs:
+                out = pa.concat_tables(outs)
+                self.metrics.num_output_rows += out.num_rows
+                self.metrics.num_output_batches += 1
+                yield out
+        return [run()]
+
+
+class CpuFlatMapCoGroupsInPandasExec(PhysicalPlan):
+    """GpuFlatMapCoGroupsInPandasExec analog:
+    fn(left_group_pdf, right_group_pdf) -> pdf over the co-grouped keys."""
+
+    def __init__(self, left: PhysicalPlan, right: PhysicalPlan,
+                 left_keys: List[str], right_keys: List[str], fn: Callable,
+                 schema: Schema):
+        super().__init__()
+        self.children = (left, right)
+        self.left_keys = left_keys
+        self.right_keys = right_keys
+        self.fn = fn
+        self._schema = schema
+
+    @property
+    def schema(self) -> Schema:
+        return self._schema
+
+    def execute(self):
+        def run() -> Iterator[pa.Table]:
+            sides = []
+            for child, keys in ((self.children[0], self.left_keys),
+                                (self.children[1], self.right_keys)):
+                parts = []
+                for it in child.execute():
+                    g = _collect_partition(it)
+                    if g is not None:
+                        parts.append(g)
+                groups = {}
+                if parts:
+                    whole = pa.concat_tables(parts)
+                    for key, grp in _group_slices(whole, keys):
+                        groups[key] = grp
+                    empty = whole.slice(0, 0)
+                else:
+                    empty = None
+                sides.append((groups, empty))
+            (lgroups, lempty), (rgroups, rempty) = sides
+            all_keys = sorted(set(lgroups) | set(rgroups),
+                              key=lambda k: tuple(
+                                  (v is None, v) for v in k))
+            outs = []
+            with borrowed_worker("cogroup", self.fn) as w:
+                for key in all_keys:
+                    lt = lgroups.get(key, lempty)
+                    rt = rgroups.get(key, rempty)
+                    if lt is None or rt is None:
+                        continue
+                    outs.append(_conform(w.run_cogroup(lt, rt),
+                                         self._schema))
+            if outs:
+                out = pa.concat_tables(outs)
+                self.metrics.num_output_rows += out.num_rows
+                self.metrics.num_output_batches += 1
+                yield out
+        return [run()]
+
+
+class CpuAggregateInPandasExec(PhysicalPlan):
+    """GpuAggregateInPandasExec analog: fn(*series) -> scalar per group;
+    output = group keys + result column."""
+
+    def __init__(self, child: PhysicalPlan, keys: List[str], fn: Callable,
+                 args: List[ir.Expression], out_field: Field):
+        super().__init__()
+        self.children = (child,)
+        self.keys = keys
+        self.fn = fn
+        self.args = args
+        self.out_field = out_field
+        base = child.schema
+        self._schema = Schema(
+            [base.field(k) for k in keys] + [out_field])
+
+    @property
+    def schema(self) -> Schema:
+        return self._schema
+
+    def execute(self):
+        def run() -> Iterator[pa.Table]:
+            parts = []
+            for it in self.children[0].execute():
+                g = _collect_partition(it)
+                if g is not None:
+                    parts.append(g)
+            if not parts:
+                return
+            whole = pa.concat_tables(parts)
+            key_rows: List[tuple] = []
+            results: List = []
+            with borrowed_worker("agg_series", self.fn) as w:
+                for key, grp in _group_slices(whole, self.keys):
+                    args = _eval_args(self.args, grp)
+                    res = w.run_table(args)
+                    key_rows.append(key)
+                    results.append(res.column(0)[0].as_py())
+            cols = {}
+            for i, k in enumerate(self.keys):
+                f = self._schema.field(k)
+                cols[k] = pa.array([r[i] for r in key_rows],
+                                   type=f.dtype.to_arrow())
+            cols[self.out_field.name] = pa.array(
+                results, type=self.out_field.dtype.to_arrow())
+            out = pa.table(cols, schema=_schema_to_arrow(self._schema))
+            self.metrics.num_output_rows += out.num_rows
+            self.metrics.num_output_batches += 1
+            yield out
+        return [run()]
+
+
+class CpuWindowInPandasExec(PhysicalPlan):
+    """GpuWindowInPandasExec analog, unbounded-frame case: fn(*series)
+    evaluated once per partition, broadcast to every row (the reference
+    computes pandas window UDFs over whole partitions the same way for
+    unbounded frames, WindowInPandasExec)."""
+
+    def __init__(self, child: PhysicalPlan, part_keys: List[str],
+                 fn: Callable, args: List[ir.Expression], out_field: Field):
+        super().__init__()
+        self.children = (child,)
+        self.part_keys = part_keys
+        self.fn = fn
+        self.args = args
+        self.out_field = out_field
+        base = child.schema
+        self._schema = Schema(list(base.fields) + [out_field])
+
+    @property
+    def schema(self) -> Schema:
+        return self._schema
+
+    def execute(self):
+        def run() -> Iterator[pa.Table]:
+            parts = []
+            for it in self.children[0].execute():
+                g = _collect_partition(it)
+                if g is not None:
+                    parts.append(g)
+            if not parts:
+                return
+            whole = pa.concat_tables(parts)
+            outs = []
+            with borrowed_worker("agg_series", self.fn) as w:
+                for _key, grp in _group_slices(whole, self.part_keys):
+                    args = _eval_args(self.args, grp)
+                    res = w.run_table(args).column(0)[0].as_py()
+                    col = pa.array([res] * grp.num_rows,
+                                   type=self.out_field.dtype.to_arrow())
+                    outs.append(grp.append_column(
+                        pa.field(self.out_field.name, col.type, True), col))
+            out = pa.concat_tables(outs)
+            self.metrics.num_output_rows += out.num_rows
+            self.metrics.num_output_batches += 1
+            yield out
+        return [run()]

@@ -1,0 +1,188 @@
+"""Worker process pool + concurrency semaphore.
+
+Reference analogs: the forking daemon that hands out workers
+(``python/rapids/daemon.py``), and ``PythonWorkerSemaphore`` bounding how
+many Python workers may touch the device at once
+(python/PythonWorkerSemaphore.scala:41).  Workers here never touch the
+TPU (host pandas only), but the semaphore still bounds host memory and
+process fan-out the same way.
+"""
+
+from __future__ import annotations
+
+import atexit
+import os
+import secrets
+import socket
+import struct
+import subprocess
+import sys
+import threading
+from typing import List, Optional, Tuple
+
+import cloudpickle
+import pyarrow as pa
+
+from spark_rapids_tpu.pyworker import worker as wp
+
+
+class PythonWorkerError(RuntimeError):
+    """UDF raised in the worker; carries the remote traceback."""
+
+
+class PythonWorker:
+    """One worker subprocess speaking the frame protocol."""
+
+    def __init__(self):
+        token = secrets.token_bytes(16)
+        lsock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        lsock.bind(("127.0.0.1", 0))
+        lsock.listen(1)
+        port = lsock.getsockname()[1]
+        env = dict(os.environ)
+        # keep workers lean and hermetic: no jax / TPU in the child
+        env["JAX_PLATFORMS"] = "cpu"
+        self.proc = subprocess.Popen(
+            [sys.executable, "-m", "spark_rapids_tpu.pyworker.worker",
+             str(port), token.hex()],
+            env=env, stdin=subprocess.DEVNULL)
+        lsock.settimeout(20.0)
+        self.sock, _ = lsock.accept()
+        lsock.close()
+        got = wp._read_exact(self.sock, len(token))
+        if got != token:
+            raise RuntimeError("python worker auth mismatch")
+        # strong ref: identity comparison is only safe while we prevent
+        # the old fn's id from being reused by a new object
+        self._current: Optional[Tuple[str, object]] = None
+
+    def set_function(self, mode: str, fn) -> None:
+        if (self._current is not None and self._current[0] == mode
+                and self._current[1] is fn):
+            return
+        wp.write_frame(self.sock, wp.OP_FUNC,
+                       cloudpickle.dumps((mode, fn)))
+        op, payload = wp.read_frame(self.sock)
+        if op != wp.OP_OK:
+            raise PythonWorkerError(payload.decode("utf-8", "replace"))
+        self._current = (mode, fn)
+
+    def run(self, payload: bytes) -> pa.Table:
+        wp.write_frame(self.sock, wp.OP_BATCH, payload)
+        op, data = wp.read_frame(self.sock)
+        if op == wp.OP_ERR:
+            raise PythonWorkerError(data.decode("utf-8", "replace"))
+        return wp.ipc_to_table(data)
+
+    def run_table(self, table: pa.Table) -> pa.Table:
+        return self.run(wp.table_to_ipc(table))
+
+    def run_cogroup(self, left: pa.Table, right: pa.Table) -> pa.Table:
+        l = wp.table_to_ipc(left)
+        r = wp.table_to_ipc(right)
+        return self.run(struct.pack("<I", len(l)) + l + r)
+
+    @property
+    def alive(self) -> bool:
+        return self.proc.poll() is None
+
+    def close(self) -> None:
+        try:
+            if self.alive:
+                wp.write_frame(self.sock, wp.OP_END)
+                self.proc.wait(timeout=5)
+        except Exception:
+            self.proc.kill()
+        finally:
+            try:
+                self.sock.close()
+            except OSError:
+                pass
+
+
+class PythonWorkerSemaphore:
+    """Bounds concurrently active workers
+    (python/PythonWorkerSemaphore.scala:41)."""
+
+    def __init__(self, permits: int):
+        self.permits = permits
+        self._sem = threading.Semaphore(permits) if permits > 0 else None
+
+    def __enter__(self):
+        if self._sem is not None:
+            self._sem.acquire()
+        return self
+
+    def __exit__(self, *a):
+        if self._sem is not None:
+            self._sem.release()
+
+
+class PythonWorkerPool:
+    """Reuses idle workers across execs (the daemon-fork role)."""
+
+    _instance: Optional["PythonWorkerPool"] = None
+    _instance_lock = threading.Lock()
+
+    def __init__(self, max_workers: int = 4):
+        self.semaphore = PythonWorkerSemaphore(max_workers)
+        self._idle: List[PythonWorker] = []
+        self._lock = threading.Lock()
+        atexit.register(self.shutdown)
+
+    @classmethod
+    def get(cls) -> "PythonWorkerPool":
+        with cls._instance_lock:
+            if cls._instance is None:
+                cls._instance = PythonWorkerPool()
+            return cls._instance
+
+    def acquire(self) -> PythonWorker:
+        with self._lock:
+            while self._idle:
+                w = self._idle.pop()
+                if w.alive:
+                    return w
+                w.close()
+        return PythonWorker()
+
+    def release(self, w: PythonWorker) -> None:
+        if not w.alive:
+            w.close()
+            return
+        with self._lock:
+            self._idle.append(w)
+
+    def shutdown(self) -> None:
+        with self._lock:
+            workers, self._idle = self._idle, []
+        for w in workers:
+            w.close()
+
+
+class borrowed_worker:
+    """``with borrowed_worker(mode, fn) as w:`` — semaphore + pool + fn
+    handshake in one scope."""
+
+    def __init__(self, mode: str, fn):
+        self.mode = mode
+        self.fn = fn
+        self.pool = PythonWorkerPool.get()
+
+    def __enter__(self) -> PythonWorker:
+        self.pool.semaphore.__enter__()
+        self.worker = self.pool.acquire()
+        try:
+            self.worker.set_function(self.mode, self.fn)
+        except Exception:
+            self.pool.semaphore.__exit__(None, None, None)
+            self.worker.close()
+            raise
+        return self.worker
+
+    def __exit__(self, exc_type, exc, tb):
+        # a failed UDF leaves the worker healthy (it replied OP_ERR);
+        # only a dead process is discarded
+        self.pool.release(self.worker)
+        self.pool.semaphore.__exit__(exc_type, exc, tb)
+        return False

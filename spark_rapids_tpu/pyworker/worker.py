@@ -1,0 +1,136 @@
+"""Worker subprocess: applies pandas UDFs to Arrow IPC batches.
+
+Reference analog: ``python/rapids/worker.py`` + ``daemon.py`` — the
+patched pyspark worker that shares the device with the JVM.  Here the
+worker is pure pandas/pyarrow (it never imports jax; device work stays in
+the parent), fed over a localhost socket with length-prefixed frames:
+
+  OP_FUNC  cloudpickle((mode, fn))     -> OP_OK
+  OP_BATCH mode-specific arrow payload -> OP_BATCH result | OP_ERR msg
+  OP_END                               -> worker exits
+
+Modes:
+  series      fn(*pd.Series) -> pd.Series/ndarray   (scalar pandas UDF)
+  table       fn(pd.DataFrame) -> pd.DataFrame      (map/apply in pandas)
+  agg_series  fn(*pd.Series) -> scalar              (grouped agg UDF)
+  cogroup     fn(left_df, right_df) -> pd.DataFrame (cogrouped map)
+
+Run as: python -m spark_rapids_tpu.pyworker.worker <port> <token-hex>
+"""
+
+from __future__ import annotations
+
+import io
+import socket
+import struct
+import sys
+import traceback
+
+OP_FUNC = 1
+OP_BATCH = 2
+OP_END = 3
+OP_OK = 4
+OP_ERR = 5
+
+
+def read_frame(sock) -> tuple:
+    hdr = _read_exact(sock, 5)
+    op, n = struct.unpack("<BI", hdr)
+    return op, _read_exact(sock, n) if n else b""
+
+
+def write_frame(sock, op: int, payload: bytes = b"") -> None:
+    sock.sendall(struct.pack("<BI", op, len(payload)))
+    if payload:
+        sock.sendall(payload)
+
+
+def _read_exact(sock, n: int) -> bytes:
+    buf = b""
+    while len(buf) < n:
+        chunk = sock.recv(n - len(buf))
+        if not chunk:
+            raise EOFError("peer closed")
+        buf += chunk
+    return buf
+
+
+def table_to_ipc(table) -> bytes:
+    import pyarrow as pa
+    sink = io.BytesIO()
+    with pa.ipc.new_stream(sink, table.schema) as w:
+        w.write_table(table)
+    return sink.getvalue()
+
+
+def ipc_to_table(data: bytes):
+    import pyarrow as pa
+    with pa.ipc.open_stream(io.BytesIO(data)) as r:
+        return r.read_all()
+
+
+def _result_to_table(result, mode: str):
+    """Normalize a UDF result into an Arrow table for the reply."""
+    import pandas as pd
+    import pyarrow as pa
+    if mode in ("table", "cogroup"):
+        if not isinstance(result, pd.DataFrame):
+            raise TypeError(f"expected DataFrame from UDF, got "
+                            f"{type(result).__name__}")
+        return pa.Table.from_pandas(result, preserve_index=False)
+    if mode == "series":
+        if isinstance(result, pd.Series):
+            arr = pa.Array.from_pandas(result)
+        else:
+            arr = pa.array(result)
+        return pa.table({"_0": arr})
+    if mode == "agg_series":
+        return pa.table({"_0": pa.array([result])})
+    raise ValueError(f"unknown mode {mode}")
+
+
+def _apply(fn, mode: str, payload: bytes):
+    if mode == "cogroup":
+        (n1,) = struct.unpack_from("<I", payload, 0)
+        left = ipc_to_table(payload[4:4 + n1]).to_pandas()
+        right = ipc_to_table(payload[4 + n1:]).to_pandas()
+        return _result_to_table(fn(left, right), mode)
+    table = ipc_to_table(payload)
+    if mode == "table":
+        return _result_to_table(fn(table.to_pandas()), mode)
+    series = [table.column(i).to_pandas() for i in range(table.num_columns)]
+    return _result_to_table(fn(*series), mode)
+
+
+def main(port: int, token: bytes) -> None:
+    import cloudpickle  # noqa: F401  (needed for unpickling closures)
+    import pickle
+
+    sock = socket.create_connection(("127.0.0.1", port))
+    sock.sendall(token)
+    fn, mode = None, None
+    while True:
+        op, payload = read_frame(sock)
+        if op == OP_END:
+            break
+        if op == OP_FUNC:
+            try:
+                mode, fn = pickle.loads(payload)
+                write_frame(sock, OP_OK)
+            except Exception:
+                write_frame(sock, OP_ERR,
+                            traceback.format_exc().encode("utf-8"))
+        elif op == OP_BATCH:
+            try:
+                out = _apply(fn, mode, payload)
+                write_frame(sock, OP_BATCH, table_to_ipc(out))
+            except Exception:
+                write_frame(sock, OP_ERR,
+                            traceback.format_exc().encode("utf-8"))
+        else:
+            write_frame(sock, OP_ERR, f"bad opcode {op}".encode())
+    sock.close()
+
+
+if __name__ == "__main__":
+    main(int(sys.argv[1]), bytes.fromhex(sys.argv[2]))

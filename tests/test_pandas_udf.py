@@ -1,0 +1,223 @@
+"""Pandas-UDF layer tests: worker protocol + every exec type.
+
+Reference analogs: udf_cudf/udf integration tests and the python exec
+suite (SURVEY.md §2d Pandas/Python execs, L9 call stack §3.5).
+"""
+
+import numpy as np
+import pandas as pd
+import pyarrow as pa
+import pytest
+
+from spark_rapids_tpu import TpuSparkSession, col, functions as F
+from spark_rapids_tpu.pyworker.execs import RebatchingRoundoffIterator
+from spark_rapids_tpu.pyworker.pool import (PythonWorkerError,
+                                            PythonWorkerPool,
+                                            borrowed_worker)
+
+
+def _session(**extra):
+    return TpuSparkSession({
+        "spark.rapids.tpu.sql.variableFloatAgg.enabled": True, **extra})
+
+
+# ---------------------------------------------------------------------------
+# Rebatching iterator (GpuArrowEvalPythonExec.scala:58 analog)
+# ---------------------------------------------------------------------------
+
+def _tables(sizes):
+    off = 0
+    for s in sizes:
+        yield pa.table({"x": pa.array(range(off, off + s))})
+        off += s
+
+
+def test_rebatching_roundoff_exact_and_remainder():
+    out = list(RebatchingRoundoffIterator(_tables([3, 5, 4]), 4))
+    assert [t.num_rows for t in out] == [4, 4, 4]
+    vals = [v for t in out for v in t.column("x").to_pylist()]
+    assert vals == list(range(12))
+
+
+def test_rebatching_roundoff_small_tail():
+    out = list(RebatchingRoundoffIterator(_tables([2, 2, 3]), 5))
+    assert [t.num_rows for t in out] == [5, 2]
+
+
+def test_rebatching_roundoff_empty():
+    assert list(RebatchingRoundoffIterator(iter([]), 4)) == []
+
+
+# ---------------------------------------------------------------------------
+# Worker protocol
+# ---------------------------------------------------------------------------
+
+def test_worker_roundtrip_and_reuse():
+    pool = PythonWorkerPool.get()
+    with borrowed_worker("series", lambda s: s * 2) as w:
+        out = w.run_table(pa.table({"_a0": [1, 2, 3]}))
+        assert out.column(0).to_pylist() == [2, 4, 6]
+        first = w
+    # the released worker is reused for the next borrow
+    with borrowed_worker("series", lambda s: s + 1) as w2:
+        assert w2 is first
+        out = w2.run_table(pa.table({"_a0": [1, 2]}))
+        assert out.column(0).to_pylist() == [2, 3]
+
+
+def test_worker_udf_error_has_remote_traceback():
+    def boom(s):
+        raise ValueError("kaboom from udf")
+    with borrowed_worker("series", boom) as w:
+        with pytest.raises(PythonWorkerError, match="kaboom from udf"):
+            w.run_table(pa.table({"_a0": [1]}))
+        # worker survives a UDF error and keeps serving
+        w.set_function("series", lambda s: s)
+        out = w.run_table(pa.table({"_a0": [7]}))
+        assert out.column(0).to_pylist() == [7]
+
+
+# ---------------------------------------------------------------------------
+# ArrowEvalPython (scalar pandas UDF in projections)
+# ---------------------------------------------------------------------------
+
+def test_pandas_udf_in_select():
+    s = _session()
+    t = pa.table({"a": pa.array([1.0, 2.0, 3.0]),
+                  "b": pa.array([10.0, 20.0, 30.0])})
+    plus = F.pandas_udf(lambda x, y: x + y, "double")
+    df = s.create_dataframe(t).select(
+        col("a"), plus(col("a"), col("b")).alias("s"))
+    out = df.collect()
+    assert out.column("s").to_pylist() == [11.0, 22.0, 33.0]
+    assert out.column_names == ["a", "s"]
+
+
+def test_pandas_udf_decorator_and_cast():
+    s = _session()
+
+    @F.pandas_udf("long")
+    def doubled(x: pd.Series) -> pd.Series:
+        return x * 2
+
+    t = pa.table({"a": pa.array([1, 2, 3], type=pa.int32())})
+    out = s.create_dataframe(t).select(doubled(col("a")).alias("d")) \
+        .collect()
+    assert out.column("d").type == pa.int64()
+    assert out.column("d").to_pylist() == [2, 4, 6]
+
+
+def test_pandas_udf_composes_with_tpu_exprs():
+    """The UDF column feeds back into ordinary (TPU-eligible) exprs."""
+    s = _session()
+    t = pa.table({"a": pa.array([1.0, 2.0, 3.0, 4.0])})
+    squared = F.pandas_udf(lambda x: x * x, "double")
+    df = (s.create_dataframe(t)
+          .select(col("a"), squared(col("a")).alias("sq"))
+          .filter(col("sq") > 4.0))
+    out = df.collect()
+    assert out.column("sq").to_pylist() == [9.0, 16.0]
+
+
+# ---------------------------------------------------------------------------
+# MapInPandas
+# ---------------------------------------------------------------------------
+
+def test_map_in_pandas():
+    s = _session()
+    t = pa.table({"k": pa.array([1, 2, 3, 4], type=pa.int64()),
+                  "v": pa.array([1.0, 2.0, 3.0, 4.0])})
+
+    def fn(pdf):
+        pdf = pdf[pdf.k % 2 == 0].copy()
+        pdf["w"] = pdf.v * 10
+        return pdf[["k", "w"]]
+
+    out = (s.create_dataframe(t)
+           .map_in_pandas(fn, pa.schema([("k", pa.int64()),
+                                         ("w", pa.float64())]))
+           .collect())
+    assert out.column("k").to_pylist() == [2, 4]
+    assert out.column("w").to_pylist() == [20.0, 40.0]
+
+
+# ---------------------------------------------------------------------------
+# FlatMapGroupsInPandas / AggregateInPandas / WindowInPandas / CoGroup
+# ---------------------------------------------------------------------------
+
+def test_apply_in_pandas_groups():
+    s = _session()
+    t = pa.table({"k": pa.array([0, 1, 0, 1, 0], type=pa.int32()),
+                  "v": pa.array([1.0, 2.0, 3.0, 4.0, 5.0])})
+
+    def center(pdf):
+        pdf = pdf.copy()
+        pdf["v"] = pdf.v - pdf.v.mean()
+        return pdf
+
+    out = (s.create_dataframe(t).group_by("k")
+           .apply_in_pandas(center, pa.schema([("k", pa.int32()),
+                                               ("v", pa.float64())]))
+           .collect().to_pandas().sort_values(["k", "v"]))
+    grp0 = sorted(out[out.k == 0].v)
+    assert np.allclose(grp0, [-2.0, 0.0, 2.0])
+    grp1 = sorted(out[out.k == 1].v)
+    assert np.allclose(grp1, [-1.0, 1.0])
+
+
+def test_agg_in_pandas():
+    s = _session()
+    t = pa.table({"k": pa.array([0, 1, 0, 1], type=pa.int32()),
+                  "v": pa.array([1.0, 2.0, 3.0, 10.0])})
+    out = (s.create_dataframe(t).group_by("k")
+           .agg_in_pandas(lambda v: float(v.median()), [col("v")],
+                          "med", "double")
+           .collect().to_pandas().sort_values("k").reset_index(drop=True))
+    assert list(out.k) == [0, 1]
+    assert list(out.med) == [2.0, 6.0]
+
+
+def test_window_in_pandas():
+    s = _session()
+    t = pa.table({"k": pa.array([0, 1, 0, 1], type=pa.int32()),
+                  "v": pa.array([1.0, 2.0, 3.0, 10.0])})
+    out = (s.create_dataframe(t)
+           .window_in_pandas("k", lambda v: float(v.max()), [col("v")],
+                             "vmax", "double")
+           .collect().to_pandas().sort_values(["k", "v"]))
+    assert (out[out.k == 0].vmax == 3.0).all()
+    assert (out[out.k == 1].vmax == 10.0).all()
+
+
+def test_cogroup_apply_in_pandas():
+    s = _session()
+    left = s.create_dataframe(pa.table(
+        {"k": pa.array([0, 1, 0], type=pa.int32()),
+         "x": pa.array([1.0, 2.0, 3.0])}))
+    right = s.create_dataframe(pa.table(
+        {"k": pa.array([1, 0, 2], type=pa.int32()),
+         "y": pa.array([10.0, 20.0, 30.0])}))
+
+    def merge(l, r):
+        return pd.DataFrame({
+            "k": [int(l.k.iloc[0]) if len(l) else int(r.k.iloc[0])],
+            "sx": [float(l.x.sum())],
+            "sy": [float(r.y.sum())]})
+
+    out = (left.group_by("k").cogroup(right.group_by("k"))
+           .apply_in_pandas(merge, pa.schema([("k", pa.int32()),
+                                              ("sx", pa.float64()),
+                                              ("sy", pa.float64())]))
+           .collect().to_pandas().sort_values("k").reset_index(drop=True))
+    assert list(out.k) == [0, 1, 2]
+    assert list(out.sx) == [4.0, 2.0, 0.0]
+    assert list(out.sy) == [20.0, 10.0, 30.0]
+
+
+def test_pandas_udf_explain_shows_cpu_fallback_reason():
+    s = _session()
+    t = pa.table({"a": pa.array([1.0])})
+    f = F.pandas_udf(lambda x: x, "double")
+    df = s.create_dataframe(t).select(f(col("a")).alias("o"))
+    txt = df.explain_string("tpu")
+    assert "ArrowEvalPython" in txt
