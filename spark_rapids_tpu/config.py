@@ -142,6 +142,14 @@ MAX_READER_BATCH_SIZE_BYTES = conf(
     "spark.rapids.tpu.sql.reader.batchSizeBytes", 2 << 30,
     "Max bytes a file reader emits per batch.", int)
 
+PARQUET_DEVICE_DECODE = conf(
+    "spark.rapids.tpu.sql.format.parquet.deviceDecode.enabled", True,
+    "Decode Parquet pages on the TPU: CPU walks footers/page headers and "
+    "run boundaries, device kernels expand RLE/bit-packed runs, definition "
+    "levels, and dictionary gathers in HBM. Columns with unsupported "
+    "encodings fall back to host Arrow decode individually. (reference: "
+    "Table.readParquet device decode, GpuParquetScan.scala:1022)", bool)
+
 PARQUET_READER_TYPE = conf(
     "spark.rapids.tpu.sql.format.parquet.reader.type", "AUTO",
     "Parquet reader strategy: AUTO, PERFILE, COALESCING, MULTITHREADED. "

@@ -1,0 +1,522 @@
+"""Device-side Parquet decode: pages upload packed, decode runs in HBM.
+
+TPU-native analog of the reference's core scan trick: CPU clips footers and
+reassembles raw column chunks, then `Table.readParquet(hostBuffer)` decodes
+**on device** (reference: GpuParquetScan.scala:456-620 host assembly,
+:1022,1400,1536 device decode via libcudf's CUDA parquet kernels).
+
+Here the CPU walks page headers and RLE/bit-packed run boundaries — O(pages
++ runs), not O(values) — and the O(values) work happens in XLA on TPU:
+
+  * hybrid RLE/bit-pack expansion: `searchsorted` run lookup + 4-byte
+    window gather + shift/mask (vectorized bit-unpack)
+  * definition levels -> validity, then non-null value scatter via
+    `cumsum(validity)` (the two-pass pattern of SURVEY.md §7 hard part #1)
+  * dictionary gather in HBM (including string dictionaries as padded
+    byte-matrix gathers)
+
+Coverage: PLAIN + PLAIN_/RLE_DICTIONARY for INT32/INT64/FLOAT/DOUBLE/
+BOOLEAN, dictionary-encoded BYTE_ARRAY (strings), flat schemas
+(max_rep == 0, max_def <= 1), data pages v1 + v2, any Arrow-supported page
+codec (host decompress — the nvcomp role stays host-side on TPU).  Anything
+else falls back to Arrow host decode *per column*, so one exotic column
+doesn't knock the whole scan off the device path (the reference's
+per-operator fallback philosophy applied at column granularity).
+"""
+
+from __future__ import annotations
+
+import struct
+from dataclasses import dataclass
+from functools import partial
+from typing import List, Optional, Tuple
+
+import numpy as np
+import pyarrow as pa
+import pyarrow.parquet as papq
+
+import jax
+import jax.numpy as jnp
+
+from spark_rapids_tpu import dtypes as dt
+from spark_rapids_tpu.columnar.batch import (DeviceBatch, DeviceColumn,
+                                             bucket_rows, from_arrow)
+from spark_rapids_tpu.io import parquet_meta as pm
+from spark_rapids_tpu.plan.logical import Schema
+
+_MAX_W = 24  # 4-byte gather window supports shift(<=7) + w bits
+
+
+# ---------------------------------------------------------------------------
+# Host side: run walking (O(runs), not O(values))
+# ---------------------------------------------------------------------------
+
+@dataclass
+class RunTable:
+    """Hybrid RLE/bit-pack runs, concatenated across pages of a chunk.
+
+    `bit_base` indexes into the shared `packed` byte buffer for bit-packed
+    runs; `value` holds the repeated value for RLE runs."""
+
+    counts: List[int]
+    is_rle: List[bool]
+    values: List[int]
+    bit_bases: List[int]
+    widths: List[int]
+
+    @staticmethod
+    def empty() -> "RunTable":
+        return RunTable([], [], [], [], [])
+
+    @property
+    def total(self) -> int:
+        return sum(self.counts)
+
+    def trim_to(self, n: int) -> None:
+        """Drop bit-pack padding so total == n (last runs clamp)."""
+        excess = self.total - n
+        while excess > 0 and self.counts:
+            take = min(excess, self.counts[-1])
+            self.counts[-1] -= take
+            excess -= take
+            if self.counts[-1] == 0:
+                for lst in (self.counts, self.is_rle, self.values,
+                            self.bit_bases, self.widths):
+                    lst.pop()
+
+
+def walk_hybrid(buf: bytes, start: int, end: int, w: int,
+                packed: bytearray, runs: RunTable,
+                max_values: Optional[int] = None) -> int:
+    """Walk one page's hybrid stream appending runs; returns values seen.
+
+    Bit-packed byte regions are appended to `packed` so the device sees one
+    contiguous buffer per chunk."""
+    pos = start
+    vbytes = (w + 7) // 8
+    seen = 0
+    while pos < end and (max_values is None or seen < max_values):
+        h = 0
+        shift = 0
+        while True:
+            b = buf[pos]
+            pos += 1
+            h |= (b & 0x7F) << shift
+            if not b & 0x80:
+                break
+            shift += 7
+        if h & 1:  # bit-packed groups
+            groups = h >> 1
+            count = groups * 8
+            nbytes = groups * w
+            runs.counts.append(count)
+            runs.is_rle.append(False)
+            runs.values.append(0)
+            runs.bit_bases.append(len(packed) * 8)
+            runs.widths.append(w)
+            packed += buf[pos:pos + nbytes]
+            pos += nbytes
+        else:  # RLE run
+            count = h >> 1
+            val = int.from_bytes(buf[pos:pos + vbytes], "little") \
+                if vbytes else 0
+            pos += vbytes
+            runs.counts.append(count)
+            runs.is_rle.append(True)
+            runs.values.append(val)
+            runs.bit_bases.append(0)
+            runs.widths.append(w)
+        seen += count
+    return seen
+
+
+def nonnull_count(runs: RunTable, packed: bytes, lo_run: int, hi_run: int,
+                  n: int) -> int:
+    """Host count of def-level==1 entries among the first n values of the
+    run range [lo_run, hi_run) — popcount over bit-packed regions only."""
+    remaining = n
+    nn = 0
+    for i in range(lo_run, hi_run):
+        c = min(runs.counts[i], remaining)
+        if c <= 0:
+            break
+        if runs.is_rle[i]:
+            nn += c if runs.values[i] == 1 else 0
+        else:
+            base = runs.bit_bases[i] // 8
+            nbytes = (c + 7) // 8
+            bits = np.unpackbits(
+                np.frombuffer(packed, dtype=np.uint8,
+                              count=nbytes, offset=base),
+                bitorder="little")[:c]
+            nn += int(bits.sum())
+        remaining -= c
+    return nn
+
+
+# ---------------------------------------------------------------------------
+# Device side: jitted expansion kernels (static shapes per bucket)
+# ---------------------------------------------------------------------------
+
+@partial(jax.jit, static_argnames=("cap",))
+def _expand_runs(packed: jnp.ndarray, run_ends: jnp.ndarray,
+                 run_is_rle: jnp.ndarray, run_value: jnp.ndarray,
+                 run_bit_base: jnp.ndarray, run_w: jnp.ndarray,
+                 cap: int) -> jnp.ndarray:
+    """Expand hybrid runs to a [cap] uint32 vector (device, one pass)."""
+    i = jnp.arange(cap, dtype=jnp.int64)
+    rid = jnp.searchsorted(run_ends, i, side="right")
+    rid = jnp.clip(rid, 0, run_ends.shape[0] - 1)
+    prev_end = jnp.where(rid > 0, jnp.take(run_ends, rid - 1), 0)
+    local = i - prev_end
+    w = jnp.take(run_w, rid)
+    bitpos = jnp.take(run_bit_base, rid) + local * w
+    byte0 = bitpos >> 3
+    sh = (bitpos & 7).astype(jnp.uint32)
+    nb = packed.shape[0]
+    g = lambda k: jnp.take(packed, jnp.clip(byte0 + k, 0, nb - 1)
+                           ).astype(jnp.uint32)
+    window = g(0) | (g(1) << 8) | (g(2) << 16) | (g(3) << 24)
+    mask = ((jnp.uint32(1) << w.astype(jnp.uint32)) - 1)
+    unpacked = (window >> sh) & mask
+    return jnp.where(jnp.take(run_is_rle, rid),
+                     jnp.take(run_value, rid), unpacked)
+
+
+@partial(jax.jit, static_argnames=("cap",))
+def _def_expand(levels: jnp.ndarray, values: jnp.ndarray, n_rows,
+                cap: int) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """validity + per-row values from def levels and non-null-compacted
+    values (cumsum two-pass scatter; values may be 1-D or 2-D)."""
+    row = jnp.arange(cap)
+    valid = (levels == 1) & (row < n_rows)
+    vidx = jnp.cumsum(valid.astype(jnp.int32)) - 1
+    vidx = jnp.clip(vidx, 0, values.shape[0] - 1)
+    out = jnp.take(values, vidx, axis=0)
+    if out.ndim == 2:
+        out = jnp.where(valid[:, None], out, 0)
+    else:
+        out = jnp.where(valid, out, jnp.zeros_like(out))
+    return out, valid
+
+
+@partial(jax.jit, static_argnames=("cap",))
+def _dict_gather(indices: jnp.ndarray, dictionary: jnp.ndarray,
+                 valid: jnp.ndarray, cap: int
+                 ) -> jnp.ndarray:
+    idx = jnp.clip(indices.astype(jnp.int32), 0, dictionary.shape[0] - 1)
+    out = jnp.take(dictionary, idx, axis=0)
+    if out.ndim == 2:
+        return jnp.where(valid[:, None], out, 0)
+    return jnp.where(valid, out, jnp.zeros_like(out))
+
+
+def _pad_np(a: np.ndarray, cap: int, fill=0) -> np.ndarray:
+    if a.shape[0] >= cap:
+        return a[:cap]
+    pad = np.full((cap - a.shape[0],) + a.shape[1:], fill, dtype=a.dtype)
+    return np.concatenate([a, pad], axis=0)
+
+
+def _upload_runs(runs: RunTable, packed: bytes):
+    """Bucket + upload a run table (device arrays)."""
+    r = max(len(runs.counts), 1)
+    rcap = bucket_rows(r, 8)
+    ends = np.cumsum(np.asarray(runs.counts + [0], dtype=np.int64))[:r]
+    dev = dict(
+        run_ends=jnp.asarray(_pad_np(ends, rcap, fill=np.int64(1) << 62)),
+        run_is_rle=jnp.asarray(_pad_np(
+            np.asarray(runs.is_rle + [False], dtype=bool)[:r], rcap)),
+        run_value=jnp.asarray(_pad_np(
+            np.asarray(runs.values + [0], dtype=np.uint32)[:r], rcap)),
+        run_bit_base=jnp.asarray(_pad_np(
+            np.asarray(runs.bit_bases + [0], dtype=np.int64)[:r], rcap)),
+        run_w=jnp.asarray(_pad_np(
+            np.asarray(runs.widths + [0], dtype=np.int64)[:r], rcap)),
+    )
+    bcap = bucket_rows(max(len(packed), 4), 64)
+    dev["packed"] = jnp.asarray(_pad_np(
+        np.frombuffer(bytes(packed), dtype=np.uint8), bcap))
+    return dev
+
+
+# ---------------------------------------------------------------------------
+# Per-chunk decode
+# ---------------------------------------------------------------------------
+
+_PLAIN_NP = {"INT32": np.dtype("<i4"), "INT64": np.dtype("<i8"),
+             "FLOAT": np.dtype("<f4"), "DOUBLE": np.dtype("<f8")}
+
+
+class UnsupportedChunk(Exception):
+    pass
+
+
+def _parse_plain_byte_array(buf: bytes, n: int) -> List[bytes]:
+    out = []
+    pos = 0
+    for _ in range(n):
+        ln = struct.unpack_from("<I", buf, pos)[0]
+        pos += 4
+        out.append(buf[pos:pos + ln])
+        pos += ln
+    return out
+
+
+def _string_dict_matrix(vals: List[bytes]) -> Tuple[np.ndarray, np.ndarray]:
+    from spark_rapids_tpu.columnar.batch import _bucket_strlen
+    max_len = _bucket_strlen(max((len(v) for v in vals), default=1))
+    mat = np.zeros((max(len(vals), 1), max_len), dtype=np.uint8)
+    lens = np.zeros((max(len(vals), 1),), dtype=np.int32)
+    for i, v in enumerate(vals):
+        mat[i, :len(v)] = np.frombuffer(v, dtype=np.uint8)
+        lens[i] = len(v)
+    return mat, lens
+
+
+def decode_chunk(chunk: pm.ChunkPages, out_dtype: dt.DType,
+                 cap: int) -> DeviceColumn:
+    """Decode one flat column chunk into a DeviceColumn of capacity cap."""
+    if chunk.max_rep > 0 or chunk.max_def > 1:
+        raise UnsupportedChunk("nested column")
+    ptype = chunk.physical_type
+    if ptype not in _PLAIN_NP and ptype != "BOOLEAN" and \
+            ptype != "BYTE_ARRAY":
+        raise UnsupportedChunk(f"physical type {ptype}")
+    lt = chunk.logical_type
+    if "Decimal" in lt or "Time(" in lt or "isSigned=false" in lt or \
+            ("Timestamp" in lt and "micro" not in lt):
+        # value transforms the device path doesn't do (unit scaling,
+        # unsigned reinterpretation, decimal) — host Arrow handles them
+        raise UnsupportedChunk(f"logical type {lt}")
+
+    # -- dictionary page (host parse; dictionaries are small) --------------
+    dict_np = None
+    dict_lens = None
+    if chunk.dict_page is not None:
+        dp = chunk.dict_page
+        payload = pm.decompress(
+            chunk.codec, chunk.data[dp.payload_off:
+                                    dp.payload_off + dp.compressed_size],
+            dp.uncompressed_size)
+        if ptype == "BYTE_ARRAY":
+            vals = _parse_plain_byte_array(payload, dp.num_values)
+            dict_np, dict_lens = _string_dict_matrix(vals)
+        else:
+            dict_np = np.frombuffer(payload, dtype=_PLAIN_NP[ptype],
+                                    count=dp.num_values).copy()
+            if dict_np.shape[0] == 0:  # all-null chunk: empty dictionary
+                dict_np = np.zeros((1,), dtype=_PLAIN_NP[ptype])
+
+    nullable = chunk.max_def == 1
+    def_runs = RunTable.empty()
+    def_packed = bytearray()
+    idx_runs = RunTable.empty()
+    idx_packed = bytearray()
+    plain_parts: List[bytes] = []   # PLAIN value byte regions
+    bool_runs = RunTable.empty()    # BOOLEAN PLAIN == w=1 bit-pack runs
+    bool_packed = bytearray()
+    n_rows = 0
+    n_nonnull_plain = 0
+    idx_target = 0   # expected cumulative values in the index stream
+    bool_target = 0
+    any_dict = False
+    any_plain = False
+
+    for page in chunk.data_pages:
+        raw = chunk.data[page.payload_off:
+                         page.payload_off + page.compressed_size]
+        if page.page_type == pm.DATA_PAGE_V2:
+            lvl = page.v2_rep_bytes + page.v2_def_bytes
+            levels_buf = raw[:lvl]
+            if page.v2_is_compressed:
+                vals_buf = pm.decompress(chunk.codec, raw[lvl:],
+                                         page.uncompressed_size - lvl)
+            else:
+                vals_buf = raw[lvl:]
+            def_start, def_end = page.v2_rep_bytes, lvl
+        else:
+            payload = pm.decompress(chunk.codec, raw,
+                                    page.uncompressed_size)
+            levels_buf = payload
+            if nullable:
+                dlen = struct.unpack_from("<I", payload, 0)[0]
+                def_start, def_end = 4, 4 + dlen
+                vals_buf = payload[def_end:]
+            else:
+                def_start = def_end = 0
+                vals_buf = payload
+
+        lo = len(def_runs.counts)
+        if nullable:
+            walk_hybrid(levels_buf, def_start, def_end, 1,
+                        def_packed, def_runs)
+            def_runs.trim_to(n_rows + page.num_values)
+            nn = nonnull_count(def_runs, bytes(def_packed), lo,
+                               len(def_runs.counts), page.num_values)
+        else:
+            nn = page.num_values
+        n_rows += page.num_values
+
+        enc = page.encoding
+        if enc in (pm.PLAIN_DICTIONARY, pm.RLE_DICTIONARY):
+            if dict_np is None:
+                raise UnsupportedChunk("dict-encoded page w/o dictionary")
+            any_dict = True
+            w = vals_buf[0]
+            if w > _MAX_W:
+                raise UnsupportedChunk(f"dict bit width {w}")
+            walk_hybrid(vals_buf, 1, len(vals_buf), w, idx_packed,
+                        idx_runs)
+            # trim this page's bit-pack group-of-8 padding
+            idx_target += nn
+            idx_runs.trim_to(idx_target)
+        elif enc == pm.PLAIN:
+            any_plain = True
+            if ptype == "BOOLEAN":
+                groups = (nn + 7) // 8
+                bool_runs.counts.append(groups * 8)
+                bool_runs.is_rle.append(False)
+                bool_runs.values.append(0)
+                bool_runs.bit_bases.append(len(bool_packed) * 8)
+                bool_runs.widths.append(1)
+                bool_packed += vals_buf[:groups]
+                bool_target += nn
+                bool_runs.trim_to(bool_target)
+            elif ptype == "BYTE_ARRAY":
+                raise UnsupportedChunk("PLAIN byte_array page")
+            else:
+                itemsize = _PLAIN_NP[ptype].itemsize
+                plain_parts.append(vals_buf[:nn * itemsize])
+            n_nonnull_plain += nn
+        else:
+            raise UnsupportedChunk(f"encoding {enc}")
+
+    if any_dict and any_plain:
+        raise UnsupportedChunk("mixed dict+plain pages")  # rare; fallback
+
+    # -- device expansion ---------------------------------------------------
+    vcap = bucket_rows(max(n_rows, 1))
+    if nullable:
+        dev = _upload_runs(def_runs, bytes(def_packed))
+        levels = _expand_runs(dev["packed"], dev["run_ends"],
+                              dev["run_is_rle"], dev["run_value"],
+                              dev["run_bit_base"], dev["run_w"], cap=vcap)
+    else:
+        levels = None
+
+    np_t = out_dtype.to_np() if not out_dtype.is_string else None
+
+    if any_dict:
+        dev = _upload_runs(idx_runs, bytes(idx_packed))
+        indices = _expand_runs(dev["packed"], dev["run_ends"],
+                               dev["run_is_rle"], dev["run_value"],
+                               dev["run_bit_base"], dev["run_w"], cap=vcap)
+        if nullable:
+            indices, valid = _def_expand(levels, indices, n_rows, cap=vcap)
+        else:
+            valid = jnp.arange(vcap) < n_rows
+        if out_dtype.is_string:
+            d_mat = jnp.asarray(dict_np)
+            d_len = jnp.asarray(dict_lens)
+            data = _dict_gather(indices, d_mat, valid, cap=vcap)
+            lengths = _dict_gather(indices, d_len, valid, cap=vcap)
+            return _to_cap(DeviceColumn(out_dtype, data, valid,
+                                        lengths.astype(jnp.int32)), cap)
+        d_vals = jnp.asarray(dict_np.astype(np_t, copy=False))
+        data = _dict_gather(indices, d_vals, valid, cap=vcap)
+        return _to_cap(DeviceColumn(out_dtype, data, valid), cap)
+
+    if ptype == "BOOLEAN":
+        dev = _upload_runs(bool_runs, bytes(bool_packed))
+        bits = _expand_runs(dev["packed"], dev["run_ends"],
+                            dev["run_is_rle"], dev["run_value"],
+                            dev["run_bit_base"], dev["run_w"], cap=vcap)
+        vals = bits.astype(jnp.bool_)
+    else:
+        raw = b"".join(plain_parts)
+        npvals = np.frombuffer(raw, dtype=_PLAIN_NP[ptype],
+                               count=n_nonnull_plain)
+        vals = jnp.asarray(_pad_np(npvals.copy(), vcap))
+
+    if nullable:
+        data, valid = _def_expand(levels, vals, n_rows, cap=vcap)
+    else:
+        data, valid = vals, jnp.arange(vcap) < n_rows
+        if data.ndim == 1:
+            data = jnp.where(valid, data, jnp.zeros_like(data))
+    data = data.astype(np_t)
+    return _to_cap(DeviceColumn(out_dtype, data, valid), cap)
+
+
+def _to_cap(col: DeviceColumn, cap: int) -> DeviceColumn:
+    """Re-bucket a column to the batch capacity."""
+    if col.capacity == cap:
+        return col
+    idx = jnp.arange(cap)
+    valid_src = idx < col.capacity
+    gidx = jnp.clip(idx, 0, col.capacity - 1)
+    return col.gather(gidx, valid_src & jnp.take(
+        jnp.ones((col.capacity,), dtype=bool), gidx))
+
+
+# ---------------------------------------------------------------------------
+# File-level API
+# ---------------------------------------------------------------------------
+
+def decode_row_group(path: str, row_group: int, schema: Schema,
+                     columns: Optional[List[str]] = None,
+                     parquet_file: Optional[papq.ParquetFile] = None
+                     ) -> Tuple[DeviceBatch, List[str]]:
+    """Decode one row group to a DeviceBatch.
+
+    Returns (batch, fallback_columns) — fallback columns were host-decoded
+    (Arrow) because their chunks use unsupported encodings/types."""
+    pf = parquet_file or papq.ParquetFile(path)
+    md = pf.metadata
+    names = [md.schema.column(i).path for i in range(md.num_columns)]
+    wanted = columns or [f.name for f in schema.fields]
+    n_rows = md.row_group(row_group).num_rows
+    cap = bucket_rows(max(n_rows, 1))
+
+    cols: List[DeviceColumn] = []
+    out_names: List[str] = []
+    fallbacks: List[str] = []
+    for name in wanted:
+        f = schema.field(name)
+        if name not in names:
+            # partition or missing column: all-null
+            npd = f.dtype.to_np() if not f.dtype.is_string else np.uint8
+            if f.dtype.is_string:
+                data = jnp.zeros((cap, 1), dtype=jnp.uint8)
+                col = DeviceColumn(f.dtype, data,
+                                   jnp.zeros((cap,), dtype=bool),
+                                   jnp.zeros((cap,), dtype=jnp.int32))
+            else:
+                col = DeviceColumn(f.dtype, jnp.zeros((cap,), dtype=npd),
+                                   jnp.zeros((cap,), dtype=bool))
+            cols.append(col)
+            out_names.append(name)
+            continue
+        ci = names.index(name)
+        try:
+            chunk = pm.read_chunk_pages(path, row_group, ci,
+                                        parquet_file=pf)
+            col = decode_chunk(chunk, f.dtype, cap)
+        except Exception:
+            # UnsupportedChunk or any malformed-page surprise: this column
+            # decodes on host; the rest of the batch stays on device
+            fallbacks.append(name)
+            t = pf.read_row_group(row_group, columns=[name])
+            sub = from_arrow(_cast_one(t, f), capacity=cap)
+            col = sub.columns[0]
+        cols.append(col)
+        out_names.append(name)
+    return DeviceBatch(out_names, cols, n_rows), fallbacks
+
+
+def _cast_one(t: pa.Table, f) -> pa.Table:
+    col = t.column(0).cast(f.dtype.to_arrow())
+    return pa.Table.from_arrays(
+        [col], schema=pa.schema([pa.field(f.name, f.dtype.to_arrow(),
+                                          f.nullable)]))

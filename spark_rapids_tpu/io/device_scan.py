@@ -1,0 +1,117 @@
+"""TPU Parquet scan exec: the file scan whose decode runs on device.
+
+Analog of ``GpuFileSourceScanExec`` + ``Table.readParquet`` (reference:
+GpuFileSourceScanExec.scala:372, GpuParquetScan.scala:1022): the reader
+uploads packed page bytes and decodes in HBM (io/device_parquet.py) instead
+of decoding on host and uploading decoded columns.  One plan partition per
+file (PERFILE); batches are emitted per row group — downstream
+TpuCoalesceBatchesExec re-sizes them to the CoalesceGoal exactly as the
+reference inserts GpuCoalesceBatches after scans.
+
+Hive partition-value columns are appended as device constant columns
+(ColumnarPartitionReaderWithPartitionValues analog)."""
+
+from __future__ import annotations
+
+from typing import Iterator, List, Optional
+
+import numpy as np
+import pyarrow.parquet as papq
+
+import jax.numpy as jnp
+
+from spark_rapids_tpu import config as cfg
+from spark_rapids_tpu import dtypes as dt
+from spark_rapids_tpu.columnar.batch import (DeviceBatch, DeviceColumn,
+                                             _bucket_strlen)
+from spark_rapids_tpu.exec.base import TpuExec, timed
+from spark_rapids_tpu.io import device_parquet as devpq
+from spark_rapids_tpu.mem.device import tpu_semaphore
+from spark_rapids_tpu.plan.logical import FileScan, Schema
+
+
+def _const_column(dtype: dt.DType, raw: Optional[str], cap: int,
+                  n_rows: int) -> DeviceColumn:
+    """Device constant column for one partition value."""
+    row_valid = jnp.arange(cap) < n_rows
+    if raw is None:
+        if dtype.is_string:
+            return DeviceColumn(dtype, jnp.zeros((cap, 1), dtype=jnp.uint8),
+                                jnp.zeros((cap,), dtype=bool),
+                                jnp.zeros((cap,), dtype=jnp.int32))
+        return DeviceColumn(dtype,
+                            jnp.zeros((cap,), dtype=dtype.to_np()),
+                            jnp.zeros((cap,), dtype=bool))
+    if dtype.is_string:
+        b = raw.encode("utf-8")
+        ml = _bucket_strlen(len(b))
+        row = np.zeros((ml,), dtype=np.uint8)
+        row[:len(b)] = np.frombuffer(b, dtype=np.uint8)
+        data = jnp.broadcast_to(jnp.asarray(row), (cap, ml))
+        lens = jnp.where(row_valid, np.int32(len(b)), 0)
+        return DeviceColumn(dtype, data, row_valid, lens)
+    val = np.asarray(raw, dtype=dtype.to_np()) if dtype.to_np().kind != "i" \
+        else np.asarray(int(raw), dtype=dtype.to_np())
+    data = jnp.where(row_valid, jnp.asarray(val),
+                     jnp.zeros((), dtype=dtype.to_np()))
+    return DeviceColumn(dtype, data, row_valid)
+
+
+class TpuParquetScanExec(TpuExec):
+    """Device-decoding parquet scan (is_tpu — yields DeviceBatch)."""
+
+    def __init__(self, scan: FileScan, conf):
+        super().__init__()
+        self.scan = scan
+        self.conf = conf
+        self.columns = scan.options.get("columns")
+        self._schema = scan.schema if not self.columns else Schema(
+            [scan.schema.field(c) for c in self.columns])
+        self.part_fields = dict(scan.options.get("part_fields") or [])
+        self.metrics.extra["fallbackColumns"] = 0
+        self.metrics.extra["decodeTime"] = 0.0
+
+    @property
+    def schema(self) -> Schema:
+        return self._schema
+
+    def _file_part(self, file_index: int) -> Iterator[DeviceBatch]:
+        path = self.scan.paths[file_index]
+        pv_list = self.scan.options.get("part_values") or []
+        pv = pv_list[file_index] if file_index < len(pv_list) else {}
+        wanted = [f.name for f in self._schema.fields]
+        part_cols = [c for c in wanted if c in self.part_fields]
+        file_cols = [c for c in wanted if c not in self.part_fields]
+        file_schema = Schema([self._schema.field(c) for c in file_cols])
+        pf = papq.ParquetFile(path)
+        for rg in range(pf.metadata.num_row_groups):
+            with tpu_semaphore():
+                with timed(self.metrics):
+                    batch, fallbacks = devpq.decode_row_group(
+                        path, rg, file_schema, columns=file_cols,
+                        parquet_file=pf)
+                self.metrics.extra["fallbackColumns"] += len(fallbacks)
+                cap = batch.capacity
+                names = list(batch.names)
+                cols = list(batch.columns)
+                for c in part_cols:
+                    d = self.part_fields[c]
+                    names.append(c)
+                    cols.append(_const_column(d, pv.get(c), cap,
+                                              int(batch.num_rows)))
+                # restore requested column order
+                order = [names.index(c) for c in wanted]
+                out = DeviceBatch([names[i] for i in order],
+                                  [cols[i] for i in order],
+                                  batch.num_rows)
+                self.metrics.num_output_rows += int(out.num_rows)
+                self.metrics.num_output_batches += 1
+                yield out
+
+    def execute(self) -> List[Iterator[DeviceBatch]]:
+        return [self._file_part(i)
+                for i in range(len(self.scan.paths))]
+
+    def simple_string(self) -> str:
+        return (f"TpuParquetScanExec(files={len(self.scan.paths)}, "
+                f"deviceDecode)")

@@ -420,6 +420,32 @@ def _make_tpu_exchange(n, ch, conf):
 _register_exchange_rule()
 
 
+def _register_file_scan_rule():
+    from spark_rapids_tpu.io.readers import CpuFileScanExec
+    from spark_rapids_tpu.io.device_scan import TpuParquetScanExec
+
+    def _tag_scan(n, conf) -> List[str]:
+        out = []
+        if n.scan.fmt != "parquet":
+            out.append(f"{n.scan.fmt} scans decode on host "
+                       "(device decode is parquet-only)")
+        if not conf.get(cfg.PARQUET_DEVICE_DECODE):
+            out.append("parquet device decode disabled by "
+                       f"{cfg.PARQUET_DEVICE_DECODE.key}")
+        return out
+
+    register_exec_rule(CpuFileScanExec, ExecRule(
+        "FileSourceScanExec",
+        "TPU parquet scan: packed pages upload, RLE/dictionary/def-level "
+        "decode in HBM (Table.readParquet analog)",
+        _no_exprs,
+        convert=lambda n, ch, conf: TpuParquetScanExec(n.scan, conf),
+        extra_tag=_tag_scan))
+
+
+_register_file_scan_rule()
+
+
 # ---------------------------------------------------------------------------
 # Meta tree
 # ---------------------------------------------------------------------------

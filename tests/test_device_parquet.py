@@ -1,0 +1,201 @@
+"""Device parquet decode vs pyarrow golden (reference test model:
+integration_tests parquet_test.py — CPU-vs-accelerated equality)."""
+
+import numpy as np
+import pyarrow as pa
+import pyarrow.parquet as papq
+import pytest
+
+from spark_rapids_tpu import dtypes as dt
+from spark_rapids_tpu.columnar.batch import to_arrow
+from spark_rapids_tpu.io import device_parquet as devpq
+from spark_rapids_tpu.io import parquet_meta as pm
+from spark_rapids_tpu.plan.logical import Schema
+
+from tests.parity import assert_tables_equal
+
+
+def _roundtrip(tmp_path, table: pa.Table, expect_fallback=(), **write_kw):
+    path = str(tmp_path / "t.parquet")
+    papq.write_table(table, path, **write_kw)
+    schema = Schema.from_arrow(table.schema)
+    batch, fallbacks = devpq.decode_row_group(path, 0, schema)
+    assert sorted(fallbacks) == sorted(expect_fallback), fallbacks
+    got = to_arrow(batch)
+    assert_tables_equal(got, table.cast(got.schema))
+    return batch
+
+
+def test_plain_int_float(tmp_path):
+    rng = np.random.default_rng(0)
+    t = pa.table({
+        "i32": pa.array(rng.integers(-1000, 1000, 500), pa.int32()),
+        "i64": pa.array(rng.integers(-10**12, 10**12, 500), pa.int64()),
+        "f32": pa.array(rng.normal(size=500).astype(np.float32)),
+        "f64": pa.array(rng.normal(size=500)),
+    })
+    # dictionary off => PLAIN pages
+    _roundtrip(tmp_path, t, use_dictionary=False)
+
+
+def test_dictionary_encoded(tmp_path):
+    rng = np.random.default_rng(1)
+    t = pa.table({
+        "k": pa.array(rng.integers(0, 50, 5000), pa.int64()),
+        "v": pa.array(rng.choice([1.5, 2.5, 3.5, 4.5], 5000)),
+    })
+    _roundtrip(tmp_path, t)  # pyarrow defaults to dict encoding
+
+
+def test_nulls_plain_and_dict(tmp_path):
+    rng = np.random.default_rng(2)
+    n = 3000
+    vals = rng.integers(0, 30, n).astype(np.int64)
+    mask = rng.random(n) < 0.3
+    arr = pa.array(vals, mask=mask)
+    fl = pa.array(rng.normal(size=n), mask=rng.random(n) < 0.5)
+    t = pa.table({"a": arr, "b": fl})
+    _roundtrip(tmp_path, t)
+    _roundtrip(tmp_path, t, use_dictionary=False)
+
+
+def test_all_null_column(tmp_path):
+    t = pa.table({"a": pa.array([None] * 100, pa.int32()),
+                  "b": pa.array(range(100), pa.int64())})
+    _roundtrip(tmp_path, t)
+
+
+def test_string_dictionary(tmp_path):
+    rng = np.random.default_rng(3)
+    words = ["alpha", "beta", "gamma", "", "delta-very-long-value-here"]
+    vals = [words[i] for i in rng.integers(0, len(words), 2000)]
+    mask = rng.random(2000) < 0.2
+    arr = pa.array([None if m else v for v, m in zip(vals, mask)],
+                   pa.string())
+    t = pa.table({"s": arr, "x": pa.array(range(2000), pa.int64())})
+    _roundtrip(tmp_path, t)
+
+
+def test_string_plain_falls_back(tmp_path):
+    # dictionary disabled => PLAIN byte_array pages => host fallback,
+    # but only for that column
+    t = pa.table({"s": pa.array(["a", "bb", None, "cccc"] * 50),
+                  "x": pa.array(range(200), pa.int64())})
+    _roundtrip(tmp_path, t, use_dictionary=False, expect_fallback=["s"])
+
+
+def test_boolean_plain(tmp_path):
+    rng = np.random.default_rng(4)
+    vals = rng.random(1000) < 0.5
+    mask = rng.random(1000) < 0.25
+    t = pa.table({"b": pa.array(vals, mask=mask),
+                  "c": pa.array(vals)})
+    _roundtrip(tmp_path, t, use_dictionary=False)
+
+
+def test_snappy_compression(tmp_path):
+    rng = np.random.default_rng(5)
+    t = pa.table({"k": pa.array(rng.integers(0, 10, 4000), pa.int32()),
+                  "v": pa.array(rng.normal(size=4000))})
+    _roundtrip(tmp_path, t, compression="snappy")
+
+
+def test_uncompressed_and_zstd(tmp_path):
+    rng = np.random.default_rng(6)
+    t = pa.table({"v": pa.array(rng.integers(0, 5, 2000), pa.int64())})
+    _roundtrip(tmp_path, t, compression="none")
+    _roundtrip(tmp_path, t, compression="zstd")
+
+
+def test_date_and_timestamp(tmp_path):
+    import datetime
+    base = datetime.date(2020, 1, 1)
+    dates = pa.array([base + datetime.timedelta(days=int(i))
+                      for i in range(300)])
+    ts = pa.array(
+        [datetime.datetime(2021, 1, 1, tzinfo=datetime.timezone.utc) +
+         datetime.timedelta(seconds=int(i)) for i in range(300)],
+        pa.timestamp("us", tz="UTC"))
+    t = pa.table({"d": dates, "ts": ts})
+    _roundtrip(tmp_path, t)
+
+
+def test_multiple_row_groups_and_pages(tmp_path):
+    rng = np.random.default_rng(7)
+    n = 50_000
+    t = pa.table({
+        "k": pa.array(rng.integers(0, 100, n), pa.int32()),
+        "v": pa.array(rng.normal(size=n),
+                      mask=rng.random(n) < 0.1),
+    })
+    path = str(tmp_path / "t.parquet")
+    papq.write_table(t, path, row_group_size=16_000,
+                     data_page_size=4_000)
+    schema = Schema.from_arrow(t.schema)
+    pf = papq.ParquetFile(path)
+    got = []
+    for rg in range(pf.metadata.num_row_groups):
+        batch, fb = devpq.decode_row_group(path, rg, schema,
+                                           parquet_file=pf)
+        assert not fb
+        got.append(to_arrow(batch))
+    assert_tables_equal(pa.concat_tables(got), t)
+
+
+def test_column_pruning(tmp_path):
+    t = pa.table({"a": pa.array(range(100), pa.int64()),
+                  "b": pa.array(np.arange(100.0)),
+                  "c": pa.array(["x"] * 100)})
+    path = str(tmp_path / "t.parquet")
+    papq.write_table(t, path)
+    schema = Schema.from_arrow(pa.schema([t.schema.field("b")]))
+    batch, fb = devpq.decode_row_group(path, 0, schema, columns=["b"])
+    assert batch.names == ["b"]
+    assert_tables_equal(to_arrow(batch), t.select(["b"]))
+
+
+def test_page_header_parser_roundtrip(tmp_path):
+    t = pa.table({"v": pa.array(range(1000), pa.int64())})
+    path = str(tmp_path / "t.parquet")
+    papq.write_table(t, path)
+    chunk = pm.read_chunk_pages(path, 0, 0)
+    assert chunk.physical_type == "INT64"
+    assert chunk.num_values == 1000
+    assert sum(p.num_values for p in chunk.data_pages) == 1000
+
+
+def test_e2e_session_device_scan(tmp_path, session):
+    """Full pipeline: device scan -> filter -> aggregate via the API."""
+    from spark_rapids_tpu import functions as F  # noqa
+    rng = np.random.default_rng(8)
+    n = 5000
+    t = pa.table({
+        "k": pa.array(rng.integers(0, 20, n), pa.int32()),
+        "price": pa.array(rng.uniform(0, 100, n)),
+    })
+    path = str(tmp_path / "data.parquet")
+    papq.write_table(t, path)
+    df = session.read.parquet(path)
+    out = df.filter(F.col("price") > 50.0) \
+        .group_by("k").agg(F.count(F.lit(1)).alias("n")).collect()
+    # golden via pyarrow
+    import pyarrow.compute as pc
+    ft = t.filter(pc.greater(t.column("price"), 50.0))
+    golden = ft.group_by("k").aggregate([("k", "count")])
+    got = {r["k"]: r["n"] for r in out.to_pylist()}
+    want = {r["k"]: r["k_count"] for r in golden.to_pylist()}
+    assert got == want
+
+
+def test_data_page_v2(tmp_path):
+    rng = np.random.default_rng(9)
+    n = 8000
+    t = pa.table({
+        "k": pa.array(rng.integers(0, 40, n), pa.int32(),
+                      mask=rng.random(n) < 0.2),
+        "v": pa.array(rng.normal(size=n)),
+    })
+    _roundtrip(tmp_path, t, data_page_version="2.0",
+               compression="snappy")
+    _roundtrip(tmp_path, t, data_page_version="2.0",
+               compression="none", use_dictionary=False)
