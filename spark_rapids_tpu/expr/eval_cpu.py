@@ -891,6 +891,13 @@ def _py_value(v: CpuVal, i: int) -> Any:
 
 
 def _python_udf(e: "ir.PythonUDF", table):
+    if getattr(e, "vectorized", False):
+        # a pandas UDF must be extracted into an ArrowEvalPython exec by
+        # the planner; evaluating it row-wise would hand scalars to a
+        # function expecting Series — fail loudly instead of silently
+        raise NotImplementedError(
+            f"pandas UDF {e.udf_name!r} in an unsupported position "
+            "(supported: projections, filters, sort keys, aggregate args)")
     args = [evaluate(c, table) for c in e.children]
     n = table.num_rows
     rt = e.return_type

@@ -29,7 +29,7 @@ def plan_cpu(node: lp.LogicalPlan, conf: RapidsTpuConf) -> PhysicalPlan:
         return _plan_filter(node, child, conf)
     if isinstance(node, lp.Sort):
         child = plan_cpu(node.children[0], conf)
-        return cpux.CpuSortExec(child, node.orders)
+        return _plan_sort(node, child, conf)
     if isinstance(node, lp.Aggregate):
         child = plan_cpu(node.children[0], conf)
         from spark_rapids_tpu.expr import ir
@@ -41,7 +41,18 @@ def plan_cpu(node: lp.LogicalPlan, conf: RapidsTpuConf) -> PhysicalPlan:
                     "aggregate expressions must be plain aggregate "
                     "functions (optionally aliased) for now")
             aggs.append(inner)
-        return cpux.CpuHashAggregateExec(child, node.groupings, aggs,
+        # pandas UDFs in grouping keys / aggregate args evaluate in an
+        # ArrowEvalPython stage below the aggregate
+        flat = list(node.groupings) + \
+            [a.children[0] for a in aggs if a.children]
+        new_flat, child = _extract_pandas_udfs(flat, child)
+        groupings = new_flat[:len(node.groupings)]
+        k = len(node.groupings)
+        for a in aggs:
+            if a.children:
+                a.children = (new_flat[k],)
+                k += 1
+        return cpux.CpuHashAggregateExec(child, groupings, aggs,
                                          node.schema)
     if isinstance(node, lp.Limit):
         child = plan_cpu(node.children[0], conf)
@@ -186,6 +197,23 @@ def _plan_filter(node: lp.Filter, child: PhysicalPlan,
     keep = [ir.BoundReference(i, f.dtype, f.nullable, name_=f.name)
             for i, f in enumerate(child.schema.fields)]
     return cpux.CpuProjectExec(filt, keep, child.schema)
+
+
+def _plan_sort(node: lp.Sort, child: PhysicalPlan,
+               conf: RapidsTpuConf) -> PhysicalPlan:
+    """Sort keys may contain pandas UDFs: evaluate them below the sort,
+    then project the eval columns away."""
+    from spark_rapids_tpu.expr import ir
+    exprs = [o.expr for o in node.orders]
+    new_exprs, eval_child = _extract_pandas_udfs(exprs, child)
+    if eval_child is child:
+        return cpux.CpuSortExec(child, node.orders)
+    orders = [lp.SortOrder(e, o.ascending, o.nulls_first)
+              for e, o in zip(new_exprs, node.orders)]
+    srt = cpux.CpuSortExec(eval_child, orders)
+    keep = [ir.BoundReference(i, f.dtype, f.nullable, name_=f.name)
+            for i, f in enumerate(child.schema.fields)]
+    return cpux.CpuProjectExec(srt, keep, child.schema)
 
 
 def _plan_join(node, conf: RapidsTpuConf):

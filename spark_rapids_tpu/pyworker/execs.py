@@ -148,28 +148,40 @@ class CpuArrowEvalPythonExec(PhysicalPlan):
         return self._schema
 
     def execute(self):
+        import contextlib
+
+        def eval_one(w, u, t: pa.Table) -> pa.Array:
+            args = _eval_args(list(u.children), t)
+            res = w.run_table(args)
+            if res.num_rows != t.num_rows:
+                raise ValueError(
+                    f"python worker returned {res.num_rows} rows for a "
+                    f"{t.num_rows}-row batch")
+            return _cast_result(res.column(0), u.return_type)
+
         def run(it) -> Iterator[pa.Table]:
             rebatch = RebatchingRoundoffIterator(it, self.batch_rows)
-            queue = BatchQueue()
-            for t in rebatch:
-                queue.push(t)
-                out_cols = []
-                for name, u in self.udfs:
-                    with borrowed_worker("series", u.func) as w:
-                        args = _eval_args(list(u.children), t)
-                        res = w.run_table(args)
-                    out_cols.append(
-                        (name, _cast_result(res.column(0), u.return_type)))
-                inp, _ = queue.pop_pair(
-                    pa.table({n: c for n, c in out_cols})
-                    if out_cols else t)
-                merged = inp
-                for n, c in out_cols:
-                    merged = merged.append_column(
-                        pa.field(n, c.type, True), c)
-                self.metrics.num_output_rows += merged.num_rows
-                self.metrics.num_output_batches += 1
-                yield merged
+            with contextlib.ExitStack() as stack:
+                # single-UDF fast path holds one worker for the whole
+                # partition (no per-batch handshake); multiple UDFs borrow
+                # per batch so fan-out can never exceed the pool permits
+                hoisted = None
+                if len(self.udfs) == 1:
+                    hoisted = stack.enter_context(
+                        borrowed_worker("series", self.udfs[0][1].func))
+                for t in rebatch:
+                    merged = t
+                    for name, u in self.udfs:
+                        if hoisted is not None:
+                            col = eval_one(hoisted, u, t)
+                        else:
+                            with borrowed_worker("series", u.func) as w:
+                                col = eval_one(w, u, t)
+                        merged = merged.append_column(
+                            pa.field(name, col.type, True), col)
+                    self.metrics.num_output_rows += merged.num_rows
+                    self.metrics.num_output_batches += 1
+                    yield merged
         return [run(it) for it in self.children[0].execute()]
 
 
@@ -215,15 +227,59 @@ def _collect_partition(it: Iterator[pa.Table]) -> Optional[pa.Table]:
     return pa.concat_tables(parts)
 
 
+class _NanKey:
+    """Canonical NaN grouping key: Spark groups all NaNs together, but
+    float('nan') != float('nan') breaks dict/set matching across cogroup
+    sides — so NaN keys are frozen to this singleton for matching and
+    thawed back to NaN for output."""
+
+    __slots__ = ()
+
+    def __eq__(self, other):
+        return isinstance(other, _NanKey)
+
+    def __hash__(self):
+        return 0x7FF8
+
+    def __repr__(self):
+        return "NaN"
+
+
+_NAN_KEY = _NanKey()
+
+
+def _freeze_key_val(v):
+    if isinstance(v, float) and np.isnan(v):
+        return _NAN_KEY
+    return v
+
+
+def _thaw_key_val(v):
+    return float("nan") if isinstance(v, _NanKey) else v
+
+
+def _key_sort_token(v):
+    """Total order over frozen key values incl. None/NaN (nulls last,
+    NaN after numbers — Spark ordering)."""
+    if v is None:
+        return (2, 0, "")
+    if isinstance(v, _NanKey):
+        return (1, 0, "")
+    return (0, 0, v)
+
+
 def _group_slices(t: pa.Table, keys: Sequence[str]
                   ) -> Iterator[Tuple[tuple, pa.Table]]:
-    """Stable group iteration: sort by keys, emit contiguous slices."""
+    """Stable group iteration: sort by keys, emit contiguous slices.
+
+    Keys come from ``to_pylist`` (None preserved — no pandas NaN coercion
+    of null integer keys) and are frozen via ``_freeze_key_val``."""
     import pyarrow.compute as pc
     # group contiguity only needs nulls sorted together; placement is
     # irrelevant, so the deprecated null_placement option is not used
     idx = pc.sort_indices(t, sort_keys=[(k, "ascending") for k in keys])
     s = t.take(idx)
-    key_cols = [np.asarray(s.column(k).to_pandas(), dtype=object)
+    key_cols = [[_freeze_key_val(v) for v in s.column(k).to_pylist()]
                 for k in keys]
     n = s.num_rows
     start = 0
@@ -238,8 +294,6 @@ def _group_slices(t: pa.Table, keys: Sequence[str]
 def _key_eq(a, b) -> bool:
     if a is None or b is None:
         return a is None and b is None
-    if isinstance(a, float) and isinstance(b, float):
-        return a == b or (np.isnan(a) and np.isnan(b))
     return a == b
 
 
@@ -315,19 +369,19 @@ class CpuFlatMapCoGroupsInPandasExec(PhysicalPlan):
                         groups[key] = grp
                     empty = whole.slice(0, 0)
                 else:
-                    empty = None
+                    # PySpark passes an EMPTY frame for the missing side,
+                    # never skips the group
+                    empty = _schema_to_arrow(child.schema).empty_table()
                 sides.append((groups, empty))
             (lgroups, lempty), (rgroups, rempty) = sides
             all_keys = sorted(set(lgroups) | set(rgroups),
-                              key=lambda k: tuple(
-                                  (v is None, v) for v in k))
+                              key=lambda k: tuple(_key_sort_token(v)
+                                                  for v in k))
             outs = []
             with borrowed_worker("cogroup", self.fn) as w:
                 for key in all_keys:
                     lt = lgroups.get(key, lempty)
                     rt = rgroups.get(key, rempty)
-                    if lt is None or rt is None:
-                        continue
                     outs.append(_conform(w.run_cogroup(lt, rt),
                                          self._schema))
             if outs:
@@ -379,7 +433,7 @@ class CpuAggregateInPandasExec(PhysicalPlan):
             cols = {}
             for i, k in enumerate(self.keys):
                 f = self._schema.field(k)
-                cols[k] = pa.array([r[i] for r in key_rows],
+                cols[k] = pa.array([_thaw_key_val(r[i]) for r in key_rows],
                                    type=f.dtype.to_arrow())
             cols[self.out_field.name] = pa.array(
                 results, type=self.out_field.dtype.to_arrow())

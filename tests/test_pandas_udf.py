@@ -221,3 +221,101 @@ def test_pandas_udf_explain_shows_cpu_fallback_reason():
     df = s.create_dataframe(t).select(f(col("a")).alias("o"))
     txt = df.explain_string("tpu")
     assert "ArrowEvalPython" in txt
+
+
+# ---------------------------------------------------------------------------
+# Regression tests: null group keys, empty cogroup sides, UDF positions
+# ---------------------------------------------------------------------------
+
+def test_agg_in_pandas_null_int_keys():
+    """Null int32 keys must form their own group, not crash as NaN."""
+    s = _session()
+    t = pa.table({"k": pa.array([0, None, 0, None], type=pa.int32()),
+                  "v": pa.array([1.0, 2.0, 3.0, 10.0])})
+    out = (s.create_dataframe(t).group_by("k")
+           .agg_in_pandas(lambda v: float(v.sum()), [col("v")],
+                          "sv", "double")
+           .collect().to_pandas())
+    rows = {(None if pd.isna(r.k) else int(r.k)): r.sv
+            for r in out.itertuples()}
+    assert rows == {0: 4.0, None: 12.0}
+
+
+def test_cogroup_one_side_fully_empty():
+    """PySpark calls fn with an EMPTY frame for a missing side."""
+    s = _session()
+    left = s.create_dataframe(pa.table(
+        {"k": pa.array([0, 1], type=pa.int32()),
+         "x": pa.array([1.0, 2.0])}))
+    right = s.create_dataframe(pa.table(
+        {"k": pa.array([], type=pa.int32()),
+         "y": pa.array([], type=pa.float64())}))
+
+    def merge(l, r):
+        return pd.DataFrame({"k": [int(l.k.iloc[0])],
+                             "nx": [len(l)], "ny": [len(r)]})
+
+    out = (left.group_by("k").cogroup(right.group_by("k"))
+           .apply_in_pandas(merge, pa.schema([("k", pa.int32()),
+                                              ("nx", pa.int64()),
+                                              ("ny", pa.int64())]))
+           .collect().to_pandas().sort_values("k").reset_index(drop=True))
+    assert list(out.k) == [0, 1]
+    assert list(out.nx) == [1, 1]
+    assert list(out.ny) == [0, 0]
+
+
+def test_cogroup_null_keys_match_across_sides():
+    s = _session()
+    left = s.create_dataframe(pa.table(
+        {"k": pa.array([1, None], type=pa.int32()),
+         "x": pa.array([1.0, 2.0])}))
+    right = s.create_dataframe(pa.table(
+        {"k": pa.array([None, 1], type=pa.int32()),
+         "y": pa.array([10.0, 20.0])}))
+
+    def merge(l, r):
+        return pd.DataFrame({"sx": [float(l.x.sum())],
+                             "sy": [float(r.y.sum())]})
+
+    out = (left.group_by("k").cogroup(right.group_by("k"))
+           .apply_in_pandas(merge, pa.schema([("sx", pa.float64()),
+                                              ("sy", pa.float64())]))
+           .collect().to_pandas())
+    # exactly 2 groups (1 and null), each seeing both sides
+    assert len(out) == 2
+    assert sorted(zip(out.sx, out.sy)) == [(1.0, 20.0), (2.0, 10.0)]
+
+
+def test_pandas_udf_in_sort_keys():
+    s = _session()
+    t = pa.table({"a": pa.array([3.0, 1.0, 2.0])})
+    neg = F.pandas_udf(lambda x: -x, "double")
+    out = s.create_dataframe(t).sort(neg(col("a"))).collect()
+    assert out.column("a").to_pylist() == [3.0, 2.0, 1.0]
+    assert out.column_names == ["a"]
+
+
+def test_pandas_udf_in_aggregate_args():
+    s = _session()
+    t = pa.table({"k": pa.array([0, 1, 0, 1], type=pa.int32()),
+                  "v": pa.array([1.0, 2.0, 3.0, 4.0])})
+    doubled = F.pandas_udf(lambda x: x * 2, "double")
+    out = (s.create_dataframe(t).group_by("k")
+           .agg(F.sum(doubled(col("v"))).alias("s"))
+           .collect().to_pandas().sort_values("k"))
+    assert list(out.s) == [8.0, 12.0]
+
+
+def test_apply_in_pandas_null_keys():
+    s = _session()
+    t = pa.table({"k": pa.array([0, None, 0], type=pa.int32()),
+                  "v": pa.array([1.0, 2.0, 3.0])})
+
+    def size(pdf):
+        return pd.DataFrame({"n": [len(pdf)]})
+
+    out = (s.create_dataframe(t).group_by("k")
+           .apply_in_pandas(size, pa.schema([("n", pa.int64())]))
+           .collect())
+    assert sorted(out.column("n").to_pylist()) == [1, 2]
