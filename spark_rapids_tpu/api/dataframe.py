@@ -151,23 +151,83 @@ class DataFrame:
                "leftsemi": "semi", "left_semi": "semi",
                "leftanti": "anti", "left_anti": "anti",
                "cross": "cross"}.get(how, how)
+        condition: Optional[ir.Expression] = None
         if on is None:
             left_keys: List[str] = []
             right_keys: List[str] = []
         elif isinstance(on, str):
             left_keys, right_keys = [on], [on]
-        elif isinstance(on, (list, tuple)):
+        elif isinstance(on, (list, tuple)) and all(
+                isinstance(c, str) for c in on):
             left_keys = list(on)
             right_keys = list(on)
+        elif isinstance(on, (Column, ir.Expression)) or (
+                isinstance(on, (list, tuple)) and all(
+                    isinstance(c, (Column, ir.Expression)) for c in on)):
+            # Expression join condition: split conjuncts into equi key
+            # pairs (resolved by which side owns each column name, as
+            # Spark's analyzer does) + a residual condition
+            # (reference: GpuHashJoin equi keys + optional condition).
+            exprs = list(on) if isinstance(on, (list, tuple)) else [on]
+            conjuncts: List[ir.Expression] = []
+            for e in exprs:
+                stack = [_as_expr(e)]
+                while stack:
+                    c = stack.pop()
+                    if isinstance(c, ir.And):
+                        stack.extend(c.children)
+                    else:
+                        conjuncts.append(c)
+            lnames = set(self.plan.schema.names)
+            rnames = set(other.plan.schema.names)
+            left_keys, right_keys = [], []
+            residual: List[ir.Expression] = []
+
+            def side(e):
+                names = [n.attr_name for n in ir.collect(
+                    e, lambda x: isinstance(x, ir.UnresolvedAttribute))]
+                for n in names:
+                    if n in lnames and n in rnames:
+                        raise ValueError(
+                            f"ambiguous column '{n}' appears on both "
+                            f"sides of the join; rename one side or use "
+                            f"on='{n}' for a same-name equi key")
+                if names and all(n in lnames for n in names):
+                    return "l"
+                if names and all(n in rnames for n in names):
+                    return "r"
+                return None
+
+            for c in conjuncts:
+                a, b = (c.children if isinstance(c, ir.EqualTo)
+                        else (None, None))
+                if (isinstance(a, ir.UnresolvedAttribute)
+                        and isinstance(b, ir.UnresolvedAttribute)):
+                    sa, sb = side(a), side(b)
+                    if sa == "l" and sb == "r":
+                        left_keys.append(a.attr_name)
+                        right_keys.append(b.attr_name)
+                        continue
+                    if sa == "r" and sb == "l":
+                        left_keys.append(b.attr_name)
+                        right_keys.append(a.attr_name)
+                        continue
+                residual.append(c)
+            if residual:
+                condition = residual[0]
+                for c in residual[1:]:
+                    condition = ir.And(condition, c)
         else:
-            raise TypeError("join on must be a column name or list of names")
+            raise TypeError("join on must be a column name, list of names, "
+                            "or a Column join condition")
         hint = None
         if getattr(other, "_broadcast_hint", False):
             hint = "broadcast_right"
         elif getattr(self, "_broadcast_hint", False):
             hint = "broadcast_left"
         return DataFrame(lp.Join(self.plan, other.plan, left_keys,
-                                 right_keys, how, hint=hint), self.session)
+                                 right_keys, how, condition=condition,
+                                 hint=hint), self.session)
 
     crossJoin = lambda self, other: self.join(other, how="cross")  # noqa
 
@@ -291,9 +351,33 @@ class GroupedData:
 
     def agg(self, *aggs) -> DataFrame:
         agg_exprs = [_as_expr(a) for a in aggs]
-        return DataFrame(
-            lp.Aggregate(self.df.plan, self.groupings, agg_exprs),
-            self.df.session)
+        if all(isinstance(e.children[0] if isinstance(e, ir.Alias) else e,
+                          ir.AggregateExpression) for e in agg_exprs):
+            return DataFrame(
+                lp.Aggregate(self.df.plan, self.groupings, agg_exprs),
+                self.df.session)
+        # Compound post-aggregation expressions (sum(a)/sum(b), ...):
+        # decompose into plain aggregates + a final projection, the same
+        # split the reference's final-projection stage performs
+        # (reference: aggregate.scala:326-421 "final projection").
+        leaves: List[ir.Expression] = []
+
+        def repl(node):
+            if isinstance(node, ir.AggregateExpression):
+                name = f"__agg{len(leaves)}"
+                leaves.append(ir.Alias(node, name))
+                return ir.UnresolvedAttribute(name)
+            return None
+
+        projected = []
+        for e in agg_exprs:
+            name = ir.output_name(e)
+            inner = e.children[0] if isinstance(e, ir.Alias) else e
+            projected.append(ir.Alias(ir.transform(inner, repl), name))
+        agg_plan = lp.Aggregate(self.df.plan, self.groupings, leaves)
+        final = [ir.UnresolvedAttribute(ir.output_name(g))
+                 for g in self.groupings] + projected
+        return DataFrame(lp.Project(agg_plan, final), self.df.session)
 
     def _simple(self, fn, cols) -> DataFrame:
         from spark_rapids_tpu.api import functions as F

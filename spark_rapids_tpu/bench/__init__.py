@@ -1,0 +1,11 @@
+"""Benchmark suites (reference analog: integration_tests/src/main/scala/
+com/nvidia/spark/rapids/tests/{tpcds,tpch,tpcxbb} + tests/BenchmarkRunner).
+
+``tpch`` holds a TpchLike suite: schema, dbgen-lite data generator, and all
+22 queries expressed in the DataFrame API; ``runner`` holds the
+BenchmarkRunner / CompareResults harness emitting JSON reports.
+"""
+
+from spark_rapids_tpu.bench import tpch  # noqa: F401
+from spark_rapids_tpu.bench.runner import (  # noqa: F401
+    BenchmarkRunner, CompareResults)

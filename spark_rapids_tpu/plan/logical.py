@@ -276,6 +276,8 @@ class Join(LogicalPlan):
             joined = Schema(lf + rf)
             self.condition = ir.bind(condition, joined.names,
                                      joined.dtypes, joined.nullables)
+            if self.condition.dtype != dt.BOOL:
+                raise TypeError("join condition must be boolean")
 
     @property
     def schema(self) -> Schema:
