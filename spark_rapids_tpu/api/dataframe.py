@@ -164,59 +164,16 @@ class DataFrame:
         elif isinstance(on, (Column, ir.Expression)) or (
                 isinstance(on, (list, tuple)) and all(
                     isinstance(c, (Column, ir.Expression)) for c in on)):
-            # Expression join condition: split conjuncts into equi key
-            # pairs (resolved by which side owns each column name, as
-            # Spark's analyzer does) + a residual condition
-            # (reference: GpuHashJoin equi keys + optional condition).
-            exprs = list(on) if isinstance(on, (list, tuple)) else [on]
-            conjuncts: List[ir.Expression] = []
-            for e in exprs:
-                stack = [_as_expr(e)]
-                while stack:
-                    c = stack.pop()
-                    if isinstance(c, ir.And):
-                        stack.extend(c.children)
-                    else:
-                        conjuncts.append(c)
-            lnames = set(self.plan.schema.names)
-            rnames = set(other.plan.schema.names)
-            left_keys, right_keys = [], []
-            residual: List[ir.Expression] = []
-
-            def side(e):
-                names = [n.attr_name for n in ir.collect(
-                    e, lambda x: isinstance(x, ir.UnresolvedAttribute))]
-                for n in names:
-                    if n in lnames and n in rnames:
-                        raise ValueError(
-                            f"ambiguous column '{n}' appears on both "
-                            f"sides of the join; rename one side or use "
-                            f"on='{n}' for a same-name equi key")
-                if names and all(n in lnames for n in names):
-                    return "l"
-                if names and all(n in rnames for n in names):
-                    return "r"
-                return None
-
-            for c in conjuncts:
-                a, b = (c.children if isinstance(c, ir.EqualTo)
-                        else (None, None))
-                if (isinstance(a, ir.UnresolvedAttribute)
-                        and isinstance(b, ir.UnresolvedAttribute)):
-                    sa, sb = side(a), side(b)
-                    if sa == "l" and sb == "r":
-                        left_keys.append(a.attr_name)
-                        right_keys.append(b.attr_name)
-                        continue
-                    if sa == "r" and sb == "l":
-                        left_keys.append(b.attr_name)
-                        right_keys.append(a.attr_name)
-                        continue
-                residual.append(c)
-            if residual:
-                condition = residual[0]
-                for c in residual[1:]:
-                    condition = ir.And(condition, c)
+            # Expression join condition: equi conjuncts become key pairs,
+            # the rest a residual condition (shared analyzer policy —
+            # lp.split_join_condition).
+            exprs = [_as_expr(e) for e in
+                     (on if isinstance(on, (list, tuple)) else [on])]
+            whole = exprs[0]
+            for e in exprs[1:]:
+                whole = ir.And(whole, e)
+            left_keys, right_keys, condition = lp.split_join_condition(
+                whole, self.plan.schema.names, other.plan.schema.names)
         else:
             raise TypeError("join on must be a column name, list of names, "
                             "or a Column join condition")
@@ -279,6 +236,11 @@ class DataFrame:
         reference: basicPhysicalOperators.scala:346)."""
         return DataFrame(lp.CoalescePartitions(self.plan, num_partitions),
                          self.session)
+
+    def create_or_replace_temp_view(self, name: str) -> None:
+        self.session.register_view(name, self)
+
+    createOrReplaceTempView = create_or_replace_temp_view
 
     def distinct(self) -> "DataFrame":
         names = self.plan.schema.names

@@ -43,6 +43,7 @@ class TpuSparkSession:
         with TpuSparkSession._lock:
             TpuSparkSession._active = self
         self._plan_listeners: List = []
+        self._views: Dict[str, lp.LogicalPlan] = {}
 
     # -- builder-compatible construction -----------------------------------
     class Builder:
@@ -102,6 +103,24 @@ class TpuSparkSession:
     @property
     def read(self) -> "DataFrameReader":
         return DataFrameReader(self)
+
+    # -- SQL ---------------------------------------------------------------
+    def sql(self, query: str) -> DataFrame:
+        """Parse and plan a SQL query against registered temp views
+        (the ``spark.sql(...)`` surface; in the reference Spark's own
+        parser runs and the plugin only sees physical plans)."""
+        from spark_rapids_tpu.sql import parse_sql
+        return DataFrame(parse_sql(query, self._views), self)
+
+    def register_view(self, name: str, df: DataFrame) -> None:
+        self._views[name.lower()] = df.plan
+
+    def drop_view(self, name: str) -> None:
+        self._views.pop(name.lower(), None)
+
+    @property
+    def catalog(self) -> Dict[str, lp.LogicalPlan]:
+        return dict(self._views)
 
     # -- planning & execution ----------------------------------------------
     def _plan_physical(self, plan: lp.LogicalPlan) -> OverrideResult:

@@ -205,8 +205,7 @@ def generate(sf: float = 0.001, seed: int = 0) -> Dict[str, pa.Table]:
         "c_phone": _phone(c_nation, rng),
         "c_acctbal": _money(rng, -999.99, 9999.99, nc),
         "c_mktsegment": rng.choice(_SEGMENTS, nc).tolist(),
-        # q13 greps '%special%requests%'
-        "c_comment": _text(rng, nc, "special packages requests", 0.1),
+        "c_comment": _text(rng, nc),
     })
 
     no = counts["orders"]
@@ -260,7 +259,8 @@ def generate(sf: float = 0.001, seed: int = 0) -> Dict[str, pa.Table]:
         "o_clerk": [f"Clerk#{i:09d}" for i in
                     rng.integers(1, max(2, int(1000 * sf)) + 1, no)],
         "o_shippriority": pa.array(np.zeros(no, dtype=np.int32)),
-        "o_comment": _text(rng, no),
+        # q13 greps o_comment NOT LIKE '%special%requests%'
+        "o_comment": _text(rng, no, "special packages requests", 0.1),
     })
 
     tables["lineitem"] = pa.table({
@@ -746,3 +746,137 @@ def q22(t):
 QUERIES = {f"q{i}": fn for i, fn in enumerate(
     [q1, q2, q3, q4, q5, q6, q7, q8, q9, q10, q11, q12, q13, q14, q15,
      q16, q17, q18, q19, q20, q21, q22], start=1)}
+
+
+# SQL texts for the queries the SQL frontend's subset covers — run through
+# session.sql() against registered views (the reference feeds Spark's
+# parser the spec SQL; TpchLikeSpark.scala registers temp views the same
+# way).
+SQL_QUERIES = {
+    "q1": """
+      SELECT l_returnflag, l_linestatus,
+             sum(l_quantity) AS sum_qty,
+             sum(l_extendedprice) AS sum_base_price,
+             sum(l_extendedprice * (1.0 - l_discount)) AS sum_disc_price,
+             sum(l_extendedprice * (1.0 - l_discount) * (1.0 + l_tax))
+               AS sum_charge,
+             avg(l_quantity) AS avg_qty,
+             avg(l_extendedprice) AS avg_price,
+             avg(l_discount) AS avg_disc,
+             count(*) AS count_order
+      FROM lineitem
+      WHERE l_shipdate <= DATE '1998-09-02'
+      GROUP BY l_returnflag, l_linestatus
+      ORDER BY l_returnflag, l_linestatus
+    """,
+    "q3": """
+      SELECT l_orderkey,
+             sum(l_extendedprice * (1.0 - l_discount)) AS revenue,
+             o_orderdate, o_shippriority
+      FROM customer c
+      JOIN orders o ON c_custkey = o_custkey
+      JOIN lineitem l ON o_orderkey = l_orderkey
+      WHERE c_mktsegment = 'BUILDING'
+        AND o_orderdate < DATE '1995-03-15'
+        AND l_shipdate > DATE '1995-03-15'
+      GROUP BY l_orderkey, o_orderdate, o_shippriority
+      ORDER BY revenue DESC, o_orderdate
+      LIMIT 10
+    """,
+    "q5": """
+      SELECT n_name,
+             sum(l_extendedprice * (1.0 - l_discount)) AS revenue
+      FROM customer
+      JOIN orders ON c_custkey = o_custkey
+      JOIN lineitem ON o_orderkey = l_orderkey
+      JOIN supplier ON l_suppkey = s_suppkey AND c_nationkey = s_nationkey
+      JOIN nation ON s_nationkey = n_nationkey
+      JOIN region ON n_regionkey = r_regionkey
+      WHERE r_name = 'ASIA'
+        AND o_orderdate >= DATE '1994-01-01'
+        AND o_orderdate < DATE '1995-01-01'
+      GROUP BY n_name
+      ORDER BY revenue DESC
+    """,
+    "q6": """
+      SELECT sum(l_extendedprice * l_discount) AS revenue
+      FROM lineitem
+      WHERE l_shipdate >= DATE '1994-01-01'
+        AND l_shipdate < DATE '1995-01-01'
+        AND l_discount BETWEEN 0.05 AND 0.07
+        AND l_quantity < 24.0
+    """,
+    "q10": """
+      SELECT c_custkey, c_name,
+             sum(l_extendedprice * (1.0 - l_discount)) AS revenue,
+             c_acctbal, n_name, c_address, c_phone, c_comment
+      FROM customer
+      JOIN orders ON c_custkey = o_custkey
+      JOIN lineitem ON o_orderkey = l_orderkey
+      JOIN nation ON c_nationkey = n_nationkey
+      WHERE o_orderdate >= DATE '1993-10-01'
+        AND o_orderdate < DATE '1994-01-01'
+        AND l_returnflag = 'R'
+      GROUP BY c_custkey, c_name, c_acctbal, c_phone, n_name,
+               c_address, c_comment
+      ORDER BY revenue DESC, c_custkey
+      LIMIT 20
+    """,
+    "q12": """
+      SELECT l_shipmode,
+             sum(CASE WHEN o_orderpriority = '1-URGENT'
+                        OR o_orderpriority = '2-HIGH'
+                      THEN 1 ELSE 0 END) AS high_line_count,
+             sum(CASE WHEN o_orderpriority <> '1-URGENT'
+                       AND o_orderpriority <> '2-HIGH'
+                      THEN 1 ELSE 0 END) AS low_line_count
+      FROM orders
+      JOIN lineitem ON o_orderkey = l_orderkey
+      WHERE l_shipmode IN ('MAIL', 'SHIP')
+        AND l_commitdate < l_receiptdate
+        AND l_shipdate < l_commitdate
+        AND l_receiptdate >= DATE '1994-01-01'
+        AND l_receiptdate < DATE '1995-01-01'
+      GROUP BY l_shipmode
+      ORDER BY l_shipmode
+    """,
+    "q14": """
+      SELECT sum(CASE WHEN p_type LIKE 'PROMO%'
+                      THEN l_extendedprice * (1.0 - l_discount)
+                      ELSE 0.0 END) * 100.0
+             / sum(l_extendedprice * (1.0 - l_discount)) AS promo_revenue
+      FROM lineitem
+      JOIN part ON l_partkey = p_partkey
+      WHERE l_shipdate >= DATE '1995-09-01'
+        AND l_shipdate < DATE '1995-10-01'
+    """,
+    "q19": """
+      SELECT sum(l_extendedprice * (1.0 - l_discount)) AS revenue
+      FROM lineitem
+      JOIN part ON p_partkey = l_partkey
+      WHERE l_shipmode IN ('AIR', 'REG AIR')
+        AND l_shipinstruct = 'DELIVER IN PERSON'
+        AND ((p_brand = 'Brand#12'
+              AND p_container IN ('SM CASE', 'SM BOX', 'SM PACK',
+                                  'SM PKG')
+              AND l_quantity BETWEEN 1.0 AND 11.0
+              AND p_size BETWEEN 1 AND 5)
+          OR (p_brand = 'Brand#23'
+              AND p_container IN ('MED BAG', 'MED BOX', 'MED PKG',
+                                  'MED PACK')
+              AND l_quantity BETWEEN 10.0 AND 20.0
+              AND p_size BETWEEN 1 AND 10)
+          OR (p_brand = 'Brand#34'
+              AND p_container IN ('LG CASE', 'LG BOX', 'LG PACK',
+                                  'LG PKG')
+              AND l_quantity BETWEEN 20.0 AND 30.0
+              AND p_size BETWEEN 1 AND 15))
+    """,
+}
+
+
+def setup_views(session, tables: Dict[str, pa.Table]) -> None:
+    """Register the 8 tables as temp views for SQL_QUERIES."""
+    for name, tbl in tables.items():
+        session.create_dataframe(tbl).create_or_replace_temp_view(name)
+

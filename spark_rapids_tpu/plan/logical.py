@@ -219,6 +219,64 @@ class Union(LogicalPlan):
         return self.children[0].schema
 
 
+def split_join_condition(condition: ir.Expression, lnames, rnames):
+    """Split a boolean join condition into equi key pairs + residual.
+
+    Conjuncts of the form ``EqualTo(left_col, right_col)`` become key
+    pairs, resolved by which side owns each column name (the analyzer
+    role; reference: GpuHashJoin equi keys + optional condition).  A name
+    owned by both sides is ambiguous and raises.  Returns
+    ``(left_keys, right_keys, residual_or_None)``.
+    """
+    lset, rset = set(lnames), set(rnames)
+    conjuncts: List[ir.Expression] = []
+    stack = [condition]
+    while stack:
+        c = stack.pop()
+        if isinstance(c, ir.And):
+            stack.extend(c.children)
+        else:
+            conjuncts.append(c)
+
+    def side(e: ir.Expression) -> Optional[str]:
+        names = [n.attr_name for n in ir.collect(
+            e, lambda x: isinstance(x, ir.UnresolvedAttribute))]
+        for n in names:
+            if n in lset and n in rset:
+                raise ValueError(
+                    f"ambiguous column '{n}' appears on both sides of "
+                    f"the join; rename one side or use a same-name "
+                    f"equi key")
+        if names and all(n in lset for n in names):
+            return "l"
+        if names and all(n in rset for n in names):
+            return "r"
+        return None
+
+    left_keys: List[str] = []
+    right_keys: List[str] = []
+    residual: List[ir.Expression] = []
+    for c in conjuncts:
+        if isinstance(c, ir.EqualTo):
+            a, b = c.children
+            if (isinstance(a, ir.UnresolvedAttribute)
+                    and isinstance(b, ir.UnresolvedAttribute)):
+                sa, sb = side(a), side(b)
+                if sa == "l" and sb == "r":
+                    left_keys.append(a.attr_name)
+                    right_keys.append(b.attr_name)
+                    continue
+                if sa == "r" and sb == "l":
+                    left_keys.append(b.attr_name)
+                    right_keys.append(a.attr_name)
+                    continue
+        residual.append(c)
+    cond = None
+    for c in residual:
+        cond = c if cond is None else ir.And(cond, c)
+    return left_keys, right_keys, cond
+
+
 class Join(LogicalPlan):
     """Equi-join on named key pairs; how in inner/left/right/full/semi/anti,
     cross for cartesian."""

@@ -1,0 +1,11 @@
+"""SQL frontend: ``session.sql("SELECT ...")``.
+
+The reference rides Spark's own SQL parser/analyzer and only rewrites
+physical plans; as a standalone engine we provide the SQL surface its
+integration suite exercises (reference analog: the qa_nightly_select_test
+/ *_test.py SQL texts in integration_tests): SELECT with joins, WHERE,
+GROUP BY / HAVING, ORDER BY / LIMIT, CTEs, UNION [ALL], DISTINCT, CASE,
+CAST, IN, BETWEEN, LIKE, and the function registry.
+"""
+
+from spark_rapids_tpu.sql.parser import parse_sql  # noqa: F401

@@ -1,0 +1,203 @@
+"""SQL frontend tests (reference analog: qa_nightly_select_test.py and the
+SQL texts throughout integration_tests — here the engine must parse them
+itself since it does not ride Spark's parser)."""
+
+import datetime as dt
+
+import pyarrow as pa
+import pytest
+
+from spark_rapids_tpu.sql.parser import SqlParseError
+from tests.parity import (assert_tables_equal, with_cpu_session,
+                          with_tpu_session)
+
+
+def _data():
+    return {
+        "people": pa.table({
+            "name": ["ann", "bob", "cal", "dee", None, "fay"],
+            "age": pa.array([34, 25, None, 47, 18, 25], type=pa.int32()),
+            "city": ["sf", "la", "sf", "ny", "la", None],
+            "salary": [100.0, 85.5, 92.0, None, 40.0, 85.5],
+        }),
+        "cities": pa.table({
+            "city_code": ["sf", "la", "ny"],
+            "city_name": ["San Francisco", "Los Angeles", "New York"],
+            "population": pa.array([870, 3900, 8300], type=pa.int64()),
+        }),
+        "hires": pa.table({
+            "emp": ["ann", "bob", "cal", "gus"],
+            "hired": pa.array([dt.date(2019, 1, 3), dt.date(2020, 6, 9),
+                               dt.date(2020, 7, 1), dt.date(2021, 2, 2)],
+                              type=pa.date32()),
+        }),
+    }
+
+
+def _run_sql(query):
+    def run(session):
+        for name, t in _data().items():
+            session.create_dataframe(t).create_or_replace_temp_view(name)
+        return session.sql(query).collect()
+    return run
+
+
+def check(query, **kw):
+    cpu = with_cpu_session(_run_sql(query))
+    tpu = with_tpu_session(
+        _run_sql(query),
+        {"spark.rapids.tpu.sql.variableFloatAgg.enabled": True})
+    assert_tables_equal(cpu, tpu, **kw)
+    return cpu
+
+
+QUERIES = [
+    "SELECT name, age FROM people",
+    "SELECT * FROM people WHERE age > 20 AND city = 'sf'",
+    "SELECT name, salary * 1.1 AS bumped FROM people WHERE salary "
+    "IS NOT NULL",
+    "SELECT upper(name) AS n, length(name) FROM people WHERE name "
+    "IS NOT NULL",
+    "SELECT city, count(*) AS cnt, avg(age) AS avg_age FROM people "
+    "GROUP BY city",
+    "SELECT city, sum(salary) / count(*) AS per_head FROM people "
+    "GROUP BY city HAVING count(*) > 1",
+    "SELECT * FROM people ORDER BY age DESC NULLS LAST, name LIMIT 3",
+    "SELECT DISTINCT age FROM people ORDER BY age",
+    "SELECT name, CASE WHEN age >= 30 THEN 'senior' WHEN age >= 21 "
+    "THEN 'adult' ELSE 'minor' END AS bracket FROM people",
+    "SELECT name, CAST(age AS double) / 2 AS half FROM people",
+    "SELECT p.name, c.city_name FROM people p JOIN cities c ON "
+    "p.city = c.city_code",
+    "SELECT p.name, c.city_name, c.population FROM people p LEFT JOIN "
+    "cities c ON p.city = c.city_code ORDER BY p.name",
+    "SELECT name FROM people WHERE age BETWEEN 20 AND 40 ORDER BY name",
+    "SELECT name FROM people WHERE city IN ('sf', 'ny') ORDER BY name",
+    "SELECT name FROM people WHERE name LIKE '%a%' ORDER BY name",
+    "SELECT name FROM people WHERE age NOT IN (25) AND age IS NOT NULL "
+    "ORDER BY name",
+    "WITH sf AS (SELECT * FROM people WHERE city = 'sf') "
+    "SELECT name, age FROM sf ORDER BY name",
+    "SELECT name FROM people WHERE age < 26 UNION ALL "
+    "SELECT emp FROM hires WHERE emp = 'gus'",
+    "SELECT year(hired) AS y, count(*) AS n FROM hires GROUP BY y "
+    "ORDER BY y",
+    "SELECT emp FROM hires WHERE hired >= DATE '2020-01-01' ORDER BY emp",
+    "SELECT p.name FROM people p LEFT SEMI JOIN hires h ON "
+    "p.name = h.emp ORDER BY p.name",
+    "SELECT p.name FROM people p LEFT ANTI JOIN hires h ON "
+    "p.name = h.emp ORDER BY p.name",
+    "SELECT city, count(*) AS c FROM people GROUP BY city "
+    "ORDER BY 2 DESC, 1",
+    "SELECT name || '!' AS shout FROM people WHERE name IS NOT NULL "
+    "ORDER BY shout",
+    "SELECT avg(salary) AS a, min(age) AS lo, max(age) AS hi FROM people",
+    "SELECT h.emp, p.age FROM hires h, people p WHERE h.emp = p.name "
+    "ORDER BY h.emp",
+]
+
+
+@pytest.mark.parametrize("q", QUERIES)
+def test_sql_parity(q):
+    # queries without a total ORDER BY compare order-independently
+    check(q, approx_float=True,
+          ignore_order="ORDER BY" not in q or "GROUP BY" in q)
+
+
+def test_sql_results_shape():
+    out = with_cpu_session(_run_sql(
+        "SELECT city, count(*) AS cnt FROM people GROUP BY city"))
+    assert set(out.column_names) == {"city", "cnt"}
+    assert out.num_rows == 4  # sf, la, ny, null
+
+
+def test_sql_join_using():
+    q = ("SELECT name, city_name FROM people JOIN "
+         "(SELECT city_code AS city, city_name FROM cities) c "
+         "USING (city) ORDER BY name")
+    out = check(q)
+    assert "city_name" in out.column_names
+
+
+def test_sql_subquery_from():
+    q = ("SELECT bracket, count(*) AS n FROM (SELECT CASE WHEN age > 25 "
+         "THEN 'old' ELSE 'young' END AS bracket FROM people WHERE age "
+         "IS NOT NULL) t GROUP BY bracket ORDER BY bracket")
+    out = check(q)
+    assert out.num_rows == 2
+
+
+def test_sql_errors():
+    for bad, msg in [
+        ("SELECT * FROM nope", "not found"),
+        ("SELECT name FROM people WHERE", "unexpected"),
+        ("SELECT unknown_fn(age) FROM people", "unknown function"),
+        ("SELECT p.oops FROM people p", "not found"),
+        ("SELECT count(DISTINCT age) FROM people", "not supported"),
+    ]:
+        with pytest.raises(SqlParseError) as ei:
+            with_cpu_session(_run_sql(bad))
+        assert msg in str(ei.value), bad
+
+
+def test_sql_runs_on_tpu_plan():
+    def run(session):
+        for name, t in _data().items():
+            session.create_dataframe(t).create_or_replace_temp_view(name)
+        df = session.sql("SELECT city, count(*) AS c FROM people "
+                         "GROUP BY city")
+        return df.explain_string("physical")
+
+    plan = with_tpu_session(
+        run, {"spark.rapids.tpu.sql.variableFloatAgg.enabled": True})
+    assert "TpuHashAggregateExec" in plan
+
+
+# -- TPC-H SQL texts vs their DataFrame forms ------------------------------
+
+@pytest.mark.parametrize("name", sorted(
+    __import__("spark_rapids_tpu.bench.tpch", fromlist=["SQL_QUERIES"])
+    .SQL_QUERIES, key=lambda q: int(q[1:])))
+def test_tpch_sql_matches_dataframe(name):
+    from spark_rapids_tpu.bench import tpch
+    data = tpch.generate(0.002, seed=7)
+
+    def run_sql(session):
+        tpch.setup_views(session, data)
+        return session.sql(tpch.SQL_QUERIES[name]).collect()
+
+    def run_df(session):
+        return tpch.QUERIES[name](tpch.setup(session, data)).collect()
+
+    sql_out = with_tpu_session(
+        run_sql, {"spark.rapids.tpu.sql.variableFloatAgg.enabled": True})
+    df_out = with_tpu_session(
+        run_df, {"spark.rapids.tpu.sql.variableFloatAgg.enabled": True})
+    assert sql_out.num_rows == df_out.num_rows
+    assert sql_out.num_columns == df_out.num_columns
+    for i in range(sql_out.num_columns):
+        sv, dv = sql_out.column(i).to_pylist(), df_out.column(i).to_pylist()
+        for a, b in zip(sv, dv):
+            if isinstance(a, float) and isinstance(b, float):
+                assert abs(a - b) <= 1e-6 * max(abs(a), abs(b), 1.0)
+            else:
+                assert a == b, (name, i)
+
+
+def test_sql_union_order_by_binds_to_whole():
+    q = ("SELECT name FROM people WHERE age >= 30 UNION ALL "
+         "SELECT emp FROM hires WHERE emp = 'gus' ORDER BY name DESC")
+    out = with_cpu_session(_run_sql(q))
+    names = out.column("name").to_pylist()
+    assert names == sorted(names, reverse=True)
+
+
+def test_sql_string_scalar_functions():
+    q = ("SELECT lpad(name, 5, '.') AS l, rpad(name, 5, '.') AS r, "
+         "replace(name, 'a', 'o') AS rep, locate('a', name) AS loc "
+         "FROM people WHERE name = 'ann'")
+    out = check(q)
+    assert out.column("l").to_pylist() == ["..ann"]
+    assert out.column("r").to_pylist() == ["ann.."]
+    assert out.column("rep").to_pylist() == ["onn"]
+    assert out.column("loc").to_pylist() == [1]
