@@ -208,6 +208,34 @@ AUTO_BROADCAST_THRESHOLD = conf(
     "Max estimated byte size of a join side to broadcast it "
     "(spark.sql.autoBroadcastJoinThreshold analog; -1 disables).", int)
 
+ADAPTIVE_ENABLED = conf(
+    "spark.rapids.tpu.sql.adaptive.enabled", True,
+    "Adaptive shuffle reads: after an exchange materializes, coalesce "
+    "undersized reduce partitions and split skewed ones using the "
+    "measured per-partition sizes (AQE CustomShuffleReaderExec analog; "
+    "reference: GpuCustomShuffleReaderExec.scala:38).", bool)
+
+ADAPTIVE_ADVISORY_PARTITION_SIZE = conf(
+    "spark.rapids.tpu.sql.adaptive.advisoryPartitionSizeInBytes",
+    64 << 20,
+    "Target output partition size for adaptive coalescing and skew "
+    "splitting.", int)
+
+ADAPTIVE_MIN_PARTITION_NUM = conf(
+    "spark.rapids.tpu.sql.adaptive.coalescePartitions.minPartitionNum", 1,
+    "Lower bound on the post-coalesce partition count.", int)
+
+ADAPTIVE_SKEW_FACTOR = conf(
+    "spark.rapids.tpu.sql.adaptive.skewJoin.skewedPartitionFactor", 5,
+    "A partition is skewed if its bytes exceed this multiple of the "
+    "median partition size (and the absolute threshold).", int)
+
+ADAPTIVE_SKEW_THRESHOLD = conf(
+    "spark.rapids.tpu.sql.adaptive.skewJoin."
+    "skewedPartitionThresholdInBytes", 256 << 20,
+    "Absolute minimum bytes for a partition to be considered skewed.",
+    int)
+
 SHUFFLE_PARTITIONS = conf(
     "spark.rapids.tpu.sql.shuffle.partitions", 8,
     "Default number of shuffle partitions (spark.sql.shuffle.partitions "

@@ -1,0 +1,309 @@
+"""Adaptive (AQE-analog) shuffle reads for join exchanges.
+
+Reference: ``GpuCustomShuffleReaderExec`` (GpuCustomShuffleReaderExec.scala:38)
+serves the coalesced/skewed partition specs Spark's AQE derived from map
+output statistics.  Here the engine computes them itself, with Spark's
+scoping rules:
+
+  * only planner-inserted join exchanges participate — a user's
+    ``df.repartition(n, ...)`` fixed the partition count explicitly and is
+    exempt (Spark's REPARTITION_BY_NUM exemption);
+  * both join sides share ONE spec list computed from the combined
+    per-partition sizes, so the join's co-partitioning contract survives
+    (Spark's ShufflePartitionsUtil.coalescePartitions over multiple map
+    output statistics);
+  * a skewed partition (side bytes > skewedPartitionFactor × median and
+    > the absolute threshold) is split by rows into advisory-sized chunks
+    while the other side's matching partition is replicated per chunk
+    (OptimizeSkewedJoin's PartialReducerPartitionSpec).  Sides are only
+    split where the join type allows it: the left for
+    inner/left/semi/anti, the right for inner/right, neither for full
+    outer.
+  * ``minPartitionNum`` constrains only coalescing, never skew splitting.
+
+Trade-off vs the reference: specs need both sides' sizes, so the
+coordinator materializes every reduce partition in HBM before the first
+read (AQE reads map statistics instead; our exchange does not persist
+host-side stats for the device transport).  Partition buffers are
+refcounted and released as the last spec referencing them drains.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Dict, Iterator, List, Optional, Sequence, Set, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from spark_rapids_tpu import config as cfg
+from spark_rapids_tpu.columnar.batch import (DeviceBatch, bucket_rows,
+                                             concat_batches)
+from spark_rapids_tpu.exec.base import PhysicalPlan, TpuExec, timed
+from spark_rapids_tpu.plan.logical import Schema
+from spark_rapids_tpu.shuffle.exchange import slice_span
+
+
+@dataclass(frozen=True)
+class CoalescedSpec:
+    """Output partition = input partitions [start, end)."""
+    start: int
+    end: int
+
+
+@dataclass(frozen=True)
+class SkewSplitSpec:
+    """Output partition = rows [row_start, row_end) of input partition."""
+    partition: int
+    row_start: int
+    row_end: int
+
+
+def skewed_indices(sizes: Sequence[int], factor: int, threshold: int
+                   ) -> Set[int]:
+    nonzero = sorted(s for s in sizes if s > 0)
+    if not nonzero:
+        return set()
+    median = nonzero[len(nonzero) // 2]
+    cut = max(factor * median, threshold)
+    return {i for i, s in enumerate(sizes) if s > cut}
+
+
+def coalesce_runs(sizes: Sequence[int], advisory: int,
+                  skew: Set[int]) -> List:
+    """Greedy contiguous coalescing up to ``advisory`` bytes; indices in
+    ``skew`` become standalone ``("skew", i)`` markers.  Returns a list of
+    CoalescedSpec | ("skew", i)."""
+    specs: List = []
+    run_start: Optional[int] = None
+    run_bytes = 0
+
+    def flush(end: int) -> None:
+        nonlocal run_start, run_bytes
+        if run_start is not None and end > run_start:
+            specs.append(CoalescedSpec(run_start, end))
+        run_start, run_bytes = None, 0
+
+    for i, s in enumerate(sizes):
+        if i in skew:
+            flush(i)
+            specs.append(("skew", i))
+            continue
+        if run_start is None:
+            run_start = i
+        run_bytes += s
+        if run_bytes >= advisory:
+            flush(i + 1)
+    flush(len(sizes))
+    return specs
+
+
+def _row_chunks(rows: int, size: int, advisory: int
+                ) -> List[Tuple[int, int]]:
+    n_chunks = max(2, -(-size // advisory))
+    chunk = max(1, -(-rows // n_chunks))
+    return [(st, min(st + chunk, rows))
+            for st in range(0, max(rows, 1), chunk)]
+
+
+def plan_join_specs(lsizes: Sequence[int], rsizes: Sequence[int],
+                    lrows: Sequence[int], rrows: Sequence[int],
+                    how: str, advisory: int, factor: int, threshold: int,
+                    min_parts: int) -> List[Tuple]:
+    """One shared spec list for both join sides.
+
+    Returns [(left_spec, right_spec), ...]; coalesced specs are identical
+    on both sides, skew entries pair row chunks of the split side with a
+    replica of the other side's whole partition."""
+    lskew = skewed_indices(lsizes, factor, threshold) \
+        if how in ("inner", "left", "semi", "anti") else set()
+    rskew = skewed_indices(rsizes, factor, threshold) \
+        if how in ("inner", "right") else set()
+    skew = lskew | rskew
+    combined = [a + b for a, b in zip(lsizes, rsizes)]
+    runs = coalesce_runs(combined, advisory, skew)
+
+    def expand(runs_list) -> List[Tuple]:
+        out: List[Tuple] = []
+        for sp in runs_list:
+            if isinstance(sp, CoalescedSpec):
+                out.append((sp, sp))
+                continue
+            _, i = sp
+            lchunks = _row_chunks(lrows[i], lsizes[i], advisory) \
+                if i in lskew else [(0, lrows[i])]
+            rchunks = _row_chunks(rrows[i], rsizes[i], advisory) \
+                if i in rskew else [(0, rrows[i])]
+            for ls, le in lchunks:
+                for rs, re in rchunks:
+                    out.append((SkewSplitSpec(i, ls, le),
+                                SkewSplitSpec(i, rs, re)))
+        return out
+
+    specs = expand(runs)
+    if len(specs) < min_parts:
+        # minPartitionNum limits coalescing only: retry without it
+        identity = []
+        for sp in runs:
+            if isinstance(sp, CoalescedSpec):
+                identity.extend(CoalescedSpec(p, p + 1)
+                                for p in range(sp.start, sp.end))
+            else:
+                identity.append(sp)
+        specs = expand(identity)
+    return specs
+
+
+class _JoinAdaptiveState:
+    """Shared coordinator: pulls both exchanges once, plans one spec
+    list, hands per-side views their batches.  Buffers are refcounted per
+    (side, partition) and dropped when the last referencing spec drains."""
+
+    def __init__(self, left: PhysicalPlan, right: PhysicalPlan, how: str,
+                 conf_obj):
+        self.children = (left, right)
+        self.how = how
+        self.advisory = int(conf_obj.get(
+            cfg.ADAPTIVE_ADVISORY_PARTITION_SIZE))
+        self.factor = int(conf_obj.get(cfg.ADAPTIVE_SKEW_FACTOR))
+        self.threshold = int(conf_obj.get(cfg.ADAPTIVE_SKEW_THRESHOLD))
+        self.min_parts = int(conf_obj.get(cfg.ADAPTIVE_MIN_PARTITION_NUM))
+        self.specs: Optional[List[Tuple]] = None
+        self.batches: List[List[List[DeviceBatch]]] = [[], []]
+        self._refs: List[Dict[int, int]] = [{}, {}]
+
+    def ensure(self) -> None:
+        if self.specs is not None:
+            return
+        per_side_sizes = []
+        per_side_rows = []
+        for side, child in enumerate(self.children):
+            parts = [[b for b in it] for it in child.execute()]
+            self.batches[side] = parts
+            per_side_sizes.append(
+                [sum(int(b.nbytes()) for b in bs) for bs in parts])
+            per_side_rows.append(
+                [sum(int(b.num_rows) for b in bs) for bs in parts])
+        self.specs = plan_join_specs(
+            per_side_sizes[0], per_side_sizes[1],
+            per_side_rows[0], per_side_rows[1],
+            self.how, self.advisory, self.factor, self.threshold,
+            self.min_parts)
+        # pre-concat partitions that skew chunks will row-slice, and
+        # count references so buffers free as readers drain
+        for side in (0, 1):
+            refs: Dict[int, int] = {}
+            for sp in (s[side] for s in self.specs):
+                if isinstance(sp, SkewSplitSpec):
+                    refs[sp.partition] = refs.get(sp.partition, 0) + 1
+                else:
+                    for p in range(sp.start, sp.end):
+                        refs[p] = refs.get(p, 0) + 1
+            self._refs[side] = refs
+            skew_parts = {sp[side].partition for sp in self.specs
+                          if isinstance(sp[side], SkewSplitSpec)}
+            for p in skew_parts:
+                bs = self.batches[side][p]
+                if len(bs) > 1:
+                    self.batches[side][p] = [concat_batches(bs)]
+
+    def release(self, side: int, parts) -> None:
+        for p in parts:
+            self._refs[side][p] -= 1
+            if self._refs[side][p] == 0:
+                self.batches[side][p] = []
+
+
+class TpuAdaptiveJoinReaderExec(TpuExec):
+    """One join side's view of the shared coordinated specs (the
+    CustomShuffleReader node that appears in explain output)."""
+
+    def __init__(self, state: _JoinAdaptiveState, side: int,
+                 child: PhysicalPlan, conf_obj):
+        super().__init__()
+        self.state = state
+        self.side = side
+        self.children = (child,)
+        self.min_bucket = conf_obj.get(cfg.MIN_BUCKET_ROWS)
+        self._kernels = {}
+
+    @property
+    def schema(self) -> Schema:
+        return self.children[0].schema
+
+    def simple_string(self) -> str:
+        n = len(self.state.specs) if self.state.specs is not None else "?"
+        return f"TpuAdaptiveJoinReaderExec(side={self.side}, specs={n})"
+
+    def _row_slice(self, batch: DeviceBatch, start: int, count: int
+                   ) -> DeviceBatch:
+        cap = bucket_rows(count, self.min_bucket)
+        key = (cap, batch.schema_key())
+        if key not in self._kernels:
+            self._kernels[key] = jax.jit(
+                lambda b, o, c: slice_span(b, o, c, cap))
+        return self._kernels[key](batch,
+                                  jnp.asarray(start, dtype=jnp.int32),
+                                  jnp.asarray(count, dtype=jnp.int32))
+
+    def execute(self):
+        self.state.ensure()
+        side = self.side
+        batches = self.state.batches[side]
+
+        def reader(spec) -> Iterator[DeviceBatch]:
+            if isinstance(spec, CoalescedSpec):
+                group = [b for p in range(spec.start, spec.end)
+                         for b in batches[p]]
+                if group:
+                    with timed(self.metrics):
+                        out = group[0] if len(group) == 1 \
+                            else concat_batches(group)
+                    self.metrics.num_output_rows += int(out.num_rows)
+                    self.metrics.num_output_batches += 1
+                    self.state.release(side, range(spec.start, spec.end))
+                    yield out
+                else:
+                    self.state.release(side, range(spec.start, spec.end))
+            else:
+                bs = batches[spec.partition]
+                count = spec.row_end - spec.row_start
+                if bs and count > 0:
+                    with timed(self.metrics):
+                        # a replica spec spanning the whole partition
+                        # (the non-split side) reuses the batch as-is
+                        if spec.row_start == 0 and \
+                                count == int(bs[0].num_rows):
+                            out = bs[0]
+                        else:
+                            out = self._row_slice(bs[0], spec.row_start,
+                                                  count)
+                    self.metrics.num_output_rows += int(out.num_rows)
+                    self.metrics.num_output_batches += 1
+                    self.state.release(side, [spec.partition])
+                    yield out
+                else:
+                    self.state.release(side, [spec.partition])
+
+        return [reader(sp[side]) for sp in self.state.specs]
+
+
+def wrap_join_children(left: PhysicalPlan, right: PhysicalPlan, how: str,
+                       conf_obj) -> Tuple[PhysicalPlan, PhysicalPlan]:
+    """Wrap a shuffled join's two exchange children in coordinated
+    adaptive readers (no-op unless both children are hash exchanges and
+    adaptive is enabled)."""
+    from spark_rapids_tpu.shuffle.exchange import (HashPartitioning,
+                                                   TpuShuffleExchangeExec)
+    if not conf_obj.get(cfg.ADAPTIVE_ENABLED):
+        return left, right
+    if not (isinstance(left, TpuShuffleExchangeExec)
+            and isinstance(right, TpuShuffleExchangeExec)
+            and isinstance(left.partitioning, HashPartitioning)
+            and isinstance(right.partitioning, HashPartitioning)
+            and left.partitioning.num_partitions
+            == right.partitioning.num_partitions):
+        return left, right
+    state = _JoinAdaptiveState(left, right, how, conf_obj)
+    return (TpuAdaptiveJoinReaderExec(state, 0, left, conf_obj),
+            TpuAdaptiveJoinReaderExec(state, 1, right, conf_obj))

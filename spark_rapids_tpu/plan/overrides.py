@@ -319,13 +319,20 @@ def _register_join_strategy_rules():
         TpuBroadcastHashJoinExec, TpuBroadcastNestedLoopJoinExec,
         TpuCartesianProductExec, TpuShuffledHashJoinExec)
 
+    def _convert_shuffled_join(n, ch, conf):
+        # AQE analog: both exchange children share one coordinated spec
+        # list (coalesce + skew split) so co-partitioning survives
+        from spark_rapids_tpu.exec.adaptive import wrap_join_children
+        left, right = wrap_join_children(ch[0], ch[1], n.how, conf)
+        return TpuShuffledHashJoinExec(
+            left, right, n.left_keys, n.right_keys, n.how, n.condition,
+            n.schema)
+
     register_exec_rule(cpux.CpuShuffledHashJoinExec, ExecRule(
         "ShuffledHashJoinExec",
         "TPU partitioned equi-join over co-partitioned exchanges",
         _join_exprs,
-        convert=lambda n, ch, conf: TpuShuffledHashJoinExec(
-            ch[0], ch[1], n.left_keys, n.right_keys, n.how, n.condition,
-            n.schema),
+        convert=_convert_shuffled_join,
         extra_tag=_tag_join))
 
     register_exec_rule(cpux.CpuBroadcastHashJoinExec, ExecRule(
@@ -413,6 +420,10 @@ def _register_exchange_rule():
 
 
 def _make_tpu_exchange(n, ch, conf):
+    # user repartition exchanges keep their exact partition count
+    # (Spark's REPARTITION_BY_NUM exemption from AQE); the adaptive
+    # reader only wraps planner-inserted join exchanges — see
+    # _convert_shuffled_join
     from spark_rapids_tpu.shuffle.exchange import TpuShuffleExchangeExec
     return TpuShuffleExchangeExec(ch[0], n.partitioning, conf)
 

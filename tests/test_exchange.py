@@ -17,7 +17,11 @@ from tests.parity import assert_tpu_and_cpu_are_equal_collect
 from tests.data_gen import gen_df, int_key_gen, long_gen, double_gen, \
     string_key_gen
 
-SHUF = {"spark.rapids.tpu.sql.shuffle.partitions": 4}
+SHUF = {"spark.rapids.tpu.sql.shuffle.partitions": 4,
+        # these tests assert raw partitioning mechanics (counts,
+        # colocation, ordering); the adaptive reader would legitimately
+        # coalesce the tiny partitions away
+        "spark.rapids.tpu.sql.adaptive.enabled": False}
 NO_BCAST = {"spark.rapids.tpu.sql.autoBroadcastJoinThreshold": -1,
             **SHUF}
 
