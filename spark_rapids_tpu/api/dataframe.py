@@ -237,6 +237,27 @@ class DataFrame:
         return DataFrame(lp.CoalescePartitions(self.plan, num_partitions),
                          self.session)
 
+    def cache(self) -> "DataFrame":
+        """Materialize this plan's output once as parquet blobs and serve
+        later executions from them (ParquetCachedBatchSerializer /
+        InMemoryTableScan analog; materialization is lazy — it happens on
+        the first action).  Only this DataFrame and ones derived from it
+        afterwards see the cache."""
+        if not isinstance(self.plan, lp.CachedRelation):
+            self.plan = lp.CachedRelation(self.plan)
+        return self
+
+    persist = cache
+
+    def unpersist(self) -> "DataFrame":
+        if isinstance(self.plan, lp.CachedRelation):
+            self.plan = self.plan.children[0]
+        return self
+
+    @property
+    def is_cached(self) -> bool:
+        return isinstance(self.plan, lp.CachedRelation)
+
     def create_or_replace_temp_view(self, name: str) -> None:
         self.session.register_view(name, self)
 

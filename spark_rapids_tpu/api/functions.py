@@ -157,6 +157,39 @@ def replace(c, search: str, replacement: str) -> Column:
                                    ir.Literal(replacement)))
 
 
+def substring_index(c, delim: str, count: int) -> Column:
+    return Column(ir.SubstringIndex(_c(c), ir.Literal(delim),
+                                    ir.Literal(count)))
+
+
+def split(c, pattern: str, limit: int = -1) -> Column:
+    return Column(ir.StringSplit(_c(c), ir.Literal(pattern),
+                                 ir.Literal(limit)))
+
+
+def regexp_replace(c, pattern: str, replacement) -> Column:
+    return Column(ir.RegExpReplace(_c(c), ir.Literal(pattern),
+                                   _c(replacement) if isinstance(
+                                       replacement, Column)
+                                   else ir.Literal(replacement)))
+
+
+def md5(c) -> Column:
+    return Column(ir.Md5(_c(c)))
+
+
+def atleast_n_nonnulls(n: int, *cols) -> Column:
+    return Column(ir.AtLeastNNonNulls(n, [_c(c) for c in cols]))
+
+
+def from_unixtime(c) -> Column:
+    return Column(ir.FromUnixTime(_c(c)))
+
+
+def input_file_name() -> Column:
+    return Column(ir.InputFileName())
+
+
 # -- temporal ---------------------------------------------------------------
 
 year = _u(ir.Year)

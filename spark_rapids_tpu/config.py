@@ -208,6 +208,16 @@ AUTO_BROADCAST_THRESHOLD = conf(
     "Max estimated byte size of a join side to broadcast it "
     "(spark.sql.autoBroadcastJoinThreshold analog; -1 disables).", int)
 
+CACHE_COMPRESSION = conf(
+    "spark.rapids.tpu.sql.cache.compression", "snappy",
+    "Parquet compression codec for df.cache() blobs "
+    "(ParquetCachedBatchSerializer analog; none|snappy|zstd|gzip|lz4).")
+
+CACHE_DEVICE_DECODE = conf(
+    "spark.rapids.tpu.sql.cache.deviceDecode.enabled", True,
+    "Decode cached parquet blobs on device (HBM RLE/dictionary "
+    "expansion), falling back per column like file scans.", bool)
+
 ADAPTIVE_ENABLED = conf(
     "spark.rapids.tpu.sql.adaptive.enabled", True,
     "Adaptive shuffle reads: after an exchange materializes, coalesce "

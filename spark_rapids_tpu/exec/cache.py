@@ -1,0 +1,128 @@
+"""In-memory table scan over cached parquet blobs (df.cache()).
+
+Reference: ``ParquetCachedBatchSerializer`` stores each cached batch as a
+device-encoded parquet blob (``compressColumnarBatchWithParquet``,
+shims/spark310/.../ParquetCachedBatchSerializer.scala:333) and
+``GpuInMemoryTableScanExec`` (GpuInMemoryTableScanExec.scala:115) decodes
+them back on device, with a CPU iterator fallback.  Here:
+
+  * materialization runs the child plan through the full override
+    pipeline once and parquet-encodes each output partition (host Arrow
+    encode — the documented delta),
+  * ``TpuInMemoryTableScanExec`` decodes blobs straight into HBM via the
+    same device parquet decoder as file scans (per-column host fallback
+    included),
+  * ``CpuInMemoryTableScanExec`` is the pure-CPU read used when the TPU
+    plan is disabled or the scan is kill-switched off.
+"""
+
+from __future__ import annotations
+
+import io
+from typing import Iterator, List
+
+import pyarrow as pa
+import pyarrow.parquet as papq
+
+from spark_rapids_tpu import config as cfg
+from spark_rapids_tpu.exec.base import PhysicalPlan, TpuExec, timed
+from spark_rapids_tpu.mem.device import tpu_semaphore
+from spark_rapids_tpu.plan.logical import CachedRelation, Schema
+
+
+def materialize(relation: CachedRelation, conf) -> None:
+    """Build the cache: run the child plan once, encode each partition
+    as one parquet blob (single row group, so device decode sees the
+    same page layout as a file scan)."""
+    if relation.materialized:
+        return
+    from spark_rapids_tpu.plan.overrides import TpuOverrides
+    from spark_rapids_tpu.plan.planner import plan_cpu
+    from spark_rapids_tpu.exec.cpu import concat_tables
+
+    cpu_plan = plan_cpu(relation.children[0], conf)
+    result = TpuOverrides.apply(cpu_plan, conf)
+    codec = str(conf.get(cfg.CACHE_COMPRESSION))
+    blobs: List[bytes] = []
+    for it in result.plan.execute():
+        tables = [t for t in it]
+        if not tables:
+            continue
+        t = concat_tables(tables, result.plan.schema)
+        buf = io.BytesIO()
+        papq.write_table(t, buf, compression=codec,
+                         row_group_size=max(t.num_rows, 1))
+        blobs.append(buf.getvalue())
+    if not blobs:
+        # empty input: keep one empty blob so readers see the schema
+        from spark_rapids_tpu.exec.cpu import _empty_table
+        t = _empty_table(relation.schema)
+        buf = io.BytesIO()
+        papq.write_table(t, buf, compression=codec)
+        blobs.append(buf.getvalue())
+    relation.blobs = blobs
+
+
+class CpuInMemoryTableScanExec(PhysicalPlan):
+    """Host-side cached read (InMemoryTableScan CPU fallback analog)."""
+
+    is_tpu = False
+
+    def __init__(self, relation: CachedRelation, conf):
+        super().__init__()
+        self.relation = relation
+        self.conf = conf
+
+    @property
+    def schema(self) -> Schema:
+        return self.relation.schema
+
+    def execute(self):
+        materialize(self.relation, self.conf)
+
+        def part(blob: bytes) -> Iterator[pa.Table]:
+            yield papq.read_table(io.BytesIO(blob))
+
+        return [part(b) for b in self.relation.blobs]
+
+    def simple_string(self) -> str:
+        return (f"CpuInMemoryTableScanExec("
+                f"partitions={len(self.relation.blobs or [])})")
+
+
+class TpuInMemoryTableScanExec(TpuExec):
+    """Device-decoding cached read (GpuInMemoryTableScanExec analog)."""
+
+    def __init__(self, relation: CachedRelation, conf):
+        super().__init__()
+        self.relation = relation
+        self.conf = conf
+        self.metrics.extra["fallbackColumns"] = 0
+
+    @property
+    def schema(self) -> Schema:
+        return self.relation.schema
+
+    def execute(self):
+        from spark_rapids_tpu.io import device_parquet as devpq
+        materialize(self.relation, self.conf)
+        schema = self.schema
+
+        def part(blob: bytes):
+            pf = papq.ParquetFile(io.BytesIO(blob))
+            for rg in range(pf.metadata.num_row_groups):
+                with tpu_semaphore():
+                    with timed(self.metrics):
+                        batch, fallbacks = devpq.decode_row_group(
+                            blob, rg, schema, parquet_file=pf)
+                    self.metrics.extra["fallbackColumns"] += \
+                        len(fallbacks)
+                    self.metrics.num_output_rows += int(batch.num_rows)
+                    self.metrics.num_output_batches += 1
+                    yield batch
+
+        return [part(b) for b in self.relation.blobs]
+
+    def simple_string(self) -> str:
+        return (f"TpuInMemoryTableScanExec("
+                f"partitions={len(self.relation.blobs or [])})")

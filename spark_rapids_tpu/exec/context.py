@@ -32,3 +32,22 @@ def task_context(partition_id, row_offset):
         yield
     finally:
         _CTX.reset(token)
+
+
+# file-scan scope for input_file_name() (reference: GpuInputFileBlock.scala
+# reads InputFileBlockHolder; scans set it per file)
+_FILE_CTX: contextvars.ContextVar[str] = contextvars.ContextVar(
+    "spark_rapids_tpu_input_file", default="")
+
+
+def input_file() -> str:
+    return _FILE_CTX.get()
+
+
+@contextlib.contextmanager
+def file_scope(path: str):
+    token = _FILE_CTX.set(path)
+    try:
+        yield
+    finally:
+        _FILE_CTX.reset(token)

@@ -653,6 +653,107 @@ def _str_replace(e, table):
     return CpuVal(dt.STRING, out, s.valid & search.valid & repl.valid)
 
 
+def _substring_index(e, table):
+    s = evaluate(e.children[0], table)
+    delim = e.children[1].value
+    count = int(e.children[2].value)
+    n = len(s.data)
+    out = np.empty(n, dtype=object)
+    for i in range(n):
+        st = s.data[i]
+        if not delim or count == 0:
+            out[i] = ""
+        elif count > 0:
+            out[i] = delim.join(st.split(delim)[:count])
+        else:
+            out[i] = delim.join(st.split(delim)[count:])
+    return CpuVal(dt.STRING, out, s.valid.copy())
+
+
+def _string_split(e, table):
+    import re as _re
+    s = evaluate(e.children[0], table)
+    pattern = e.children[1].value
+    limit = int(e.children[2].value)
+    rx = _re.compile(pattern)
+    n = len(s.data)
+    out = np.empty(n, dtype=object)
+    for i in range(n):
+        # Spark: limit<=0 keeps all (dropping no trailing empties for
+        # limit<0, dropping them for limit=0); limit>0 caps the count
+        # (note limit=1 = no split; re.split's maxsplit=0 means unlimited)
+        if limit == 1:
+            parts = [s.data[i]]
+        else:
+            parts = rx.split(s.data[i], maxsplit=limit - 1 if limit > 0
+                             else 0)
+        if limit == 0:
+            while parts and parts[-1] == "":
+                parts.pop()
+        out[i] = parts
+    return CpuVal(e.dtype, out, s.valid.copy())
+
+
+def _regexp_replace(e, table):
+    import re as _re
+    s = evaluate(e.children[0], table)
+    rx = _re.compile(e.children[1].value)
+    repl = evaluate(e.children[2], table)
+    n = len(s.data)
+    out = np.empty(n, dtype=object)
+    for i in range(n):
+        # Java-style $1 group references -> python \1
+        r = _re.sub(r"\$(\d+)", r"\\\1", repl.data[i])
+        out[i] = rx.sub(r, s.data[i])
+    return CpuVal(dt.STRING, out, s.valid & repl.valid)
+
+
+def _md5(e, table):
+    import hashlib
+    s = evaluate(e.child, table)
+    n = len(s.data)
+    out = np.empty(n, dtype=object)
+    for i in range(n):
+        out[i] = hashlib.md5(
+            s.data[i].encode("utf-8")).hexdigest()
+    return CpuVal(dt.STRING, out, s.valid.copy())
+
+
+def _at_least_n_non_nulls(e, table):
+    n = table.num_rows
+    count = np.zeros(n, dtype=np.int32)
+    for c in e.children:
+        v = evaluate(c, table)
+        ok = v.valid.copy()
+        if v.dtype.id in (dt.TypeId.FLOAT32, dt.TypeId.FLOAT64):
+            ok &= ~np.isnan(np.where(v.valid, v.data, 0.0))
+        count += ok.astype(np.int32)
+    return CpuVal(dt.BOOL, count >= e.n, np.ones(n, dtype=bool))
+
+
+def _from_unixtime(e, table):
+    v = evaluate(e.child, table)
+    secs = v.data.astype(np.int64)
+    days = secs // 86400
+    rem = secs - days * 86400
+    dates = days.astype("datetime64[D]")
+    n = len(secs)
+    out = np.empty(n, dtype=object)
+    for i in range(n):
+        r = int(rem[i])
+        out[i] = (f"{str(dates[i])} "
+                  f"{r // 3600:02d}:{(r // 60) % 60:02d}:{r % 60:02d}")
+    return CpuVal(dt.STRING, out, v.valid.copy())
+
+
+def _input_file_name(e, table):
+    from spark_rapids_tpu.exec import context
+    n = table.num_rows
+    return CpuVal(dt.STRING,
+                  np.full(n, context.input_file(), dtype=object),
+                  np.ones(n, dtype=bool))
+
+
 def _initcap(e, table):
     def cap(s: str) -> str:
         out = []
@@ -1149,6 +1250,13 @@ _DISPATCH = {
     ir.StringTrimRight: _str_unary(lambda s: s.rstrip(" ")),
     ir.InitCap: _initcap,
     ir.StringReplace: _str_replace,
+    ir.SubstringIndex: _substring_index,
+    ir.StringSplit: _string_split,
+    ir.RegExpReplace: _regexp_replace,
+    ir.Md5: _md5,
+    ir.AtLeastNNonNulls: _at_least_n_non_nulls,
+    ir.FromUnixTime: _from_unixtime,
+    ir.InputFileName: _input_file_name,
     ir.StringLocate: _locate,
     ir.LPad: _pad(True),
     ir.RPad: _pad(False),

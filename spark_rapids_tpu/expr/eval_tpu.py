@@ -328,6 +328,16 @@ def _eval_isnan(e, batch):
     return ColVal(dt.BOOL, _is_nan(c) & c.validity, jnp.ones_like(c.validity))
 
 
+def _eval_at_least_n_non_nulls(e, batch):
+    count = jnp.zeros((batch.capacity,), dtype=jnp.int32)
+    for c in e.children:
+        v = evaluate(c, batch)
+        ok = v.validity & ~_is_nan(v)
+        count = count + ok.astype(jnp.int32)
+    return ColVal(dt.BOOL, count >= e.n,
+                  jnp.ones((batch.capacity,), dtype=jnp.bool_))
+
+
 def _eval_coalesce(e, batch):
     vals = [evaluate(c, batch) for c in e.children]
     out = vals[0]
@@ -1234,6 +1244,7 @@ _DISPATCH = {
     ir.IsNotNull: _eval_isnotnull,
     ir.IsNan: _eval_isnan,
     ir.Coalesce: _eval_coalesce,
+    ir.AtLeastNNonNulls: _eval_at_least_n_non_nulls,
     ir.NaNvl: _eval_nanvl,
     ir.If: _eval_if,
     ir.CaseWhen: _eval_casewhen,

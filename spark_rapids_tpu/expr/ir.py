@@ -694,6 +694,67 @@ class InitCap(UnaryExpression):
         self.nullable = self.child.nullable
 
 
+class SubstringIndex(Expression):
+    """substring_index(str, delim, count) — reference:
+    stringFunctions.scala GpuSubstringIndex (literal delim/count)."""
+
+    def __init__(self, s: Expression, delim: Expression,
+                 count: Expression):
+        self.children = (s, delim, count)
+
+    def resolve(self) -> None:
+        if not (isinstance(self.children[1], Literal)
+                and isinstance(self.children[2], Literal)):
+            raise TypeError("substring_index delimiter and count must be "
+                            "literals")
+        self.dtype = dt.STRING
+        self.nullable = self.children[0].nullable
+
+
+class StringSplit(Expression):
+    """split(str, regex[, limit]) -> array<string> — reference:
+    stringFunctions.scala GpuStringSplit (literal pattern)."""
+
+    def __init__(self, s: Expression, pattern: Expression,
+                 limit: Expression):
+        self.children = (s, pattern, limit)
+
+    def resolve(self) -> None:
+        if not (isinstance(self.children[1], Literal)
+                and isinstance(self.children[2], Literal)):
+            raise TypeError("split pattern and limit must be literals")
+        self.dtype = dt.DType(dt.TypeId.LIST, element=dt.STRING)
+        self.nullable = self.children[0].nullable
+
+
+class RegExpReplace(Expression):
+    """regexp_replace(str, pattern, replacement) with a literal pattern
+    (reference: shims Spark300Shims.scala:183-247 GpuRegExpReplace —
+    likewise incompat-flagged for regex dialect differences)."""
+
+    def __init__(self, s: Expression, pattern: Expression,
+                 replacement: Expression):
+        self.children = (s, pattern, replacement)
+
+    def resolve(self) -> None:
+        if not isinstance(self.children[1], Literal):
+            raise TypeError("regexp_replace pattern must be a literal")
+        self.dtype = dt.STRING
+        self.nullable = self.children[0].nullable or \
+            self.children[2].nullable
+
+
+class Md5(UnaryExpression):
+    """md5(col) -> 32-char hex string (reference: HashFunctions.scala
+    GpuMd5)."""
+
+    def resolve(self) -> None:
+        if self.child.dtype != dt.STRING:
+            raise TypeError("md5 requires a string input")
+        self.dtype = dt.STRING
+        self.nullable = self.child.nullable
+
+
 class LPad(Expression):
     def __init__(self, s: Expression, length: Expression, pad: Expression):
         self.children = (s, length, pad)
@@ -786,6 +847,44 @@ class UnixTimestampFromTs(UnaryExpression):
     def resolve(self) -> None:
         self.dtype = dt.INT64
         self.nullable = self.child.nullable
+
+
+class FromUnixTime(UnaryExpression):
+    """seconds since epoch -> 'yyyy-MM-dd HH:mm:ss' string, UTC only
+    (reference: datetimeExpressions.scala GpuFromUnixTime — the default
+    format only, like the reference's supported subset)."""
+
+    def resolve(self) -> None:
+        if not self.child.dtype.is_numeric:
+            raise TypeError("from_unixtime requires numeric seconds")
+        self.dtype = dt.STRING
+        self.nullable = self.child.nullable
+
+
+class AtLeastNNonNulls(Expression):
+    """true when >= n of the children are non-null (and non-NaN for
+    floats) — reference: nullExpressions.scala GpuAtLeastNNonNulls."""
+
+    def __init__(self, n: int, children: Sequence[Expression]):
+        self.n = int(n)
+        self.children = tuple(children)
+
+    def resolve(self) -> None:
+        self.dtype = dt.BOOL
+        self.nullable = False
+
+
+class InputFileName(Expression):
+    """input_file_name(): path of the file feeding the current batch, or
+    '' outside a file scan (reference: GpuInputFileBlock.scala
+    GpuInputFileName; value threaded through a scan-scoped context)."""
+
+    def __init__(self):
+        self.children = ()
+
+    def resolve(self) -> None:
+        self.dtype = dt.STRING
+        self.nullable = False
 
 
 # ---------------------------------------------------------------------------

@@ -471,7 +471,14 @@ def decode_row_group(path: str, row_group: int, schema: Schema,
     """Decode one row group to a DeviceBatch.
 
     Returns (batch, fallback_columns) — fallback columns were host-decoded
-    (Arrow) because their chunks use unsupported encodings/types."""
+    (Arrow) because their chunks use unsupported encodings/types.
+
+    ``path`` may also be an in-memory parquet blob (bytes) — the cached
+    -batch decode path (ParquetCachedBatchSerializer analog)."""
+    if parquet_file is None and isinstance(path,
+                                           (bytes, bytearray, memoryview)):
+        import io as _io
+        parquet_file = papq.ParquetFile(_io.BytesIO(path))
     pf = parquet_file or papq.ParquetFile(path)
     md = pf.metadata
     names = [md.schema.column(i).path for i in range(md.num_columns)]

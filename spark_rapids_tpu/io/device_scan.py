@@ -76,6 +76,11 @@ class TpuParquetScanExec(TpuExec):
         return self._schema
 
     def _file_part(self, file_index: int) -> Iterator[DeviceBatch]:
+        from spark_rapids_tpu.exec.context import file_scope
+        with file_scope(self.scan.paths[file_index]):
+            yield from self._file_part_inner(file_index)
+
+    def _file_part_inner(self, file_index: int) -> Iterator[DeviceBatch]:
         path = self.scan.paths[file_index]
         pv_list = self.scan.options.get("part_values") or []
         pv = pv_list[file_index] if file_index < len(pv_list) else {}

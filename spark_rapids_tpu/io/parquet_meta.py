@@ -226,9 +226,13 @@ def read_chunk_pages(path: str, row_group: int, col_idx: int,
                          cc.data_page_offset < start):
         start = cc.data_page_offset
     total = cc.total_compressed_size
-    with open(path, "rb") as f:
-        f.seek(start)
-        data = f.read(total)
+    if isinstance(path, (bytes, bytearray, memoryview)):
+        # in-memory parquet blob (cached-batch path)
+        data = bytes(path[start:start + total])
+    else:
+        with open(path, "rb") as f:
+            f.seek(start)
+            data = f.read(total)
 
     pq_schema = md.schema
     col_schema = pq_schema.column(col_idx)

@@ -242,7 +242,9 @@ class CpuFileScanExec(PhysicalPlan):
 
         # PERFILE: one partition per file
         def part(i):
-            yield from self._batches(self._read_one(i))
+            from spark_rapids_tpu.exec.context import file_scope
+            with file_scope(self.scan.paths[i]):
+                yield from self._batches(self._read_one(i))
         return [part(i) for i in indices]
 
     def simple_string(self) -> str:

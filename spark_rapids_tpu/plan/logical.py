@@ -345,6 +345,35 @@ class Join(LogicalPlan):
         return (f"Join({self.how}, {list(zip(self.left_keys, self.right_keys))})")
 
 
+class CachedRelation(LogicalPlan):
+    """df.cache(): the child's output materialized once as parquet blobs
+    (one per partition) and served from them afterwards.
+
+    Reference analog: ``ParquetCachedBatchSerializer``
+    (shims/spark310/.../ParquetCachedBatchSerializer.scala:253 —
+    ``compressColumnarBatchWithParquet`` at :333) + GpuInMemoryTableScanExec.
+    Delta: blob encode happens on host via Arrow (the reference encodes on
+    device via Table.writeParquetChunked); decode runs on device through
+    the same pallas/XLA parquet decoder as file scans.
+    """
+
+    def __init__(self, child: LogicalPlan):
+        self.children = (child,)
+        self.blobs: Optional[List[bytes]] = None   # one per partition
+
+    @property
+    def schema(self) -> Schema:
+        return self.children[0].schema
+
+    @property
+    def materialized(self) -> bool:
+        return self.blobs is not None
+
+    def simple_string(self) -> str:
+        state = "materialized" if self.materialized else "pending"
+        return f"CachedRelation({state})"
+
+
 class Range(LogicalPlan):
     """spark.range analog (reference: GpuRangeExec,
     basicPhysicalOperators.scala:187)."""

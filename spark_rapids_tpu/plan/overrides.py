@@ -457,6 +457,29 @@ def _register_file_scan_rule():
 _register_file_scan_rule()
 
 
+def _register_cache_scan_rule():
+    from spark_rapids_tpu.exec.cache import (CpuInMemoryTableScanExec,
+                                             TpuInMemoryTableScanExec)
+
+    def _tag_cache(n, conf) -> List[str]:
+        if not conf.get(cfg.CACHE_DEVICE_DECODE):
+            return ["cached-batch device decode disabled by "
+                    f"{cfg.CACHE_DEVICE_DECODE.key}"]
+        return []
+
+    register_exec_rule(CpuInMemoryTableScanExec, ExecRule(
+        "InMemoryTableScanExec",
+        "TPU cached-batch scan: parquet blobs decode in HBM "
+        "(GpuInMemoryTableScanExec / ParquetCachedBatchSerializer analog)",
+        _no_exprs,
+        convert=lambda n, ch, conf: TpuInMemoryTableScanExec(
+            n.relation, conf),
+        extra_tag=_tag_cache))
+
+
+_register_cache_scan_rule()
+
+
 # ---------------------------------------------------------------------------
 # Meta tree
 # ---------------------------------------------------------------------------

@@ -21,6 +21,9 @@ def plan_cpu(node: lp.LogicalPlan, conf: RapidsTpuConf) -> PhysicalPlan:
     if isinstance(node, lp.FileScan):
         from spark_rapids_tpu.io.readers import CpuFileScanExec
         return CpuFileScanExec(node, conf)
+    if isinstance(node, lp.CachedRelation):
+        from spark_rapids_tpu.exec.cache import CpuInMemoryTableScanExec
+        return CpuInMemoryTableScanExec(node, conf)
     if isinstance(node, lp.Project):
         child = plan_cpu(node.children[0], conf)
         return _plan_project(node, child, conf)

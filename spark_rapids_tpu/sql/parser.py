@@ -202,6 +202,35 @@ def _register_functions():
         return F.replace(Column(args[0]), _lit(args[1], "search"),
                          _lit(args[2], "replacement")).expr
 
+    _FUNCTIONS["md5"] = wrap(F.md5, 1)
+    _FUNCTIONS["from_unixtime"] = wrap(F.from_unixtime, 1)
+    _FUNCTIONS["input_file_name"] = wrap(
+        lambda: F.input_file_name(), 0)
+
+    def _substring_index(args):
+        if len(args) != 3:
+            raise SqlParseError("substring_index takes 3 arguments")
+        return F.substring_index(
+            Column(args[0]), _lit(args[1], "delimiter"),
+            _lit(args[2], "count")).expr
+
+    def _regexp_replace(args):
+        if len(args) != 3:
+            raise SqlParseError("regexp_replace takes 3 arguments")
+        return F.regexp_replace(Column(args[0]),
+                                _lit(args[1], "pattern"),
+                                _lit(args[2], "replacement")).expr
+
+    def _split(args):
+        if len(args) not in (2, 3):
+            raise SqlParseError("split takes 2 or 3 arguments")
+        limit = _lit(args[2], "limit") if len(args) == 3 else -1
+        return F.split(Column(args[0]), _lit(args[1], "pattern"),
+                       limit).expr
+
+    _FUNCTIONS["substring_index"] = _substring_index
+    _FUNCTIONS["regexp_replace"] = _regexp_replace
+    _FUNCTIONS["split"] = _split
     _FUNCTIONS["locate"] = _locate
     _FUNCTIONS["lpad"] = _pad(F.lpad)
     _FUNCTIONS["rpad"] = _pad(F.rpad)

@@ -1,0 +1,145 @@
+"""df.cache() tests (reference analog: cache_test.py over
+ParquetCachedBatchSerializer + GpuInMemoryTableScanExec)."""
+
+import datetime as dt
+
+import numpy as np
+import pyarrow as pa
+import pytest
+
+from tests.parity import (assert_tables_equal, with_cpu_session,
+                          with_tpu_session)
+
+_CONF = {"spark.rapids.tpu.sql.variableFloatAgg.enabled": True}
+
+
+def _table(n=5000):
+    rng = np.random.default_rng(11)
+    return pa.table({
+        "i": pa.array(rng.integers(-100, 100, n), type=pa.int32()),
+        "l": pa.array(rng.integers(0, 1 << 40, n), type=pa.int64()),
+        "f": rng.uniform(-1e3, 1e3, n),
+        "s": [f"row-{v}" for v in rng.integers(0, 50, n)],
+        "d": pa.array(
+            [dt.date(1992, 1, 1) + dt.timedelta(days=int(v))
+             for v in rng.integers(0, 2000, n)], type=pa.date32()),
+    })
+
+
+def test_cache_roundtrip_parity():
+    t = _table()
+
+    def run(session):
+        from spark_rapids_tpu import col
+        df = session.create_dataframe(t).filter(col("i") > 0).cache()
+        first = df.collect()
+        second = df.collect()     # served from cache
+        assert_tables_equal(first, second, approx_float=False)
+        return second
+
+    cpu = with_cpu_session(run)
+    tpu = with_tpu_session(run, _CONF)
+    assert_tables_equal(cpu, tpu, ignore_order=True)
+
+
+def test_cache_materializes_once():
+    t = _table(1000)
+
+    def run(session):
+        from spark_rapids_tpu import col
+        calls = []
+        session.add_plan_listener(lambda r: calls.append(r))
+        df = session.create_dataframe(t).filter(col("i") > 0).cache()
+        df.collect()
+        blobs_after_first = df.plan.blobs
+        assert blobs_after_first is not None
+        df.collect()
+        # same blob objects — no re-materialization
+        assert df.plan.blobs is blobs_after_first
+        return True
+
+    assert with_tpu_session(run, _CONF)
+
+
+def test_cached_scan_on_device_plan():
+    t = _table(1000)
+
+    def run(session):
+        df = session.create_dataframe(t).cache()
+        df.collect()     # build cache
+        from spark_rapids_tpu import functions as F
+        q = df.group_by("s").agg(F.count("*").alias("c"))
+        return q.explain_string("physical")
+
+    plan = with_tpu_session(run, _CONF)
+    assert "TpuInMemoryTableScanExec" in plan, plan
+
+
+def test_cached_scan_kill_switch_falls_back():
+    t = _table(500)
+
+    def run(session):
+        df = session.create_dataframe(t).cache()
+        return df.explain_string("physical")
+
+    plan = with_tpu_session(run, {
+        **_CONF, "spark.rapids.tpu.sql.cache.deviceDecode.enabled": False})
+    assert "CpuInMemoryTableScanExec" in plan
+    assert "TpuInMemoryTableScanExec" not in plan
+
+
+def test_unpersist_restores_plan():
+    t = _table(500)
+
+    def run(session):
+        df = session.create_dataframe(t).cache()
+        assert df.is_cached
+        df.unpersist()
+        assert not df.is_cached
+        return df.collect()
+
+    out = with_tpu_session(run, _CONF)
+    assert out.num_rows == 500
+
+
+def test_cache_downstream_query_parity():
+    t = _table()
+
+    def run(session):
+        from spark_rapids_tpu import col, functions as F
+        df = session.create_dataframe(t).cache()
+        df.count()       # trigger materialization via one action
+        return (df.filter(col("f") > 0)
+                .group_by("s")
+                .agg(F.sum("l").alias("sl"), F.avg("f").alias("af"),
+                     F.count("*").alias("c"))
+                .sort("s").collect())
+
+    cpu = with_cpu_session(run)
+    tpu = with_tpu_session(run, _CONF)
+    assert_tables_equal(cpu, tpu)
+
+
+def test_cache_empty_input():
+    def run(session):
+        from spark_rapids_tpu import col
+        df = session.create_dataframe(_table(50)).filter(
+            col("i") > 1000).cache()
+        out = df.collect()
+        assert out.num_rows == 0
+        return out.schema.names
+
+    assert with_tpu_session(run, _CONF) == ["i", "l", "f", "s", "d"]
+
+
+@pytest.mark.parametrize("codec", ["none", "snappy", "zstd"])
+def test_cache_compression_codecs(codec):
+    t = _table(800)
+
+    def run(session):
+        df = session.create_dataframe(t).cache()
+        return df.collect()
+
+    out = with_tpu_session(run, {
+        **_CONF, "spark.rapids.tpu.sql.cache.compression": codec})
+    assert out.num_rows == 800

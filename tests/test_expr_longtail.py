@@ -1,0 +1,175 @@
+"""Long-tail expression tests: substring_index, split, regexp_replace,
+md5, AtLeastNNonNulls, from_unixtime, input_file_name (reference:
+stringFunctions.scala, HashFunctions.scala, nullExpressions.scala,
+datetimeExpressions.scala, GpuInputFileBlock.scala)."""
+
+import hashlib
+
+import numpy as np
+import pyarrow as pa
+
+from spark_rapids_tpu.api import functions as F
+from spark_rapids_tpu.api.column import col, lit
+from tests.parity import (assert_tables_equal,
+                          assert_tpu_and_cpu_are_equal_collect,
+                          with_cpu_session, with_tpu_session)
+
+
+def _strings():
+    return pa.table({
+        "s": ["www.apache.org", "a.b.c.d", "no-dots", "", "x..y",
+              "trailing."],
+        "t": ["hello world", "foo123bar456", "  pad  ", "CAPS", "",
+              "a-b-c"],
+    })
+
+
+def test_substring_index_parity():
+    t = _strings()
+
+    def fn(session):
+        df = session.create_dataframe(t)
+        return df.select(
+            F.substring_index(col("s"), ".", 2).alias("p2"),
+            F.substring_index(col("s"), ".", -1).alias("m1"),
+            F.substring_index(col("s"), ".", 0).alias("z"))
+
+    assert_tpu_and_cpu_are_equal_collect(fn)
+    out = with_cpu_session(lambda s: fn(s).collect())
+    assert out.column("p2").to_pylist()[0] == "www.apache"
+    assert out.column("m1").to_pylist()[0] == "org"
+    assert out.column("z").to_pylist()[0] == ""
+
+
+def test_split_and_element():
+    t = _strings()
+
+    def fn(session):
+        df = session.create_dataframe(t)
+        return df.select(F.split(col("t"), "-").alias("parts"))
+
+    out = with_cpu_session(lambda s: fn(s).collect())
+    assert out.column("parts").to_pylist()[5] == ["a", "b", "c"]
+    assert_tpu_and_cpu_are_equal_collect(fn)
+
+
+def test_split_regex_and_limit():
+    t = pa.table({"s": ["a1b22c333d", "xyz"]})
+
+    def fn(session):
+        df = session.create_dataframe(t)
+        return df.select(F.split(col("s"), "[0-9]+").alias("a"),
+                         F.split(col("s"), "[0-9]+", 2).alias("b"))
+
+    out = with_cpu_session(lambda s: fn(s).collect())
+    assert out.column("a").to_pylist()[0] == ["a", "b", "c", "d"]
+    assert out.column("b").to_pylist()[0] == ["a", "b22c333d"]
+
+
+def test_regexp_replace_parity():
+    t = _strings()
+
+    def fn(session):
+        df = session.create_dataframe(t)
+        return df.select(
+            F.regexp_replace(col("t"), "[0-9]+", "#").alias("r"),
+            F.regexp_replace(col("t"), "(fo+)", "<$1>").alias("g"))
+
+    out = with_cpu_session(lambda s: fn(s).collect())
+    assert out.column("r").to_pylist()[1] == "foo#bar#"
+    assert out.column("g").to_pylist()[1] == "<foo>123bar456"
+    assert_tpu_and_cpu_are_equal_collect(fn)
+
+
+def test_md5_matches_hashlib():
+    t = _strings()
+
+    def fn(session):
+        return session.create_dataframe(t).select(
+            F.md5(col("s")).alias("h"))
+
+    out = with_cpu_session(lambda s: fn(s).collect())
+    expect = [hashlib.md5(v.encode()).hexdigest()
+              for v in t.column("s").to_pylist()]
+    assert out.column("h").to_pylist() == expect
+    assert_tpu_and_cpu_are_equal_collect(fn)
+
+
+def test_at_least_n_non_nulls():
+    t = pa.table({
+        "a": [1.0, None, float("nan"), 4.0],
+        "b": pa.array([None, 2, 3, 4], type=pa.int32()),
+        "c": ["x", None, None, "w"],
+    })
+
+    def fn(session):
+        df = session.create_dataframe(t)
+        return df.select(
+            F.atleast_n_nonnulls(2, col("a"), col("b"),
+                                 col("c")).alias("ge2"))
+
+    out = with_cpu_session(lambda s: fn(s).collect())
+    # row2: a is NaN (not counted), b=3, c=None → 1 → False
+    assert out.column("ge2").to_pylist() == [True, False, False, True]
+    assert_tpu_and_cpu_are_equal_collect(fn)
+
+
+def test_from_unixtime():
+    t = pa.table({"sec": pa.array([0, 86399, 1_600_000_000],
+                                  type=pa.int64())})
+
+    def fn(session):
+        return session.create_dataframe(t).select(
+            F.from_unixtime(col("sec")).alias("ts"))
+
+    out = with_cpu_session(lambda s: fn(s).collect())
+    assert out.column("ts").to_pylist() == [
+        "1970-01-01 00:00:00", "1970-01-01 23:59:59",
+        "2020-09-13 12:26:40"]
+    assert_tpu_and_cpu_are_equal_collect(fn)
+
+
+def test_input_file_name(tmp_path):
+    import pyarrow.parquet as papq
+    for i in range(2):
+        papq.write_table(pa.table({"v": [i * 10 + 1, i * 10 + 2]}),
+                         tmp_path / f"f{i}.parquet")
+
+    def fn(session):
+        df = session.read.parquet(str(tmp_path / "f0.parquet"),
+                                  str(tmp_path / "f1.parquet"))
+        return df.select(col("v"),
+                         F.input_file_name().alias("f")).collect()
+
+    for runner, conf in ((with_cpu_session, None),
+                         (with_tpu_session,
+                          {"spark.rapids.tpu.sql."
+                           "variableFloatAgg.enabled": True})):
+        out = runner(fn) if conf is None else runner(fn, conf)
+        rows = sorted(zip(out.column("v").to_pylist(),
+                          out.column("f").to_pylist()))
+        assert rows[0][0] == 1 and rows[0][1].endswith("f0.parquet")
+        assert rows[-1][0] == 12 and rows[-1][1].endswith("f1.parquet")
+
+
+def test_sql_exposes_new_functions():
+    def run(session):
+        session.create_dataframe(_strings()) \
+            .create_or_replace_temp_view("t")
+        return session.sql(
+            "SELECT substring_index(s, '.', 1) AS h, md5(s) AS m, "
+            "regexp_replace(t, '[0-9]+', '') AS r FROM t").collect()
+
+    out = with_cpu_session(run)
+    assert out.column("h").to_pylist()[0] == "www"
+    assert len(out.column("m").to_pylist()[0]) == 32
+
+
+def test_split_limit_one_no_split():
+    t = pa.table({"s": ["a,b,c"]})
+
+    def fn(session):
+        return session.create_dataframe(t).select(
+            F.split(col("s"), ",", 1).alias("p")).collect()
+
+    assert with_cpu_session(fn).column("p").to_pylist() == [["a,b,c"]]
