@@ -251,6 +251,9 @@ class DataFrame:
 
     def unpersist(self) -> "DataFrame":
         if isinstance(self.plan, lp.CachedRelation):
+            # free the blobs for every dependent (derived DataFrames
+            # holding this CachedRelation re-materialize on next action)
+            self.plan.blobs = None
             self.plan = self.plan.children[0]
         return self
 

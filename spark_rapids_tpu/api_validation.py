@@ -1,0 +1,114 @@
+"""API audit: Cpu*Exec ↔ Tpu*Exec constructor-signature drift detection.
+
+Reference analog: the ``api_validation`` module
+(api_validation/.../ApiValidation.scala:181) reflectively diffs every
+``Gpu*Exec`` constructor against its Spark counterpart per shim version to
+catch upstream signature drift.  Here the "upstream" is our own CPU engine:
+every TPU exec must stay constructible from the same planning information
+as the CPU exec it replaces, so the per-class diff below catches the same
+kind of drift the reference's auditor does.
+
+Run: ``python -m spark_rapids_tpu.api_validation`` (prints a report,
+exit code 1 on unexpected drift), or call :func:`audit` from tests.
+"""
+
+from __future__ import annotations
+
+import inspect
+from typing import Dict, List, Tuple, Type
+
+# (cpu param names that the tpu side is allowed to add/substitute) —
+# conf-like trailing params carry engine configuration, not plan info,
+# and key_dtypes is pre-resolved promotion info only the CPU oracle needs
+_ALLOWED_EXTRA = {"conf", "conf_obj", "min_bucket", "max_batch_rows",
+                  "key_dtypes"}
+
+# documented, deliberate signature deltas (reference's audit likewise
+# prints a report of knowns rather than failing on them)
+_KNOWN_DIFFS = {
+    # broadcast/nested-loop CPU execs are thin *args wrappers over
+    # CpuJoinExec; the TPU classes take the join fields directly
+    "CpuBroadcastHashJoinExec",
+    "CpuBroadcastNestedLoopJoinExec",
+    # cartesian on CPU shares CpuJoinExec's full signature; the TPU exec
+    # only needs (left, right, condition, schema) since a cross join has
+    # no keys
+    "CpuCartesianProductExec",
+}
+
+
+def _exec_classes() -> Dict[str, Type]:
+    import spark_rapids_tpu.exec.cpu as cpux
+    import spark_rapids_tpu.exec.cache as cachex
+    import spark_rapids_tpu.exec.cpu_window as cpuw
+    import spark_rapids_tpu.exec.generate as genx
+    import spark_rapids_tpu.exec.tpu_aggregate as tpa
+    import spark_rapids_tpu.exec.tpu_basic as tpb
+    import spark_rapids_tpu.exec.tpu_join as tpj
+    import spark_rapids_tpu.exec.tpu_sort as tps
+    import spark_rapids_tpu.exec.tpu_window as tpw
+    import spark_rapids_tpu.io.device_scan as devscan
+    import spark_rapids_tpu.io.readers as readers
+    import spark_rapids_tpu.pyworker.execs as pyx
+    import spark_rapids_tpu.shuffle.exchange as ex
+
+    out: Dict[str, Type] = {}
+    for mod in (cpux, cachex, cpuw, genx, tpa, tpb, tpj, tps, tpw,
+                devscan, readers, pyx, ex):
+        for name, cls in vars(mod).items():
+            if inspect.isclass(cls) and name.endswith("Exec") and \
+                    (name.startswith("Cpu") or name.startswith("Tpu")):
+                out.setdefault(name, cls)
+    return out
+
+
+def _params(cls: Type) -> List[str]:
+    sig = inspect.signature(cls.__init__)
+    return [p for p in sig.parameters if p != "self"]
+
+
+def audit() -> Tuple[List[str], List[str], List[str]]:
+    """Returns (problems, knowns, audited_pairs)."""
+    classes = _exec_classes()
+    problems: List[str] = []
+    knowns: List[str] = []
+    pairs: List[str] = []
+    for name, cpu_cls in sorted(classes.items()):
+        if not name.startswith("Cpu"):
+            continue
+        tpu_name = "Tpu" + name[3:]
+        tpu_cls = classes.get(tpu_name)
+        if tpu_cls is None:
+            # CPU-only execs are legitimate (they're the fallback), but
+            # record them so a missing TPU counterpart is a visible,
+            # deliberate state rather than silent drift
+            continue
+        pairs.append(f"{name} <-> {tpu_name}")
+        cpu_p, tpu_p = _params(cpu_cls), _params(tpu_cls)
+        cpu_core = [p for p in cpu_p if p not in _ALLOWED_EXTRA]
+        tpu_core = [p for p in tpu_p if p not in _ALLOWED_EXTRA]
+        if cpu_core != tpu_core:
+            msg = (f"{name}({', '.join(cpu_p)}) vs "
+                   f"{tpu_name}({', '.join(tpu_p)}): plan-info params "
+                   f"differ: {cpu_core} != {tpu_core}")
+            (knowns if name in _KNOWN_DIFFS else problems).append(msg)
+    return problems, knowns, pairs
+
+
+def main() -> int:
+    problems, knowns, pairs = audit()
+    print(f"audited {len(pairs)} Cpu<->Tpu exec pairs")
+    for p in pairs:
+        print(f"  ok  {p}")
+    for p in knowns:
+        print(f"  known  {p}")
+    if problems:
+        print("SIGNATURE DRIFT:")
+        for p in problems:
+            print(f"  !!  {p}")
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -42,20 +42,21 @@ def materialize(relation: CachedRelation, conf) -> None:
 
     cpu_plan = plan_cpu(relation.children[0], conf)
     result = TpuOverrides.apply(cpu_plan, conf)
+    from spark_rapids_tpu.exec.cpu import _empty_table
     codec = str(conf.get(cfg.CACHE_COMPRESSION))
     blobs: List[bytes] = []
     for it in result.plan.execute():
         tables = [t for t in it]
-        if not tables:
-            continue
-        t = concat_tables(tables, result.plan.schema)
+        # empty partitions cache as empty blobs so the cached relation
+        # keeps the child's partition count (spark_partition_id /
+        # monotonically_increasing_id stay cache-transparent)
+        t = concat_tables(tables, result.plan.schema) if tables \
+            else _empty_table(relation.schema)
         buf = io.BytesIO()
         papq.write_table(t, buf, compression=codec,
                          row_group_size=max(t.num_rows, 1))
         blobs.append(buf.getvalue())
     if not blobs:
-        # empty input: keep one empty blob so readers see the schema
-        from spark_rapids_tpu.exec.cpu import _empty_table
         t = _empty_table(relation.schema)
         buf = io.BytesIO()
         papq.write_table(t, buf, compression=codec)

@@ -694,6 +694,39 @@ def _string_split(e, table):
     return CpuVal(e.dtype, out, s.valid.copy())
 
 
+def _java_replacement(template: str):
+    """Parse a Java Matcher-style replacement ($N group refs, backslash
+    escapes the next char) into a function(match) -> str, so Python's
+    template rules (octal escapes, bad-escape errors) never apply."""
+    segments = []   # str literal | int group index
+    i, buf = 0, []
+    while i < len(template):
+        ch = template[i]
+        if ch == "\\" and i + 1 < len(template):
+            buf.append(template[i + 1])
+            i += 2
+        elif ch == "$" and i + 1 < len(template) \
+                and template[i + 1].isdigit():
+            if buf:
+                segments.append("".join(buf))
+                buf = []
+            j = i + 1
+            while j < len(template) and template[j].isdigit():
+                j += 1
+            segments.append(int(template[i + 1:j]))
+            i = j
+        else:
+            buf.append(ch)
+            i += 1
+    if buf:
+        segments.append("".join(buf))
+
+    def expand(m):
+        return "".join(seg if isinstance(seg, str)
+                       else (m.group(seg) or "") for seg in segments)
+    return expand
+
+
 def _regexp_replace(e, table):
     import re as _re
     s = evaluate(e.children[0], table)
@@ -701,10 +734,13 @@ def _regexp_replace(e, table):
     repl = evaluate(e.children[2], table)
     n = len(s.data)
     out = np.empty(n, dtype=object)
-    for i in range(n):
-        # Java-style $1 group references -> python \1
-        r = _re.sub(r"\$(\d+)", r"\\\1", repl.data[i])
-        out[i] = rx.sub(r, s.data[i])
+    if isinstance(e.children[2], ir.Literal):
+        fn = _java_replacement(e.children[2].value)
+        for i in range(n):
+            out[i] = rx.sub(fn, s.data[i])
+    else:
+        for i in range(n):
+            out[i] = rx.sub(_java_replacement(repl.data[i]), s.data[i])
     return CpuVal(dt.STRING, out, s.valid & repl.valid)
 
 

@@ -173,3 +173,16 @@ def test_split_limit_one_no_split():
             F.split(col("s"), ",", 1).alias("p")).collect()
 
     assert with_cpu_session(fn).column("p").to_pylist() == [["a,b,c"]]
+
+
+def test_regexp_replace_java_template_semantics():
+    t = pa.table({"s": ["foo", "C:path"]})
+
+    def fn(session):
+        return session.create_dataframe(t).select(
+            F.regexp_replace(col("s"), "(fo+)", "[$0]").alias("whole"),
+            F.regexp_replace(col("s"), "o", "\\$").alias("esc")).collect()
+
+    out = with_cpu_session(fn)
+    assert out.column("whole").to_pylist()[0] == "[foo]"
+    assert out.column("esc").to_pylist()[0] == "f$$"
