@@ -337,6 +337,17 @@ class GroupedData:
 
     def agg(self, *aggs) -> DataFrame:
         agg_exprs = [_as_expr(a) for a in aggs]
+
+        # DISTINCT aggregates: shared double-aggregate rewrite (pre-alias
+        # so output names survive the strip)
+        plan2, groupings2, exprs2 = lp.rewrite_distinct_aggregates(
+            self.df.plan, self.groupings,
+            [e if isinstance(e, ir.Alias)
+             else ir.Alias(e, ir.output_name(e)) for e in agg_exprs])
+        if plan2 is not self.df.plan:
+            return GroupedData(DataFrame(plan2, self.df.session),
+                               groupings2).agg(*exprs2)
+
         if all(isinstance(e.children[0] if isinstance(e, ir.Alias) else e,
                           ir.AggregateExpression) for e in agg_exprs):
             return DataFrame(

@@ -261,6 +261,24 @@ def avg(c) -> Column:
 mean = avg
 
 
+def count_distinct(c) -> Column:
+    return Column(ir.Count(_c(c), distinct=True))
+
+
+countDistinct = count_distinct
+
+
+def sum_distinct(c) -> Column:
+    return Column(ir.Sum(_c(c), distinct=True))
+
+
+sumDistinct = sum_distinct
+
+
+def avg_distinct(c) -> Column:
+    return Column(ir.Average(_c(c), distinct=True))
+
+
 # -- UDFs -------------------------------------------------------------------
 
 def udf(f=None, returnType="string"):

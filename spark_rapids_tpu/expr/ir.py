@@ -973,10 +973,17 @@ class PythonUDF(Expression):
 
 class AggregateExpression(Expression):
     """Base for aggregate functions; evaluated by the aggregate exec, never
-    by the row-wise evaluators."""
+    by the row-wise evaluators.
 
-    def __init__(self, child: Optional[Expression]):
+    ``distinct=True`` never reaches an exec: GroupedData.agg rewrites
+    distinct aggregates into a double aggregate (dedup on (keys, child)
+    first, plain aggregate second) — Spark's RewriteDistinctAggregates
+    single-distinct shape."""
+
+    def __init__(self, child: Optional[Expression],
+                 distinct: bool = False):
         self.children = (child,) if child is not None else ()
+        self.distinct = distinct
 
     @property
     def child(self) -> Optional[Expression]:
@@ -1129,6 +1136,12 @@ class WindowExpression(Expression):
         self.order_dirs = tuple(
             (o.ascending, o.nulls_first_resolved) for o in order_by)
         order_exprs = [o.expr for o in order_by]
+        if isinstance(function, AggregateExpression) and \
+                getattr(function, "distinct", False):
+            # the double-aggregate rewrite cannot apply inside a window
+            raise NotImplementedError(
+                "DISTINCT aggregates are not supported in window "
+                "functions")
         self.children = (function, *partition_by, *order_exprs)
         if frame is None:
             if self.order_dirs:
@@ -1227,6 +1240,34 @@ def _try_compile_python_udf(node: "PythonUDF") -> Optional[Expression]:
         return out
     except Exception:
         return None
+
+
+def expr_eq(a: Expression, b: Expression) -> bool:
+    """Structural equality on unresolved expression trees (the analyzer's
+    semanticEquals role for our purposes).  Compares node type, children,
+    and every non-child instance attribute (so Cast targets, ignore_nulls
+    flags, distinct flags etc. participate)."""
+    if type(a) is not type(b):
+        return False
+    if isinstance(a, BoundReference):
+        return a.ordinal == b.ordinal
+    skip = ("children", "nullable")
+    ka = {k: v for k, v in a.__dict__.items() if k not in skip}
+    kb = {k: v for k, v in b.__dict__.items() if k not in skip}
+    if ka.keys() != kb.keys():
+        return False
+    for k in ka:
+        va, vb = ka[k], kb[k]
+        if isinstance(va, Expression) or isinstance(vb, Expression):
+            if not (isinstance(va, Expression)
+                    and isinstance(vb, Expression)
+                    and expr_eq(va, vb)):
+                return False
+        elif va != vb:
+            return False
+    if len(a.children) != len(b.children):
+        return False
+    return all(expr_eq(x, y) for x, y in zip(a.children, b.children))
 
 
 def collect(e: Expression, pred) -> List[Expression]:

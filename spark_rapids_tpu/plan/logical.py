@@ -219,6 +219,54 @@ class Union(LogicalPlan):
         return self.children[0].schema
 
 
+def rewrite_distinct_aggregates(plan: LogicalPlan, groupings, exprs):
+    """DISTINCT-aggregate rewrite shared by the DataFrame and SQL
+    frontends (Spark's RewriteDistinctAggregates, single-distinct shape):
+    dedup on (grouping keys, child) with an inner Aggregate, then
+    aggregate plainly over the deduped values.
+
+    ``exprs`` are the aggregate-bearing output expressions (plus HAVING,
+    if any).  Returns (plan, groupings, exprs) — unchanged when no
+    distinct aggregate is present; otherwise the inner Aggregate plan,
+    name-reference groupings, and exprs with distinct stripped and
+    grouping subtrees replaced by their output-name references.
+    """
+    all_aggs = [a for e in exprs for a in ir.collect(
+        e, lambda n: isinstance(n, ir.AggregateExpression))]
+    distincts = [a for a in all_aggs if getattr(a, "distinct", False)]
+    if not distincts:
+        return plan, groupings, exprs
+    if any(a.child is None for a in distincts):
+        raise ValueError("DISTINCT requires an aggregate child "
+                         "expression")
+    same_child = all(ir.expr_eq(a.child, distincts[0].child)
+                     for a in distincts[1:])
+    if not same_child or len(distincts) != len(all_aggs):
+        raise NotImplementedError(
+            "only a single distinct child expression, with no "
+            "non-distinct aggregates alongside, is supported (Spark's "
+            "Expand-based multi-distinct rewrite is not implemented)")
+    x = distincts[0].child
+    xname = "__distinct_val"
+    inner = Aggregate(plan, list(groupings) + [ir.Alias(x, xname)], [])
+    new_groupings = [ir.UnresolvedAttribute(ir.output_name(g))
+                     for g in groupings]
+
+    def repl(node):
+        for g in groupings:
+            if ir.expr_eq(node, g):
+                return ir.UnresolvedAttribute(ir.output_name(g))
+        if isinstance(node, ir.AggregateExpression) and \
+                getattr(node, "distinct", False):
+            r = node.with_children([ir.UnresolvedAttribute(xname)])
+            r.distinct = False
+            return r
+        return None
+
+    new_exprs = [ir.transform(e, repl) for e in exprs]
+    return inner, new_groupings, new_exprs
+
+
 def split_join_condition(condition: ir.Expression, lnames, rnames):
     """Split a boolean join condition into equi key pairs + residual.
 

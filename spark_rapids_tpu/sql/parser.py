@@ -519,6 +519,25 @@ class Parser:
             plan = lp.Project(plan, proj_exprs)
             return plan, _Scope(plan.schema.names)
 
+        # DISTINCT aggregates: shared double-aggregate rewrite before
+        # the leaf split (lp.rewrite_distinct_aggregates); pre-alias so
+        # output names survive the strip
+        proj_exprs = [e if isinstance(e, ir.Alias)
+                      else ir.Alias(e, ir.output_name(e))
+                      for e in proj_exprs]
+        rw_exprs = list(proj_exprs) + ([having] if having is not None
+                                       else [])
+        plan2, groupings2, exprs2 = lp.rewrite_distinct_aggregates(
+            plan, group_exprs, rw_exprs)
+        if plan2 is not plan:
+            plan = plan2
+            group_exprs = groupings2
+            if having is not None:
+                having = exprs2[-1]
+                proj_exprs = exprs2[:-1]
+            else:
+                proj_exprs = exprs2
+
         # aggregate: groupings = GROUP BY exprs; select items that are
         # bare group refs pass through, others must be aggregates (the
         # compound/post-projection split mirrors GroupedData.agg)
@@ -902,18 +921,20 @@ class Parser:
     def func_call(self, scope) -> ir.Expression:
         name = self.expect_name_or_kw().lower()
         self.expect("op", "(")
-        # count(*) / count(distinct x)
+        # count(*) / aggregate(DISTINCT x)
         if name == "count":
             if self.accept("op", "*"):
                 self.expect("op", ")")
                 return ir.Count(None)
-            if self.kw("distinct"):
-                raise SqlParseError(
-                    "COUNT(DISTINCT ...) is not supported; use a "
-                    "subquery with SELECT DISTINCT")
+            distinct = bool(self.kw("distinct"))
             arg = self.expr(scope)
             self.expect("op", ")")
-            return ir.Count(arg)
+            return ir.Count(arg, distinct=distinct)
+        if name in ("sum", "avg", "mean") and self.kw("distinct"):
+            arg = self.expr(scope)
+            self.expect("op", ")")
+            cls = ir.Sum if name == "sum" else ir.Average
+            return cls(arg, distinct=True)
         args: List[ir.Expression] = []
         if not (self.peek().kind == "op" and self.peek().value == ")"):
             args.append(self.expr(scope))
@@ -941,16 +962,7 @@ class Parser:
         return ir.UnresolvedAttribute(name)
 
 
-def _expr_eq(a: ir.Expression, b: ir.Expression) -> bool:
-    if type(a) is not type(b):
-        return False
-    if isinstance(a, ir.UnresolvedAttribute):
-        return a.attr_name == b.attr_name
-    if isinstance(a, ir.Literal):
-        return a.value == b.value
-    if len(a.children) != len(b.children):
-        return False
-    return all(_expr_eq(x, y) for x, y in zip(a.children, b.children))
+_expr_eq = ir.expr_eq
 
 
 def _group_ref(e: ir.Expression, group_keys, group_names

@@ -186,3 +186,78 @@ def test_regexp_replace_java_template_semantics():
     out = with_cpu_session(fn)
     assert out.column("whole").to_pylist()[0] == "[foo]"
     assert out.column("esc").to_pylist()[0] == "f$$"
+
+
+def test_count_distinct_dataframe_parity():
+    t = pa.table({
+        "g": ["a", "a", "a", "b", "b", None],
+        "v": pa.array([1, 1, 2, 3, None, 4], type=pa.int32()),
+    })
+
+    def fn(session):
+        df = session.create_dataframe(t)
+        return df.group_by("g").agg(
+            F.count_distinct(col("v")).alias("cd"))
+
+    assert_tpu_and_cpu_are_equal_collect(fn, ignore_order=True)
+    out = with_cpu_session(lambda s: fn(s).collect())
+    m = dict(zip(out.column("g").to_pylist(),
+                 out.column("cd").to_pylist()))
+    assert m["a"] == 2 and m["b"] == 1 and m[None] == 1
+
+
+def test_avg_distinct_global():
+    t = pa.table({"v": [2.0, 2.0, 4.0, None]})
+
+    def fn(session):
+        return session.create_dataframe(t).agg(
+            F.avg_distinct(col("v")).alias("ad")).collect()
+
+    assert with_cpu_session(fn).column("ad")[0].as_py() == 3.0
+
+
+def test_mixed_distinct_raises():
+    import pytest
+    t = pa.table({"g": ["a"], "v": [1]})
+
+    def fn(session):
+        df = session.create_dataframe(t)
+        with pytest.raises(NotImplementedError):
+            df.group_by("g").agg(F.count_distinct(col("v")),
+                                 F.count("*"))
+        return True
+
+    assert with_cpu_session(fn)
+
+
+def test_distinct_over_window_raises():
+    import pytest
+    from spark_rapids_tpu.api.window import Window
+    with pytest.raises(NotImplementedError):
+        F.count_distinct(col("v")).over(Window.partition_by("g"))
+
+
+def test_distinct_different_casts_rejected():
+    import pytest
+    t = pa.table({"g": ["a"], "v": [1]})
+
+    def fn(session):
+        df = session.create_dataframe(t)
+        with pytest.raises(NotImplementedError):
+            df.group_by("g").agg(
+                F.sum_distinct(col("v").cast("int")),
+                F.sum_distinct(col("v").cast("double")))
+        return True
+
+    assert with_cpu_session(fn)
+
+
+def test_sql_count_distinct_output_name():
+    def run(session):
+        session.create_dataframe(pa.table({"g": ["a"], "v": [1]})) \
+            .create_or_replace_temp_view("tt")
+        return session.sql(
+            "SELECT g, count(DISTINCT v) FROM tt GROUP BY g").collect()
+
+    out = with_cpu_session(run)
+    assert "__distinct_val" not in " ".join(out.column_names)

@@ -133,7 +133,6 @@ def test_sql_errors():
         ("SELECT name FROM people WHERE", "unexpected"),
         ("SELECT unknown_fn(age) FROM people", "unknown function"),
         ("SELECT p.oops FROM people p", "not found"),
-        ("SELECT count(DISTINCT age) FROM people", "not supported"),
     ]:
         with pytest.raises(SqlParseError) as ei:
             with_cpu_session(_run_sql(bad))
@@ -201,3 +200,21 @@ def test_sql_string_scalar_functions():
     assert out.column("r").to_pylist() == ["ann.."]
     assert out.column("rep").to_pylist() == ["onn"]
     assert out.column("loc").to_pylist() == [1]
+
+
+def test_sql_count_distinct():
+    q = ("SELECT city, count(DISTINCT age) AS n FROM people "
+         "GROUP BY city ORDER BY city NULLS LAST")
+    out = check(q)
+    # sf: ages {34, None} → 1; la: {25, 18} → 2; ny: {47} → 1; null: {25}
+    m = dict(zip(out.column("city").to_pylist(),
+                 out.column("n").to_pylist()))
+    assert m["sf"] == 1 and m["la"] == 2 and m["ny"] == 1
+
+
+def test_sql_sum_distinct():
+    q = "SELECT sum(DISTINCT salary) AS s FROM people"
+    out = check(q)
+    # salaries {100.0, 85.5, 92.0, None, 40.0, 85.5} → distinct sum
+    assert abs(out.column("s")[0].as_py() - (100.0 + 85.5 + 92.0 + 40.0)) \
+        < 1e-9
