@@ -213,8 +213,14 @@ class CpuWindowExec(PhysicalPlan):
         # ordering "preceding" means larger values, so bounds flip
         v = ovals[0][order[i]]
         if v is None:
+            # null current row: its peers on value-bounded sides, the
+            # partition bound on unbounded sides (Spark's bound
+            # comparators: null vs null+offset compare equal, null vs
+            # value follows the null ordering)
             qs, qe = peers(ps, pe, i)
-            return qs, qe - 1  # null orders by itself: frame = its peers
+            a = ps if frame.start is None else qs
+            b = pe - 1 if frame.end is None else qe - 1
+            return a, b
         ascending = True
         if getattr(self, "_range_dirs", None):
             ascending = self._range_dirs[0][0]
@@ -224,14 +230,43 @@ class CpuWindowExec(PhysicalPlan):
         else:
             lo = v - frame.end if frame.end is not None else None
             hi = v - frame.start if frame.start is not None else None
-        a, b = pe, ps - 1
-        for j in range(ps, pe):
-            w = ovals[0][order[j]]
-            if w is None:
-                continue
-            if (lo is None or w >= lo) and (hi is None or w <= hi):
-                a = min(a, j)
-                b = max(b, j)
+        # Spark's frame scans (Sliding/Unbounded*WindowFunctionFrame):
+        # the comparator treats a null order key as -inf when nulls sort
+        # first and +inf when they sort last, so a value-bounded side
+        # excludes the null run on its side (or degenerates TO the
+        # opposite null run when no value qualifies), while an unbounded
+        # side reaches the partition bound.
+        vals = [ovals[0][order[j]] for j in range(ps, pe)]
+        nulls_first = bool(vals) and vals[0] is None
+        nleading = 0
+        while nleading < len(vals) and vals[nleading] is None:
+            nleading += 1
+        ntrailing = 0
+        while ntrailing < len(vals) - nleading and \
+                vals[-1 - ntrailing] is None:
+            ntrailing += 1
+        if not nulls_first:
+            nleading = 0
+        else:
+            ntrailing = 0
+        vlo, vhi = ps + nleading, pe - 1 - ntrailing
+
+        if frame.start is None:
+            a = ps
+        else:
+            a = pe - ntrailing   # no qualifying value: trailing null run
+            for j in range(vlo, vhi + 1):
+                if ovals[0][order[j]] >= lo:
+                    a = j
+                    break
+        if frame.end is None:
+            b = pe - 1
+        else:
+            b = ps + nleading - 1  # no qualifying value: leading null run
+            for j in range(vhi, vlo - 1, -1):
+                if ovals[0][order[j]] <= hi:
+                    b = j
+                    break
         return a, b
 
 

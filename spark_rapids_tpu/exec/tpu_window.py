@@ -89,10 +89,109 @@ class _WinCtx:
             jax.ops.segment_max(pos, self.peer_seg, num_segments=cap),
             self.peer_seg)
         self.sorted_exists = jnp.take(row_mask, self.order)
+        # finite RANGE frames need the (single) order value in sorted
+        # space plus its direction/null placement
+        self.order_dirs = tuple(order_dirs)
+        self.order_vals = ovals
 
     def sorted_val(self, v: ColVal) -> ColVal:
         c = v.to_column().gather(self.order, self.sorted_exists)
         return ColVal(c.dtype, c.data, c.validity, c.lengths)
+
+
+def _seg_searchsorted(vals: jnp.ndarray, lo0: jnp.ndarray,
+                      hi0: jnp.ndarray, target: jnp.ndarray,
+                      left: bool) -> jnp.ndarray:
+    """Vectorized per-row binary search of ``target`` within the sorted
+    slice [lo0, hi0] of ``vals`` (inclusive positions).  Returns the
+    insertion point (bisect_left/bisect_right semantics)."""
+    cap = vals.shape[0]
+    lo = lo0.astype(jnp.int64)
+    hi = hi0.astype(jnp.int64) + 1
+    steps = int(np.ceil(np.log2(cap + 1))) + 1
+
+    def body(_, lh):
+        lo, hi = lh
+        active = lo < hi
+        mid = (lo + hi) // 2
+        v = jnp.take(vals, jnp.clip(mid, 0, cap - 1))
+        go_right = (v < target) if left else (v <= target)
+        new_lo = jnp.where(go_right, mid + 1, lo)
+        new_hi = jnp.where(go_right, hi, mid)
+        return (jnp.where(active, new_lo, lo),
+                jnp.where(active, new_hi, hi))
+
+    lo, hi = lax.fori_loop(0, steps, body, (lo, hi))
+    return lo
+
+
+def _sat_add(w: jnp.ndarray, off: int) -> jnp.ndarray:
+    """w + off with int64 saturation (full-range order values must not
+    wrap)."""
+    if w.dtype == jnp.float64:
+        return w + off
+    t = w + np.int64(off)
+    if off > 0:
+        return jnp.where(t < w, np.iinfo(np.int64).max, t)
+    if off < 0:
+        return jnp.where(t > w, np.iinfo(np.int64).min, t)
+    return t
+
+
+def _range_frame_bounds(ctx: _WinCtx, frame: ir.WindowFrame):
+    """Finite RANGE offsets (reference analog: cudf
+    aggregateWindowsOverTimeRanges, GpuWindowExpression.scala:233-269):
+    row i's frame = partition rows whose order value lies within
+    [v_i + start, v_i + end] along the sort direction, via a segmented
+    binary search over the sorted order values.
+
+    Nulls (and NaN for float keys) sort into contiguous runs at one end
+    of the partition; the search is restricted to the plain-value run,
+    and a null/NaN current row frames over its peer group on finite
+    sides and the partition bound on unbounded sides (Spark semantics).
+    """
+    v = ctx.sorted_val(ctx.order_vals[0])
+    asc, nulls_first = ctx.order_dirs[0]
+    use_float = v.dtype.is_floating
+    w = v.data.astype(jnp.float64 if use_float else jnp.int64)
+    if not asc:
+        w = -w   # descending sort == ascending on the negation
+    exists = ctx.sorted_exists
+    is_null = ~v.validity & exists
+    if use_float:
+        is_nan = jnp.isnan(w) & ~is_null & exists
+        w = jnp.where(is_nan, 0.0, w)   # value unused once excluded
+    else:
+        is_nan = jnp.zeros_like(is_null)
+    special = is_null | is_nan
+
+    # per-partition counts -> bounds of the plain-value run in sorted
+    # sequence (nulls at the nulls_first/last end; NaN at the largest-
+    # value end, which after desc negation is the sequence start)
+    def pcount(mask):
+        c = jax.ops.segment_sum(mask.astype(jnp.int64), ctx.part_seg,
+                                num_segments=ctx.cap)
+        return jnp.take(c, ctx.part_seg)
+
+    nulls = pcount(is_null)
+    nans = pcount(is_nan)
+    lo = ctx.part_start + jnp.where(nulls_first, nulls, 0) + \
+        jnp.where(asc, 0, nans)
+    hi = ctx.part_end - jnp.where(nulls_first, 0, nulls) - \
+        jnp.where(asc, nans, 0)
+
+    start, end = frame.start, frame.end
+    a = ctx.part_start if start is None else jnp.maximum(
+        _seg_searchsorted(w, lo, hi, _sat_add(w, start), left=True), lo)
+    b = ctx.part_end if end is None else jnp.minimum(
+        _seg_searchsorted(w, lo, hi, _sat_add(w, end), left=False) - 1,
+        hi)
+    # null/NaN current rows: peer group on finite sides
+    if start is not None:
+        a = jnp.where(special, ctx.peer_start, a)
+    if end is not None:
+        b = jnp.where(special, ctx.peer_end, b)
+    return a, b
 
 
 def _frame_bounds(ctx: _WinCtx, frame: ir.WindowFrame):
@@ -107,7 +206,7 @@ def _frame_bounds(ctx: _WinCtx, frame: ir.WindowFrame):
         return ctx.part_start, ctx.peer_end
     if frame.start is None and frame.end is None:
         return ctx.part_start, ctx.part_end
-    raise NotImplementedError("finite range offsets on TPU")
+    return _range_frame_bounds(ctx, frame)
 
 
 def _prefix(x: jnp.ndarray) -> jnp.ndarray:

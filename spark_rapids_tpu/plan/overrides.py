@@ -235,8 +235,17 @@ def _tag_window(n, conf) -> List[str]:
         finite_range = fr.kind == "range" and not (
             fr.start is None and fr.end in (0, None))
         if finite_range:
-            out.append("finite RANGE frame offsets not supported on TPU "
-                       "yet")
+            # device range frames binary-search the single numeric/
+            # temporal order key (cudf aggregateWindowsOverTimeRanges
+            # analog)
+            if len(we.order_exprs) != 1:
+                out.append("finite RANGE frames require exactly one "
+                           "ORDER BY expression")
+            else:
+                od = we.order_exprs[0].dtype
+                if od is not None and not (od.is_numeric or od.is_temporal):
+                    out.append(f"finite RANGE frames need a numeric or "
+                               f"temporal order key, got {od.name}")
         if isinstance(fn, (ir.Min, ir.Max)) and fr.start is not None:
             out.append("bounded-start min/max window frames not supported "
                        "on TPU yet")

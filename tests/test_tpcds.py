@@ -1,0 +1,59 @@
+"""TPC-DS-like suite parity tests (reference analog: tpcds_test.py over
+TpcdsLikeSpark queries, CPU vs accelerated sessions)."""
+
+import pytest
+
+from spark_rapids_tpu.bench import tpcds
+from spark_rapids_tpu.bench.runner import BenchmarkRunner, CompareResults
+from tests.parity import with_cpu_session, with_tpu_session
+
+SF = 0.002
+
+
+@pytest.fixture(scope="module")
+def data():
+    return tpcds.generate(SF, seed=13)
+
+
+# queries whose final sort keys can tie → order-independent compare
+_IGNORE_ORDER = {"q3", "q7", "q19", "q42", "q52", "q55", "q68", "q73",
+                 "q98"}
+
+
+@pytest.mark.parametrize("name", sorted(tpcds.QUERIES,
+                                        key=lambda q: int(q[1:])))
+def test_tpcds_query_parity(name, data):
+    def run(session):
+        tables = tpcds.setup(session, data)
+        return tpcds.QUERIES[name](tables).collect()
+
+    cpu = with_cpu_session(run)
+    tpu = with_tpu_session(
+        run, {"spark.rapids.tpu.sql.variableFloatAgg.enabled": True})
+    cmp = CompareResults(epsilon=1e-4,
+                         ignore_ordering=name in _IGNORE_ORDER)
+    problems = cmp.compare(cpu, tpu)
+    assert not problems, f"{name}: {problems}"
+
+
+def test_tpcds_results_nonempty(data):
+    def run(session):
+        tables = tpcds.setup(session, data)
+        return {n: q(tables).collect().num_rows
+                for n, q in tpcds.QUERIES.items()}
+
+    counts = with_cpu_session(run)
+    empty = [n for n, c in counts.items() if c == 0]
+    assert not empty, f"queries with empty results at SF={SF}: {empty}"
+
+
+def test_tpcds_benchmark_runner(data):
+    def run(session):
+        tables = tpcds.setup(session, data)
+        r = BenchmarkRunner(session, tables, tpcds.QUERIES,
+                            suite="tpcds", mode="cpu")
+        return r.run(names=["q42", "q96"], iterations=1)
+
+    report = with_cpu_session(run)
+    assert all(q.error is None for q in report.queries), \
+        [(q.query, q.error) for q in report.queries]

@@ -143,3 +143,72 @@ def test_no_partition_window():
         .select("o", F.row_number().over(w).alias("rn"),
                 F.sum("v").over(w).alias("rsum")),
         ignore_order=True)
+
+
+# -- finite RANGE frames on device (cudf aggregateWindowsOverTimeRanges
+# analog) --------------------------------------------------------------
+
+def test_finite_range_sum_on_tpu_plan():
+    w = Window.partition_by("k").order_by("o").range_between(-5, 5)
+
+    def q(s):
+        df = gen_df(s, [int_key_gen, IntGen(32, lo=0, hi=60), long_gen],
+                    ["k", "o", "v"], n=200, seed=21)
+        return df.select("k", "o", F.sum("v").over(w).alias("rsum"))
+
+    assert_tpu_and_cpu_are_equal_collect(q, ignore_order=True)
+    from tests.parity import with_tpu_session
+    plan = with_tpu_session(
+        lambda s: q(s).explain_string("physical"),
+        {"spark.rapids.tpu.sql.variableFloatAgg.enabled": True})
+    assert "TpuWindowExec" in plan, plan
+
+
+def test_finite_range_desc_and_counts():
+    w = (Window.partition_by("k").order_by(col("o").desc())
+         .range_between(-3, 3))
+
+    def q(s):
+        df = gen_df(s, [int_key_gen, IntGen(32, lo=0, hi=40), long_gen],
+                    ["k", "o", "v"], n=150, seed=22)
+        return df.select("k", "o",
+                         F.count("v").over(w).alias("c"),
+                         F.avg("v").over(w).alias("a"))
+
+    assert_tpu_and_cpu_are_equal_collect(q, ignore_order=True)
+
+
+def test_finite_range_with_null_order_keys():
+    w = Window.partition_by("k").order_by("o").range_between(-2, 2)
+
+    def q(s):
+        df = gen_df(s, [int_key_gen,
+                        IntGen(32, lo=0, hi=20, null_prob=0.2),
+                        long_gen],
+                    ["k", "o", "v"], n=150, seed=23)
+        return df.select("k", "o", F.sum("v").over(w).alias("rsum"))
+
+    assert_tpu_and_cpu_are_equal_collect(q, ignore_order=True)
+
+
+def test_finite_range_one_sided():
+    w = (Window.partition_by("k").order_by("o")
+         .range_between(Window.unbounded_preceding, 4))
+
+    def q(s):
+        df = gen_df(s, [int_key_gen, IntGen(32, lo=0, hi=30), long_gen],
+                    ["k", "o", "v"], n=120, seed=24)
+        return df.select("k", "o", F.sum("v").over(w).alias("rsum"))
+
+    assert_tpu_and_cpu_are_equal_collect(q, ignore_order=True)
+
+
+def test_finite_range_double_order_key():
+    w = Window.partition_by("k").order_by("o").range_between(-1, 1)
+
+    def q(s):
+        df = gen_df(s, [int_key_gen, double_gen, long_gen],
+                    ["k", "o", "v"], n=150, seed=25)
+        return df.select("k", "o", F.sum("v").over(w).alias("rsum"))
+
+    assert_tpu_and_cpu_are_equal_collect(q, ignore_order=True)
