@@ -133,6 +133,8 @@ class TpuSparkSession:
         return result
 
     def _execute(self, plan: lp.LogicalPlan) -> pa.Table:
+        from spark_rapids_tpu.exec.context import set_input_file
+        set_input_file("")  # fresh query: no stale input_file_name()
         result = self._plan_physical(plan)
         tables: List[pa.Table] = []
         for it in result.plan.execute():

@@ -22,10 +22,14 @@ scoping rules:
   * ``minPartitionNum`` constrains only coalescing, never skew splitting.
 
 Trade-off vs the reference: specs need both sides' sizes, so the
-coordinator materializes every reduce partition in HBM before the first
-read (AQE reads map statistics instead; our exchange does not persist
-host-side stats for the device transport).  Partition buffers are
-refcounted and released as the last spec referencing them drains.
+coordinator materializes every reduce partition before the first read
+(AQE reads map statistics instead; our exchange does not persist
+host-side stats for the device transport).  To keep that from pinning
+HBM on large joins, every buffered partition batch is registered in the
+spill catalog (when enabled) so the device store can evict it to
+host/disk under pressure, exactly like the hash aggregate's buffered
+partials.  Partition buffers are refcounted and released as the last
+spec referencing them drains.
 """
 
 from __future__ import annotations
@@ -156,8 +160,10 @@ def plan_join_specs(lsizes: Sequence[int], rsizes: Sequence[int],
 
 class _JoinAdaptiveState:
     """Shared coordinator: pulls both exchanges once, plans one spec
-    list, hands per-side views their batches.  Buffers are refcounted per
-    (side, partition) and dropped when the last referencing spec drains."""
+    list, hands per-side views their batches.  Buffers are spillable
+    (registered in the spill catalog when enabled), refcounted per
+    (side, partition), and dropped when the last referencing spec
+    drains."""
 
     def __init__(self, left: PhysicalPlan, right: PhysicalPlan, how: str,
                  conf_obj):
@@ -169,21 +175,28 @@ class _JoinAdaptiveState:
         self.threshold = int(conf_obj.get(cfg.ADAPTIVE_SKEW_THRESHOLD))
         self.min_parts = int(conf_obj.get(cfg.ADAPTIVE_MIN_PARTITION_NUM))
         self.specs: Optional[List[Tuple]] = None
-        self.batches: List[List[List[DeviceBatch]]] = [[], []]
+        # handles with .get()/.close() (SpillableBatch/PlainBatchHandle)
+        self.batches: List[List[List]] = [[], []]
         self._refs: List[Dict[int, int]] = [{}, {}]
 
     def ensure(self) -> None:
         if self.specs is not None:
             return
+        from spark_rapids_tpu.mem.spill import register_or_hold
         per_side_sizes = []
         per_side_rows = []
         for side, child in enumerate(self.children):
-            parts = [[b for b in it] for it in child.execute()]
-            self.batches[side] = parts
-            per_side_sizes.append(
-                [sum(int(b.nbytes()) for b in bs) for bs in parts])
-            per_side_rows.append(
-                [sum(int(b.num_rows) for b in bs) for bs in parts])
+            sizes: List[int] = []
+            rows: List[int] = []
+            handles: List[List] = []
+            for it in child.execute():
+                bs = [b for b in it]
+                sizes.append(sum(int(b.nbytes()) for b in bs))
+                rows.append(sum(int(b.num_rows) for b in bs))
+                handles.append([register_or_hold(b) for b in bs])
+            self.batches[side] = handles
+            per_side_sizes.append(sizes)
+            per_side_rows.append(rows)
         self.specs = plan_join_specs(
             per_side_sizes[0], per_side_sizes[1],
             per_side_rows[0], per_side_rows[1],
@@ -203,14 +216,19 @@ class _JoinAdaptiveState:
             skew_parts = {sp[side].partition for sp in self.specs
                           if isinstance(sp[side], SkewSplitSpec)}
             for p in skew_parts:
-                bs = self.batches[side][p]
-                if len(bs) > 1:
-                    self.batches[side][p] = [concat_batches(bs)]
+                hs = self.batches[side][p]
+                if len(hs) > 1:
+                    merged = concat_batches([h.get() for h in hs])
+                    for h in hs:
+                        h.close()
+                    self.batches[side][p] = [register_or_hold(merged)]
 
     def release(self, side: int, parts) -> None:
         for p in parts:
             self._refs[side][p] -= 1
             if self._refs[side][p] == 0:
+                for h in self.batches[side][p]:
+                    h.close()
                 self.batches[side][p] = []
 
 
@@ -253,8 +271,8 @@ class TpuAdaptiveJoinReaderExec(TpuExec):
 
         def reader(spec) -> Iterator[DeviceBatch]:
             if isinstance(spec, CoalescedSpec):
-                group = [b for p in range(spec.start, spec.end)
-                         for b in batches[p]]
+                group = [h.get() for p in range(spec.start, spec.end)
+                         for h in batches[p]]
                 if group:
                     with timed(self.metrics):
                         out = group[0] if len(group) == 1 \
@@ -266,17 +284,18 @@ class TpuAdaptiveJoinReaderExec(TpuExec):
                 else:
                     self.state.release(side, range(spec.start, spec.end))
             else:
-                bs = batches[spec.partition]
+                hs = batches[spec.partition]
                 count = spec.row_end - spec.row_start
-                if bs and count > 0:
+                if hs and count > 0:
+                    first = hs[0].get()
                     with timed(self.metrics):
                         # a replica spec spanning the whole partition
                         # (the non-split side) reuses the batch as-is
                         if spec.row_start == 0 and \
-                                count == int(bs[0].num_rows):
-                            out = bs[0]
+                                count == int(first.num_rows):
+                            out = first
                         else:
-                            out = self._row_slice(bs[0], spec.row_start,
+                            out = self._row_slice(first, spec.row_start,
                                                   count)
                     self.metrics.num_output_rows += int(out.num_rows)
                     self.metrics.num_output_batches += 1

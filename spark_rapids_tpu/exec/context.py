@@ -14,6 +14,7 @@ from __future__ import annotations
 
 import contextlib
 import contextvars
+import threading
 from typing import Any, Tuple
 
 _CTX: contextvars.ContextVar[Tuple[Any, Any]] = contextvars.ContextVar(
@@ -34,20 +35,26 @@ def task_context(partition_id, row_offset):
         _CTX.reset(token)
 
 
-# file-scan scope for input_file_name() (reference: GpuInputFileBlock.scala
-# reads InputFileBlockHolder; scans set it per file)
-_FILE_CTX: contextvars.ContextVar[str] = contextvars.ContextVar(
-    "spark_rapids_tpu_input_file", default="")
+# file-scan slot for input_file_name() (reference: GpuInputFileBlock.scala
+# reads InputFileBlockHolder; scans set it per file).  A thread-local
+# last-writer-wins slot set immediately before each batch is yielded —
+# NOT a generator-scoped contextvar, whose set/reset straddles yield
+# suspensions and so mis-attributes paths when two scans are consumed
+# interleaved (e.g. both sides of a join).  Thread-local matches Spark's
+# per-task InputFileBlockHolder: when partitions execute on a thread
+# pool, each task thread sees only its own scan's path.
+_FILE_SLOT = threading.local()
 
 
 def input_file() -> str:
-    return _FILE_CTX.get()
+    return getattr(_FILE_SLOT, "path", "")
 
 
-@contextlib.contextmanager
-def file_scope(path: str):
-    token = _FILE_CTX.set(path)
-    try:
-        yield
-    finally:
-        _FILE_CTX.reset(token)
+def set_input_file(path: str) -> None:
+    """Mark `path` as the source of the batch about to be yielded.
+
+    Scans must call ``set_input_file("")`` when exhausted, and the
+    session clears the slot at query start, so a later query that reads
+    no files sees Spark's empty-string default rather than a stale path.
+    """
+    _FILE_SLOT.path = path

@@ -188,9 +188,12 @@ class CpuWindowExec(PhysicalPlan):
             src = _vals(eval_cpu.evaluate(fn.child, t)) \
                 if fn.child is not None else [1] * t.num_rows
             for ps, pe in parts:
+                # partition-level range-scan stats are row-independent:
+                # hoist them out of the per-row loop (O(n) not O(n^2))
+                stats = self._range_stats(frame, ps, pe, ovals, order)
                 for i in range(ps, pe):
                     a, b = self._bounds(frame, ps, pe, i, peers, ovals,
-                                        order)
+                                        order, stats)
                     window = [src[order[j]] for j in range(a, b + 1)] \
                         if b >= a else []
                     out[i] = _agg_py(fn, window)
@@ -198,7 +201,57 @@ class CpuWindowExec(PhysicalPlan):
 
         raise NotImplementedError(type(fn).__name__)
 
-    def _bounds(self, frame, ps, pe, i, peers, ovals, order):
+    def _range_stats(self, frame, ps, pe, ovals, order):
+        """Row-independent per-partition stats for finite numeric RANGE
+        frames: normalized order values plus null/NaN run boundaries.
+
+        Spark's frame scans (Sliding/Unbounded*WindowFunctionFrame): the
+        comparator treats a null order key as -inf when nulls sort first
+        and +inf when they sort last, and NaN as above every finite
+        value; value-bounded sides exclude the null runs (or degenerate
+        TO the run that ranks past the bound), while an unbounded side
+        reaches the partition bound.
+        """
+        if frame.kind != "range" or not ovals or \
+                (frame.start is None and frame.end in (None, 0)):
+            return None
+        ascending = True
+        if getattr(self, "_range_dirs", None):
+            ascending = self._range_dirs[0][0]
+        # normalize to ascending w-space exactly like the TPU path
+        # (tpu_window's `w = -w` for DESC) so the monotonic scans are
+        # direction-agnostic
+        raw = [ovals[0][order[j]] for j in range(ps, pe)]
+        wvals = [None if x is None
+                 else (x if isinstance(x, float) and math.isnan(x)
+                       else (x if ascending else -x))
+                 for x in raw]
+        nulls_first = bool(wvals) and wvals[0] is None
+        nleading = 0
+        while nleading < len(wvals) and wvals[nleading] is None:
+            nleading += 1
+        ntrailing = 0
+        while ntrailing < len(wvals) - nleading and \
+                wvals[-1 - ntrailing] is None:
+            ntrailing += 1
+        if not nulls_first:
+            nleading = 0
+        else:
+            ntrailing = 0
+        vlo, vhi = ps + nleading, pe - 1 - ntrailing
+        # NaN rows rank above every finite value, so after normalization
+        # the NaN run sits at the high end of the value run under ASC and
+        # at the low (physical-start) end under DESC
+        nnan = sum(1 for j in range(vlo, vhi + 1)
+                   if isinstance(wvals[j - ps], float)
+                   and math.isnan(wvals[j - ps]))
+        if ascending:
+            flo, fhi = vlo, vhi - nnan
+        else:
+            flo, fhi = vlo + nnan, vhi
+        return (ascending, wvals, nleading, ntrailing, nnan, flo, fhi)
+
+    def _bounds(self, frame, ps, pe, i, peers, ovals, order, stats=None):
         if frame.kind == "rows":
             a = ps if frame.start is None else max(ps, i + frame.start)
             b = pe - 1 if frame.end is None else min(pe - 1, i + frame.end)
@@ -209,62 +262,45 @@ class CpuWindowExec(PhysicalPlan):
             return ps, qe - 1
         if frame.start is None and frame.end is None:
             return ps, pe - 1
-        # numeric range offsets over a single order column; under DESC
-        # ordering "preceding" means larger values, so bounds flip
+        # numeric range offsets over a single order column
         v = ovals[0][order[i]]
-        if v is None:
-            # null current row: its peers on value-bounded sides, the
+        if v is None or (isinstance(v, float) and math.isnan(v)):
+            # null/NaN current row: its peers on value-bounded sides, the
             # partition bound on unbounded sides (Spark's bound
-            # comparators: null vs null+offset compare equal, null vs
-            # value follows the null ordering)
+            # comparators: null+offset is null and NaN+offset is NaN,
+            # which compare equal to the row's own key and outside every
+            # finite value run)
             qs, qe = peers(ps, pe, i)
             a = ps if frame.start is None else qs
             b = pe - 1 if frame.end is None else qe - 1
             return a, b
-        ascending = True
-        if getattr(self, "_range_dirs", None):
-            ascending = self._range_dirs[0][0]
-        if ascending:
-            lo = v + frame.start if frame.start is not None else None
-            hi = v + frame.end if frame.end is not None else None
-        else:
-            lo = v - frame.end if frame.end is not None else None
-            hi = v - frame.start if frame.start is not None else None
-        # Spark's frame scans (Sliding/Unbounded*WindowFunctionFrame):
-        # the comparator treats a null order key as -inf when nulls sort
-        # first and +inf when they sort last, so a value-bounded side
-        # excludes the null run on its side (or degenerates TO the
-        # opposite null run when no value qualifies), while an unbounded
-        # side reaches the partition bound.
-        vals = [ovals[0][order[j]] for j in range(ps, pe)]
-        nulls_first = bool(vals) and vals[0] is None
-        nleading = 0
-        while nleading < len(vals) and vals[nleading] is None:
-            nleading += 1
-        ntrailing = 0
-        while ntrailing < len(vals) - nleading and \
-                vals[-1 - ntrailing] is None:
-            ntrailing += 1
-        if not nulls_first:
-            nleading = 0
-        else:
-            ntrailing = 0
-        vlo, vhi = ps + nleading, pe - 1 - ntrailing
+        if stats is None:
+            stats = self._range_stats(frame, ps, pe, ovals, order)
+        ascending, wvals, nleading, ntrailing, nnan, flo, fhi = stats
+        w = v if ascending else -v
+        lo = w + frame.start if frame.start is not None else None
+        hi = w + frame.end if frame.end is not None else None
 
         if frame.start is None:
             a = ps
         else:
-            a = pe - ntrailing   # no qualifying value: trailing null run
-            for j in range(vlo, vhi + 1):
-                if ovals[0][order[j]] >= lo:
+            if ascending and nnan:
+                a = fhi + 1        # NaN run satisfies >= any finite bound
+            else:
+                a = pe - ntrailing  # trailing null run (pe when none)
+            for j in range(flo, fhi + 1):
+                if wvals[j - ps] >= lo:
                     a = j
                     break
         if frame.end is None:
             b = pe - 1
         else:
-            b = ps + nleading - 1  # no qualifying value: leading null run
-            for j in range(vhi, vlo - 1, -1):
-                if ovals[0][order[j]] <= hi:
+            if not ascending and nnan:
+                b = flo - 1        # NaN run (in w-space) precedes finites
+            else:
+                b = ps + nleading - 1  # leading null run (ps-1 when none)
+            for j in range(fhi, flo - 1, -1):
+                if wvals[j - ps] <= hi:
                     b = j
                     break
         return a, b

@@ -148,10 +148,36 @@ class _MinMaxSpec(_AggSpec):
     def buffer_dtypes(self):
         return [self.agg.dtype]
 
-    def _reduce(self, data, validity, ctx):
+    def _reduce_string(self, data, validity, lengths, ctx):
+        """String min/max: rank every row by its total-order byte
+        encoding (exec/sortkeys.py), segment-argmin/argmax the ranks,
+        then gather the winning row's bytes.  cudf's GpuMin/GpuMax are
+        type-generic (reference: AggregateFunctions.scala:531)."""
+        considered = validity & ctx.row_mask
+        sv = ColVal(self.agg.dtype, data, considered, lengths)
+        words = sortkeys.encode_keys(sv, True, nulls_first=False)
+        order = sortkeys.lexsort_indices([words], considered)
+        rank = jnp.zeros((ctx.cap,), jnp.int64).at[order].set(
+            jnp.arange(ctx.cap, dtype=jnp.int64))
+        if self.is_min:
+            pos = jnp.where(considered, rank, _BIG)
+            win = _seg_min(pos, ctx.seg_orig, ctx.cap)
+            found = win < _BIG
+        else:
+            pos = jnp.where(considered, rank, -1)
+            win = _seg_max(pos, ctx.seg_orig, ctx.cap)
+            found = win >= 0
+        orig = jnp.take(order, jnp.clip(win, 0, ctx.cap - 1))
+        val = jnp.where(found[:, None], jnp.take(data, orig, axis=0), 0)
+        lens = jnp.where(found, jnp.take(lengths, orig), 0)
+        return [(val, found, lens)]
+
+    def _reduce(self, data, validity, lengths, ctx):
         d = self.agg.dtype
         tgt = d.to_np()
         considered = validity & ctx.row_mask
+        if d.is_string:
+            return self._reduce_string(data, validity, lengths, ctx)
         if d.is_floating:
             isnan = jnp.isnan(data)
             non_nan = considered & ~isnan
@@ -172,8 +198,6 @@ class _MinMaxSpec(_AggSpec):
                 # max: any NaN wins
                 val = jnp.where(has_nan, nan, red)
             return [(jnp.where(has_any, val, 0), has_any)]
-        if d.is_string:
-            raise NotImplementedError("min/max over strings on TPU")
         if d.is_bool:
             x = jnp.where(considered, data,
                           jnp.array(not self.is_min, dtype=bool))
@@ -193,13 +217,15 @@ class _MinMaxSpec(_AggSpec):
         return [(jnp.where(has, red, 0), has)]
 
     def update(self, v, ctx):
-        return self._reduce(v.data, v.validity, ctx)
+        return self._reduce(v.data, v.validity, v.lengths, ctx)
 
     def merge(self, bufs, ctx):
-        return self._reduce(bufs[0].data, bufs[0].validity, ctx)
+        return self._reduce(bufs[0].data, bufs[0].validity,
+                            bufs[0].lengths, ctx)
 
     def finalize(self, bufs):
-        return ColVal(self.agg.dtype, bufs[0].data, bufs[0].validity)
+        return ColVal(self.agg.dtype, bufs[0].data, bufs[0].validity,
+                      bufs[0].lengths)
 
 
 class _AverageSpec(_AggSpec):
@@ -242,7 +268,7 @@ class _FirstLastSpec(_AggSpec):
     def buffer_dtypes(self):
         return [self.agg.dtype, dt.BOOL]
 
-    def _pick(self, data, validity, considered, ctx):
+    def _pick(self, data, validity, lengths, considered, ctx):
         """In sorted space, pick first/last considered row per group.
 
         Stable lexsort preserves input order within a group, so 'first in
@@ -266,21 +292,26 @@ class _FirstLastSpec(_AggSpec):
             val = jnp.where(found[:, None], val, 0)
         else:
             val = jnp.where(found, val, 0)
+        if lengths is not None:
+            lens = jnp.where(found, jnp.take(lengths, orig), 0)
+            return [(val, vvalid, lens), (found, jnp.ones_like(found))]
         return [(val, vvalid), (found, jnp.ones_like(found))]
 
     def update(self, v, ctx):
         considered = ctx.row_mask & (v.validity if self.ignore_nulls
                                      else jnp.ones_like(v.validity))
-        return self._pick(v.data, v.validity, considered, ctx)
+        return self._pick(v.data, v.validity, v.lengths, considered, ctx)
 
     def merge(self, bufs, ctx):
         considered = ctx.row_mask & bufs[1].data.astype(bool)
         if self.ignore_nulls:
             considered = considered & bufs[0].validity
-        return self._pick(bufs[0].data, bufs[0].validity, considered, ctx)
+        return self._pick(bufs[0].data, bufs[0].validity, bufs[0].lengths,
+                          considered, ctx)
 
     def finalize(self, bufs):
-        return ColVal(self.agg.dtype, bufs[0].data, bufs[0].validity)
+        return ColVal(self.agg.dtype, bufs[0].data, bufs[0].validity,
+                      bufs[0].lengths)
 
 
 def make_spec(agg: ir.AggregateExpression) -> _AggSpec:
@@ -354,13 +385,16 @@ def gather_group_keys(key_vals: List[ColVal],
 
 def _append_buffers(cols, names, bufs_per_spec, specs, ctx):
     for ai, (spec, bufs) in enumerate(zip(specs, bufs_per_spec)):
-        for bi, ((data, valid), bdt) in enumerate(
-                zip(bufs, spec.buffer_dtypes())):
+        for bi, (buf, bdt) in enumerate(zip(bufs, spec.buffer_dtypes())):
+            data, valid = buf[0], buf[1]
+            lengths = buf[2] if len(buf) > 2 else None
             group_exists = jnp.arange(ctx.cap) < ctx.n_groups
             cols.append(DeviceColumn(
                 bdt, jnp.where(group_exists, data.astype(bdt.to_np()), 0)
                 if data.ndim == 1 else data,
-                valid & group_exists, None))
+                valid & group_exists,
+                jnp.where(group_exists, lengths, 0)
+                if lengths is not None else None))
             names.append(f"__a{ai}_{bi}")
 
 
@@ -452,9 +486,7 @@ class TpuHashAggregateExec(TpuExec):
             self._final_kernel = jax.jit(self._final_impl)
 
         def run():
-            from spark_rapids_tpu.mem import spill as spillmod
-            catalog = spillmod.get_catalog() if spillmod.is_enabled() \
-                else None
+            from spark_rapids_tpu.mem.spill import register_or_hold
             # buffered partials stay spillable between update and merge
             # (reference: aggregate.scala buffers partial results;
             # SpillableColumnarBatch keeps them evictable)
@@ -466,9 +498,7 @@ class TpuHashAggregateExec(TpuExec):
                             continue
                         with timed(self.metrics):
                             partial = self._update_kernel(b)
-                        partials.append(
-                            catalog.register(partial) if catalog is not None
-                            else _UnspillableHandle(partial))
+                        partials.append(register_or_hold(partial))
                 if not partials:
                     if self.groupings:
                         return  # grouped agg over empty input -> no rows
@@ -491,25 +521,19 @@ class TpuHashAggregateExec(TpuExec):
         return [run()]
 
 
-class _UnspillableHandle:
-    """Plain batch holder used when the spill catalog is disabled."""
-
-    def __init__(self, batch: DeviceBatch):
-        self._batch = batch
-
-    def get(self) -> DeviceBatch:
-        return self._batch
-
-    def close(self) -> None:
-        self._batch = None
-
-
 def _make_empty_buffer_batch(exec_: TpuHashAggregateExec) -> DeviceBatch:
     """Buffer-layout batch for a global aggregate over zero rows."""
     cap = 16
     cols, names = [], []
     for ai, spec in enumerate(exec_.specs):
         for bi, bdt in enumerate(spec.buffer_dtypes()):
+            if bdt.is_string:
+                cols.append(DeviceColumn(
+                    bdt, jnp.zeros((cap, 1), dtype=jnp.uint8),
+                    jnp.zeros((cap,), dtype=jnp.bool_),
+                    jnp.zeros((cap,), dtype=jnp.int32)))
+                names.append(f"__a{ai}_{bi}")
+                continue
             data = jnp.zeros((cap,), dtype=bdt.to_np())
             # count buffers are valid-0; value buffers are null
             valid = jnp.zeros((cap,), dtype=jnp.bool_)

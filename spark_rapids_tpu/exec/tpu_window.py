@@ -213,6 +213,40 @@ def _prefix(x: jnp.ndarray) -> jnp.ndarray:
     return jnp.concatenate([jnp.zeros((1,), x.dtype), jnp.cumsum(x)])
 
 
+def _range_sum(x: jnp.ndarray, a: jnp.ndarray, b: jnp.ndarray
+               ) -> jnp.ndarray:
+    """Subtraction-free per-row range sum over inclusive [a, b].
+
+    A prefix-sum difference cancels catastrophically when huge values
+    surround a small frame (|P| ~ 1e19 swallows a frame sum of 1.0), so
+    float frames instead decompose each range into O(log cap) power-of-
+    two blocks from a sparse table of pairwise partial sums — every
+    block is *added*, never subtracted, so the error stays relative to
+    the true frame sum.  This is the exact-per-frame evaluation the
+    reference gets from cudf's rolling-window kernels
+    (GpuWindowExpression.scala:233-269).
+    """
+    cap = x.shape[0]
+    levels = max(int(np.ceil(np.log2(cap))), 0) + 1 if cap > 1 else 1
+    tables = [x]
+    for lvl in range(1, levels):
+        half = 1 << (lvl - 1)
+        prev = tables[-1]
+        shifted = jnp.concatenate(
+            [prev[half:], jnp.zeros((min(half, cap),), prev.dtype)])[:cap]
+        tables.append(prev + shifted)
+    end = b.astype(jnp.int64) + 1
+    p = a.astype(jnp.int64)
+    acc = jnp.zeros(a.shape, x.dtype)
+    for lvl in range(levels - 1, -1, -1):
+        size = 1 << lvl
+        take = p + size <= end
+        val = jnp.take(tables[lvl], jnp.clip(p, 0, cap - 1))
+        acc = acc + jnp.where(take, val, 0)
+        p = jnp.where(take, p + size, p)
+    return acc
+
+
 def _window_agg(fn: ir.AggregateExpression, ctx: _WinCtx,
                 frame: ir.WindowFrame, batch: DeviceBatch) -> ColVal:
     if fn.child is not None:
@@ -249,8 +283,11 @@ def _window_agg(fn: ir.AggregateExpression, ctx: _WinCtx,
             frame_has_nan = (jnp.take(nanP, b + 1) - jnp.take(nanP, a)) > 0
         else:
             frame_has_nan = jnp.zeros((ctx.cap,), dtype=jnp.bool_)
-        P = _prefix(x)
-        s = jnp.take(P, b + 1) - jnp.take(P, a)
+        if is_float:
+            s = _range_sum(x, a, b)
+        else:
+            P = _prefix(x)
+            s = jnp.take(P, b + 1) - jnp.take(P, a)
         cnt = _prefix(valid.astype(jnp.int64))
         c = jnp.maximum(jnp.take(cnt, b + 1) - jnp.take(cnt, a), 0)
         c = jnp.where(nonempty, c, 0)

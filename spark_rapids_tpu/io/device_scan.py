@@ -76,9 +76,17 @@ class TpuParquetScanExec(TpuExec):
         return self._schema
 
     def _file_part(self, file_index: int) -> Iterator[DeviceBatch]:
-        from spark_rapids_tpu.exec.context import file_scope
-        with file_scope(self.scan.paths[file_index]):
-            yield from self._file_part_inner(file_index)
+        from spark_rapids_tpu.exec.context import set_input_file
+        path = self.scan.paths[file_index]
+        try:
+            for b in self._file_part_inner(file_index):
+                # set right before the yield so the consumer evaluates
+                # input_file_name() against THIS batch's file even when
+                # two scans are drained interleaved
+                set_input_file(path)
+                yield b
+        finally:
+            set_input_file("")
 
     def _file_part_inner(self, file_index: int) -> Iterator[DeviceBatch]:
         path = self.scan.paths[file_index]

@@ -242,9 +242,17 @@ class CpuFileScanExec(PhysicalPlan):
 
         # PERFILE: one partition per file
         def part(i):
-            from spark_rapids_tpu.exec.context import file_scope
-            with file_scope(self.scan.paths[i]):
-                yield from self._batches(self._read_one(i))
+            from spark_rapids_tpu.exec.context import set_input_file
+            path = self.scan.paths[i]
+            try:
+                for b in self._batches(self._read_one(i)):
+                    # set right before the yield so the consumer
+                    # evaluates input_file_name() against THIS batch's
+                    # file even when two scans are drained interleaved
+                    set_input_file(path)
+                    yield b
+            finally:
+                set_input_file("")
         return [part(i) for i in indices]
 
     def simple_string(self) -> str:

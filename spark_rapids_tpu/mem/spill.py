@@ -104,7 +104,9 @@ class BufferCatalog:
             min(host_budget, 1 << 30))
         self._buffers: Dict[int, _Buffer] = {}
         self._ids = itertools.count()
-        self._lock = threading.Lock()
+        # RLock: SpillableBatch.__del__ may fire during a GC triggered
+        # inside a catalog method that already holds the lock
+        self._lock = threading.RLock()
         self.device_bytes = 0
         self.host_bytes = 0
         self.spilled_device_bytes = 0  # metrics (memoryBytesSpilled analog)
@@ -273,11 +275,47 @@ class SpillableBatch:
             self._catalog.release(self._id)
             self._closed = True
 
+    def __del__(self):
+        # abandoned handles (e.g. a limit short-circuiting an adaptive
+        # join's readers) must not pin catalog entries forever
+        try:
+            self.close()
+        except Exception:
+            pass
+
     def __enter__(self):
         return self
 
     def __exit__(self, *a):
         self.close()
+
+
+class PlainBatchHandle:
+    """SpillableBatch-shaped holder (get/close) used by operators that
+    buffer batches when the spill catalog is disabled."""
+
+    def __init__(self, batch: DeviceBatch):
+        self._batch = batch
+
+    def get(self) -> DeviceBatch:
+        return self._batch
+
+    def close(self) -> None:
+        self._batch = None
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *a):
+        self.close()
+
+
+def register_or_hold(batch: DeviceBatch):
+    """Register `batch` in the global spill catalog when enabled, else
+    wrap it in a PlainBatchHandle; either way the caller gets a
+    get()/close() handle."""
+    return get_catalog().register(batch) if is_enabled() \
+        else PlainBatchHandle(batch)
 
 
 # ---------------------------------------------------------------------------

@@ -93,12 +93,6 @@ def _check_expr_node(e: ir.Expression, conf: RapidsTpuConf
                 return f"cast string->{e.to.name} not supported on TPU yet"
             if e.to.is_string:
                 return f"cast {src.name}->string not supported on TPU yet"
-    if isinstance(e, (ir.Min, ir.Max)) and e.child is not None and \
-            e.child.dtype is not None and e.child.dtype.is_string:
-        return "min/max over strings not supported on TPU yet"
-    if isinstance(e, (ir.First, ir.Last)) and e.child is not None and \
-            e.child.dtype is not None and e.child.dtype.is_string:
-        return "first/last over strings not supported on TPU yet"
     if isinstance(e, (ir.Sum, ir.Average)) and e.child is not None and \
             e.child.dtype is not None and e.child.dtype.is_floating:
         if not conf.get(cfg.VARIABLE_FLOAT_AGG) and \

@@ -69,6 +69,31 @@ def test_groupby_min_max_avg():
         ignore_order=True)
 
 
+def test_groupby_string_min_max():
+    from tests.data_gen import StringGen
+    assert_tpu_and_cpu_are_equal_collect(
+        lambda s: gen_df(s, [int_key_gen, StringGen(max_len=10)],
+                         ["k", "s"], n=300)
+        .group_by("k").agg(F.min("s").alias("mn"),
+                           F.max("s").alias("mx"),
+                           F.first("s").alias("f"),
+                           F.last("s").alias("l")),
+        ignore_order=True)
+
+
+def test_global_string_min_max():
+    from tests.data_gen import StringGen
+    assert_tpu_and_cpu_are_equal_collect(
+        lambda s: gen_df(s, [StringGen(max_len=12)], ["s"], n=150)
+        .agg(F.min("s").alias("mn"), F.max("s").alias("mx")))
+
+
+def test_global_string_min_max_empty():
+    assert_tpu_and_cpu_are_equal_collect(
+        lambda s: s.create_dataframe({"s": pa.array([], type=pa.string())})
+        .agg(F.min("s").alias("mn"), F.max("s").alias("mx")))
+
+
 def test_global_agg():
     assert_tpu_and_cpu_are_equal_collect(
         lambda s: gen_df(s, [long_gen], ["v"], n=100)

@@ -212,3 +212,33 @@ def test_finite_range_double_order_key():
         return df.select("k", "o", F.sum("v").over(w).alias("rsum"))
 
     assert_tpu_and_cpu_are_equal_collect(q, ignore_order=True)
+
+
+def test_finite_range_desc_null_order_keys():
+    w = (Window.partition_by("k").order_by(col("o").desc())
+         .range_between(-2, 2))
+
+    def q(s):
+        df = gen_df(s, [int_key_gen,
+                        IntGen(32, lo=0, hi=20, null_prob=0.25),
+                        long_gen],
+                    ["k", "o", "v"], n=150, seed=26)
+        return df.select("k", "o", F.sum("v").over(w).alias("rsum"),
+                         F.count("v").over(w).alias("c"))
+
+    assert_tpu_and_cpu_are_equal_collect(q, ignore_order=True)
+
+
+def test_finite_range_desc_double_order_key():
+    # DESC double order key: NaN/null runs sit at the physical start of
+    # each partition after the sort; frames must still exclude them
+    w = (Window.partition_by("k").order_by(col("o").desc())
+         .range_between(-1.5, 1.5))
+
+    def q(s):
+        df = gen_df(s, [int_key_gen, double_gen, long_gen],
+                    ["k", "o", "v"], n=150, seed=27)
+        return df.select("k", "o", F.sum("v").over(w).alias("rsum"),
+                         F.avg("v").over(w).alias("a"))
+
+    assert_tpu_and_cpu_are_equal_collect(q, ignore_order=True)
