@@ -367,6 +367,16 @@ def concat_batches(batches: Sequence[DeviceBatch],
     batches = [b for b in batches if int(b.num_rows) > 0] or list(batches[:1])
     if len(batches) == 1:
         return batches[0]
+    # distributed readers (shuffle/ici.py) hand out batches committed to
+    # their owning mesh device; concatenating across partitions must first
+    # colocate them or XLA rejects the mixed-device concat
+    devs = set()
+    for b in batches:
+        if b.columns:
+            devs |= set(b.columns[0].data.devices())
+    if len(devs) > 1:
+        target = sorted(devs, key=lambda d: d.id)[0]
+        batches = [jax.device_put(b, target) for b in batches]
     total = sum(int(b.num_rows) for b in batches)
     cap = bucket_rows(total, min_bucket)
     names = batches[0].names

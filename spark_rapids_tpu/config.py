@@ -251,6 +251,13 @@ SHUFFLE_PARTITIONS = conf(
     "Default number of shuffle partitions (spark.sql.shuffle.partitions "
     "analog).", int)
 
+AGG_EXCHANGE = conf(
+    "spark.rapids.tpu.sql.agg.exchange.enabled", False,
+    "Plan grouped aggregates as a hash exchange on the grouping keys "
+    "followed by a per-partition aggregate (Spark's partial/final "
+    "aggregate split restructured so the exchange can ride a distributed "
+    "data plane; auto-enabled when shuffle.transport=ici).", bool)
+
 ENABLE_FLOAT_SORT = conf(
     "spark.rapids.tpu.sql.sort.float.enabled", True,
     "Enable sorting on float columns (NaN ordering matches Spark: NaN sorts "

@@ -316,6 +316,11 @@ def wrap_join_children(left: PhysicalPlan, right: PhysicalPlan, how: str,
                                                    TpuShuffleExchangeExec)
     if not conf_obj.get(cfg.ADAPTIVE_ENABLED):
         return left, right
+    # the ICI plane keeps reducer batches committed to their owning mesh
+    # device; the adaptive reader's cross-partition coalesce would force
+    # cross-device concats, so exchanges ride ICI un-wrapped
+    if str(conf_obj.get(cfg.SHUFFLE_TRANSPORT)) == "ici":
+        return left, right
     if not (isinstance(left, TpuShuffleExchangeExec)
             and isinstance(right, TpuShuffleExchangeExec)
             and isinstance(left.partitioning, HashPartitioning)

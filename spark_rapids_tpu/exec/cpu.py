@@ -241,12 +241,16 @@ class CpuHashAggregateExec(PhysicalPlan):
     def __init__(self, child: PhysicalPlan,
                  groupings: Sequence[ir.Expression],
                  aggregates: Sequence[ir.Expression],
-                 schema: Schema):
+                 schema: Schema, per_partition: bool = False):
         super().__init__()
         self.children = (child,)
         self.groupings = list(groupings)
         self.aggregates = list(aggregates)
         self._schema = schema
+        # per_partition: each child partition aggregates independently
+        # (correct when the child is hash-partitioned on the grouping
+        # keys — the distributed plan shape, see planner two-stage agg)
+        self.per_partition = per_partition
 
     @property
     def schema(self) -> Schema:
@@ -283,104 +287,115 @@ class CpuHashAggregateExec(PhysicalPlan):
         return v
 
     def execute(self):
+        if self.per_partition:
+            def run_part(it):
+                t = concat_tables(list(it), self.children[0].schema)
+                out = self._agg_one(t)
+                if out.num_rows:
+                    yield out
+            return [run_part(it) for it in self.children[0].execute()]
+
         def run():
             t = _gather_single(self.children[0], self.children[0].schema)
-            proj = self._agg_arrays(t)
-            key_names = [f"__k{i}" for i in range(len(self.groupings))]
-
-            # arrow group_by cannot key on nested types; substitute a dense
-            # surrogate id per distinct nested value, map back afterwards
-            # (Spark supports grouping on arrays)
-            nested_originals = {}
-            for i, g in enumerate(self.groupings):
-                if g.dtype is None or not g.dtype.is_nested:
-                    continue
-                cname = f"__k{i}"
-                arr = proj.column(cname)
-                py = arr.to_pylist()
-                seen, originals = {}, []
-                sur = np.empty(len(py), dtype=np.int64)
-                for r, v in enumerate(py):
-                    k = self._hashable(v)
-                    if k not in seen:
-                        seen[k] = len(seen)
-                        originals.append(v)
-                    sur[r] = seen[k]
-                proj = proj.set_column(
-                    proj.column_names.index(cname), cname, pa.array(sur))
-                nested_originals[i] = (originals, arr.type)
-            aggs = []
-            out_names_in_result = []
-            count_modes = {}
-            for i, a in enumerate(self.aggregates):
-                if isinstance(a, ir.Count):
-                    mode = "all" if a.child is None else "only_valid"
-                    count_modes[f"__a{i}"] = mode
-                    aggs.append((f"__a{i}", "count",
-                                 pc.CountOptions(mode=mode)))
-                    out_names_in_result.append(f"__a{i}_count")
-                elif isinstance(a, ir.First):
-                    aggs.append((f"__a{i}", "first", pc.ScalarAggregateOptions(
-                        skip_nulls=a.ignore_nulls)))
-                    out_names_in_result.append(f"__a{i}_first")
-                elif isinstance(a, ir.Last):
-                    aggs.append((f"__a{i}", "last", pc.ScalarAggregateOptions(
-                        skip_nulls=a.ignore_nulls)))
-                    out_names_in_result.append(f"__a{i}_last")
-                else:
-                    fn = _AGG_MAP[type(a)]
-                    aggs.append((f"__a{i}", fn))
-                    out_names_in_result.append(f"__a{i}_{fn}")
-
-            if key_names:
-                res = proj.group_by(key_names, use_threads=False).aggregate(
-                    aggs)
-            else:
-                # global aggregation (always exactly one output row)
-                cols, names2 = [], []
-                for (col_name, fn, *opt), oname in zip(aggs,
-                                                       out_names_in_result):
-                    c = proj.column(col_name).combine_chunks()
-                    options = opt[0] if opt else None
-                    if fn == "count":
-                        val = pc.count(c, mode=count_modes.get(
-                            col_name, "only_valid"))
-                    elif fn == "first":
-                        cc = c.drop_null() if (options and
-                                               options.skip_nulls) else c
-                        val = cc[0] if len(cc) else pa.scalar(None, c.type)
-                    elif fn == "last":
-                        cc = c.drop_null() if (options and
-                                               options.skip_nulls) else c
-                        val = cc[-1] if len(cc) else pa.scalar(None, c.type)
-                    else:
-                        val = getattr(pc, fn)(c)
-                    cols.append(pa.array([val.as_py()],
-                                         type=getattr(val, "type", None)))
-                    names2.append(oname)
-                res = pa.Table.from_arrays(cols, names=names2)
-
-            # assemble final output: keys then aggs with target dtypes
-            out_arrays = []
-            for i in range(len(self.groupings)):
-                if not key_names:
-                    out_arrays.append(None)
-                    continue
-                kcol = res.column(f"__k{i}")
-                if i in nested_originals:
-                    originals, ktype = nested_originals[i]
-                    ids = kcol.to_pylist()
-                    kcol = pa.chunked_array([pa.array(
-                        [originals[s] for s in ids], type=ktype)])
-                out_arrays.append(kcol)
-            for i, a in enumerate(self.aggregates):
-                col = res.column(out_names_in_result[i])
-                tgt = self._schema.fields[len(self.groupings) + i].dtype
-                col = col.cast(tgt.to_arrow())
-                out_arrays.append(col)
-            arrays = [a for a in out_arrays if a is not None]
-            yield pa.Table.from_arrays(arrays, names=self._schema.names)
+            yield self._agg_one(t)
         return [run()]
+
+    def _agg_one(self, t: pa.Table) -> pa.Table:
+        proj = self._agg_arrays(t)
+        key_names = [f"__k{i}" for i in range(len(self.groupings))]
+
+        # arrow group_by cannot key on nested types; substitute a dense
+        # surrogate id per distinct nested value, map back afterwards
+        # (Spark supports grouping on arrays)
+        nested_originals = {}
+        for i, g in enumerate(self.groupings):
+            if g.dtype is None or not g.dtype.is_nested:
+                continue
+            cname = f"__k{i}"
+            arr = proj.column(cname)
+            py = arr.to_pylist()
+            seen, originals = {}, []
+            sur = np.empty(len(py), dtype=np.int64)
+            for r, v in enumerate(py):
+                k = self._hashable(v)
+                if k not in seen:
+                    seen[k] = len(seen)
+                    originals.append(v)
+                sur[r] = seen[k]
+            proj = proj.set_column(
+                proj.column_names.index(cname), cname, pa.array(sur))
+            nested_originals[i] = (originals, arr.type)
+        aggs = []
+        out_names_in_result = []
+        count_modes = {}
+        for i, a in enumerate(self.aggregates):
+            if isinstance(a, ir.Count):
+                mode = "all" if a.child is None else "only_valid"
+                count_modes[f"__a{i}"] = mode
+                aggs.append((f"__a{i}", "count",
+                             pc.CountOptions(mode=mode)))
+                out_names_in_result.append(f"__a{i}_count")
+            elif isinstance(a, ir.First):
+                aggs.append((f"__a{i}", "first", pc.ScalarAggregateOptions(
+                    skip_nulls=a.ignore_nulls)))
+                out_names_in_result.append(f"__a{i}_first")
+            elif isinstance(a, ir.Last):
+                aggs.append((f"__a{i}", "last", pc.ScalarAggregateOptions(
+                    skip_nulls=a.ignore_nulls)))
+                out_names_in_result.append(f"__a{i}_last")
+            else:
+                fn = _AGG_MAP[type(a)]
+                aggs.append((f"__a{i}", fn))
+                out_names_in_result.append(f"__a{i}_{fn}")
+
+        if key_names:
+            res = proj.group_by(key_names, use_threads=False).aggregate(
+                aggs)
+        else:
+            # global aggregation (always exactly one output row)
+            cols, names2 = [], []
+            for (col_name, fn, *opt), oname in zip(aggs,
+                                                   out_names_in_result):
+                c = proj.column(col_name).combine_chunks()
+                options = opt[0] if opt else None
+                if fn == "count":
+                    val = pc.count(c, mode=count_modes.get(
+                        col_name, "only_valid"))
+                elif fn == "first":
+                    cc = c.drop_null() if (options and
+                                           options.skip_nulls) else c
+                    val = cc[0] if len(cc) else pa.scalar(None, c.type)
+                elif fn == "last":
+                    cc = c.drop_null() if (options and
+                                           options.skip_nulls) else c
+                    val = cc[-1] if len(cc) else pa.scalar(None, c.type)
+                else:
+                    val = getattr(pc, fn)(c)
+                cols.append(pa.array([val.as_py()],
+                                     type=getattr(val, "type", None)))
+                names2.append(oname)
+            res = pa.Table.from_arrays(cols, names=names2)
+
+        # assemble final output: keys then aggs with target dtypes
+        out_arrays = []
+        for i in range(len(self.groupings)):
+            if not key_names:
+                out_arrays.append(None)
+                continue
+            kcol = res.column(f"__k{i}")
+            if i in nested_originals:
+                originals, ktype = nested_originals[i]
+                ids = kcol.to_pylist()
+                kcol = pa.chunked_array([pa.array(
+                    [originals[s] for s in ids], type=ktype)])
+            out_arrays.append(kcol)
+        for i, a in enumerate(self.aggregates):
+            col = res.column(out_names_in_result[i])
+            tgt = self._schema.fields[len(self.groupings) + i].dtype
+            col = col.cast(tgt.to_arrow())
+            out_arrays.append(col)
+        arrays = [a for a in out_arrays if a is not None]
+        return pa.Table.from_arrays(arrays, names=self._schema.names)
 
 
 class CpuExpandExec(PhysicalPlan):

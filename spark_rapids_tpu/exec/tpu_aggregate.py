@@ -453,13 +453,16 @@ class TpuHashAggregateExec(TpuExec):
     def __init__(self, child: PhysicalPlan,
                  groupings: Sequence[ir.Expression],
                  aggregates: Sequence[ir.AggregateExpression],
-                 schema: Schema):
+                 schema: Schema, per_partition: bool = False):
         super().__init__()
         self.children = (child,)
         self.groupings = list(groupings)
         self.aggregates = list(aggregates)
         self.specs = [make_spec(a) for a in self.aggregates]
         self._schema = schema
+        # per_partition: aggregate each child partition independently
+        # (the distributed plan shape over a hash exchange on the keys)
+        self.per_partition = per_partition
         self._update_kernel = None
         self._merge_kernel = None
 
@@ -485,14 +488,14 @@ class TpuHashAggregateExec(TpuExec):
             self._merge_kernel = jax.jit(self._merge_impl)
             self._final_kernel = jax.jit(self._final_impl)
 
-        def run():
+        def run(its):
             from spark_rapids_tpu.mem.spill import register_or_hold
             # buffered partials stay spillable between update and merge
             # (reference: aggregate.scala buffers partial results;
             # SpillableColumnarBatch keeps them evictable)
             partials: List = []
             try:
-                for it in self.children[0].execute():
+                for it in its:
                     for b in it:
                         if int(b.num_rows) == 0 and self.groupings:
                             continue
@@ -518,7 +521,10 @@ class TpuHashAggregateExec(TpuExec):
             finally:
                 for p in partials:
                     p.close()
-        return [run()]
+
+        if self.per_partition:
+            return [run([it]) for it in self.children[0].execute()]
+        return [run(self.children[0].execute())]
 
 
 def _make_empty_buffer_batch(exec_: TpuHashAggregateExec) -> DeviceBatch:

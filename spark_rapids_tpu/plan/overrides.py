@@ -207,7 +207,8 @@ register_exec_rule(cpux.CpuHashAggregateExec, ExecRule(
     "TPU hash aggregate (sort-based segmented reduction)",
     lambda n: list(n.groupings) + list(n.aggregates),
     convert=lambda n, ch, conf: TpuHashAggregateExec(
-        ch[0], n.groupings, n.aggregates, n.schema),
+        ch[0], n.groupings, n.aggregates, n.schema,
+        per_partition=n.per_partition),
     extra_tag=lambda n, conf: _nested_key_reasons(n.groupings, "grouping")))
 
 register_exec_rule(cpux.CpuExpandExec, ExecRule(

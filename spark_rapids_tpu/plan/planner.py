@@ -55,6 +55,23 @@ def plan_cpu(node: lp.LogicalPlan, conf: RapidsTpuConf) -> PhysicalPlan:
             if a.children:
                 a.children = (new_flat[k],)
                 k += 1
+        # distributed plan shape: hash-exchange on the grouping keys, then
+        # a per-partition (complete) aggregate — Spark's partial/final
+        # split restructured so the exchange is a planner-visible node the
+        # ICI data plane can ride (reference: aggregate.scala partial/
+        # final stage pair around GpuShuffleExchangeExec)
+        two_stage = bool(groupings) and (
+            conf.get(cfg.AGG_EXCHANGE)
+            or str(conf.get(cfg.SHUFFLE_TRANSPORT)) == "ici")
+        if two_stage and all(g.dtype is not None and not g.dtype.is_nested
+                             for g in groupings):
+            from spark_rapids_tpu.shuffle import exchange as ex
+            child = ex.CpuShuffleExchangeExec(
+                child, ex.HashPartitioning(conf.shuffle_partitions,
+                                           list(groupings)))
+            return cpux.CpuHashAggregateExec(child, groupings, aggs,
+                                             node.schema,
+                                             per_partition=True)
         return cpux.CpuHashAggregateExec(child, groupings, aggs,
                                          node.schema)
     if isinstance(node, lp.Limit):

@@ -402,7 +402,81 @@ class TpuShuffleExchangeExec(TpuExec):
     # read exercises both the local-catalog and the remote-fetch paths
     _MANAGER_EXECUTORS = 2
 
+    def _execute_ici(self):
+        """ICI data plane: the whole exchange is ONE lax.all_to_all over
+        the device mesh (reference: the UCX peer-to-peer transport,
+        UCX.scala:53-533, restructured as a collective per SURVEY.md §5).
+
+        Rows route to the device owning their target partition
+        (partition p lives on device p % n_dev); reducer p's reader then
+        sub-splits its device's received rows by the carried '__part__'
+        column, staying on that device — so downstream per-partition
+        kernels (join probe, per-partition aggregate) execute distributed
+        across the mesh.
+        """
+        from spark_rapids_tpu.shuffle import ici
+        n_parts = self.partitioning.num_partitions
+        state = {"done": False, "dev": None, "n_dev": 1,
+                 "reads_left": n_parts}
+
+        def materialize():
+            if state["done"]:
+                return
+            batches = []
+            for it in self.children[0].execute():
+                batches.extend(b for b in it if int(b.num_rows))
+            if batches:
+                g = concat_batches(batches)
+                tf = self._target_fn()
+                key = ("ici_target", g.schema_key())
+                if key not in self._kernels:
+                    self._kernels[key] = jax.jit(
+                        lambda b: tf(b, jnp.int32(0)))
+                with timed(self.metrics):
+                    targets = self._kernels[key](g)
+                    dev, mesh = ici.exchange_batch(g, targets,
+                                                   self.min_bucket)
+                state["dev"] = dev
+                state["n_dev"] = mesh.shape["shuffle"]
+                self.metrics.extra["ici_devices"] = state["n_dev"]
+            state["done"] = True
+
+        def reader(pidx: int) -> Iterator[DeviceBatch]:
+            materialize()
+            try:
+                if state["dev"] is None:
+                    return
+                b = state["dev"][pidx % state["n_dev"]]
+                if b is None:
+                    return
+                key = ("ici_extract", b.schema_key())
+                if key not in self._kernels:
+                    def extract(batch, pid):
+                        from spark_rapids_tpu.exec.tpu_basic import compact
+                        part = batch.columns[-1].data
+                        return compact(batch, part == pid)
+                    self._kernels[key] = jax.jit(extract)
+                with timed(self.metrics):
+                    out = self._kernels[key](b, jnp.int32(pidx))
+                if int(out.num_rows) == 0:
+                    return
+                out = DeviceBatch(out.names[:-1], out.columns[:-1],
+                                  out.num_rows)  # drop __part__
+                self.metrics.num_output_rows += int(out.num_rows)
+                self.metrics.num_output_batches += 1
+            finally:
+                # last reducer out drops the device-resident shards so a
+                # multi-stage query doesn't pin every exchange in HBM
+                state["reads_left"] -= 1
+                if state["reads_left"] == 0:
+                    state["dev"] = None
+            yield out
+
+        return [reader(p) for p in range(n_parts)]
+
     def execute(self):
+        if self.transport == "ici":
+            return self._execute_ici()
         n_parts = self.partitioning.num_partitions
         state = {"done": False, "store": None, "dev_slices": None,
                  "mgr": None, "sid": None, "reads_left": n_parts}

@@ -90,7 +90,10 @@ def bucketize(batch: DeviceBatch, target: jnp.ndarray, n_parts: int
         validity = g.validity.reshape((n_parts, cap))
         lengths = g.lengths.reshape((n_parts, cap)) \
             if g.lengths is not None else None
-        out_cols.append(DeviceColumn(c.dtype, data, validity, lengths))
+        ev = g.elem_validity.reshape((n_parts, cap) +
+                                     g.elem_validity.shape[1:]) \
+            if g.elem_validity is not None else None
+        out_cols.append(DeviceColumn(c.dtype, data, validity, lengths, ev))
     return out_cols, counts
 
 
@@ -106,7 +109,8 @@ def exchange(stacked_cols: List[DeviceColumn], counts: jnp.ndarray,
     for c in stacked_cols:
         out_cols.append(DeviceColumn(
             c.dtype, a2a(c.data), a2a(c.validity),
-            a2a(c.lengths) if c.lengths is not None else None))
+            a2a(c.lengths) if c.lengths is not None else None,
+            a2a(c.elem_validity) if c.elem_validity is not None else None))
     return out_cols, a2a(counts)
 
 
@@ -123,7 +127,10 @@ def reassemble(names: Sequence[str], stacked_cols: List[DeviceColumn],
         validity = c.validity.reshape((n_parts * cap,))
         lengths = c.lengths.reshape((n_parts * cap,)) \
             if c.lengths is not None else None
-        flat_cols.append(DeviceColumn(c.dtype, data, validity, lengths))
+        ev = c.elem_validity.reshape((n_parts * cap,) +
+                                     c.elem_validity.shape[2:]) \
+            if c.elem_validity is not None else None
+        flat_cols.append(DeviceColumn(c.dtype, data, validity, lengths, ev))
     # rows arrive block-strided; compact the `valid` rows to the front so
     # the result satisfies the DeviceBatch row_mask contract
     count = jnp.sum(valid.astype(jnp.int32))
@@ -200,7 +207,9 @@ def _col_specs(dtypes, spec):
 def _cols_to_leaves(cols: Sequence[DeviceColumn]):
     leaves = []
     for c in cols:
-        if c.lengths is not None:
+        if c.elem_validity is not None:
+            leaves.append((c.data, c.validity, c.lengths, c.elem_validity))
+        elif c.lengths is not None:
             leaves.append((c.data, c.validity, c.lengths))
         else:
             leaves.append((c.data, c.validity))
@@ -210,7 +219,9 @@ def _cols_to_leaves(cols: Sequence[DeviceColumn]):
 def _leaves_to_cols(leaves, dtypes):
     cols = []
     for leaf, d in zip(leaves, dtypes):
-        if len(leaf) == 3:
+        if len(leaf) == 4:
+            cols.append(DeviceColumn(d, leaf[0], leaf[1], leaf[2], leaf[3]))
+        elif len(leaf) == 3:
             cols.append(DeviceColumn(d, leaf[0], leaf[1], leaf[2]))
         else:
             cols.append(DeviceColumn(d, leaf[0], leaf[1], None))
@@ -236,12 +247,134 @@ def shard_batch(batch: DeviceBatch, mesh: Mesh, axis: str
     sharding = NamedSharding(mesh, P(axis))
     leaves = []
     for c in batch.columns:
-        data = jax.device_put(c.data, sharding)
-        validity = jax.device_put(c.validity, sharding)
+        # leaf arity must match _cols_to_leaves: 4-tuple implies lengths
+        assert c.elem_validity is None or c.lengths is not None
+        leaf = [jax.device_put(c.data, sharding),
+                jax.device_put(c.validity, sharding)]
         if c.lengths is not None:
-            leaves.append((data, validity,
-                           jax.device_put(c.lengths, sharding)))
-        else:
-            leaves.append((data, validity))
+            leaf.append(jax.device_put(c.lengths, sharding))
+        if c.elem_validity is not None:
+            leaf.append(jax.device_put(c.elem_validity, sharding))
+        leaves.append(tuple(leaf))
     counts = jax.device_put(counts, sharding)
     return tuple(leaves), counts
+
+
+# ---------------------------------------------------------------------------
+# Generic partition exchange: the ICI data plane behind
+# TpuShuffleExchangeExec(transport='ici').  Reference analog: the UCX
+# transport implementation behind the shuffle SPI
+# (shuffle-plugin/.../UCX.scala:53-533) — here the entire peer-to-peer
+# client/server machinery collapses into one lax.all_to_all over the mesh.
+# ---------------------------------------------------------------------------
+
+_DEFAULT_MESH: Optional[Mesh] = None
+_STEP_CACHE = {}
+
+
+def get_default_mesh() -> Mesh:
+    """Process-wide 1-D mesh over every visible device (the 'shuffle'
+    axis).  On the 8-virtual-CPU test platform this is an 8-way mesh; on a
+    single real TPU chip it degenerates to 1 device (all_to_all becomes an
+    identity, keeping one code path)."""
+    global _DEFAULT_MESH
+    if _DEFAULT_MESH is None:
+        _DEFAULT_MESH = Mesh(np.array(jax.devices()), ("shuffle",))
+    return _DEFAULT_MESH
+
+
+def with_capacity(batch: DeviceBatch, cap: int) -> DeviceBatch:
+    """Re-capacity a front-compacted batch (grow or shrink padding)."""
+    if batch.capacity == cap:
+        return batch
+    assert int(batch.num_rows) <= cap
+    from spark_rapids_tpu.shuffle.exchange import slice_span
+    return slice_span(batch, jnp.int32(0),
+                      jnp.asarray(batch.num_rows, jnp.int32), cap)
+
+
+def make_exchange_step(mesh: Mesh, axis: str, names, dtypes, aux_key):
+    """Jitted shard_map step routing rows to the device owning their
+    target partition.  The batch's LAST column is the int32 target
+    partition id; device d owns partitions {p : p % n_dev == d}.
+
+    Returns out leaves of per-device capacity n_dev*local_cap (worst case:
+    every row lands on one device) plus per-device received row counts.
+    """
+    key = (mesh, axis, tuple(names), aux_key)
+    if key in _STEP_CACHE:
+        return _STEP_CACHE[key]
+    n_dev = mesh.shape[axis]
+
+    def local_step(leaves, local_rows):
+        cols = _leaves_to_cols(leaves, dtypes)
+        batch = DeviceBatch(names, cols, local_rows[0])
+        part = batch.columns[-1].data.astype(jnp.int32)
+        owner = part % np.int32(n_dev)
+        stacked, counts = bucketize(batch, owner, n_dev)
+        stacked, counts_recv = exchange(stacked, counts, axis)
+        received = reassemble(names, stacked, counts_recv)
+        return _cols_to_leaves(received.columns), jnp.reshape(
+            jnp.asarray(received.num_rows, dtype=jnp.int32), (1,))
+
+    step = jax.jit(jax.shard_map(
+        local_step, mesh=mesh, in_specs=(P(axis), P(axis)),
+        out_specs=(P(axis), P(axis)), check_vma=False))
+    _STEP_CACHE[key] = step
+    return step
+
+
+def split_shards(arr: jnp.ndarray, n_dev: int) -> List[jnp.ndarray]:
+    """Per-device local views of a leading-axis-sharded global array,
+    without any collective (each view stays committed to its device)."""
+    per = arr.shape[0] // n_dev
+    shards = {s.index[0].start or 0: s.data for s in arr.addressable_shards}
+    if len(shards) == n_dev and all(d * per in shards
+                                    for d in range(n_dev)):
+        return [shards[d * per] for d in range(n_dev)]
+    return [arr[d * per:(d + 1) * per] for d in range(n_dev)]
+
+
+def exchange_batch(batch: DeviceBatch, targets: jnp.ndarray,
+                   min_bucket: int = 16
+                   ) -> Tuple[List[Optional[DeviceBatch]], Mesh]:
+    """Run the full ICI exchange for one global batch.
+
+    ``targets`` is a per-slot int32 target-partition vector (padding slots
+    ignored).  Returns one local DeviceBatch per mesh device — each batch
+    carries a trailing '__part__' column so the reader can sub-split the
+    device's rows into its owned partitions — plus the mesh used.
+    """
+    from spark_rapids_tpu.columnar.batch import bucket_rows
+
+    mesh = get_default_mesh()
+    n_dev = mesh.shape["shuffle"]
+    total = int(batch.num_rows)
+    part_col = DeviceColumn(dt.INT32, targets.astype(jnp.int32),
+                            batch.row_mask(), None)
+    aug = DeviceBatch(list(batch.names) + ["__part__"],
+                      list(batch.columns) + [part_col], total)
+    local_cap = bucket_rows((total + n_dev - 1) // n_dev, min_bucket)
+    aug = with_capacity(aug, local_cap * n_dev)
+    leaves, counts = shard_batch(aug, mesh, "shuffle")
+    aux_key = tuple((c.dtype.name, c.data.shape[1:],
+                     c.lengths is not None, c.elem_validity is not None)
+                    for c in aug.columns) + (local_cap,)
+    step = make_exchange_step(mesh, "shuffle", aug.names, aug.dtypes,
+                              aux_key)
+    out_leaves, out_rows = step(leaves, counts)
+    rows = np.asarray(out_rows)
+    dev_batches: List[Optional[DeviceBatch]] = []
+    for d in range(n_dev):
+        if int(rows[d]) == 0:
+            dev_batches.append(None)
+            continue
+        cols = []
+        for leaf, c in zip(out_leaves, aug.columns):
+            parts = [split_shards(a, n_dev)[d] for a in leaf]
+            lengths = parts[2] if c.lengths is not None else None
+            ev = parts[-1] if c.elem_validity is not None else None
+            cols.append(DeviceColumn(c.dtype, parts[0], parts[1],
+                                     lengths, ev))
+        dev_batches.append(DeviceBatch(aug.names, cols, int(rows[d])))
+    return dev_batches, mesh

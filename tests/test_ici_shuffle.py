@@ -145,3 +145,164 @@ def test_distributed_string_keys():
     want = pa.Table.from_pandas(pd, preserve_index=False)
     assert got.num_rows == want.num_rows
     assert _sorted_pylist(got, ["k"]) == _sorted_pylist(want, ["k"])
+
+
+# ---------------------------------------------------------------------------
+# Planner-driven distributed execution: queries built through the public
+# DataFrame API run end-to-end over the ICI data plane (transport='ici'),
+# with TpuShuffleExchangeExec routing rows through one lax.all_to_all over
+# the 8-virtual-device mesh.  The reference analog is a query running
+# through RapidsShuffleManager's UCX plane
+# (RapidsShuffleInternalManager.scala:90-186) instead of Spark's sort
+# shuffle.
+# ---------------------------------------------------------------------------
+
+from spark_rapids_tpu import TpuSparkSession
+import spark_rapids_tpu.api.functions as F
+from tests.parity import assert_tables_equal
+
+_ICI_CONF = {
+    "spark.rapids.tpu.shuffle.transport": "ici",
+    "spark.rapids.tpu.sql.variableFloatAgg.enabled": True,
+}
+
+
+def _cpu_collect(fn):
+    s = TpuSparkSession({"spark.rapids.tpu.sql.enabled": False})
+    return fn(s)
+
+
+def _ici_collect(fn, extra_conf=None):
+    conf = dict(_ICI_CONF)
+    conf.update(extra_conf or {})
+    s = TpuSparkSession(conf)
+    captured = []
+    s.add_plan_listener(captured.append)
+    out = fn(s)
+    return out, captured
+
+
+def _assert_has_ici_exchange(captured):
+    from spark_rapids_tpu.shuffle.exchange import TpuShuffleExchangeExec
+    found = []
+    captured[-1].plan.foreach(
+        lambda n: found.append(n) if isinstance(n, TpuShuffleExchangeExec)
+        else None)
+    assert found, "no TpuShuffleExchangeExec in plan"
+    assert all(x.transport == "ici" for x in found)
+
+
+def _agg_query(n_parts):
+    rng = np.random.default_rng(7)
+    n = 700
+    tbl = pa.table({
+        "k": pa.array(rng.integers(0, 31, n), type=pa.int32()),
+        "v": pa.array(rng.integers(-50, 50, n), type=pa.int64()),
+        "s": pa.array([f"w{i % 5}" for i in range(n)]),
+    })
+
+    def q(s):
+        df = s.create_dataframe(tbl, num_partitions=n_parts)
+        return df.group_by("k").agg(
+            F.sum("v").alias("sv"), F.count("*").alias("c"),
+            F.min("s").alias("ms")).collect()
+    return q
+
+
+def test_planned_distributed_groupby_parity():
+    q = _agg_query(4)
+    cpu = _cpu_collect(q)
+    tpu, captured = _ici_collect(q)
+    _assert_has_ici_exchange(captured)
+    assert_tables_equal(cpu, tpu, ignore_order=True)
+
+
+def test_planned_distributed_join_parity():
+    rng = np.random.default_rng(8)
+    n = 600
+    left = pa.table({
+        "k": pa.array(rng.integers(0, 40, n), type=pa.int64()),
+        "x": pa.array(rng.normal(size=n)),
+    })
+    right = pa.table({
+        "k": pa.array(np.arange(0, 50, dtype=np.int64)),
+        "tag": pa.array([f"t{i}" for i in range(50)]),
+    })
+
+    def q(s):
+        # force a shuffled (non-broadcast) join so both sides exchange
+        s.set_conf("spark.rapids.tpu.sql.autoBroadcastJoinThreshold", -1)
+        a = s.create_dataframe(left, num_partitions=3)
+        b = s.create_dataframe(right, num_partitions=2)
+        return a.join(b, on="k", how="inner").collect()
+
+    cpu = _cpu_collect(q)
+    tpu, captured = _ici_collect(
+        q, {"spark.rapids.tpu.sql.autoBroadcastJoinThreshold": -1})
+    _assert_has_ici_exchange(captured)
+    assert_tables_equal(cpu, tpu, ignore_order=True)
+
+
+@pytest.mark.parametrize("how", ["left", "full", "leftsemi", "leftanti"])
+def test_planned_distributed_join_types(how):
+    rng = np.random.default_rng(9)
+    left = pa.table({
+        "k": pa.array(rng.integers(0, 25, 300), type=pa.int32()),
+        "x": pa.array(rng.integers(0, 9, 300), type=pa.int64()),
+    })
+    right = pa.table({
+        "k": pa.array(rng.integers(10, 35, 200), type=pa.int32()),
+        "y": pa.array(rng.integers(0, 9, 200), type=pa.int64()),
+    })
+
+    def q(s):
+        s.set_conf("spark.rapids.tpu.sql.autoBroadcastJoinThreshold", -1)
+        a = s.create_dataframe(left, num_partitions=3)
+        b = s.create_dataframe(right, num_partitions=3)
+        return a.join(b, on="k", how=how).collect()
+
+    cpu = _cpu_collect(q)
+    tpu, _ = _ici_collect(
+        q, {"spark.rapids.tpu.sql.autoBroadcastJoinThreshold": -1})
+    assert_tables_equal(cpu, tpu, ignore_order=True)
+
+
+def test_planned_repartition_roundtrip():
+    tbl = pa.table({
+        "a": pa.array(np.arange(123, dtype=np.int64)),
+        "s": pa.array([f"row-{i}" if i % 7 else None for i in range(123)]),
+    })
+
+    def q(s):
+        df = s.create_dataframe(tbl, num_partitions=2)
+        return df.repartition(5, "a").collect()
+
+    cpu = _cpu_collect(q)
+    tpu, captured = _ici_collect(q)
+    _assert_has_ici_exchange(captured)
+    assert_tables_equal(cpu, tpu, ignore_order=True)
+
+
+def test_planned_distributed_agg_then_join():
+    """Composite: distributed agg feeding a distributed join."""
+    rng = np.random.default_rng(11)
+    facts = pa.table({
+        "k": pa.array(rng.integers(0, 20, 400), type=pa.int64()),
+        "v": pa.array(rng.integers(0, 100, 400), type=pa.int64()),
+    })
+    dims = pa.table({
+        "k": pa.array(np.arange(20, dtype=np.int64)),
+        "w": pa.array(np.arange(20, dtype=np.int64) * 10),
+    })
+
+    def q(s):
+        s.set_conf("spark.rapids.tpu.sql.autoBroadcastJoinThreshold", -1)
+        f = s.create_dataframe(facts, num_partitions=4)
+        d = s.create_dataframe(dims, num_partitions=2)
+        g = f.group_by("k").agg(F.sum("v").alias("sv"))
+        return g.join(d, on="k", how="inner").collect()
+
+    cpu = _cpu_collect(q)
+    tpu, _ = _ici_collect(
+        q, {"spark.rapids.tpu.sql.autoBroadcastJoinThreshold": -1})
+    assert_tables_equal(cpu, tpu, ignore_order=True)
