@@ -1,28 +1,124 @@
-"""Benchmark: TPC-DS q6-class pipeline (filter -> hash aggregate).
+"""Benchmark: TPC-DS q6-class pipeline END-TO-END over parquet files.
+
+This measures BASELINE.json staged config #1 — "TPC-DS q6 @ SF1 parquet
+(scan+filter+hash-agg), single local executor": parquet scan -> decode ->
+filter -> group-by aggregate -> collect, wall-clock, through the full
+planner/session stack on both engines.
 
 Prints ONE JSON line:
-  {"metric": ..., "value": N, "unit": ..., "vs_baseline": N}
+  {"metric": ..., "value": N, "unit": ..., "vs_baseline": N, ...}
 
-value        = TPU steady-state throughput (million rows/s) of the fused
-               filter+group-by-aggregate kernel over HBM-resident data
-vs_baseline  = speedup over the engine's own CPU (pyarrow) execution of the
-               same query — the "stock Spark CPU" role in the reference's
-               GPU-vs-CPU framing (reference: docs/FAQ.md 3-7x typical).
+value        = end-to-end scan throughput in GB/s (parquet bytes read /
+               wall-clock) on the TPU engine (device parquet decode)
+vs_baseline  = TPU wall-clock speedup over the engine's own CPU
+               (pyarrow) execution of the same end-to-end query — the
+               "stock Spark CPU" role in the reference's GPU-vs-CPU
+               framing (reference: docs/FAQ.md 3-7x typical).
+kernel_mrows_per_s = secondary metric: the fused filter+agg kernel over
+               HBM-resident data (the round-1 headline number).
 """
 
 import json
+import os
 import sys
+import tempfile
 import time
 
 import numpy as np
 import pyarrow as pa
+import pyarrow.parquet as papq
 
 
-def main() -> None:
-    import spark_rapids_tpu  # noqa: F401 (x64)
+def _gen_store_sales(n: int, seed: int = 42) -> pa.Table:
+    """q6-class fact slice: sold date fk, item fk, price, qty."""
+    rng = np.random.default_rng(seed)
+    return pa.table({
+        "ss_sold_date_sk": pa.array(
+            rng.integers(1, 1827, n).astype(np.int64)),
+        "ss_item_sk": pa.array(
+            rng.integers(1, 18001, n).astype(np.int64)),
+        "ss_quantity": pa.array(rng.integers(1, 101, n).astype(np.int32)),
+        "ss_list_price": np.round(rng.uniform(1.0, 200.0, n), 2),
+        "ss_sales_price": np.round(rng.uniform(0.2, 200.0, n), 2),
+        "ss_ext_sales_price": np.round(rng.uniform(1.0, 20000.0, n), 2),
+    })
+
+
+def _write_dataset(root: str, n: int, files: int) -> int:
+    per = n // files
+    total = 0
+    for i in range(files):
+        path = os.path.join(root, f"part-{i:04d}.parquet")
+        papq.write_table(_gen_store_sales(per, seed=100 + i), path)
+        total += os.path.getsize(path)
+    return total
+
+
+def _query(session, path):
+    from spark_rapids_tpu import col, functions as F
+    return (session.read.parquet(path)
+            .filter(col("ss_sales_price") > 150.0)
+            .group_by("ss_item_sk")
+            .agg(F.count("*").alias("cnt"),
+                 F.sum("ss_quantity").alias("qty"),
+                 F.avg("ss_ext_sales_price").alias("aesp")))
+
+
+def _time_engine(conf: dict, path: str, iters: int) -> float:
+    from spark_rapids_tpu import TpuSparkSession
+    s = TpuSparkSession(conf)
+    _query(s, path).collect()  # warm (compile caches, file listings)
+    times = []
+    for _ in range(iters):
+        t0 = time.perf_counter()
+        _query(s, path).collect()
+        times.append(time.perf_counter() - t0)
+    return min(times)  # min on BOTH legs: same noise filter as the TPU
+
+
+def _time_tpu_subprocess(path: str, iters: int) -> float:
+    """Each TPU iteration runs one query in a FRESH process.
+
+    Under a remote/tunneled device runtime, the first device->host
+    read-back degrades every later dispatch in the process to a
+    synchronous round trip; a per-query process (with the persistent
+    XLA compile cache carrying the compiled kernels) measures what a
+    per-query executor on local TPU hardware would see.  One warm run
+    populates the compile cache first.
+    """
+    import subprocess
+
+    code = (
+        "import sys, time, json\n"
+        f"sys.path.insert(0, {os.path.dirname(os.path.abspath(__file__))!r})\n"
+        "import bench\n"
+        "from spark_rapids_tpu import TpuSparkSession\n"
+        "s = TpuSparkSession({'spark.rapids.tpu.sql.variableFloatAgg."
+        "enabled': True})\n"
+        f"t0 = time.perf_counter()\n"
+        f"out = bench._query(s, {path!r}).collect()\n"
+        "print(json.dumps({'wall': time.perf_counter() - t0,"
+        " 'rows': out.num_rows}))\n"
+    )
+
+    def run_once() -> float:
+        proc = subprocess.run([sys.executable, "-c", code],
+                              capture_output=True, text=True,
+                              timeout=1800)
+        if proc.returncode != 0:
+            raise RuntimeError(f"tpu bench subprocess failed:\n"
+                               f"{proc.stderr[-2000:]}")
+        return float(json.loads(proc.stdout.strip().splitlines()[-1])
+                     ["wall"])
+
+    run_once()  # warm: populates the persistent compile cache
+    return min(run_once() for _ in range(iters))
+
+
+def _kernel_metric(n: int = 1 << 21) -> float:
+    """Secondary: fused filter+agg kernel over HBM-resident data."""
     import jax
     import jax.numpy as jnp
-    from spark_rapids_tpu import TpuSparkSession, col, functions as F
     from spark_rapids_tpu.columnar.batch import from_arrow
     from spark_rapids_tpu.exec.tpu_aggregate import (
         finalize_aggregate, make_spec, update_aggregate)
@@ -30,35 +126,12 @@ def main() -> None:
     from spark_rapids_tpu.expr import eval_tpu, ir
     from spark_rapids_tpu.plan.logical import Schema
 
-    n = int(sys.argv[1]) if len(sys.argv) > 1 else 1 << 21  # 2M rows
-    rng = np.random.default_rng(42)
+    rng = np.random.default_rng(7)
     table = pa.table({
         "k": pa.array(rng.integers(0, 1000, n), type=pa.int32()),
         "price": pa.array(rng.uniform(0, 300, n)),
         "qty": pa.array(rng.integers(1, 100, n), type=pa.int64()),
     })
-
-    # ---- CPU baseline: same query through the CPU engine ------------------
-    cpu = TpuSparkSession({"spark.rapids.tpu.sql.enabled": False,
-                           "spark.rapids.tpu.sql.variableFloatAgg.enabled":
-                           True})
-
-    def query(s):
-        return (s.create_dataframe(table)
-                .filter(col("price") > 150.0)
-                .group_by("k")
-                .agg(F.count("*").alias("cnt"),
-                     F.sum("qty").alias("qty_sum"),
-                     F.avg("price").alias("price_avg")))
-
-    query(cpu).collect()  # warm
-    t0 = time.perf_counter()
-    cpu_iters = 3
-    for _ in range(cpu_iters):
-        query(cpu).collect()
-    cpu_time = (time.perf_counter() - t0) / cpu_iters
-
-    # ---- TPU kernel: fused filter + update-agg + finalize -----------------
     schema = Schema.from_arrow(table.schema)
 
     def b(e):
@@ -84,21 +157,45 @@ def main() -> None:
     batch = from_arrow(table)
     fn = jax.jit(step)
     out = fn(batch)
-    jax.block_until_ready(jax.tree_util.tree_leaves(out))  # compile+warm
+    jax.block_until_ready(jax.tree_util.tree_leaves(out))
     iters = 5
     t0 = time.perf_counter()
     for _ in range(iters):
         out = fn(batch)
     jax.block_until_ready(jax.tree_util.tree_leaves(out))
     tpu_time = (time.perf_counter() - t0) / iters
+    return (n / tpu_time) / 1e6
 
-    mrows_per_s = (n / tpu_time) / 1e6
+
+def main() -> None:
+    import spark_rapids_tpu  # noqa: F401 (x64)
+
+    n = int(sys.argv[1]) if len(sys.argv) > 1 else 2_880_000  # ~SF1 slice
+    files = 8
+    iters = 2
+    # kernel metric first: it performs no device->host read-back, so it
+    # runs before anything can degrade a tunneled runtime's dispatch path
+    kernel = _kernel_metric()
+    with tempfile.TemporaryDirectory(prefix="tpcds_q6_") as root:
+        nbytes = _write_dataset(root, n, files)
+
+        cpu_time = _time_engine(
+            {"spark.rapids.tpu.sql.enabled": False,
+             "spark.rapids.tpu.sql.variableFloatAgg.enabled": True},
+            root, iters)
+        tpu_time = _time_tpu_subprocess(root, iters)
+
+    gbps = nbytes / tpu_time / 1e9
     print(json.dumps({
-        "metric": "q6-class filter+hash-agg throughput (2M rows, "
-                  "1000 groups)",
-        "value": round(mrows_per_s, 3),
-        "unit": "Mrows/s",
+        "metric": "TPC-DS q6-class end-to-end over parquet "
+                  f"({n} rows, {files} files, {nbytes >> 20} MiB): "
+                  "scan+decode+filter+hash-agg+collect",
+        "value": round(gbps, 3),
+        "unit": "GB/s",
         "vs_baseline": round(cpu_time / tpu_time, 3),
+        "tpu_wall_s": round(tpu_time, 4),
+        "cpu_wall_s": round(cpu_time, 4),
+        "kernel_mrows_per_s": round(kernel, 1),
     }))
 
 

@@ -9,10 +9,43 @@ device->host->disk spill framework.  See SURVEY.md at the repo root for the
 full blueprint and reference mapping.
 """
 
+import os as _os
+
 import jax as _jax
 
 # SQL engines need exact int64/float64; enable before anything traces.
 _jax.config.update("jax_enable_x64", True)
+
+def _enable_compile_cache() -> None:
+    """Persistent XLA compilation cache for ACCELERATOR backends.
+
+    The engine plans fresh exec trees per query and fresh processes per
+    benchmark run; re-loading compiled executables beats recompiling
+    (especially with remote/tunneled compilation).  CPU is deliberately
+    excluded: under a remote-compilation service, XLA:CPU AOT results
+    target the *server's* CPU features and can SIGILL on the local host.
+    Opt out with SPARK_RAPIDS_TPU_NO_COMPILE_CACHE=1.
+
+    Called lazily (session init) once the backend platform is known.
+    """
+    if _os.environ.get("SPARK_RAPIDS_TPU_NO_COMPILE_CACHE"):
+        return
+    try:
+        platform = _jax.default_backend()
+        if platform == "cpu":
+            return
+        cache_dir = _os.environ.get(
+            "SPARK_RAPIDS_TPU_COMPILE_CACHE",
+            _os.path.expanduser("~/.cache/spark_rapids_tpu/xla-"
+                                + platform))
+        _os.makedirs(cache_dir, exist_ok=True)
+        _jax.config.update("jax_compilation_cache_dir", cache_dir)
+        _jax.config.update("jax_persistent_cache_min_compile_time_secs",
+                           0.0)
+        _jax.config.update("jax_persistent_cache_min_entry_size_bytes",
+                           0)
+    except Exception:  # cache is an optimization, never a hard failure
+        pass
 
 from spark_rapids_tpu.api.session import TpuSparkSession  # noqa: E402,F401
 from spark_rapids_tpu.api.column import Column, col, lit  # noqa: E402,F401

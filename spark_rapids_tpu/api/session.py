@@ -32,6 +32,8 @@ class TpuSparkSession:
     def __init__(self, conf: Optional[Dict[str, Any]] = None):
         self.conf = RapidsTpuConf(conf)
         devmgr.initialize(self.conf.get(cfg.CONCURRENT_TPU_TASKS))
+        import spark_rapids_tpu as _pkg
+        _pkg._enable_compile_cache()  # accelerator backends only
         from spark_rapids_tpu.mem import spill
         if self.conf.get(cfg.MEM_SPILL_ENABLED):
             spill.init_catalog(
@@ -132,13 +134,45 @@ class TpuSparkSession:
             listener(result)
         return result
 
+    def _drain_partitions(self, its) -> List:
+        """Drain partition iterators, one task per partition on a thread
+        pool sized by ``concurrentTpuTasks`` (the Spark task model:
+        executor task slots gated by GpuSemaphore, reference:
+        GpuSemaphore.scala:101-135).  Output preserves partition order.
+        """
+        n_tasks = int(self.conf.get(cfg.CONCURRENT_TPU_TASKS))
+        if len(its) <= 1 or n_tasks <= 1:
+            out: List = []
+            for it in its:
+                out.extend(it)
+            return out
+        from concurrent.futures import ThreadPoolExecutor
+        with ThreadPoolExecutor(
+                max_workers=min(n_tasks, len(its)),
+                thread_name_prefix="tpu-task") as pool:
+            parts = list(pool.map(list, its))
+        return [x for p in parts for x in p]
+
     def _execute(self, plan: lp.LogicalPlan) -> pa.Table:
         from spark_rapids_tpu.exec.context import set_input_file
         set_input_file("")  # fresh query: no stale input_file_name()
         result = self._plan_physical(plan)
-        tables: List[pa.Table] = []
-        for it in result.plan.execute():
-            tables.extend(it)
+        p = result.plan
+        from spark_rapids_tpu.exec.tpu_basic import DeviceToHostExec
+        if isinstance(p, DeviceToHostExec):
+            # defer ALL device->host downloads behind one completion
+            # barrier: the async pipeline runs dispatch-only end to end
+            # (a mid-stream read-back would serialize it — and on
+            # remote-device runtimes permanently degrade dispatch)
+            import jax
+            from spark_rapids_tpu.columnar.batch import to_arrow
+            batches = self._drain_partitions(p.children[0].execute())
+            leaves = [a for b in batches for c in b.columns
+                      for a in (c.data, c.validity)]
+            jax.block_until_ready(leaves)
+            tables = [to_arrow(b) for b in batches]
+            return concat_tables(tables, p.schema)
+        tables = self._drain_partitions(p.execute())
         return concat_tables(tables, result.plan.schema)
 
     def _execute_device(self, plan: lp.LogicalPlan):
@@ -151,10 +185,7 @@ class TpuSparkSession:
             p = p.children[0]  # strip the terminal download
         else:
             p = HostToDeviceExec(p, self.conf.get(cfg.MIN_BUCKET_ROWS))
-        batches = []
-        for it in p.execute():
-            batches.extend(it)
-        return batches
+        return self._drain_partitions(p.execute())
 
     # plan-capture hook for tests (ExecutionPlanCaptureCallback analog,
     # reference: Plugin.scala:214-303)

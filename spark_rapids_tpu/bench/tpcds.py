@@ -28,6 +28,9 @@ TPCDS_TABLES = [
     "date_dim", "time_dim", "item", "store", "customer",
     "customer_address", "customer_demographics",
     "household_demographics", "promotion", "store_sales",
+    "store_returns", "catalog_sales", "catalog_returns", "web_sales",
+    "web_returns", "inventory", "warehouse", "ship_mode", "reason",
+    "call_center", "catalog_page", "web_site", "web_page", "income_band",
 ]
 
 _CATEGORIES = ["Books", "Electronics", "Home", "Jewelry", "Music",
@@ -35,6 +38,11 @@ _CATEGORIES = ["Books", "Electronics", "Home", "Jewelry", "Music",
 _CLASSES = ["class01", "class02", "class03", "class04", "class05"]
 _CITIES = ["Midway", "Fairview", "Oakland", "Riverside", "Centerville",
            "Pleasant Hill", "Bunker Hill", "Five Points"]
+_COUNTIES = ["Williamson County", "Ziebach County", "Walker County",
+             "Daviess County", "Barrow County", "Luce County",
+             "Richland County", "Bronx County"]
+_COUNTRIES = ["United States", "Canada", "Mexico", "Germany", "Japan",
+              "Brazil", "India", "France"]
 _STATES = ["CA", "TX", "NY", "WA", "GA", "OH", "IL", "TN"]
 _BUY_POTENTIAL = [">10000", "5001-10000", "1001-5000", "501-1000",
                   "0-500", "Unknown"]
@@ -51,6 +59,9 @@ def generate(sf: float = 0.001, seed: int = 0) -> Dict[str, pa.Table]:
     start = _dt.date(1998, 1, 1)
     n_days = (_dt.date(2002, 12, 31) - start).days + 1
     days = [start + _dt.timedelta(days=i) for i in range(n_days)]
+    epoch_week = (start - _dt.date(1995, 1, 2)).days // 7
+    day_names = ["Monday", "Tuesday", "Wednesday", "Thursday", "Friday",
+                 "Saturday", "Sunday"]
     t["date_dim"] = pa.table({
         "d_date_sk": pa.array(np.arange(1, n_days + 1, dtype=np.int64)),
         "d_date": pa.array(days, type=pa.date32()),
@@ -64,13 +75,28 @@ def generate(sf: float = 0.001, seed: int = 0) -> Dict[str, pa.Table]:
                                    dtype=np.int32)),
         "d_qoy": pa.array(np.array([(d.month - 1) // 3 + 1 for d in days],
                                    dtype=np.int32)),
+        "d_week_seq": pa.array(np.array(
+            [epoch_week + (d - start).days // 7 for d in days],
+            dtype=np.int32)),
+        "d_month_seq": pa.array(np.array(
+            [(d.year - 1990) * 12 + d.month - 1 for d in days],
+            dtype=np.int32)),
+        "d_day_name": [day_names[d.weekday()] for d in days],
+        "d_quarter_name": [f"{d.year}Q{(d.month - 1) // 3 + 1}"
+                           for d in days],
     })
 
+    meal = np.full(86400, "", dtype=object)
+    hours = np.arange(86400) // 3600
+    meal[(hours >= 6) & (hours < 9)] = "breakfast"
+    meal[(hours >= 17) & (hours < 21)] = "dinner"
     t["time_dim"] = pa.table({
         "t_time_sk": pa.array(np.arange(1, 86401, dtype=np.int64)),
-        "t_hour": pa.array((np.arange(86400) // 3600).astype(np.int32)),
+        "t_time": pa.array(np.arange(86400).astype(np.int32)),
+        "t_hour": pa.array(hours.astype(np.int32)),
         "t_minute": pa.array(((np.arange(86400) % 3600) // 60)
                              .astype(np.int32)),
+        "t_meal_time": meal.tolist(),
     })
 
     ni = max(100, int(18_000 * sf * 10))
@@ -89,11 +115,21 @@ def generate(sf: float = 0.001, seed: int = 0) -> Dict[str, pa.Table]:
             rng.integers(1, len(_CLASSES) + 1, ni).astype(np.int32)),
         "i_class": rng.choice(_CLASSES, ni).tolist(),
         "i_manufact_id": pa.array(manu),
+        "i_manufact": [f"manufact#{m}" for m in manu],
         # 1..30 (spec uses 1..100) so point filters like q55's
         # i_manager_id = 28 select rows even at tiny scale factors
         "i_manager_id": pa.array(
             rng.integers(1, 31, ni).astype(np.int32)),
         "i_current_price": np.round(rng.uniform(0.1, 100.0, ni), 2),
+        "i_wholesale_cost": np.round(rng.uniform(0.1, 80.0, ni), 2),
+        "i_size": rng.choice(["small", "medium", "large", "extra large",
+                              "economy", "N/A", "petite"], ni).tolist(),
+        "i_color": rng.choice(["red", "blue", "green", "white", "black",
+                               "ivory", "almond", "navy", "plum",
+                               "indian", "khaki"], ni).tolist(),
+        "i_units": rng.choice(["Each", "Dozen", "Case", "Pound", "Ton",
+                               "Oz", "Pallet"], ni).tolist(),
+        "i_product_name": [f"product{i}" for i in range(1, ni + 1)],
     })
 
     ns = max(6, int(12 * sf * 100))
@@ -102,10 +138,16 @@ def generate(sf: float = 0.001, seed: int = 0) -> Dict[str, pa.Table]:
         "s_store_id": [f"STORE{i:06d}" for i in range(1, ns + 1)],
         "s_store_name": [f"store-{i}" for i in range(1, ns + 1)],
         "s_city": rng.choice(_CITIES, ns).tolist(),
+        "s_county": rng.choice(_COUNTIES, ns).tolist(),
         "s_state": rng.choice(_STATES, ns).tolist(),
         "s_zip": [f"{z:05d}" for z in rng.integers(10000, 99999, ns)],
         "s_number_employees": pa.array(
             rng.integers(200, 301, ns).astype(np.int32)),
+        "s_floor_space": pa.array(
+            rng.integers(5_000_000, 10_000_000, ns).astype(np.int32)),
+        "s_gmt_offset": rng.choice([-5.0, -6.0, -7.0, -8.0], ns),
+        "s_market_id": pa.array(rng.integers(1, 11, ns).astype(np.int32)),
+        "s_company_name": ["Unknown"] * ns,
     })
 
     ncd = 1000
@@ -115,11 +157,32 @@ def generate(sf: float = 0.001, seed: int = 0) -> Dict[str, pa.Table]:
         "cd_marital_status": rng.choice(
             ["M", "S", "D", "W", "U"], ncd).tolist(),
         "cd_education_status": rng.choice(_EDUCATION, ncd).tolist(),
+        "cd_purchase_estimate": pa.array(
+            (rng.integers(1, 21, ncd) * 500).astype(np.int32)),
+        "cd_credit_rating": rng.choice(
+            ["Good", "Low Risk", "High Risk", "Unknown"], ncd).tolist(),
+        "cd_dep_count": pa.array(rng.integers(0, 7, ncd).astype(np.int32)),
+        "cd_dep_employed_count": pa.array(
+            rng.integers(0, 7, ncd).astype(np.int32)),
+        "cd_dep_college_count": pa.array(
+            rng.integers(0, 7, ncd).astype(np.int32)),
+    })
+
+    nib = 20
+    t["income_band"] = pa.table({
+        "ib_income_band_sk": pa.array(np.arange(1, nib + 1,
+                                                dtype=np.int64)),
+        "ib_lower_bound": pa.array(
+            (np.arange(nib) * 10000).astype(np.int32)),
+        "ib_upper_bound": pa.array(
+            ((np.arange(nib) + 1) * 10000).astype(np.int32)),
     })
 
     nhd = 720
     t["household_demographics"] = pa.table({
         "hd_demo_sk": pa.array(np.arange(1, nhd + 1, dtype=np.int64)),
+        "hd_income_band_sk": pa.array(
+            rng.integers(1, nib + 1, nhd).astype(np.int64)),
         "hd_dep_count": pa.array(
             rng.integers(0, 10, nhd).astype(np.int32)),
         "hd_vehicle_count": pa.array(
@@ -131,9 +194,13 @@ def generate(sf: float = 0.001, seed: int = 0) -> Dict[str, pa.Table]:
     t["customer_address"] = pa.table({
         "ca_address_sk": pa.array(np.arange(1, nca + 1, dtype=np.int64)),
         "ca_city": rng.choice(_CITIES, nca).tolist(),
+        "ca_county": rng.choice(_COUNTIES, nca).tolist(),
         "ca_state": rng.choice(_STATES, nca).tolist(),
         "ca_zip": [f"{z:05d}" for z in rng.integers(10000, 99999, nca)],
         "ca_country": ["United States"] * nca,
+        "ca_gmt_offset": rng.choice([-5.0, -6.0, -7.0, -8.0], nca),
+        "ca_location_type": rng.choice(
+            ["condo", "apartment", "single family"], nca).tolist(),
     })
 
     nc = max(100, int(100_000 * sf * 10))
@@ -148,15 +215,36 @@ def generate(sf: float = 0.001, seed: int = 0) -> Dict[str, pa.Table]:
             rng.integers(1, nhd + 1, nc).astype(np.int64)),
         "c_first_name": [f"First{i % 977}" for i in range(nc)],
         "c_last_name": [f"Last{i % 653}" for i in range(nc)],
+        "c_preferred_cust_flag": rng.choice(["Y", "N"], nc).tolist(),
+        "c_birth_year": pa.array(
+            rng.integers(1924, 1993, nc).astype(np.int32)),
+        "c_birth_month": pa.array(
+            rng.integers(1, 13, nc).astype(np.int32)),
+        "c_birth_day": pa.array(
+            rng.integers(1, 29, nc).astype(np.int32)),
+        "c_birth_country": rng.choice(_COUNTRIES, nc).tolist(),
+        "c_salutation": rng.choice(
+            ["Mr.", "Mrs.", "Ms.", "Dr.", "Sir"], nc).tolist(),
+        "c_email_address": [f"c{i}@example.com" for i in range(nc)],
+        "c_first_sales_date_sk": pa.array(
+            rng.integers(1, n_days + 1, nc).astype(np.int64)),
+        "c_first_shipto_date_sk": pa.array(
+            rng.integers(1, n_days + 1, nc).astype(np.int64)),
     })
 
     npromo = 30
     t["promotion"] = pa.table({
         "p_promo_sk": pa.array(np.arange(1, npromo + 1, dtype=np.int64)),
+        "p_promo_id": [f"PROMO{i:08d}" for i in range(1, npromo + 1)],
+        "p_promo_name": [f"promo-{i}" for i in range(1, npromo + 1)],
         "p_channel_email": rng.choice(["Y", "N"], npromo,
                                       p=[0.15, 0.85]).tolist(),
         "p_channel_event": rng.choice(["Y", "N"], npromo,
                                       p=[0.15, 0.85]).tolist(),
+        "p_channel_dmail": rng.choice(["Y", "N"], npromo,
+                                      p=[0.5, 0.5]).tolist(),
+        "p_channel_tv": rng.choice(["Y", "N"], npromo,
+                                   p=[0.15, 0.85]).tolist(),
     })
 
     nss = max(2000, int(2_880_000 * sf))
@@ -180,8 +268,10 @@ def generate(sf: float = 0.001, seed: int = 0) -> Dict[str, pa.Table]:
             rng.integers(1, ncd + 1, nss).astype(np.int64)),
         "ss_hdemo_sk": pa.array(
             rng.integers(1, nhd + 1, nss).astype(np.int64)),
+        # ~4% null addresses (q76-class queries probe null fk buckets)
         "ss_addr_sk": pa.array(
-            rng.integers(1, nca + 1, nss).astype(np.int64)),
+            rng.integers(1, nca + 1, nss).astype(np.int64),
+            mask=rng.random(nss) < 0.04),
         "ss_store_sk": pa.array(
             rng.integers(1, ns + 1, nss).astype(np.int64)),
         "ss_promo_sk": pa.array(
@@ -189,14 +279,318 @@ def generate(sf: float = 0.001, seed: int = 0) -> Dict[str, pa.Table]:
         "ss_ticket_number": pa.array(
             rng.integers(1, nss // 3 + 2, nss).astype(np.int64)),
         "ss_quantity": pa.array(qty),
+        "ss_wholesale_cost": wholesale,
         "ss_list_price": list_price,
         "ss_sales_price": sales_price,
         "ss_ext_sales_price": ext_sales,
+        "ss_ext_list_price": np.round(list_price * qty, 2),
         "ss_ext_discount_amt": coupon,
         "ss_ext_wholesale_cost": np.round(wholesale * qty, 2),
+        "ss_ext_tax": np.round(ext_sales * 0.08, 2),
         "ss_coupon_amt": coupon,
+        "ss_net_paid": np.round(ext_sales - coupon, 2),
         "ss_net_profit": np.round(ext_sales - wholesale * qty - coupon,
                                   2),
+    })
+
+    # -- store_returns: ~10% of store_sales rows, correlated on
+    # (ticket, item, customer) so returns join back to their sale --------
+    nsr = max(200, nss // 10)
+    ridx = rng.choice(nss, nsr, replace=False)
+    r_qty = np.minimum(qty[ridx],
+                       rng.integers(1, 101, nsr).astype(np.int32))
+    r_amt = np.round(sales_price[ridx] * r_qty, 2)
+    ss = t["store_sales"]
+    t["store_returns"] = pa.table({
+        "sr_returned_date_sk": pa.array(np.minimum(
+            np.asarray(ss.column("ss_sold_date_sk"))[ridx]
+            + rng.integers(1, 60, nsr), n_days).astype(np.int64)),
+        "sr_return_time_sk": pa.array(
+            rng.integers(1, 86401, nsr).astype(np.int64)),
+        "sr_item_sk": pa.array(
+            np.asarray(ss.column("ss_item_sk"))[ridx]),
+        "sr_customer_sk": pa.array(
+            np.asarray(ss.column("ss_customer_sk"))[ridx]),
+        "sr_cdemo_sk": pa.array(
+            rng.integers(1, ncd + 1, nsr).astype(np.int64)),
+        "sr_hdemo_sk": pa.array(
+            rng.integers(1, nhd + 1, nsr).astype(np.int64)),
+        "sr_addr_sk": pa.array(
+            rng.integers(1, nca + 1, nsr).astype(np.int64)),
+        "sr_store_sk": pa.array(
+            np.asarray(ss.column("ss_store_sk"))[ridx]),
+        "sr_reason_sk": pa.array(
+            rng.integers(1, 36, nsr).astype(np.int64)),
+        "sr_ticket_number": pa.array(
+            np.asarray(ss.column("ss_ticket_number"))[ridx]),
+        "sr_return_quantity": pa.array(r_qty),
+        "sr_return_amt": r_amt,
+        "sr_return_tax": np.round(r_amt * 0.08, 2),
+        "sr_return_amt_inc_tax": np.round(r_amt * 1.08, 2),
+        "sr_fee": np.round(rng.uniform(0.5, 100.0, nsr), 2),
+        "sr_return_ship_cost": np.round(rng.uniform(0, 30.0, nsr), 2),
+        "sr_refunded_cash": np.round(r_amt * 0.7, 2),
+        "sr_reversed_charge": np.round(r_amt * 0.2, 2),
+        "sr_store_credit": np.round(r_amt * 0.1, 2),
+        "sr_net_loss": np.round(r_amt * 0.1
+                                + rng.uniform(0.5, 50.0, nsr), 2),
+    })
+
+    # -- catalog channel --------------------------------------------------
+    ncc = 6
+    t["call_center"] = pa.table({
+        "cc_call_center_sk": pa.array(np.arange(1, ncc + 1,
+                                                dtype=np.int64)),
+        "cc_call_center_id": [f"CC{i:06d}" for i in range(1, ncc + 1)],
+        "cc_name": [f"call center {i}" for i in range(1, ncc + 1)],
+        "cc_manager": [f"Manager{i}" for i in range(1, ncc + 1)],
+        "cc_county": rng.choice(_COUNTIES, ncc).tolist(),
+    })
+
+    ncp = 100
+    t["catalog_page"] = pa.table({
+        "cp_catalog_page_sk": pa.array(np.arange(1, ncp + 1,
+                                                 dtype=np.int64)),
+        "cp_catalog_page_id": [f"CP{i:08d}" for i in range(1, ncp + 1)],
+    })
+
+    nwh = 5
+    t["warehouse"] = pa.table({
+        "w_warehouse_sk": pa.array(np.arange(1, nwh + 1, dtype=np.int64)),
+        "w_warehouse_name": [f"Warehouse {i}" for i in range(1, nwh + 1)],
+        "w_warehouse_sq_ft": pa.array(
+            rng.integers(50_000, 1_000_000, nwh).astype(np.int32)),
+        "w_city": rng.choice(_CITIES, nwh).tolist(),
+        "w_county": rng.choice(_COUNTIES, nwh).tolist(),
+        "w_state": rng.choice(_STATES, nwh).tolist(),
+        "w_country": ["United States"] * nwh,
+    })
+
+    nsm = 20
+    t["ship_mode"] = pa.table({
+        "sm_ship_mode_sk": pa.array(np.arange(1, nsm + 1,
+                                              dtype=np.int64)),
+        "sm_type": rng.choice(["EXPRESS", "NEXT DAY", "OVERNIGHT",
+                               "REGULAR", "TWO DAY", "LIBRARY"],
+                              nsm).tolist(),
+        "sm_carrier": rng.choice(["UPS", "FEDEX", "AIRBORNE", "USPS",
+                                  "DHL", "TBS"], nsm).tolist(),
+        "sm_code": rng.choice(["AIR", "SURFACE", "SEA"], nsm).tolist(),
+    })
+
+    nreason = 35
+    t["reason"] = pa.table({
+        "r_reason_sk": pa.array(np.arange(1, nreason + 1,
+                                          dtype=np.int64)),
+        "r_reason_desc": [f"reason {i}" for i in range(1, nreason + 1)],
+    })
+
+    def _sales_channel(prefix: str, nrows: int, order_div: int,
+                       extra: Dict[str, pa.Array]) -> pa.Table:
+        """Shared generator for catalog_sales/web_sales columns."""
+        q2 = rng.integers(1, 101, nrows).astype(np.int32)
+        lp2 = np.round(rng.uniform(1.0, 200.0, nrows), 2)
+        sp2 = np.round(lp2 * rng.uniform(0.2, 1.0, nrows), 2)
+        ws2 = np.round(lp2 * 0.6, 2)
+        ext2 = np.round(sp2 * q2, 2)
+        disc = np.where(rng.random(nrows) < 0.1,
+                        np.round(ext2 * 0.1, 2), 0.0)
+        sold = rng.integers(1, n_days + 1, nrows).astype(np.int64)
+        cols = {
+            f"{prefix}_sold_date_sk": pa.array(sold),
+            f"{prefix}_sold_time_sk": pa.array(
+                rng.integers(1, 86401, nrows).astype(np.int64)),
+            f"{prefix}_ship_date_sk": pa.array(np.minimum(
+                sold + rng.integers(1, 121, nrows), n_days)
+                .astype(np.int64)),
+            f"{prefix}_item_sk": pa.array(
+                rng.integers(1, ni + 1, nrows).astype(np.int64)),
+            f"{prefix}_order_number": pa.array(
+                rng.integers(1, nrows // order_div + 2, nrows)
+                .astype(np.int64)),
+            f"{prefix}_quantity": pa.array(q2),
+            f"{prefix}_wholesale_cost": ws2,
+            f"{prefix}_list_price": lp2,
+            f"{prefix}_sales_price": sp2,
+            f"{prefix}_ext_discount_amt": disc,
+            f"{prefix}_ext_sales_price": ext2,
+            f"{prefix}_ext_wholesale_cost": np.round(ws2 * q2, 2),
+            f"{prefix}_ext_list_price": np.round(lp2 * q2, 2),
+            f"{prefix}_ext_ship_cost": np.round(
+                rng.uniform(0, 25.0, nrows) * q2, 2),
+            f"{prefix}_net_paid": np.round(ext2 - disc, 2),
+            f"{prefix}_net_profit": np.round(ext2 - ws2 * q2 - disc, 2),
+            f"{prefix}_coupon_amt": disc,
+            f"{prefix}_promo_sk": pa.array(
+                rng.integers(1, npromo + 1, nrows).astype(np.int64)),
+            f"{prefix}_warehouse_sk": pa.array(
+                rng.integers(1, nwh + 1, nrows).astype(np.int64)),
+            f"{prefix}_ship_mode_sk": pa.array(
+                rng.integers(1, nsm + 1, nrows).astype(np.int64)),
+        }
+        cols.update(extra)
+        return pa.table(cols)
+
+    ncs = max(1500, int(1_440_000 * sf))
+    t["catalog_sales"] = _sales_channel("cs", ncs, 4, {
+        "cs_bill_customer_sk": pa.array(
+            rng.integers(1, nc + 1, ncs).astype(np.int64)),
+        "cs_bill_cdemo_sk": pa.array(
+            rng.integers(1, ncd + 1, ncs).astype(np.int64)),
+        "cs_bill_hdemo_sk": pa.array(
+            rng.integers(1, nhd + 1, ncs).astype(np.int64)),
+        "cs_bill_addr_sk": pa.array(
+            rng.integers(1, nca + 1, ncs).astype(np.int64)),
+        "cs_ship_customer_sk": pa.array(
+            rng.integers(1, nc + 1, ncs).astype(np.int64)),
+        "cs_ship_addr_sk": pa.array(
+            rng.integers(1, nca + 1, ncs).astype(np.int64),
+            mask=rng.random(ncs) < 0.04),
+        "cs_call_center_sk": pa.array(
+            rng.integers(1, ncc + 1, ncs).astype(np.int64)),
+        "cs_catalog_page_sk": pa.array(
+            rng.integers(1, ncp + 1, ncs).astype(np.int64)),
+    })
+
+    nws = max(1000, int(720_000 * sf))
+    t["web_sales"] = _sales_channel("ws", nws, 4, {
+        "ws_bill_customer_sk": pa.array(
+            rng.integers(1, nc + 1, nws).astype(np.int64)),
+        "ws_bill_cdemo_sk": pa.array(
+            rng.integers(1, ncd + 1, nws).astype(np.int64)),
+        "ws_bill_hdemo_sk": pa.array(
+            rng.integers(1, nhd + 1, nws).astype(np.int64)),
+        "ws_bill_addr_sk": pa.array(
+            rng.integers(1, nca + 1, nws).astype(np.int64)),
+        "ws_ship_customer_sk": pa.array(
+            rng.integers(1, nc + 1, nws).astype(np.int64),
+            mask=rng.random(nws) < 0.04),
+        "ws_ship_addr_sk": pa.array(
+            rng.integers(1, nca + 1, nws).astype(np.int64)),
+        "ws_web_site_sk": pa.array(
+            rng.integers(1, 13, nws).astype(np.int64)),
+        "ws_web_page_sk": pa.array(
+            rng.integers(1, 61, nws).astype(np.int64)),
+    })
+
+    def _returns(prefix: str, sales: pa.Table, sprefix: str,
+                 extra_fn) -> pa.Table:
+        nr = max(150, sales.num_rows // 10)
+        idx = rng.choice(sales.num_rows, nr, replace=False)
+        rq = np.minimum(np.asarray(sales.column(f"{sprefix}_quantity"))[idx],
+                        rng.integers(1, 101, nr).astype(np.int32))
+        ra = np.round(
+            np.asarray(sales.column(f"{sprefix}_sales_price"))[idx] * rq, 2)
+        cols = {
+            f"{prefix}_returned_date_sk": pa.array(np.minimum(
+                np.asarray(sales.column(f"{sprefix}_sold_date_sk"))[idx]
+                + rng.integers(1, 60, nr), n_days).astype(np.int64)),
+            f"{prefix}_item_sk": pa.array(
+                np.asarray(sales.column(f"{sprefix}_item_sk"))[idx]),
+            f"{prefix}_order_number": pa.array(
+                np.asarray(sales.column(f"{sprefix}_order_number"))[idx]),
+            f"{prefix}_return_quantity": pa.array(rq),
+            f"{prefix}_reason_sk": pa.array(
+                rng.integers(1, nreason + 1, nr).astype(np.int64)),
+            f"{prefix}_refunded_cash": np.round(ra * 0.7, 2),
+            f"{prefix}_reversed_charge": np.round(ra * 0.2, 2),
+            f"{prefix}_net_loss": np.round(
+                ra * 0.1 + rng.uniform(0.5, 50.0, nr), 2),
+            f"{prefix}_fee": np.round(rng.uniform(0.5, 100.0, nr), 2),
+        }
+        cols.update(extra_fn(idx, nr, ra))
+        return pa.table(cols)
+
+    # correlate ~1/3 of catalog orders with store-returned (customer,
+    # item) pairs so cross-channel repurchase chains (q17/q25/q29/q64)
+    # select rows even at tiny scale factors
+    sr_cust = np.asarray(t["store_returns"].column("sr_customer_sk"))
+    sr_item = np.asarray(t["store_returns"].column("sr_item_sk"))
+    n_corr = min(nsr, ncs // 3)
+    corr_rows = rng.choice(ncs, n_corr, replace=False)
+    pick = rng.integers(0, nsr, n_corr)
+    cs_tbl = t["catalog_sales"]
+    bill = np.asarray(cs_tbl.column("cs_bill_customer_sk")).copy()
+    citem = np.asarray(cs_tbl.column("cs_item_sk")).copy()
+    bill[corr_rows] = sr_cust[pick]
+    citem[corr_rows] = sr_item[pick]
+    cs_tbl = cs_tbl.set_column(
+        cs_tbl.column_names.index("cs_bill_customer_sk"),
+        "cs_bill_customer_sk", pa.array(bill))
+    t["catalog_sales"] = cs_tbl.set_column(
+        cs_tbl.column_names.index("cs_item_sk"), "cs_item_sk",
+        pa.array(citem))
+
+    t["catalog_returns"] = _returns("cr", t["catalog_sales"], "cs",
+        lambda idx, nr, ra: {
+            "cr_return_amount": ra,
+            "cr_return_amt_inc_tax": np.round(ra * 1.08, 2),
+            "cr_returning_customer_sk": pa.array(
+                rng.integers(1, nc + 1, nr).astype(np.int64)),
+            "cr_refunded_customer_sk": pa.array(np.asarray(
+                t["catalog_sales"].column("cs_bill_customer_sk"))[idx]),
+            "cr_call_center_sk": pa.array(
+                rng.integers(1, ncc + 1, nr).astype(np.int64)),
+            "cr_catalog_page_sk": pa.array(
+                rng.integers(1, ncp + 1, nr).astype(np.int64)),
+            "cr_warehouse_sk": pa.array(
+                rng.integers(1, nwh + 1, nr).astype(np.int64)),
+            "cr_store_credit": np.round(ra * 0.1, 2),
+        })
+
+    t["web_returns"] = _returns("wr", t["web_sales"], "ws",
+        lambda idx, nr, ra: {
+            "wr_return_amt": ra,
+            "wr_return_amt_inc_tax": np.round(ra * 1.08, 2),
+            "wr_returning_customer_sk": pa.array(
+                rng.integers(1, nc + 1, nr).astype(np.int64)),
+            "wr_refunded_customer_sk": pa.array(np.asarray(
+                t["web_sales"].column("ws_bill_customer_sk"))[idx]),
+            "wr_refunded_cdemo_sk": pa.array(
+                rng.integers(1, ncd + 1, nr).astype(np.int64)),
+            "wr_returning_cdemo_sk": pa.array(
+                rng.integers(1, ncd + 1, nr).astype(np.int64)),
+            "wr_refunded_addr_sk": pa.array(
+                rng.integers(1, nca + 1, nr).astype(np.int64)),
+            "wr_web_page_sk": pa.array(
+                rng.integers(1, 61, nr).astype(np.int64)),
+        })
+
+    nwsite = 12
+    t["web_site"] = pa.table({
+        "web_site_sk": pa.array(np.arange(1, nwsite + 1,
+                                          dtype=np.int64)),
+        "web_site_id": [f"WEB{i:06d}" for i in range(1, nwsite + 1)],
+        "web_name": [f"site-{i}" for i in range(1, nwsite + 1)],
+        "web_company_name": rng.choice(["pri", "able", "ese", "anti",
+                                        "cally"], nwsite).tolist(),
+    })
+
+    nwp = 60
+    t["web_page"] = pa.table({
+        "wp_web_page_sk": pa.array(np.arange(1, nwp + 1,
+                                             dtype=np.int64)),
+        "wp_char_count": pa.array(
+            rng.integers(100, 8000, nwp).astype(np.int32)),
+    })
+
+    # -- inventory: weekly snapshots (every 7th date) ---------------------
+    inv_dates = np.arange(1, n_days + 1, 7, dtype=np.int64)
+    inv_items = np.arange(1, ni + 1, dtype=np.int64)
+    n_inv = len(inv_dates) * nwh
+    # one row per (week, warehouse) x a sampled item subset bounds size
+    items_per = min(ni, max(20, int(200 * sf * 100)))
+    di, wi = np.meshgrid(inv_dates, np.arange(1, nwh + 1,
+                                              dtype=np.int64))
+    di, wi = di.ravel(), wi.ravel()
+    reps = len(di)
+    inv_item = rng.choice(inv_items, (reps, items_per))
+    t["inventory"] = pa.table({
+        "inv_date_sk": pa.array(np.repeat(di, items_per)),
+        "inv_warehouse_sk": pa.array(np.repeat(wi, items_per)),
+        "inv_item_sk": pa.array(inv_item.ravel()),
+        "inv_quantity_on_hand": pa.array(
+            rng.integers(0, 1000, reps * items_per).astype(np.int32)),
     })
     return t
 
@@ -418,3 +812,18 @@ def q98(t):
 
 QUERIES = {"q3": q3, "q7": q7, "q19": q19, "q42": q42, "q52": q52,
            "q55": q55, "q68": q68, "q73": q73, "q96": q96, "q98": q98}
+
+
+def _collect_extended():
+    """Merge q1-q99 from the three query modules (all 99 present)."""
+    from spark_rapids_tpu.bench import (tpcds_queries_a,
+                                        tpcds_queries_b,
+                                        tpcds_queries_c)
+    for mod in (tpcds_queries_a, tpcds_queries_b, tpcds_queries_c):
+        for name, fn in vars(mod).items():
+            if name.startswith("q") and name[1:].isdigit():
+                QUERIES.setdefault(name, fn)
+
+
+_collect_extended()
+assert len(QUERIES) == 99, f"expected 99 TPC-DS queries, {len(QUERIES)}"

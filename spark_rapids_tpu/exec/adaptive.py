@@ -167,6 +167,8 @@ class _JoinAdaptiveState:
 
     def __init__(self, left: PhysicalPlan, right: PhysicalPlan, how: str,
                  conf_obj):
+        import threading
+        self._lock = threading.Lock()
         self.children = (left, right)
         self.how = how
         self.advisory = int(conf_obj.get(
@@ -180,6 +182,10 @@ class _JoinAdaptiveState:
         self._refs: List[Dict[int, int]] = [{}, {}]
 
     def ensure(self) -> None:
+        with self._lock:
+            return self._ensure_locked()
+
+    def _ensure_locked(self) -> None:
         if self.specs is not None:
             return
         from spark_rapids_tpu.mem.spill import register_or_hold
@@ -224,12 +230,14 @@ class _JoinAdaptiveState:
                     self.batches[side][p] = [register_or_hold(merged)]
 
     def release(self, side: int, parts) -> None:
-        for p in parts:
-            self._refs[side][p] -= 1
-            if self._refs[side][p] == 0:
-                for h in self.batches[side][p]:
-                    h.close()
-                self.batches[side][p] = []
+        # partition readers run concurrently under the task thread pool
+        with self._lock:
+            for p in parts:
+                self._refs[side][p] -= 1
+                if self._refs[side][p] == 0:
+                    for h in self.batches[side][p]:
+                        h.close()
+                    self.batches[side][p] = []
 
 
 class TpuAdaptiveJoinReaderExec(TpuExec):
@@ -255,11 +263,12 @@ class TpuAdaptiveJoinReaderExec(TpuExec):
 
     def _row_slice(self, batch: DeviceBatch, start: int, count: int
                    ) -> DeviceBatch:
+        from spark_rapids_tpu.exec import kernel_cache as kc
         cap = bucket_rows(count, self.min_bucket)
-        key = (cap, batch.schema_key())
+        key = ("exch_slice", cap, batch.schema_key())
         if key not in self._kernels:
-            self._kernels[key] = jax.jit(
-                lambda b, o, c: slice_span(b, o, c, cap))
+            self._kernels[key] = kc.get_kernel(
+                key, lambda: lambda b, o, c: slice_span(b, o, c, cap))
         return self._kernels[key](batch,
                                   jnp.asarray(start, dtype=jnp.int32),
                                   jnp.asarray(count, dtype=jnp.int32))
@@ -277,7 +286,7 @@ class TpuAdaptiveJoinReaderExec(TpuExec):
                     with timed(self.metrics):
                         out = group[0] if len(group) == 1 \
                             else concat_batches(group)
-                    self.metrics.num_output_rows += int(out.num_rows)
+                    self.metrics.add_rows(out.num_rows)
                     self.metrics.num_output_batches += 1
                     self.state.release(side, range(spec.start, spec.end))
                     yield out
@@ -297,7 +306,7 @@ class TpuAdaptiveJoinReaderExec(TpuExec):
                         else:
                             out = self._row_slice(first, spec.row_start,
                                                   count)
-                    self.metrics.num_output_rows += int(out.num_rows)
+                    self.metrics.add_rows(out.num_rows)
                     self.metrics.num_output_batches += 1
                     self.state.release(side, [spec.partition])
                     yield out

@@ -14,6 +14,7 @@ GpuRowToColumnar/GpuColumnarToRow analogs).
 
 from __future__ import annotations
 
+import threading
 import time
 from dataclasses import dataclass, field
 from typing import Any, Dict, Iterator, List, Optional, Tuple
@@ -48,11 +49,41 @@ REQUIRE_SINGLE_BATCH = RequireSingleBatch()
 
 @dataclass
 class Metrics:
-    num_output_rows: int = 0
+    _rows_host: int = 0
     num_output_batches: int = 0
     total_time_ns: int = 0
     peak_dev_memory: int = 0
     extra: Dict[str, float] = field(default_factory=dict)
+    _rows_pending: list = field(default_factory=list)
+    _rows_lock: Any = field(default_factory=threading.Lock)
+
+    def add_rows(self, nr) -> None:
+        """Count output rows WITHOUT forcing a device sync: traced/device
+        counts buffer and resolve lazily when the metric is read (a
+        mid-pipeline int() would serialize the whole async pipeline —
+        and on remote-device runtimes a single early read-back degrades
+        every later dispatch).  Thread-safe: partition iterators of one
+        exec run concurrently under the task pool."""
+        with self._rows_lock:
+            if isinstance(nr, int):
+                self._rows_host += nr
+            else:
+                self._rows_pending.append(nr)
+
+    @property
+    def num_output_rows(self) -> int:
+        with self._rows_lock:
+            if self._rows_pending:
+                self._rows_host += sum(int(x)
+                                       for x in self._rows_pending)
+                self._rows_pending.clear()
+            return self._rows_host
+
+    @num_output_rows.setter
+    def num_output_rows(self, v) -> None:
+        with self._rows_lock:
+            self._rows_pending.clear()
+            self._rows_host = int(v)
 
 
 class PhysicalPlan:

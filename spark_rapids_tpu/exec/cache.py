@@ -118,7 +118,7 @@ class TpuInMemoryTableScanExec(TpuExec):
                             blob, rg, schema, parquet_file=pf)
                     self.metrics.extra["fallbackColumns"] += \
                         len(fallbacks)
-                    self.metrics.num_output_rows += int(batch.num_rows)
+                    self.metrics.add_rows(batch.num_rows)
                     self.metrics.num_output_batches += 1
                     yield batch
 

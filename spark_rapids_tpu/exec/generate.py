@@ -155,22 +155,26 @@ class TpuGenerateExec(TpuExec):
             return jnp.sum(counts)
 
         def run(it) -> Iterator[DeviceBatch]:
+            from spark_rapids_tpu.exec import kernel_cache as kc
+            gsig = kc.expr_sig(gen)
             for b in it:
-                ckey = ("count", b.schema_key())
+                ckey = ("gen_count", gsig, outer, b.schema_key())
                 if ckey not in self._kernels:
-                    self._kernels[ckey] = jax.jit(count_fn)
+                    self._kernels[ckey] = kc.get_kernel(
+                        ckey, lambda: count_fn)
                 with timed(self.metrics):
                     total = int(self._kernels[ckey](b))
                 out_cap = bucket_rows(total)
-                ekey = ("emit", out_cap, b.schema_key())
+                ekey = ("gen_emit", gsig, out_cap, with_pos, outer,
+                        tuple(self._schema.names), b.schema_key())
                 if ekey not in self._kernels:
-                    self._kernels[ekey] = jax.jit(
-                        lambda bb: _generate_kernel(
+                    self._kernels[ekey] = kc.get_kernel(
+                        ekey, lambda: lambda bb: _generate_kernel(
                             bb, gen, out_cap, self._schema, with_pos,
                             outer))
                 with timed(self.metrics):
                     out = self._kernels[ekey](b)
-                self.metrics.num_output_rows += int(out.num_rows)
+                self.metrics.add_rows(out.num_rows)
                 self.metrics.num_output_batches += 1
                 yield out
 

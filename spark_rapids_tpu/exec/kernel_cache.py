@@ -1,0 +1,91 @@
+"""Process-wide compiled-kernel cache.
+
+The engine plans a FRESH exec tree for every ``collect()`` (the reference
+does too — Spark re-plans each action), so per-instance ``jax.jit``
+handles would recompile identical kernels on every query.  This cache
+keys jitted callables on a canonical (operator, expression-tree,
+parameter) signature so the XLA compile cost is paid once per
+(operator, schema, batch-bucket) per process — the compile-cache
+contract of SURVEY.md §7 ("XLA computations compiled per (operator,
+schema, batch-bucket)").
+
+jax.jit itself re-traces per input shape bucket under one cached handle,
+so batch capacity does not belong in the key.
+"""
+
+from __future__ import annotations
+
+import threading
+from collections import OrderedDict
+from typing import Any, Callable
+
+import jax
+
+from spark_rapids_tpu.expr import ir
+
+_MAX_ENTRIES = 1024
+_CACHE: "OrderedDict[Any, Any]" = OrderedDict()
+_LOCK = threading.Lock()
+
+
+def expr_sig(e) -> Any:
+    """Canonical hashable signature of an expression tree (class, dtype,
+    scalar params, children) — the kernel-cache key component for any
+    closed-over expression."""
+    if e is None:
+        return None
+    if isinstance(e, ir.Expression):
+        parts = [type(e).__name__,
+                 e.dtype.name if e.dtype is not None else "?",
+                 bool(e.nullable)]
+        for k in sorted(e.__dict__):
+            if k in ("children", "dtype", "nullable"):
+                continue
+            parts.append((k, _value_sig(e.__dict__[k])))
+        parts.append(tuple(expr_sig(c) for c in e.children))
+        return tuple(parts)
+    return _value_sig(e)
+
+
+def _value_sig(v) -> Any:
+    if isinstance(v, (str, int, float, bool, bytes, type(None))):
+        return v
+    if isinstance(v, (list, tuple)):
+        return tuple(_value_sig(x) for x in v)
+    if isinstance(v, ir.Expression):
+        return expr_sig(v)
+    if hasattr(v, "name") and not callable(v):  # DType-like
+        return getattr(v, "name")
+    if callable(v):
+        # UDF payloads etc. — unique per object, no cross-instance reuse
+        return ("callable", id(v))
+    d = getattr(v, "__dict__", None)
+    if d is not None:  # WindowFrame / SortOrder-like value objects
+        return (type(v).__name__,) + tuple(
+            (k, _value_sig(x)) for k, x in sorted(d.items()))
+    return repr(v)
+
+
+def exprs_sig(exprs) -> Any:
+    return tuple(expr_sig(e) for e in exprs)
+
+
+def get_kernel(key: Any, builder: Callable[[], Callable],
+               **jit_kwargs) -> Callable:
+    """Return the cached jitted kernel for ``key``, building+jitting via
+    ``builder`` on first use (LRU-bounded)."""
+    with _LOCK:
+        fn = _CACHE.get(key)
+        if fn is not None:
+            _CACHE.move_to_end(key)
+            return fn
+    fn = jax.jit(builder(), **jit_kwargs)
+    with _LOCK:
+        cur = _CACHE.setdefault(key, fn)
+        if len(_CACHE) > _MAX_ENTRIES:
+            _CACHE.popitem(last=False)
+    return cur
+
+
+def clear() -> None:
+    _CACHE.clear()

@@ -484,9 +484,25 @@ class TpuHashAggregateExec(TpuExec):
     # ------------------------------------------------------------------
     def execute(self):
         if self._update_kernel is None:
-            self._update_kernel = jax.jit(self._update_impl)
-            self._merge_kernel = jax.jit(self._merge_impl)
-            self._final_kernel = jax.jit(self._final_impl)
+            import functools
+            import types
+            from spark_rapids_tpu.exec import kernel_cache as kc
+            sig = (kc.exprs_sig(self.groupings),
+                   kc.exprs_sig(self.aggregates),
+                   tuple(self._schema.names))
+            shim = types.SimpleNamespace(
+                groupings=self.groupings, aggregates=self.aggregates,
+                specs=self.specs, _schema=self._schema)
+            cls = type(self)
+            self._update_kernel = kc.get_kernel(
+                ("agg_update", sig),
+                lambda: functools.partial(cls._update_impl, shim))
+            self._merge_kernel = kc.get_kernel(
+                ("agg_merge", sig),
+                lambda: functools.partial(cls._merge_impl, shim))
+            self._final_kernel = kc.get_kernel(
+                ("agg_final", sig),
+                lambda: functools.partial(cls._final_impl, shim))
 
         def run(its):
             from spark_rapids_tpu.mem.spill import register_or_hold
@@ -497,7 +513,12 @@ class TpuHashAggregateExec(TpuExec):
             try:
                 for it in its:
                     for b in it:
-                        if int(b.num_rows) == 0 and self.groupings:
+                        # skip empty batches only when the count is
+                        # already host-side: forcing a device sync here
+                        # would serialize the whole pipeline per batch
+                        nr = b.num_rows
+                        if isinstance(nr, (int, np.integer)) \
+                                and nr == 0 and self.groupings:
                             continue
                         with timed(self.metrics):
                             partial = self._update_kernel(b)
@@ -516,7 +537,7 @@ class TpuHashAggregateExec(TpuExec):
                     with timed(self.metrics):
                         merged = self._merge_kernel(whole)
                 out = self._final_kernel(merged)
-                self.metrics.num_output_rows += int(out.num_rows)
+                self.metrics.add_rows(out.num_rows)
                 yield out
             finally:
                 for p in partials:

@@ -108,7 +108,17 @@ class TpuProjectExec(TpuExec):
 
     def execute(self):
         if self._kernel is None:
-            self._kernel = jax.jit(self._impl)
+            import functools
+            import types
+            from spark_rapids_tpu.exec import kernel_cache as kc
+            # detach from self: the cached closure must not pin the exec
+            # instance (and through it the whole child plan subtree)
+            shim = types.SimpleNamespace(exprs=self.exprs,
+                                         _schema=self._schema)
+            self._kernel = kc.get_kernel(
+                ("project", kc.exprs_sig(self.exprs),
+                 tuple(self._schema.names)),
+                lambda: functools.partial(type(self)._impl, shim))
 
         needs_ctx = any(
             ir.collect(e, lambda n: isinstance(
@@ -163,7 +173,13 @@ class TpuFilterExec(TpuExec):
 
     def execute(self):
         if self._kernel is None:
-            self._kernel = jax.jit(self._impl)
+            import functools
+            import types
+            from spark_rapids_tpu.exec import kernel_cache as kc
+            shim = types.SimpleNamespace(condition=self.condition)
+            self._kernel = kc.get_kernel(
+                ("filter", kc.expr_sig(self.condition)),
+                lambda: functools.partial(type(self)._impl, shim))
 
         def run(it):
             for b in it:
@@ -330,13 +346,17 @@ class TpuExpandExec(TpuExec):
 
     def execute(self):
         if self._kernels is None:
+            from spark_rapids_tpu.exec import kernel_cache as kc
+
             def mk(proj):
                 def impl(batch):
                     cols = [eval_tpu.evaluate(e, batch).to_column()
                             for e in proj]
                     return DeviceBatch(self._schema.names, cols,
                                        batch.num_rows)
-                return jax.jit(impl)
+                return kc.get_kernel(
+                    ("expand", kc.exprs_sig(proj),
+                     tuple(self._schema.names)), lambda: impl)
             self._kernels = [mk(p) for p in self.projections]
 
         def run(it):

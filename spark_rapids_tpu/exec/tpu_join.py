@@ -229,12 +229,16 @@ class _BroadcastBuildMixin:
         self.build_side = build_side
         self._built = None
         self._build_done = False
+        import threading
+        self._build_lock = threading.Lock()
 
     def _build(self):
-        if not self._build_done:
-            side = 1 if self.build_side == "right" else 0
-            self._built = _gather(self.children[side])
-            self._build_done = True
+        # concurrent stream partitions must gather the build side once
+        with self._build_lock:
+            if not self._build_done:
+                side = 1 if self.build_side == "right" else 0
+                self._built = _gather(self.children[side])
+                self._build_done = True
         return self._built
 
 
@@ -271,14 +275,16 @@ class _HashJoinBase(TpuExec):
         right = DeviceBatch(rnames, right.columns, right.num_rows)
 
         if how in ("semi", "anti"):
-            key = ("semi", how, left.schema_key(), right.schema_key())
+            from spark_rapids_tpu.exec import kernel_cache as kc
+            key = ("semi", how, tuple(lkeys), tuple(rkeys),
+                   left.schema_key(), right.schema_key())
             if key not in self._kernels:
-                self._kernels[key] = jax.jit(
-                    lambda b, s: _semi_kernel(b, s, rkeys, lkeys,
-                                              how == "anti"))
+                self._kernels[key] = kc.get_kernel(
+                    key, lambda: lambda b, s: _semi_kernel(
+                        b, s, rkeys, lkeys, how == "anti"))
             with timed(self.metrics):
                 out = self._kernels[key](right, left)
-            self.metrics.num_output_rows += int(out.num_rows)
+            self.metrics.add_rows(out.num_rows)
             self.metrics.num_output_batches += 1
             yield DeviceBatch(self._schema.names, out.columns,
                               out.num_rows)
@@ -296,20 +302,21 @@ class _HashJoinBase(TpuExec):
             emit_how = how
             build_first = False
 
-        ckey = ("count", emit_how, build.schema_key(),
-                stream.schema_key())
+        from spark_rapids_tpu.exec import kernel_cache as kc
+        ckey = ("count", emit_how, tuple(bkeys), tuple(skeys),
+                build.schema_key(), stream.schema_key())
         if ckey not in self._kernels:
-            self._kernels[ckey] = jax.jit(
-                lambda b, s: _count_kernel(b, s, bkeys, skeys,
-                                           emit_how))
+            self._kernels[ckey] = kc.get_kernel(
+                ckey, lambda: lambda b, s: _count_kernel(
+                    b, s, bkeys, skeys, emit_how))
         with timed(self.metrics):
             total = int(self._kernels[ckey](build, stream))
         out_cap = bucket_rows(total)
-        ekey = ("emit", emit_how, out_cap, build.schema_key(),
-                stream.schema_key())
+        ekey = ("emit", emit_how, out_cap, tuple(bkeys), tuple(skeys),
+                build_first, build.schema_key(), stream.schema_key())
         if ekey not in self._kernels:
-            self._kernels[ekey] = jax.jit(
-                lambda b, s: _emit_kernel(
+            self._kernels[ekey] = kc.get_kernel(
+                ekey, lambda: lambda b, s: _emit_kernel(
                     b, s, bkeys, skeys, emit_how, out_cap,
                     build.names, stream.names, build_first))
         with timed(self.metrics):
@@ -318,7 +325,7 @@ class _HashJoinBase(TpuExec):
         if self.condition is not None:
             v = eval_tpu.evaluate(self.condition, out)
             out = compact(out, v.data.astype(jnp.bool_) & v.validity)
-        self.metrics.num_output_rows += int(out.num_rows)
+        self.metrics.add_rows(out.num_rows)
         self.metrics.num_output_batches += 1
         yield out
 
@@ -418,8 +425,11 @@ class _NestedLoopBase(TpuExec):
         nl, nr = int(left.num_rows), int(right.num_rows)
         if nl == 0 or nr == 0:
             return
+        from spark_rapids_tpu.exec import kernel_cache as kc
         out_cap = bucket_rows(nl * nr)
-        key = (out_cap, left.schema_key(), right.schema_key())
+        key = ("cross", out_cap, kc.expr_sig(self.condition),
+               tuple(self._schema.names), left.schema_key(),
+               right.schema_key())
         if key not in self._kernels:
             def impl(l, r):
                 total = l.num_rows * r.num_rows
@@ -437,10 +447,10 @@ class _NestedLoopBase(TpuExec):
                     out = compact(out, v.data.astype(jnp.bool_) &
                                   v.validity)
                 return out
-            self._kernels[key] = jax.jit(impl)
+            self._kernels[key] = kc.get_kernel(key, lambda: impl)
         with timed(self.metrics):
             out = self._kernels[key](left, right)
-        self.metrics.num_output_rows += int(out.num_rows)
+        self.metrics.add_rows(out.num_rows)
         self.metrics.num_output_batches += 1
         yield out
 

@@ -57,7 +57,15 @@ class TpuSortExec(TpuExec):
 
     def execute(self):
         if self._kernel is None:
-            self._kernel = jax.jit(self._impl)
+            import functools
+            import types
+            from spark_rapids_tpu.exec import kernel_cache as kc
+            shim = types.SimpleNamespace(orders=self.orders)
+            self._kernel = kc.get_kernel(
+                ("sort", tuple((kc.expr_sig(o.expr), o.ascending,
+                                o.nulls_first_resolved)
+                               for o in self.orders)),
+                lambda: functools.partial(type(self)._impl, shim))
 
         def run():
             batches: List[DeviceBatch] = []
@@ -68,6 +76,6 @@ class TpuSortExec(TpuExec):
             whole = concat_batches(batches)
             with timed(self.metrics):
                 out = self._kernel(whole)
-            self.metrics.num_output_rows += int(out.num_rows)
+            self.metrics.add_rows(out.num_rows)
             yield out
         return [run()]

@@ -438,7 +438,16 @@ class TpuWindowExec(TpuExec):
 
     def execute(self):
         if self._kernel is None:
-            self._kernel = jax.jit(self._impl)
+            import functools
+            import types
+            from spark_rapids_tpu.exec import kernel_cache as kc
+            shim = types.SimpleNamespace(window_exprs=self.window_exprs,
+                                         out_names=self.out_names,
+                                         _schema=self._schema)
+            self._kernel = kc.get_kernel(
+                ("window", kc.exprs_sig(self.window_exprs),
+                 tuple(self.out_names)),
+                lambda: functools.partial(type(self)._impl, shim))
 
         def run():
             batches: List[DeviceBatch] = []
@@ -449,6 +458,6 @@ class TpuWindowExec(TpuExec):
             whole = concat_batches(batches)
             with timed(self.metrics):
                 out = self._kernel(whole)
-            self.metrics.num_output_rows += int(out.num_rows)
+            self.metrics.add_rows(out.num_rows)
             yield out
         return [run()]

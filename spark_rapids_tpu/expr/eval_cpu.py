@@ -461,6 +461,10 @@ _US_PER_DAY = 86400 * 1000 * 1000
 def _cast(e, table):
     c = evaluate(e.child, table)
     src, tgt = c.dtype, e.to
+    if e.child.dtype == dt.NULL:
+        # a void child materializes as an all-null placeholder column
+        # whose runtime dtype is arbitrary — the STATIC type is the truth
+        src = dt.NULL
     if src == tgt:
         return CpuVal(tgt, c.data, c.valid)
     if src == dt.NULL:

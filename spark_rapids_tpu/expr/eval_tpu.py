@@ -531,6 +531,10 @@ _US_PER_DAY = 86400 * 1000 * 1000
 def _eval_cast(e, batch):
     c = evaluate(e.child, batch)
     src, tgt = c.dtype, e.to
+    if e.child.dtype == dt.NULL:
+        # void child: the all-null placeholder's runtime dtype is
+        # arbitrary — the static type decides (all-null of the target)
+        src = dt.NULL
     if src == tgt:
         return ColVal(tgt, c.data, c.validity, c.lengths)
     if src == dt.NULL:

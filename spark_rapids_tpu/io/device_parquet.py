@@ -159,6 +159,16 @@ def nonnull_count(runs: RunTable, packed: bytes, lo_run: int, hi_run: int,
 # ---------------------------------------------------------------------------
 
 @partial(jax.jit, static_argnames=("cap",))
+def _expand_runs_packed(runs_mat: jnp.ndarray, packed: jnp.ndarray,
+                        cap: int) -> jnp.ndarray:
+    """Expand from the single packed [rcap, 5] run matrix (one upload):
+    columns are (end, is_rle, value, bit_base, width)."""
+    return _expand_runs(packed, runs_mat[:, 0], runs_mat[:, 1] != 0,
+                        runs_mat[:, 2].astype(jnp.uint32),
+                        runs_mat[:, 3], runs_mat[:, 4], cap=cap)
+
+
+@partial(jax.jit, static_argnames=("cap",))
 def _expand_runs(packed: jnp.ndarray, run_ends: jnp.ndarray,
                  run_is_rle: jnp.ndarray, run_value: jnp.ndarray,
                  run_bit_base: jnp.ndarray, run_w: jnp.ndarray,
@@ -219,25 +229,24 @@ def _pad_np(a: np.ndarray, cap: int, fill=0) -> np.ndarray:
 
 
 def _upload_runs(runs: RunTable, packed: bytes):
-    """Bucket + upload a run table (device arrays)."""
+    """Bucket + upload a run table as TWO device arrays (one [rcap, 5]
+    int64 run matrix + the packed byte buffer) — minimizing host->device
+    transfers, which dominate scan cost on remote/tunneled devices."""
     r = max(len(runs.counts), 1)
     rcap = bucket_rows(r, 8)
     ends = np.cumsum(np.asarray(runs.counts + [0], dtype=np.int64))[:r]
-    dev = dict(
-        run_ends=jnp.asarray(_pad_np(ends, rcap, fill=np.int64(1) << 62)),
-        run_is_rle=jnp.asarray(_pad_np(
-            np.asarray(runs.is_rle + [False], dtype=bool)[:r], rcap)),
-        run_value=jnp.asarray(_pad_np(
-            np.asarray(runs.values + [0], dtype=np.uint32)[:r], rcap)),
-        run_bit_base=jnp.asarray(_pad_np(
-            np.asarray(runs.bit_bases + [0], dtype=np.int64)[:r], rcap)),
-        run_w=jnp.asarray(_pad_np(
-            np.asarray(runs.widths + [0], dtype=np.int64)[:r], rcap)),
-    )
+    n = len(runs.counts)
+    mat = np.zeros((rcap, 5), dtype=np.int64)
+    mat[:, 0] = _pad_np(ends, rcap, fill=np.int64(1) << 62)
+    mat[:n, 1] = np.asarray(runs.is_rle, dtype=np.int64)
+    mat[:n, 2] = np.asarray(runs.values, dtype=np.int64)
+    mat[:n, 3] = np.asarray(runs.bit_bases, dtype=np.int64)
+    mat[:n, 4] = np.asarray(runs.widths, dtype=np.int64)
     bcap = bucket_rows(max(len(packed), 4), 64)
-    dev["packed"] = jnp.asarray(_pad_np(
-        np.frombuffer(bytes(packed), dtype=np.uint8), bcap))
-    return dev
+    return dict(
+        runs_mat=jnp.asarray(mat),
+        packed=jnp.asarray(_pad_np(
+            np.frombuffer(bytes(packed), dtype=np.uint8), bcap)))
 
 
 # ---------------------------------------------------------------------------
@@ -399,9 +408,8 @@ def decode_chunk(chunk: pm.ChunkPages, out_dtype: dt.DType,
     vcap = bucket_rows(max(n_rows, 1))
     if nullable:
         dev = _upload_runs(def_runs, bytes(def_packed))
-        levels = _expand_runs(dev["packed"], dev["run_ends"],
-                              dev["run_is_rle"], dev["run_value"],
-                              dev["run_bit_base"], dev["run_w"], cap=vcap)
+        levels = _expand_runs_packed(dev["runs_mat"], dev["packed"],
+                                     cap=vcap)
     else:
         levels = None
 
@@ -409,9 +417,8 @@ def decode_chunk(chunk: pm.ChunkPages, out_dtype: dt.DType,
 
     if any_dict:
         dev = _upload_runs(idx_runs, bytes(idx_packed))
-        indices = _expand_runs(dev["packed"], dev["run_ends"],
-                               dev["run_is_rle"], dev["run_value"],
-                               dev["run_bit_base"], dev["run_w"], cap=vcap)
+        indices = _expand_runs_packed(dev["runs_mat"], dev["packed"],
+                                      cap=vcap)
         if nullable:
             indices, valid = _def_expand(levels, indices, n_rows, cap=vcap)
         else:
@@ -429,9 +436,8 @@ def decode_chunk(chunk: pm.ChunkPages, out_dtype: dt.DType,
 
     if ptype == "BOOLEAN":
         dev = _upload_runs(bool_runs, bytes(bool_packed))
-        bits = _expand_runs(dev["packed"], dev["run_ends"],
-                            dev["run_is_rle"], dev["run_value"],
-                            dev["run_bit_base"], dev["run_w"], cap=vcap)
+        bits = _expand_runs_packed(dev["runs_mat"], dev["packed"],
+                                   cap=vcap)
         vals = bits.astype(jnp.bool_)
     else:
         raw = b"".join(plain_parts)
@@ -450,14 +456,18 @@ def decode_chunk(chunk: pm.ChunkPages, out_dtype: dt.DType,
 
 
 def _to_cap(col: DeviceColumn, cap: int) -> DeviceColumn:
-    """Re-bucket a column to the batch capacity."""
+    """Re-bucket a column to the batch capacity (jitted per shape)."""
     if col.capacity == cap:
         return col
+    return _to_cap_jit(col, cap=cap)
+
+
+@partial(jax.jit, static_argnames=("cap",))
+def _to_cap_jit(col: DeviceColumn, cap: int) -> DeviceColumn:
     idx = jnp.arange(cap)
     valid_src = idx < col.capacity
     gidx = jnp.clip(idx, 0, col.capacity - 1)
-    return col.gather(gidx, valid_src & jnp.take(
-        jnp.ones((col.capacity,), dtype=bool), gidx))
+    return col.gather(gidx, valid_src)
 
 
 # ---------------------------------------------------------------------------

@@ -88,7 +88,7 @@ def _check_expr_node(e: ir.Expression, conf: RapidsTpuConf
             return "pad length/fill must be literals"
     if isinstance(e, ir.Cast):
         src = e.children[0].dtype
-        if src is not None and src != e.to:
+        if src is not None and src != e.to and src != dt.NULL:
             if src.is_string and not e.to.is_integral:
                 return f"cast string->{e.to.name} not supported on TPU yet"
             if e.to.is_string:

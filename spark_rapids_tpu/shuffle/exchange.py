@@ -278,8 +278,10 @@ class CpuShuffleExchangeExec(PhysicalPlan):
         raise NotImplementedError(type(p).__name__)
 
     def execute(self):
+        import threading
         n_parts = self.partitioning.num_partitions
         state = {"slices": None}
+        lock = threading.Lock()
 
         def input_batches():
             """(map_idx, table) pairs; range partitioning needs the global
@@ -298,6 +300,11 @@ class CpuShuffleExchangeExec(PhysicalPlan):
                         yield m, t
 
         def materialize():
+            # readers may run on concurrent tasks; one thread materializes
+            with lock:
+                return _materialize_locked()
+
+        def _materialize_locked():
             if state["slices"] is not None:
                 return state["slices"]
             slices: List[List[pa.Table]] = [[] for _ in range(n_parts)]
@@ -376,12 +383,17 @@ class TpuShuffleExchangeExec(TpuExec):
 
     def _partition_one(self, batch: DeviceBatch, rows_seen: int
                        ) -> Tuple[DeviceBatch, np.ndarray]:
+        from spark_rapids_tpu.exec import kernel_cache as kc
         n_parts = self.partitioning.num_partitions
-        key = ("part", batch.schema_key())
+        key = ("exch_part", type(self.partitioning).__name__, n_parts,
+               kc.exprs_sig(self.partitioning.exprs()),
+               batch.schema_key())
         if key not in self._kernels:
             tf = self._target_fn()
-            self._kernels[key] = jax.jit(
-                lambda b, st: partition_batch(b, tf(b, st), n_parts))
+            self._kernels[key] = kc.get_kernel(
+                key,
+                lambda: lambda b, st: partition_batch(b, tf(b, st),
+                                                      n_parts))
         with timed(self.metrics):
             reordered, counts = self._kernels[key](
                 batch, jnp.asarray(rows_seen, dtype=jnp.int32))
@@ -389,11 +401,13 @@ class TpuShuffleExchangeExec(TpuExec):
 
     def _slice(self, reordered: DeviceBatch, offset: int, count: int
                ) -> DeviceBatch:
+        from spark_rapids_tpu.exec import kernel_cache as kc
         out_cap = bucket_rows(count, self.min_bucket)
-        key = ("slice", out_cap, reordered.schema_key())
+        key = ("exch_slice", out_cap, reordered.schema_key())
         if key not in self._kernels:
-            self._kernels[key] = jax.jit(
-                lambda b, o, c: slice_span(b, o, c, out_cap))
+            self._kernels[key] = kc.get_kernel(
+                key, lambda: lambda b, o, c: slice_span(b, o, c,
+                                                        out_cap))
         return self._kernels[key](reordered,
                                   jnp.asarray(offset, dtype=jnp.int32),
                                   jnp.asarray(count, dtype=jnp.int32))
@@ -414,24 +428,34 @@ class TpuShuffleExchangeExec(TpuExec):
         kernels (join probe, per-partition aggregate) execute distributed
         across the mesh.
         """
+        import threading
         from spark_rapids_tpu.shuffle import ici
         n_parts = self.partitioning.num_partitions
         state = {"done": False, "dev": None, "n_dev": 1,
                  "reads_left": n_parts}
+        lock = threading.Lock()
 
         def materialize():
+            with lock:
+                return _materialize_locked()
+
+        def _materialize_locked():
             if state["done"]:
                 return
             batches = []
             for it in self.children[0].execute():
                 batches.extend(b for b in it if int(b.num_rows))
             if batches:
+                from spark_rapids_tpu.exec import kernel_cache as kc
                 g = concat_batches(batches)
                 tf = self._target_fn()
-                key = ("ici_target", g.schema_key())
+                key = ("ici_target", type(self.partitioning).__name__,
+                       self.partitioning.num_partitions,
+                       kc.exprs_sig(self.partitioning.exprs()),
+                       g.schema_key())
                 if key not in self._kernels:
-                    self._kernels[key] = jax.jit(
-                        lambda b: tf(b, jnp.int32(0)))
+                    self._kernels[key] = kc.get_kernel(
+                        key, lambda: lambda b: tf(b, jnp.int32(0)))
                 with timed(self.metrics):
                     targets = self._kernels[key](g)
                     dev, mesh = ici.exchange_batch(g, targets,
@@ -449,27 +473,30 @@ class TpuShuffleExchangeExec(TpuExec):
                 b = state["dev"][pidx % state["n_dev"]]
                 if b is None:
                     return
+                from spark_rapids_tpu.exec import kernel_cache as kc
                 key = ("ici_extract", b.schema_key())
                 if key not in self._kernels:
                     def extract(batch, pid):
                         from spark_rapids_tpu.exec.tpu_basic import compact
                         part = batch.columns[-1].data
                         return compact(batch, part == pid)
-                    self._kernels[key] = jax.jit(extract)
+                    self._kernels[key] = kc.get_kernel(
+                        key, lambda: extract)
                 with timed(self.metrics):
                     out = self._kernels[key](b, jnp.int32(pidx))
                 if int(out.num_rows) == 0:
                     return
                 out = DeviceBatch(out.names[:-1], out.columns[:-1],
                                   out.num_rows)  # drop __part__
-                self.metrics.num_output_rows += int(out.num_rows)
+                self.metrics.add_rows(out.num_rows)
                 self.metrics.num_output_batches += 1
             finally:
                 # last reducer out drops the device-resident shards so a
                 # multi-stage query doesn't pin every exchange in HBM
-                state["reads_left"] -= 1
-                if state["reads_left"] == 0:
-                    state["dev"] = None
+                with lock:
+                    state["reads_left"] -= 1
+                    if state["reads_left"] == 0:
+                        state["dev"] = None
             yield out
 
         return [reader(p) for p in range(n_parts)]
@@ -477,11 +504,17 @@ class TpuShuffleExchangeExec(TpuExec):
     def execute(self):
         if self.transport == "ici":
             return self._execute_ici()
+        import threading
         n_parts = self.partitioning.num_partitions
         state = {"done": False, "store": None, "dev_slices": None,
                  "mgr": None, "sid": None, "reads_left": n_parts}
+        lock = threading.Lock()
 
         def materialize():
+            with lock:
+                return _materialize_locked()
+
+        def _materialize_locked():
             if state["done"]:
                 return
             host = self.transport == "local"
@@ -560,9 +593,10 @@ class TpuShuffleExchangeExec(TpuExec):
                 finally:
                     # last reducer out frees the device-resident blocks
                     # (ShuffleManager.unregisterShuffle analog)
-                    state["reads_left"] -= 1
-                    if state["reads_left"] == 0:
-                        state["mgr"].unregister_shuffle(state["sid"])
+                    with lock:
+                        state["reads_left"] -= 1
+                        if state["reads_left"] == 0:
+                            state["mgr"].unregister_shuffle(state["sid"])
                 yield b
             elif self.transport == "local":
                 tables = state["store"].fetch(pidx)
@@ -581,7 +615,7 @@ class TpuShuffleExchangeExec(TpuExec):
                     return
                 with timed(self.metrics):
                     b = concat_batches(slices)
-                self.metrics.num_output_rows += int(b.num_rows)
+                self.metrics.add_rows(b.num_rows)
                 self.metrics.num_output_batches += 1
                 yield b
 

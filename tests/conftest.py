@@ -24,6 +24,17 @@ jax.config.update("jax_platforms", "cpu")
 import pytest  # noqa: E402
 
 
+@pytest.fixture(autouse=True, scope="module")
+def _bounded_compile_state():
+    """Clear jit/kernel caches between test modules: a full-suite run
+    compiles thousands of XLA:CPU executables, and unbounded accumulation
+    has produced compiler segfaults late in the run."""
+    yield
+    from spark_rapids_tpu.exec import kernel_cache
+    kernel_cache.clear()
+    jax.clear_caches()
+
+
 @pytest.fixture()
 def session():
     from spark_rapids_tpu import TpuSparkSession

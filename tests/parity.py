@@ -67,6 +67,12 @@ def assert_tables_equal(cpu: pa.Table, tpu: pa.Table,
 
 _BASE_CONF = {
     "spark.rapids.tpu.sql.variableFloatAgg.enabled": True,
+    # every parity test PROVES the device path ran: any unexpected CPU
+    # node in the final plan raises (reference: RapidsConf.scala:607-621
+    # spark.rapids.sql.test.enabled + assertIsOnTheGpu,
+    # GpuTransitionOverrides.scala:389-446); tests with intentional
+    # fallbacks pass allow_non_tpu=[...]
+    "spark.rapids.tpu.sql.test.enabled": True,
 }
 
 
@@ -74,22 +80,34 @@ def with_cpu_session(fn: Callable, conf: Optional[dict] = None):
     c = dict(_BASE_CONF)
     c.update(conf or {})
     c["spark.rapids.tpu.sql.enabled"] = False
+    c["spark.rapids.tpu.sql.test.enabled"] = False
     return fn(TpuSparkSession(c))
 
 
-def with_tpu_session(fn: Callable, conf: Optional[dict] = None):
+def with_tpu_session(fn: Callable, conf: Optional[dict] = None,
+                     allow_non_tpu: Optional[List[str]] = None):
     c = dict(_BASE_CONF)
     c.update(conf or {})
     c["spark.rapids.tpu.sql.enabled"] = True
+    if allow_non_tpu:
+        prev = str(c.get("spark.rapids.tpu.sql.test.allowedNonTpu", ""))
+        allowed = [s for s in prev.split(",") if s] + list(allow_non_tpu)
+        c["spark.rapids.tpu.sql.test.allowedNonTpu"] = ",".join(allowed)
     return fn(TpuSparkSession(c))
 
 
 def assert_tpu_and_cpu_are_equal_collect(
         fn: Callable, conf: Optional[dict] = None,
-        ignore_order: bool = False, approx_float: bool = True) -> None:
-    """fn(session) -> DataFrame; runs on both engines and compares."""
+        ignore_order: bool = False, approx_float: bool = True,
+        allow_non_tpu: Optional[List[str]] = None) -> None:
+    """fn(session) -> DataFrame; runs on both engines and compares.
+
+    ``allow_non_tpu`` lists exec class names permitted to stay on CPU
+    (the ALLOW_NON_GPU decorator analog,
+    SparkQueryCompareTestSuite.scala:378-874)."""
     cpu = with_cpu_session(lambda s: fn(s).collect(), conf)
-    tpu = with_tpu_session(lambda s: fn(s).collect(), conf)
+    tpu = with_tpu_session(lambda s: fn(s).collect(), conf,
+                           allow_non_tpu)
     assert_tables_equal(cpu, tpu, ignore_order, approx_float)
 
 
@@ -105,6 +123,8 @@ def assert_tpu_fallback(fn: Callable, fallback_exec: str,
     """Assert the query ran but a specific exec fell back to CPU
     (assert_gpu_fallback_collect analog)."""
     c = dict(_BASE_CONF)
+    # fallback tests intentionally keep nodes on CPU
+    c["spark.rapids.tpu.sql.test.enabled"] = False
     c.update(conf or {})
     s = TpuSparkSession(c)
     captured = collect_plans(s)

@@ -82,8 +82,10 @@ def test_cached_scan_kill_switch_falls_back():
         df = session.create_dataframe(t).cache()
         return df.explain_string("physical")
 
-    plan = with_tpu_session(run, {
-        **_CONF, "spark.rapids.tpu.sql.cache.deviceDecode.enabled": False})
+    plan = with_tpu_session(
+        run,
+        {**_CONF, "spark.rapids.tpu.sql.cache.deviceDecode.enabled": False},
+        allow_non_tpu=["CpuInMemoryTableScanExec"])
     assert "CpuInMemoryTableScanExec" in plan
     assert "TpuInMemoryTableScanExec" not in plan
 

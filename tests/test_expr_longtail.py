@@ -34,7 +34,8 @@ def test_substring_index_parity():
             F.substring_index(col("s"), ".", -1).alias("m1"),
             F.substring_index(col("s"), ".", 0).alias("z"))
 
-    assert_tpu_and_cpu_are_equal_collect(fn)
+    assert_tpu_and_cpu_are_equal_collect(
+        fn, allow_non_tpu=["CpuProjectExec"])
     out = with_cpu_session(lambda s: fn(s).collect())
     assert out.column("p2").to_pylist()[0] == "www.apache"
     assert out.column("m1").to_pylist()[0] == "org"
@@ -50,7 +51,8 @@ def test_split_and_element():
 
     out = with_cpu_session(lambda s: fn(s).collect())
     assert out.column("parts").to_pylist()[5] == ["a", "b", "c"]
-    assert_tpu_and_cpu_are_equal_collect(fn)
+    assert_tpu_and_cpu_are_equal_collect(
+        fn, allow_non_tpu=["CpuProjectExec"])
 
 
 def test_split_regex_and_limit():
@@ -78,7 +80,8 @@ def test_regexp_replace_parity():
     out = with_cpu_session(lambda s: fn(s).collect())
     assert out.column("r").to_pylist()[1] == "foo#bar#"
     assert out.column("g").to_pylist()[1] == "<foo>123bar456"
-    assert_tpu_and_cpu_are_equal_collect(fn)
+    assert_tpu_and_cpu_are_equal_collect(
+        fn, allow_non_tpu=["CpuProjectExec"])
 
 
 def test_md5_matches_hashlib():
@@ -92,7 +95,8 @@ def test_md5_matches_hashlib():
     expect = [hashlib.md5(v.encode()).hexdigest()
               for v in t.column("s").to_pylist()]
     assert out.column("h").to_pylist() == expect
-    assert_tpu_and_cpu_are_equal_collect(fn)
+    assert_tpu_and_cpu_are_equal_collect(
+        fn, allow_non_tpu=["CpuProjectExec"])
 
 
 def test_at_least_n_non_nulls():
@@ -126,7 +130,8 @@ def test_from_unixtime():
     assert out.column("ts").to_pylist() == [
         "1970-01-01 00:00:00", "1970-01-01 23:59:59",
         "2020-09-13 12:26:40"]
-    assert_tpu_and_cpu_are_equal_collect(fn)
+    assert_tpu_and_cpu_are_equal_collect(
+        fn, allow_non_tpu=["CpuProjectExec"])
 
 
 def test_input_file_name(tmp_path):
@@ -141,11 +146,13 @@ def test_input_file_name(tmp_path):
         return df.select(col("v"),
                          F.input_file_name().alias("f")).collect()
 
-    for runner, conf in ((with_cpu_session, None),
-                         (with_tpu_session,
-                          {"spark.rapids.tpu.sql."
-                           "variableFloatAgg.enabled": True})):
-        out = runner(fn) if conf is None else runner(fn, conf)
+    for runner, kw in (
+            (with_cpu_session, {}),
+            (with_tpu_session,
+             {"conf": {"spark.rapids.tpu.sql."
+                       "variableFloatAgg.enabled": True},
+              "allow_non_tpu": ["CpuProjectExec"]})):
+        out = runner(fn, **kw)
         rows = sorted(zip(out.column("v").to_pylist(),
                           out.column("f").to_pylist()))
         assert rows[0][0] == 1 and rows[0][1].endswith("f0.parquet")

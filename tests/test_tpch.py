@@ -32,8 +32,13 @@ def test_tpch_query_parity(name, data):
         return tpch.QUERIES[name](tables).collect()
 
     cpu = with_cpu_session(run)
+    # q13/q16 use multi-wildcard NOT LIKE patterns, a documented
+    # CPU-fallback expression (ALLOW_NON_GPU analog)
+    allow = {"q13": ["CpuProjectExec", "CpuFilterExec"],
+             "q16": ["CpuProjectExec", "CpuFilterExec"]}.get(name)
     tpu = with_tpu_session(
-        run, {"spark.rapids.tpu.sql.variableFloatAgg.enabled": True})
+        run, {"spark.rapids.tpu.sql.variableFloatAgg.enabled": True},
+        allow_non_tpu=allow)
     cmp = CompareResults(epsilon=1e-4,
                          ignore_ordering=name in _IGNORE_ORDER)
     problems = cmp.compare(cpu, tpu)

@@ -1,0 +1,72 @@
+"""TPCx-BB-like + mortgage-like suite parity tests.
+
+Reference analog: tpcxbb_test.py / mortgage_test.py smoke parity over
+TpcxbbLikeSpark and MortgageSpark (CPU vs accelerated sessions)."""
+
+import pytest
+
+from spark_rapids_tpu.bench import mortgage, tpcxbb
+from spark_rapids_tpu.bench.runner import CompareResults
+from tests.parity import with_cpu_session, with_tpu_session
+
+SF = 0.002
+
+
+@pytest.fixture(scope="module")
+def xbb_data():
+    return tpcxbb.generate(SF, seed=13)
+
+
+@pytest.fixture(scope="module")
+def mort_data():
+    return mortgage.generate(SF, seed=13)
+
+
+def test_tpcxbb_scope_matches_reference():
+    # the reference implements 19 of 30 (UDTF/python/NLP queries throw)
+    assert len(tpcxbb.QUERIES) == 19
+    assert not set(tpcxbb.QUERIES) & tpcxbb.UNSUPPORTED
+
+
+@pytest.mark.parametrize("name", sorted(tpcxbb.QUERIES,
+                                        key=lambda q: int(q[1:])))
+def test_tpcxbb_query_parity(name, xbb_data):
+    def run(session):
+        tables = tpcxbb.setup(session, xbb_data)
+        return tpcxbb.QUERIES[name](tables).collect()
+
+    cpu = with_cpu_session(run)
+    tpu = with_tpu_session(
+        run, {"spark.rapids.tpu.sql.variableFloatAgg.enabled": True})
+    cmp = CompareResults(epsilon=1e-4, ignore_ordering=True)
+    problems = cmp.compare(cpu, tpu)
+    assert not problems, f"{name}: {problems}"
+
+
+def test_tpcxbb_results_nonempty(xbb_data):
+    def run(session):
+        tables = tpcxbb.setup(session, xbb_data)
+        return {n: q(tables).collect().num_rows
+                for n, q in tpcxbb.QUERIES.items()}
+
+    counts = with_cpu_session(run)
+    empty = [n for n, c in counts.items() if c == 0]
+    assert not empty, f"queries with empty results at SF={SF}: {empty}"
+
+
+@pytest.mark.parametrize("piece", ["etl", "simple_aggregates",
+                                   "delinquency_rate"])
+def test_mortgage_parity(piece, mort_data):
+    def run(session):
+        t = mortgage.setup(session, mort_data)
+        if piece == "etl":
+            return mortgage.run(t, session).collect()
+        return getattr(mortgage, piece)(t).collect()
+
+    cpu = with_cpu_session(run)
+    tpu = with_tpu_session(
+        run, {"spark.rapids.tpu.sql.variableFloatAgg.enabled": True})
+    cmp = CompareResults(epsilon=1e-4, ignore_ordering=True)
+    problems = cmp.compare(cpu, tpu)
+    assert not problems, f"{piece}: {problems}"
+    assert cpu.num_rows > 0
