@@ -144,11 +144,17 @@ MAX_READER_BATCH_SIZE_BYTES = conf(
 
 PARQUET_DEVICE_DECODE = conf(
     "spark.rapids.tpu.sql.format.parquet.deviceDecode.enabled", True,
-    "Decode Parquet pages on the TPU: CPU walks footers/page headers and "
-    "run boundaries, device kernels expand RLE/bit-packed runs, definition "
-    "levels, and dictionary gathers in HBM. Columns with unsupported "
+    "Decode parquet pages in HBM (RLE/dictionary/def-level expansion on "
+    "device; reference: GpuParquetScan.scala:1022 Table.readParquet).",
+    bool)
+
+ORC_DEVICE_DECODE = conf(
+    "spark.rapids.tpu.sql.format.orc.deviceDecode.enabled", True,
+    "Decode ORC stripes on the TPU: CPU parses stripe footers and RLEv2 "
+    "run boundaries, device kernels expand runs/PRESENT streams and "
+    "gather string dictionaries in HBM. Columns with unsupported "
     "encodings fall back to host Arrow decode individually. (reference: "
-    "Table.readParquet device decode, GpuParquetScan.scala:1022)", bool)
+    "GpuOrcScan.scala:206 device decode via libcudf)", bool)
 
 PARQUET_READER_TYPE = conf(
     "spark.rapids.tpu.sql.format.parquet.reader.type", "AUTO",

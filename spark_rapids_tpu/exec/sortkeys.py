@@ -28,13 +28,15 @@ import numpy as np
 import jax.numpy as jnp
 
 from spark_rapids_tpu import dtypes as dt
-from spark_rapids_tpu.expr.eval_tpu import ColVal
+from spark_rapids_tpu.expr.eval_tpu import ColVal, f64_bits
 
 _SIGN64 = np.uint64(0x8000000000000000)
 
 
 def _int_key(data: jnp.ndarray) -> jnp.ndarray:
-    u = data.astype(jnp.int64).view(jnp.uint64)
+    # two's-complement wrap (convert, not bitcast: TPU x64 emulation has
+    # no 64-bit bitcast-convert) then sign-bit flip
+    u = data.astype(jnp.int64).astype(jnp.uint64)
     return u ^ _SIGN64
 
 
@@ -46,10 +48,12 @@ def _float_key(data: jnp.ndarray, is32: bool) -> jnp.ndarray:
     if is32:
         bits = x.view(jnp.int32).astype(jnp.int64)
         bits = bits << 32  # keep ordering in the top bits
-    else:
-        bits = x.view(jnp.int64)
-    u = bits.view(jnp.uint64)
-    neg = bits < 0
+        u = bits.astype(jnp.uint64)
+        neg = bits < 0
+        return jnp.where(neg, ~u, u ^ _SIGN64)
+    # float64: arithmetic IEEE bit reconstruction (no 64-bit bitcast)
+    u = f64_bits(x)
+    neg = (u & _SIGN64) != 0
     return jnp.where(neg, ~u, u ^ _SIGN64)
 
 

@@ -89,6 +89,55 @@ def _const(batch: DeviceBatch, value, dtype: dt.DType) -> ColVal:
     return ColVal(dtype, data, jnp.ones((cap,), dtype=jnp.bool_))
 
 
+def f64_bits(x: jnp.ndarray) -> jnp.ndarray:
+    """IEEE-754 bit pattern of a float64 as uint64 (NaN canonicalized to
+    the positive quiet pattern).
+
+    On CPU backends this is one bitcast.  TPU runtimes emulate x64
+    ("X64 rewriting") and reject 64-bit bitcast-convert HLOs, so there
+    the bits are reconstructed arithmetically: frexp gives (m, e) with
+    ax = m * 2^e, m in [0.5, 1); for normals the exponent field is
+    e + 1022 and the mantissa field is m * 2^53 - 2^52 (exact — m has
+    <= 53 significant bits).  Subnormals flush to ±0's pattern — the
+    accelerator flushes subnormal operands throughout, so they cannot
+    survive device arithmetic anyway (documented incompat)."""
+    if jax.default_backend() == "cpu":
+        bits = x.view(jnp.uint64)
+        return jnp.where(jnp.isnan(x),
+                         np.uint64(0x7FF8000000000000), bits)
+    return _f64_bits_arith(x)
+
+
+def _f64_bits_arith(x: jnp.ndarray) -> jnp.ndarray:
+    """Arithmetic-only IEEE reconstruction (exact for normals).
+
+    No frexp/signbit either — both lower to 64-bit bitcasts.  The
+    exponent comes from a greedy power-of-two ladder (exact multiplies),
+    the mantissa from (m - 1) * 2^52 once m is normalized into [1, 2).
+    Callers canonicalize -0.0 and NaN first, so sign is just x < 0."""
+    neg = x < 0.0
+    ax = jnp.abs(x)
+    normal = ax >= np.float64(2.0 ** -1022)
+    m = jnp.where(normal & jnp.isfinite(ax), ax, np.float64(1.0))
+    e = jnp.zeros(x.shape, dtype=jnp.int64)
+    for k in (512, 256, 128, 64, 32, 16, 8, 4, 2, 1):
+        hi = m >= np.float64(2.0 ** k)
+        m = jnp.where(hi, m * np.float64(2.0 ** -k), m)
+        e = e + jnp.where(hi, k, 0)
+        lo = m < np.float64(2.0 ** (1 - k))
+        m = jnp.where(lo, m * np.float64(2.0 ** k), m)
+        e = e - jnp.where(lo, k, 0)
+    frac = ((m - np.float64(1.0))
+            * np.float64(2.0 ** 52)).astype(jnp.uint64)
+    ebits = (e + 1023).astype(jnp.uint64)
+    bits = (ebits << np.uint64(52)) | frac
+    # subnormals flush to 0 on accelerators (documented incompat)
+    bits = jnp.where(normal, bits, jnp.uint64(0))
+    bits = jnp.where(jnp.isinf(ax), np.uint64(0x7FF0000000000000), bits)
+    bits = jnp.where(jnp.isnan(x), np.uint64(0x7FF8000000000000), bits)
+    return jnp.where(neg, bits | (np.uint64(1) << np.uint64(63)), bits)
+
+
 def _binary_null(l: ColVal, r: ColVal):
     return l.validity & r.validity
 
@@ -1072,7 +1121,7 @@ def hash_colval(v: ColVal, seed: jnp.ndarray) -> jnp.ndarray:
     elif d.id == dt.TypeId.FLOAT64:
         x = jnp.where(v.data == 0.0, 0.0, v.data)  # -0.0 -> 0.0
         x = jnp.where(jnp.isnan(x), jnp.float64(np.nan), x)
-        h = _hash_long(x.view(jnp.int64), seed)
+        h = _hash_long(f64_bits(x).astype(jnp.int64), seed)
     elif d.id == dt.TypeId.FLOAT32:
         x = jnp.where(v.data == 0.0, jnp.float32(0.0), v.data)
         x = jnp.where(jnp.isnan(x), jnp.float32(np.nan), x)

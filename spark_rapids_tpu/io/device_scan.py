@@ -60,6 +60,8 @@ def _const_column(dtype: dt.DType, raw: Optional[str], cap: int,
 class TpuParquetScanExec(TpuExec):
     """Device-decoding parquet scan (is_tpu — yields DeviceBatch)."""
 
+    fmt = "parquet"
+
     def __init__(self, scan: FileScan, conf):
         super().__init__()
         self.scan = scan
@@ -96,13 +98,12 @@ class TpuParquetScanExec(TpuExec):
         part_cols = [c for c in wanted if c in self.part_fields]
         file_cols = [c for c in wanted if c not in self.part_fields]
         file_schema = Schema([self._schema.field(c) for c in file_cols])
-        pf = papq.ParquetFile(path)
-        for rg in range(pf.metadata.num_row_groups):
+        fctx = self._open(path)  # one open/footer parse per file
+        for rg in range(self._num_chunks(fctx)):
             with tpu_semaphore():
                 with timed(self.metrics):
-                    batch, fallbacks = devpq.decode_row_group(
-                        path, rg, file_schema, columns=file_cols,
-                        parquet_file=pf)
+                    batch, fallbacks = self._decode_chunk(
+                        fctx, rg, file_schema, file_cols)
                 self.metrics.extra["fallbackColumns"] += len(fallbacks)
                 cap = batch.capacity
                 names = list(batch.names)
@@ -121,10 +122,47 @@ class TpuParquetScanExec(TpuExec):
                 self.metrics.num_output_batches += 1
                 yield out
 
+    def _open(self, path: str):
+        return path, papq.ParquetFile(path)
+
+    def _num_chunks(self, fctx) -> int:
+        return fctx[1].metadata.num_row_groups
+
+    def _decode_chunk(self, fctx, idx: int, file_schema: Schema,
+                      file_cols):
+        path, pf = fctx
+        return devpq.decode_row_group(path, idx, file_schema,
+                                      columns=file_cols,
+                                      parquet_file=pf)
+
     def execute(self) -> List[Iterator[DeviceBatch]]:
         return [self._file_part(i)
                 for i in range(len(self.scan.paths))]
 
     def simple_string(self) -> str:
-        return (f"TpuParquetScanExec(files={len(self.scan.paths)}, "
-                f"deviceDecode)")
+        return (f"{type(self).__name__}"
+                f"(files={len(self.scan.paths)}, deviceDecode)")
+
+
+class TpuOrcScanExec(TpuParquetScanExec):
+    """Device-decoding ORC scan: stripe streams expand in HBM
+    (GpuOrcScan analog, reference: GpuOrcScan.scala:206+).  One batch
+    per stripe; shares the partition-column and fallback machinery."""
+
+    fmt = "orc"
+
+    def _open(self, path: str):
+        from spark_rapids_tpu.io import device_orc as dorc
+        with open(path, "rb") as f:
+            raw = f.read()
+        return path, raw, dorc.read_meta(raw)
+
+    def _num_chunks(self, fctx) -> int:
+        return len(fctx[2].stripes)
+
+    def _decode_chunk(self, fctx, idx: int, file_schema: Schema,
+                      file_cols):
+        from spark_rapids_tpu.io import device_orc as dorc
+        path, raw, _ = fctx
+        return dorc.decode_stripe(path, idx, file_schema,
+                                  columns=file_cols, raw=raw)

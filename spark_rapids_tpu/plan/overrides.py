@@ -437,24 +437,36 @@ _register_exchange_rule()
 
 def _register_file_scan_rule():
     from spark_rapids_tpu.io.readers import CpuFileScanExec
-    from spark_rapids_tpu.io.device_scan import TpuParquetScanExec
+    from spark_rapids_tpu.io.device_scan import (TpuOrcScanExec,
+                                                 TpuParquetScanExec)
 
     def _tag_scan(n, conf) -> List[str]:
         out = []
-        if n.scan.fmt != "parquet":
+        if n.scan.fmt == "parquet":
+            if not conf.get(cfg.PARQUET_DEVICE_DECODE):
+                out.append("parquet device decode disabled by "
+                           f"{cfg.PARQUET_DEVICE_DECODE.key}")
+        elif n.scan.fmt == "orc":
+            if not conf.get(cfg.ORC_DEVICE_DECODE):
+                out.append("orc device decode disabled by "
+                           f"{cfg.ORC_DEVICE_DECODE.key}")
+        else:
             out.append(f"{n.scan.fmt} scans decode on host "
-                       "(device decode is parquet-only)")
-        if not conf.get(cfg.PARQUET_DEVICE_DECODE):
-            out.append("parquet device decode disabled by "
-                       f"{cfg.PARQUET_DEVICE_DECODE.key}")
+                       "(device decode is parquet/orc-only)")
         return out
+
+    def _convert_scan(n, ch, conf):
+        if n.scan.fmt == "orc":
+            return TpuOrcScanExec(n.scan, conf)
+        return TpuParquetScanExec(n.scan, conf)
 
     register_exec_rule(CpuFileScanExec, ExecRule(
         "FileSourceScanExec",
-        "TPU parquet scan: packed pages upload, RLE/dictionary/def-level "
-        "decode in HBM (Table.readParquet analog)",
+        "TPU parquet/ORC scan: packed pages/streams upload, "
+        "RLE/dictionary/def-level decode in HBM (Table.readParquet / "
+        "GpuOrcScan analog)",
         _no_exprs,
-        convert=lambda n, ch, conf: TpuParquetScanExec(n.scan, conf),
+        convert=_convert_scan,
         extra_tag=_tag_scan))
 
 
