@@ -32,7 +32,13 @@ def _enable_compile_cache() -> None:
         return
     try:
         platform = _jax.default_backend()
-        if platform == "cpu":
+        if platform == "cpu" and not _os.environ.get(
+                "SPARK_RAPIDS_TPU_CPU_COMPILE_CACHE"):
+            # CPU stays opt-in: under a REMOTE compilation service,
+            # XLA:CPU AOT results target the server's CPU features and
+            # can SIGILL locally.  The test suite opts in explicitly
+            # (tests/conftest.py) where JAX_PLATFORMS=cpu guarantees a
+            # local compile.
             return
         cache_dir = _os.environ.get(
             "SPARK_RAPIDS_TPU_COMPILE_CACHE",

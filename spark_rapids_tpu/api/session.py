@@ -164,13 +164,9 @@ class TpuSparkSession:
             # barrier: the async pipeline runs dispatch-only end to end
             # (a mid-stream read-back would serialize it — and on
             # remote-device runtimes permanently degrade dispatch)
-            import jax
-            from spark_rapids_tpu.columnar.batch import to_arrow
+            from spark_rapids_tpu.columnar.batch import to_arrow_all
             batches = self._drain_partitions(p.children[0].execute())
-            leaves = [a for b in batches for c in b.columns
-                      for a in (c.data, c.validity)]
-            jax.block_until_ready(leaves)
-            tables = [to_arrow(b) for b in batches]
+            tables = to_arrow_all(batches)
             return concat_tables(tables, p.schema)
         tables = self._drain_partitions(p.execute())
         return concat_tables(tables, result.plan.schema)

@@ -148,6 +148,15 @@ PARQUET_DEVICE_DECODE = conf(
     "device; reference: GpuParquetScan.scala:1022 Table.readParquet).",
     bool)
 
+PARQUET_FUSED_DECODE = conf(
+    "spark.rapids.tpu.sql.format.parquet.fusedDecode.enabled", True,
+    "Decode ALL columns of ALL coalesced row groups in one XLA program "
+    "(the multi-file coalescing reader; reference: "
+    "GpuParquetScan.scala:489 MultiFileParquetPartitionReader packs "
+    "many files into one Table.readParquet call). Falls back to "
+    "per-column decode per row group when off or when "
+    "input_file_name() is used.", bool)
+
 ORC_DEVICE_DECODE = conf(
     "spark.rapids.tpu.sql.format.orc.deviceDecode.enabled", True,
     "Decode ORC stripes on the TPU: CPU parses stripe footers and RLEv2 "

@@ -287,7 +287,7 @@ class TpuAdaptiveJoinReaderExec(TpuExec):
                         out = group[0] if len(group) == 1 \
                             else concat_batches(group)
                     self.metrics.add_rows(out.num_rows)
-                    self.metrics.num_output_batches += 1
+                    self.metrics.add_batches()
                     self.state.release(side, range(spec.start, spec.end))
                     yield out
                 else:
@@ -307,7 +307,7 @@ class TpuAdaptiveJoinReaderExec(TpuExec):
                             out = self._row_slice(first, spec.row_start,
                                                   count)
                     self.metrics.add_rows(out.num_rows)
-                    self.metrics.num_output_batches += 1
+                    self.metrics.add_batches()
                     self.state.release(side, [spec.partition])
                     yield out
                 else:

@@ -70,6 +70,17 @@ class Metrics:
             else:
                 self._rows_pending.append(nr)
 
+    def add_batches(self, n: int = 1) -> None:
+        """Locked batch-count increment: partition iterators run
+        concurrently under the task pool, so a bare ``+=`` loses counts
+        to read-modify-write races."""
+        with self._rows_lock:
+            self.num_output_batches += n
+
+    def add_extra(self, key: str, n: float) -> None:
+        with self._rows_lock:
+            self.extra[key] = self.extra.get(key, 0) + n
+
     @property
     def num_output_rows(self) -> int:
         with self._rows_lock:

@@ -119,7 +119,7 @@ class TpuInMemoryTableScanExec(TpuExec):
                     self.metrics.extra["fallbackColumns"] += \
                         len(fallbacks)
                     self.metrics.add_rows(batch.num_rows)
-                    self.metrics.num_output_batches += 1
+                    self.metrics.add_batches()
                     yield batch
 
         return [part(b) for b in self.relation.blobs]

@@ -12,6 +12,7 @@ import functools
 import math
 from typing import Any, List, Optional, Sequence, Tuple
 
+import numpy as np
 import pyarrow as pa
 
 from spark_rapids_tpu.exec.base import PhysicalPlan
@@ -316,7 +317,18 @@ def _agg_py(fn: ir.AggregateExpression, window: List[Any]):
         return len(non_null) + len(nans)
     if isinstance(fn, ir.Sum):
         vals = non_null + nans
-        return sum(vals) if vals else None
+        if not vals:
+            return None
+        if all(isinstance(v, (int, np.integer)) for v in vals):
+            # numpy-scalar sum() wraps with a RuntimeWarning and its
+            # behavior shifted across numpy versions; Spark's long SUM
+            # wraps silently (Java long add, non-ANSI).  Sum exactly in
+            # Python ints, then wrap to int64 explicitly so the oracle
+            # has pinned overflow semantics.
+            s = sum(int(v) for v in vals)
+            return np.int64(((s + (1 << 63)) & ((1 << 64) - 1))
+                            - (1 << 63))
+        return sum(vals)
     if isinstance(fn, ir.Average):
         # Spark averages in double space (no integral overflow)
         vals = [float(v) for v in non_null] + nans

@@ -175,7 +175,7 @@ class TpuGenerateExec(TpuExec):
                 with timed(self.metrics):
                     out = self._kernels[ekey](b)
                 self.metrics.add_rows(out.num_rows)
-                self.metrics.num_output_batches += 1
+                self.metrics.add_batches()
                 yield out
 
         return [run(it) for it in self.children[0].execute()]

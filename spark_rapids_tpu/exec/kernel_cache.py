@@ -26,6 +26,9 @@ from spark_rapids_tpu.expr import ir
 _MAX_ENTRIES = 1024
 _CACHE: "OrderedDict[Any, Any]" = OrderedDict()
 _LOCK = threading.Lock()
+# objects keyed by identity in _value_sig; pinned so CPython can't hand
+# their address to a different value while a cache key references it
+_ID_PINNED: dict = {}
 
 
 def expr_sig(e) -> Any:
@@ -54,6 +57,14 @@ def _value_sig(v) -> Any:
         return tuple(_value_sig(x) for x in v)
     if isinstance(v, ir.Expression):
         return expr_sig(v)
+    import numpy as _np
+    if isinstance(v, _np.ndarray):
+        # repr() truncates large arrays ('...') so two big IN-lists could
+        # collide; hash the full buffer instead.
+        import hashlib
+        return ("ndarray", str(v.dtype), v.shape,
+                hashlib.sha1(_np.ascontiguousarray(v).tobytes())
+                .hexdigest())
     if hasattr(v, "name") and not callable(v):  # DType-like
         return getattr(v, "name")
     if callable(v):
@@ -63,7 +74,17 @@ def _value_sig(v) -> Any:
     if d is not None:  # WindowFrame / SortOrder-like value objects
         return (type(v).__name__,) + tuple(
             (k, _value_sig(x)) for k, x in sorted(d.items()))
-    return repr(v)
+    # unknown opaque object: content hash when picklable; identity as a
+    # last resort — with the object PINNED so its address can't be
+    # recycled into a different value aliasing this cache key
+    try:
+        import hashlib
+        import pickle
+        return ("pickle", type(v).__name__,
+                hashlib.sha1(pickle.dumps(v)).hexdigest())
+    except Exception:
+        _ID_PINNED.setdefault(id(v), v)
+        return ("id", type(v).__name__, id(v))
 
 
 def exprs_sig(exprs) -> Any:
@@ -89,3 +110,4 @@ def get_kernel(key: Any, builder: Callable[[], Callable],
 
 def clear() -> None:
     _CACHE.clear()
+    _ID_PINNED.clear()

@@ -131,3 +131,123 @@ def group_boundaries(key_groups: List[List[jnp.ndarray]],
         [jnp.ones((1,), dtype=jnp.bool_), sorted_mask[:-1]])
     new_group = new_group | (sorted_mask != prev_mask)
     return new_group
+
+
+# ---------------------------------------------------------------------------
+# Shared standalone sort kernels
+# ---------------------------------------------------------------------------
+#
+# XLA ``sort`` unrolls a ~log^2(n)-stage network on TPU; a single sort
+# compile at SQL batch sizes costs 10-180 s (measured).  Embedding a sort
+# in every exec's fused kernel therefore recompiles that cost per
+# (operator, schema, bucket).  Instead, the sort itself lives in a
+# STANDALONE jitted kernel keyed only on (word count, capacity), shared
+# by every sort/window/exchange/range in the process and reused from the
+# persistent compile cache across processes.  Callers split their work
+# into (encode keys) -> shared sort -> (apply order), each side cheap to
+# compile.
+
+def stack_sort_words(key_groups: List[List[jnp.ndarray]],
+                     row_mask: jnp.ndarray) -> jnp.ndarray:
+    """[m, cap] uint64 word matrix, most-significant first, with the
+    padding key leading so padding rows always sort last."""
+    flat: List[jnp.ndarray] = []
+    for group in key_groups:
+        flat.extend(group)
+    pad_key = (~row_mask).astype(jnp.uint64)
+    return jnp.stack([pad_key] + flat)
+
+
+def _shared_lexsort_impl(wm: jnp.ndarray) -> jnp.ndarray:
+    m = wm.shape[0]
+    # jnp.lexsort: LAST key is primary -> feed least-significant first
+    return jnp.lexsort(tuple(wm[i] for i in range(m - 1, -1, -1)))
+
+
+def shared_lexsort(wm: jnp.ndarray) -> jnp.ndarray:
+    """Stable sort order for a [m, cap] word matrix via the shared
+    per-(m, cap) kernel."""
+    from spark_rapids_tpu.exec import kernel_cache as kc
+    m, cap = int(wm.shape[0]), int(wm.shape[1])
+    fn = kc.get_kernel(("shared_lexsort", m, cap),
+                       lambda: _shared_lexsort_impl)
+    return fn(wm)
+
+
+def _shared_partition_order_impl(targets: jnp.ndarray) -> jnp.ndarray:
+    """Stable order grouping rows by small non-negative target id: one
+    single-operand u64 sort of (target << 32 | row)."""
+    cap = targets.shape[0]
+    iota = jnp.arange(cap, dtype=jnp.uint64)
+    key = (targets.astype(jnp.uint64) << jnp.uint64(32)) | iota
+    skey = jnp.sort(key)
+    return (skey & jnp.uint64(0xFFFFFFFF)).astype(jnp.int32)
+
+
+def shared_partition_order(targets: jnp.ndarray) -> jnp.ndarray:
+    """Stable grouping order for int32 targets in [0, 2^31); shared
+    kernel keyed on capacity only."""
+    from spark_rapids_tpu.exec import kernel_cache as kc
+    cap = int(targets.shape[0])
+    fn = kc.get_kernel(("shared_partition_order", cap),
+                       lambda: _shared_partition_order_impl)
+    return fn(targets)
+
+
+def hash_group_ids(words: List[jnp.ndarray], row_mask: jnp.ndarray):
+    """Dense group ids for equal-key rows WITHOUT sorting: linear-probe
+    hash build with scatter claims (the cudf hash-groupby analog).
+
+    Returns (seg, n_groups): seg[i] in [0, n_groups) for real rows —
+    equal keys share an id — and cap-1 for padding rows (safe: padding
+    implies n_groups < cap).  Ids are dense but hash-ordered."""
+    import jax
+    cap = int(row_mask.shape[0])
+    wm = jnp.stack(words)                      # [W, cap] uint64
+    W = wm.shape[0]
+    h = jnp.full((cap,), 2166136261, dtype=jnp.uint32)
+    for i in range(W):
+        for part in (wm[i].astype(jnp.uint32),
+                     (wm[i] >> jnp.uint64(32)).astype(jnp.uint32)):
+            h = (h ^ part) * jnp.uint32(16777619)
+    # the probe wraparound is a bitmask, so the table size must be a
+    # power of two regardless of the (configurable) batch capacity
+    T = 1
+    while T < 2 * cap:
+        T <<= 1
+    tmask = jnp.int32(T - 1)
+    row_idx = jnp.arange(cap, dtype=jnp.int32)
+    MAXI = jnp.int32(np.iinfo(np.int32).max)
+    slot0 = jnp.where(row_mask, (h & tmask.astype(jnp.uint32))
+                      .astype(jnp.int32), 0)
+    init = (slot0, ~row_mask, jnp.full((T,), -1, dtype=jnp.int32))
+
+    def cond(c):
+        return jnp.any(~c[1])
+
+    def body(c):
+        slot, resolved, owner = c
+        unres = ~resolved
+        own = jnp.take(owner, slot)
+        cand = jnp.where(unres & (own < 0), row_idx, MAXI)
+        claimed = jnp.full((T,), MAXI, dtype=jnp.int32
+                           ).at[slot].min(cand, mode="drop")
+        owner = jnp.where((owner < 0) & (claimed < MAXI), claimed,
+                          owner)
+        own2 = jnp.take(owner, slot)
+        ref = jnp.clip(own2, 0, cap - 1)
+        eq = own2 >= 0
+        for i in range(W):
+            eq = eq & (wm[i] == jnp.take(wm[i], ref))
+        done = (own2 == row_idx) | eq
+        resolved2 = resolved | (unres & done)
+        slot2 = jnp.where(resolved2, slot, (slot + 1) & tmask)
+        return slot2, resolved2, owner
+
+    slot, _, owner = jax.lax.while_loop(cond, body, init)
+    used = owner >= 0
+    dense = jnp.cumsum(used.astype(jnp.int32)) - 1
+    n_groups = jnp.sum(used.astype(jnp.int32))
+    seg = jnp.where(row_mask, jnp.take(dense, slot),
+                    jnp.int32(cap - 1))
+    return seg, n_groups

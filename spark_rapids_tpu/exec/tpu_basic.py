@@ -60,7 +60,7 @@ class HostToDeviceExec(TpuExec):
                     with timed(self.metrics):
                         b = from_arrow(t, self.min_bucket)
                     self.metrics.num_output_rows += t.num_rows
-                    self.metrics.num_output_batches += 1
+                    self.metrics.add_batches()
                     yield b
         return [run(it) for it in self.children[0].execute()]
 
@@ -135,7 +135,7 @@ class TpuProjectExec(TpuExec):
                     # row-offset tracking costs one host sync per batch;
                     # only pay it when a partition-dependent expr exists
                     offset += int(b.num_rows)
-                self.metrics.num_output_batches += 1
+                self.metrics.add_batches()
                 yield out
         return [run(pid, it) for pid, it in
                 enumerate(self.children[0].execute())]
@@ -144,15 +144,29 @@ class TpuProjectExec(TpuExec):
 def compact(batch: DeviceBatch, keep: jnp.ndarray) -> DeviceBatch:
     """Stream compaction: stable-partition kept rows to the front.
 
-    XLA formulation of cudf's boolean-mask ``Table.filter``: one stable
-    argsort of the inverted mask + gathers (sorts lower to an on-chip
-    bitonic/radix network).
-    """
+    XLA formulation of cudf's boolean-mask ``Table.filter``: cumsum the
+    mask for destination slots, then SCATTER kept rows (dropped rows
+    scatter out of bounds).  No sort — XLA sort compiles are minutes-
+    scale on TPU at SQL batch sizes, scatter is milliseconds."""
+    cap = batch.capacity
     keep = keep & batch.row_mask()
     count = jnp.sum(keep.astype(jnp.int32))
-    order = jnp.argsort(~keep, stable=True)
-    valid = jnp.arange(batch.capacity) < count
-    cols = [c.gather(order, valid) for c in batch.columns]
+    dest = jnp.where(keep, jnp.cumsum(keep.astype(jnp.int32)) - 1, cap)
+    cols = []
+    for c in batch.columns:
+        data = jnp.zeros_like(c.data).at[dest].set(
+            c.data, mode="drop")
+        validity = jnp.zeros_like(c.validity).at[dest].set(
+            c.validity & keep, mode="drop")
+        lengths = None
+        ev = None
+        if c.lengths is not None:
+            lengths = jnp.zeros_like(c.lengths).at[dest].set(
+                jnp.where(keep, c.lengths, 0), mode="drop")
+        if c.elem_validity is not None:
+            ev = jnp.zeros_like(c.elem_validity).at[dest].set(
+                c.elem_validity & keep[:, None], mode="drop")
+        cols.append(DeviceColumn(c.dtype, data, validity, lengths, ev))
     return DeviceBatch(batch.names, cols, count)
 
 
@@ -311,11 +325,11 @@ class TpuCoalesceBatchesExec(TpuExec):
                     out = self._emit(pending)
                     pending, pending_bytes = [], 0
                     if out is not None:
-                        self.metrics.num_output_batches += 1
+                        self.metrics.add_batches()
                         yield out
             out = self._emit(pending)
             if out is not None:
-                self.metrics.num_output_batches += 1
+                self.metrics.add_batches()
                 yield out
         if isinstance(self.goal, RequireSingleBatch):
             # single batch across ALL partitions

@@ -87,36 +87,75 @@ def _concat_colvals(a: ColVal, b: ColVal) -> ColVal:
     return normalize_key(merged)
 
 
+def _join_sort_key(build: DeviceBatch, stream: DeviceBatch,
+                   build_keys: Sequence[str],
+                   stream_keys: Sequence[str], seg0=None):
+    """(combined keys, exists, side, hash group ids, packed sort key)
+    for the combined build+stream row space.
+
+    Equal-key adjacency WITHOUT a multi-word lexsort: hash-group the
+    combined keys (scatter build, compile-cheap), then the caller sorts
+    ONE u64 word of (group id, side) — XLA sort compile cost scales with
+    operand count, and at SQL batch sizes a multi-word lexsort compiles
+    for minutes."""
+    cap_b, cap_s = build.capacity, stream.capacity
+    # pad the combined space to a power-of-two capacity so the shared
+    # sort kernel is keyed on a handful of buckets, not on every
+    # (cap_b + cap_s) sum the suite produces
+    cap2 = bucket_rows(cap_b + cap_s)
+    pad = cap2 - (cap_b + cap_s)
+    bk = _key_vals(build, build_keys)
+    sk = _key_vals(stream, stream_keys)
+    combined = [_concat_colvals(b, s) for b, s in zip(bk, sk)]
+    exists = jnp.pad(jnp.concatenate([build.row_mask(),
+                                      stream.row_mask()]), (0, pad))
+    side = jnp.pad(jnp.concatenate([
+        jnp.zeros((cap_b,), dtype=jnp.uint64),
+        jnp.ones((cap_s,), dtype=jnp.uint64)]), (0, pad))
+    if seg0 is None:
+        key_groups = [sortkeys.encode_keys(v, True, True)
+                      for v in combined]
+        words = [jnp.pad(w, (0, pad)) for g in key_groups for w in g]
+        seg0, _ = sortkeys.hash_group_ids(words, exists)
+    packed = (seg0.astype(jnp.uint64) << jnp.uint64(1)) | side
+    packed = jnp.where(exists, packed, jnp.uint64(0xFFFFFFFFFFFFFFFF))
+    null_key = jnp.zeros((cap_b + cap_s,), dtype=jnp.bool_)
+    for v in combined:
+        null_key = null_key | ~v.validity
+    null_key = jnp.pad(null_key, (0, pad))
+    return null_key, exists, side, seg0, packed
+
+
 class _JoinCtx:
     """Combined sorted space over build+stream rows."""
 
     def __init__(self, build: DeviceBatch, stream: DeviceBatch,
-                 build_keys: Sequence[str], stream_keys: Sequence[str]):
+                 build_keys: Sequence[str], stream_keys: Sequence[str],
+                 order=None, seg0=None):
         self.cap_b = build.capacity
         self.cap_s = stream.capacity
-        cap = self.cap_b + self.cap_s
+        null_key, exists, side, seg0, packed = _join_sort_key(
+            build, stream, build_keys, stream_keys, seg0=seg0)
+        cap = int(packed.shape[0])   # bucketed combined capacity
         self.cap = cap
-        bk = _key_vals(build, build_keys)
-        sk = _key_vals(stream, stream_keys)
-        combined = [_concat_colvals(b, s) for b, s in zip(bk, sk)]
-        exists = jnp.concatenate([build.row_mask(), stream.row_mask()])
-        side = jnp.concatenate([
-            jnp.zeros((self.cap_b,), dtype=jnp.uint64),
-            jnp.ones((self.cap_s,), dtype=jnp.uint64)])
 
-        key_groups = [sortkeys.encode_keys(v, True, True) for v in combined]
-        # side as the least-significant tiebreak: build rows lead each group
-        order = sortkeys.lexsort_indices(key_groups + [[side]], exists)
-        new_group = sortkeys.group_boundaries(key_groups, order, exists)
+        # the stable sort of the packed key normally runs OUTSIDE this
+        # (jitted) kernel via sortkeys.shared_lexsort — embedding it
+        # would recompile a minutes-scale XLA sort per join schema
+        if order is None:
+            order = jnp.lexsort((packed,))  # stable
+        seg_sorted_raw = jnp.take(seg0, order)
+        exists_sorted = jnp.take(exists, order)
+        new_group = jnp.concatenate(
+            [jnp.ones((1,), dtype=jnp.bool_),
+             (seg_sorted_raw[1:] != seg_sorted_raw[:-1]) |
+             (exists_sorted[1:] != exists_sorted[:-1])])
         seg = jnp.cumsum(new_group.astype(jnp.int32)) - 1
 
         self.order = order
         self.seg = seg
         sorted_exists = jnp.take(exists, order)
         sorted_side = jnp.take(side, order)
-        null_key = jnp.zeros((cap,), dtype=jnp.bool_)
-        for v in combined:
-            null_key = null_key | ~v.validity
         self.sorted_null_key = jnp.take(null_key, order)
         self.is_build = sorted_exists & (sorted_side == 0)
         self.is_stream = sorted_exists & (sorted_side == 1)
@@ -147,8 +186,10 @@ def _pairs_layout(ctx: _JoinCtx, outer: bool):
     return m_out, incl
 
 
-def _count_kernel(build, stream, build_keys, stream_keys, how):
-    ctx = _JoinCtx(build, stream, build_keys, stream_keys)
+def _count_kernel(build, stream, order, seg0, build_keys, stream_keys,
+                  how):
+    ctx = _JoinCtx(build, stream, build_keys, stream_keys, order=order,
+                   seg0=seg0)
     outer = how in ("left", "right", "full")
     m_out, incl = _pairs_layout(ctx, outer)
     total = incl[-1]
@@ -159,10 +200,12 @@ def _count_kernel(build, stream, build_keys, stream_keys, how):
     return total
 
 
-def _emit_kernel(build, stream, build_keys, stream_keys, how, out_cap,
+def _emit_kernel(build, stream, order, seg0, build_keys, stream_keys,
+                 how, out_cap,
                  build_names, stream_names, build_first_in_output):
     """Pass 2: materialize the joined batch at static capacity out_cap."""
-    ctx = _JoinCtx(build, stream, build_keys, stream_keys)
+    ctx = _JoinCtx(build, stream, build_keys, stream_keys, order=order,
+                   seg0=seg0)
     outer = how in ("left", "right", "full")
     m_out, incl = _pairs_layout(ctx, outer)
     total_pairs = incl[-1]
@@ -185,10 +228,15 @@ def _emit_kernel(build, stream, build_keys, stream_keys, how, out_cap,
     build_valid = valid_pair & has_match
 
     if how == "full":
-        # append unmatched build rows after the pairs
+        # append unmatched build rows after the pairs (rank->row map via
+        # cumsum+scatter, no sort)
         unmatched = ctx.is_build & (jnp.take(ctx.s_count, ctx.seg) == 0)
-        u_order = jnp.argsort(~unmatched, stable=True)
         u_count = jnp.sum(unmatched.astype(jnp.int64))
+        u_dest = jnp.where(
+            unmatched, jnp.cumsum(unmatched.astype(jnp.int32)) - 1,
+            ctx.cap)
+        u_order = jnp.zeros((ctx.cap,), dtype=jnp.int32).at[u_dest].set(
+            jnp.arange(ctx.cap, dtype=jnp.int32), mode="drop")
         tail_idx = jnp.clip(k - total_pairs, 0, ctx.cap - 1)
         in_tail = (k >= total_pairs) & (k < total_pairs + u_count)
         tail_sorted_pos = jnp.take(u_order, tail_idx)
@@ -212,11 +260,13 @@ def _emit_kernel(build, stream, build_keys, stream_keys, how, out_cap,
     return DeviceBatch(names, cols, total_out)
 
 
-def _semi_kernel(build, stream, build_keys, stream_keys, anti: bool):
-    ctx = _JoinCtx(build, stream, build_keys, stream_keys)
+def _semi_kernel(build, stream, order, seg0, build_keys, stream_keys,
+                 anti: bool):
+    ctx = _JoinCtx(build, stream, build_keys, stream_keys, order=order,
+                   seg0=seg0)
     # scatter per-sorted-row match count back to original stream rows
     m_orig = jnp.zeros((ctx.cap,), dtype=jnp.int64).at[ctx.order].set(ctx.m)
-    m_stream = m_orig[ctx.cap_b:]
+    m_stream = m_orig[ctx.cap_b:ctx.cap_b + ctx.cap_s]
     keep = (m_stream == 0) if anti else (m_stream > 0)
     return compact(stream, keep)
 
@@ -262,6 +312,22 @@ class _HashJoinBase(TpuExec):
     def schema(self) -> Schema:
         return self._schema
 
+    def _sort_order(self, build: DeviceBatch, stream: DeviceBatch,
+                    bkeys, skeys) -> jnp.ndarray:
+        """Combined-space sort order via the SHARED per-capacity sort
+        kernel (the expensive compile), fed by a cheap per-schema pack
+        kernel."""
+        from spark_rapids_tpu.exec import kernel_cache as kc
+        pkey = ("join_pack", tuple(bkeys), tuple(skeys),
+                build.schema_key(), stream.schema_key())
+        if pkey not in self._kernels:
+            self._kernels[pkey] = kc.get_kernel(
+                pkey, lambda: lambda b, s: _join_sort_key(
+                    b, s, bkeys, skeys)[3:5])
+        seg0, packed = self._kernels[pkey](build, stream)
+        order = sortkeys.shared_lexsort(jnp.reshape(packed, (1, -1)))
+        return order, seg0
+
     def _join_pair(self, left: DeviceBatch, right: DeviceBatch,
                    build_side: str = "right"):
         """Join two single batches; yields 0 or 1 output batches."""
@@ -280,12 +346,14 @@ class _HashJoinBase(TpuExec):
                    left.schema_key(), right.schema_key())
             if key not in self._kernels:
                 self._kernels[key] = kc.get_kernel(
-                    key, lambda: lambda b, s: _semi_kernel(
-                        b, s, rkeys, lkeys, how == "anti"))
+                    key, lambda: lambda b, s, o, g: _semi_kernel(
+                        b, s, o, g, rkeys, lkeys, how == "anti"))
             with timed(self.metrics):
-                out = self._kernels[key](right, left)
+                order, seg0 = self._sort_order(right, left, rkeys,
+                                               lkeys)
+                out = self._kernels[key](right, left, order, seg0)
             self.metrics.add_rows(out.num_rows)
-            self.metrics.num_output_batches += 1
+            self.metrics.add_batches()
             yield DeviceBatch(self._schema.names, out.columns,
                               out.num_rows)
             return
@@ -307,26 +375,28 @@ class _HashJoinBase(TpuExec):
                 build.schema_key(), stream.schema_key())
         if ckey not in self._kernels:
             self._kernels[ckey] = kc.get_kernel(
-                ckey, lambda: lambda b, s: _count_kernel(
-                    b, s, bkeys, skeys, emit_how))
+                ckey, lambda: lambda b, s, o, g: _count_kernel(
+                    b, s, o, g, bkeys, skeys, emit_how))
         with timed(self.metrics):
-            total = int(self._kernels[ckey](build, stream))
+            order, seg0 = self._sort_order(build, stream, bkeys, skeys)
+            total = int(self._kernels[ckey](build, stream, order,
+                                            seg0))
         out_cap = bucket_rows(total)
         ekey = ("emit", emit_how, out_cap, tuple(bkeys), tuple(skeys),
                 build_first, build.schema_key(), stream.schema_key())
         if ekey not in self._kernels:
             self._kernels[ekey] = kc.get_kernel(
-                ekey, lambda: lambda b, s: _emit_kernel(
-                    b, s, bkeys, skeys, emit_how, out_cap,
+                ekey, lambda: lambda b, s, o, g: _emit_kernel(
+                    b, s, o, g, bkeys, skeys, emit_how, out_cap,
                     build.names, stream.names, build_first))
         with timed(self.metrics):
-            out = self._kernels[ekey](build, stream)
+            out = self._kernels[ekey](build, stream, order, seg0)
         out = DeviceBatch(self._schema.names, out.columns, out.num_rows)
         if self.condition is not None:
             v = eval_tpu.evaluate(self.condition, out)
             out = compact(out, v.data.astype(jnp.bool_) & v.validity)
         self.metrics.add_rows(out.num_rows)
-        self.metrics.num_output_batches += 1
+        self.metrics.add_batches()
         yield out
 
 
@@ -451,7 +521,7 @@ class _NestedLoopBase(TpuExec):
         with timed(self.metrics):
             out = self._kernels[key](left, right)
         self.metrics.add_rows(out.num_rows)
-        self.metrics.num_output_batches += 1
+        self.metrics.add_batches()
         yield out
 
 

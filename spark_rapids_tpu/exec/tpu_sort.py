@@ -49,23 +49,35 @@ class TpuSortExec(TpuExec):
     def children_coalesce_goal(self):
         return [REQUIRE_SINGLE_BATCH]
 
-    def _impl(self, batch: DeviceBatch) -> DeviceBatch:
-        order = sorted_indices(batch, self.orders)
+    def _keys_impl(self, batch: DeviceBatch) -> jnp.ndarray:
+        groups = []
+        for o in self.orders:
+            v = eval_tpu.evaluate(o.expr, batch)
+            groups.append(sortkeys.encode_keys(
+                v, o.ascending, o.nulls_first_resolved))
+        return sortkeys.stack_sort_words(groups, batch.row_mask())
+
+    @staticmethod
+    def _apply_impl(batch: DeviceBatch,
+                    order: jnp.ndarray) -> DeviceBatch:
         valid = jnp.arange(batch.capacity) < batch.num_rows
         cols = [c.gather(order, valid) for c in batch.columns]
         return DeviceBatch(batch.names, cols, batch.num_rows)
 
     def execute(self):
-        if self._kernel is None:
-            import functools
-            import types
-            from spark_rapids_tpu.exec import kernel_cache as kc
-            shim = types.SimpleNamespace(orders=self.orders)
-            self._kernel = kc.get_kernel(
-                ("sort", tuple((kc.expr_sig(o.expr), o.ascending,
-                                o.nulls_first_resolved)
-                               for o in self.orders)),
-                lambda: functools.partial(type(self)._impl, shim))
+        # The sort itself runs in sortkeys.shared_lexsort — a standalone
+        # kernel keyed (words, cap) shared by every sort in the process
+        # (XLA sort compiles are minutes-scale; see sortkeys.py).  Only
+        # the cheap encode/apply kernels are schema-specific.
+        import functools
+        import types
+        from spark_rapids_tpu.exec import kernel_cache as kc
+        shim = types.SimpleNamespace(orders=self.orders)
+        keys_kernel = kc.get_kernel(
+            ("sort_keys", tuple((kc.expr_sig(o.expr), o.ascending,
+                                 o.nulls_first_resolved)
+                                for o in self.orders)),
+            lambda: functools.partial(type(self)._keys_impl, shim))
 
         def run():
             batches: List[DeviceBatch] = []
@@ -75,7 +87,12 @@ class TpuSortExec(TpuExec):
                 return
             whole = concat_batches(batches)
             with timed(self.metrics):
-                out = self._kernel(whole)
+                wm = keys_kernel(whole)
+                order = sortkeys.shared_lexsort(wm)
+                apply_kernel = kc.get_kernel(
+                    ("sort_apply", whole.schema_key()),
+                    lambda: type(self)._apply_impl)
+                out = apply_kernel(whole, order)
             self.metrics.add_rows(out.num_rows)
             yield out
         return [run()]

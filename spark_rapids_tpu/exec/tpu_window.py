@@ -56,7 +56,7 @@ class _WinCtx:
     """Sorted-space context for one (partition, order) spec."""
 
     def __init__(self, batch: DeviceBatch,
-                 part_exprs, order_exprs, order_dirs):
+                 part_exprs, order_exprs, order_dirs, order=None):
         cap = batch.capacity
         self.cap = cap
         row_mask = batch.row_mask()
@@ -67,7 +67,11 @@ class _WinCtx:
         pgroups = [sortkeys.encode_keys(v, True, True) for v in pvals]
         ogroups = [sortkeys.encode_keys(v, asc, nf)
                    for v, (asc, nf) in zip(ovals, order_dirs)]
-        self.order = sortkeys.lexsort_indices(pgroups + ogroups, row_mask)
+        # the sort order is normally computed OUTSIDE this (jitted)
+        # kernel via sortkeys.shared_lexsort — embedding the sort here
+        # would recompile a minutes-scale XLA sort per window spec
+        self.order = order if order is not None else \
+            sortkeys.lexsort_indices(pgroups + ogroups, row_mask)
         new_part = sortkeys.group_boundaries(pgroups, self.order, row_mask)
         new_peer = sortkeys.group_boundaries(pgroups + ogroups, self.order,
                                              row_mask)
@@ -405,19 +409,42 @@ class TpuWindowExec(TpuExec):
     def children_coalesce_goal(self):
         return [REQUIRE_SINGLE_BATCH]
 
-    def _impl(self, batch: DeviceBatch) -> DeviceBatch:
-        # group window exprs sharing a (partition, order) spec per sort pass
+    @staticmethod
+    def _spec_groups(out_names, window_exprs):
+        """Window exprs grouped by shared (partition, order) spec, in a
+        deterministic order."""
         groups = {}
-        for name, we in zip(self.out_names, self.window_exprs):
+        order = []
+        for name, we in zip(out_names, window_exprs):
             sig = (tuple(e.sql() for e in we.partition_exprs),
                    tuple(e.sql() for e in we.order_exprs), we.order_dirs)
-            groups.setdefault(sig, []).append((name, we))
+            if sig not in groups:
+                groups[sig] = []
+                order.append(sig)
+            groups[sig].append((name, we))
+        return [groups[sig] for sig in order]
+
+    def _keys_impl(self, gi: int, batch: DeviceBatch) -> jnp.ndarray:
+        we0 = self._spec_groups(self.out_names, self.window_exprs)[gi][0][1]
+        pvals = [normalize_key(eval_tpu.evaluate(e, batch))
+                 for e in we0.partition_exprs]
+        ovals = [normalize_key(eval_tpu.evaluate(e, batch))
+                 for e in we0.order_exprs]
+        pgroups = [sortkeys.encode_keys(v, True, True) for v in pvals]
+        ogroups = [sortkeys.encode_keys(v, asc, nf)
+                   for v, (asc, nf) in zip(ovals, we0.order_dirs)]
+        return sortkeys.stack_sort_words(pgroups + ogroups,
+                                         batch.row_mask())
+
+    def _impl(self, batch: DeviceBatch, orders) -> DeviceBatch:
+        spec_groups = self._spec_groups(self.out_names,
+                                        self.window_exprs)
         new_cols = {}
         last_order = None
-        for (_, _, dirs), items in groups.items():
+        for gi, items in enumerate(spec_groups):
             we0 = items[0][1]
             ctx = _WinCtx(batch, we0.partition_exprs, we0.order_exprs,
-                          we0.order_dirs)
+                          we0.order_dirs, order=orders[gi])
             last_order = ctx
             for name, we in items:
                 v = _window_value(we, ctx, batch)
@@ -437,17 +464,25 @@ class TpuWindowExec(TpuExec):
                            batch.num_rows)
 
     def execute(self):
-        if self._kernel is None:
-            import functools
-            import types
-            from spark_rapids_tpu.exec import kernel_cache as kc
-            shim = types.SimpleNamespace(window_exprs=self.window_exprs,
-                                         out_names=self.out_names,
-                                         _schema=self._schema)
-            self._kernel = kc.get_kernel(
-                ("window", kc.exprs_sig(self.window_exprs),
-                 tuple(self.out_names)),
-                lambda: functools.partial(type(self)._impl, shim))
+        import functools
+        import types
+        from spark_rapids_tpu.exec import kernel_cache as kc
+        shim = types.SimpleNamespace(window_exprs=self.window_exprs,
+                                     out_names=self.out_names,
+                                     _schema=self._schema,
+                                     _spec_groups=type(self)._spec_groups)
+        cls = type(self)
+        sig = (kc.exprs_sig(self.window_exprs), tuple(self.out_names))
+        n_groups = len(self._spec_groups(self.out_names,
+                                         self.window_exprs))
+        keys_kernels = [
+            kc.get_kernel(("win_keys", sig, gi),
+                          lambda gi=gi: functools.partial(
+                              cls._keys_impl, shim, gi))
+            for gi in range(n_groups)]
+        apply_kernel = kc.get_kernel(
+            ("window_apply", sig),
+            lambda: functools.partial(cls._impl, shim))
 
         def run():
             batches: List[DeviceBatch] = []
@@ -457,7 +492,10 @@ class TpuWindowExec(TpuExec):
                 return
             whole = concat_batches(batches)
             with timed(self.metrics):
-                out = self._kernel(whole)
+                orders = tuple(
+                    sortkeys.shared_lexsort(k(whole))
+                    for k in keys_kernels)
+                out = apply_kernel(whole, orders)
             self.metrics.add_rows(out.num_rows)
             yield out
         return [run()]

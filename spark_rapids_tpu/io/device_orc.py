@@ -588,16 +588,20 @@ def decode_column(kind: int, enc: Tuple[int, int],
 
 def decode_stripe(path: str, stripe: int, schema: Schema,
                   columns: Optional[List[str]] = None,
-                  raw: Optional[bytes] = None
+                  raw: Optional[bytes] = None,
+                  meta: Optional[OrcMeta] = None
                   ) -> Tuple[DeviceBatch, List[str]]:
     """Decode one ORC stripe to a DeviceBatch.
 
     Returns (batch, fallback_columns); fallback columns host-decode via
-    Arrow so one exotic column doesn't knock the stripe off device."""
+    Arrow so one exotic column doesn't knock the stripe off device.
+    Pass ``meta`` (from ``read_meta``) to skip the O(stripes) redundant
+    footer re-parse when decoding many stripes of one file."""
     if raw is None:
         with open(path, "rb") as f:
             raw = f.read()
-    meta = read_meta(raw)
+    if meta is None:
+        meta = read_meta(raw)
     wanted = columns or [f.name for f in schema.fields]
     # flat-schema guard: nested types shift ORC column ids (each subtree
     # claims a contiguous id range) — decoding by field position would

@@ -158,29 +158,25 @@ def nonnull_count(runs: RunTable, packed: bytes, lo_run: int, hi_run: int,
 # Device side: jitted expansion kernels (static shapes per bucket)
 # ---------------------------------------------------------------------------
 
-@partial(jax.jit, static_argnames=("cap",))
-def _expand_runs_packed(runs_mat: jnp.ndarray, packed: jnp.ndarray,
-                        cap: int) -> jnp.ndarray:
-    """Expand from the single packed [rcap, 5] run matrix (one upload):
-    columns are (end, is_rle, value, bit_base, width)."""
-    return _expand_runs(packed, runs_mat[:, 0], runs_mat[:, 1] != 0,
-                        runs_mat[:, 2].astype(jnp.uint32),
-                        runs_mat[:, 3], runs_mat[:, 4], cap=cap)
+def expand_runs_matrix(runs_mat: jnp.ndarray, packed: jnp.ndarray,
+                       cap: int) -> jnp.ndarray:
+    """Expand one hybrid-run stream to a [cap] uint32 vector (device,
+    one pass).  ``runs_mat`` is [rcap, 5] with columns (cumulative end,
+    is_rle, value, bit_base, width); int32 or int64.
 
-
-@partial(jax.jit, static_argnames=("cap",))
-def _expand_runs(packed: jnp.ndarray, run_ends: jnp.ndarray,
-                 run_is_rle: jnp.ndarray, run_value: jnp.ndarray,
-                 run_bit_base: jnp.ndarray, run_w: jnp.ndarray,
-                 cap: int) -> jnp.ndarray:
-    """Expand hybrid runs to a [cap] uint32 vector (device, one pass)."""
-    i = jnp.arange(cap, dtype=jnp.int64)
-    rid = jnp.searchsorted(run_ends, i, side="right")
-    rid = jnp.clip(rid, 0, run_ends.shape[0] - 1)
-    prev_end = jnp.where(rid > 0, jnp.take(run_ends, rid - 1), 0)
+    THE shared implementation of the searchsorted run lookup + 4-byte
+    window gather + shift/mask bit-unpack — used by both the per-column
+    decode (this module) and the fused whole-batch kernel
+    (io/parquet_fused.py), so the tricky bit math exists exactly once.
+    """
+    ends = runs_mat[:, 0]
+    i = jnp.arange(cap, dtype=ends.dtype)
+    rid = jnp.searchsorted(ends, i, side="right")
+    rid = jnp.clip(rid, 0, ends.shape[0] - 1)
+    prev_end = jnp.where(rid > 0, jnp.take(ends, rid - 1), 0)
     local = i - prev_end
-    w = jnp.take(run_w, rid)
-    bitpos = jnp.take(run_bit_base, rid) + local * w
+    w = jnp.take(runs_mat[:, 4], rid)
+    bitpos = jnp.take(runs_mat[:, 3], rid) + local * w
     byte0 = bitpos >> 3
     sh = (bitpos & 7).astype(jnp.uint32)
     nb = packed.shape[0]
@@ -189,8 +185,16 @@ def _expand_runs(packed: jnp.ndarray, run_ends: jnp.ndarray,
     window = g(0) | (g(1) << 8) | (g(2) << 16) | (g(3) << 24)
     mask = ((jnp.uint32(1) << w.astype(jnp.uint32)) - 1)
     unpacked = (window >> sh) & mask
-    return jnp.where(jnp.take(run_is_rle, rid),
-                     jnp.take(run_value, rid), unpacked)
+    return jnp.where(jnp.take(runs_mat[:, 1], rid) != 0,
+                     jnp.take(runs_mat[:, 2], rid).astype(jnp.uint32),
+                     unpacked)
+
+
+@partial(jax.jit, static_argnames=("cap",))
+def _expand_runs_packed(runs_mat: jnp.ndarray, packed: jnp.ndarray,
+                        cap: int) -> jnp.ndarray:
+    """Jitted wrapper over expand_runs_matrix (one upload per stream)."""
+    return expand_runs_matrix(runs_mat, packed, cap)
 
 
 @partial(jax.jit, static_argnames=("cap",))
@@ -283,9 +287,29 @@ def _string_dict_matrix(vals: List[bytes]) -> Tuple[np.ndarray, np.ndarray]:
     return mat, lens
 
 
-def decode_chunk(chunk: pm.ChunkPages, out_dtype: dt.DType,
-                 cap: int) -> DeviceColumn:
-    """Decode one flat column chunk into a DeviceColumn of capacity cap."""
+@dataclass
+class ChunkPlan:
+    """Host-side decode plan for one flat column chunk: run tables,
+    packed bit regions, raw PLAIN bytes and dictionaries — everything
+    the device expansion kernels need, produced by one O(pages+runs)
+    host walk.  Shared by the per-column decode path (decode_chunk) and
+    the fused whole-row-group kernel (io/parquet_fused.py)."""
+    n_rows: int
+    nullable: bool
+    out_dtype: dt.DType
+    mode: str                      # 'dict' | 'dict_str' | 'plain' | 'bool'
+    def_runs: RunTable = None
+    def_packed: bytes = b""
+    val_runs: RunTable = None      # dict indices or bool bits
+    val_packed: bytes = b""
+    plain_np: np.ndarray = None    # PLAIN values (raw, non-null only)
+    dict_np: np.ndarray = None
+    dict_lens: np.ndarray = None
+
+
+def plan_chunk(chunk: pm.ChunkPages, out_dtype: dt.DType) -> ChunkPlan:
+    """Host walk of one chunk's pages -> ChunkPlan (raises
+    UnsupportedChunk for anything the device path doesn't cover)."""
     if chunk.max_rep > 0 or chunk.max_def > 1:
         raise UnsupportedChunk("nested column")
     ptype = chunk.physical_type
@@ -404,10 +428,35 @@ def decode_chunk(chunk: pm.ChunkPages, out_dtype: dt.DType,
     if any_dict and any_plain:
         raise UnsupportedChunk("mixed dict+plain pages")  # rare; fallback
 
+    if any_dict:
+        mode = "dict_str" if out_dtype.is_string else "dict"
+    elif ptype == "BOOLEAN":
+        mode = "bool"
+    else:
+        mode = "plain"
+    plain_np = None
+    if mode == "plain":
+        raw = b"".join(plain_parts)
+        plain_np = np.frombuffer(raw, dtype=_PLAIN_NP[ptype],
+                                 count=n_nonnull_plain)
+    return ChunkPlan(
+        n_rows=n_rows, nullable=nullable, out_dtype=out_dtype, mode=mode,
+        def_runs=def_runs, def_packed=bytes(def_packed),
+        val_runs=idx_runs if any_dict else bool_runs,
+        val_packed=bytes(idx_packed) if any_dict else bytes(bool_packed),
+        plain_np=plain_np, dict_np=dict_np, dict_lens=dict_lens)
+
+
+def decode_chunk(chunk: pm.ChunkPages, out_dtype: dt.DType,
+                 cap: int) -> DeviceColumn:
+    """Decode one flat column chunk into a DeviceColumn of capacity cap."""
+    p = plan_chunk(chunk, out_dtype)
+    n_rows = p.n_rows
+
     # -- device expansion ---------------------------------------------------
     vcap = bucket_rows(max(n_rows, 1))
-    if nullable:
-        dev = _upload_runs(def_runs, bytes(def_packed))
+    if p.nullable:
+        dev = _upload_runs(p.def_runs, p.def_packed)
         levels = _expand_runs_packed(dev["runs_mat"], dev["packed"],
                                      cap=vcap)
     else:
@@ -415,37 +464,34 @@ def decode_chunk(chunk: pm.ChunkPages, out_dtype: dt.DType,
 
     np_t = out_dtype.to_np() if not out_dtype.is_string else None
 
-    if any_dict:
-        dev = _upload_runs(idx_runs, bytes(idx_packed))
+    if p.mode in ("dict", "dict_str"):
+        dev = _upload_runs(p.val_runs, p.val_packed)
         indices = _expand_runs_packed(dev["runs_mat"], dev["packed"],
                                       cap=vcap)
-        if nullable:
+        if p.nullable:
             indices, valid = _def_expand(levels, indices, n_rows, cap=vcap)
         else:
             valid = jnp.arange(vcap) < n_rows
         if out_dtype.is_string:
-            d_mat = jnp.asarray(dict_np)
-            d_len = jnp.asarray(dict_lens)
+            d_mat = jnp.asarray(p.dict_np)
+            d_len = jnp.asarray(p.dict_lens)
             data = _dict_gather(indices, d_mat, valid, cap=vcap)
             lengths = _dict_gather(indices, d_len, valid, cap=vcap)
             return _to_cap(DeviceColumn(out_dtype, data, valid,
                                         lengths.astype(jnp.int32)), cap)
-        d_vals = jnp.asarray(dict_np.astype(np_t, copy=False))
+        d_vals = jnp.asarray(p.dict_np.astype(np_t, copy=False))
         data = _dict_gather(indices, d_vals, valid, cap=vcap)
         return _to_cap(DeviceColumn(out_dtype, data, valid), cap)
 
-    if ptype == "BOOLEAN":
-        dev = _upload_runs(bool_runs, bytes(bool_packed))
+    if p.mode == "bool":
+        dev = _upload_runs(p.val_runs, p.val_packed)
         bits = _expand_runs_packed(dev["runs_mat"], dev["packed"],
                                    cap=vcap)
         vals = bits.astype(jnp.bool_)
     else:
-        raw = b"".join(plain_parts)
-        npvals = np.frombuffer(raw, dtype=_PLAIN_NP[ptype],
-                               count=n_nonnull_plain)
-        vals = jnp.asarray(_pad_np(npvals.copy(), vcap))
+        vals = jnp.asarray(_pad_np(p.plain_np.copy(), vcap))
 
-    if nullable:
+    if p.nullable:
         data, valid = _def_expand(levels, vals, n_rows, cap=vcap)
     else:
         data, valid = vals, jnp.arange(vcap) < n_rows

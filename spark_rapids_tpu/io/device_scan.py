@@ -70,6 +70,10 @@ class TpuParquetScanExec(TpuExec):
         self._schema = scan.schema if not self.columns else Schema(
             [scan.schema.field(c) for c in self.columns])
         self.part_fields = dict(scan.options.get("part_fields") or [])
+        # cleared by the planner when the plan reads input_file_name()
+        # (the reference's coalescing reader bails out the same way:
+        # GpuParquetScan.scala canUseCoalesceFilesReader)
+        self.allow_fused = True
         self.metrics.extra["fallbackColumns"] = 0
         self.metrics.extra["decodeTime"] = 0.0
 
@@ -104,7 +108,8 @@ class TpuParquetScanExec(TpuExec):
                 with timed(self.metrics):
                     batch, fallbacks = self._decode_chunk(
                         fctx, rg, file_schema, file_cols)
-                self.metrics.extra["fallbackColumns"] += len(fallbacks)
+                self.metrics.add_extra("fallbackColumns",
+                                       len(fallbacks))
                 cap = batch.capacity
                 names = list(batch.names)
                 cols = list(batch.columns)
@@ -119,7 +124,7 @@ class TpuParquetScanExec(TpuExec):
                                   [cols[i] for i in order],
                                   batch.num_rows)
                 self.metrics.num_output_rows += int(out.num_rows)
-                self.metrics.num_output_batches += 1
+                self.metrics.add_batches()
                 yield out
 
     def _open(self, path: str):
@@ -136,8 +141,97 @@ class TpuParquetScanExec(TpuExec):
                                       parquet_file=pf)
 
     def execute(self) -> List[Iterator[DeviceBatch]]:
+        if (self.fmt == "parquet" and self.allow_fused and
+                self.conf.get(cfg.PARQUET_FUSED_DECODE)):
+            return self._execute_fused()
         return [self._file_part(i)
                 for i in range(len(self.scan.paths))]
+
+    # -- fused coalescing reader (one XLA program per batch) ---------------
+    def _fused_groups(self):
+        """Greedy grouping of (file, row-group) pairs: same partition
+        values, bounded by reader batchSizeRows/Bytes (the coalescing
+        goal; reference: MultiFileParquetPartitionReader's
+        maxReadBatchSizeRows/Bytes).
+
+        Files open only transiently here (footer metadata) and lazily
+        again inside each group's iterator — a scan over thousands of
+        files must not hold thousands of descriptors for the query."""
+        max_rows = int(self.conf.get(cfg.MAX_READER_BATCH_SIZE_ROWS))
+        max_bytes = int(self.conf.get(cfg.MAX_READER_BATCH_SIZE_BYTES))
+        pv_list = self.scan.options.get("part_values") or []
+        groups = []
+        cur, cur_rows, cur_bytes, cur_pv = [], 0, 0, None
+        for fi, path in enumerate(self.scan.paths):
+            pf = papq.ParquetFile(path)
+            pv = pv_list[fi] if fi < len(pv_list) else {}
+            pv_key = tuple(sorted(pv.items()))
+            md = pf.metadata
+            n_rgs = md.num_row_groups
+            sizes = [(md.row_group(rg).num_rows,
+                      md.row_group(rg).total_byte_size)
+                     for rg in range(n_rgs)]
+            pf.close()
+            for rg in range(n_rgs):
+                rows, nbytes = sizes[rg]
+                if cur and (pv_key != cur_pv or
+                            cur_rows + rows > max_rows or
+                            cur_bytes + nbytes > max_bytes):
+                    groups.append((cur, dict(cur_pv)))
+                    cur, cur_rows, cur_bytes = [], 0, 0
+                cur_pv = pv_key
+                cur.append((path, rg))
+                cur_rows += rows
+                cur_bytes += nbytes
+        if cur:
+            groups.append((cur, dict(cur_pv)))
+        return groups
+
+    def _execute_fused(self) -> List[Iterator[DeviceBatch]]:
+        from spark_rapids_tpu.io.parquet_fused import \
+            decode_row_groups_fused
+
+        wanted = [f.name for f in self._schema.fields]
+        part_cols = [c for c in wanted if c in self.part_fields]
+        file_cols = [c for c in wanted if c not in self.part_fields]
+        file_schema = Schema([self._schema.field(c) for c in file_cols])
+
+        def group_part(path_rgs, pv) -> Iterator[DeviceBatch]:
+            from spark_rapids_tpu.exec.context import set_input_file
+            paths = {p for p, _ in path_rgs}
+            pfs = {p: papq.ParquetFile(p) for p in paths}
+            sources = [(pfs[p], p, rg) for p, rg in path_rgs]
+            try:
+                with tpu_semaphore():
+                    with timed(self.metrics):
+                        batch, fallbacks = decode_row_groups_fused(
+                            sources, file_schema, columns=file_cols)
+                    self.metrics.add_extra("fallbackColumns",
+                                           len(fallbacks))
+                    cap = batch.capacity
+                    names = list(batch.names)
+                    cols = list(batch.columns)
+                    for c in part_cols:
+                        d = self.part_fields[c]
+                        names.append(c)
+                        cols.append(_const_column(
+                            d, pv.get(c), cap, int(batch.num_rows)))
+                    order = [names.index(c) for c in wanted]
+                    out = DeviceBatch([names[i] for i in order],
+                                      [cols[i] for i in order],
+                                      batch.num_rows)
+                    self.metrics.num_output_rows += int(out.num_rows)
+                    self.metrics.add_batches()
+                    set_input_file(paths.pop() if len(paths) == 1
+                                   else "")
+                    yield out
+            finally:
+                set_input_file("")
+                for pf in pfs.values():
+                    pf.close()
+
+        return [group_part(srcs, pv)
+                for srcs, pv in self._fused_groups()]
 
     def simple_string(self) -> str:
         return (f"{type(self).__name__}"
@@ -163,6 +257,6 @@ class TpuOrcScanExec(TpuParquetScanExec):
     def _decode_chunk(self, fctx, idx: int, file_schema: Schema,
                       file_cols):
         from spark_rapids_tpu.io import device_orc as dorc
-        path, raw, _ = fctx
+        path, raw, meta = fctx
         return dorc.decode_stripe(path, idx, file_schema,
-                                  columns=file_cols, raw=raw)
+                                  columns=file_cols, raw=raw, meta=meta)

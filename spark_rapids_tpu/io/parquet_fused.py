@@ -1,0 +1,536 @@
+"""Fused multi-row-group Parquet decode: ONE XLA program per scan batch.
+
+The per-column decode path (io/device_parquet.py) issues ~5 device
+dispatches and ~4 uploads per column per row group — hundreds per query.
+On any runtime that's dispatch overhead; on a tunneled/remote device it
+dominates the whole query (measured: r2's q6 bench spent >90% of wall
+clock on per-op round trips).  This module is the TPU-first answer to
+the reference's one-kernel-per-buffer decode (`Table.readParquet`,
+reference: GpuParquetScan.scala:1022 — one libcudf call decodes every
+column of the assembled buffer):
+
+  * the HOST walks pages for every column of every row group in the
+    batch (O(pages+runs), reusing device_parquet.plan_chunk),
+  * all run tables pack into ONE [streams, rcap, 5] int32 matrix, all
+    bit-packed regions into ONE uint8 buffer, PLAIN values and
+    dictionaries into ONE buffer per wire dtype — ≤8 uploads total,
+  * ONE jitted program expands runs, applies definition levels, gathers
+    dictionaries and stitches row groups, emitting the whole batch.
+
+Every data-dependent number (row counts, buffer offsets, dictionary
+sizes) travels as a traced int32 operand; only power-of-two shape
+buckets are static — so the compile cache hits across files, queries
+and processes.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+import pyarrow as pa
+import pyarrow.parquet as papq
+
+import jax
+import jax.numpy as jnp
+
+from spark_rapids_tpu import dtypes as dt
+from spark_rapids_tpu.columnar.batch import (DeviceBatch, DeviceColumn,
+                                             _bucket_strlen, bucket_rows,
+                                             from_arrow)
+from spark_rapids_tpu.io import parquet_meta as pm
+from spark_rapids_tpu.io.device_parquet import (ChunkPlan, UnsupportedChunk,
+                                                _cast_one, _pad_np,
+                                                plan_chunk)
+from spark_rapids_tpu.plan.logical import Schema
+
+_END_SENTINEL = np.int32(1 << 30)
+
+
+# ---------------------------------------------------------------------------
+# Host assembly
+# ---------------------------------------------------------------------------
+
+@dataclass
+class _SegSpec:
+    """Static decode recipe for one (column, row-group) segment.
+
+    Only bucketed shapes live here (it is part of the kernel cache key);
+    exact offsets/counts are traced operands in the meta vector."""
+    mode: str             # 'dict' | 'dict_str' | 'plain' | 'bool' | 'null'
+    nullable: bool
+    def_stream: int = -1  # index into runs_mat, -1 = none
+    val_stream: int = -1
+    plain_key: str = ""   # wire dtype of the plain buffer
+    dcap: int = 0         # bucketed dictionary rows
+    dlen: int = 0         # bucketed string dict max_len
+    # traced meta slots (positions in the meta vector)
+    m_plain_off: int = -1
+    m_dict_off: int = -1
+    m_dict_size: int = -1
+    m_dlen_off: int = -1
+
+
+@dataclass
+class _FusedPlan:
+    """Everything decode_row_groups_fused assembled on host."""
+    key: Tuple            # kernel cache key (static spec)
+    specs: List[List[_SegSpec]]      # [col][rg]
+    out_dtypes: List[dt.DType]
+    names: List[str]
+    arrays: Dict[str, np.ndarray]    # upload set
+    n_rows: List[int]
+    cap: int
+    vcap: int
+
+
+def _runs_to_rows(runs, packed_off_bits: int, rcap: int) -> np.ndarray:
+    """One stream's RunTable -> [rcap, 5] int32 row block."""
+    r = len(runs.counts)
+    mat = np.full((rcap, 5), 0, dtype=np.int32)
+    ends = np.cumsum(np.asarray(runs.counts, dtype=np.int64))
+    if np.any(ends > (1 << 30)):
+        raise UnsupportedChunk("stream too long for fused decode")
+    mat[:, 0] = _END_SENTINEL
+    mat[:r, 0] = ends.astype(np.int32)
+    mat[:r, 1] = np.asarray(runs.is_rle, dtype=np.int32)
+    mat[:r, 2] = np.asarray(runs.values, dtype=np.int32)
+    bases = np.asarray(runs.bit_bases, dtype=np.int64) + packed_off_bits
+    if np.any(bases + 32 > (np.int64(1) << 31)):
+        raise UnsupportedChunk("packed buffer too long for fused decode")
+    mat[:r, 3] = bases.astype(np.int32)
+    mat[:r, 4] = np.asarray(runs.widths, dtype=np.int32)
+    return mat
+
+
+def assemble(plans: List[List[Optional[ChunkPlan]]],
+             out_dtypes: List[dt.DType], names: List[str],
+             n_rows: List[int]) -> _FusedPlan:
+    """Pack every segment's host structures into the fused upload set.
+
+    plans[col][rg] is a ChunkPlan, or None for a column missing from
+    that file (emitted as all-null rows for that segment)."""
+    K = len(n_rows)
+    streams: List[Tuple[Any, bytes]] = []   # (RunTable, packed)
+    plain_parts: Dict[str, List[np.ndarray]] = {}
+    plain_sizes: Dict[str, int] = {}
+    dict_parts: Dict[str, List[np.ndarray]] = {}
+    dict_sizes: Dict[str, int] = {}
+    meta: List[int] = []
+    specs: List[List[_SegSpec]] = []
+
+    def add_meta(v: int) -> int:
+        meta.append(int(v))
+        return len(meta) - 1
+
+    for ci, col_plans in enumerate(plans):
+        col_specs: List[_SegSpec] = []
+        for r, p in enumerate(col_plans):
+            if p is None:
+                col_specs.append(_SegSpec(mode="null", nullable=True))
+                continue
+            s = _SegSpec(mode=p.mode, nullable=p.nullable)
+            if p.nullable:
+                s.def_stream = len(streams)
+                streams.append((p.def_runs, p.def_packed))
+            if p.mode in ("dict", "dict_str", "bool"):
+                s.val_stream = len(streams)
+                streams.append((p.val_runs, p.val_packed))
+            if p.mode == "plain":
+                key = str(p.plain_np.dtype)
+                s.plain_key = key
+                off = plain_sizes.get(key, 0)
+                s.m_plain_off = add_meta(off)
+                plain_parts.setdefault(key, []).append(p.plain_np)
+                plain_sizes[key] = off + p.plain_np.shape[0]
+            if p.mode == "dict":
+                d = p.dict_np
+                key = str(d.dtype)
+                s.plain_key = key
+                off = dict_sizes.get(key, 0)
+                s.m_dict_off = add_meta(off)
+                s.m_dict_size = add_meta(d.shape[0])
+                s.dcap = bucket_rows(d.shape[0], 8)
+                dict_parts.setdefault(key, []).append(d)
+                dict_sizes[key] = off + d.shape[0]
+            if p.mode == "dict_str":
+                mat, lens = p.dict_np, p.dict_lens
+                s.dlen = _bucket_strlen(mat.shape[1])
+                s.dcap = bucket_rows(mat.shape[0], 8)
+                off = dict_sizes.get("u8str", 0)
+                s.m_dict_off = add_meta(off)
+                s.m_dict_size = add_meta(mat.shape[0])
+                dict_parts.setdefault("u8str", []).append(
+                    mat.reshape(-1).astype(np.uint8))
+                dict_sizes["u8str"] = off + mat.size
+                loff = dict_sizes.get("strlens", 0)
+                s.m_dlen_off = add_meta(loff)
+                dict_parts.setdefault("strlens", []).append(
+                    lens.astype(np.int32))
+                dict_sizes["strlens"] = loff + lens.shape[0]
+                # record the un-bucketed row stride for the flat matrix
+                s.plain_key = str(mat.shape[1])  # exact L (static)
+            col_specs.append(s)
+        specs.append(col_specs)
+
+    rcap = bucket_rows(max((len(rt.counts) for rt, _ in streams),
+                           default=1), 8)
+    S = max(len(streams), 1)
+    runs_mat = np.full((S, rcap, 5), 0, dtype=np.int32)
+    runs_mat[:, :, 0] = _END_SENTINEL
+    packed_chunks: List[bytes] = []
+    packed_off = 0
+    for si, (rt, pk) in enumerate(streams):
+        runs_mat[si] = _runs_to_rows(rt, packed_off * 8, rcap)
+        packed_chunks.append(pk)
+        packed_off += len(pk)
+    packed = b"".join(packed_chunks)
+    bcap = bucket_rows(max(len(packed), 4), 64)
+
+    arrays: Dict[str, np.ndarray] = {
+        "runs": runs_mat,
+        "packed": _pad_np(np.frombuffer(packed, dtype=np.uint8), bcap),
+        "nrows": np.asarray(n_rows, dtype=np.int32),
+        "meta": np.asarray(meta or [0], dtype=np.int32),
+    }
+    vcap = bucket_rows(max(max(n_rows, default=1), 1))
+    for key, parts in plain_parts.items():
+        buf = np.concatenate(parts) if len(parts) > 1 else parts[0]
+        # slack so a dynamic_slice of size vcap never walks off the end
+        arrays["plain_" + key] = _pad_np(
+            buf, bucket_rows(buf.shape[0] + vcap, 64))
+    for key, parts in dict_parts.items():
+        buf = np.concatenate(parts) if len(parts) > 1 else parts[0]
+        pad = max((s.dcap * max(s.dlen, 1)
+                   for row in specs for s in row), default=64)
+        arrays["dict_" + key] = _pad_np(
+            buf, bucket_rows(buf.shape[0] + pad, 64))
+
+    total = sum(n_rows)
+    cap = bucket_rows(max(total, 1))
+    key = ("pq_fused", tuple(names),
+           tuple(d.name for d in out_dtypes), K, rcap, bcap, vcap, cap,
+           tuple((a, arrays[a].shape, str(arrays[a].dtype))
+                 for a in sorted(arrays)),
+           tuple(tuple((s.mode, s.nullable, s.def_stream, s.val_stream,
+                        s.plain_key, s.dcap, s.dlen, s.m_plain_off,
+                        s.m_dict_off, s.m_dict_size, s.m_dlen_off)
+                       for s in row) for row in specs))
+    return _FusedPlan(key=key, specs=specs, out_dtypes=out_dtypes,
+                      names=names, arrays=arrays, n_rows=list(n_rows),
+                      cap=cap, vcap=vcap)
+
+
+# ---------------------------------------------------------------------------
+# Device kernel (traced once per _FusedPlan.key)
+# ---------------------------------------------------------------------------
+
+def _expand_stream(runs_row: jnp.ndarray, packed: jnp.ndarray,
+                   vcap: int) -> jnp.ndarray:
+    """Expand one stream's [rcap, 5] runs to [vcap] uint32 values —
+    delegates to the single shared bit-unpack implementation."""
+    from spark_rapids_tpu.io.device_parquet import expand_runs_matrix
+    return expand_runs_matrix(runs_row, packed, vcap)
+
+
+def _def_apply(levels: Optional[jnp.ndarray], values: jnp.ndarray,
+               n_r: jnp.ndarray, vcap: int
+               ) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """Definition levels -> (per-row values, validity) for one segment."""
+    row = jnp.arange(vcap, dtype=jnp.int32)
+    if levels is None:
+        valid = row < n_r
+        return values, valid
+    valid = (levels == 1) & (row < n_r)
+    vidx = jnp.cumsum(valid.astype(jnp.int32)) - 1
+    vidx = jnp.clip(vidx, 0, values.shape[0] - 1)
+    return jnp.take(values, vidx, axis=0), valid
+
+
+def _make_kernel(plan_key: Tuple, specs, out_dtypes, names, K: int,
+                 rcap: int, vcap: int, cap: int):
+    """Build the fused decode program for one static spec.
+
+    Compile-size discipline: segments (column x row-group) are grouped
+    by (mode, nullable, wire dtype, string stride) and each group is
+    processed with ONE vmapped subgraph — so the HLO scales with the
+    number of distinct segment SHAPES (a handful), not with columns x
+    row groups (which made cold compiles take minutes)."""
+
+    # group segments by identical processing recipe
+    groups: Dict[Tuple, List[Tuple[int, int]]] = {}
+    for ci, col_specs in enumerate(specs):
+        for r, s in enumerate(col_specs):
+            if s.mode == "null":
+                continue
+            sig = (s.mode, s.nullable, s.plain_key, s.dlen)
+            groups.setdefault(sig, []).append((ci, r))
+
+    def kernel(arrays: Dict[str, jnp.ndarray]):
+        runs = arrays["runs"]
+        packed = arrays["packed"]
+        nrows = arrays["nrows"]
+        meta = arrays["meta"]
+        # ONE batched expansion for every stream (def levels, dict
+        # indices, bool bits)
+        expanded = jax.vmap(_expand_stream, in_axes=(0, None, None))(
+            runs, packed, vcap)                      # [S, vcap] uint32
+        cum = jnp.cumsum(nrows)
+        total = cum[-1]
+        out_row = jnp.arange(cap, dtype=jnp.int32)
+        seg_of_row = jnp.searchsorted(cum, out_row, side="right")
+        seg_of_row = jnp.clip(seg_of_row, 0, K - 1)
+        prev = jnp.where(seg_of_row > 0,
+                         jnp.take(cum, seg_of_row - 1), 0)
+        local = out_row - prev
+        flat_idx = seg_of_row * vcap + local
+        row_exists = out_row < total
+
+        # -- pass 1: one vmapped subgraph per group ------------------------
+        # group results: (ci, r) -> (data, valid[, lens])
+        seg_out: Dict[Tuple[int, int], Tuple] = {}
+        for sig, members in groups.items():
+            mode, nullable, pkey, dlen = sig
+            s0 = specs[members[0][0]][members[0][1]]
+            specs_m = [specs[ci][r] for ci, r in members]
+            n_m = nrows[jnp.asarray([r for _, r in members])]
+            if nullable:
+                lv_m = expanded[
+                    jnp.asarray([s.def_stream for s in specs_m])
+                ].astype(jnp.int32)
+            else:
+                lv_m = None
+
+            if mode in ("dict", "dict_str"):
+                idx_m = expanded[
+                    jnp.asarray([s.val_stream for s in specs_m])
+                ].astype(jnp.int32)
+                doff_m = meta[jnp.asarray(
+                    [s.m_dict_off for s in specs_m])]
+                dsize_m = meta[jnp.asarray(
+                    [s.m_dict_size for s in specs_m])]
+                if mode == "dict":
+                    dbuf = arrays["dict_" + pkey]
+
+                    def one_dict(idx, lv, n_r, doff, dsize):
+                        idx, valid = _def_apply(lv, idx, n_r, vcap)
+                        idx = jnp.clip(idx, 0,
+                                       jnp.maximum(dsize - 1, 0))
+                        vals = jnp.take(dbuf, doff + idx)
+                        return jnp.where(valid, vals, 0), valid
+
+                    in_ax = (0, 0 if nullable else None, 0, 0, 0)
+                    data_m, valid_m = jax.vmap(
+                        one_dict, in_axes=in_ax)(idx_m, lv_m, n_m,
+                                                 doff_m, dsize_m)
+                    for (ci, r), d, v in zip(members, data_m, valid_m):
+                        seg_out[(ci, r)] = (d, v)
+                else:
+                    L = int(pkey)
+                    dbuf = arrays["dict_u8str"]
+                    lbuf = arrays["dict_strlens"]
+                    loff_m = meta[jnp.asarray(
+                        [s.m_dlen_off for s in specs_m])]
+
+                    def one_str(idx, lv, n_r, doff, dsize, loff):
+                        idx, valid = _def_apply(lv, idx, n_r, vcap)
+                        idx = jnp.clip(idx, 0,
+                                       jnp.maximum(dsize - 1, 0))
+                        byte_idx = ((doff + idx * L)[:, None] +
+                                    jnp.arange(dlen)[None, :])
+                        in_range = jnp.arange(dlen)[None, :] < L
+                        mat = jnp.take(dbuf,
+                                       jnp.clip(byte_idx, 0,
+                                                dbuf.shape[0] - 1))
+                        mat = jnp.where(valid[:, None] & in_range,
+                                        mat, 0)
+                        lens = jnp.take(lbuf, loff + idx)
+                        return (mat, jnp.where(valid, lens,
+                                               0).astype(jnp.int32),
+                                valid)
+
+                    in_ax = (0, 0 if nullable else None, 0, 0, 0, 0)
+                    mat_m, lens_m, valid_m = jax.vmap(
+                        one_str, in_axes=in_ax)(idx_m, lv_m, n_m,
+                                                doff_m, dsize_m,
+                                                loff_m)
+                    for (ci, r), d, ln, v in zip(members, mat_m,
+                                                 lens_m, valid_m):
+                        seg_out[(ci, r)] = (d, v, ln)
+            elif mode == "bool":
+                bits_m = expanded[
+                    jnp.asarray([s.val_stream for s in specs_m])
+                ].astype(jnp.bool_)
+
+                def one_bool(bits, lv, n_r):
+                    data, valid = _def_apply(lv, bits, n_r, vcap)
+                    return data & valid, valid
+
+                data_m, valid_m = jax.vmap(
+                    one_bool, in_axes=(0, 0 if nullable else None, 0)
+                )(bits_m, lv_m, n_m)
+                for (ci, r), d, v in zip(members, data_m, valid_m):
+                    seg_out[(ci, r)] = (d, v)
+            else:  # plain
+                pbuf = arrays["plain_" + pkey]
+                off_m = meta[jnp.asarray(
+                    [s.m_plain_off for s in specs_m])]
+
+                def one_plain(off, lv, n_r):
+                    vals = jax.lax.dynamic_slice(pbuf, (off,), (vcap,))
+                    data, valid = _def_apply(lv, vals, n_r, vcap)
+                    return jnp.where(valid, data, 0), valid
+
+                data_m, valid_m = jax.vmap(
+                    one_plain, in_axes=(0, 0 if nullable else None, 0)
+                )(off_m, lv_m, n_m)
+                for (ci, r), d, v in zip(members, data_m, valid_m):
+                    seg_out[(ci, r)] = (d, v)
+
+        # -- pass 2: stitch row groups per column --------------------------
+        cols: List[DeviceColumn] = []
+        for ci, col_specs in enumerate(specs):
+            odt = out_dtypes[ci]
+            np_t = odt.to_np() if not odt.is_string else None
+            col_L = max((s.dlen for s in col_specs), default=1) \
+                if odt.is_string else 0
+            seg_data, seg_valid, seg_lens = [], [], []
+            for r, s in enumerate(col_specs):
+                if s.mode == "null":
+                    if odt.is_string:
+                        seg_data.append(jnp.zeros((vcap, col_L),
+                                                  dtype=jnp.uint8))
+                        seg_lens.append(jnp.zeros((vcap,),
+                                                  dtype=jnp.int32))
+                    else:
+                        seg_data.append(jnp.zeros((vcap,), dtype=np_t))
+                    seg_valid.append(jnp.zeros((vcap,),
+                                               dtype=jnp.bool_))
+                    continue
+                out = seg_out[(ci, r)]
+                if odt.is_string:
+                    d = out[0]
+                    if d.shape[1] < col_L:
+                        d = jnp.pad(d, ((0, 0), (0, col_L - d.shape[1])))
+                    seg_data.append(d)
+                    seg_valid.append(out[1])
+                    seg_lens.append(out[2])
+                else:
+                    seg_data.append(out[0].astype(np_t))
+                    seg_valid.append(out[1])
+
+            stacked = jnp.stack(seg_data)          # [K, vcap(, L)]
+            stackedv = jnp.stack(seg_valid)        # [K, vcap]
+            if odt.is_string:
+                data = jnp.take(stacked.reshape(K * vcap, col_L),
+                                flat_idx, axis=0)
+                data = jnp.where(row_exists[:, None], data, 0)
+                lens = jnp.take(jnp.stack(seg_lens).reshape(-1),
+                                flat_idx)
+                lens = jnp.where(row_exists, lens, 0)
+                valid = jnp.take(stackedv.reshape(-1),
+                                 flat_idx) & row_exists
+                cols.append(DeviceColumn(odt, data, valid, lens))
+            else:
+                data = jnp.take(stacked.reshape(K * vcap), flat_idx)
+                data = jnp.where(row_exists, data,
+                                 jnp.zeros((), dtype=np_t))
+                valid = jnp.take(stackedv.reshape(-1),
+                                 flat_idx) & row_exists
+                cols.append(DeviceColumn(odt, data, valid))
+        return tuple(cols), total
+
+    return kernel
+
+
+# ---------------------------------------------------------------------------
+# Public entry
+# ---------------------------------------------------------------------------
+
+def decode_row_groups_fused(sources: Sequence[Tuple[Any, str, int]],
+                            schema: Schema,
+                            columns: Optional[List[str]] = None
+                            ) -> Tuple[DeviceBatch, List[str]]:
+    """Decode several (parquet_file, path, row_group) sources into ONE
+    DeviceBatch with one fused kernel (+ a host-decoded column merge for
+    anything the device path can't cover).
+
+    Returns (batch, fallback_column_names)."""
+    wanted = columns or [f.name for f in schema.fields]
+    out_dtypes = [schema.field(c).dtype for c in wanted]
+    n_rows = [pf.metadata.row_group(rg).num_rows
+              for pf, _, rg in sources]
+
+    plans: List[List[Optional[ChunkPlan]]] = []
+    fallbacks: List[str] = []
+    for c in wanted:
+        f = schema.field(c)
+        col_plans: List[Optional[ChunkPlan]] = []
+        try:
+            for pf, path, rg in sources:
+                md = pf.metadata
+                names_in_file = [md.schema.column(i).path
+                                 for i in range(md.num_columns)]
+                if c not in names_in_file:
+                    col_plans.append(None)
+                    continue
+                chunk = pm.read_chunk_pages(path, rg,
+                                            names_in_file.index(c),
+                                            parquet_file=pf)
+                col_plans.append(plan_chunk(chunk, f.dtype))
+        except Exception:
+            fallbacks.append(c)
+            col_plans = None
+        plans.append(col_plans)
+
+    dev_cols = [c for c, p in zip(wanted, plans) if p is not None]
+    dev_dtypes = [d for d, p in zip(out_dtypes, plans) if p is not None]
+    dev_plans = [p for p in plans if p is not None]
+
+    total = sum(n_rows)
+    cap = bucket_rows(max(total, 1))
+
+    cols_by_name: Dict[str, DeviceColumn] = {}
+    if dev_plans:
+        fp = assemble(dev_plans, dev_dtypes, dev_cols, n_rows)
+        from spark_rapids_tpu.exec import kernel_cache as kc
+        kern = kc.get_kernel(
+            fp.key,
+            lambda: _make_kernel(fp.key, fp.specs, fp.out_dtypes,
+                                 fp.names, len(fp.n_rows),
+                                 fp.arrays["runs"].shape[1], fp.vcap,
+                                 fp.cap))
+        dev_arrays = {k: jnp.asarray(v) for k, v in fp.arrays.items()}
+        out_cols, _ = kern(dev_arrays)
+        for name, col in zip(dev_cols, out_cols):
+            cols_by_name[name] = col
+
+    if fallbacks:
+        tables = []
+        for pf, path, rg in sources:
+            md = pf.metadata
+            names_in_file = [md.schema.column(i).path
+                             for i in range(md.num_columns)]
+            present = [c for c in fallbacks if c in names_in_file]
+            t = pf.read_row_group(rg, columns=present) if present \
+                else pa.table({})
+            arrs = []
+            for c in fallbacks:
+                f = schema.field(c)
+                if c in present:
+                    arrs.append(_cast_one(t.select([c]), f).column(0))
+                else:
+                    arrs.append(pa.nulls(t.num_rows if present
+                                         else md.row_group(rg).num_rows,
+                                         type=f.dtype.to_arrow()))
+            tables.append(pa.Table.from_arrays(
+                arrs, names=list(fallbacks)))
+        merged = pa.concat_tables(tables)
+        fb = from_arrow(merged, capacity=cap)
+        for name, col in zip(fb.names, fb.columns):
+            cols_by_name[name] = col
+
+    out = DeviceBatch(
+        wanted, [cols_by_name[c] for c in wanted], total)
+    return out, fallbacks

@@ -83,10 +83,23 @@ class _Buffer:
         self.host: Optional[_HostPayload] = None
         self.disk_path: Optional[str] = None
         self.size = batch.nbytes()
-        self.meta = (list(batch.names), [c.dtype for c in batch.columns],
-                     int(batch.num_rows))
+        # num_rows may be a traced device scalar (a jitted kernel's
+        # output); int() here would block the whole async pipeline on a
+        # synchronous device->host round trip per registered batch — the
+        # r2 bench's dominant cost.  Defer the read to spill time, when
+        # we download the data anyway.
+        self._meta = (list(batch.names),
+                      [c.dtype for c in batch.columns], batch.num_rows)
         self.lock = threading.Lock()
         self.closed = False
+
+    @property
+    def meta(self):
+        names, dtypes, nr = self._meta
+        if not isinstance(nr, (int, np.integer)):
+            nr = int(nr)
+            self._meta = (names, dtypes, nr)
+        return (names, dtypes, nr)
 
 
 class BufferCatalog:

@@ -594,6 +594,33 @@ def _convert(meta: ExecMeta, conf: RapidsTpuConf) -> PhysicalPlan:
     return node
 
 
+def _plan_uses_input_file(plan: PhysicalPlan) -> bool:
+    """Does any expression anywhere in the plan read input_file_name()?"""
+    from spark_rapids_tpu.expr import ir as _ir
+    found: List[bool] = []
+
+    def walk_expr(e):
+        if isinstance(e, _ir.InputFileName):
+            found.append(True)
+        for c in getattr(e, "children", ()):
+            walk_expr(c)
+
+    def visit(n):
+        for v in vars(n).values():
+            if isinstance(v, _ir.Expression):
+                walk_expr(v)
+            elif isinstance(v, (list, tuple)):
+                for x in v:
+                    if isinstance(x, _ir.Expression):
+                        walk_expr(x)
+                    elif hasattr(x, "expr") and \
+                            isinstance(getattr(x, "expr"), _ir.Expression):
+                        walk_expr(x.expr)  # SortOrder-like wrappers
+
+    plan.foreach(visit)
+    return bool(found)
+
+
 class TpuOverrides:
     """The ColumnarRule analog: apply() rewrites the CPU physical plan."""
 
@@ -604,6 +631,16 @@ class TpuOverrides:
         plan = _convert(meta, conf)
         if plan.is_tpu:
             plan = tpub.DeviceToHostExec(plan)
+        if _plan_uses_input_file(cpu_plan):
+            # fused multi-file batches can't answer input_file_name();
+            # reference: GpuParquetScan falls back from the coalescing
+            # reader to PERFILE under the same condition
+            from spark_rapids_tpu.io.device_scan import TpuParquetScanExec
+
+            def _disable(n):
+                if isinstance(n, TpuParquetScanExec):
+                    n.allow_fused = False
+            plan.foreach(_disable)
         explain = conf.explain
         if explain in ("NOT_ON_TPU", "ALL"):
             lines = meta.explain_lines(all_=(explain == "ALL"))

@@ -180,7 +180,7 @@ class CpuArrowEvalPythonExec(PhysicalPlan):
                         merged = merged.append_column(
                             pa.field(name, col.type, True), col)
                     self.metrics.num_output_rows += merged.num_rows
-                    self.metrics.num_output_batches += 1
+                    self.metrics.add_batches()
                     yield merged
         return [run(it) for it in self.children[0].execute()]
 
@@ -211,7 +211,7 @@ class CpuMapInPandasExec(PhysicalPlan):
                 for t in rebatch:
                     out = _conform(w.run_table(t), self._schema)
                     self.metrics.num_output_rows += out.num_rows
-                    self.metrics.num_output_batches += 1
+                    self.metrics.add_batches()
                     yield out
         return [run(it) for it in self.children[0].execute()]
 
@@ -329,7 +329,7 @@ class CpuFlatMapGroupsInPandasExec(PhysicalPlan):
             if outs:
                 out = pa.concat_tables(outs)
                 self.metrics.num_output_rows += out.num_rows
-                self.metrics.num_output_batches += 1
+                self.metrics.add_batches()
                 yield out
         return [run()]
 
@@ -387,7 +387,7 @@ class CpuFlatMapCoGroupsInPandasExec(PhysicalPlan):
             if outs:
                 out = pa.concat_tables(outs)
                 self.metrics.num_output_rows += out.num_rows
-                self.metrics.num_output_batches += 1
+                self.metrics.add_batches()
                 yield out
         return [run()]
 
@@ -439,7 +439,7 @@ class CpuAggregateInPandasExec(PhysicalPlan):
                 results, type=self.out_field.dtype.to_arrow())
             out = pa.table(cols, schema=_schema_to_arrow(self._schema))
             self.metrics.num_output_rows += out.num_rows
-            self.metrics.num_output_batches += 1
+            self.metrics.add_batches()
             yield out
         return [run()]
 
@@ -486,6 +486,6 @@ class CpuWindowInPandasExec(PhysicalPlan):
                         pa.field(self.out_field.name, col.type, True), col))
             out = pa.concat_tables(outs)
             self.metrics.num_output_rows += out.num_rows
-            self.metrics.num_output_batches += 1
+            self.metrics.add_batches()
             yield out
         return [run()]

@@ -65,6 +65,13 @@ class Partitioning:
     def exprs(self) -> List[ir.Expression]:
         return []
 
+    def cache_sig(self) -> Any:
+        """Kernel-cache signature: everything the compiled target kernel
+        closes over.  Subclasses with extra compile-time state (sort
+        direction, null ordering) must extend this."""
+        from spark_rapids_tpu.exec import kernel_cache as kc
+        return kc.exprs_sig(self.exprs())
+
 
 @dataclass
 class SinglePartitioning(Partitioning):
@@ -91,6 +98,48 @@ class RangePartitioning(Partitioning):
     def exprs(self) -> List[ir.Expression]:
         return [o.expr for o in self.orders]
 
+    def cache_sig(self) -> Any:
+        # ascending / nulls-first are baked into the compiled range-target
+        # kernel (sortkeys.encode_keys) — they must be part of the key or
+        # an ASC kernel gets reused for a DESC order on the same expr.
+        from spark_rapids_tpu.exec import kernel_cache as kc
+        return tuple((kc.expr_sig(o.expr), o.ascending,
+                      o.nulls_first_resolved) for o in self.orders)
+
+
+class _ReleasingIter:
+    """Partition-reader wrapper that fires a release callback exactly once
+    — on exhaustion, on ``close()``, or at garbage collection — so an
+    abandoned (never-iterated) reader still gives up its claim on the
+    exchange's device-resident shards."""
+
+    def __init__(self, gen, release):
+        self._gen = gen
+        self._release = release
+        self._released = False
+
+    def __iter__(self):
+        return self
+
+    def __next__(self):
+        try:
+            return next(self._gen)
+        except BaseException:
+            self._do_release()
+            raise
+
+    def _do_release(self):
+        if not self._released:
+            self._released = True
+            self._release()
+
+    def close(self):
+        self._gen.close()
+        self._do_release()
+
+    def __del__(self):
+        self._do_release()
+
 
 # ---------------------------------------------------------------------------
 # Device-side target computation
@@ -109,16 +158,24 @@ def hash_targets(batch: DeviceBatch, keys: Sequence[ir.Expression],
     return jnp.where(m < 0, m + n_parts, m).astype(jnp.int32)
 
 
-def range_targets(batch: DeviceBatch, orders: Sequence[SortOrder],
-                  n_parts: int) -> jnp.ndarray:
-    """Exact-rank range targets with equal-key group cohesion."""
-    exists = batch.row_mask()
+def range_targets_from_order(batch: DeviceBatch,
+                             orders: Sequence[SortOrder],
+                             order: jnp.ndarray,
+                             n_parts: int) -> jnp.ndarray:
+    """Exact-rank range targets with equal-key group cohesion, with the
+    (expensive, shared-kernel) sort already done; re-derives key groups
+    for boundary detection only."""
     key_groups = []
     for o in orders:
         v = eval_tpu.evaluate(o.expr, batch)
         key_groups.append(sortkeys.encode_keys(
             v, o.ascending, o.nulls_first_resolved))
-    order = sortkeys.lexsort_indices(key_groups, exists)
+    return _range_spans(batch, key_groups, order, n_parts)
+
+
+def _range_spans(batch: DeviceBatch, key_groups, order: jnp.ndarray,
+                 n_parts: int) -> jnp.ndarray:
+    exists = batch.row_mask()
     cap = batch.capacity
     n = batch.num_rows
     # rank r of sorted position -> span r*n_parts//n; group cohesion: every
@@ -376,27 +433,81 @@ class TpuShuffleExchangeExec(TpuExec):
                                                      st)
         if isinstance(p, HashPartitioning):
             return lambda b, st: hash_targets(b, p.keys, p.num_partitions)
-        if isinstance(p, RangePartitioning):
-            return lambda b, st: range_targets(b, p.orders,
-                                               p.num_partitions)
+        # RangePartitioning never reaches here: _compute_targets routes
+        # it through the shared-sort split (keys kernel ->
+        # sortkeys.shared_lexsort -> range_targets_from_order) so the
+        # minutes-scale XLA sort compile is never embedded per-schema
         raise NotImplementedError(type(p).__name__)
+
+    def _compute_targets(self, batch: DeviceBatch,
+                         rows_seen: int) -> jnp.ndarray:
+        """Per-row target partition ids (padding rows -> n_parts), with
+        any sort routed through the SHARED per-capacity kernels
+        (sortkeys.shared_lexsort) instead of recompiling a sort inside
+        every (partitioning, schema) kernel."""
+        from spark_rapids_tpu.exec import kernel_cache as kc
+        p = self.partitioning
+        n_parts = p.num_partitions
+        if isinstance(p, RangePartitioning):
+            rkey = ("exch_rkeys", p.cache_sig(), batch.schema_key())
+            if rkey not in self._kernels:
+                orders = p.orders
+
+                def keys_impl(b):
+                    groups = [sortkeys.encode_keys(
+                        eval_tpu.evaluate(o.expr, b), o.ascending,
+                        o.nulls_first_resolved) for o in orders]
+                    return sortkeys.stack_sort_words(groups,
+                                                     b.row_mask())
+                self._kernels[rkey] = kc.get_kernel(rkey,
+                                                    lambda: keys_impl)
+            wm = self._kernels[rkey](batch)
+            order = sortkeys.shared_lexsort(wm)
+            skey = ("exch_rspan", p.cache_sig(), n_parts,
+                    batch.schema_key())
+            if skey not in self._kernels:
+                orders = p.orders
+
+                def span_impl(b, o):
+                    t = range_targets_from_order(b, orders, o, n_parts)
+                    return jnp.where(b.row_mask(), t,
+                                     jnp.int32(n_parts))
+                self._kernels[skey] = kc.get_kernel(skey,
+                                                    lambda: span_impl)
+            return self._kernels[skey](batch, order)
+        key = ("exch_target", type(p).__name__, n_parts,
+               p.cache_sig(), batch.schema_key())
+        if key not in self._kernels:
+            tf = self._target_fn()
+
+            def adj_targets(b, st):
+                return jnp.where(b.row_mask(), tf(b, st),
+                                 jnp.int32(n_parts))
+            self._kernels[key] = kc.get_kernel(key,
+                                               lambda: adj_targets)
+        return self._kernels[key](
+            batch, jnp.asarray(rows_seen, dtype=jnp.int32))
 
     def _partition_one(self, batch: DeviceBatch, rows_seen: int
                        ) -> Tuple[DeviceBatch, np.ndarray]:
         from spark_rapids_tpu.exec import kernel_cache as kc
         n_parts = self.partitioning.num_partitions
-        key = ("exch_part", type(self.partitioning).__name__, n_parts,
-               kc.exprs_sig(self.partitioning.exprs()),
-               batch.schema_key())
-        if key not in self._kernels:
-            tf = self._target_fn()
-            self._kernels[key] = kc.get_kernel(
-                key,
-                lambda: lambda b, st: partition_batch(b, tf(b, st),
-                                                      n_parts))
+        akey = ("exch_apply", n_parts, batch.schema_key())
+        if akey not in self._kernels:
+            def apply_order(b, t, order):
+                counts = jnp.zeros((n_parts,), dtype=jnp.int32
+                                   ).at[t].add(
+                    (t < n_parts).astype(jnp.int32), mode="drop")
+                exists = b.row_mask()
+                cols = [c.gather(order, jnp.take(exists, order))
+                        for c in b.columns]
+                return DeviceBatch(b.names, cols, b.num_rows), counts
+            self._kernels[akey] = kc.get_kernel(akey,
+                                                lambda: apply_order)
         with timed(self.metrics):
-            reordered, counts = self._kernels[key](
-                batch, jnp.asarray(rows_seen, dtype=jnp.int32))
+            t = self._compute_targets(batch, rows_seen)
+            order = sortkeys.shared_partition_order(t)
+            reordered, counts = self._kernels[akey](batch, t, order)
         return reordered, np.asarray(counts)
 
     def _slice(self, reordered: DeviceBatch, offset: int, count: int
@@ -446,18 +557,9 @@ class TpuShuffleExchangeExec(TpuExec):
             for it in self.children[0].execute():
                 batches.extend(b for b in it if int(b.num_rows))
             if batches:
-                from spark_rapids_tpu.exec import kernel_cache as kc
                 g = concat_batches(batches)
-                tf = self._target_fn()
-                key = ("ici_target", type(self.partitioning).__name__,
-                       self.partitioning.num_partitions,
-                       kc.exprs_sig(self.partitioning.exprs()),
-                       g.schema_key())
-                if key not in self._kernels:
-                    self._kernels[key] = kc.get_kernel(
-                        key, lambda: lambda b: tf(b, jnp.int32(0)))
                 with timed(self.metrics):
-                    targets = self._kernels[key](g)
+                    targets = self._compute_targets(g, 0)
                     dev, mesh = ici.exchange_batch(g, targets,
                                                    self.min_bucket)
                 state["dev"] = dev
@@ -465,41 +567,44 @@ class TpuShuffleExchangeExec(TpuExec):
                 self.metrics.extra["ici_devices"] = state["n_dev"]
             state["done"] = True
 
+        def release():
+            # last reducer out (iterated, closed, OR collected unread)
+            # drops the device-resident shards so a multi-stage query —
+            # including early-exit/limit plans that abandon partition
+            # iterators — doesn't pin every exchange in HBM
+            with lock:
+                state["reads_left"] -= 1
+                if state["reads_left"] == 0:
+                    state["dev"] = None
+
         def reader(pidx: int) -> Iterator[DeviceBatch]:
             materialize()
-            try:
-                if state["dev"] is None:
-                    return
-                b = state["dev"][pidx % state["n_dev"]]
-                if b is None:
-                    return
-                from spark_rapids_tpu.exec import kernel_cache as kc
-                key = ("ici_extract", b.schema_key())
-                if key not in self._kernels:
-                    def extract(batch, pid):
-                        from spark_rapids_tpu.exec.tpu_basic import compact
-                        part = batch.columns[-1].data
-                        return compact(batch, part == pid)
-                    self._kernels[key] = kc.get_kernel(
-                        key, lambda: extract)
-                with timed(self.metrics):
-                    out = self._kernels[key](b, jnp.int32(pidx))
-                if int(out.num_rows) == 0:
-                    return
-                out = DeviceBatch(out.names[:-1], out.columns[:-1],
-                                  out.num_rows)  # drop __part__
-                self.metrics.add_rows(out.num_rows)
-                self.metrics.num_output_batches += 1
-            finally:
-                # last reducer out drops the device-resident shards so a
-                # multi-stage query doesn't pin every exchange in HBM
-                with lock:
-                    state["reads_left"] -= 1
-                    if state["reads_left"] == 0:
-                        state["dev"] = None
+            if state["dev"] is None:
+                return
+            b = state["dev"][pidx % state["n_dev"]]
+            if b is None:
+                return
+            from spark_rapids_tpu.exec import kernel_cache as kc
+            key = ("ici_extract", b.schema_key())
+            if key not in self._kernels:
+                def extract(batch, pid):
+                    from spark_rapids_tpu.exec.tpu_basic import compact
+                    part = batch.columns[-1].data
+                    return compact(batch, part == pid)
+                self._kernels[key] = kc.get_kernel(
+                    key, lambda: extract)
+            with timed(self.metrics):
+                out = self._kernels[key](b, jnp.int32(pidx))
+            if int(out.num_rows) == 0:
+                return
+            out = DeviceBatch(out.names[:-1], out.columns[:-1],
+                              out.num_rows)  # drop __part__
+            self.metrics.add_rows(out.num_rows)
+            self.metrics.add_batches()
             yield out
 
-        return [reader(p) for p in range(n_parts)]
+        return [_ReleasingIter(reader(p), release)
+                for p in range(n_parts)]
 
     def execute(self):
         if self.transport == "ici":
@@ -589,7 +694,7 @@ class TpuShuffleExchangeExec(TpuExec):
                     with timed(self.metrics):
                         b = from_arrow(t, self.min_bucket)
                     self.metrics.num_output_rows += t.num_rows
-                    self.metrics.num_output_batches += 1
+                    self.metrics.add_batches()
                 finally:
                     # last reducer out frees the device-resident blocks
                     # (ShuffleManager.unregisterShuffle analog)
@@ -607,7 +712,7 @@ class TpuShuffleExchangeExec(TpuExec):
                 with timed(self.metrics):
                     b = from_arrow(t, self.min_bucket)
                 self.metrics.num_output_rows += t.num_rows
-                self.metrics.num_output_batches += 1
+                self.metrics.add_batches()
                 yield b
             else:
                 slices = state["dev_slices"][pidx]
@@ -616,7 +721,7 @@ class TpuShuffleExchangeExec(TpuExec):
                 with timed(self.metrics):
                     b = concat_batches(slices)
                 self.metrics.add_rows(b.num_rows)
-                self.metrics.num_output_batches += 1
+                self.metrics.add_batches()
                 yield b
 
         return [reader(p) for p in range(n_parts)]

@@ -132,11 +132,24 @@ def reassemble(names: Sequence[str], stacked_cols: List[DeviceColumn],
             if c.elem_validity is not None else None
         flat_cols.append(DeviceColumn(c.dtype, data, validity, lengths, ev))
     # rows arrive block-strided; compact the `valid` rows to the front so
-    # the result satisfies the DeviceBatch row_mask contract
+    # the result satisfies the DeviceBatch row_mask contract (scatter by
+    # cumsum rank — no sort; XLA sort compiles are minutes-scale)
+    tcap = n_parts * cap
     count = jnp.sum(valid.astype(jnp.int32))
-    order = jnp.argsort(~valid, stable=True)
-    cvalid = jnp.arange(n_parts * cap) < count
-    cols = [c.gather(order, cvalid) for c in flat_cols]
+    dest = jnp.where(valid, jnp.cumsum(valid.astype(jnp.int32)) - 1,
+                     tcap)
+    cols = []
+    for c in flat_cols:
+        data = jnp.zeros_like(c.data).at[dest].set(c.data, mode="drop")
+        validity = jnp.zeros_like(c.validity).at[dest].set(
+            c.validity & valid, mode="drop")
+        lengths = jnp.zeros_like(c.lengths).at[dest].set(
+            jnp.where(valid, c.lengths, 0), mode="drop") \
+            if c.lengths is not None else None
+        ev = jnp.zeros_like(c.elem_validity).at[dest].set(
+            c.elem_validity & valid[:, None], mode="drop") \
+            if c.elem_validity is not None else None
+        cols.append(DeviceColumn(c.dtype, data, validity, lengths, ev))
     return DeviceBatch(names, cols, count)
 
 

@@ -13,6 +13,16 @@ flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (
         flags + " --xla_force_host_platform_device_count=8").strip()
+# persistent compile cache: safe here because JAX_PLATFORMS=cpu compiles
+# locally (no remote AOT service -> no foreign-CPU SIGILL risk), and it
+# cuts repeat suite runs from minutes of XLA recompiles to cache reads.
+# A tests-only directory keeps entries written by non-hermetic processes
+# (whose CPU compiles may route through the remote service and target
+# the SERVER's CPU features) out of this cache.
+os.environ.setdefault("SPARK_RAPIDS_TPU_CPU_COMPILE_CACHE", "1")
+os.environ.setdefault(
+    "SPARK_RAPIDS_TPU_COMPILE_CACHE",
+    os.path.expanduser("~/.cache/spark_rapids_tpu/xla-cpu-tests"))
 
 # the axon sitecustomize force-registers the tunneled TPU backend (with
 # remote compilation) ahead of CPU regardless of JAX_PLATFORMS; override
