@@ -47,7 +47,8 @@ import numpy as np
 import pyarrow as pa
 import pyarrow.parquet as papq
 
-ITERS_LOOP = 64      # fori_loop trips for the headline measurement
+ITERS_LOOP = 8       # fori_loop trips: one program must stay under
+                     # the TPU runtime's per-execution watchdog
 E2E_ITERS = 1        # fresh-process e2e runs (each pays the replay)
 
 

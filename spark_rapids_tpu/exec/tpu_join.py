@@ -443,19 +443,55 @@ class TpuBroadcastHashJoinExec(_BroadcastBuildMixin, _HashJoinBase):
     partitioned (reference: GpuBroadcastHashJoinExec — broadcast host
     batch -> device once per task, then probe per batch)."""
 
-    def __init__(self, *args, build_side: str = "right"):
+    def __init__(self, *args, build_side: str = "right",
+                 transport: str = "local"):
         super().__init__(*args)
         self._init_build(build_side)
+        # 'ici': replicate the build side over the device mesh with one
+        # mesh broadcast so each stream shard joins against its LOCAL
+        # copy (GpuBroadcastExchangeExec analog) instead of depending on
+        # a single in-process batch
+        self.transport = transport
+        self._bcast_map = None
+        import threading
+        self._bcast_lock = threading.Lock()
+
+    def _build_broadcast(self):
+        built = self._build()   # takes _build_lock itself
+        with self._bcast_lock:
+            if self._bcast_map is None:
+                from spark_rapids_tpu.shuffle import ici
+                if built is None:
+                    self._bcast_map = {}
+                else:
+                    self._bcast_map = ici.broadcast_batch(built)
+                    self.metrics.extra["ici_broadcast_devices"] = \
+                        len(self._bcast_map)
+        return self._bcast_map
+
+    def _build_for(self, stream_batch: DeviceBatch):
+        """The build-side copy colocated with this stream batch."""
+        if self.transport != "ici":
+            return self._build()
+        bmap = self._build_broadcast()
+        if not bmap:
+            return None
+        if stream_batch.columns:
+            devs = stream_batch.columns[0].data.devices()
+            for d in devs:
+                if d in bmap:
+                    return bmap[d]
+        return next(iter(bmap.values()))
 
     def execute(self):
         stream_side = 0 if self.build_side == "right" else 1
         sits = self.children[stream_side].execute()
 
         def run(sit):
-            build = self._build()
             for sb in sit:
                 if not int(sb.num_rows):
                     continue
+                build = self._build_for(sb)
                 b = build if build is not None else \
                     _empty_like(self.children[1 - stream_side].schema)
                 if self.build_side == "right":

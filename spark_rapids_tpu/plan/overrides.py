@@ -346,7 +346,8 @@ def _register_join_strategy_rules():
         _join_exprs,
         convert=lambda n, ch, conf: TpuBroadcastHashJoinExec(
             ch[0], ch[1], n.left_keys, n.right_keys, n.how, n.condition,
-            n.schema, build_side=n.build_side),
+            n.schema, build_side=n.build_side,
+            transport=conf.get(cfg.SHUFFLE_TRANSPORT)),
         extra_tag=_tag_join))
 
     register_exec_rule(cpux.CpuBroadcastNestedLoopJoinExec, ExecRule(

@@ -391,3 +391,33 @@ def exchange_batch(batch: DeviceBatch, targets: jnp.ndarray,
                                      lengths, ev))
         dev_batches.append(DeviceBatch(aug.names, cols, int(rows[d])))
     return dev_batches, mesh
+
+
+def broadcast_batch(batch: DeviceBatch) -> dict:
+    """One-to-all replication of a batch over the mesh: ONE
+    fully-replicated ``jax.device_put`` lets XLA broadcast every column
+    over ICI, then each device gets a zero-copy local view.
+
+    The mesh sibling of ``exchange_batch`` (all-to-all) — the
+    ``GpuBroadcastExchangeExec`` analog (reference:
+    GpuBroadcastExchangeExec.scala:238-398, which serializes the build
+    side once and ships it to every executor).  Returns
+    {device: DeviceBatch} with one entry per mesh device."""
+    mesh = get_default_mesh()
+    rep = NamedSharding(mesh, P())
+    rep_batch = jax.device_put(batch, rep)
+    out = {}
+    for d in mesh.devices.flat:
+        def local(a, d=d):
+            if a is None or not hasattr(a, "addressable_shards"):
+                return a
+            for s in a.addressable_shards:
+                if s.device == d:
+                    return s.data
+            return a
+        cols = [DeviceColumn(c.dtype, local(c.data), local(c.validity),
+                             local(c.lengths), local(c.elem_validity))
+                for c in rep_batch.columns]
+        out[d] = DeviceBatch(batch.names, cols,
+                             local(rep_batch.num_rows))
+    return out

@@ -306,3 +306,41 @@ def test_planned_distributed_agg_then_join():
     tpu, _ = _ici_collect(
         q, {"spark.rapids.tpu.sql.autoBroadcastJoinThreshold": -1})
     assert_tables_equal(cpu, tpu, ignore_order=True)
+
+
+def test_planned_broadcast_join_ici():
+    """Broadcast hash join over the mesh: the build side replicates to
+    every device with ONE mesh broadcast (ici.broadcast_batch,
+    GpuBroadcastExchangeExec analog) and each ICI-distributed stream
+    shard joins against its LOCAL copy."""
+    rng = np.random.default_rng(21)
+    n = 500
+    facts = pa.table({
+        "k": pa.array(rng.integers(0, 30, n), type=pa.int64()),
+        "v": pa.array(rng.normal(size=n)),
+    })
+    dims = pa.table({
+        "k": pa.array(np.arange(0, 40, dtype=np.int64)),
+        "tag": pa.array([f"d{i}" for i in range(40)]),
+    })
+
+    def q(s):
+        # distribute the stream side through an ICI exchange, then
+        # broadcast-join the small dim table (under the threshold)
+        f = s.create_dataframe(facts, num_partitions=3)
+        d = s.create_dataframe(dims)
+        g = f.repartition(4, "k")
+        return g.join(d, on="k", how="inner").collect()
+
+    cpu = _cpu_collect(q)
+    tpu, captured = _ici_collect(q)
+    from spark_rapids_tpu.exec.tpu_join import TpuBroadcastHashJoinExec
+    joins = []
+    captured[-1].plan.foreach(
+        lambda x: joins.append(x)
+        if isinstance(x, TpuBroadcastHashJoinExec) else None)
+    assert joins, "no TpuBroadcastHashJoinExec in plan"
+    assert all(j.transport == "ici" for j in joins)
+    assert any(j.metrics.extra.get("ici_broadcast_devices") == 8
+               for j in joins), [j.metrics.extra for j in joins]
+    assert_tables_equal(cpu, tpu, ignore_order=True)
