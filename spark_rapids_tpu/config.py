@@ -148,6 +148,21 @@ PARQUET_DEVICE_DECODE = conf(
     "device; reference: GpuParquetScan.scala:1022 Table.readParquet).",
     bool)
 
+CSV_DEVICE_DECODE = conf(
+    "spark.rapids.tpu.sql.format.csv.deviceDecode.enabled", True,
+    "Decode CSV files in HBM: one byte-tensor kernel scans delimiters "
+    "and parses fields per file (reference: GpuBatchScanExec.scala:465 "
+    "Table.readCSV). Quoted/ragged/exotic files fall back to the host "
+    "Arrow reader.", bool)
+
+PARQUET_DEVICE_ENCODE = conf(
+    "spark.rapids.tpu.sql.format.parquet.deviceEncode.enabled", True,
+    "Encode parquet writes from device batches: per-column null "
+    "compaction on device, one packed download, host page/footer "
+    "assembly (reference: GpuParquetFileFormat.scala:281 "
+    "Table.writeParquetChunked). Unsupported types or partitioned "
+    "writes fall back to the host Arrow writer.", bool)
+
 PARQUET_FUSED_DECODE = conf(
     "spark.rapids.tpu.sql.format.parquet.fusedDecode.enabled", True,
     "Decode ALL columns of ALL coalesced row groups in one XLA program "

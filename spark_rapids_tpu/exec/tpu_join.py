@@ -488,6 +488,14 @@ class TpuBroadcastHashJoinExec(_BroadcastBuildMixin, _HashJoinBase):
         sits = self.children[stream_side].execute()
 
         def run(sit):
+            # materialize (and for ICI, broadcast) the build side BEFORE
+            # pulling any stream batch: stream scans hold the TPU
+            # semaphore across their yield, and the build side's own
+            # scan acquiring it then would deadlock the task pool
+            if self.transport == "ici":
+                self._build_broadcast()
+            else:
+                self._build()
             for sb in sit:
                 if not int(sb.num_rows):
                     continue

@@ -492,8 +492,78 @@ def _cast(e, table):
         for i in range(n):
             if not valid[i]:
                 continue
+            s = c.data[i].strip()
+            # Python float() accepts '_' separators; Spark does not
+            if "_" in s:
+                valid[i] = False
+                continue
             try:
-                out[i] = float(c.data[i].strip())
+                out[i] = float(s)
+            except ValueError:
+                valid[i] = False
+        return CpuVal(tgt, out, valid)
+    if src.is_string and tgt.is_bool:
+        n = len(c.data)
+        out = np.zeros(n, dtype=bool)
+        valid = c.valid.copy()
+        for i in range(n):
+            if not valid[i]:
+                continue
+            s = c.data[i].strip().lower()
+            if s in ("t", "true", "y", "yes", "1"):
+                out[i] = True
+            elif s in ("f", "false", "n", "no", "0"):
+                out[i] = False
+            else:
+                valid[i] = False
+        return CpuVal(tgt, out, valid)
+    if src.is_string and tgt.id == dt.TypeId.DATE32:
+        import datetime as _dtm
+        n = len(c.data)
+        out = np.zeros(n, dtype=np.int32)
+        valid = c.valid.copy()
+        for i in range(n):
+            if not valid[i]:
+                continue
+            s = c.data[i].strip()
+            try:
+                d = _dtm.date.fromisoformat(s)
+                if len(s) != 10:
+                    raise ValueError(s)  # Spark needs zero-padded
+                out[i] = (d - _dtm.date(1970, 1, 1)).days
+            except ValueError:
+                valid[i] = False
+        return CpuVal(tgt, out, valid)
+    if src.is_string and tgt.id == dt.TypeId.TIMESTAMP_US:
+        # the engine's documented (incompat-gated) surface:
+        # 'yyyy-MM-dd[ HH:mm:ss[.f{1,6}]]', UTC only — the oracle
+        # implements EXACTLY that grammar so CPU/TPU agree
+        import datetime as _dtm
+        import re as _re
+        pat = _re.compile(
+            r"(\d{4})-(\d{2})-(\d{2})"
+            r"(?:[ T](\d{2}):(\d{2}):(\d{2})(?:\.(\d{1,6}))?)?")
+        n = len(c.data)
+        out = np.zeros(n, dtype=np.int64)
+        valid = c.valid.copy()
+        epoch = _dtm.datetime(1970, 1, 1, tzinfo=_dtm.timezone.utc)
+        us_td = _dtm.timedelta(microseconds=1)
+        for i in range(n):
+            if not valid[i]:
+                continue
+            s = c.data[i].strip()
+            mo = pat.fullmatch(s)
+            if not mo:
+                valid[i] = False
+                continue
+            try:
+                frac = (mo.group(7) or "").ljust(6, "0")
+                ts = _dtm.datetime(
+                    int(mo.group(1)), int(mo.group(2)),
+                    int(mo.group(3)), int(mo.group(4) or 0),
+                    int(mo.group(5) or 0), int(mo.group(6) or 0),
+                    int(frac or 0), tzinfo=_dtm.timezone.utc)
+                out[i] = (ts - epoch) // us_td
             except ValueError:
                 valid[i] = False
         return CpuVal(tgt, out, valid)
@@ -534,7 +604,11 @@ def _spark_str(x, src: dt.DType) -> str:
     if src.id == dt.TypeId.DATE32:
         return str(np.datetime64(int(x), "D"))
     if src.id == dt.TypeId.TIMESTAMP_US:
-        return str(np.datetime64(int(x), "us"))
+        # Spark: space separator, fraction trimmed of trailing zeros
+        s = str(np.datetime64(int(x), "us")).replace("T", " ")
+        if "." in s:
+            s = s.rstrip("0").rstrip(".")
+        return s
     return str(x)
 
 

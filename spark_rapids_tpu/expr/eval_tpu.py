@@ -590,9 +590,25 @@ def _eval_cast(e, batch):
         return _const(batch, None, tgt)
     if src.is_string and tgt.is_integral:
         return _cast_string_to_int(c, tgt)
+    if src.is_string and tgt.is_floating:
+        return _cast_string_to_float(c, tgt)
+    if src.is_string and tgt.is_bool:
+        return _cast_string_to_bool(c)
+    if src.is_string and tgt.id == dt.TypeId.DATE32:
+        return _cast_string_to_date(c)
+    if src.is_string and tgt.id == dt.TypeId.TIMESTAMP_US:
+        return _cast_string_to_timestamp(c)
     if src.is_string:
         raise NotImplementedError(f"cast string->{tgt.name} on TPU")
     if tgt.is_string:
+        if src.is_bool:
+            return _cast_bool_to_string(c)
+        if src.is_integral:
+            return _cast_int_to_string(c)
+        if src.id == dt.TypeId.DATE32:
+            return _cast_date_to_string(c)
+        if src.id == dt.TypeId.TIMESTAMP_US:
+            return _cast_timestamp_to_string(c)
         raise NotImplementedError(f"cast {src.name}->string on TPU")
     if src.id == dt.TypeId.DATE32 and tgt.id == dt.TypeId.TIMESTAMP_US:
         return ColVal(tgt, c.data.astype(jnp.int64) * _US_PER_DAY, c.validity)
@@ -655,6 +671,366 @@ def _cast_string_to_int(c: ColVal, tgt: dt.DType) -> ColVal:
     return ColVal(tgt, acc.astype(tgt.to_np()), c.validity & ok)
 
 
+def _trimmed(c: ColVal):
+    """(start, end) of the whitespace-trimmed span per row."""
+    data, lengths = c.data, c.lengths
+    w = data.shape[1]
+    idx = jnp.arange(w)[None, :]
+    in_str = idx < lengths[:, None]
+    non_space = in_str & (data != ord(" "))
+    any_ns = jnp.any(non_space, axis=1)
+    first_ns = jnp.argmax(non_space, axis=1)
+    last_ns = (w - 1) - jnp.argmax(non_space[:, ::-1], axis=1)
+    start = jnp.where(any_ns, first_ns, 0).astype(jnp.int32)
+    end = jnp.where(any_ns, last_ns + 1, 0).astype(jnp.int32)
+    return start, end
+
+
+def _cast_string_to_float(c: ColVal, tgt: dt.DType) -> ColVal:
+    """[+-]digits[.digits][eE[+-]digits], plus Infinity/NaN keywords
+    (GpuCast.scala castStringToFloats analog; invalid -> null)."""
+    data = c.data
+    w = data.shape[1]
+    idx = jnp.arange(w)[None, :]
+    t_start, t_end = _trimmed(c)
+    first = jnp.take_along_axis(
+        data, jnp.clip(t_start, 0, w - 1)[:, None], axis=1)[:, 0]
+    neg = first == ord("-")
+    signed = neg | (first == ord("+"))
+    start = t_start + signed.astype(jnp.int32)
+
+    def _kw(word: bytes, s):
+        m = len(word)
+        okk = (t_end - s) == m
+        for j, byte in enumerate(word):
+            p = jnp.clip(s + j, 0, w - 1)
+            got = jnp.take_along_axis(data, p[:, None], axis=1)[:, 0]
+            lo = got | 0x20  # case-insensitive ASCII
+            okk = okk & (lo == (byte | 0x20))
+        return okk
+    is_inf = _kw(b"infinity", start) | _kw(b"inf", start)
+    is_nan = _kw(b"nan", t_start)
+
+    digit = data.astype(jnp.int64) - ord("0")
+    is_digit = (digit >= 0) & (digit <= 9)
+    is_dot = data == ord(".")
+    is_e = (data == ord("e")) | (data == ord("E"))
+    in_tok = (idx >= start[:, None]) & (idx < t_end[:, None])
+    e_pos = jnp.min(jnp.where(is_e & in_tok, idx,
+                              jnp.int32(w)), axis=1)
+    mant_end = jnp.minimum(t_end, e_pos)
+    in_mant = in_tok & (idx < mant_end[:, None])
+    dot_pos = jnp.min(jnp.where(is_dot & in_mant, idx,
+                                jnp.int32(w)), axis=1)
+    # exponent part: optional sign then digits
+    es = e_pos + 1
+    efirst = jnp.take_along_axis(
+        data, jnp.clip(es, 0, w - 1)[:, None], axis=1)[:, 0]
+    eneg = efirst == ord("-")
+    es = es + ((efirst == ord("-")) | (efirst == ord("+"))
+               ).astype(jnp.int32)
+    in_exp = (idx >= es[:, None]) & (idx < t_end[:, None])
+
+    legal = ~in_mant | is_digit | is_dot
+    legal_e = ~in_exp | is_digit
+    one_dot = jnp.sum((is_dot & in_mant).astype(jnp.int32), axis=1) <= 1
+    has_digit = jnp.any(is_digit & in_mant, axis=1)
+    has_exp = e_pos < jnp.int32(w)
+    exp_digits = jnp.any(is_digit & in_exp, axis=1)
+    ok = (jnp.all(legal, axis=1) & jnp.all(legal_e, axis=1) & one_dot &
+          has_digit & (t_end > start) &
+          (~has_exp | (exp_digits & (e_pos < t_end))))
+
+    mant = jnp.zeros((data.shape[0],), dtype=jnp.float64)
+    frac_n = jnp.zeros((data.shape[0],), dtype=jnp.int32)
+    exp_v = jnp.zeros((data.shape[0],), dtype=jnp.int32)
+    for j in range(w):
+        d = digit[:, j]
+        tk = is_digit[:, j] & in_mant[:, j]
+        mant = jnp.where(tk, mant * 10 + d.astype(jnp.float64), mant)
+        frac_n = frac_n + (tk & (j > dot_pos)).astype(jnp.int32)
+        te = is_digit[:, j] & in_exp[:, j]
+        exp_v = jnp.where(te, jnp.minimum(exp_v * 10 + d, 99999)
+                          .astype(jnp.int32), exp_v)
+    exp_v = jnp.where(eneg & has_exp, -exp_v, exp_v)
+    p10 = (exp_v - frac_n).astype(jnp.float64)
+    v = mant * jnp.power(jnp.float64(10.0), p10)
+    v = jnp.where(is_inf, jnp.inf, v)
+    v = jnp.where(neg, -v, v)
+    v = jnp.where(is_nan, jnp.nan, v)
+    ok = ok | is_inf | is_nan
+    v = jnp.where(ok, v, 0.0)
+    return ColVal(tgt, v.astype(tgt.to_np()), c.validity & ok)
+
+
+def _cast_string_to_bool(c: ColVal) -> ColVal:
+    """Spark StringUtils: t/true/y/yes/1 and f/false/n/no/0."""
+    t_start, t_end = _trimmed(c)
+    data = c.data
+    w = data.shape[1]
+
+    def word(wd: bytes):
+        okk = (t_end - t_start) == len(wd)
+        for j, byte in enumerate(wd):
+            p = jnp.clip(t_start + j, 0, w - 1)
+            got = jnp.take_along_axis(data, p[:, None], axis=1)[:, 0]
+            okk = okk & ((got | 0x20) == (byte | 0x20))
+        return okk
+    is_t = word(b"t") | word(b"true") | word(b"y") | word(b"yes") | \
+        word(b"1")
+    is_f = word(b"f") | word(b"false") | word(b"n") | word(b"no") | \
+        word(b"0")
+    return ColVal(dt.BOOL, is_t, c.validity & (is_t | is_f))
+
+
+def _parse_ymd(c: ColVal):
+    """'yyyy-MM-dd' (4-2-2 fixed layout) -> (y, m, d, ok, end_pos)."""
+    data = c.data
+    w = data.shape[1]
+    t_start, t_end = _trimmed(c)
+
+    def at(off):
+        p = jnp.clip(t_start + off, 0, w - 1)
+        return jnp.take_along_axis(data, p[:, None], axis=1)[:, 0]
+
+    def num(offs):
+        v = jnp.zeros((data.shape[0],), dtype=jnp.int32)
+        okk = jnp.ones((data.shape[0],), dtype=jnp.bool_)
+        for o in offs:
+            b = at(o).astype(jnp.int32) - ord("0")
+            okk = okk & (b >= 0) & (b <= 9)
+            v = v * 10 + b
+        return v, okk
+    y, ok_y = num((0, 1, 2, 3))
+    m, ok_m = num((5, 6))
+    d, ok_d = num((8, 9))
+    ok = (ok_y & ok_m & ok_d & (at(4) == ord("-")) &
+          (at(7) == ord("-")) & ((t_end - t_start) >= 10) &
+          (m >= 1) & (m <= 12) & (d >= 1) & (d <= 31))
+    # calendar-exact day check (Feb 30, Apr 31, non-leap Feb 29, ...):
+    # round-trip through the civil-days conversion and compare
+    days = _days_from_civil(y, m, d)
+    y2, m2, d2 = _civil_from_days(days)
+    ok = ok & (y2 == y) & (m2 == m) & (d2 == d)
+    return y, m, d, ok, t_start + 10, t_end
+
+
+def _days_from_civil(y, m, d):
+    """Hinnant's civil-days algorithm, pure vector int math."""
+    y = y.astype(jnp.int64)
+    m = m.astype(jnp.int64)
+    d = d.astype(jnp.int64)
+    y = y - (m <= 2)
+    era = jnp.where(y >= 0, y, y - 399) // 400
+    yoe = y - era * 400
+    mp = (m + 9) % 12
+    doy = (153 * mp + 2) // 5 + d - 1
+    doe = yoe * 365 + yoe // 4 - yoe // 100 + doy
+    return era * 146097 + doe - 719468
+
+
+def _cast_string_to_date(c: ColVal) -> ColVal:
+    y, m, d, ok, end10, t_end = _parse_ymd(c)
+    ok = ok & (t_end == end10)    # exact 'yyyy-MM-dd'
+    days = _days_from_civil(y, m, d)
+    days = jnp.where(ok, days, 0)
+    return ColVal(dt.DATE32, days.astype(jnp.int32), c.validity & ok)
+
+
+def _cast_string_to_timestamp(c: ColVal) -> ColVal:
+    """'yyyy-MM-dd[ HH:mm:ss[.f{1..6}]]' in UTC (the incompat UTC-only
+    surface gated by castStringToTimestamp.enabled)."""
+    data = c.data
+    w = data.shape[1]
+    y, m, d, ok, pos10, t_end = _parse_ymd(c)
+
+    def at(off):
+        p = jnp.clip(off, 0, w - 1)
+        return jnp.take_along_axis(data, p[:, None], axis=1)[:, 0]
+
+    def num2(off):
+        v = jnp.zeros((data.shape[0],), dtype=jnp.int32)
+        okk = jnp.ones((data.shape[0],), dtype=jnp.bool_)
+        for k in (0, 1):
+            b = at(off + k).astype(jnp.int32) - ord("0")
+            okk = okk & (b >= 0) & (b <= 9)
+            v = v * 10 + b
+        return v, okk
+    has_time = t_end > pos10
+    sep_ok = (at(pos10) == ord(" ")) | (at(pos10) == ord("T"))
+    hh, ok_h = num2(pos10 + 1)
+    mm, ok_mi = num2(pos10 + 4)
+    ss, ok_s = num2(pos10 + 7)
+    colon_ok = (at(pos10 + 3) == ord(":")) & (at(pos10 + 6) == ord(":"))
+    time_ok = sep_ok & ok_h & ok_mi & ok_s & colon_ok & \
+        (hh < 24) & (mm < 60) & (ss < 60) & ((t_end - pos10) >= 9)
+    # optional .fraction (1-6 digits)
+    dot_ok = at(pos10 + 9) == ord(".")
+    micros = jnp.zeros((data.shape[0],), dtype=jnp.int64)
+    fdigits = jnp.zeros((data.shape[0],), dtype=jnp.int32)
+    for k in range(6):
+        p = pos10 + 10 + k
+        b = at(p).astype(jnp.int64) - ord("0")
+        tk = (p < t_end) & (b >= 0) & (b <= 9)
+        micros = jnp.where(tk, micros * 10 + b, micros)
+        fdigits = fdigits + tk.astype(jnp.int32)
+    has_frac = has_time & (t_end > (pos10 + 9))
+    frac_ok = ~has_frac | (dot_ok & (fdigits ==
+                                     (t_end - pos10 - 10)) &
+                           (fdigits >= 1) & (fdigits <= 6))
+    micros = micros * jnp.power(jnp.int64(10),
+                                (6 - fdigits).astype(jnp.int64))
+    hh = jnp.where(has_time, hh, 0)
+    mm = jnp.where(has_time, mm, 0)
+    ss = jnp.where(has_time, ss, 0)
+    micros = jnp.where(has_time, micros, 0)
+    ok = ok & (~has_time | (time_ok & frac_ok))
+    days = _days_from_civil(y, m, d)
+    us = (days * 86400 + hh.astype(jnp.int64) * 3600 +
+          mm.astype(jnp.int64) * 60 + ss.astype(jnp.int64)
+          ) * 1000000 + micros
+    us = jnp.where(ok, us, 0)
+    return ColVal(dt.TIMESTAMP_US, us, c.validity & ok)
+
+
+def _digits_matrix(v: jnp.ndarray, width: int):
+    """abs(v) -> right-aligned digit matrix [n, width] + digit count."""
+    u = jnp.abs(v.astype(jnp.int64)).astype(jnp.uint64)
+    # int64 min: abs overflows; uint64 space handles it
+    u = jnp.where(v == jnp.iinfo(jnp.int64).min,
+                  jnp.uint64(9223372036854775808), u)
+    digs = []
+    x = u
+    for _ in range(width):
+        digs.append((x % 10).astype(jnp.uint8) + ord("0"))
+        x = x // 10
+    mat = jnp.stack(digs[::-1], axis=1)        # [n, width], right-aligned
+    nz = mat != ord("0")
+    first = jnp.argmax(nz, axis=1)
+    any_nz = jnp.any(nz, axis=1)
+    ndig = jnp.where(any_nz, width - first, 1).astype(jnp.int32)
+    return mat, ndig
+
+
+def _left_align(mat, start, out_w):
+    """Gather columns starting at per-row offset into [n, out_w]."""
+    idx = jnp.clip(start[:, None] + jnp.arange(out_w)[None, :], 0,
+                   mat.shape[1] - 1)
+    return jnp.take_along_axis(mat, idx, axis=1)
+
+
+def _cast_int_to_string(c: ColVal) -> ColVal:
+    v = c.data.astype(jnp.int64)
+    mat, ndig = _digits_matrix(v, 19)   # int64 abs max has 19 digits
+    neg = v < 0
+    out_w = 20
+    body = _left_align(mat, (mat.shape[1] - ndig), out_w - 1)
+    data = jnp.concatenate(
+        [jnp.full((v.shape[0], 1), ord("-"), jnp.uint8), body], axis=1)
+    # shift right rows that are not negative (drop the '-')
+    nonneg_view = jnp.concatenate(
+        [body, jnp.zeros((v.shape[0], 1), jnp.uint8)], axis=1)
+    data = jnp.where(neg[:, None], data, nonneg_view)
+    lens = ndig + neg.astype(jnp.int32)
+    keep = jnp.arange(out_w)[None, :] < lens[:, None]
+    data = jnp.where(keep & c.validity[:, None], data, 0)
+    return ColVal(dt.STRING, data,
+                  c.validity, jnp.where(c.validity, lens, 0))
+
+
+def _cast_bool_to_string(c: ColVal) -> ColVal:
+    n = c.data.shape[0]
+    t = jnp.asarray(np.frombuffer(b"true\0", np.uint8))
+    f = jnp.asarray(np.frombuffer(b"false", np.uint8))
+    data = jnp.where(c.data.astype(bool)[:, None],
+                     jnp.broadcast_to(t, (n, 5)),
+                     jnp.broadcast_to(f, (n, 5)))
+    lens = jnp.where(c.data.astype(bool), 4, 5).astype(jnp.int32)
+    keep = jnp.arange(5)[None, :] < lens[:, None]
+    data = jnp.where(keep & c.validity[:, None], data, 0)
+    return ColVal(dt.STRING, data, c.validity,
+                  jnp.where(c.validity, lens, 0))
+
+
+def _civil_from_days(z):
+    """days since epoch -> (y, m, d); Hinnant's civil_from_days."""
+    z = z.astype(jnp.int64) + 719468
+    era = jnp.where(z >= 0, z, z - 146096) // 146097
+    doe = z - era * 146097
+    yoe = (doe - doe // 1460 + doe // 36524 - doe // 146096) // 365
+    y = yoe + era * 400
+    doy = doe - (365 * yoe + yoe // 4 - yoe // 100)
+    mp = (5 * doy + 2) // 153
+    d = doy - (153 * mp + 2) // 5 + 1
+    m = jnp.where(mp < 10, mp + 3, mp - 9)
+    y = y + (m <= 2)
+    return y, m, d
+
+
+def _two(v):
+    v = v.astype(jnp.int64)
+    return jnp.stack([(v // 10 % 10).astype(jnp.uint8) + ord("0"),
+                      (v % 10).astype(jnp.uint8) + ord("0")], axis=1)
+
+
+def _four(v):
+    v = v.astype(jnp.int64)
+    return jnp.stack([(v // 1000 % 10).astype(jnp.uint8) + ord("0"),
+                      (v // 100 % 10).astype(jnp.uint8) + ord("0"),
+                      (v // 10 % 10).astype(jnp.uint8) + ord("0"),
+                      (v % 10).astype(jnp.uint8) + ord("0")], axis=1)
+
+
+def _cast_date_to_string(c: ColVal) -> ColVal:
+    y, m, d = _civil_from_days(c.data)
+    n = c.data.shape[0]
+    dash = jnp.full((n, 1), ord("-"), jnp.uint8)
+    data = jnp.concatenate([_four(y), dash, _two(m), dash, _two(d)],
+                           axis=1)
+    lens = jnp.full((n,), 10, jnp.int32)
+    data = jnp.where(c.validity[:, None], data, 0)
+    return ColVal(dt.STRING, data, c.validity,
+                  jnp.where(c.validity, lens, 0))
+
+
+def _cast_timestamp_to_string(c: ColVal) -> ColVal:
+    """'yyyy-MM-dd HH:mm:ss[.ffffff]' with trailing fraction zeros
+    trimmed (Spark timestamp formatting, UTC)."""
+    us = c.data.astype(jnp.int64)
+    days = jnp.where(us >= 0, us // 86400000000,
+                     -((-us + 86399999999) // 86400000000))
+    rem = us - days * 86400000000
+    y, m, d = _civil_from_days(days)
+    hh = rem // 3600000000
+    mm = rem // 60000000 % 60
+    ss = rem // 1000000 % 60
+    frac = (rem % 1000000).astype(jnp.int64)
+    n = us.shape[0]
+    dash = jnp.full((n, 1), ord("-"), jnp.uint8)
+    sp = jnp.full((n, 1), ord(" "), jnp.uint8)
+    col = jnp.full((n, 1), ord(":"), jnp.uint8)
+    dot = jnp.full((n, 1), ord("."), jnp.uint8)
+    fd = []
+    x = frac
+    for _ in range(6):
+        fd.append((x % 10).astype(jnp.uint8) + ord("0"))
+        x = x // 10
+    fmat = jnp.stack(fd[::-1], axis=1)
+    data = jnp.concatenate([_four(y), dash, _two(m), dash, _two(d), sp,
+                            _two(hh), col, _two(mm), col, _two(ss),
+                            dot, fmat], axis=1)
+    # trim trailing zeros of the fraction; no fraction -> no dot
+    nz = fmat != ord("0")
+    any_nz = jnp.any(nz, axis=1)
+    last_nz = 5 - jnp.argmax(nz[:, ::-1], axis=1)
+    flen = jnp.where(any_nz, last_nz + 1, 0).astype(jnp.int32)
+    lens = 19 + jnp.where(flen > 0, flen + 1, 0)
+    keep = jnp.arange(data.shape[1])[None, :] < lens[:, None]
+    data = jnp.where(keep & c.validity[:, None], data, 0)
+    return ColVal(dt.STRING, data, c.validity,
+                  jnp.where(c.validity, lens, 0))
+
+
 # ---------------------------------------------------------------------------
 # strings (byte-matrix kernels; ASCII case ops like cudf's default path)
 # ---------------------------------------------------------------------------
@@ -713,32 +1089,55 @@ def _needle_bytes(e_right) -> bytes:
     return e_right.value.encode("utf-8")
 
 
+def _match_at(l: ColVal, r: ColVal, offs: jnp.ndarray) -> jnp.ndarray:
+    """[n] bool: needle column r matches l starting at per-row offset
+    offs (clipped); caller guards length feasibility."""
+    wl, wr = l.data.shape[1], r.data.shape[1]
+    ok = jnp.ones((l.data.shape[0],), dtype=jnp.bool_)
+    for j in range(wr):
+        in_needle = j < r.lengths
+        p = jnp.clip(offs + j, 0, wl - 1)
+        got = jnp.take_along_axis(l.data, p[:, None], axis=1)[:, 0]
+        ok = ok & (~in_needle | (got == r.data[:, j]))
+    return ok
+
+
 def _eval_startswith(e, batch):
     l = evaluate(e.left, batch)
-    needle = _needle_bytes(e.right)
-    m = len(needle)
-    w = l.data.shape[1]
-    ok = l.lengths >= m
-    for j, byte in enumerate(needle):
-        if j < w:
-            ok = ok & (l.data[:, j] == byte)
-        else:
-            ok = jnp.zeros_like(ok)
-    return ColVal(dt.BOOL, ok, l.validity)
+    if isinstance(e.right, ir.Literal) and e.right.value is not None:
+        needle = _needle_bytes(e.right)
+        m = len(needle)
+        w = l.data.shape[1]
+        ok = l.lengths >= m
+        for j, byte in enumerate(needle):
+            if j < w:
+                ok = ok & (l.data[:, j] == byte)
+            else:
+                ok = jnp.zeros_like(ok)
+        return ColVal(dt.BOOL, ok, l.validity)
+    r = evaluate(e.right, batch)     # column needle
+    ok = (l.lengths >= r.lengths) & _match_at(
+        l, r, jnp.zeros_like(l.lengths))
+    return ColVal(dt.BOOL, ok, l.validity & r.validity)
 
 
 def _eval_endswith(e, batch):
     l = evaluate(e.left, batch)
-    needle = _needle_bytes(e.right)
-    m = len(needle)
-    w = l.data.shape[1]
-    ok = l.lengths >= m
-    for j, byte in enumerate(needle):
-        # position from the end: lengths - m + j
-        p = jnp.clip(l.lengths - m + j, 0, w - 1)
-        got = jnp.take_along_axis(l.data, p[:, None], axis=1)[:, 0]
-        ok = ok & (got == byte)
-    return ColVal(dt.BOOL, ok, l.validity)
+    if isinstance(e.right, ir.Literal) and e.right.value is not None:
+        needle = _needle_bytes(e.right)
+        m = len(needle)
+        w = l.data.shape[1]
+        ok = l.lengths >= m
+        for j, byte in enumerate(needle):
+            # position from the end: lengths - m + j
+            p = jnp.clip(l.lengths - m + j, 0, w - 1)
+            got = jnp.take_along_axis(l.data, p[:, None], axis=1)[:, 0]
+            ok = ok & (got == byte)
+        return ColVal(dt.BOOL, ok, l.validity)
+    r = evaluate(e.right, batch)
+    ok = (l.lengths >= r.lengths) & _match_at(
+        l, r, l.lengths - r.lengths)
+    return ColVal(dt.BOOL, ok, l.validity & r.validity)
 
 
 def _contains_mask(l: ColVal, needle: bytes) -> jnp.ndarray:
@@ -759,40 +1158,87 @@ def _contains_mask(l: ColVal, needle: bytes) -> jnp.ndarray:
 
 def _eval_contains(e, batch):
     l = evaluate(e.left, batch)
-    return ColVal(dt.BOOL, _contains_mask(l, _needle_bytes(e.right)),
-                  l.validity)
+    if isinstance(e.right, ir.Literal) and e.right.value is not None:
+        return ColVal(dt.BOOL, _contains_mask(l, _needle_bytes(e.right)),
+                      l.validity)
+    r = evaluate(e.right, batch)     # column needle: fori over offsets
+    wl = l.data.shape[1]
+
+    def body(s, acc):
+        feasible = (s + r.lengths <= l.lengths)
+        return acc | (feasible & _match_at(
+            l, r, jnp.full_like(l.lengths, 1) * s))
+    ok = jax.lax.fori_loop(0, wl, body,
+                           r.lengths == 0)
+    ok = ok & (r.lengths <= l.lengths)
+    return ColVal(dt.BOOL, ok, l.validity & r.validity)
+
+
+def _seg_match_positions(l: ColVal, seg: bytes) -> jnp.ndarray:
+    """[n, w] bool: the segment (with '_' single-char wildcards) matches
+    starting at byte position p and fits inside the string."""
+    m = len(seg)
+    w = l.data.shape[1]
+    n = l.data.shape[0]
+    if m > w:
+        return jnp.zeros((n, w), dtype=jnp.bool_)
+    span = w - m + 1
+    match = jnp.ones((n, span), dtype=jnp.bool_)
+    for j, byte in enumerate(seg):
+        if byte == ord("_"):
+            continue
+        match = match & (l.data[:, j:j + span] == byte)
+    starts = jnp.arange(span)[None, :]
+    match = match & (starts + m <= l.lengths[:, None])
+    return jnp.pad(match, ((0, 0), (0, w - span)))
 
 
 def _eval_like(e, batch):
+    """Full SQL LIKE: literal pattern with '%' multi-char and '_'
+    single-char wildcards (GpuLike analog, reference:
+    stringFunctions.scala:506), evaluated as a greedy leftmost
+    segment-placement scan over the byte matrix."""
     l = evaluate(e.left, batch)
-    pat = _needle_bytes(e.right).decode("utf-8")
-    # supported shapes: exact, 'x%', '%x', '%x%' (no '_', no inner %)
-    if "_" in pat:
-        raise NotImplementedError("LIKE with _ on TPU")
-    core = pat.strip("%")
-    if "%" in core:
-        raise NotImplementedError("LIKE with inner % on TPU")
-    needle = core.encode("utf-8")
-    if pat.startswith("%") and pat.endswith("%") and len(pat) >= 2:
-        ok = _contains_mask(l, needle)
-    elif pat.endswith("%"):
-        m = len(needle)
-        ok = l.lengths >= m
-        for j, byte in enumerate(needle):
-            if j < l.data.shape[1]:
-                ok = ok & (l.data[:, j] == byte)
-            else:
-                ok = jnp.zeros_like(ok)
-    elif pat.startswith("%"):
-        m = len(needle)
-        ok = l.lengths >= m
-        for j, byte in enumerate(needle):
-            p = jnp.clip(l.lengths - m + j, 0, l.data.shape[1] - 1)
-            got = jnp.take_along_axis(l.data, p[:, None], axis=1)[:, 0]
-            ok = ok & (got == byte)
-    else:
-        lit = _const(batch, pat, dt.STRING)
-        ok = _string_eq(l, lit)
+    pat = _needle_bytes(e.right)
+    w = l.data.shape[1]
+    n = l.data.shape[0]
+    segs = pat.split(b"%")
+    lead = not pat.startswith(b"%")
+    trail = not pat.endswith(b"%")
+    nonempty = [(k, s) for k, s in enumerate(segs) if s]
+    if not nonempty:
+        # '', '%', '%%', ...: empty pattern matches only empty string
+        ok = jnp.ones((n,), jnp.bool_) if b"%" in pat \
+            else (l.lengths == 0)
+        return ColVal(dt.BOOL, ok, l.validity)
+
+    ok = jnp.ones((n,), dtype=jnp.bool_)
+    pos = jnp.zeros((n,), dtype=jnp.int32)
+    for i, (k, seg) in enumerate(nonempty):
+        m = len(seg)
+        is_first = k == 0 and lead
+        is_last = (k == len(segs) - 1) and trail
+        mp = _seg_match_positions(l, seg)
+        if is_first and is_last and len(nonempty) == 1:
+            ok = ok & mp[:, 0] & (l.lengths == m) if m <= w \
+                else jnp.zeros_like(ok)
+            break
+        if is_first:
+            ok = ok & (mp[:, 0] if m <= w else jnp.zeros_like(ok))
+            pos = jnp.full((n,), m, dtype=jnp.int32)
+            continue
+        if is_last:
+            p = l.lengths - m
+            got = jnp.take_along_axis(
+                mp, jnp.clip(p, 0, w - 1)[:, None], axis=1)[:, 0]
+            ok = ok & got & (p >= pos)
+            continue
+        # middle (or leading-%%) segment: leftmost occurrence >= pos
+        cand = mp & (jnp.arange(w)[None, :] >= pos[:, None])
+        found = jnp.any(cand, axis=1)
+        first = jnp.argmax(cand, axis=1).astype(jnp.int32)
+        ok = ok & found
+        pos = first + m
     return ColVal(dt.BOOL, ok, l.validity)
 
 
@@ -1370,3 +1816,190 @@ _DISPATCH = {
 
 def supported_on_tpu(cls) -> bool:
     return cls in _DISPATCH
+
+
+# ---------------------------------------------------------------------------
+# md5 (reference: HashFunctions.scala GpuMd5 via cudf; here the full MD5
+# block function vectorized over rows, fori-looped over 64-byte blocks)
+# ---------------------------------------------------------------------------
+
+_MD5_S = np.array(
+    [7, 12, 17, 22] * 4 + [5, 9, 14, 20] * 4 + [4, 11, 16, 23] * 4 +
+    [6, 10, 15, 21] * 4, dtype=np.int32)
+_MD5_K = np.array([int(abs(np.sin(i + 1)) * (1 << 32)) & 0xFFFFFFFF
+                   for i in range(64)], dtype=np.uint32)
+_MD5_G = np.array(
+    [i for i in range(16)] +
+    [(5 * i + 1) % 16 for i in range(16)] +
+    [(3 * i + 5) % 16 for i in range(16)] +
+    [(7 * i) % 16 for i in range(16)], dtype=np.int32)
+
+
+def _eval_md5(e, batch):
+    c = evaluate(e.child, batch)
+    if not c.dtype.is_string:
+        raise NotImplementedError("md5 over non-string on TPU")
+    data, lengths = c.data, c.lengths.astype(jnp.int64)
+    n, w = data.shape
+    # padded message: data + 0x80 + zeros + 8-byte little-endian bitlen
+    n_blocks = (w + 9 + 63) // 64
+    total = n_blocks * 64
+    idx = jnp.arange(total)[None, :]
+    msg = jnp.zeros((n, total), dtype=jnp.uint32)
+    msg = msg.at[:, :w].set(
+        jnp.where(jnp.arange(w)[None, :] < lengths[:, None],
+                  data.astype(jnp.uint32), 0))
+    msg = jnp.where(idx == lengths[:, None], jnp.uint32(0x80), msg)
+    # per-row block count: message fits in ceil((len+9)/64) blocks
+    row_blocks = (lengths + 9 + 63) // 64
+    bitlen = (lengths * 8).astype(jnp.uint64)
+    lenpos = row_blocks * 64 - 8
+    for k in range(8):
+        byte = ((bitlen >> jnp.uint64(8 * k)) &
+                jnp.uint64(0xFF)).astype(jnp.uint32)
+        msg = jnp.where(idx == (lenpos + k)[:, None], byte[:, None],
+                        msg)
+    # bytes -> 16 little-endian u32 words per block
+    words = (msg[:, 0::4] | (msg[:, 1::4] << 8) | (msg[:, 2::4] << 16) |
+             (msg[:, 3::4] << 24))          # [n, n_blocks*16]
+
+    def rotl(x, s):
+        return ((x << s) | (x >> (32 - s))) & jnp.uint32(0xFFFFFFFF)
+
+    a0 = jnp.full((n,), 0x67452301, jnp.uint32)
+    b0 = jnp.full((n,), 0xEFCDAB89, jnp.uint32)
+    c0 = jnp.full((n,), 0x98BADCFE, jnp.uint32)
+    d0 = jnp.full((n,), 0x10325476, jnp.uint32)
+
+    def block(bi, carry):
+        a0, b0, c0, d0 = carry
+        base = bi * 16
+        m = jax.lax.dynamic_slice_in_dim(words, base, 16, axis=1)
+        A, B, C, D = a0, b0, c0, d0
+        for i in range(64):
+            if i < 16:
+                F = (B & C) | (~B & D)
+            elif i < 32:
+                F = (D & B) | (~D & C)
+            elif i < 48:
+                F = B ^ C ^ D
+            else:
+                F = C ^ (B | ~D)
+            F = (F + A + jnp.uint32(_MD5_K[i]) +
+                 m[:, int(_MD5_G[i])]) & jnp.uint32(0xFFFFFFFF)
+            A = D
+            D = C
+            C = B
+            B = (B + rotl(F, int(_MD5_S[i]))) & jnp.uint32(0xFFFFFFFF)
+        active = bi < row_blocks
+        return (jnp.where(active, (a0 + A) & jnp.uint32(0xFFFFFFFF), a0),
+                jnp.where(active, (b0 + B) & jnp.uint32(0xFFFFFFFF), b0),
+                jnp.where(active, (c0 + C) & jnp.uint32(0xFFFFFFFF), c0),
+                jnp.where(active, (d0 + D) & jnp.uint32(0xFFFFFFFF), d0))
+
+    a0, b0, c0, d0 = jax.lax.fori_loop(0, n_blocks, block,
+                                       (a0, b0, c0, d0))
+    # digest: a,b,c,d little-endian bytes -> 32 hex chars
+    digest_bytes = []
+    for word in (a0, b0, c0, d0):
+        for k in range(4):
+            digest_bytes.append((word >> (8 * k)) & jnp.uint32(0xFF))
+    hexmat = []
+    for byte in digest_bytes:
+        hi = byte >> 4
+        lo = byte & 0xF
+        hexmat.append(jnp.where(hi < 10, hi + ord("0"),
+                                hi - 10 + ord("a")).astype(jnp.uint8))
+        hexmat.append(jnp.where(lo < 10, lo + ord("0"),
+                                lo - 10 + ord("a")).astype(jnp.uint8))
+    out = jnp.stack(hexmat, axis=1)
+    lens = jnp.where(c.validity, 32, 0).astype(jnp.int32)
+    out = jnp.where(c.validity[:, None], out, 0)
+    return ColVal(dt.STRING, out, c.validity, lens)
+
+
+_DISPATCH[ir.Md5] = _eval_md5
+
+
+_REGEX_META = set(".^$*+?()[]{}|\\")
+
+
+def _eval_regexp_replace(e, batch):
+    """regexp_replace with a literal METACHARACTER-FREE pattern ==
+    replace-all-occurrences (the planner falls back for real regex;
+    reference: Spark300Shims.scala:183-247 GpuRegExpReplace is likewise
+    restricted).  Greedy leftmost non-overlapping, like java.util.regex.
+    """
+    s = evaluate(e.children[0], batch)
+    pat = e.children[1]
+    rep = e.children[2]
+    if not isinstance(pat, ir.Literal) or pat.value is None or \
+            not isinstance(rep, ir.Literal) or rep.value is None:
+        raise NotImplementedError("regexp_replace pattern/replacement "
+                                  "must be literals on TPU")
+    needle = pat.value.encode("utf-8")
+    if any(chr(b) in _REGEX_META for b in needle) or not needle:
+        raise NotImplementedError("regex metacharacters on TPU")
+    r = rep.value.encode("utf-8")
+    m, lr = len(needle), len(r)
+    n, w = s.data.shape
+    pos = jnp.arange(w)[None, :]
+
+    # occurrence candidates (needle fits at p, inside the string)
+    if m > w:
+        occ = jnp.zeros((n, w), dtype=jnp.bool_)
+    else:
+        span = w - m + 1
+        match = jnp.ones((n, span), dtype=jnp.bool_)
+        for j, byte in enumerate(needle):
+            match = match & (s.data[:, j:j + span] == byte)
+        match = match & (jnp.arange(span)[None, :] + m <=
+                         s.lengths[:, None])
+        occ = jnp.pad(match, ((0, 0), (0, w - span)))
+
+    # greedy leftmost non-overlap: a start is real if no real start in
+    # the previous m-1 positions — sequential scan via fori over w
+    def body(p, carry):
+        starts, next_free = carry
+        here = occ[:, p] & (p >= next_free)
+        starts = jax.lax.dynamic_update_index_in_dim(
+            starts, here, p, axis=1)
+        next_free = jnp.where(here, p + m, next_free)
+        return starts, next_free
+    starts, _ = jax.lax.fori_loop(
+        0, w, body, (jnp.zeros((n, w), jnp.bool_),
+                     jnp.zeros((n,), jnp.int32)))
+
+    sstart = jnp.where(starts, pos, -(1 << 30))
+    last = jax.lax.associative_scan(jnp.maximum, sstart, axis=1)
+    covered = (pos - last) < m
+    in_str = pos < s.lengths[:, None]
+    emit = jnp.where(starts, lr,
+                     jnp.where(covered, 0, 1)) * in_str.astype(jnp.int32)
+    out_pos = jnp.cumsum(emit, axis=1) - emit
+    out_len = jnp.sum(emit, axis=1).astype(jnp.int32)
+
+    w_out = w if lr <= m else (w // max(m, 1)) * lr + w
+    from spark_rapids_tpu.columnar.batch import _bucket_strlen
+    w_out = _bucket_strlen(w_out)
+    row = jnp.arange(n)[:, None]
+    flat = jnp.zeros((n * w_out,), dtype=jnp.uint8)
+    # copy-through characters
+    plain = in_str & ~covered & ~starts
+    tgt = jnp.where(plain, row * w_out + out_pos, n * w_out)
+    flat = flat.at[tgt.reshape(-1)].set(
+        s.data.reshape(-1), mode="drop")
+    # replacement bytes
+    for k, byte in enumerate(r):
+        tgt = jnp.where(starts & in_str, row * w_out + out_pos + k,
+                        n * w_out)
+        flat = flat.at[tgt.reshape(-1)].set(jnp.uint8(byte),
+                                            mode="drop")
+    data = flat.reshape(n, w_out)
+    keep = jnp.arange(w_out)[None, :] < out_len[:, None]
+    data = jnp.where(keep & s.validity[:, None], data, 0)
+    return ColVal(dt.STRING, data, s.validity,
+                  jnp.where(s.validity, out_len, 0))
+
+
+_DISPATCH[ir.RegExpReplace] = _eval_regexp_replace

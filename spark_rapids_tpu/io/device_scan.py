@@ -260,3 +260,62 @@ class TpuOrcScanExec(TpuParquetScanExec):
         path, raw, meta = fctx
         return dorc.decode_stripe(path, idx, file_schema,
                                   columns=file_cols, raw=raw, meta=meta)
+
+
+class TpuCsvScanExec(TpuExec):
+    """Device-decoding CSV scan: ONE byte-tensor kernel per file scans
+    delimiters and parses fields in HBM (GpuBatchScanExec Table.readCSV
+    analog, reference: GpuBatchScanExec.scala:465).  Unsupported
+    dialects (quotes, ragged rows, exotic numerics) fall back to the
+    Arrow reader per file/column."""
+
+    def __init__(self, scan: FileScan, conf):
+        super().__init__()
+        self.scan = scan
+        self.conf = conf
+        self.columns = scan.options.get("columns")
+        self._schema = scan.schema if not self.columns else Schema(
+            [scan.schema.field(c) for c in self.columns])
+        self.metrics.extra["fallbackColumns"] = 0
+        self.metrics.extra["fallbackFiles"] = 0
+
+    @property
+    def schema(self) -> Schema:
+        return self._schema
+
+    def _file_part(self, path: str):
+        from spark_rapids_tpu.exec.context import set_input_file
+        from spark_rapids_tpu.io import device_csv as dcsv
+        from spark_rapids_tpu.io.readers import _read_csv, _normalize
+        from spark_rapids_tpu.columnar.batch import from_arrow
+        wanted = [f.name for f in self._schema.fields]
+        opts = self.scan.options
+        try:
+            with tpu_semaphore():
+                with timed(self.metrics):
+                    try:
+                        batch, fallbacks = dcsv.decode_csv(
+                            path, self.scan.schema, columns=wanted,
+                            sep=opts.get("sep", ","),
+                            header=bool(opts.get("header", True)))
+                        self.metrics.add_extra("fallbackColumns",
+                                               len(fallbacks))
+                    except dcsv.UnsupportedCsv:
+                        # whole-file host fallback
+                        self.metrics.add_extra("fallbackFiles", 1)
+                        t = _normalize(_read_csv(path, opts),
+                                       self.scan.schema)
+                        batch = from_arrow(t.select(wanted))
+                    self.metrics.num_output_rows += int(batch.num_rows)
+                    self.metrics.add_batches()
+                    set_input_file(path)
+                    yield batch
+        finally:
+            set_input_file("")
+
+    def execute(self):
+        return [self._file_part(p) for p in self.scan.paths]
+
+    def simple_string(self) -> str:
+        return (f"{type(self).__name__}"
+                f"(files={len(self.scan.paths)}, deviceDecode)")

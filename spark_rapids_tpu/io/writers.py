@@ -103,6 +103,9 @@ class DataFrameWriter:
         ext = {"parquet": "parquet", "csv": "csv", "orc": "orc"}[fmt]
 
         result = self.df.session._plan_physical(self.df.plan)
+        if fmt == "parquet" and self._device_encode_ok(result.plan):
+            return self._write_device_parquet(result.plan, path, job_id,
+                                              stats)
         part_iters = result.plan.execute()
         for pid, it in enumerate(part_iters):
             tables = [t for t in it if t.num_rows > 0]
@@ -119,6 +122,44 @@ class DataFrameWriter:
                 stats.num_files += 1
                 stats.num_rows += table.num_rows
         # _SUCCESS marker like Hadoop committers
+        open(os.path.join(path, "_SUCCESS"), "w").close()
+        return stats
+
+    # -- device parquet encode --------------------------------------------
+    def _device_encode_ok(self, plan) -> bool:
+        from spark_rapids_tpu import config as cfg
+        from spark_rapids_tpu.exec.tpu_basic import DeviceToHostExec
+        from spark_rapids_tpu.io import parquet_encode as pqe
+        if self._partition_by:
+            return False
+        if not self.df.session.conf.get(cfg.PARQUET_DEVICE_ENCODE):
+            return False
+        return isinstance(plan, DeviceToHostExec) and \
+            pqe.supported(plan.schema.fields)
+
+    def _write_device_parquet(self, plan, path: str, job_id: str,
+                              stats: WriteStats) -> WriteStats:
+        """Device-encode path (GpuParquetFileFormat analog): per-column
+        null compaction on device, one packed download per batch, host
+        page assembly (io/parquet_encode.py)."""
+        from spark_rapids_tpu.columnar.batch import concat_batches
+        from spark_rapids_tpu.io import parquet_encode as pqe
+        codec = self._options.get("compression", "snappy")
+        inner = plan.children[0]
+        for pid, it in enumerate(inner.execute()):
+            batches = [b for b in it if int(b.num_rows)]
+            if not batches:
+                continue
+            whole = concat_batches(batches) if len(batches) > 1 \
+                else batches[0]
+            blob = pqe.encode_batch(whole, codec=codec)
+            fname = os.path.join(path,
+                                 f"part-{pid:05d}-{job_id}.parquet")
+            with open(fname, "wb") as f:
+                f.write(blob)
+            stats.num_bytes += len(blob)
+            stats.num_files += 1
+            stats.num_rows += int(whole.num_rows)
         open(os.path.join(path, "_SUCCESS"), "w").close()
         return stats
 

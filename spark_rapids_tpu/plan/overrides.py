@@ -43,9 +43,9 @@ from spark_rapids_tpu.expr import eval_tpu, ir
 # ---------------------------------------------------------------------------
 
 _LITERAL_ARG_EXPRS = {
-    ir.StartsWith: "string search needle must be a literal",
-    ir.EndsWith: "string search needle must be a literal",
-    ir.Contains: "string search needle must be a literal",
+    # the pattern tokenizes at trace time; a per-row pattern column
+    # would need a dynamic NFA — fall back (matches the reference's
+    # GpuLike literal-regex restriction, Spark300Shims.scala:183)
     ir.Like: "LIKE pattern must be a literal",
 }
 
@@ -71,13 +71,19 @@ def _check_expr_node(e: ir.Expression, conf: RapidsTpuConf
     if type(e) in _LITERAL_ARG_EXPRS:
         if not isinstance(e.children[1], ir.Literal):
             return _LITERAL_ARG_EXPRS[type(e)]
-    if isinstance(e, ir.Like):
+    if isinstance(e, ir.RegExpReplace):
         pat = e.children[1]
-        if isinstance(pat, ir.Literal) and pat.value is not None:
-            p = pat.value
-            core = p.strip("%")
-            if "_" in p or "%" in core:
-                return f"LIKE pattern '{p}' not supported on TPU yet"
+        rep = e.children[2]
+        if not isinstance(pat, ir.Literal) or pat.value is None or \
+                not isinstance(rep, ir.Literal) or rep.value is None:
+            return "regexp_replace pattern/replacement must be literals"
+        from spark_rapids_tpu.expr.eval_tpu import _REGEX_META
+        if not pat.value or any(ch in _REGEX_META for ch in pat.value):
+            return (f"regexp pattern '{pat.value}' uses regex "
+                    "metacharacters — TPU does literal patterns only")
+        if "$" in rep.value or "\\" in rep.value:
+            return ("regexp replacement with $group/backslash "
+                    "references is not supported on TPU")
     if isinstance(e, ir.StringLocate):
         if not isinstance(e.children[0], ir.Literal) or \
            not isinstance(e.children[2], ir.Literal):
@@ -89,9 +95,25 @@ def _check_expr_node(e: ir.Expression, conf: RapidsTpuConf
     if isinstance(e, ir.Cast):
         src = e.children[0].dtype
         if src is not None and src != e.to and src != dt.NULL:
-            if src.is_string and not e.to.is_integral:
+            if src.is_string and e.to.id == dt.TypeId.TIMESTAMP_US and \
+                    not conf.get(cfg.ALLOW_INCOMPAT_UTC_ONLY):
+                return ("cast string->timestamp is UTC-only on TPU; "
+                        f"enable {cfg.ALLOW_INCOMPAT_UTC_ONLY.key}")
+            if src.is_string and not (
+                    e.to.is_integral or e.to.is_floating or
+                    e.to.is_bool or
+                    e.to.id in (dt.TypeId.DATE32,
+                                dt.TypeId.TIMESTAMP_US)):
                 return f"cast string->{e.to.name} not supported on TPU yet"
-            if e.to.is_string:
+            if e.to.is_string and src.is_floating:
+                # Java Double.toString shortest-repr semantics
+                # (reference marks GPU float->string incompatible too)
+                return ("cast float->string formatting differs from "
+                        "Spark; not supported on TPU yet")
+            if e.to.is_string and not (
+                    src.is_bool or src.is_integral or
+                    src.id in (dt.TypeId.DATE32,
+                               dt.TypeId.TIMESTAMP_US)):
                 return f"cast {src.name}->string not supported on TPU yet"
     if isinstance(e, (ir.Sum, ir.Average)) and e.child is not None and \
             e.child.dtype is not None and e.child.dtype.is_floating:
@@ -451,14 +473,24 @@ def _register_file_scan_rule():
             if not conf.get(cfg.ORC_DEVICE_DECODE):
                 out.append("orc device decode disabled by "
                            f"{cfg.ORC_DEVICE_DECODE.key}")
+        elif n.scan.fmt == "csv":
+            if not conf.get(cfg.CSV_DEVICE_DECODE):
+                out.append("csv device decode disabled by "
+                           f"{cfg.CSV_DEVICE_DECODE.key}")
+            elif n.scan.options.get("part_fields"):
+                out.append("csv device decode does not yet append "
+                           "Hive partition columns")
         else:
             out.append(f"{n.scan.fmt} scans decode on host "
-                       "(device decode is parquet/orc-only)")
+                       "(device decode is parquet/orc/csv-only)")
         return out
 
     def _convert_scan(n, ch, conf):
         if n.scan.fmt == "orc":
             return TpuOrcScanExec(n.scan, conf)
+        if n.scan.fmt == "csv":
+            from spark_rapids_tpu.io.device_scan import TpuCsvScanExec
+            return TpuCsvScanExec(n.scan, conf)
         return TpuParquetScanExec(n.scan, conf)
 
     register_exec_rule(CpuFileScanExec, ExecRule(

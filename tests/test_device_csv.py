@@ -1,0 +1,122 @@
+"""Device CSV decode parity (reference analog: csv_test.py + the
+Table.readCSV device path of GpuBatchScanExec)."""
+
+import numpy as np
+import pyarrow as pa
+import pyarrow.csv as pacsv
+import pytest
+
+from spark_rapids_tpu import TpuSparkSession
+from spark_rapids_tpu.io import device_csv as dcsv
+from spark_rapids_tpu.plan.logical import Schema
+from spark_rapids_tpu.columnar.batch import to_arrow
+from tests.parity import assert_tables_equal
+
+
+@pytest.fixture()
+def spark():
+    return TpuSparkSession(
+        {"spark.rapids.tpu.sql.variableFloatAgg.enabled": True})
+
+
+def _write_csv(tmp_path, table, name="t.csv"):
+    p = str(tmp_path / name)
+    pacsv.write_csv(table, p,
+                    pacsv.WriteOptions(quoting_style="none"))
+    return p
+
+
+def _table(n=120, seed=0):
+    rng = np.random.default_rng(seed)
+    return pa.table({
+        "i": pa.array(rng.integers(-10**9, 10**9, n), type=pa.int64()),
+        "f": pa.array(np.round(rng.normal(size=n) * 1000, 4)),
+        "s": pa.array([f"name_{int(x)}" for x in
+                       rng.integers(0, 50, n)]),
+        "b": pa.array([bool(x) for x in rng.integers(0, 2, n)]),
+    })
+
+
+def test_decode_csv_direct(tmp_path):
+    t = _table()
+    p = _write_csv(tmp_path, t)
+    schema = Schema.from_arrow(t.schema)
+    batch, fallbacks = dcsv.decode_csv(p, schema)
+    assert fallbacks == []
+    got = to_arrow(batch)
+    assert_tables_equal(t.cast(got.schema), got)
+
+
+def test_decode_csv_nulls_and_crlf(tmp_path):
+    p = str(tmp_path / "n.csv")
+    with open(p, "wb") as f:
+        f.write(b"a,b,s\r\n1,,x\r\n,2.5,\r\n-3,0.25,zz\r\n")
+    schema = Schema.from_arrow(pa.schema(
+        [("a", pa.int64()), ("b", pa.float64()), ("s", pa.string())]))
+    batch, fallbacks = dcsv.decode_csv(p, schema)
+    got = to_arrow(batch)
+    assert got.column("a").to_pylist() == [1, None, -3]
+    assert got.column("b").to_pylist() == [None, 2.5, 0.25]
+    assert got.column("s").to_pylist() == ["x", None, "zz"]
+
+
+def test_decode_csv_exotic_numeric_column_falls_back(tmp_path):
+    # scientific notation in the float column: that COLUMN host-decodes,
+    # the rest stay device
+    p = str(tmp_path / "e.csv")
+    with open(p, "wb") as f:
+        f.write(b"a,b\n1,1e3\n2,2.5\n3,-4e-2\n")
+    schema = Schema.from_arrow(pa.schema(
+        [("a", pa.int64()), ("b", pa.float64())]))
+    batch, fallbacks = dcsv.decode_csv(p, schema)
+    assert fallbacks == ["b"]
+    got = to_arrow(batch)
+    assert got.column("a").to_pylist() == [1, 2, 3]
+    assert got.column("b").to_pylist() == [1000.0, 2.5, -0.04]
+
+
+def test_decode_csv_quoted_raises(tmp_path):
+    p = str(tmp_path / "q.csv")
+    with open(p, "wb") as f:
+        f.write(b'a,s\n1,"x,y"\n')
+    schema = Schema.from_arrow(pa.schema(
+        [("a", pa.int64()), ("s", pa.string())]))
+    with pytest.raises(dcsv.UnsupportedCsv):
+        dcsv.decode_csv(p, schema)
+
+
+def test_planned_csv_scan_runs_on_device(spark, tmp_path):
+    t = _table(80, seed=5)
+    p = _write_csv(tmp_path, t)
+    captured = []
+    spark.add_plan_listener(captured.append)
+    out = spark.read.csv(p).collect()
+    names = []
+    captured[-1].plan.foreach(lambda n: names.append(type(n).__name__))
+    assert "TpuCsvScanExec" in names, names
+    assert_tables_equal(t.cast(out.schema), out, ignore_order=True)
+
+
+def test_planned_csv_quoted_file_host_fallback_inside_exec(spark,
+                                                           tmp_path):
+    # quoted file: the EXEC falls back to the Arrow reader per file but
+    # results stay correct
+    p = str(tmp_path / "q2.csv")
+    with open(p, "wb") as f:
+        f.write(b'a,s\n1,"x,y"\n2,plain\n')
+    out = spark.read.csv(p).collect()
+    assert out.column("s").to_pylist() == ["x,y", "plain"]
+
+
+def test_csv_device_decode_kill_switch(tmp_path):
+    s = TpuSparkSession({
+        "spark.rapids.tpu.sql.format.csv.deviceDecode.enabled": False})
+    t = _table(30, seed=7)
+    p = _write_csv(tmp_path, t)
+    captured = []
+    s.add_plan_listener(captured.append)
+    out = s.read.csv(p).collect()
+    names = []
+    captured[-1].plan.foreach(lambda n: names.append(type(n).__name__))
+    assert "TpuCsvScanExec" not in names, names
+    assert out.num_rows == 30

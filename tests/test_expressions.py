@@ -240,3 +240,121 @@ def test_conditional_case_when():
                 .otherwise(lit("zero")).alias("sign"),
                 F.if_(col("a").is_null(), lit(-1),
                       col("a")).alias("nvl")))
+
+
+# -- round-3 device surface: cast matrix, general LIKE, column needles ------
+
+def test_cast_string_to_float_parity():
+    def q(s):
+        df = s.create_dataframe(pa.table({"s": [
+            "1.5", "-2.25", " 42 ", "1e3", "-4.5E-2", "0.0", "",
+            "abc", "1.2.3", None, "Infinity", "-Infinity", "NaN",
+            "+7.125", "123456789.5", "00012"]}))
+        return df.select(col("s").cast("double").alias("d"),
+                         col("s").cast("float").alias("f"))
+    assert_tpu_and_cpu_are_equal_collect(q)
+
+
+def test_cast_string_to_bool_and_date_parity():
+    def q(s):
+        df = s.create_dataframe(pa.table({
+            "b": ["true", "FALSE", "y", "N", "1", "0", "maybe", "", None,
+                  " t "],
+            "d": ["2024-02-29", "1999-12-31", "2024-13-01", "bad", "",
+                  None, "1970-01-01", "2024-1-1", " 2024-03-05 ",
+                  "2024-03-05x"],
+        }))
+        return df.select(col("b").cast("boolean").alias("bb"),
+                         col("d").cast("date").alias("dd"))
+    assert_tpu_and_cpu_are_equal_collect(q)
+
+
+def test_cast_int_bool_to_string_parity():
+    def q(s):
+        df = gen_df(s, [long_gen, int_gen, boolean_gen],
+                    ["l", "i", "b"], n=150)
+        return df.select(col("l").cast("string").alias("ls"),
+                         col("i").cast("string").alias("is_"),
+                         col("b").cast("string").alias("bs"))
+    assert_tpu_and_cpu_are_equal_collect(q)
+
+
+def test_cast_int_to_string_extremes():
+    def q(s):
+        df = s.create_dataframe(pa.table({"v": pa.array(
+            [0, 1, -1, 9223372036854775807, -9223372036854775808,
+             None, 10, -100], type=pa.int64())}))
+        return df.select(col("v").cast("string").alias("s"))
+    assert_tpu_and_cpu_are_equal_collect(q)
+
+
+def test_cast_date_timestamp_to_string_parity():
+    def q(s):
+        df = gen_df(s, [date_gen, timestamp_gen], ["d", "t"], n=120)
+        return df.select(col("d").cast("string").alias("ds"),
+                         col("t").cast("string").alias("ts"))
+    assert_tpu_and_cpu_are_equal_collect(q)
+
+
+@pytest.mark.parametrize("pat", [
+    "a%", "%z", "%mid%", "a_c", "_bc", "ab_", "a%c", "a_%_c",
+    "%a_b%", "", "%", "abc", "a%b%c", "%%x%%"])
+def test_like_general_parity(pat):
+    def q(s):
+        df = gen_df(s, [StringGen(max_len=6)], ["s"], n=300, seed=11)
+        return df.select(col("s").like(pat).alias("m"))
+    assert_tpu_and_cpu_are_equal_collect(q)
+
+
+def test_string_search_column_needles_parity():
+    def q(s):
+        df = gen_df(s, [StringGen(max_len=8), StringGen(max_len=3)],
+                    ["h", "n"], n=250, seed=13)
+        return df.select(
+            col("h").startswith(col("n")).alias("sw"),
+            col("h").endswith(col("n")).alias("ew"),
+            col("h").contains(col("n")).alias("ct"))
+    assert_tpu_and_cpu_are_equal_collect(q)
+
+
+def test_md5_parity():
+    def q(s):
+        df = gen_df(s, [StringGen(max_len=12)], ["s"], n=200, seed=17)
+        return df.select(F.md5(col("s")).alias("h"))
+    assert_tpu_and_cpu_are_equal_collect(q)
+
+
+def test_regexp_replace_literal_parity():
+    def q(s):
+        df = s.create_dataframe(pa.table({"s": [
+            "hello world", "aaa", "abcabcabc", "", None, "no match",
+            "aa", "xaax", "overlap: aaaa"]}))
+        return df.select(
+            F.regexp_replace(col("s"), "aa", "Z").alias("r1"),
+            F.regexp_replace(col("s"), "abc", "xy").alias("r2"),
+            F.regexp_replace(col("s"), "o", "00").alias("r3"))
+    assert_tpu_and_cpu_are_equal_collect(q)
+
+
+def test_cast_string_to_date_calendar_overflow():
+    def q(s):
+        df = s.create_dataframe(pa.table({"d": [
+            "2024-02-29", "2023-02-29", "2024-02-30", "2024-04-31",
+            "2024-12-31", "2100-02-29", "2000-02-29"]}))
+        return df.select(col("d").cast("date").alias("dd"))
+    assert_tpu_and_cpu_are_equal_collect(q)
+
+
+def test_cast_string_to_timestamp_parity():
+    def q(s):
+        s.set_conf(
+            "spark.rapids.tpu.sql.castStringToTimestamp.enabled", True)
+        df = s.create_dataframe(pa.table({"t": [
+            "2024-03-05 12:34:56", "2024-03-05", "1970-01-01 00:00:00",
+            "2024-03-05 12:34:56.123", "2024-03-05 12:34:56.123456",
+            "bad", "", None, "2024-02-30 01:02:03",
+            "2024-03-05T07:08:09"]}))
+        return df.select(col("t").cast("timestamp").alias("ts"))
+    assert_tpu_and_cpu_are_equal_collect(
+        q, conf={"spark.rapids.tpu.sql.castStringToTimestamp.enabled":
+                 True})
