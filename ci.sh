@@ -1,0 +1,39 @@
+#!/usr/bin/env bash
+# CI gate (reference analog: jenkins/spark-premerge-build.sh:24-30 —
+# build + full test suite + a smoke benchmark, red on any failure).
+set -euo pipefail
+cd "$(dirname "$0")"
+
+echo "== lint (syntax + import sanity) =="
+python -m compileall -q spark_rapids_tpu tests bench.py __graft_entry__.py
+if python -c "import pyflakes" 2>/dev/null; then
+    python -m pyflakes spark_rapids_tpu bench.py __graft_entry__.py || exit 1
+fi
+
+echo "== generated docs up to date =="
+python - <<'EOF'
+import io, subprocess, sys
+cur = open("docs/configs.md").read()
+new = subprocess.run([sys.executable, "-m", "spark_rapids_tpu.config"],
+                     capture_output=True, text=True).stdout
+if cur != new:
+    sys.exit("docs/configs.md is stale: run "
+             "python -m spark_rapids_tpu.config > docs/configs.md")
+EOF
+
+echo "== full test suite (one process) =="
+python -m pytest tests/ -q
+
+echo "== graft entry + multichip dryrun =="
+python - <<'EOF'
+import jax
+import __graft_entry__ as g
+fn, args = g.entry()
+jax.jit(fn)(*args)
+g.dryrun_multichip(8)
+EOF
+
+echo "== smoke bench =="
+python bench.py --smoke
+
+echo "CI GREEN"

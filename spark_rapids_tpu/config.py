@@ -129,6 +129,13 @@ TEST_ALLOWED_NON_TPU = conf(
     "Comma-separated exec/expr class names allowed to stay on CPU in test "
     "mode.")
 
+CAST_STRING_TO_FLOAT = conf(
+    "spark.rapids.tpu.sql.castStringToFloat.enabled", False,
+    "Enable string-to-float casts on TPU. The device parse "
+    "(mantissa x 10^exp in float64) can differ from strtod in the last "
+    "ulp for full-precision decimal strings (reference flags GPU "
+    "castStringToFloat incompatible for the same reason).", bool)
+
 ALLOW_INCOMPAT_UTC_ONLY = conf(
     "spark.rapids.tpu.sql.castStringToTimestamp.enabled", False,
     "Enable string-to-timestamp casts (UTC only).", bool)

@@ -660,9 +660,13 @@ def _substring(e, table):
 def _str_pred(fn):
     def f(e, table):
         l, r = evaluate(e.left, table), evaluate(e.right, table)
-        out = np.array([fn(a, b) for a, b in zip(l.data, r.data)],
-                       dtype=bool) if len(l.data) else np.zeros(0, bool)
-        return CpuVal(dt.BOOL, out, l.valid & r.valid)
+        n = len(l.data)
+        valid = l.valid & r.valid
+        out = np.array(
+            [fn(a, b) if valid[i] else False
+             for i, (a, b) in enumerate(zip(l.data, r.data))],
+            dtype=bool) if n else np.zeros(0, bool)
+        return CpuVal(dt.BOOL, out, valid)
     return f
 
 

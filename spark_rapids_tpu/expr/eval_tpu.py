@@ -672,12 +672,14 @@ def _cast_string_to_int(c: ColVal, tgt: dt.DType) -> ColVal:
 
 
 def _trimmed(c: ColVal):
-    """(start, end) of the whitespace-trimmed span per row."""
+    """(start, end) of the whitespace-trimmed span per row (ASCII
+    whitespace set of str.strip(): space, \t, \n, \r, \v, \f)."""
     data, lengths = c.data, c.lengths
     w = data.shape[1]
     idx = jnp.arange(w)[None, :]
     in_str = idx < lengths[:, None]
-    non_space = in_str & (data != ord(" "))
+    is_ws = (data == 32) | ((data >= 9) & (data <= 13))
+    non_space = in_str & ~is_ws
     any_ns = jnp.any(non_space, axis=1)
     first_ns = jnp.argmax(non_space, axis=1)
     last_ns = (w - 1) - jnp.argmax(non_space[:, ::-1], axis=1)
@@ -1199,6 +1201,10 @@ def _eval_like(e, batch):
     stringFunctions.scala:506), evaluated as a greedy leftmost
     segment-placement scan over the byte matrix."""
     l = evaluate(e.left, batch)
+    if isinstance(e.right, ir.Literal) and e.right.value is None:
+        n0 = l.data.shape[0]
+        return ColVal(dt.BOOL, jnp.zeros((n0,), jnp.bool_),
+                      jnp.zeros((n0,), jnp.bool_))   # LIKE NULL -> NULL
     pat = _needle_bytes(e.right)
     w = l.data.shape[1]
     n = l.data.shape[0]

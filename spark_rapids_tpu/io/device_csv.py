@@ -80,10 +80,10 @@ def prescan(raw: bytes, n_cols: int, sep: bytes = b",",
 
 
 @partial(jax.jit, static_argnames=("n_cols", "cap", "widths",
-                                   "dtypes_key", "sep"))
+                                   "dtypes_key", "sep", "parse_cols"))
 def _decode_kernel(raw: jnp.ndarray, n_rows, n_cols: int, cap: int,
                    widths: Tuple[int, ...], dtypes_key: Tuple[str, ...],
-                   sep: int):
+                   sep: int, parse_cols: Tuple[int, ...]):
     """ONE program: delimiter scan -> boundary matrix -> per-column
     parse.  Shapes are static buckets only; the exact row count is a
     traced operand so the compile cache hits across files."""
@@ -110,7 +110,9 @@ def _decode_kernel(raw: jnp.ndarray, n_rows, n_cols: int, cap: int,
 
     row_pad = jnp.arange(cap) < n_rows
     out = []
-    for c in range(n_cols):
+    # column pruning: the delimiter scan covers every column, but the
+    # gather+parse runs only for requested ones
+    for c in parse_cols:
         F = widths[c]
         st = jnp.where(row_pad, starts[:, c], 0)
         ln = jnp.where(row_pad, lens[:, c], 0)
@@ -167,6 +169,7 @@ def _parse_column(mat: jnp.ndarray, ln: jnp.ndarray,
     int_v = jnp.zeros(mat.shape[0], dtype=jnp.int64)
     frac_v = jnp.zeros(mat.shape[0], dtype=jnp.int64)
     frac_n = jnp.zeros(mat.shape[0], dtype=jnp.int32)
+    n_dig = jnp.zeros(mat.shape[0], dtype=jnp.int32)
     for i in range(F):
         d = digit[:, i].astype(jnp.int64)
         take_int = is_digit[:, i] & (i < ln) & (i < dot_pos)
@@ -174,6 +177,11 @@ def _parse_column(mat: jnp.ndarray, ln: jnp.ndarray,
         int_v = jnp.where(take_int, int_v * 10 + d, int_v)
         frac_v = jnp.where(take_frac, frac_v * 10 + d, frac_v)
         frac_n = frac_n + take_frac.astype(jnp.int32)
+        n_dig = n_dig + (take_int | take_frac).astype(jnp.int32)
+    # a bare '-' / '.' is NOT a number, and >18 digits would silently
+    # wrap the int64 fold — both host-fallback instead
+    ok = ok & jnp.all((n_dig >= 1) | empty | ~row_pad)
+    ok = ok & jnp.all((n_dig <= 18) | ~row_pad)
     valid = row_pad & ~empty
     if dkey in ("int32", "int64"):
         # a '.' in an integer column falls back
@@ -220,15 +228,19 @@ def decode_csv(path: str, schema: Schema,
         [a, np.zeros(bcap - a.shape[0], np.uint8)]))
     widths_b = tuple(_bucket_strlen(w) for w in widths)
     dkeys = tuple(_DKEY[f.dtype.id] for f in schema.fields)
+    parse_cols = tuple(i for i, nme in enumerate(all_names)
+                       if nme in wanted)
     outs = _decode_kernel(dev_raw, jnp.int32(n_rows),
                           n_cols=len(all_names), cap=cap,
                           widths=widths_b, dtypes_key=dkeys,
-                          sep=ord(sep))
+                          sep=ord(sep), parse_cols=parse_cols)
+    out_by_idx = dict(zip(parse_cols, outs))
 
     # one tiny read for the per-column ok flags
     oks = [bool(x) for x in np.asarray(
         jnp.stack([o[3] for o in outs]))]
-    fallbacks = [n for n, okf in zip(all_names, oks) if not okf]
+    fallbacks = [all_names[i] for i, okf in zip(parse_cols, oks)
+                 if not okf]
     host_cols = {}
     if fallbacks:
         from spark_rapids_tpu.io.readers import _normalize, _read_csv
@@ -239,9 +251,10 @@ def decode_csv(path: str, schema: Schema,
         host_cols = dict(zip(sub.names, sub.columns))
 
     cols, names = [], []
-    for name, f, o in zip(all_names, schema.fields, outs):
+    for i, (name, f) in enumerate(zip(all_names, schema.fields)):
         if name not in wanted:
             continue
+        o = out_by_idx[i]
         if name in host_cols:
             cols.append(host_cols[name])
         elif f.dtype.is_string:

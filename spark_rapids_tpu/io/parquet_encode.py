@@ -241,8 +241,7 @@ def _rle_def_levels(valid: np.ndarray) -> bytes:
     4-byte length prefix of DataPage v1."""
     n = valid.shape[0]
     if n and valid.all():
-        body = bytes([(n << 1) & 0xFF]) if n < 64 else None
-        # general varint RLE-run header
+        # one RLE run covering all n values (varint header + level byte)
         out = bytearray()
         h = n << 1
         while True:

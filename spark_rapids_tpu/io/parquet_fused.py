@@ -448,6 +448,30 @@ def _make_kernel(plan_key: Tuple, specs, out_dtypes, names, K: int,
 # Public entry
 # ---------------------------------------------------------------------------
 
+def _fused_list_column(sources, f, n_rows) -> Optional[DeviceColumn]:
+    """Device list decode per row group + device concat for the fused
+    batch; None -> host fallback."""
+    from spark_rapids_tpu.columnar.batch import concat_batches
+    from spark_rapids_tpu.io.device_parquet import (decode_list_chunk,
+                                                    leaf_index_map)
+    try:
+        per = []
+        for (pf, path, rg), nr in zip(sources, n_rows):
+            leaf_of = leaf_index_map(pf)
+            if f.name not in leaf_of:
+                return None
+            chunk = pm.read_chunk_pages(path, rg, leaf_of[f.name],
+                                        parquet_file=pf)
+            col = decode_list_chunk(chunk, f.dtype,
+                                    bucket_rows(max(nr, 1)),
+                                    f.nullable)
+            per.append(DeviceBatch([f.name], [col], nr))
+        merged = concat_batches(per)
+        return merged.columns[0]
+    except Exception:
+        return None
+
+
 def decode_row_groups_fused(sources: Sequence[Tuple[Any, str, int]],
                             schema: Schema,
                             columns: Optional[List[str]] = None
@@ -464,19 +488,29 @@ def decode_row_groups_fused(sources: Sequence[Tuple[Any, str, int]],
 
     plans: List[List[Optional[ChunkPlan]]] = []
     fallbacks: List[str] = []
+    list_cols: Dict[str, DeviceColumn] = {}
     for c in wanted:
         f = schema.field(c)
+        if f.dtype.is_list:
+            # list columns decode per row group via the dedicated
+            # rep/def path and concatenate on device
+            col = _fused_list_column(sources, f, n_rows)
+            if col is not None:
+                list_cols[c] = col
+            else:
+                fallbacks.append(c)
+            plans.append(None)
+            continue
         col_plans: List[Optional[ChunkPlan]] = []
         try:
             for pf, path, rg in sources:
-                md = pf.metadata
-                names_in_file = [md.schema.column(i).path
-                                 for i in range(md.num_columns)]
-                if c not in names_in_file:
+                from spark_rapids_tpu.io.device_parquet import \
+                    leaf_index_map
+                leaf_of = leaf_index_map(pf)
+                if c not in leaf_of:
                     col_plans.append(None)
                     continue
-                chunk = pm.read_chunk_pages(path, rg,
-                                            names_in_file.index(c),
+                chunk = pm.read_chunk_pages(path, rg, leaf_of[c],
                                             parquet_file=pf)
                 col_plans.append(plan_chunk(chunk, f.dtype))
         except Exception:
@@ -491,7 +525,7 @@ def decode_row_groups_fused(sources: Sequence[Tuple[Any, str, int]],
     total = sum(n_rows)
     cap = bucket_rows(max(total, 1))
 
-    cols_by_name: Dict[str, DeviceColumn] = {}
+    cols_by_name: Dict[str, DeviceColumn] = dict(list_cols)
     if dev_plans:
         fp = assemble(dev_plans, dev_dtypes, dev_cols, n_rows)
         from spark_rapids_tpu.exec import kernel_cache as kc
@@ -509,10 +543,8 @@ def decode_row_groups_fused(sources: Sequence[Tuple[Any, str, int]],
     if fallbacks:
         tables = []
         for pf, path, rg in sources:
-            md = pf.metadata
-            names_in_file = [md.schema.column(i).path
-                             for i in range(md.num_columns)]
-            present = [c for c in fallbacks if c in names_in_file]
+            leaf_of2 = leaf_index_map(pf)
+            present = [c for c in fallbacks if c in leaf_of2]
             t = pf.read_row_group(rg, columns=present) if present \
                 else pa.table({})
             arrs = []

@@ -95,6 +95,12 @@ def _check_expr_node(e: ir.Expression, conf: RapidsTpuConf
     if isinstance(e, ir.Cast):
         src = e.children[0].dtype
         if src is not None and src != e.to and src != dt.NULL:
+            if src.is_string and e.to.is_floating and \
+                    not conf.get(cfg.CAST_STRING_TO_FLOAT) and \
+                    not conf.get(cfg.INCOMPATIBLE_OPS):
+                return ("cast string->float can differ from Spark in "
+                        "the last ulp; enable "
+                        f"{cfg.CAST_STRING_TO_FLOAT.key}")
             if src.is_string and e.to.id == dt.TypeId.TIMESTAMP_US and \
                     not conf.get(cfg.ALLOW_INCOMPAT_UTC_ONLY):
                 return ("cast string->timestamp is UTC-only on TPU; "

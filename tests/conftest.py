@@ -74,3 +74,10 @@ def session():
     return TpuSparkSession({
         "spark.rapids.tpu.sql.variableFloatAgg.enabled": True,
     })
+
+
+def pytest_configure(config):
+    config.addinivalue_line(
+        "markers",
+        "tpu_hw: touches the real TPU chip (skips hermetically when "
+        "no accelerator is present)")

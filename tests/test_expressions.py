@@ -252,7 +252,9 @@ def test_cast_string_to_float_parity():
             "+7.125", "123456789.5", "00012"]}))
         return df.select(col("s").cast("double").alias("d"),
                          col("s").cast("float").alias("f"))
-    assert_tpu_and_cpu_are_equal_collect(q)
+    assert_tpu_and_cpu_are_equal_collect(
+        q, conf={"spark.rapids.tpu.sql.castStringToFloat.enabled":
+                 True})
 
 
 def test_cast_string_to_bool_and_date_parity():
@@ -358,3 +360,10 @@ def test_cast_string_to_timestamp_parity():
     assert_tpu_and_cpu_are_equal_collect(
         q, conf={"spark.rapids.tpu.sql.castStringToTimestamp.enabled":
                  True})
+
+
+def test_like_null_pattern():
+    def q(s):
+        df = s.create_dataframe(pa.table({"s": ["a", "b", None]}))
+        return df.select(col("s").like(None).alias("m"))
+    assert_tpu_and_cpu_are_equal_collect(q)
