@@ -418,14 +418,37 @@ class TpuShuffledHashJoinExec(_HashJoinBase):
         assert len(lits) == len(rits), \
             f"join children not co-partitioned: {len(lits)} vs {len(rits)}"
 
-        def run(lit, rit):
+        def run_streamed(lit, rit):
+            """inner/left/semi/anti: build side coalesced once, STREAM
+            side probes per batch (reference: GpuHashJoin.scala:193-326
+            streams the probe side) — the stream partition is never
+            concatenated into one giant batch.  Cost note: each probe
+            batch re-groups the combined build+batch key space (the
+            sort-based formulation has no persistent hash table);
+            coalesce goals keep probe batches per partition few.
+            """
+            right = _gather_partition(rit)
+            if right is None:
+                if self.how == "inner":
+                    # nothing can match — but the stream iterator must
+                    # still drain: AQE readers release their
+                    # spill-catalog claims inside the generator body
+                    for _ in lit:
+                        pass
+                    return
+                right = _empty_like(self.children[1].schema)
+            for lb in lit:
+                if not int(lb.num_rows):
+                    continue
+                yield from self._join_pair(lb, right)
+
+        def run_gathered(lit, rit):
+            """right/full: unmatched-build emission needs every stream
+            batch, so the pair joins as two single batches."""
             left = _gather_partition(lit)
             right = _gather_partition(rit)
             if left is None or right is None:
-                if self.how in ("left", "semi", "anti") and left is not None:
-                    right = _empty_like(self.children[1].schema)
-                elif self.how in ("right", "full") and \
-                        (left is not None or right is not None):
+                if left is not None or right is not None:
                     left = left if left is not None else \
                         _empty_like(self.children[0].schema)
                     right = right if right is not None else \
@@ -434,6 +457,8 @@ class TpuShuffledHashJoinExec(_HashJoinBase):
                     return
             yield from self._join_pair(left, right)
 
+        run = run_gathered if self.how in ("right", "full") \
+            else run_streamed
         return [run(l, r) for l, r in zip(lits, rits)]
 
 
