@@ -154,6 +154,9 @@ class TpuSparkSession:
         return [x for p in parts for x in p]
 
     def _execute(self, plan: lp.LogicalPlan) -> pa.Table:
+        # executor-longevity guard (see kernel_cache docstring)
+        from spark_rapids_tpu.exec import kernel_cache
+        kernel_cache.maybe_clear_for_map_pressure()
         from spark_rapids_tpu.exec.context import set_input_file
         set_input_file("")  # fresh query: no stale input_file_name()
         result = self._plan_physical(plan)

@@ -111,3 +111,58 @@ def get_kernel(key: Any, builder: Callable[[], Callable],
 def clear() -> None:
     _CACHE.clear()
     _ID_PINNED.clear()
+
+
+def clear_compile_state() -> None:
+    """Drop every cached executable (this cache + jax's internal ones)
+    so their memory mappings release; the persistent compile cache
+    makes re-loading cheap."""
+    import gc
+
+    import jax
+    clear()
+    jax.clear_caches()
+    gc.collect()
+
+
+_maps_calls = 0
+_maps_guard_disabled = False
+
+
+def _count_maps() -> int:
+    with open("/proc/self/maps", "rb") as f:
+        return f.read().count(b"\n")
+
+
+def maybe_clear_for_map_pressure(threshold: int = 40000,
+                                 every: int = 16,
+                                 force_check: bool = False) -> bool:
+    """Executor-longevity guard: every loaded XLA executable costs
+    memory mappings, and a long-lived process compiling many queries
+    would hit ``vm.max_map_count`` (65530) and SIGSEGV — round 2's
+    reproducible suite-killer.  Samples /proc/self/maps every ``every``
+    calls (the scan itself costs ~ms) and clears cached executables
+    past ``threshold``; if clearing doesn't actually reduce the count
+    (mappings owned by something else), the guard disables itself
+    instead of thrashing recompiles.  (The reference gets this bound
+    for free from the JVM's code-cache management.)"""
+    global _maps_calls, _maps_guard_disabled
+    if _maps_guard_disabled:
+        return False
+    _maps_calls += 1
+    if not force_check and _maps_calls % every:
+        return False
+    try:
+        n = _count_maps()
+    except OSError:
+        _maps_guard_disabled = True
+        return False
+    if n <= threshold:
+        return False
+    clear_compile_state()
+    try:
+        if _count_maps() > 0.9 * threshold:
+            _maps_guard_disabled = True
+    except OSError:
+        _maps_guard_disabled = True
+    return True

@@ -34,38 +34,25 @@ jax.config.update("jax_platforms", "cpu")
 import pytest  # noqa: E402
 
 
-def _clear_compile_state():
-    from spark_rapids_tpu.exec import kernel_cache
-    kernel_cache.clear()
-    jax.clear_caches()
-    import gc
-    gc.collect()
-
-
 @pytest.fixture(autouse=True, scope="module")
 def _bounded_compile_state():
     """Clear jit/kernel caches between test modules: a full-suite run
     compiles thousands of XLA:CPU executables, and unbounded accumulation
     has produced compiler segfaults late in the run."""
     yield
-    _clear_compile_state()
+    from spark_rapids_tpu.exec import kernel_cache
+    kernel_cache.clear_compile_state()
 
 
 @pytest.fixture(autouse=True)
 def _bounded_memory_maps():
     """Executor-longevity guard INSIDE big modules (TPC-DS is ~120
-    tests in one module): every loaded XLA executable costs memory
-    mappings, and the process segfaults at vm.max_map_count (65530).
-    When the count crosses a safety line, drop every cached executable
-    so the mappings release."""
+    tests in one module) — the shared engine guard, forced every test
+    with a tighter line."""
     yield
-    try:
-        with open("/proc/self/maps") as f:
-            n = sum(1 for _ in f)
-    except OSError:
-        return
-    if n > 25000:
-        _clear_compile_state()
+    from spark_rapids_tpu.exec import kernel_cache
+    kernel_cache.maybe_clear_for_map_pressure(threshold=25000,
+                                              force_check=True)
 
 
 @pytest.fixture()
