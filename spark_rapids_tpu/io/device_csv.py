@@ -189,6 +189,12 @@ def _parse_column(mat: jnp.ndarray, ln: jnp.ndarray,
         v = jnp.where(neg, -int_v, int_v)
         v = jnp.where(valid, v, 0)
         if dkey == "int32":
+            # the 18-digit guard only protects the int64 fold; values
+            # outside int32 range would silently wrap on the device cast
+            # — route them to the host fallback like other unsupported
+            # numerics
+            in_range = (v >= jnp.int64(-2**31)) & (v <= jnp.int64(2**31 - 1))
+            ok = ok & jnp.all(in_range | ~row_pad)
             v = v.astype(jnp.int32)
         return v, valid, None, ok
     v = int_v.astype(jnp.float64) + \
@@ -244,10 +250,11 @@ def decode_csv(path: str, schema: Schema,
     host_cols = {}
     if fallbacks:
         from spark_rapids_tpu.io.readers import _normalize, _read_csv
+        fb_schema = Schema([schema.field(n) for n in fallbacks])
         t = _normalize(_read_csv(path, {"header": header, "sep": sep}),
-                       schema)
+                       fb_schema, permissive=True)
         from spark_rapids_tpu.columnar.batch import from_arrow
-        sub = from_arrow(t.select(fallbacks), capacity=cap)
+        sub = from_arrow(t, capacity=cap)
         host_cols = dict(zip(sub.names, sub.columns))
 
     cols, names = [], []

@@ -304,7 +304,8 @@ class TpuCsvScanExec(TpuExec):
                         # whole-file host fallback
                         self.metrics.add_extra("fallbackFiles", 1)
                         t = _normalize(_read_csv(path, opts),
-                                       self.scan.schema)
+                                       self.scan.schema,
+                                       permissive=True)
                         batch = from_arrow(t.select(wanted))
                     self.metrics.num_output_rows += int(batch.num_rows)
                     self.metrics.add_batches()

@@ -42,7 +42,7 @@ from spark_rapids_tpu.columnar.batch import (DeviceBatch, DeviceColumn,
 from spark_rapids_tpu.io import parquet_meta as pm
 from spark_rapids_tpu.io.device_parquet import (ChunkPlan, UnsupportedChunk,
                                                 _cast_one, _pad_np,
-                                                plan_chunk)
+                                                leaf_index_map, plan_chunk)
 from spark_rapids_tpu.plan.logical import Schema
 
 _END_SENTINEL = np.int32(1 << 30)
@@ -452,8 +452,7 @@ def _fused_list_column(sources, f, n_rows) -> Optional[DeviceColumn]:
     """Device list decode per row group + device concat for the fused
     batch; None -> host fallback."""
     from spark_rapids_tpu.columnar.batch import concat_batches
-    from spark_rapids_tpu.io.device_parquet import (decode_list_chunk,
-                                                    leaf_index_map)
+    from spark_rapids_tpu.io.device_parquet import decode_list_chunk
     try:
         per = []
         for (pf, path, rg), nr in zip(sources, n_rows):
@@ -504,8 +503,6 @@ def decode_row_groups_fused(sources: Sequence[Tuple[Any, str, int]],
         col_plans: List[Optional[ChunkPlan]] = []
         try:
             for pf, path, rg in sources:
-                from spark_rapids_tpu.io.device_parquet import \
-                    leaf_index_map
                 leaf_of = leaf_index_map(pf)
                 if c not in leaf_of:
                     col_plans.append(None)
@@ -554,7 +551,8 @@ def decode_row_groups_fused(sources: Sequence[Tuple[Any, str, int]],
                     arrs.append(_cast_one(t.select([c]), f).column(0))
                 else:
                     arrs.append(pa.nulls(t.num_rows if present
-                                         else md.row_group(rg).num_rows,
+                                         else pf.metadata.row_group(rg)
+                                         .num_rows,
                                          type=f.dtype.to_arrow()))
             tables.append(pa.Table.from_arrays(
                 arrs, names=list(fallbacks)))

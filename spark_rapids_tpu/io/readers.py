@@ -123,8 +123,14 @@ def _read_csv(path: str, options: dict) -> pa.Table:
                           convert_options=convert_opts)
 
 
-def _normalize(t: pa.Table, schema: Schema) -> pa.Table:
-    """Cast to the scan schema (timestamps to us/UTC etc.)."""
+def _normalize(t: pa.Table, schema: Schema,
+               permissive: bool = False) -> pa.Table:
+    """Cast to the scan schema (timestamps to us/UTC etc.).
+
+    ``permissive`` applies Spark's permissive-CSV semantics to numeric
+    narrowing: values an integer column cannot hold become null instead
+    of raising — used by every CSV path so the per-column device
+    fallback, the whole-file fallback and the CPU scan agree."""
     target = pa.schema([pa.field(f.name, f.dtype.to_arrow(), f.nullable)
                         for f in schema.fields])
     cols = []
@@ -132,9 +138,48 @@ def _normalize(t: pa.Table, schema: Schema) -> pa.Table:
         col = t.column(f.name) if f.name in t.column_names else None
         if col is None:
             cols.append(pa.nulls(t.num_rows, f.type))
+        elif permissive:
+            cols.append(_permissive_cast(col, f.type))
         else:
             cols.append(col.cast(f.type))
     return pa.Table.from_arrays(cols, schema=target)
+
+
+def _permissive_cast(col: pa.ChunkedArray, typ: pa.DataType):
+    """Arrow cast with Spark's permissive-CSV overflow semantics:
+    integer-column values out of range (int source) or out of
+    range/non-integral (float source) become null rather than raising
+    (stock safe cast) or wrapping (unsafe cast)."""
+    import numpy as np
+    import pyarrow.compute as pc
+    try:
+        return col.cast(typ)
+    except (pa.ArrowInvalid, pa.ArrowNotImplementedError):
+        if not pa.types.is_integer(typ):
+            raise
+        info = np.iinfo(typ.to_pandas_dtype())
+        if pa.types.is_floating(col.type):
+            # float(int64.max) rounds UP to 2^63, which is NOT a valid
+            # int64 — use a strict compare when the bound rounded so the
+            # boundary value nulls out instead of raising in the cast
+            hi = float(info.max)
+            hi_cmp = pc.less if int(hi) > info.max else pc.less_equal
+            ok = pc.and_kleene(
+                pc.equal(col, pc.trunc(col)),
+                pc.and_kleene(
+                    pc.greater_equal(col, pa.scalar(float(info.min),
+                                                    type=col.type)),
+                    hi_cmp(col, pa.scalar(hi, type=col.type))))
+        elif pa.types.is_integer(col.type):
+            ok = pc.and_kleene(
+                pc.greater_equal(col, pa.scalar(int(info.min),
+                                                type=col.type)),
+                pc.less_equal(col, pa.scalar(int(info.max),
+                                             type=col.type)))
+        else:
+            raise
+        return pc.if_else(ok, col,
+                          pa.scalar(None, type=col.type)).cast(typ)
 
 
 class CpuFileScanExec(PhysicalPlan):
@@ -204,7 +249,7 @@ class CpuFileScanExec(PhysicalPlan):
             t = t.append_column(k, col)
         schema = self._schema if not self.columns else Schema(
             [self._schema.field(c) for c in self.columns])
-        return _normalize(t, schema)
+        return _normalize(t, schema, permissive=(fmt == "csv"))
 
     def _batches(self, t: pa.Table) -> Iterator[pa.Table]:
         for off in range(0, max(t.num_rows, 1), self.max_rows):

@@ -108,6 +108,55 @@ def test_planned_csv_quoted_file_host_fallback_inside_exec(spark,
     assert out.column("s").to_pylist() == ["x,y", "plain"]
 
 
+def test_decode_csv_int32_out_of_range_falls_back(tmp_path):
+    # 3000000000 fits the int64 device fold but not int32: the device
+    # path must route the column to the host fallback instead of
+    # silently wrapping to a negative number; permissive semantics turn
+    # the overflow into null (Spark permissive CSV behavior)
+    p = str(tmp_path / "o.csv")
+    with open(p, "wb") as f:
+        f.write(b"a,b\n1,x\n3000000000,y\n-5,z\n")
+    schema = Schema.from_arrow(pa.schema(
+        [("a", pa.int32()), ("b", pa.string())]))
+    batch, fallbacks = dcsv.decode_csv(p, schema)
+    assert fallbacks == ["a"]
+    got = to_arrow(batch)
+    assert got.column("a").to_pylist() == [1, None, -5]
+    assert got.column("b").to_pylist() == ["x", "y", "z"]
+
+
+def test_decode_csv_fractional_in_int_column_is_null(tmp_path):
+    # '3.5' in an int32 column: device kernel routes the column to the
+    # host fallback (dot in integer field) and permissive semantics
+    # yield null, not a crash
+    p = str(tmp_path / "fr.csv")
+    with open(p, "wb") as f:
+        f.write(b"a,b\n1,x\n3.5,y\n-2,z\n")
+    schema = Schema.from_arrow(pa.schema(
+        [("a", pa.int32()), ("b", pa.string())]))
+    batch, fallbacks = dcsv.decode_csv(p, schema)
+    assert fallbacks == ["a"]
+    got = to_arrow(batch)
+    assert got.column("a").to_pylist() == [1, None, -2]
+
+
+def test_csv_whole_file_fallback_is_also_permissive(tmp_path):
+    # a quoted field forces the WHOLE-FILE host fallback; the same
+    # overflow value must yield null there too (same semantics on
+    # every CSV route)
+    from spark_rapids_tpu.io.readers import _normalize, _read_csv
+    p = str(tmp_path / "qperm.csv")
+    with open(p, "wb") as f:
+        f.write(b'a,s\n1,"x,y"\n3000000000,z\n')
+    schema = Schema.from_arrow(pa.schema(
+        [("a", pa.int32()), ("s", pa.string())]))
+    with pytest.raises(dcsv.UnsupportedCsv):
+        dcsv.decode_csv(p, schema)
+    t = _normalize(_read_csv(p, {"header": True, "sep": ","}),
+                   schema, permissive=True)
+    assert t.column("a").to_pylist() == [1, None]
+
+
 def test_csv_device_decode_kill_switch(tmp_path):
     s = TpuSparkSession({
         "spark.rapids.tpu.sql.format.csv.deviceDecode.enabled": False})
