@@ -182,9 +182,7 @@ def _build_device_pipeline(root: str):
     fp = pqf.assemble(plans, [schema.field(c).dtype for c in wanted],
                       wanted, n_rows)
     host_prep_s = time.perf_counter() - t0
-    decode = pqf._make_kernel(fp.key, fp.specs, fp.out_dtypes, fp.names,
-                              len(fp.n_rows), fp.arrays["runs"].shape[1],
-                              fp.vcap, fp.cap)
+    decode = pqf._make_kernel(fp)
     total_rows = sum(n_rows)
 
     def b(e):

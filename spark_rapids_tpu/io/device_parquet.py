@@ -164,10 +164,10 @@ def expand_runs_matrix(runs_mat: jnp.ndarray, packed: jnp.ndarray,
     one pass).  ``runs_mat`` is [rcap, 5] with columns (cumulative end,
     is_rle, value, bit_base, width); int32 or int64.
 
-    THE shared implementation of the searchsorted run lookup + 4-byte
-    window gather + shift/mask bit-unpack — used by both the per-column
-    decode (this module) and the fused whole-batch kernel
-    (io/parquet_fused.py), so the tricky bit math exists exactly once.
+    Used by the per-column decode path (this module) only; the fused
+    whole-batch kernel (io/parquet_fused.py) uses a dense phase-
+    decomposed unpack (_unpack_width + slice/scatter run expansion)
+    instead — when touching bit math here, check that module too.
     """
     ends = runs_mat[:, 0]
     i = jnp.arange(cap, dtype=ends.dtype)

@@ -3,19 +3,44 @@
 The per-column decode path (io/device_parquet.py) issues ~5 device
 dispatches and ~4 uploads per column per row group — hundreds per query.
 On any runtime that's dispatch overhead; on a tunneled/remote device it
-dominates the whole query (measured: r2's q6 bench spent >90% of wall
-clock on per-op round trips).  This module is the TPU-first answer to
-the reference's one-kernel-per-buffer decode (`Table.readParquet`,
+dominates the whole query.  This module is the TPU-first answer to the
+reference's one-kernel-per-buffer decode (`Table.readParquet`,
 reference: GpuParquetScan.scala:1022 — one libcudf call decodes every
 column of the assembled buffer):
 
   * the HOST walks pages for every column of every row group in the
     batch (O(pages+runs), reusing device_parquet.plan_chunk),
-  * all run tables pack into ONE [streams, rcap, 5] int32 matrix, all
-    bit-packed regions into ONE uint8 buffer, PLAIN values and
-    dictionaries into ONE buffer per wire dtype — ≤8 uploads total,
   * ONE jitted program expands runs, applies definition levels, gathers
     dictionaries and stitches row groups, emitting the whole batch.
+
+The round-4 kernel is a DENSE PHASE DECOMPOSITION — TPU gathers run at
+~90M lookups/s while dense vector ops stream at HBM bandwidth, so every
+per-element gather the round-3 kernel did (4-byte window reads + ~5
+run-metadata takes per element) is reformulated as dense work:
+
+  phase 0  bit-unpack: all bit-packed regions of one width concatenate
+           into one byte buffer; unpack is a reshape + shift/mask +
+           weighted-sum — O(bits) elementwise, ZERO gathers.  The
+           per-width value streams concatenate into ONE dense value
+           array (`dense_all`).
+  phase 1  run expansion:
+           - streams with few runs (the common case: pyarrow emits ~1
+             hybrid run per page) unroll as `dynamic_slice`s of
+             dense_all masked per run — dense copies, ZERO gathers;
+           - many-run streams use delta-scatter + cumsum to broadcast
+             per-run metadata (A = value-base − run-start, C =
+             value·2+is_rle) to elements, then ONE gather/element into
+             dense_all.
+  phase 2  definition levels: chunks whose def stream is all-valid
+           (no nulls — detected on host from the run table) skip level
+           expansion AND the null-scatter compaction entirely; only
+           truly-nullable segments pay the cumsum + take.
+  phase 3  dictionary gather — the one irreducible gather (the analog
+           of libcudf's dictionary decode).
+  phase 4  row-group stitching: sequential `dynamic_update_slice`
+           writes per segment (dense copies; segment k's padding tail
+           is overwritten by segment k+1's write) replace the round-3
+           per-column stitch gather.
 
 Every data-dependent number (row counts, buffer offsets, dictionary
 sizes) travels as a traced int32 operand; only power-of-two shape
@@ -25,7 +50,7 @@ and processes.
 
 from __future__ import annotations
 
-from dataclasses import dataclass
+from dataclasses import dataclass, field
 from typing import Any, Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
@@ -40,12 +65,17 @@ from spark_rapids_tpu.columnar.batch import (DeviceBatch, DeviceColumn,
                                              _bucket_strlen, bucket_rows,
                                              from_arrow)
 from spark_rapids_tpu.io import parquet_meta as pm
-from spark_rapids_tpu.io.device_parquet import (ChunkPlan, UnsupportedChunk,
-                                                _cast_one, _pad_np,
-                                                leaf_index_map, plan_chunk)
+from spark_rapids_tpu.io.device_parquet import (ChunkPlan, RunTable,
+                                                UnsupportedChunk, _cast_one,
+                                                _pad_np, leaf_index_map,
+                                                plan_chunk)
 from spark_rapids_tpu.plan.logical import Schema
 
-_END_SENTINEL = np.int32(1 << 30)
+_BIG = np.int32(1 << 30)
+# streams with at most this many hybrid runs expand as unrolled masked
+# dynamic_slices (dense); above it, the delta-scatter+cumsum general
+# path with one gather/element takes over
+_SLICE_MAX_RUNS = 8
 
 
 # ---------------------------------------------------------------------------
@@ -59,8 +89,8 @@ class _SegSpec:
     Only bucketed shapes live here (it is part of the kernel cache key);
     exact offsets/counts are traced operands in the meta vector."""
     mode: str             # 'dict' | 'dict_str' | 'plain' | 'bool' | 'null'
-    nullable: bool
-    def_stream: int = -1  # index into runs_mat, -1 = none
+    nullable: bool        # EFFECTIVE: False when def levels are all-valid
+    def_stream: int = -1  # global stream index, -1 = none
     val_stream: int = -1
     plain_key: str = ""   # wire dtype of the plain buffer
     dcap: int = 0         # bucketed dictionary rows
@@ -83,25 +113,53 @@ class _FusedPlan:
     n_rows: List[int]
     cap: int
     vcap: int
+    # per global stream: ('slice', row in sruns) | ('general', row in gruns)
+    stream_path: List[Tuple[str, int]] = field(default_factory=list)
+    nslcap: int = 1       # unroll count of the slice path
+    widths: Tuple[Tuple[int, int], ...] = ()   # (width, Ncap) sorted
 
 
-def _runs_to_rows(runs, packed_off_bits: int, rcap: int) -> np.ndarray:
-    """One stream's RunTable -> [rcap, 5] int32 row block."""
-    r = len(runs.counts)
-    mat = np.full((rcap, 5), 0, dtype=np.int32)
-    ends = np.cumsum(np.asarray(runs.counts, dtype=np.int64))
-    if np.any(ends > (1 << 30)):
-        raise UnsupportedChunk("stream too long for fused decode")
-    mat[:, 0] = _END_SENTINEL
-    mat[:r, 0] = ends.astype(np.int32)
-    mat[:r, 1] = np.asarray(runs.is_rle, dtype=np.int32)
-    mat[:r, 2] = np.asarray(runs.values, dtype=np.int32)
-    bases = np.asarray(runs.bit_bases, dtype=np.int64) + packed_off_bits
-    if np.any(bases + 32 > (np.int64(1) << 31)):
-        raise UnsupportedChunk("packed buffer too long for fused decode")
-    mat[:r, 3] = bases.astype(np.int32)
-    mat[:r, 4] = np.asarray(runs.widths, dtype=np.int32)
-    return mat
+def _all_valid(runs: RunTable) -> bool:
+    """True when a def-level stream encodes zero nulls (every run is an
+    RLE run of 1) — pyarrow writes exactly this for null-free pages."""
+    return all(r and v == 1
+               for r, v in zip(runs.is_rle, runs.values))
+
+
+def _stream_quads(runs: RunTable, packed: bytes,
+                  add_region) -> List[Tuple[int, int, int, int]]:
+    """Per-run (start, end, A, C) for one stream.
+
+    A = dense_all index of the run's first value minus the run's start
+    (so element i of the run reads dense_all[A + i]); C packs the RLE
+    value and flag as value*2+is_rle.  ``add_region(w, bytes) -> value
+    offset`` appends a bit-packed byte region to the width-w buffer and
+    returns its value offset within that buffer (resolved to a global
+    dense_all offset later via a per-width base)."""
+    n = len(runs.counts)
+    bp = [i for i in range(n) if not runs.is_rle[i]]
+    region_end = {}
+    for j, i in enumerate(bp):
+        b1 = runs.bit_bases[bp[j + 1]] // 8 if j + 1 < len(bp) \
+            else len(packed)
+        region_end[i] = b1
+    quads = []
+    pos = 0
+    for i in range(n):
+        c = runs.counts[i]
+        start, end = pos, pos + c
+        pos = end
+        if runs.is_rle[i]:
+            # A is irrelevant for RLE elements; carry 0 markers — the
+            # delta chain re-telescopes through whatever value we pick,
+            # and the slice path never reads A when C's flag is set
+            quads.append((start, end, None, (runs.values[i] << 1) | 1))
+        else:
+            w = runs.widths[i]
+            b0 = runs.bit_bases[i] // 8
+            off = add_region(w, packed[b0:region_end[i]])
+            quads.append((start, end, (w, off - start), 0))
+    return quads
 
 
 def assemble(plans: List[List[Optional[ChunkPlan]]],
@@ -112,11 +170,20 @@ def assemble(plans: List[List[Optional[ChunkPlan]]],
     plans[col][rg] is a ChunkPlan, or None for a column missing from
     that file (emitted as all-null rows for that segment)."""
     K = len(n_rows)
-    streams: List[Tuple[Any, bytes]] = []   # (RunTable, packed)
-    plain_parts: Dict[str, List[np.ndarray]] = {}
-    plain_sizes: Dict[str, int] = {}
-    dict_parts: Dict[str, List[np.ndarray]] = {}
-    dict_sizes: Dict[str, int] = {}
+    vcap = bucket_rows(max(max(n_rows, default=1), 1))
+    total = sum(n_rows)
+    cap = bucket_rows(max(total, 1))
+
+    width_bytes: Dict[int, List[bytes]] = {}
+    width_vals: Dict[int, int] = {}
+
+    def add_region(w: int, b: bytes) -> int:
+        off = width_vals.get(w, 0)
+        width_bytes.setdefault(w, []).append(b)
+        width_vals[w] = off + len(b) * 8 // w
+        return off
+
+    stream_quads: List[List[Tuple]] = []
     meta: List[int] = []
     specs: List[List[_SegSpec]] = []
 
@@ -124,19 +191,27 @@ def assemble(plans: List[List[Optional[ChunkPlan]]],
         meta.append(int(v))
         return len(meta) - 1
 
+    plain_parts: Dict[str, List[np.ndarray]] = {}
+    plain_sizes: Dict[str, int] = {}
+    dict_parts: Dict[str, List[np.ndarray]] = {}
+    dict_sizes: Dict[str, int] = {}
+
     for ci, col_plans in enumerate(plans):
         col_specs: List[_SegSpec] = []
         for r, p in enumerate(col_plans):
             if p is None:
                 col_specs.append(_SegSpec(mode="null", nullable=True))
                 continue
-            s = _SegSpec(mode=p.mode, nullable=p.nullable)
-            if p.nullable:
-                s.def_stream = len(streams)
-                streams.append((p.def_runs, p.def_packed))
+            nullable = p.nullable and not _all_valid(p.def_runs)
+            s = _SegSpec(mode=p.mode, nullable=nullable)
+            if nullable:
+                s.def_stream = len(stream_quads)
+                stream_quads.append(_stream_quads(
+                    p.def_runs, p.def_packed, add_region))
             if p.mode in ("dict", "dict_str", "bool"):
-                s.val_stream = len(streams)
-                streams.append((p.val_runs, p.val_packed))
+                s.val_stream = len(stream_quads)
+                stream_quads.append(_stream_quads(
+                    p.val_runs, p.val_packed, add_region))
             if p.mode == "plain":
                 key = str(p.plain_np.dtype)
                 s.plain_key = key
@@ -174,27 +249,81 @@ def assemble(plans: List[List[Optional[ChunkPlan]]],
             col_specs.append(s)
         specs.append(col_specs)
 
-    rcap = bucket_rows(max((len(rt.counts) for rt, _ in streams),
-                           default=1), 8)
-    S = max(len(streams), 1)
-    runs_mat = np.full((S, rcap, 5), 0, dtype=np.int32)
-    runs_mat[:, :, 0] = _END_SENTINEL
-    packed_chunks: List[bytes] = []
-    packed_off = 0
-    for si, (rt, pk) in enumerate(streams):
-        runs_mat[si] = _runs_to_rows(rt, packed_off * 8, rcap)
-        packed_chunks.append(pk)
-        packed_off += len(pk)
-    packed = b"".join(packed_chunks)
-    bcap = bucket_rows(max(len(packed), 4), 64)
+    # -- width layout: one dense value array, front-padded by vcap so a
+    # -- run's slice start (A >= dense_off - start >= vcap - vcap) is
+    # -- never negative
+    widths = tuple(sorted(width_vals))
+    w_caps = []
+    dense_off: Dict[int, int] = {}
+    off = vcap
+    for w in widths:
+        ncap = bucket_rows(width_vals[w], 16)   # multiple of 8
+        dense_off[w] = off
+        off += ncap
+        w_caps.append((w, ncap))
+    # tail pad of vcap: a run near the end of the last width section has
+    # A up to ~dense_len, and its dynamic_slice must fit un-clamped
+    dense_len = off + vcap
+    if dense_len > int(_BIG):
+        raise UnsupportedChunk("packed streams too long for fused decode")
+
+    # -- resolve stream runs to (start, end, A, C) with global A, and
+    # -- split into the slice path and the general path
+    stream_path: List[Tuple[str, int]] = []
+    sruns_rows: List[np.ndarray] = []
+    gruns_rows: List[np.ndarray] = []
+    max_slice_runs = 1
+    max_gen_runs = 1
+    resolved: List[List[Tuple[int, int, int, int]]] = []
+    for quads in stream_quads:
+        rs = []
+        a_carry = 0
+        for (start, end, pv, c) in quads:
+            if pv is not None:
+                w, rel = pv
+                a_carry = dense_off[w] + rel
+            rs.append((start, end, a_carry, c))
+        resolved.append(rs)
+        if len(rs) <= _SLICE_MAX_RUNS:
+            stream_path.append(("slice", len(sruns_rows)))
+            sruns_rows.append(None)   # placeholder, filled below
+            max_slice_runs = max(max_slice_runs, len(rs) or 1)
+        else:
+            stream_path.append(("general", len(gruns_rows)))
+            gruns_rows.append(None)
+            max_gen_runs = max(max_gen_runs, len(rs))
+
+    nslcap = _bucket_strlen(max_slice_runs)
+    rcap = bucket_rows(max_gen_runs, 8)
+    for si, rs in enumerate(resolved):
+        path, idx = stream_path[si]
+        if path == "slice":
+            mat = np.zeros((nslcap, 4), dtype=np.int32)
+            mat[:, 0] = _BIG        # empty range: start == end == BIG
+            mat[:, 1] = _BIG
+            for r, (st, en, a, c) in enumerate(rs):
+                mat[r] = (st, en, a, c)
+            sruns_rows[idx] = mat
+        else:
+            mat = np.zeros((rcap, 3), dtype=np.int32)
+            mat[:, 0] = _BIG        # scatter target past vcap: dropped
+            prev_a = prev_c = 0
+            for r, (st, en, a, c) in enumerate(rs):
+                mat[r] = (st, a - prev_a, c - prev_c)
+                prev_a, prev_c = a, c
+            gruns_rows[idx] = mat
 
     arrays: Dict[str, np.ndarray] = {
-        "runs": runs_mat,
-        "packed": _pad_np(np.frombuffer(packed, dtype=np.uint8), bcap),
         "nrows": np.asarray(n_rows, dtype=np.int32),
         "meta": np.asarray(meta or [0], dtype=np.int32),
+        "sruns": np.stack(sruns_rows) if sruns_rows else
+        np.zeros((1, nslcap, 4), dtype=np.int32),
+        "gruns": np.stack(gruns_rows) if gruns_rows else
+        np.zeros((1, rcap, 3), dtype=np.int32),
     }
-    vcap = bucket_rows(max(max(n_rows, default=1), 1))
+    for w, ncap in w_caps:
+        buf = np.frombuffer(b"".join(width_bytes[w]), dtype=np.uint8)
+        arrays[f"bits_{w}"] = _pad_np(buf, ncap * w // 8)
     for key, parts in plain_parts.items():
         buf = np.concatenate(parts) if len(parts) > 1 else parts[0]
         # slack so a dynamic_slice of size vcap never walks off the end
@@ -207,10 +336,9 @@ def assemble(plans: List[List[Optional[ChunkPlan]]],
         arrays["dict_" + key] = _pad_np(
             buf, bucket_rows(buf.shape[0] + pad, 64))
 
-    total = sum(n_rows)
-    cap = bucket_rows(max(total, 1))
-    key = ("pq_fused", tuple(names),
-           tuple(d.name for d in out_dtypes), K, rcap, bcap, vcap, cap,
+    key = ("pq_fused4", tuple(names),
+           tuple(d.name for d in out_dtypes), K, vcap, cap,
+           nslcap, rcap, tuple(stream_path), tuple(w_caps),
            tuple((a, arrays[a].shape, str(arrays[a].dtype))
                  for a in sorted(arrays)),
            tuple(tuple((s.mode, s.nullable, s.def_stream, s.val_stream,
@@ -219,25 +347,73 @@ def assemble(plans: List[List[Optional[ChunkPlan]]],
                        for s in row) for row in specs))
     return _FusedPlan(key=key, specs=specs, out_dtypes=out_dtypes,
                       names=names, arrays=arrays, n_rows=list(n_rows),
-                      cap=cap, vcap=vcap)
+                      cap=cap, vcap=vcap, stream_path=stream_path,
+                      nslcap=nslcap, widths=tuple(w_caps))
 
 
 # ---------------------------------------------------------------------------
 # Device kernel (traced once per _FusedPlan.key)
 # ---------------------------------------------------------------------------
 
-def _expand_stream(runs_row: jnp.ndarray, packed: jnp.ndarray,
-                   vcap: int) -> jnp.ndarray:
-    """Expand one stream's [rcap, 5] runs to [vcap] uint32 values —
-    delegates to the single shared bit-unpack implementation."""
-    from spark_rapids_tpu.io.device_parquet import expand_runs_matrix
-    return expand_runs_matrix(runs_row, packed, vcap)
+def _unpack_width(bytes_arr: jnp.ndarray, w: int, ncap: int) -> jnp.ndarray:
+    """Phase 0: dense bit-unpack of one width's byte buffer to [ncap]
+    uint32 values — reshape + shift/mask + weighted sum, no gathers.
+    Parquet packs LSB-first, which is exactly byte >> bit & 1 order."""
+    bits = ((bytes_arr[:, None] >>
+             jnp.arange(8, dtype=jnp.uint8)) & 1)          # [B, 8]
+    bits = bits.reshape(-1)                                # [ncap * w]
+    if w == 1:
+        return bits.astype(jnp.uint32)
+    vals = bits.reshape(ncap, w).astype(jnp.uint32)
+    return jnp.sum(vals << jnp.arange(w, dtype=jnp.uint32)[None, :],
+                   axis=1)
+
+
+def _expand_slice_stream(sruns_row: jnp.ndarray, dense_all: jnp.ndarray,
+                         vcap: int, nsl: int) -> jnp.ndarray:
+    """Phase 1, few-runs path: per run, one dynamic_slice of dense_all
+    (element i of a bit-packed run lives at dense_all[A + i]) masked to
+    the run's [start, end) range — dense copies, zero gathers."""
+    i = jnp.arange(vcap, dtype=jnp.int32)
+    out = jnp.zeros((vcap,), jnp.uint32)
+    hi = dense_all.shape[0] - vcap
+    for r in range(nsl):
+        start, end = sruns_row[r, 0], sruns_row[r, 1]
+        a, c = sruns_row[r, 2], sruns_row[r, 3]
+        shifted = jax.lax.dynamic_slice(
+            dense_all, (jnp.clip(a, 0, hi),), (vcap,))
+        vals = jnp.where((c & 1) != 0, (c >> 1).astype(jnp.uint32),
+                         shifted)
+        out = jnp.where((i >= start) & (i < end), vals, out)
+    return out
+
+
+def _expand_general(gruns: jnp.ndarray, dense_all: jnp.ndarray,
+                    vcap: int) -> jnp.ndarray:
+    """Phase 1, many-runs path: broadcast per-run metadata to elements
+    with delta-scatter + cumsum (A and C step functions), then ONE
+    gather/element into dense_all."""
+    def one(g):
+        starts = jnp.minimum(g[:, 0], vcap)   # padding rows drop
+        a = jnp.zeros((vcap,), jnp.int32).at[starts].add(
+            g[:, 1], mode="drop")
+        c = jnp.zeros((vcap,), jnp.int32).at[starts].add(
+            g[:, 2], mode="drop")
+        a = jnp.cumsum(a)
+        c = jnp.cumsum(c)
+        i = jnp.arange(vcap, dtype=jnp.int32)
+        idx = jnp.clip(a + i, 0, dense_all.shape[0] - 1)
+        vals = jnp.take(dense_all, idx)
+        return jnp.where((c & 1) != 0, (c >> 1).astype(jnp.uint32),
+                         vals)
+    return jax.vmap(one)(gruns)
 
 
 def _def_apply(levels: Optional[jnp.ndarray], values: jnp.ndarray,
                n_r: jnp.ndarray, vcap: int
                ) -> Tuple[jnp.ndarray, jnp.ndarray]:
-    """Definition levels -> (per-row values, validity) for one segment."""
+    """Definition levels -> (per-row values, validity) for one segment.
+    Segments with no nulls pass levels=None and skip the compaction."""
     row = jnp.arange(vcap, dtype=jnp.int32)
     if levels is None:
         valid = row < n_r
@@ -248,15 +424,21 @@ def _def_apply(levels: Optional[jnp.ndarray], values: jnp.ndarray,
     return jnp.take(values, vidx, axis=0), valid
 
 
-def _make_kernel(plan_key: Tuple, specs, out_dtypes, names, K: int,
-                 rcap: int, vcap: int, cap: int):
+def _make_kernel(fp: _FusedPlan):
     """Build the fused decode program for one static spec.
 
     Compile-size discipline: segments (column x row-group) are grouped
     by (mode, nullable, wire dtype, string stride) and each group is
     processed with ONE vmapped subgraph — so the HLO scales with the
     number of distinct segment SHAPES (a handful), not with columns x
-    row groups (which made cold compiles take minutes)."""
+    row groups."""
+    specs = fp.specs
+    out_dtypes = fp.out_dtypes
+    K = len(fp.n_rows)
+    vcap, cap = fp.vcap, fp.cap
+    stream_path = fp.stream_path
+    nslcap = fp.nslcap
+    w_caps = fp.widths
 
     # group segments by identical processing recipe
     groups: Dict[Tuple, List[Tuple[int, int]]] = {}
@@ -268,31 +450,39 @@ def _make_kernel(plan_key: Tuple, specs, out_dtypes, names, K: int,
             groups.setdefault(sig, []).append((ci, r))
 
     def kernel(arrays: Dict[str, jnp.ndarray]):
-        runs = arrays["runs"]
-        packed = arrays["packed"]
         nrows = arrays["nrows"]
         meta = arrays["meta"]
-        # ONE batched expansion for every stream (def levels, dict
-        # indices, bool bits)
-        expanded = jax.vmap(_expand_stream, in_axes=(0, None, None))(
-            runs, packed, vcap)                      # [S, vcap] uint32
+
+        # -- phase 0: dense per-width unpack -> one value array --------
+        dense_parts = [jnp.zeros((vcap,), jnp.uint32)]   # front pad
+        for w, ncap in w_caps:
+            dense_parts.append(
+                _unpack_width(arrays[f"bits_{w}"], w, ncap))
+        dense_parts.append(jnp.zeros((vcap,), jnp.uint32))  # tail pad
+        dense_all = jnp.concatenate(dense_parts)
+
+        # -- phase 1: expand every stream to [vcap] uint32 -------------
+        outs: List[Optional[jnp.ndarray]] = [None] * len(stream_path)
+        gen_rows = [idx for (p, idx) in stream_path if p == "general"]
+        gen_out = _expand_general(arrays["gruns"], dense_all, vcap) \
+            if gen_rows else None
+        for si, (path, idx) in enumerate(stream_path):
+            if path == "slice":
+                outs[si] = _expand_slice_stream(
+                    arrays["sruns"][idx], dense_all, vcap, nslcap)
+            else:
+                outs[si] = gen_out[idx]
+        expanded = jnp.stack(outs) if outs else \
+            jnp.zeros((1, vcap), jnp.uint32)
+
         cum = jnp.cumsum(nrows)
         total = cum[-1]
-        out_row = jnp.arange(cap, dtype=jnp.int32)
-        seg_of_row = jnp.searchsorted(cum, out_row, side="right")
-        seg_of_row = jnp.clip(seg_of_row, 0, K - 1)
-        prev = jnp.where(seg_of_row > 0,
-                         jnp.take(cum, seg_of_row - 1), 0)
-        local = out_row - prev
-        flat_idx = seg_of_row * vcap + local
-        row_exists = out_row < total
+        prevs = cum - nrows                        # [K] traced starts
 
-        # -- pass 1: one vmapped subgraph per group ------------------------
-        # group results: (ci, r) -> (data, valid[, lens])
+        # -- phases 2-3: one vmapped subgraph per group ----------------
         seg_out: Dict[Tuple[int, int], Tuple] = {}
         for sig, members in groups.items():
             mode, nullable, pkey, dlen = sig
-            s0 = specs[members[0][0]][members[0][1]]
             specs_m = [specs[ci][r] for ci, r in members]
             n_m = nrows[jnp.asarray([r for _, r in members])]
             if nullable:
@@ -388,7 +578,22 @@ def _make_kernel(plan_key: Tuple, specs, out_dtypes, names, K: int,
                 for (ci, r), d, v in zip(members, data_m, valid_m):
                     seg_out[(ci, r)] = (d, v)
 
-        # -- pass 2: stitch row groups per column --------------------------
+        # -- phase 4: stitch row groups per column ---------------------
+        # sequential dynamic_update_slice per segment: write k's padding
+        # tail [n_k, vcap) lands in [prevs[k]+n_k, prevs[k]+vcap), which
+        # write k+1 (starting at prevs[k]+n_k) fully overwrites; the
+        # last segment's tail is invalid-masked zeros by construction
+        cap_pad = cap + vcap
+
+        def stitch(parts, fill):
+            out = jnp.full((cap_pad,) + parts[0].shape[1:], fill,
+                           dtype=parts[0].dtype)
+            for k in range(K):
+                start = (prevs[k],) + \
+                    (jnp.int32(0),) * (parts[k].ndim - 1)
+                out = jax.lax.dynamic_update_slice(out, parts[k], start)
+            return out[:cap]
+
         cols: List[DeviceColumn] = []
         for ci, col_specs in enumerate(specs):
             odt = out_dtypes[ci]
@@ -420,24 +625,13 @@ def _make_kernel(plan_key: Tuple, specs, out_dtypes, names, K: int,
                     seg_data.append(out[0].astype(np_t))
                     seg_valid.append(out[1])
 
-            stacked = jnp.stack(seg_data)          # [K, vcap(, L)]
-            stackedv = jnp.stack(seg_valid)        # [K, vcap]
+            valid = stitch(seg_valid, False)
             if odt.is_string:
-                data = jnp.take(stacked.reshape(K * vcap, col_L),
-                                flat_idx, axis=0)
-                data = jnp.where(row_exists[:, None], data, 0)
-                lens = jnp.take(jnp.stack(seg_lens).reshape(-1),
-                                flat_idx)
-                lens = jnp.where(row_exists, lens, 0)
-                valid = jnp.take(stackedv.reshape(-1),
-                                 flat_idx) & row_exists
+                data = stitch(seg_data, np.uint8(0))
+                lens = stitch(seg_lens, np.int32(0))
                 cols.append(DeviceColumn(odt, data, valid, lens))
             else:
-                data = jnp.take(stacked.reshape(K * vcap), flat_idx)
-                data = jnp.where(row_exists, data,
-                                 jnp.zeros((), dtype=np_t))
-                valid = jnp.take(stackedv.reshape(-1),
-                                 flat_idx) & row_exists
+                data = stitch(seg_data, np.zeros((), np_t)[()])
                 cols.append(DeviceColumn(odt, data, valid))
         return tuple(cols), total
 
@@ -526,12 +720,7 @@ def decode_row_groups_fused(sources: Sequence[Tuple[Any, str, int]],
     if dev_plans:
         fp = assemble(dev_plans, dev_dtypes, dev_cols, n_rows)
         from spark_rapids_tpu.exec import kernel_cache as kc
-        kern = kc.get_kernel(
-            fp.key,
-            lambda: _make_kernel(fp.key, fp.specs, fp.out_dtypes,
-                                 fp.names, len(fp.n_rows),
-                                 fp.arrays["runs"].shape[1], fp.vcap,
-                                 fp.cap))
+        kern = kc.get_kernel(fp.key, lambda: _make_kernel(fp))
         dev_arrays = {k: jnp.asarray(v) for k, v in fp.arrays.items()}
         out_cols, _ = kern(dev_arrays)
         for name, col in zip(dev_cols, out_cols):
