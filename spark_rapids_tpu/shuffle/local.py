@@ -52,8 +52,10 @@ class _TagChannel:
         self._pending_recvs: Dict[int, deque] = {}
         self._completions: deque = deque()
         self._draining = False
+        self._failed: Optional[str] = None
 
     def _dispatch(self, completions) -> None:
+        """completions: (tx, status, payload, error) 4-tuples."""
         with self._lock:
             self._completions.extend(completions)
             if self._draining:
@@ -64,8 +66,9 @@ class _TagChannel:
                 with self._lock:
                     if not self._completions:
                         return
-                    tx, status, payload = self._completions.popleft()
-                tx.complete(status, payload=payload)
+                    tx, status, payload, error = \
+                        self._completions.popleft()
+                tx.complete(status, payload=payload, error=error)
         finally:
             with self._lock:
                 self._draining = False
@@ -73,30 +76,59 @@ class _TagChannel:
     def send(self, tag: int, data: bytes, tx: Transaction) -> None:
         recv = None
         with self._lock:
-            q = self._pending_recvs.get(tag)
-            if q:
-                recv = q.popleft()
-            else:
-                self._pending_sends.setdefault(tag, deque()).append(
-                    (data, tx))
-        if recv is not None:
+            failed = self._failed
+            if failed is None:
+                q = self._pending_recvs.get(tag)
+                if q:
+                    recv = q.popleft()
+                else:
+                    self._pending_sends.setdefault(tag, deque()).append(
+                        (data, tx))
+        if failed is not None:
+            self._dispatch([(tx, TransactionStatus.ERROR, None, failed)])
+        elif recv is not None:
             rtx, _nbytes = recv
-            self._dispatch([(tx, TransactionStatus.SUCCESS, None),
-                            (rtx, TransactionStatus.SUCCESS, data)])
+            self._dispatch([(tx, TransactionStatus.SUCCESS, None, None),
+                            (rtx, TransactionStatus.SUCCESS, data,
+                             None)])
 
     def receive(self, tag: int, nbytes: int, tx: Transaction) -> None:
         send = None
         with self._lock:
-            q = self._pending_sends.get(tag)
-            if q:
-                send = q.popleft()
-            else:
-                self._pending_recvs.setdefault(tag, deque()).append(
-                    (tx, nbytes))
-        if send is not None:
+            failed = self._failed
+            if failed is None:
+                q = self._pending_sends.get(tag)
+                if q:
+                    send = q.popleft()
+                else:
+                    self._pending_recvs.setdefault(tag, deque()).append(
+                        (tx, nbytes))
+        if failed is not None:
+            self._dispatch([(tx, TransactionStatus.ERROR, None, failed)])
+        elif send is not None:
             data, stx = send
-            self._dispatch([(stx, TransactionStatus.SUCCESS, None),
-                            (tx, TransactionStatus.SUCCESS, data)])
+            self._dispatch([(stx, TransactionStatus.SUCCESS, None,
+                             None),
+                            (tx, TransactionStatus.SUCCESS, data,
+                             None)])
+
+    def fail_all(self, error: str) -> None:
+        """Fail every queued send/receive AND mark the channel terminal:
+        operations posted after the failure complete with ERROR instead
+        of queueing forever (a disconnect racing a fetch would otherwise
+        stall the iterator to its timeout).  Completions route through
+        the trampoline like every other path."""
+        with self._lock:
+            self._failed = error
+            pending = [(tx, TransactionStatus.ERROR, None, error)
+                       for q in self._pending_sends.values()
+                       for (_data, tx) in q]
+            pending += [(tx, TransactionStatus.ERROR, None, error)
+                        for q in self._pending_recvs.values()
+                        for (tx, _n) in q]
+            self._pending_sends.clear()
+            self._pending_recvs.clear()
+        self._dispatch(pending)
 
 
 class LocalClientConnection(ClientConnection):

@@ -77,6 +77,12 @@ class ShuffleEnv:
     def client_for(self, peer_executor_id: str) -> RapidsShuffleClient:
         with self._lock:
             c = self._clients.get(peer_executor_id)
+            # a dead connection (peer restarted, network drop) must not
+            # pin this peer to permanent failure: rebuild the wrapper so
+            # the transport can reconnect (its make_client revalidates)
+            if c is not None and getattr(c.connection, "closed", False):
+                self._clients.pop(peer_executor_id, None)
+                c = None
             if c is None:
                 c = RapidsShuffleClient(
                     self.transport.make_client(peer_executor_id),

@@ -1,0 +1,378 @@
+"""TCP socket shuffle transport: the cross-process/DCN data plane.
+
+Reference analog: the UCX transport plugin
+(``shuffle-plugin/.../ucx/UCX.scala:53-533``) — a TCP management
+handshake (UCX.scala:192-246) plus tag-matched buffer transfers
+(UCX.scala:247-311) behind the ``RapidsShuffleTransport`` SPI.  On TPU
+pods the intra-slice data plane is ICI collectives (shuffle/ici.py); this
+transport is the DCN stand-in that moves shuffle bytes BETWEEN engine
+processes/hosts, proving the client/server/iterator state machines over a
+real process boundary (the round-3 gap: only the in-process loopback
+existed).
+
+Wire protocol (little-endian, length-prefixed frames like
+pyworker/worker.py):
+
+    frame   := u8 kind, u64 tag, u32 len, len bytes
+    HELLO   := kind 0, payload = client executor id (utf-8); sent once
+               per connection so the server can route streaming DATA
+               frames back over the same socket (the reference's
+               "rapids=<port>" MapStatus topology plays this role)
+    REQ     := kind 1, tag = request id, payload = control frame
+    RESP    := kind 2, tag = request id, payload = response frame
+    DATA    := kind 3, tag = transfer tag, payload = buffer bytes
+
+Tag-matched receives reuse the loopback's rendezvous channel
+(shuffle/local.py _TagChannel): the socket reader posts arriving DATA
+frames as "sends" into the channel, client code posts receives — sends
+arriving before their matching receive queue, exactly UCX's
+expected-tag semantics.
+"""
+
+from __future__ import annotations
+
+import socket
+import struct
+import threading
+from typing import Callable, Dict, List, Optional, Tuple
+
+from spark_rapids_tpu.shuffle.local import _TagChannel
+from spark_rapids_tpu.shuffle.transport import (ClientConnection,
+                                                ServerConnection,
+                                                ShuffleTransport,
+                                                Transaction,
+                                                TransactionStatus)
+
+_HELLO, _REQ, _RESP, _DATA, _ERR = 0, 1, 2, 3, 4
+_HDR = struct.Struct("<BQI")
+
+
+def _send_frame(sock: socket.socket, kind: int, tag: int,
+                payload: bytes, lock: threading.Lock) -> None:
+    with lock:
+        sock.sendall(_HDR.pack(kind, tag, len(payload)))
+        if payload:
+            sock.sendall(payload)
+
+
+def _recv_exact(sock: socket.socket, n: int) -> Optional[bytes]:
+    buf = bytearray()
+    while len(buf) < n:
+        chunk = sock.recv(n - len(buf))
+        if not chunk:
+            return None
+        buf += chunk
+    return bytes(buf)
+
+
+def _read_frame(sock: socket.socket
+                ) -> Optional[Tuple[int, int, bytes]]:
+    hdr = _recv_exact(sock, _HDR.size)
+    if hdr is None:
+        return None
+    kind, tag, ln = _HDR.unpack(hdr)
+    payload = _recv_exact(sock, ln) if ln else b""
+    if ln and payload is None:
+        return None
+    return kind, tag, payload
+
+
+class TcpClientConnection(ClientConnection):
+    """Reducer-side connection to one mapper executor over one socket."""
+
+    def __init__(self, local_executor_id: str, host: str, port: int):
+        self.local_executor_id = local_executor_id
+        self.channel = _TagChannel()
+        self._sock = socket.create_connection((host, port), timeout=30)
+        self._sock.settimeout(None)
+        self._wlock = threading.Lock()
+        self._reqs: Dict[int, Transaction] = {}
+        self._req_lock = threading.Lock()
+        self._next_req = 0
+        self._closed = False
+        _send_frame(self._sock, _HELLO, 0,
+                    local_executor_id.encode(), self._wlock)
+        self._reader = threading.Thread(target=self._read_loop,
+                                        daemon=True)
+        self._reader.start()
+
+    def _read_loop(self) -> None:
+        while True:
+            try:
+                frame = _read_frame(self._sock)
+            except OSError:
+                frame = None
+            if frame is None:
+                self._fail_all("connection closed")
+                return
+            kind, tag, payload = frame
+            if kind == _RESP:
+                with self._req_lock:
+                    tx = self._reqs.pop(tag, None)
+                if tx is not None:
+                    tx.complete(TransactionStatus.SUCCESS,
+                                payload=payload)
+            elif kind == _ERR:
+                with self._req_lock:
+                    tx = self._reqs.pop(tag, None)
+                if tx is not None:
+                    tx.complete(TransactionStatus.ERROR,
+                                error=payload.decode(errors="replace"))
+            elif kind == _DATA:
+                # post as a "send" into the rendezvous; a dummy tx
+                # carries the completion the channel requires
+                stx = Transaction(tag)
+                stx.start(None)
+                self.channel.send(tag, payload, stx)
+
+    def _fail_all(self, msg: str) -> None:
+        with self._req_lock:
+            self._closed = True
+            pending = list(self._reqs.values())
+            self._reqs.clear()
+        for tx in pending:
+            tx.complete(TransactionStatus.ERROR, error=msg)
+        # posted tagged receives must fail too, or a mid-transfer
+        # disconnect stalls the iterator until its timeout
+        self.channel.fail_all(msg)
+
+    def request(self, data: bytes, cb) -> Transaction:
+        tx = Transaction()
+        tx.start(cb)
+        with self._req_lock:
+            if self._closed:
+                closed = True
+            else:
+                closed = False
+                rid = self._next_req
+                self._next_req += 1
+                self._reqs[rid] = tx
+        if closed:
+            tx.complete(TransactionStatus.ERROR,
+                        error="connection closed")
+            return tx
+        try:
+            _send_frame(self._sock, _REQ, rid, data, self._wlock)
+        except OSError as e:
+            with self._req_lock:
+                self._reqs.pop(rid, None)
+            tx.complete(TransactionStatus.ERROR, error=str(e))
+        return tx
+
+    def receive(self, tag: int, nbytes: int, cb) -> Transaction:
+        tx = Transaction(tag)
+        tx.start(cb)
+        if self._closed:
+            tx.complete(TransactionStatus.ERROR,
+                        error="connection closed")
+            return tx
+        self.channel.receive(tag, nbytes, tx)
+        return tx
+
+    @property
+    def closed(self) -> bool:
+        return self._closed
+
+    def close(self) -> None:
+        try:
+            self._sock.close()
+        except OSError:
+            pass
+
+
+class _DeadClientConnection(ClientConnection):
+    """Returned when a (re)connect fails: every operation completes with
+    ERROR so the client/iterator state machines surface fetch-failed —
+    connection failures are data-plane errors, not caller crashes."""
+
+    def __init__(self, msg: str):
+        self._msg = msg
+        self.closed = True
+
+    def request(self, data: bytes, cb) -> Transaction:
+        tx = Transaction()
+        tx.start(cb)
+        tx.complete(TransactionStatus.ERROR, error=self._msg)
+        return tx
+
+    def receive(self, tag: int, nbytes: int, cb) -> Transaction:
+        tx = Transaction(tag)
+        tx.start(cb)
+        tx.complete(TransactionStatus.ERROR, error=self._msg)
+        return tx
+
+    def close(self) -> None:
+        pass
+
+
+class TcpServerConnection(ServerConnection):
+    """Mapper-side listener: accepts client sockets, routes REQ frames to
+    the handler, streams DATA frames back over the requester's socket."""
+
+    def __init__(self, executor_id: str, port: int = 0):
+        self.executor_id = executor_id
+        self.handler: Optional[Callable] = None
+        self._peers: Dict[str, Tuple[socket.socket, threading.Lock]] = {}
+        self._peer_lock = threading.Lock()
+        self._accepted: List[socket.socket] = []
+        self._lsock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        self._lsock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        self._lsock.bind(("127.0.0.1", port))
+        self._lsock.listen(64)
+        self.port = self._lsock.getsockname()[1]
+        self._accept_thread = threading.Thread(target=self._accept_loop,
+                                               daemon=True)
+        self._accept_thread.start()
+
+    def register_request_handler(self, handler) -> None:
+        self.handler = handler
+
+    def _accept_loop(self) -> None:
+        while True:
+            try:
+                sock, _addr = self._lsock.accept()
+            except OSError:
+                return
+            with self._peer_lock:
+                self._accepted.append(sock)
+            threading.Thread(target=self._serve_conn, args=(sock,),
+                             daemon=True).start()
+
+    def _serve_conn(self, sock: socket.socket) -> None:
+        wlock = threading.Lock()
+        peer_id: Optional[str] = None
+        try:
+            while True:
+                try:
+                    frame = _read_frame(sock)
+                except OSError:
+                    frame = None
+                if frame is None:
+                    return
+                kind, tag, payload = frame
+                if kind == _HELLO:
+                    peer_id = payload.decode()
+                    with self._peer_lock:
+                        self._peers[peer_id] = (sock, wlock)
+                elif kind == _REQ and self.handler is not None:
+                    try:
+                        resp_kind, resp = _RESP, self.handler(
+                            payload, peer_id or "")
+                    except Exception as e:  # surfaced as transport error
+                        resp_kind, resp = _ERR, str(e).encode()
+                    try:
+                        _send_frame(sock, resp_kind, tag, resp or b"",
+                                    wlock)
+                    except OSError:
+                        return
+        finally:
+            # every exit path: drop our peer entry (a reconnect may have
+            # registered a NEWER socket under this id — only drop our
+            # own), close the fd, and prune the accepted list
+            with self._peer_lock:
+                if peer_id is not None:
+                    cur = self._peers.get(peer_id)
+                    if cur is not None and cur[0] is sock:
+                        self._peers.pop(peer_id, None)
+                try:
+                    self._accepted.remove(sock)
+                except ValueError:
+                    pass
+            try:
+                sock.close()
+            except OSError:
+                pass
+
+    def send(self, peer_executor_id: str, tag: int, data: bytes,
+             cb) -> Transaction:
+        tx = Transaction(tag)
+        tx.start(cb)
+        with self._peer_lock:
+            peer = self._peers.get(peer_executor_id)
+        if peer is None:
+            tx.complete(TransactionStatus.ERROR,
+                        error=f"no connection from {peer_executor_id}")
+            return tx
+        sock, wlock = peer
+        try:
+            _send_frame(sock, _DATA, tag, data, wlock)
+            tx.complete(TransactionStatus.SUCCESS)
+        except OSError as e:
+            tx.complete(TransactionStatus.ERROR, error=str(e))
+        return tx
+
+    def close(self) -> None:
+        try:
+            self._lsock.close()
+        except OSError:
+            pass
+        with self._peer_lock:
+            accepted, self._accepted = self._accepted, []
+            self._peers.clear()
+        for sock in accepted:
+            try:
+                sock.close()
+            except OSError:
+                pass
+
+
+class TcpShuffleTransport(ShuffleTransport):
+    """Socket transport loadable via ``make_transport`` exactly like the
+    reference's UCX plugin (RapidsShuffleTransport.scala:542-576).
+
+    conf (dict or RapidsTpuConf-like with ``.get``):
+      * ``listen_port``: server bind port (default 0 = ephemeral)
+      * ``peers``: {executor_id: (host, port)} address book; entries can
+        be added later via ``add_peer`` (the analog of discovering a
+        peer's port from MapStatus topology)
+    """
+
+    def __init__(self, executor_id: str, conf=None):
+        super().__init__(executor_id, conf)
+        conf = conf or {}
+        get = conf.get if hasattr(conf, "get") else lambda k, d=None: d
+        self._peers: Dict[str, Tuple[str, int]] = dict(
+            get("peers", {}) or {})
+        self._listen_port = int(get("listen_port", 0) or 0)
+        self._server: Optional[TcpServerConnection] = None
+        self._clients: Dict[str, TcpClientConnection] = {}
+
+    def add_peer(self, executor_id: str, host: str, port: int) -> None:
+        self._peers[executor_id] = (host, port)
+
+    def make_client(self, peer_executor_id: str) -> TcpClientConnection:
+        cached = self._clients.get(peer_executor_id)
+        if cached is not None:
+            if not cached.closed:
+                return cached
+            # dead connection (peer restarted / network drop): reconnect
+            # to the current address book entry
+            cached.close()
+            del self._clients[peer_executor_id]
+        if peer_executor_id not in self._peers:
+            raise KeyError(f"unknown peer {peer_executor_id}; "
+                           f"add_peer() or conf['peers'] required")
+        host, port = self._peers[peer_executor_id]
+        try:
+            c = TcpClientConnection(self.executor_id, host, port)
+        except OSError as e:
+            # do NOT cache: the next make_client retries the connect
+            return _DeadClientConnection(
+                f"connect to {peer_executor_id} at {host}:{port} "
+                f"failed: {e}")
+        self._clients[peer_executor_id] = c
+        return c
+
+    def server(self) -> TcpServerConnection:
+        if self._server is None:
+            self._server = TcpServerConnection(self.executor_id,
+                                               self._listen_port)
+        return self._server
+
+    def shutdown(self) -> None:
+        for c in self._clients.values():
+            c.close()
+        self._clients.clear()
+        if self._server is not None:
+            self._server.close()
+            self._server = None

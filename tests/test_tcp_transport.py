@@ -1,0 +1,215 @@
+"""Cross-process shuffle over the TCP socket transport.
+
+The round-3 gap (VERDICT): the client/server/iterator protocol stack had
+never moved a byte between two OS processes.  These tests start a REAL
+second engine process that registers map output in its shuffle catalog
+and serves it over ``TcpShuffleTransport``; the parent fetches through
+the standard client/iterator state machines.  Reference analog: the UCX
+transport's executor-to-executor pulls
+(shuffle-plugin/.../ucx/UCX.scala:53-533, mgmt handshake :192-246).
+"""
+
+import json
+import os
+import subprocess
+import sys
+import time
+
+import numpy as np
+import pyarrow as pa
+import pytest
+
+from spark_rapids_tpu.shuffle.catalogs import ShuffleReceivedBufferCatalog
+from spark_rapids_tpu.shuffle.client import RapidsShuffleClient
+from spark_rapids_tpu.shuffle.iterator import (
+    RapidsShuffleFetchFailedException, RapidsShuffleIterator, RemoteSource)
+from spark_rapids_tpu.shuffle.tcp import TcpShuffleTransport
+
+_SERVER_SCRIPT = r"""
+import json, sys
+import jax
+jax.config.update("jax_platforms", "cpu")
+import numpy as np
+import pyarrow as pa
+from spark_rapids_tpu.columnar.batch import from_arrow
+from spark_rapids_tpu.shuffle.catalogs import ShuffleBufferCatalog
+from spark_rapids_tpu.shuffle.server import ShuffleServer
+from spark_rapids_tpu.shuffle.tcp import TcpShuffleTransport
+
+seed = int(sys.argv[1])
+n = int(sys.argv[2])
+rng = np.random.default_rng(seed)
+t = pa.table({
+    "v": pa.array(rng.integers(0, 1 << 30, n)),
+    "s": pa.array([f"row-{i}" for i in range(n)]),
+})
+cat = ShuffleBufferCatalog()
+cat.register_batch(1, 0, 0, from_arrow(t))
+# second partition: different rows
+t2 = pa.table({"v": pa.array(rng.integers(0, 100, 17)),
+               "s": pa.array([f"p1-{i}" for i in range(17)])})
+cat.register_batch(1, 0, 1, from_arrow(t2))
+tr = TcpShuffleTransport("mapper", {"listen_port": 0})
+srv_conn = tr.server()
+ShuffleServer("mapper", cat, srv_conn)
+print(json.dumps({"port": srv_conn.port}), flush=True)
+sys.stdin.readline()   # parent closes stdin (or sends a line) to stop
+"""
+
+
+def _expected_table(seed, n):
+    rng = np.random.default_rng(seed)
+    return pa.table({
+        "v": pa.array(rng.integers(0, 1 << 30, n)),
+        "s": pa.array([f"row-{i}" for i in range(n)]),
+    })
+
+
+def _start_server(seed=7, n=20_000):
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    proc = subprocess.Popen(
+        [sys.executable, "-c", _SERVER_SCRIPT, str(seed), str(n)],
+        stdin=subprocess.PIPE, stdout=subprocess.PIPE,
+        cwd="/root/repo", env=env, text=True)
+    line = proc.stdout.readline()
+    if not line:
+        proc.kill()
+        raise RuntimeError("server subprocess died before reporting port")
+    port = json.loads(line)["port"]
+    return proc, port
+
+
+def test_two_process_fetch_parity():
+    proc, port = _start_server()
+    try:
+        tr = TcpShuffleTransport(
+            "reducer", {"peers": {"mapper": ("127.0.0.1", port)}})
+        recv = ShuffleReceivedBufferCatalog()
+        client = RapidsShuffleClient(tr.make_client("mapper"), recv,
+                                     bounce_window=4096)
+        batches, dones = [], []
+        client.do_fetch(1, 0, None, batches.append, dones.append)
+        t0 = time.time()
+        while not dones and time.time() - t0 < 30:
+            time.sleep(0.01)
+        assert dones == [None], dones
+        assert len(batches) == 1
+        got = recv.materialize(batches[0])
+        assert got.equals(_expected_table(7, 20_000))
+        tr.shutdown()
+    finally:
+        proc.kill()
+        proc.wait()
+
+
+def test_two_process_iterator_both_partitions():
+    proc, port = _start_server()
+    try:
+        tr = TcpShuffleTransport(
+            "reducer", {"peers": {"mapper": ("127.0.0.1", port)}})
+        recv = ShuffleReceivedBufferCatalog()
+        tables = []
+        for rid, expect_rows in ((0, 20_000), (1, 17)):
+            client = RapidsShuffleClient(tr.make_client("mapper"), recv,
+                                         bounce_window=4096)
+            it = RapidsShuffleIterator(
+                1, rid, None, [RemoteSource("mapper", client)], recv,
+                timeout_s=30)
+            got = list(it)
+            assert len(got) == 1 and got[0].num_rows == expect_rows
+            tables.append(got[0])
+        assert tables[0].equals(_expected_table(7, 20_000))
+        assert tables[1].column("s").to_pylist()[0].startswith("p1-")
+        tr.shutdown()
+    finally:
+        proc.kill()
+        proc.wait()
+
+
+def test_two_process_fetch_failed_after_server_death():
+    proc, port = _start_server(n=500)
+    tr = TcpShuffleTransport(
+        "reducer", {"peers": {"mapper": ("127.0.0.1", port)}})
+    recv = ShuffleReceivedBufferCatalog()
+    client = RapidsShuffleClient(tr.make_client("mapper"), recv,
+                                 bounce_window=4096)
+    # first fetch works
+    batches, dones = [], []
+    client.do_fetch(1, 0, None, batches.append, dones.append)
+    t0 = time.time()
+    while not dones and time.time() - t0 < 30:
+        time.sleep(0.01)
+    assert dones == [None]
+    # kill the server, then a fresh fetch must surface fetch-failed
+    proc.kill()
+    proc.wait()
+    time.sleep(0.2)
+    client2 = RapidsShuffleClient(tr.make_client("mapper"), recv,
+                                  bounce_window=4096)
+    it = RapidsShuffleIterator(
+        1, 0, None, [RemoteSource("mapper", client2)], recv,
+        timeout_s=10)
+    with pytest.raises(RapidsShuffleFetchFailedException):
+        list(it)
+    tr.shutdown()
+
+
+def test_posted_receive_fails_fast_on_disconnect():
+    # a receive posted before the server dies must complete with ERROR
+    # immediately on disconnect, not stall to the iterator timeout
+    proc, port = _start_server(n=100)
+    tr = TcpShuffleTransport(
+        "reducer", {"peers": {"mapper": ("127.0.0.1", port)}})
+    conn = tr.make_client("mapper")
+    done = []
+    conn.receive(999, 64, lambda tx: done.append(tx.status))
+    proc.kill()
+    proc.wait()
+    t0 = time.time()
+    while not done and time.time() - t0 < 5:
+        time.sleep(0.01)
+    from spark_rapids_tpu.shuffle.transport import TransactionStatus
+    assert done and done[0] == TransactionStatus.ERROR
+    tr.shutdown()
+
+
+def test_make_client_reconnects_after_peer_restart():
+    proc, port = _start_server(seed=5, n=300)
+    tr = TcpShuffleTransport(
+        "reducer", {"peers": {"mapper": ("127.0.0.1", port)}})
+    recv = ShuffleReceivedBufferCatalog()
+
+    def fetch_ok():
+        client = RapidsShuffleClient(tr.make_client("mapper"), recv,
+                                     bounce_window=2048)
+        batches, dones = [], []
+        client.do_fetch(1, 0, None, batches.append, dones.append)
+        t0 = time.time()
+        while not dones and time.time() - t0 < 20:
+            time.sleep(0.01)
+        return dones == [None]
+
+    assert fetch_ok()
+    proc.kill()
+    proc.wait()
+    time.sleep(0.2)
+    # peer restarts on a NEW port; add_peer + make_client must reconnect
+    proc2, port2 = _start_server(seed=5, n=300)
+    try:
+        tr.add_peer("mapper", "127.0.0.1", port2)
+        assert fetch_ok()
+    finally:
+        proc2.kill()
+        proc2.wait()
+    tr.shutdown()
+
+
+def test_make_transport_loads_tcp():
+    from spark_rapids_tpu.shuffle.transport import make_transport
+    t = make_transport("spark_rapids_tpu.shuffle.tcp.TcpShuffleTransport",
+                      "e9", {"listen_port": 0})
+    assert isinstance(t, TcpShuffleTransport)
+    srv = t.server()
+    assert srv.port > 0
+    t.shutdown()
