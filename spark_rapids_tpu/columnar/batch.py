@@ -150,8 +150,13 @@ class DeviceBatch:
     # num_rows travels as a leaf so jit does NOT specialize on it — only on
     # capacity/schema (the XLA static-shape bucketing contract)
     def tree_flatten(self):
-        leaves = tuple(self.columns) + (
-            jnp.asarray(self.num_rows, dtype=jnp.int32),)
+        # flatten must be purely structural: transforms (lax.cond, vmap)
+        # round-trip pytrees through abstract values, and coercing here
+        # would call jnp.asarray on an aval.  Coerce only host ints.
+        nr = self.num_rows
+        if isinstance(nr, (int, np.integer)):
+            nr = jnp.asarray(nr, dtype=jnp.int32)
+        leaves = tuple(self.columns) + (nr,)
         return leaves, (tuple(self.names), self._capacity)
 
     @classmethod

@@ -170,6 +170,22 @@ PARQUET_DEVICE_ENCODE = conf(
     "Table.writeParquetChunked). Unsupported types or partitioned "
     "writes fall back to the host Arrow writer.", bool)
 
+ORC_DEVICE_ENCODE = conf(
+    "spark.rapids.tpu.sql.format.orc.deviceEncode.enabled", True,
+    "Encode ORC writes from device batches: per-column null compaction "
+    "on device, one packed download, host RLEv1/protobuf stripe "
+    "assembly (reference: GpuOrcFileFormat.scala:103 "
+    "Table.writeORCChunked). Unsupported types or partitioned writes "
+    "fall back to the host Arrow writer.", bool)
+
+CACHE_DEVICE_ENCODE = conf(
+    "spark.rapids.tpu.sql.cache.deviceEncode.enabled", True,
+    "Compress df.cache() batches to parquet blobs with the DEVICE "
+    "encoder instead of host Arrow (reference: "
+    "ParquetCachedBatchSerializer.scala:333 "
+    "compressColumnarBatchWithParquet encodes cached batches on GPU).",
+    bool)
+
 PARQUET_FUSED_DECODE = conf(
     "spark.rapids.tpu.sql.format.parquet.fusedDecode.enabled", True,
     "Decode ALL columns of ALL coalesced row groups in one XLA program "
@@ -232,8 +248,12 @@ SHUFFLE_TRANSPORT = conf(
     "Shuffle transport implementation: 'local' (in-process Arrow IPC store, "
     "the default-path analog), 'device' (HBM-resident slices, one process), "
     "'manager' (accelerated TpuShuffleManager: device-resident catalog + "
-    "tag-matched client/server transport), or 'ici' (device-resident "
-    "all_to_all over a jax Mesh; reference: shuffle-plugin UCX transport).")
+    "tag-matched client/server transport), 'ici' (device-resident "
+    "all_to_all over a jax Mesh; reference: shuffle-plugin UCX "
+    "transport), or 'ici_ring' (like 'ici' but broadcast builds "
+    "replicate via collective_permute ring hops — the point-to-point "
+    "plane; reference: tag-matched per-peer pulls, "
+    "UCXConnection.scala:385).")
 
 SHUFFLE_COMPRESSION_CODEC = conf(
     "spark.rapids.tpu.shuffle.compression.codec", "none",

@@ -328,7 +328,7 @@ def wrap_join_children(left: PhysicalPlan, right: PhysicalPlan, how: str,
     # the ICI plane keeps reducer batches committed to their owning mesh
     # device; the adaptive reader's cross-partition coalesce would force
     # cross-device concats, so exchanges ride ICI un-wrapped
-    if str(conf_obj.get(cfg.SHUFFLE_TRANSPORT)) == "ici":
+    if str(conf_obj.get(cfg.SHUFFLE_TRANSPORT)) in ("ici", "ici_ring"):
         return left, right
     if not (isinstance(left, TpuShuffleExchangeExec)
             and isinstance(right, TpuShuffleExchangeExec)

@@ -33,7 +33,13 @@ from spark_rapids_tpu.plan.logical import CachedRelation, Schema
 def materialize(relation: CachedRelation, conf) -> None:
     """Build the cache: run the child plan once, encode each partition
     as one parquet blob (single row group, so device decode sees the
-    same page layout as a file scan)."""
+    same page layout as a file scan).
+
+    When the child plan ends on device, batches are encoded by the
+    DEVICE parquet encoder (reference:
+    ParquetCachedBatchSerializer.scala:333
+    compressColumnarBatchWithParquet encodes cached batches on GPU);
+    otherwise host Arrow encodes."""
     if relation.materialized:
         return
     from spark_rapids_tpu.plan.overrides import TpuOverrides
@@ -44,7 +50,33 @@ def materialize(relation: CachedRelation, conf) -> None:
     result = TpuOverrides.apply(cpu_plan, conf)
     from spark_rapids_tpu.exec.cpu import _empty_table
     codec = str(conf.get(cfg.CACHE_COMPRESSION))
-    blobs: List[bytes] = []
+    relation.device_encoded = False
+
+    from spark_rapids_tpu.exec.tpu_basic import DeviceToHostExec
+    from spark_rapids_tpu.io import parquet_encode as pqe
+    if (conf.get(cfg.CACHE_DEVICE_ENCODE) and
+            isinstance(result.plan, DeviceToHostExec) and
+            pqe.supported(result.plan.schema.fields) and
+            codec in ("snappy", "zstd", "none", "uncompressed")):
+        from spark_rapids_tpu.columnar.batch import concat_batches
+        blobs: List[bytes] = []
+        for it in result.plan.children[0].execute():
+            batches = [b for b in it if int(b.num_rows)]
+            if batches:
+                whole = concat_batches(batches) if len(batches) > 1 \
+                    else batches[0]
+                blobs.append(pqe.encode_batch(whole, codec=codec))
+            else:
+                buf = io.BytesIO()
+                papq.write_table(_empty_table(relation.schema), buf,
+                                 compression=codec)
+                blobs.append(buf.getvalue())
+        if blobs:
+            relation.blobs = blobs
+            relation.device_encoded = True
+            return
+
+    blobs = []
     for it in result.plan.execute():
         tables = [t for t in it]
         # empty partitions cache as empty blobs so the cached relation

@@ -21,7 +21,7 @@ hash-aggregate and the sort-merge join.
 
 from __future__ import annotations
 
-from typing import List
+from typing import List, Tuple
 
 import numpy as np
 
@@ -95,6 +95,123 @@ def encode_keys(v: ColVal, ascending: bool = True,
     return keys
 
 
+def encode_fields(v: ColVal, ascending: bool = True,
+                  nulls_first: bool = True, nullable: bool = True
+                  ) -> List[Tuple[int, jnp.ndarray]]:
+    """Encode one column as BIT-WIDTH-AWARE key fields, most significant
+    first: (width_bits, uint64 values masked to width).
+
+    The u64-word encoding (encode_keys) spends a full 64-bit word on
+    every key — a 1-bit null flag costs the same radix passes as an
+    int64.  Fields pack to their true width (bool=1, int32/float32/
+    date=32, int64/float64=64 split into two 32-bit halves, string
+    length=16), so fields_to_digits can chop the concatenated bitstring
+    into ~2x fewer u32 radix digits.  Schema-non-nullable columns skip
+    the null field entirely."""
+    fields: List[Tuple[int, jnp.ndarray]] = []
+    if nullable:
+        nk = jnp.where(v.validity,
+                       jnp.uint64(1 if nulls_first else 0),
+                       jnp.uint64(0 if nulls_first else 1))
+        fields.append((1, nk))
+
+    def split64(u: jnp.ndarray) -> List[Tuple[int, jnp.ndarray]]:
+        return [(32, (u >> jnp.uint64(32)) & jnp.uint64(0xFFFFFFFF)),
+                (32, u & jnp.uint64(0xFFFFFFFF))]
+
+    d = v.dtype
+    vals: List[Tuple[int, jnp.ndarray]] = []
+    if d.is_string:
+        w = v.data.shape[1]
+        for word_start in range(0, w, 4):
+            word = jnp.zeros(v.data.shape[0], dtype=jnp.uint64)
+            for k in range(4):
+                j = word_start + k
+                if j < w:
+                    byte = v.data[:, j].astype(jnp.uint64)
+                    word = word | (byte << jnp.uint64(8 * (3 - k)))
+            vals.append((32, word))
+        vals.append((16, v.lengths.astype(jnp.uint64) &
+                     jnp.uint64(0xFFFF)))
+    elif d.is_floating:
+        if d.id == dt.TypeId.FLOAT32:
+            x = v.data
+            x = jnp.where(x == 0.0, jnp.zeros_like(x), x)
+            x = jnp.where(jnp.isnan(x), jnp.array(np.nan, x.dtype), x)
+            bits = x.view(jnp.int32)
+            u = bits.astype(jnp.int64).astype(jnp.uint64) & \
+                jnp.uint64(0xFFFFFFFF)
+            neg = bits < 0
+            key = jnp.where(neg, (~u) & jnp.uint64(0xFFFFFFFF),
+                            u ^ jnp.uint64(0x80000000))
+            vals.append((32, key))
+        else:
+            vals.extend(split64(_float_key(v.data, False)))
+    elif d.is_bool:
+        vals.append((1, v.data.astype(jnp.uint64)))
+    else:
+        npd = np.dtype(d.to_np())
+        if npd.itemsize <= 4:
+            key = (v.data.astype(jnp.int64) +
+                   jnp.int64(1 << 31)).astype(jnp.uint64) & \
+                jnp.uint64(0xFFFFFFFF)
+            vals.append((32, key))
+        else:
+            vals.extend(split64(_int_key(v.data)))
+
+    if not ascending:
+        vals = [(w, (~k) & ((jnp.uint64(1) << jnp.uint64(w)) -
+                            jnp.uint64(1))) for w, k in vals]
+    # null rows: zero value fields so equal nulls tie deterministically
+    vals = [(w, jnp.where(v.validity, k, jnp.uint64(0)))
+            for w, k in vals]
+    return fields + vals
+
+
+def fields_to_digits(fields: List[Tuple[int, jnp.ndarray]],
+                     ) -> jnp.ndarray:
+    """Concatenate MSB-first bit fields and chop the bitstring into u32
+    radix digits, LEAST significant digit first — the direct input to
+    radix_order_digits.  Every field must be <= 32 bits (encode_fields
+    guarantees it)."""
+    digits: List[jnp.ndarray] = []
+    cur = None
+    cur_bits = 0
+    for w, vals in reversed(fields):   # least-significant field first
+        assert w <= 32, w
+        v = vals & ((jnp.uint64(1) << jnp.uint64(w)) - jnp.uint64(1))
+        if cur is None:
+            cur = jnp.zeros_like(v)
+        cur = cur | (v << jnp.uint64(cur_bits))
+        cur_bits += w
+        while cur_bits >= 32:
+            digits.append((cur & jnp.uint64(0xFFFFFFFF)
+                           ).astype(jnp.uint32))
+            cur = cur >> jnp.uint64(32)
+            cur_bits -= 32
+    assert cur is not None, "fields_to_digits needs at least one field"
+    if cur_bits or not digits:
+        digits.append((cur & jnp.uint64(0xFFFFFFFF)
+                       ).astype(jnp.uint32))
+    return jnp.stack(digits)           # [d, cap], LSB digit first
+
+
+def radix_order_digits(digits: jnp.ndarray) -> jnp.ndarray:
+    """Stable order from [d, cap] u32 digits (least significant digit
+    FIRST) via LSD radix passes — one cheap single-key sort in a scan,
+    any key arity (see radix_order)."""
+    cap = digits.shape[1]
+    perm0 = jnp.arange(cap, dtype=jnp.int32)
+
+    def body(perm, digit):
+        dk = jnp.take(digit, perm)
+        _, perm2 = jax.lax.sort((dk, perm), num_keys=1, is_stable=True)
+        return perm2, None
+
+    perm, _ = jax.lax.scan(body, perm0, digits)
+    return perm
+
+
 def radix_order(wm: jnp.ndarray) -> jnp.ndarray:
     """Stable lexicographic order of a [m, cap] uint64 word matrix
     (row 0 most significant) via LSD radix over u32 half-words.
@@ -106,21 +223,12 @@ def radix_order(wm: jnp.ndarray) -> jnp.ndarray:
     in ``lax.scan`` compiles the sort ONCE regardless of word count, so
     any ORDER BY arity costs one cheap compile.  Stability of each pass
     makes the final order exactly the multi-key lexicographic order."""
-    m, cap = wm.shape
+    m, _cap = wm.shape
     parts = []
     for i in range(m - 1, -1, -1):          # least-significant first
         parts.append(wm[i].astype(jnp.uint32))
         parts.append((wm[i] >> jnp.uint64(32)).astype(jnp.uint32))
-    digits = jnp.stack(parts)               # [2m, cap] uint32
-    perm0 = jnp.arange(cap, dtype=jnp.int32)
-
-    def body(perm, digit):
-        dk = jnp.take(digit, perm)
-        _, perm2 = jax.lax.sort((dk, perm), num_keys=1, is_stable=True)
-        return perm2, None
-
-    perm, _ = jax.lax.scan(body, perm0, digits)
-    return perm
+    return radix_order_digits(jnp.stack(parts))   # [2m, cap] uint32
 
 
 def lexsort_indices(key_groups: List[List[jnp.ndarray]],

@@ -488,6 +488,11 @@ class TpuBroadcastHashJoinExec(_BroadcastBuildMixin, _HashJoinBase):
                 from spark_rapids_tpu.shuffle import ici
                 if built is None:
                     self._bcast_map = {}
+                elif self.transport == "ici_ring":
+                    # point-to-point plane: ppermute ring rotation
+                    self._bcast_map = ici.ring_broadcast_batch(built)
+                    self.metrics.extra["ici_ring_hops"] = \
+                        max(len(self._bcast_map) - 1, 0)
                 else:
                     self._bcast_map = ici.broadcast_batch(built)
                     self.metrics.extra["ici_broadcast_devices"] = \
@@ -496,7 +501,7 @@ class TpuBroadcastHashJoinExec(_BroadcastBuildMixin, _HashJoinBase):
 
     def _build_for(self, stream_batch: DeviceBatch):
         """The build-side copy colocated with this stream batch."""
-        if self.transport != "ici":
+        if self.transport not in ("ici", "ici_ring"):
             return self._build()
         bmap = self._build_broadcast()
         if not bmap:
@@ -517,7 +522,7 @@ class TpuBroadcastHashJoinExec(_BroadcastBuildMixin, _HashJoinBase):
             # pulling any stream batch: stream scans hold the TPU
             # semaphore across their yield, and the build side's own
             # scan acquiring it then would deadlock the task pool
-            if self.transport == "ici":
+            if self.transport in ("ici", "ici_ring"):
                 self._build_broadcast()
             else:
                 self._build()

@@ -103,9 +103,10 @@ class DataFrameWriter:
         ext = {"parquet": "parquet", "csv": "csv", "orc": "orc"}[fmt]
 
         result = self.df.session._plan_physical(self.df.plan)
-        if fmt == "parquet" and self._device_encode_ok(result.plan):
-            return self._write_device_parquet(result.plan, path, job_id,
-                                              stats)
+        if fmt in ("parquet", "orc") and \
+                self._device_encode_ok(result.plan, fmt):
+            return self._write_device(result.plan, path, job_id, stats,
+                                      fmt)
         part_iters = result.plan.execute()
         for pid, it in enumerate(part_iters):
             tables = [t for t in it if t.num_rows > 0]
@@ -125,26 +126,37 @@ class DataFrameWriter:
         open(os.path.join(path, "_SUCCESS"), "w").close()
         return stats
 
-    # -- device parquet encode --------------------------------------------
-    def _device_encode_ok(self, plan) -> bool:
+    # -- device encode (parquet + ORC) ------------------------------------
+    def _device_encode_ok(self, plan, fmt: str) -> bool:
         from spark_rapids_tpu import config as cfg
         from spark_rapids_tpu.exec.tpu_basic import DeviceToHostExec
-        from spark_rapids_tpu.io import parquet_encode as pqe
         if self._partition_by:
             return False
-        if not self.df.session.conf.get(cfg.PARQUET_DEVICE_ENCODE):
+        if fmt == "parquet":
+            from spark_rapids_tpu.io import parquet_encode as enc
+            key = cfg.PARQUET_DEVICE_ENCODE
+        else:
+            from spark_rapids_tpu.io import orc_encode as enc
+            key = cfg.ORC_DEVICE_ENCODE
+        if not self.df.session.conf.get(key):
             return False
         return isinstance(plan, DeviceToHostExec) and \
-            pqe.supported(plan.schema.fields)
+            enc.supported(plan.schema.fields)
 
-    def _write_device_parquet(self, plan, path: str, job_id: str,
-                              stats: WriteStats) -> WriteStats:
-        """Device-encode path (GpuParquetFileFormat analog): per-column
-        null compaction on device, one packed download per batch, host
-        page assembly (io/parquet_encode.py)."""
+    def _write_device(self, plan, path: str, job_id: str,
+                      stats: WriteStats, fmt: str) -> WriteStats:
+        """Device-encode path (GpuParquetFileFormat / GpuOrcFileFormat
+        analog): per-column null compaction on device, one packed
+        download per batch, host page/stripe assembly
+        (io/parquet_encode.py, io/orc_encode.py)."""
         from spark_rapids_tpu.columnar.batch import concat_batches
-        from spark_rapids_tpu.io import parquet_encode as pqe
-        codec = self._options.get("compression", "snappy")
+        if fmt == "parquet":
+            from spark_rapids_tpu.io import parquet_encode as pqe
+            codec = self._options.get("compression", "snappy")
+            encode = lambda b: pqe.encode_batch(b, codec=codec)  # noqa
+        else:
+            from spark_rapids_tpu.io import orc_encode as oce
+            encode = oce.encode_batch
         inner = plan.children[0]
         for pid, it in enumerate(inner.execute()):
             batches = [b for b in it if int(b.num_rows)]
@@ -152,9 +164,9 @@ class DataFrameWriter:
                 continue
             whole = concat_batches(batches) if len(batches) > 1 \
                 else batches[0]
-            blob = pqe.encode_batch(whole, codec=codec)
+            blob = encode(whole)
             fname = os.path.join(path,
-                                 f"part-{pid:05d}-{job_id}.parquet")
+                                 f"part-{pid:05d}-{job_id}.{fmt}")
             with open(fname, "wb") as f:
                 f.write(blob)
             stats.num_bytes += len(blob)

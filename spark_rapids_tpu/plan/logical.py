@@ -408,6 +408,7 @@ class CachedRelation(LogicalPlan):
     def __init__(self, child: LogicalPlan):
         self.children = (child,)
         self.blobs: Optional[List[bytes]] = None   # one per partition
+        self.device_encoded = False
 
     @property
     def schema(self) -> Schema:

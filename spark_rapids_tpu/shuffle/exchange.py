@@ -607,7 +607,7 @@ class TpuShuffleExchangeExec(TpuExec):
                 for p in range(n_parts)]
 
     def execute(self):
-        if self.transport == "ici":
+        if self.transport in ("ici", "ici_ring"):
             return self._execute_ici()
         import threading
         n_parts = self.partitioning.num_partitions

@@ -393,6 +393,88 @@ def exchange_batch(batch: DeviceBatch, targets: jnp.ndarray,
     return dev_batches, mesh
 
 
+def ring_broadcast_batch(batch: DeviceBatch) -> dict:
+    """Build replication over the POINT-TO-POINT plane: the batch is
+    sharded across the mesh and each shard travels around the ICI ring
+    with ``lax.ppermute`` (collective_permute) until every device holds
+    every shard — n_dev-1 neighbor hops instead of one all-to-all, the
+    memory-traffic shape of a ring all-gather.
+
+    This is the engine's collective formulation of the reference's
+    tag-matched per-peer pulls (UCXConnection.scala:385: each reducer
+    fetches specific blocks from specific peers); BASELINE.json's north
+    star names ICI all_to_all AND collective_permute as the two data
+    planes.  Same {device: DeviceBatch} contract as broadcast_batch."""
+    from spark_rapids_tpu.columnar.batch import bucket_rows
+
+    mesh = get_default_mesh()
+    n_dev = mesh.shape["shuffle"]
+    if n_dev == 1:
+        return broadcast_batch(batch)
+    total = int(batch.num_rows)
+    local_cap = bucket_rows(max((total + n_dev - 1) // n_dev, 1), 16)
+    aug = with_capacity(batch, local_cap * n_dev)
+    leaves, counts = shard_batch(aug, mesh, "shuffle")
+    names = aug.names
+    # each device sends its current block to its LEFT neighbor, so after
+    # k hops a device holds the block of (its index + k) % n_dev
+    perm = [(i, (i - 1) % n_dev) for i in range(n_dev)]
+
+    def local_step(cols_leaves, local_rows):
+        me = lax.axis_index("shuffle")
+        flat, treedef = jax.tree_util.tree_flatten(
+            (cols_leaves, local_rows))
+        accs = [jnp.zeros((n_dev,) + a.shape, a.dtype) for a in flat]
+        cur = list(flat)
+        for k in range(n_dev):
+            pos = (me + k) % np.int32(n_dev)
+            accs = [jax.lax.dynamic_update_slice(
+                acc, c[None], (pos,) + (jnp.int32(0),) * c.ndim)
+                for acc, c in zip(accs, cur)]
+            if k < n_dev - 1:
+                cur = [lax.ppermute(c, "shuffle", perm) for c in cur]
+        # accs are IDENTICAL on every device now: [n_dev, ...] blocks in
+        # global shard order — rebuild stacked columns and compact
+        g_cols_leaves, g_rows = jax.tree_util.tree_unflatten(
+            treedef, accs)
+        stacked: List[DeviceColumn] = []
+        for c, leaf in zip(aug.columns, g_cols_leaves):
+            parts = list(leaf)
+            lengths = parts[2] if c.lengths is not None else None
+            ev = parts[-1] if c.elem_validity is not None else None
+            stacked.append(DeviceColumn(c.dtype, parts[0], parts[1],
+                                        lengths, ev))
+        counts_recv = jnp.reshape(g_rows, (n_dev,))
+        out = reassemble(names, stacked, counts_recv)
+        return _cols_to_leaves(out.columns), jnp.reshape(
+            jnp.asarray(out.num_rows, jnp.int32), (1,))
+
+    step = jax.jit(jax.shard_map(
+        local_step, mesh=mesh, in_specs=(P("shuffle"), P("shuffle")),
+        out_specs=(P(), P()), check_vma=False))
+    out_leaves, out_rows = step(leaves, counts)
+    n_out = int(np.asarray(out_rows)[0])
+
+    out = {}
+    for d in mesh.devices.flat:
+        def local(a, d=d):
+            if a is None or not hasattr(a, "addressable_shards"):
+                return a
+            for s in a.addressable_shards:
+                if s.device == d:
+                    return s.data
+            return a
+        cols = []
+        for leaf, c in zip(out_leaves, aug.columns):
+            parts = [local(a) for a in leaf]
+            lengths = parts[2] if c.lengths is not None else None
+            ev = parts[-1] if c.elem_validity is not None else None
+            cols.append(DeviceColumn(c.dtype, parts[0], parts[1],
+                                     lengths, ev))
+        out[d] = DeviceBatch(names, cols, n_out)
+    return out
+
+
 def broadcast_batch(batch: DeviceBatch) -> dict:
     """One-to-all replication of a batch over the mesh: ONE
     fully-replicated ``jax.device_put`` lets XLA broadcast every column

@@ -308,6 +308,65 @@ def test_planned_distributed_agg_then_join():
     assert_tables_equal(cpu, tpu, ignore_order=True)
 
 
+def test_ring_broadcast_batch_replicates():
+    """collective_permute plane: n_dev-1 ppermute ring hops replicate a
+    sharded build batch to every device (reference analog: tag-matched
+    per-peer pulls, UCXConnection.scala:385)."""
+    rng = np.random.default_rng(33)
+    t = pa.table({
+        "k": pa.array(rng.integers(0, 99, 333), type=pa.int64()),
+        "s": pa.array([f"s{i % 11}" for i in range(333)]),
+    })
+    batch = from_arrow(t)
+    bmap = ici.ring_broadcast_batch(batch)
+    assert len(bmap) == len(jax.devices())
+    from spark_rapids_tpu.columnar.batch import to_arrow
+    for d, b in bmap.items():
+        got = to_arrow(b)
+        assert got.num_rows == 333
+        # replication preserves multiset content (ring order is by shard)
+        assert sorted(got.column("k").to_pylist()) == \
+            sorted(t.column("k").to_pylist())
+        assert sorted(got.column("s").to_pylist()) == \
+            sorted(t.column("s").to_pylist())
+
+
+def test_planned_broadcast_join_ici_ring():
+    """Broadcast hash join with the build side replicated over the
+    ppermute ring instead of one mesh broadcast — planner-reachable via
+    spark.rapids.tpu.shuffle.transport=ici_ring."""
+    rng = np.random.default_rng(22)
+    n = 400
+    facts = pa.table({
+        "k": pa.array(rng.integers(0, 25, n), type=pa.int64()),
+        "v": pa.array(rng.normal(size=n)),
+    })
+    dims = pa.table({
+        "k": pa.array(np.arange(0, 30, dtype=np.int64)),
+        "tag": pa.array([f"d{i}" for i in range(30)]),
+    })
+
+    def q(s):
+        f = s.create_dataframe(facts, num_partitions=3)
+        d = s.create_dataframe(dims)
+        g = f.repartition(4, "k")
+        return g.join(d, on="k", how="inner").collect()
+
+    cpu = _cpu_collect(q)
+    tpu, captured = _ici_collect(
+        q, {"spark.rapids.tpu.shuffle.transport": "ici_ring"})
+    from spark_rapids_tpu.exec.tpu_join import TpuBroadcastHashJoinExec
+    joins = []
+    captured[-1].plan.foreach(
+        lambda x: joins.append(x)
+        if isinstance(x, TpuBroadcastHashJoinExec) else None)
+    assert joins, "no TpuBroadcastHashJoinExec in plan"
+    assert all(j.transport == "ici_ring" for j in joins)
+    assert any(j.metrics.extra.get("ici_ring_hops") == 7
+               for j in joins), [j.metrics.extra for j in joins]
+    assert_tables_equal(cpu, tpu, ignore_order=True)
+
+
 def test_planned_broadcast_join_ici():
     """Broadcast hash join over the mesh: the build side replicates to
     every device with ONE mesh broadcast (ici.broadcast_batch,
