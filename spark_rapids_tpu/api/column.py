@@ -126,6 +126,9 @@ class Column:
     def like(self, pattern: str):
         return Column(ir.Like(self.expr, ir.Literal(pattern)))
 
+    def rlike(self, pattern: str):
+        return Column(ir.RLike(self.expr, ir.Literal(pattern)))
+
     def substr(self, start, length):
         return Column(ir.Substring(self.expr, _to_expr(start),
                                    _to_expr(length)))

@@ -315,6 +315,23 @@ AGG_EXCHANGE = conf(
     "aggregate split restructured so the exchange can ride a distributed "
     "data plane; auto-enabled when shuffle.transport=ici).", bool)
 
+SORT_EXCHANGE = conf(
+    "spark.rapids.tpu.sql.sort.exchange.enabled", False,
+    "Plan global ORDER BY as a range exchange on the sort keys followed "
+    "by per-partition sorts (partition p holds range-bucket p, so "
+    "partition-ordered concatenation IS the total order; auto-enabled "
+    "when shuffle.transport=ici/ici_ring so the exchange rides the "
+    "mesh; reference: GpuRangePartitioning + GpuSortExec per shard).",
+    bool)
+
+WINDOW_EXCHANGE = conf(
+    "spark.rapids.tpu.sql.window.exchange.enabled", False,
+    "Plan window functions over PARTITION BY keys as a hash exchange on "
+    "those keys followed by per-partition window evaluation "
+    "(auto-enabled when shuffle.transport=ici/ici_ring; reference: "
+    "Spark requires ClusteredDistribution(partitionSpec) under "
+    "GpuWindowExec).", bool)
+
 ENABLE_FLOAT_SORT = conf(
     "spark.rapids.tpu.sql.sort.float.enabled", True,
     "Enable sorting on float columns (NaN ordering matches Spark: NaN sorts "

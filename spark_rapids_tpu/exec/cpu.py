@@ -188,18 +188,31 @@ def _gather_single(child: PhysicalPlan, schema: Schema) -> pa.Table:
 
 
 class CpuSortExec(PhysicalPlan):
-    def __init__(self, child: PhysicalPlan, orders: Sequence[SortOrder]):
+    def __init__(self, child: PhysicalPlan, orders: Sequence[SortOrder],
+                 partitionwise: bool = False):
         super().__init__()
         self.children = (child,)
         self.orders = list(orders)
+        # partitionwise: each child partition sorts independently (the
+        # planner put a range exchange below, so partition-ordered
+        # concatenation is the total order)
+        self.partitionwise = partitionwise
 
     @property
     def schema(self) -> Schema:
         return self.children[0].schema
 
     def execute(self):
+        if self.partitionwise:
+            return [self._run_one(
+                lambda it=it: concat_tables(list(it), self.schema))
+                for it in self.children[0].execute()]
+        return [self._run_one(
+            lambda: _gather_single(self.children[0], self.schema))]
+
+    def _run_one(self, get_table):
         def run():
-            t = _gather_single(self.children[0], self.schema)
+            t = get_table()
             key_names = []
             key_arrays = []
             sort_keys = []
@@ -226,7 +239,7 @@ class CpuSortExec(PhysicalPlan):
                     else "at_end")
                 idx = idx[np.asarray(order_idx)]
             yield t.take(pa.array(idx))
-        return [run()]
+        return run()
 
 
 _AGG_MAP = {

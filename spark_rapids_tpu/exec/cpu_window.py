@@ -60,20 +60,36 @@ def _order_cmp(keys_a, keys_b, dirs) -> int:
 class CpuWindowExec(PhysicalPlan):
     def __init__(self, child: PhysicalPlan,
                  window_exprs: Sequence[ir.WindowExpression],
-                 out_names: Sequence[str], schema: Schema):
+                 out_names: Sequence[str], schema: Schema,
+                 partitionwise: bool = False):
         super().__init__()
         self.children = (child,)
         self.window_exprs = list(window_exprs)
         self.out_names = list(out_names)
         self._schema = schema
+        # partitionwise: each child partition evaluates independently —
+        # the planner hashed-exchanged on the PARTITION BY keys, so
+        # every window group is colocated in one partition
+        self.partitionwise = partitionwise
 
     @property
     def schema(self) -> Schema:
         return self._schema
 
     def execute(self):
+        if self.partitionwise:
+            from spark_rapids_tpu.exec.cpu import concat_tables
+            return [self._run_one(
+                lambda it=it: concat_tables(list(it),
+                                            self.children[0].schema))
+                for it in self.children[0].execute()]
+        return [self._run_one(
+            lambda: _gather_single(self.children[0],
+                                   self.children[0].schema))]
+
+    def _run_one(self, get_table):
         def run():
-            t = _gather_single(self.children[0], self.children[0].schema)
+            t = get_table()
             n = t.num_rows
             result_cols = {name: None for name in self.out_names}
             final_order = list(range(n))
@@ -133,7 +149,7 @@ class CpuWindowExec(PhysicalPlan):
                 arrays.append(pa.array(vals, type=we.dtype.to_arrow()))
             yield pa.Table.from_arrays(
                 arrays, names=list(t.column_names) + self.out_names)
-        return [run()]
+        return run()
 
     # ------------------------------------------------------------------
     def _compute(self, we: ir.WindowExpression, t, order, parts, dirs):

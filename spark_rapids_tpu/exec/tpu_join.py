@@ -46,11 +46,24 @@ _BIG = np.int64(1 << 62)
 
 def _gather(child: PhysicalPlan) -> Optional[DeviceBatch]:
     """Coalesce all of a child's partitions into one batch (build-side
-    RequireSingleBatch, reference: GpuHashJoin build side)."""
-    batches = []
+    RequireSingleBatch, reference: GpuHashJoin build side).
+
+    Each arriving batch registers with the spill catalog so the
+    accumulating build side stays evictable until the concat
+    (reference: build side held as LazySpillableColumnarBatch,
+    GpuHashJoin.scala / SpillableColumnarBatch.scala:169)."""
+    from spark_rapids_tpu.mem.spill import register_or_hold
+    handles = []
     for it in child.execute():
-        batches.extend(it)
-    return concat_batches(batches) if batches else None
+        for b in it:
+            handles.append(register_or_hold(b))
+    if not handles:
+        return None
+    try:
+        return concat_batches([h.get() for h in handles])
+    finally:
+        for h in handles:
+            h.close()
 
 
 def _key_vals(batch: DeviceBatch, key_names: Sequence[str]) -> List[ColVal]:
@@ -283,13 +296,20 @@ class _BroadcastBuildMixin:
         self._build_lock = threading.Lock()
 
     def _build(self):
-        # concurrent stream partitions must gather the build side once
+        # concurrent stream partitions must gather the build side once;
+        # the cached copy is held through the whole probe phase, so it
+        # stays registered with the spill catalog and is rematerialized
+        # per probe (reference: broadcast build kept as
+        # SpillableColumnarBatch, GpuBroadcastExchangeExec)
+        from spark_rapids_tpu.mem.spill import register_or_hold
         with self._build_lock:
             if not self._build_done:
                 side = 1 if self.build_side == "right" else 0
-                self._built = _gather(self.children[side])
+                built = _gather(self.children[side])
+                self._built = None if built is None \
+                    else register_or_hold(built)
                 self._build_done = True
-        return self._built
+        return None if self._built is None else self._built.get()
 
 
 class _HashJoinBase(TpuExec):
@@ -427,6 +447,7 @@ class TpuShuffledHashJoinExec(_HashJoinBase):
             sort-based formulation has no persistent hash table);
             coalesce goals keep probe batches per partition few.
             """
+            from spark_rapids_tpu.mem.spill import register_or_hold
             right = _gather_partition(rit)
             if right is None:
                 if self.how == "inner":
@@ -437,10 +458,13 @@ class TpuShuffledHashJoinExec(_HashJoinBase):
                         pass
                     return
                 right = _empty_like(self.children[1].schema)
-            for lb in lit:
-                if not int(lb.num_rows):
-                    continue
-                yield from self._join_pair(lb, right)
+            # the build partition is held across the whole stream probe
+            # loop — keep it spillable between probe batches
+            with register_or_hold(right) as rh:
+                for lb in lit:
+                    if not int(lb.num_rows):
+                        continue
+                    yield from self._join_pair(lb, rh.get())
 
         def run_gathered(lit, rit):
             """right/full: unmatched-build emission needs every stream

@@ -36,10 +36,16 @@ class TpuSortExec(TpuExec):
     reference's out-of-core-less sort; spill integration comes via the
     coalesce/spill framework)."""
 
-    def __init__(self, child: PhysicalPlan, orders: Sequence[SortOrder]):
+    def __init__(self, child: PhysicalPlan, orders: Sequence[SortOrder],
+                 partitionwise: bool = False):
         super().__init__()
         self.children = (child,)
         self.orders = list(orders)
+        # partitionwise: sort each child partition independently — the
+        # planner placed a range exchange below, so partition-ordered
+        # concatenation IS the total order (distributed ORDER BY; the
+        # exchange rides the ICI plane under transport=ici/ici_ring)
+        self.partitionwise = partitionwise
         self._kernel = None
 
     @property
@@ -79,13 +85,24 @@ class TpuSortExec(TpuExec):
                                 for o in self.orders)),
             lambda: functools.partial(type(self)._keys_impl, shim))
 
-        def run():
-            batches: List[DeviceBatch] = []
-            for it in self.children[0].execute():
-                batches.extend(it)
-            if not batches:
+        def run(iters):
+            from spark_rapids_tpu.mem.spill import register_or_hold
+            # RequireSingleBatch coalesce is a pressure point: every
+            # input batch buffers until the concat.  Register each with
+            # the spill catalog so accumulated input stays evictable
+            # (reference: GpuSortExec's input via SpillableColumnarBatch,
+            # SpillableColumnarBatch.scala:169)
+            handles: List = []
+            for it in iters:
+                for b in it:
+                    handles.append(register_or_hold(b))
+            if not handles:
                 return
-            whole = concat_batches(batches)
+            try:
+                whole = concat_batches([h.get() for h in handles])
+            finally:
+                for h in handles:
+                    h.close()
             with timed(self.metrics):
                 wm = keys_kernel(whole)
                 order = sortkeys.shared_lexsort(wm)
@@ -95,4 +112,6 @@ class TpuSortExec(TpuExec):
                 out = apply_kernel(whole, order)
             self.metrics.add_rows(out.num_rows)
             yield out
-        return [run()]
+        if self.partitionwise:
+            return [run([it]) for it in self.children[0].execute()]
+        return [run(self.children[0].execute())]

@@ -394,12 +394,18 @@ def _window_value(we: ir.WindowExpression, ctx: _WinCtx,
 class TpuWindowExec(TpuExec):
     def __init__(self, child: PhysicalPlan,
                  window_exprs: Sequence[ir.WindowExpression],
-                 out_names: Sequence[str], schema: Schema):
+                 out_names: Sequence[str], schema: Schema,
+                 partitionwise: bool = False):
         super().__init__()
         self.children = (child,)
         self.window_exprs = list(window_exprs)
         self.out_names = list(out_names)
         self._schema = schema
+        # partitionwise: the planner hash-exchanged on the PARTITION BY
+        # keys (rides the ICI plane under transport=ici/ici_ring), so
+        # each child partition holds whole window groups and evaluates
+        # independently
+        self.partitionwise = partitionwise
         self._kernel = None
 
     @property
@@ -484,9 +490,9 @@ class TpuWindowExec(TpuExec):
             ("window_apply", sig),
             lambda: functools.partial(cls._impl, shim))
 
-        def run():
+        def run(iters):
             batches: List[DeviceBatch] = []
-            for it in self.children[0].execute():
+            for it in iters:
                 batches.extend(it)
             if not batches:
                 return
@@ -498,4 +504,6 @@ class TpuWindowExec(TpuExec):
                 out = apply_kernel(whole, orders)
             self.metrics.add_rows(out.num_rows)
             yield out
-        return [run()]
+        if self.partitionwise:
+            return [run([it]) for it in self.children[0].execute()]
+        return [run(self.children[0].execute())]

@@ -677,6 +677,11 @@ def _like_match(s: str, pat: str) -> bool:
     return re.fullmatch(rx, s, flags=re.DOTALL) is not None
 
 
+def _rlike_match(s: str, pat: str) -> bool:
+    import re
+    return re.search(pat, s) is not None
+
+
 def _concat(e, table):
     vals = [evaluate(c, table) for c in e.children]
     n = len(vals[0].data)
@@ -1362,6 +1367,7 @@ _DISPATCH = {
     ir.EndsWith: _str_pred(lambda a, b: a.endswith(b)),
     ir.Contains: _str_pred(lambda a, b: b in a),
     ir.Like: _str_pred(_like_match),
+    ir.RLike: _str_pred(_rlike_match),
     ir.Concat: _concat,
     ir.StringTrim: _str_unary(lambda s: s.strip(" ")),
     ir.StringTrimLeft: _str_unary(lambda s: s.lstrip(" ")),

@@ -1930,64 +1930,20 @@ _DISPATCH[ir.Md5] = _eval_md5
 _REGEX_META = set(".^$*+?()[]{}|\\")
 
 
-def _eval_regexp_replace(e, batch):
-    """regexp_replace with a literal METACHARACTER-FREE pattern ==
-    replace-all-occurrences (the planner falls back for real regex;
-    reference: Spark300Shims.scala:183-247 GpuRegExpReplace is likewise
-    restricted).  Greedy leftmost non-overlapping, like java.util.regex.
-    """
-    s = evaluate(e.children[0], batch)
-    pat = e.children[1]
-    rep = e.children[2]
-    if not isinstance(pat, ir.Literal) or pat.value is None or \
-            not isinstance(rep, ir.Literal) or rep.value is None:
-        raise NotImplementedError("regexp_replace pattern/replacement "
-                                  "must be literals on TPU")
-    needle = pat.value.encode("utf-8")
-    if any(chr(b) in _REGEX_META for b in needle) or not needle:
-        raise NotImplementedError("regex metacharacters on TPU")
-    r = rep.value.encode("utf-8")
-    m, lr = len(needle), len(r)
+def _emit_replaced(s, starts, covered, rep_bytes, w_out):
+    """Shared regexp_replace emission: given per-position start flags
+    (emit the replacement) and covered flags (emit nothing; starts take
+    precedence), scatter copy-through characters and replacement bytes
+    into a fresh byte matrix."""
     n, w = s.data.shape
+    lr = len(rep_bytes)
     pos = jnp.arange(w)[None, :]
-
-    # occurrence candidates (needle fits at p, inside the string)
-    if m > w:
-        occ = jnp.zeros((n, w), dtype=jnp.bool_)
-    else:
-        span = w - m + 1
-        match = jnp.ones((n, span), dtype=jnp.bool_)
-        for j, byte in enumerate(needle):
-            match = match & (s.data[:, j:j + span] == byte)
-        match = match & (jnp.arange(span)[None, :] + m <=
-                         s.lengths[:, None])
-        occ = jnp.pad(match, ((0, 0), (0, w - span)))
-
-    # greedy leftmost non-overlap: a start is real if no real start in
-    # the previous m-1 positions — sequential scan via fori over w
-    def body(p, carry):
-        starts, next_free = carry
-        here = occ[:, p] & (p >= next_free)
-        starts = jax.lax.dynamic_update_index_in_dim(
-            starts, here, p, axis=1)
-        next_free = jnp.where(here, p + m, next_free)
-        return starts, next_free
-    starts, _ = jax.lax.fori_loop(
-        0, w, body, (jnp.zeros((n, w), jnp.bool_),
-                     jnp.zeros((n,), jnp.int32)))
-
-    sstart = jnp.where(starts, pos, -(1 << 30))
-    last = jax.lax.associative_scan(jnp.maximum, sstart, axis=1)
-    covered = (pos - last) < m
     in_str = pos < s.lengths[:, None]
     emit = jnp.where(starts, lr,
                      jnp.where(covered, 0, 1)) * in_str.astype(jnp.int32)
     out_pos = jnp.cumsum(emit, axis=1) - emit
     out_len = jnp.sum(emit, axis=1).astype(jnp.int32)
 
-    w_out = w if lr <= m else (w // max(m, 1)) * lr + w
-    from spark_rapids_tpu.columnar.batch import _bucket_strlen
-    w_out = _bucket_strlen(w_out)
     row = jnp.arange(n)[:, None]
     flat = jnp.zeros((n * w_out,), dtype=jnp.uint8)
     # copy-through characters
@@ -1996,7 +1952,7 @@ def _eval_regexp_replace(e, batch):
     flat = flat.at[tgt.reshape(-1)].set(
         s.data.reshape(-1), mode="drop")
     # replacement bytes
-    for k, byte in enumerate(r):
+    for k, byte in enumerate(rep_bytes):
         tgt = jnp.where(starts & in_str, row * w_out + out_pos + k,
                         n * w_out)
         flat = flat.at[tgt.reshape(-1)].set(jnp.uint8(byte),
@@ -2008,4 +1964,127 @@ def _eval_regexp_replace(e, batch):
                   jnp.where(s.validity, out_len, 0))
 
 
+def _replace_out_width(w: int, min_match: int, lr: int) -> int:
+    from spark_rapids_tpu.columnar.batch import _bucket_strlen
+    w_out = w if lr <= min_match else \
+        (w // max(min_match, 1)) * lr + w
+    return _bucket_strlen(w_out)
+
+
+def _eval_regexp_replace(e, batch):
+    """regexp_replace with a literal pattern: metacharacter-free
+    patterns use the direct occurrence scan; real regex in the
+    device_regex.py subset (char classes, anchors, greedy quantifiers,
+    groups — no alternation, which diverges from Java's leftmost-branch
+    semantics, and no empty-matchable patterns) runs the bitmask NFA
+    and replaces the LONGEST match per start.  The planner falls back
+    for everything else (reference: Spark300Shims.scala:183-247
+    GpuRegExpReplace, likewise restricted/incompat-flagged).  Greedy
+    leftmost non-overlapping, like java.util.regex.
+    """
+    s = evaluate(e.children[0], batch)
+    pat = e.children[1]
+    rep = e.children[2]
+    if not isinstance(pat, ir.Literal) or pat.value is None or \
+            not isinstance(rep, ir.Literal) or rep.value is None:
+        raise NotImplementedError("regexp_replace pattern/replacement "
+                                  "must be literals on TPU")
+    needle = pat.value.encode("utf-8")
+    r = rep.value.encode("utf-8")
+    n, w = s.data.shape
+    pos = jnp.arange(w)[None, :]
+
+    if needle and not any(chr(b) in _REGEX_META for b in needle):
+        # -- literal fast path: occurrence candidates via shifted
+        # equality (needle fits at p, inside the string)
+        m = len(needle)
+        if m > w:
+            occ = jnp.zeros((n, w), dtype=jnp.bool_)
+        else:
+            span = w - m + 1
+            match = jnp.ones((n, span), dtype=jnp.bool_)
+            for j, byte in enumerate(needle):
+                match = match & (s.data[:, j:j + span] == byte)
+            match = match & (jnp.arange(span)[None, :] + m <=
+                             s.lengths[:, None])
+            occ = jnp.pad(match, ((0, 0), (0, w - span)))
+
+        # greedy leftmost non-overlap: a start is real if no real start
+        # in the previous m-1 positions — sequential scan via fori
+        def body(p, carry):
+            starts, next_free = carry
+            here = occ[:, p] & (p >= next_free)
+            starts = jax.lax.dynamic_update_index_in_dim(
+                starts, here, p, axis=1)
+            next_free = jnp.where(here, p + m, next_free)
+            return starts, next_free
+        starts, _ = jax.lax.fori_loop(
+            0, w, body, (jnp.zeros((n, w), jnp.bool_),
+                         jnp.zeros((n,), jnp.int32)))
+
+        sstart = jnp.where(starts, pos, -(1 << 30))
+        last = jax.lax.associative_scan(jnp.maximum, sstart, axis=1)
+        covered = (pos - last) < m
+        return _emit_replaced(s, starts, covered, r,
+                              _replace_out_width(w, m, len(r)))
+
+    # -- NFA subset path -------------------------------------------------
+    from spark_rapids_tpu.expr import device_regex as dr
+    try:
+        cr = dr.compile_pattern(pat.value)
+    except dr.Unsupported as ex:
+        raise NotImplementedError(f"regex pattern outside the device "
+                                  f"subset: {ex}")
+    if not cr.replace_safe:
+        # Java's greedy-backtracking match (leftmost alternation
+        # branch; earlier quantifiers maximized first) only provably
+        # equals the longest-end table for single-variable-element
+        # patterns — see CompiledRegex.replace_safe
+        raise NotImplementedError("regexp_replace pattern where Java "
+                                  "greedy semantics may differ from "
+                                  "longest-match")
+    if b"$" in r or b"\\" in r:
+        raise NotImplementedError("group references in replacement")
+    ends = dr.match_ends(cr, s.data, s.lengths)   # [n, w] excl, -1
+
+    def body(p, carry):
+        starts, covered, cur_end = carry
+        cov_p = p < cur_end
+        here = (ends[:, p] >= 0) & ~cov_p
+        starts = jax.lax.dynamic_update_index_in_dim(
+            starts, here, p, axis=1)
+        covered = jax.lax.dynamic_update_index_in_dim(
+            covered, cov_p, p, axis=1)
+        cur_end = jnp.where(here, ends[:, p], cur_end)
+        return starts, covered, cur_end
+    starts, covered, _ = jax.lax.fori_loop(
+        0, w, body, (jnp.zeros((n, w), jnp.bool_),
+                     jnp.zeros((n, w), jnp.bool_),
+                     jnp.zeros((n,), jnp.int32)))
+    return _emit_replaced(s, starts, covered, r,
+                          _replace_out_width(w, cr.min_len, len(r)))
+
+
+def _eval_rlike(e, batch):
+    """RLIKE / regexp find-anywhere predicate over the bitmask NFA
+    (device_regex.py); pattern must be a literal in the device subset
+    (reference: Spark300Shims.scala:183-247 GpuRLike)."""
+    l = evaluate(e.left, batch)
+    if isinstance(e.right, ir.Literal) and e.right.value is None:
+        n0 = l.data.shape[0]
+        return ColVal(dt.BOOL, jnp.zeros((n0,), jnp.bool_),
+                      jnp.zeros((n0,), jnp.bool_))  # RLIKE NULL -> NULL
+    if not isinstance(e.right, ir.Literal):
+        raise NotImplementedError("rlike pattern must be a literal")
+    from spark_rapids_tpu.expr import device_regex as dr
+    try:
+        cr = dr.compile_pattern(e.right.value)
+    except dr.Unsupported as ex:
+        raise NotImplementedError(f"regex pattern outside the device "
+                                  f"subset: {ex}")
+    hit = dr.rlike(cr, l.data, l.lengths)
+    return ColVal(dt.BOOL, hit, l.validity)
+
+
+_DISPATCH[ir.RLike] = _eval_rlike
 _DISPATCH[ir.RegExpReplace] = _eval_regexp_replace

@@ -641,6 +641,18 @@ class Like(BinaryExpression):
         self.nullable = self.left.nullable or self.right.nullable
 
 
+class RLike(BinaryExpression):
+    """SQL RLIKE / regexp predicate: Java Matcher.find semantics with a
+    literal pattern (reference: Spark300Shims.scala:183-247 GpuRLike —
+    likewise incompat-flagged for regex dialect deltas).  On TPU the
+    pattern compiles to the bitmask NFA of expr/device_regex.py; the
+    planner falls back for patterns outside that subset."""
+
+    def resolve(self) -> None:
+        self.dtype = dt.BOOL
+        self.nullable = self.left.nullable or self.right.nullable
+
+
 class Concat(Expression):
     def __init__(self, *parts: Expression):
         self.children = tuple(parts)

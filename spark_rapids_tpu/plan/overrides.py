@@ -78,12 +78,35 @@ def _check_expr_node(e: ir.Expression, conf: RapidsTpuConf
                 not isinstance(rep, ir.Literal) or rep.value is None:
             return "regexp_replace pattern/replacement must be literals"
         from spark_rapids_tpu.expr.eval_tpu import _REGEX_META
-        if not pat.value or any(ch in _REGEX_META for ch in pat.value):
-            return (f"regexp pattern '{pat.value}' uses regex "
-                    "metacharacters — TPU does literal patterns only")
         if "$" in rep.value or "\\" in rep.value:
             return ("regexp replacement with $group/backslash "
                     "references is not supported on TPU")
+        if not pat.value or any(ch in _REGEX_META for ch in pat.value):
+            # real regex: device NFA subset (expr/device_regex.py);
+            # alternation replace diverges from Java's leftmost-branch
+            # pick and empty-matchable patterns insert at every gap
+            from spark_rapids_tpu.expr import device_regex as dr
+            try:
+                cr = dr.compile_pattern(pat.value or "")
+            except dr.Unsupported as ex:
+                return (f"regexp pattern '{pat.value}' outside the "
+                        f"device regex subset: {ex}")
+            if not cr.replace_safe:
+                return ("regexp_replace pattern where Java greedy "
+                        "semantics may differ from longest-match "
+                        "(alternation, empty-matchable, or multiple "
+                        "variable-length elements) — not on TPU")
+    if isinstance(e, ir.RLike):
+        pat = e.children[1]
+        if not isinstance(pat, ir.Literal):
+            return "rlike pattern must be a literal"
+        if pat.value is not None:
+            from spark_rapids_tpu.expr import device_regex as dr
+            try:
+                dr.compile_pattern(pat.value)
+            except dr.Unsupported as ex:
+                return (f"rlike pattern '{pat.value}' outside the "
+                        f"device regex subset: {ex}")
     if isinstance(e, ir.StringLocate):
         if not isinstance(e.children[0], ir.Literal) or \
            not isinstance(e.children[2], ir.Literal):
@@ -227,7 +250,8 @@ register_exec_rule(cpux.CpuLimitExec, ExecRule(
 register_exec_rule(cpux.CpuSortExec, ExecRule(
     "SortExec", "TPU total sort (total-order key encode + lexsort)",
     lambda n: [o.expr for o in n.orders],
-    convert=lambda n, ch, conf: TpuSortExec(ch[0], n.orders),
+    convert=lambda n, ch, conf: TpuSortExec(ch[0], n.orders,
+                                            n.partitionwise),
     extra_tag=_sort_unsupported_types))
 
 register_exec_rule(cpux.CpuHashAggregateExec, ExecRule(
@@ -305,7 +329,8 @@ def _register_window_rule():
         "TPU window functions (lexsort + segmented scans/prefix sums)",
         _win_exprs,
         convert=lambda n, ch, conf: TpuWindowExec(ch[0], n.window_exprs,
-                                            n.out_names, n.schema),
+                                            n.out_names, n.schema,
+                                            n.partitionwise),
         extra_tag=_tag_window))
 
 

@@ -113,6 +113,27 @@ def plan_cpu(node: lp.LogicalPlan, conf: RapidsTpuConf) -> PhysicalPlan:
     if isinstance(node, lp.Window):
         from spark_rapids_tpu.exec.cpu_window import CpuWindowExec
         child = plan_cpu(node.children[0], conf)
+        # distributed plan shape: when every window spec shares the same
+        # non-empty PARTITION BY, hash-exchange on those keys and run
+        # the window per partition (Spark's ClusteredDistribution
+        # requirement under GpuWindowExec, restructured so the exchange
+        # is a planner-visible node the ICI plane can ride)
+        dist = conf.get(cfg.WINDOW_EXCHANGE) or \
+            str(conf.get(cfg.SHUFFLE_TRANSPORT)) in ("ici", "ici_ring")
+        if dist and node.window_exprs:
+            psigs = {tuple(e.sql() for e in we.partition_exprs)
+                     for we in node.window_exprs}
+            pk = list(node.window_exprs[0].partition_exprs)
+            if len(psigs) == 1 and pk and \
+                    all(e.dtype is not None and not e.dtype.is_nested
+                        for e in pk):
+                from spark_rapids_tpu.shuffle import exchange as ex
+                child = ex.CpuShuffleExchangeExec(
+                    child, ex.HashPartitioning(conf.shuffle_partitions,
+                                               pk))
+                return CpuWindowExec(child, node.window_exprs,
+                                     node.out_names, node.schema,
+                                     partitionwise=True)
         return CpuWindowExec(child, node.window_exprs, node.out_names,
                              node.schema)
     if isinstance(node, lp.MapInPandas):
@@ -228,6 +249,24 @@ def _plan_sort(node: lp.Sort, child: PhysicalPlan,
     exprs = [o.expr for o in node.orders]
     new_exprs, eval_child = _extract_pandas_udfs(exprs, child)
     if eval_child is child:
+        # distributed plan shape: a RANGE exchange on the sort keys,
+        # then per-partition sorts — partition p holds range-bucket p,
+        # so partition-ordered concatenation IS the total order and the
+        # exchange can ride the ICI plane (reference:
+        # GpuRangePartitioning + per-shard GpuSortExec)
+        dist = bool(node.orders) and (
+            conf.get(cfg.SORT_EXCHANGE)
+            or str(conf.get(cfg.SHUFFLE_TRANSPORT)) in ("ici",
+                                                        "ici_ring"))
+        if dist and all(
+                o.expr.dtype is not None and not o.expr.dtype.is_nested
+                for o in node.orders):
+            from spark_rapids_tpu.shuffle import exchange as ex
+            exch = ex.CpuShuffleExchangeExec(
+                child, ex.RangePartitioning(conf.shuffle_partitions,
+                                            node.orders))
+            return cpux.CpuSortExec(exch, node.orders,
+                                    partitionwise=True)
         return cpux.CpuSortExec(child, node.orders)
     orders = [lp.SortOrder(e, o.ascending, o.nulls_first)
               for e, o in zip(new_exprs, node.orders)]

@@ -50,7 +50,8 @@ _TOKEN_RE = re.compile(r"""
 _KEYWORDS = {
     "select", "distinct", "from", "where", "group", "by", "having",
     "order", "limit", "union", "all", "as", "and", "or", "not", "in",
-    "between", "like", "is", "null", "case", "when", "then", "else",
+    "between", "like", "rlike", "regexp", "is", "null", "case", "when",
+    "then", "else",
     "end", "cast", "join", "inner", "left", "right", "full", "outer",
     "cross", "semi", "anti", "on", "using", "with", "asc", "desc",
     "date", "timestamp", "interval", "true", "false", "exists",
@@ -795,6 +796,11 @@ class Parser:
             if self.kw("like"):
                 pat = self.expect("str").value
                 base = ir.Like(e, ir.Literal(pat))
+                e = ir.Not(base) if negate else base
+                continue
+            if self.kw("rlike") or self.kw("regexp"):
+                pat = self.expect("str").value
+                base = ir.RLike(e, ir.Literal(pat))
                 e = ir.Not(base) if negate else base
                 continue
             if negate:

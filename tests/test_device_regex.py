@@ -1,0 +1,211 @@
+"""Device regex subset tests: NFA engine parity against Python re, and
+plan-level coverage that supported patterns RUN ON DEVICE while
+unsupported ones fall back with a tagged reason (reference:
+Spark300Shims.scala:183-247 GpuRLike / GpuRegExpReplace)."""
+
+import re
+
+import numpy as np
+import pyarrow as pa
+import pytest
+
+import jax.numpy as jnp
+
+from spark_rapids_tpu.expr import device_regex as dr
+from tests.parity import (assert_tpu_and_cpu_are_equal_collect,
+                          collect_plans, with_cpu_session,
+                          with_tpu_session)
+
+
+def _mat(strings, w=32):
+    data = np.zeros((len(strings), w), np.uint8)
+    lens = np.zeros((len(strings),), np.int32)
+    for i, s in enumerate(strings):
+        b = s.encode()
+        data[i, :len(b)] = list(b)
+        lens[i] = len(b)
+    return jnp.asarray(data), jnp.asarray(lens)
+
+
+_STRINGS = ["", "abc", "aabbb", "a1b2c3", "  x  ", "a.b", "0x1F",
+            "aaa", "abcabc", "-a-b-", "Foo123", "tail7", "7head",
+            "a" * 30, "ab" * 12, "x1x22x333"]
+
+
+@pytest.mark.parametrize("pat", [
+    "abc", "a+b", "a*b+c?", "[abc]+", "[^abc]", "a{2,3}", "x{2}",
+    "^a", "c$", "^abc$", "(ab)+", "a|b|cc", r"\d+", r"\w+", r"\s",
+    r"a\.b", "[a-c][0-9]", "(a|b)c", "a.c", ".*x", "(?:ab|cd)+",
+    "[0-9]{1,3}", r"\d{2,}", "^$", "^[ab]+$", "a{0,2}b",
+])
+def test_rlike_engine_matches_python_re(pat):
+    cr = dr.compile_pattern(pat)
+    data, lens = _mat(_STRINGS)
+    got = np.asarray(dr.rlike(cr, data, lens))
+    want = np.array([re.search(pat, s) is not None for s in _STRINGS])
+    assert (got == want).all(), \
+        [(s, bool(g), bool(w)) for s, g, w in zip(_STRINGS, got, want)
+         if g != w]
+
+
+@pytest.mark.parametrize("pat", [
+    "a+b", "[abc]{2}", r"\d+", "[a-c][0-9]", "a.c", "x{2,3}", "^a+",
+    r"\d+$", "a{1,4}",
+])
+def test_match_ends_longest_per_start(pat):
+    cr = dr.compile_pattern(pat)
+    assert cr.min_len >= 1
+    data, lens = _mat(_STRINGS)
+    ends = np.asarray(dr.match_ends(cr, data, lens))
+    core = pat.lstrip("^")
+    endanch = core.endswith("$")
+    core = core.rstrip("$") if endanch else core
+    for i, s in enumerate(_STRINGS):
+        for p in range(len(s)):
+            if pat.startswith("^") and p != 0:
+                assert ends[i, p] == -1
+                continue
+            best = -1
+            for e in range(p + 1, len(s) + 1):
+                if endanch and e != len(s):
+                    continue
+                if re.fullmatch(core, s[p:e]):
+                    best = e
+            assert ends[i, p] == best, (pat, s, p, ends[i, p], best)
+
+
+@pytest.mark.parametrize("pat", [
+    r"(a|b)\1", r"(?=x)a", r"a*?", r"\p{L}", "a{40}", "(?i)x",
+    r"a\b", "a$b",
+])
+def test_unsupported_patterns_raise(pat):
+    with pytest.raises(dr.Unsupported):
+        dr.compile_pattern(pat)
+
+
+def _str_table():
+    return pa.table({"s": pa.array(
+        ["foo123", "bar", None, "x9y8", "aa bb", "Zebra77",
+         "", "a.b.c", "123", "mixed Case 42"])})
+
+
+def test_rlike_query_parity_and_on_device():
+    def fn(session):
+        df = session.create_dataframe(_str_table())
+        from spark_rapids_tpu import col
+        return df.select(
+            col("s").rlike(r"\d+").alias("has_digit"),
+            col("s").rlike("^[a-z]+$").alias("lower_only"),
+            col("s").rlike("a{2}").alias("double_a"))
+
+    # test.enabled in the base conf asserts everything stays on TPU —
+    # a fallback would fail the run, proving the device path
+    assert_tpu_and_cpu_are_equal_collect(fn)
+
+
+def test_regexp_replace_regex_query_parity_and_on_device():
+    from spark_rapids_tpu.api import functions as F
+    from spark_rapids_tpu import col
+
+    def fn(session):
+        df = session.create_dataframe(_str_table())
+        return df.select(
+            F.regexp_replace(col("s"), r"[0-9]+", "#").alias("r1"),
+            F.regexp_replace(col("s"), r"[a-z]{2,}", "<w>").alias("r2"),
+            F.regexp_replace(col("s"), r"\s+", "_").alias("r3"))
+
+    assert_tpu_and_cpu_are_equal_collect(fn)
+
+
+def test_rlike_sql_surface():
+    def fn(session):
+        session.create_dataframe(_str_table()) \
+            .create_or_replace_temp_view("t")
+        return session.sql(
+            "SELECT s FROM t WHERE s RLIKE '^[a-z]+[0-9]+$'")
+
+    out = with_cpu_session(lambda s: fn(s).collect())
+    assert out.column("s").to_pylist() == ["foo123"]
+    assert_tpu_and_cpu_are_equal_collect(fn)
+
+
+def test_unsupported_rlike_falls_back_with_reason():
+    from spark_rapids_tpu import col
+
+    def q(session):
+        df = session.create_dataframe(_str_table())
+        return df.select(col("s").rlike(r"(a)\1").alias("r"))
+
+    # CPU run agrees with the fallback result
+    cpu = with_cpu_session(lambda s: q(s).collect())
+    s = with_tpu_session(
+        lambda s: s, {"spark.rapids.tpu.sql.test.enabled": False})
+    captured = collect_plans(s)
+    got = q(s).collect()
+    assert got.equals(cpu)
+    assert captured
+    explain = captured[-1].explain_string(all_=True)
+    assert "outside the device regex subset" in explain
+
+
+def test_rlike_null_pattern_yields_null():
+    from spark_rapids_tpu import dtypes as dt
+    from spark_rapids_tpu.api.column import Column
+    from spark_rapids_tpu.expr import ir
+
+    def fn(session):
+        df = session.create_dataframe(_str_table())
+        return df.select(
+            Column(ir.RLike(ir.UnresolvedAttribute("s"),
+                            ir.Literal(None, dt.STRING))).alias("r"))
+
+    out = with_cpu_session(lambda s: fn(s).collect())
+    assert out.column("r").null_count == out.num_rows
+
+
+def test_anchor_with_top_level_alternation_unsupported():
+    # '^a|b' anchors only the first branch in Java; flag-style anchors
+    # would wrongly anchor both -> must fall back, not mis-match
+    for pat in ["^a|b", "a|b$", "^a|b$"]:
+        with pytest.raises(dr.Unsupported):
+            dr.compile_pattern(pat)
+    # grouped forms stay supported and correct
+    cr = dr.compile_pattern("^(a|b)")
+    data, lens = _mat(["ax", "xb", "b"])
+    assert np.asarray(dr.rlike(cr, data, lens)).tolist() == \
+        [True, False, True]
+
+
+def test_replace_safe_gate():
+    # single variable-length element: longest == Java greedy
+    assert dr.compile_pattern(r"[0-9]+").replace_safe
+    assert dr.compile_pattern(r"a{2,5}").replace_safe
+    assert dr.compile_pattern(r"ab*c").replace_safe
+    # two variable elements can diverge (a{1,2}(ab)? on 'aab':
+    # Java matches 'aa', longest is 'aab') -> not replace-safe
+    assert not dr.compile_pattern(r"a{1,2}(ab)?").replace_safe
+    assert not dr.compile_pattern(r"a*b?").replace_safe
+    assert not dr.compile_pattern(r"x|yy").replace_safe
+
+
+def test_regexp_replace_divergent_pattern_falls_back():
+    from spark_rapids_tpu.api import functions as F
+    from spark_rapids_tpu import col
+
+    def q(session):
+        df = session.create_dataframe(pa.table({"s": ["aab", "ab"]}))
+        return df.select(
+            F.regexp_replace(col("s"), r"a{1,2}(ab)?", "X").alias("r"))
+
+    cpu = with_cpu_session(lambda s: q(s).collect())
+    # Java/re semantics: 'aab' -> greedy a{1,2}='aa', (ab)? empty ->
+    # 'Xb' (the longest match 'aab' -> 'X' would be WRONG)
+    assert cpu.column("r").to_pylist() == ["Xb", "Xb"]
+    s = with_tpu_session(
+        lambda s: s, {"spark.rapids.tpu.sql.test.enabled": False})
+    from tests.parity import collect_plans as _cp
+    captured = _cp(s)
+    got = q(s).collect()
+    assert got.equals(cpu)
+    assert "may differ from longest-match" in \
+        captured[-1].explain_string(all_=True)

@@ -403,3 +403,91 @@ def test_planned_broadcast_join_ici():
     assert any(j.metrics.extra.get("ici_broadcast_devices") == 8
                for j in joins), [j.metrics.extra for j in joins]
     assert_tables_equal(cpu, tpu, ignore_order=True)
+
+
+def test_planned_distributed_total_sort():
+    """Total ORDER BY across shards: range exchange on the sort keys
+    (riding the ICI plane) + per-shard sorts; partition-ordered
+    concatenation must equal the global sort."""
+    from spark_rapids_tpu import col
+    rng = np.random.default_rng(13)
+    n = 500
+    tbl = pa.table({
+        "k": pa.array(rng.integers(-40, 40, n), type=pa.int64()),
+        "i": pa.array(np.arange(n, dtype=np.int64)),  # total tiebreak
+        "s": pa.array([f"s{i % 9}" if i % 11 else None
+                       for i in range(n)]),
+    })
+
+    def q(s):
+        df = s.create_dataframe(tbl, num_partitions=4)
+        return df.sort(col("k").desc(), col("i")).collect()
+
+    cpu = _cpu_collect(q)
+    tpu, captured = _ici_collect(q)
+    _assert_has_ici_exchange(captured)
+    from spark_rapids_tpu.exec.tpu_sort import TpuSortExec
+    from spark_rapids_tpu.shuffle.exchange import (RangePartitioning,
+                                                   TpuShuffleExchangeExec)
+    sorts, exchs = [], []
+    captured[-1].plan.foreach(
+        lambda x: sorts.append(x) if isinstance(x, TpuSortExec)
+        else exchs.append(x) if isinstance(x, TpuShuffleExchangeExec)
+        else None)
+    assert sorts and all(x.partitionwise for x in sorts)
+    assert any(isinstance(x.partitioning, RangePartitioning)
+               for x in exchs)
+    # exact order parity, not just same multiset
+    assert_tables_equal(cpu, tpu, ignore_order=False)
+
+
+def test_planned_distributed_window_parity():
+    """Window over PARTITION BY keys: hash exchange on the keys (ICI
+    plane) + per-shard window evaluation."""
+    from spark_rapids_tpu.api.window import Window
+    rng = np.random.default_rng(14)
+    n = 400
+    tbl = pa.table({
+        "g": pa.array(rng.integers(0, 12, n), type=pa.int32()),
+        "o": pa.array(rng.permutation(n).astype(np.int64)),
+        "v": pa.array(rng.integers(-30, 30, n), type=pa.int64()),
+    })
+
+    def q(s):
+        df = s.create_dataframe(tbl, num_partitions=4)
+        w = Window.partition_by("g").order_by("o")
+        return df.select(
+            "g", "o", "v",
+            F.row_number().over(w).alias("rn"),
+            F.sum("v").over(w).alias("rs"),
+            F.lag("v").over(w).alias("lg")).collect()
+
+    cpu = _cpu_collect(q)
+    tpu, captured = _ici_collect(q)
+    _assert_has_ici_exchange(captured)
+    from spark_rapids_tpu.exec.tpu_window import TpuWindowExec
+    wins = []
+    captured[-1].plan.foreach(
+        lambda x: wins.append(x) if isinstance(x, TpuWindowExec)
+        else None)
+    assert wins and all(x.partitionwise for x in wins)
+    assert_tables_equal(cpu, tpu, ignore_order=True)
+
+
+def test_planned_distributed_sort_then_limit():
+    """ORDER BY + LIMIT over the distributed sort keeps global order
+    (limit drains range partitions in partition order)."""
+    from spark_rapids_tpu import col
+    rng = np.random.default_rng(15)
+    tbl = pa.table({
+        "k": pa.array(rng.permutation(300).astype(np.int64)),
+    })
+
+    def q(s):
+        df = s.create_dataframe(tbl, num_partitions=3)
+        return df.sort(col("k")).limit(17).collect()
+
+    cpu = _cpu_collect(q)
+    tpu, _ = _ici_collect(q)
+    assert_tables_equal(cpu, tpu, ignore_order=False)
+    assert tpu.column("k").to_pylist() == list(range(17))

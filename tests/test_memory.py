@@ -285,3 +285,56 @@ def test_string_outlier_bounded_hbm():
     # the guard keeps every batch under the budget with margin
     assert max(sizes) <= 300 << 20, max(sizes)
     assert sum(sizes) < 600 << 20, sum(sizes)
+
+
+def test_sort_query_under_tiny_device_budget():
+    """End-to-end ORDER BY with the RequireSingleBatch input coalesce
+    forced through the spill path (reference: sort input held as
+    SpillableColumnarBatch, SpillableColumnarBatch.scala:169)."""
+    from spark_rapids_tpu import TpuSparkSession, col
+    s = TpuSparkSession({
+        "spark.rapids.tpu.memory.device.batchStorageSize": 1,
+    })
+    rng = np.random.default_rng(7)
+    t = pa.table({
+        "k": pa.array(rng.integers(0, 1000, 800), type=pa.int64()),
+        "s": pa.array([f"v{i % 37}" for i in range(800)]),
+    })
+    df = s.create_dataframe(t, num_partitions=4)
+    got = df.sort(col("k"), col("s").desc()).collect().to_pandas()
+    from spark_rapids_tpu.mem.spill import get_catalog
+    assert get_catalog().spilled_device_bytes > 0
+    want = t.to_pandas().sort_values(
+        ["k", "s"], ascending=[True, False]).reset_index(drop=True)
+    assert got["k"].tolist() == want["k"].tolist()
+    assert got["s"].tolist() == want["s"].tolist()
+
+
+def test_join_query_under_tiny_device_budget():
+    """End-to-end shuffled AND broadcast hash joins with build sides
+    registered in the spill catalog under a 1-byte device budget."""
+    from spark_rapids_tpu import TpuSparkSession
+    rng = np.random.default_rng(11)
+    fact = pa.table({
+        "k": pa.array(rng.integers(0, 50, 600), type=pa.int32()),
+        "v": pa.array(rng.integers(0, 100, 600), type=pa.int64()),
+    })
+    dim = pa.table({
+        "k": pa.array(np.arange(50, dtype=np.int32)),
+        "w": pa.array(np.arange(50, dtype=np.int64) * 10),
+    })
+    want = fact.to_pandas().merge(dim.to_pandas(), on="k")
+    for extra in ({"spark.rapids.tpu.sql.autoBroadcastJoinThreshold": -1},
+                  {}):  # shuffled, then broadcast
+        s = TpuSparkSession({
+            "spark.rapids.tpu.memory.device.batchStorageSize": 1,
+            **extra,
+        })
+        f = s.create_dataframe(fact, num_partitions=3)
+        d = s.create_dataframe(dim, num_partitions=2)
+        got = f.join(d, on="k", how="inner").collect().to_pandas()
+        from spark_rapids_tpu.mem.spill import get_catalog
+        assert get_catalog().spilled_device_bytes > 0
+        assert len(got) == len(want)
+        assert sorted(got["v"] + got["w"]) == \
+            sorted(want["v"] + want["w"])
