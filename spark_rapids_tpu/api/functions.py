@@ -129,6 +129,7 @@ trim = _u(ir.StringTrim)
 ltrim = _u(ir.StringTrimLeft)
 rtrim = _u(ir.StringTrimRight)
 initcap = _u(ir.InitCap)
+reverse = _u(ir.StringReverse)
 
 
 def substring(c, pos, length_) -> Column:

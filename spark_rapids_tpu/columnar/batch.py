@@ -113,9 +113,13 @@ class DeviceColumn:
         ev = None
         if self.lengths is not None:
             lengths = jnp.where(valid, jnp.take(self.lengths, indices), 0)
-            data = jnp.where(valid[:, None], data, 0)
+            data = jnp.where(valid[:, None], data,
+                             jnp.zeros((), data.dtype))
         else:
-            data = jnp.where(_bcast(valid, data), data, 0)
+            # zeros typed like data: a bare 0 would PROMOTE bool columns
+            # to int under numpy rules and change the output schema
+            data = jnp.where(_bcast(valid, data), data,
+                             jnp.zeros((), data.dtype))
         if self.elem_validity is not None:
             ev = jnp.take(self.elem_validity, indices, axis=0) & \
                 valid[:, None]

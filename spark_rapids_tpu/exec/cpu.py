@@ -219,6 +219,20 @@ class CpuSortExec(PhysicalPlan):
             for i, o in enumerate(self.orders):
                 name = f"__sort_{i}"
                 v = eval_cpu.evaluate(o.expr, t)
+                if v.dtype.is_floating:
+                    # Spark total order: -inf < ... < +inf < NaN, and
+                    # -0.0 == 0.0.  Arrow's sort groups NaN with nulls,
+                    # so sort on the sign-flipped IEEE bit key instead
+                    # (same transform as the device sortkeys encoder).
+                    x = v.data.astype(np.float64)
+                    x = np.where(np.isnan(x), np.nan, x)   # canonical NaN
+                    x = np.where(x == 0.0, 0.0, x)         # -0.0 -> 0.0
+                    u = x.view(np.uint64)
+                    sign = np.uint64(1) << np.uint64(63)
+                    ukey = np.where(u >> np.uint64(63) == 1, ~u,
+                                    u | sign)
+                    key = (ukey ^ sign).view(np.int64)
+                    v = eval_cpu.CpuVal(dt.INT64, key, v.valid)
                 key_names.append(name)
                 key_arrays.append(eval_cpu.to_arrow_array(v))
                 sort_keys.append((name, "ascending" if o.ascending
@@ -341,6 +355,23 @@ class CpuHashAggregateExec(PhysicalPlan):
         aggs = []
         out_names_in_result = []
         count_modes = {}
+        # Spark float ordering: NaN is GREATEST (max -> NaN if any NaN;
+        # min -> NaN only when every non-null value is NaN).  Arrow's
+        # min/max skip NaN, so strip NaNs to null and carry a per-group
+        # NaN count to patch the results after the aggregation.
+        nan_fix = {}
+        for i, a in enumerate(self.aggregates):
+            if isinstance(a, (ir.Min, ir.Max)) and \
+                    a.dtype is not None and a.dtype.is_floating:
+                cname = f"__a{i}"
+                c = proj.column(cname).combine_chunks()
+                isn = pc.fill_null(pc.is_nan(c), False)
+                clean = pc.if_else(isn, pa.scalar(None, c.type), c)
+                proj = proj.set_column(
+                    proj.column_names.index(cname), cname, clean)
+                proj = proj.append_column(
+                    f"{cname}__nan", pc.cast(isn, pa.int64()))
+                nan_fix[i] = isinstance(a, ir.Min)
         for i, a in enumerate(self.aggregates):
             if isinstance(a, ir.Count):
                 mode = "all" if a.child is None else "only_valid"
@@ -360,6 +391,9 @@ class CpuHashAggregateExec(PhysicalPlan):
                 fn = _AGG_MAP[type(a)]
                 aggs.append((f"__a{i}", fn))
                 out_names_in_result.append(f"__a{i}_{fn}")
+        for i in nan_fix:
+            aggs.append((f"__a{i}__nan", "sum"))
+            out_names_in_result.append(f"__a{i}__nan_sum")
 
         if key_names:
             res = proj.group_by(key_names, use_threads=False).aggregate(
@@ -388,6 +422,26 @@ class CpuHashAggregateExec(PhysicalPlan):
                                      type=getattr(val, "type", None)))
                 names2.append(oname)
             res = pa.Table.from_arrays(cols, names=names2)
+
+        # patch Spark NaN ordering into float min/max results
+        for i, is_min in nan_fix.items():
+            fn = "min" if is_min else "max"
+            base_name = f"__a{i}_{fn}"
+            base = res.column(base_name).combine_chunks()
+            has_nan = pc.greater(
+                pc.coalesce(res.column(f"__a{i}__nan_sum"),
+                            pa.scalar(0, pa.int64())),
+                pa.scalar(0, pa.int64()))
+            if is_min:
+                # NaN is greatest: min -> NaN only when every non-null
+                # value in the group was NaN (clean min came up null)
+                cond = pc.and_(pc.is_null(base), has_nan)
+            else:
+                cond = has_nan
+            fixed = pc.if_else(cond, pa.scalar(float("nan"), base.type),
+                               base)
+            res = res.set_column(
+                res.column_names.index(base_name), base_name, fixed)
 
         # assemble final output: keys then aggs with target dtypes
         out_arrays = []

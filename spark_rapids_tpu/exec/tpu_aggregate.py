@@ -33,7 +33,7 @@ from spark_rapids_tpu import dtypes as dt
 from spark_rapids_tpu.columnar.batch import (DeviceBatch, DeviceColumn,
                                              bucket_rows, concat_batches)
 from spark_rapids_tpu.exec.base import PhysicalPlan, TpuExec, timed
-from spark_rapids_tpu.exec import sortkeys
+from spark_rapids_tpu.exec import scans, sortkeys
 from spark_rapids_tpu.expr import eval_tpu, ir
 from spark_rapids_tpu.expr.eval_tpu import ColVal
 from spark_rapids_tpu.plan.logical import Schema
@@ -80,35 +80,33 @@ class _SortedCtx:
         xs = jnp.where(self.take_sorted(mask), self.take_sorted(x),
                        jnp.zeros((), dtype=x.dtype))
         if jnp.issubdtype(xs.dtype, jnp.floating):
-            return self.seg_scan_reduce(xs, jnp.add)
-        c = jnp.cumsum(xs)
+            return self.seg_scan_reduce(xs, jnp.add, 0)
+        c = scans.cumsum(xs)
         ce = jnp.take(c, self.end_pos)
         return ce - jnp.concatenate([ce[:1] * 0, ce[:-1]])
 
     def seg_count(self, mask: jnp.ndarray) -> jnp.ndarray:
         return self.seg_sum(mask.astype(jnp.int64), mask)
 
-    def seg_scan_reduce(self, x_sorted: jnp.ndarray, op) -> jnp.ndarray:
+    def seg_scan_reduce(self, x_sorted: jnp.ndarray, op,
+                        identity) -> jnp.ndarray:
         """Segmented reduce via associative scan over sorted rows; the
-        caller pre-fills excluded rows with op's identity."""
-        def combine(a, b):
-            fa, va = a
-            fb, vb = b
-            return fa | fb, jnp.where(fb, vb, op(va, vb))
-        _f, s = jax.lax.associative_scan(combine, (self.new, x_sorted))
+        caller pre-fills excluded rows with op's identity (also passed
+        here so the capacity-blocked scan can pad with it)."""
+        s = scans.seg_scan(op, self.new, x_sorted, identity)
         return jnp.take(s, self.end_pos)
 
     def seg_min_of(self, x: jnp.ndarray, mask: jnp.ndarray,
                    fill) -> jnp.ndarray:
         xs = jnp.where(self.take_sorted(mask), self.take_sorted(x),
                        jnp.asarray(fill, dtype=x.dtype))
-        return self.seg_scan_reduce(xs, jnp.minimum)
+        return self.seg_scan_reduce(xs, jnp.minimum, fill)
 
     def seg_max_of(self, x: jnp.ndarray, mask: jnp.ndarray,
                    fill) -> jnp.ndarray:
         xs = jnp.where(self.take_sorted(mask), self.take_sorted(x),
                        jnp.asarray(fill, dtype=x.dtype))
-        return self.seg_scan_reduce(xs, jnp.maximum)
+        return self.seg_scan_reduce(xs, jnp.maximum, fill)
 
 
 class _AggSpec:
@@ -204,11 +202,11 @@ class _MinMaxSpec(_AggSpec):
         for w in words:
             wv_s = ctx.take_sorted(w if self.is_min else ~w)
             best = ctx.seg_scan_reduce(
-                jnp.where(cand_s, wv_s, umax), jnp.minimum)
+                jnp.where(cand_s, wv_s, umax), jnp.minimum, umax)
             cand_s = cand_s & (wv_s == jnp.take(best, ctx.gid_sorted))
         i = jnp.arange(ctx.cap, dtype=jnp.int64)
         win = ctx.seg_scan_reduce(jnp.where(cand_s, i, _BIG),
-                                  jnp.minimum)
+                                  jnp.minimum, _BIG)
         found = ctx.seg_count(considered) > 0
         orig = jnp.take(ctx.order, jnp.clip(win, 0, ctx.cap - 1))
         val = jnp.where(found[:, None], jnp.take(data, orig, axis=0), 0)
@@ -310,11 +308,12 @@ class _FirstLastSpec(_AggSpec):
         considered_s = ctx.take_sorted(considered)
         if self.is_first:
             win = ctx.seg_scan_reduce(
-                jnp.where(considered_s, i, _BIG), jnp.minimum)
+                jnp.where(considered_s, i, _BIG), jnp.minimum, _BIG)
             found = win < _BIG
         else:
             win = ctx.seg_scan_reduce(
-                jnp.where(considered_s, i, jnp.int64(-1)), jnp.maximum)
+                jnp.where(considered_s, i, jnp.int64(-1)), jnp.maximum,
+                jnp.int64(-1))
             found = win >= 0
         j = jnp.clip(win, 0, ctx.cap - 1)
         orig = jnp.take(ctx.order, j)  # original row index of the winner

@@ -1373,6 +1373,7 @@ _DISPATCH = {
     ir.StringTrimLeft: _str_unary(lambda s: s.lstrip(" ")),
     ir.StringTrimRight: _str_unary(lambda s: s.rstrip(" ")),
     ir.InitCap: _initcap,
+    ir.StringReverse: _str_unary(lambda s: s[::-1]),
     ir.StringReplace: _str_replace,
     ir.SubstringIndex: _substring_index,
     ir.StringSplit: _string_split,

@@ -1051,6 +1051,16 @@ def _eval_lower(e, batch):
                   c.validity, c.lengths)
 
 
+def _eval_reverse(e, batch):
+    c = evaluate(e.child, batch)
+    w = c.data.shape[1]
+    pos = jnp.arange(w)[None, :]
+    src = jnp.clip(c.lengths[:, None] - 1 - pos, 0, w - 1)
+    out = jnp.take_along_axis(c.data, src, axis=1)
+    out = jnp.where(pos < c.lengths[:, None], out, 0)
+    return ColVal(dt.STRING, out, c.validity, c.lengths)
+
+
 def _eval_length(e, batch):
     c = evaluate(e.child, batch)
     # NOTE: byte length == char length for ASCII; UTF-8 char count needs a
@@ -2087,4 +2097,5 @@ def _eval_rlike(e, batch):
 
 
 _DISPATCH[ir.RLike] = _eval_rlike
+_DISPATCH[ir.StringReverse] = _eval_reverse
 _DISPATCH[ir.RegExpReplace] = _eval_regexp_replace

@@ -680,6 +680,15 @@ class StringTrimRight(UnaryExpression):
         self.nullable = self.child.nullable
 
 
+class StringReverse(UnaryExpression):
+    """reverse(str): bytes reversed within the string length (ASCII;
+    reference: GpuStringReverse via cudf strings::reverse)."""
+
+    def resolve(self) -> None:
+        self.dtype = dt.STRING
+        self.nullable = self.child.nullable
+
+
 class StringLocate(Expression):
     """locate(substr, str, start) -> 1-based position or 0."""
 

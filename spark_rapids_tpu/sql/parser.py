@@ -156,7 +156,7 @@ def _register_functions():
         "lower": F.lower, "lcase": F.lower,
         "length": F.length, "char_length": F.length,
         "trim": F.trim, "ltrim": F.ltrim, "rtrim": F.rtrim,
-        "initcap": F.initcap,
+        "initcap": F.initcap, "reverse": F.reverse,
         "year": F.year, "month": F.month,
         "day": F.dayofmonth, "dayofmonth": F.dayofmonth,
         "dayofyear": F.dayofyear, "dayofweek": F.dayofweek,
@@ -352,7 +352,33 @@ class Parser:
                 orders.append(self.order_item(out_scope, plan))
                 if not self.accept("op", ","):
                     break
-            plan = lp.Sort(plan, orders)
+            # Spark resolves sort refs against the SELECT output first,
+            # then against the projection's INPUT, carrying missing
+            # input columns through as hidden sort columns and dropping
+            # them after the sort (ResolveSortReferences)
+            missing = []
+            for o in orders:
+                for a in ir.collect(
+                        o.expr,
+                        lambda n: isinstance(n, ir.UnresolvedAttribute)):
+                    if a.attr_name not in plan.schema.names and \
+                            a.attr_name not in missing:
+                        missing.append(a.attr_name)
+            visible = list(plan.schema.names)
+            if missing and isinstance(plan, lp.Project) and \
+                    len(set(visible)) == len(visible) and all(
+                    m in plan.children[0].schema.names for m in missing):
+                inner = plan.children[0]
+                aug = lp.Project(
+                    inner,
+                    [ir.Alias(e, n) for e, n in
+                     zip(plan.exprs, visible)] +
+                    [ir.UnresolvedAttribute(m) for m in missing])
+                srt = lp.Sort(aug, orders)
+                plan = lp.Project(
+                    srt, [ir.UnresolvedAttribute(n) for n in visible])
+            else:
+                plan = lp.Sort(plan, orders)
 
         if self.kw("limit"):
             n = self.expect("num").value
@@ -595,11 +621,11 @@ class Parser:
             asc = False
         else:
             self.kw("asc")
-        nulls: Optional[str] = None
+        nulls: Optional[bool] = None   # SortOrder.nulls_first is a BOOL
         if self.kw("nulls", "first"):
-            nulls = "first"
+            nulls = True
         elif self.kw("nulls", "last"):
-            nulls = "last"
+            nulls = False
         return SortOrder(e, asc, nulls)
 
     # -- FROM -------------------------------------------------------------

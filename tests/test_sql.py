@@ -219,3 +219,232 @@ def test_sql_sum_distinct():
     # salaries {100.0, 85.5, 92.0, None, 40.0, 85.5} → distinct sum
     assert abs(out.column("s")[0].as_py() - (100.0 + 85.5 + 92.0 + 40.0)) \
         < 1e-9
+
+
+# -- qa_nightly-style SELECT-surface sweep ---------------------------------
+# The reference's qa_nightly_select_test.py (818 LoC) sweeps hundreds of
+# SELECT fragments over typed random data; this is the engine-parser
+# analog: every parser production x the typed columns of data_gen.
+
+def _qa_table():
+    from tests.data_gen import (gen_table, byte_gen, short_gen, int_gen,
+                                long_gen, float_gen, double_gen,
+                                boolean_gen, string_gen, date_gen,
+                                IntGen, StringGen)
+    gens = [IntGen(32, lo=0, hi=6), StringGen(max_len=3), byte_gen,
+            short_gen, int_gen, long_gen, float_gen, double_gen,
+            boolean_gen, string_gen, date_gen]
+    names = ["ik", "sk", "b", "s", "i", "l", "f", "d", "bo", "st", "dt"]
+    return gen_table(gens, names, n=180, seed=101)
+
+
+def _qa_run(query):
+    t = _qa_table()
+
+    def run(session):
+        session.create_dataframe(t, num_partitions=3) \
+            .create_or_replace_temp_view("qa")
+        return session.sql(query).collect()
+    return run
+
+
+def qa_check(query, allow_non_tpu=None):
+    cpu = with_cpu_session(_qa_run(query))
+    tpu = with_tpu_session(
+        _qa_run(query),
+        {"spark.rapids.tpu.sql.variableFloatAgg.enabled": True,
+         "spark.rapids.tpu.sql.castStringToFloat.enabled": True},
+        allow_non_tpu=allow_non_tpu)
+    assert_tables_equal(cpu, tpu, approx_float=True,
+                        ignore_order="ORDER BY" not in query)
+
+
+# every fragment is one SELECT through session.sql(); fragments marked
+# with a second tuple element list exec names allowed to stay on CPU
+_QA_SWEEP = [
+    # projection: arithmetic over every numeric width
+    "SELECT b + s AS x, i - l AS y, f * 2 AS z, d / 3 AS w FROM qa",
+    "SELECT -i AS ni, -f AS nf, l % 7 AS m FROM qa WHERE l IS NOT NULL",
+    "SELECT i + l AS il, b * s AS bs, d - f AS df FROM qa",
+    # math functions
+    "SELECT abs(i) AS a, sign(l) AS sg, ceil(d) AS c, floor(f) AS fl "
+    "FROM qa",
+    "SELECT sqrt(abs(d)) AS r, exp(ln(abs(d) + 1)) AS e FROM qa",
+    "SELECT pow(abs(f), 0.5) AS p, pmod(i, 5) AS pm, cbrt(d) AS cb "
+    "FROM qa",
+    "SELECT log2(abs(l) + 1) AS l2, log10(abs(i) + 1) AS l10 FROM qa",
+    "SELECT sin(f) AS sn, cos(f) AS cs, atan(d) AS at FROM qa",
+    "SELECT degrees(f) AS dg, radians(d) AS rd, signum(i) AS sg FROM qa",
+    "SELECT shiftleft(i, 2) AS sl, shiftright(l, 3) AS sr FROM qa",
+    # string functions
+    "SELECT upper(st) AS u, lower(st) AS lo, length(st) AS n FROM qa",
+    "SELECT trim(st) AS t, ltrim(st) AS lt, rtrim(st) AS rt FROM qa",
+    "SELECT substr(st, 2, 3) AS ss, initcap(st) AS ic FROM qa",
+    "SELECT concat(sk, '-', st) AS c, st || '!' AS bang FROM qa",
+    "SELECT lpad(sk, 5, '*') AS lp, rpad(sk, 5, '*') AS rp FROM qa",
+    "SELECT replace(st, 'a', '@') AS rep, locate('a', st) AS loc "
+    "FROM qa",
+    "SELECT md5(sk) AS h FROM qa WHERE sk IS NOT NULL",
+    "SELECT reverse(sk) AS r FROM qa",
+    # predicates and boolean logic
+    "SELECT * FROM qa WHERE i > 0 AND l < 0",
+    "SELECT * FROM qa WHERE NOT (bo OR i < 0)",
+    "SELECT * FROM qa WHERE f > 0 OR (d < 0 AND bo)",
+    "SELECT i = l AS eq, i != l AS ne, i <= l AS le, i >= l AS ge "
+    "FROM qa",
+    "SELECT * FROM qa WHERE b BETWEEN -10 AND 50",
+    "SELECT * FROM qa WHERE ik IN (1, 3, 5)",
+    "SELECT * FROM qa WHERE ik NOT IN (0, 2) AND ik IS NOT NULL",
+    "SELECT * FROM qa WHERE st LIKE '%a%'",
+    "SELECT * FROM qa WHERE st LIKE 'a_'",
+    "SELECT * FROM qa WHERE sk RLIKE '^[a-m]'",
+    "SELECT * FROM qa WHERE st IS NULL",
+    "SELECT * FROM qa WHERE st IS NOT NULL AND bo IS NOT NULL",
+    # conditionals and null functions
+    "SELECT CASE WHEN i > 0 THEN 'pos' WHEN i < 0 THEN 'neg' "
+    "ELSE 'zero' END AS sgn FROM qa",
+    "SELECT CASE ik WHEN 0 THEN 'a' WHEN 1 THEN 'b' END AS pick "
+    "FROM qa",
+    "SELECT coalesce(st, sk, 'none') AS c1, coalesce(i, b) AS c2 "
+    "FROM qa",
+    "SELECT if(bo, i, l) AS cond, nanvl(f, d) AS nv FROM qa",
+    "SELECT isnull(st) AS n1, isnan(f) AS n2 FROM qa",
+    # casts
+    "SELECT CAST(i AS bigint) AS a, CAST(l AS int) AS b2, "
+    "CAST(b AS smallint) AS c FROM qa",
+    "SELECT CAST(i AS double) AS a, CAST(f AS double) AS b2 FROM qa",
+    "SELECT CAST(d AS int) AS a FROM qa WHERE d BETWEEN -1e9 AND 1e9",
+    "SELECT CAST(ik AS string) AS a, CAST(bo AS string) AS b2 FROM qa",
+    "SELECT CAST(sk AS string) AS a FROM qa",
+    "SELECT CAST(dt AS string) AS a FROM qa",
+    # date functions
+    "SELECT year(dt) AS y, month(dt) AS m, day(dt) AS dd FROM qa",
+    "SELECT dayofyear(dt) AS dy, dayofweek(dt) AS dw, quarter(dt) "
+    "AS q, weekofyear(dt) AS w FROM qa",
+    "SELECT date_add(dt, 30) AS fwd, date_sub(dt, 7) AS back FROM qa",
+    "SELECT datediff(dt, DATE '2000-01-01') AS dd FROM qa",
+    "SELECT * FROM qa WHERE dt >= DATE '1990-06-15'",
+    # hash
+    "SELECT hash(ik, sk) AS h FROM qa",
+    # aggregates: global and grouped, every numeric type
+    "SELECT count(*) AS n, count(st) AS ns FROM qa",
+    "SELECT sum(b) AS sb, sum(s) AS ss, sum(i) AS si, sum(l) AS sl "
+    "FROM qa",
+    "SELECT min(f) AS mf, max(d) AS xd, avg(i) AS ai FROM qa",
+    "SELECT min(st) AS ms, max(sk) AS xs FROM qa",
+    "SELECT min(dt) AS md, max(dt) AS xd FROM qa",
+    "SELECT ik, count(*) AS n FROM qa GROUP BY ik",
+    "SELECT ik, sk, sum(l) AS t FROM qa GROUP BY ik, sk",
+    "SELECT ik, avg(d) AS a, min(i) AS lo, max(i) AS hi FROM qa "
+    "GROUP BY ik",
+    "SELECT ik, count(DISTINCT sk) AS u FROM qa GROUP BY ik",
+    "SELECT sum(DISTINCT ik) AS sd FROM qa",
+    "SELECT ik, sum(i) AS t FROM qa GROUP BY ik HAVING count(*) > 10",
+    "SELECT ik + 1 AS k2, count(*) AS n FROM qa GROUP BY k2",
+    # distinct
+    "SELECT DISTINCT ik FROM qa",
+    "SELECT DISTINCT ik, bo FROM qa",
+    # order by variants
+    "SELECT ik, i FROM qa ORDER BY ik ASC NULLS FIRST, i DESC "
+    "NULLS LAST, l",
+    "SELECT ik, l FROM qa ORDER BY 2 DESC, 1 LIMIT 20",
+    "SELECT st FROM qa ORDER BY st NULLS LAST LIMIT 10",
+    "SELECT f FROM qa ORDER BY f",                      # NaN ordering
+    "SELECT dt FROM qa ORDER BY dt DESC LIMIT 15",
+    # limit
+    "SELECT * FROM qa LIMIT 7",
+    "SELECT ik FROM qa WHERE ik IS NOT NULL LIMIT 0",
+    # subqueries / CTE / union
+    "SELECT k2, count(*) AS n FROM (SELECT ik + 1 AS k2 FROM qa "
+    "WHERE ik IS NOT NULL) t GROUP BY k2",
+    "WITH pos AS (SELECT * FROM qa WHERE i > 0), "
+    "neg AS (SELECT * FROM qa WHERE i < 0) "
+    "SELECT (SELECT_COUNT_POS.n) AS np FROM "
+    "(SELECT count(*) AS n FROM pos) SELECT_COUNT_POS",
+    "SELECT ik FROM qa WHERE i > 0 UNION ALL SELECT ik FROM qa "
+    "WHERE i <= 0",
+    "WITH a AS (SELECT ik, sum(l) AS t FROM qa GROUP BY ik) "
+    "SELECT * FROM a WHERE t > 0",
+]
+
+
+@pytest.mark.parametrize("q", _QA_SWEEP)
+def test_sql_select_surface(q):
+    qa_check(q, allow_non_tpu=["CpuProjectExec"])
+
+
+_QA_JOINS = [
+    # the engine keeps flat output names: same-name non-key columns on
+    # both sides must be aliased apart (documented restriction)
+    "SELECT a.ik, a.i, b2.l2 FROM qa a JOIN "
+    "(SELECT ik AS ik2, l AS l2 FROM qa) b2 ON a.ik = b2.ik2 "
+    "WHERE a.i > 0 AND b2.l2 > 0",
+    "SELECT a.ik, b2.sk2 FROM qa a LEFT JOIN "
+    "(SELECT DISTINCT ik AS ik2, sk AS sk2 FROM qa WHERE ik < 3) b2 "
+    "ON a.ik = b2.ik2",
+    "SELECT a.ik FROM qa a LEFT SEMI JOIN "
+    "(SELECT ik AS ik2 FROM qa WHERE bo) b2 ON a.ik = b2.ik2",
+    "SELECT a.ik FROM qa a LEFT ANTI JOIN "
+    "(SELECT ik AS ik2 FROM qa WHERE bo) b2 ON a.ik = b2.ik2",
+    "SELECT a.ik, b2.ik2 FROM (SELECT DISTINCT ik FROM qa) a FULL "
+    "JOIN (SELECT DISTINCT ik AS ik2 FROM qa WHERE ik > 2) b2 "
+    "ON a.ik = b2.ik2",
+    "SELECT c1.ik, c2.mx FROM (SELECT DISTINCT ik FROM qa) c1 JOIN "
+    "(SELECT ik, max(l) AS mx FROM qa GROUP BY ik) c2 USING (ik)",
+    "SELECT x.ik, y.ik2 FROM (SELECT DISTINCT ik FROM qa WHERE "
+    "ik < 2) x CROSS JOIN (SELECT DISTINCT ik AS ik2 FROM qa WHERE "
+    "ik > 4) y",
+]
+
+
+@pytest.mark.parametrize("q", _QA_JOINS)
+def test_sql_join_surface(q):
+    qa_check(q, allow_non_tpu=["CpuProjectExec"])
+
+
+def test_sql_select_surface_runs_on_tpu():
+    """The sweep's core shapes must actually plan onto the TPU — probe
+    one representative fragment per exec family."""
+    t = _qa_table()
+
+    def plan_of(query):
+        def run(session):
+            session.create_dataframe(t, num_partitions=3) \
+                .create_or_replace_temp_view("qa")
+            return session.sql(query).explain_string("physical")
+        return with_tpu_session(
+            run, {"spark.rapids.tpu.sql.variableFloatAgg.enabled": True})
+
+    assert "TpuFilterExec" in plan_of("SELECT * FROM qa WHERE i > 0")
+    assert "TpuHashAggregateExec" in plan_of(
+        "SELECT ik, count(*) AS n FROM qa GROUP BY ik")
+    assert "TpuSortExec" in plan_of("SELECT i FROM qa ORDER BY i")
+    assert "JoinExec" in plan_of(
+        "SELECT a.ik FROM qa a JOIN (SELECT ik AS ik2 FROM qa) b2 "
+        "ON a.ik = b2.ik2")
+
+
+def test_sql_nulls_last_ground_truth():
+    """Engine-vs-engine parity cannot catch a shared NULLS LAST bug —
+    pin the absolute placement."""
+    t = pa.table({"x": pa.array([3, None, 1, None, 2],
+                                type=pa.int64())})
+
+    def run(session):
+        session.create_dataframe(t).create_or_replace_temp_view("nl")
+        return session.sql("SELECT x FROM nl ORDER BY x NULLS LAST")
+
+    for sess in (with_cpu_session, ):
+        out = sess(lambda s: run(s).collect()).column("x").to_pylist()
+        assert out == [1, 2, 3, None, None], out
+    out = with_tpu_session(
+        lambda s: run(s).collect()).column("x").to_pylist()
+    assert out == [1, 2, 3, None, None], out
+    # NULLS FIRST with DESC (non-default placement on both counts)
+    def run2(session):
+        session.create_dataframe(t).create_or_replace_temp_view("nl")
+        return session.sql(
+            "SELECT x FROM nl ORDER BY x DESC NULLS FIRST")
+    out2 = with_cpu_session(
+        lambda s: run2(s).collect()).column("x").to_pylist()
+    assert out2 == [None, None, 3, 2, 1], out2
