@@ -154,9 +154,8 @@ def _build_device_pipeline(root: str):
     from spark_rapids_tpu.io import parquet_meta as pqm
     from spark_rapids_tpu.exec.tpu_aggregate import (
         finalize_aggregate, make_spec, update_aggregate)
-    from spark_rapids_tpu.exec.tpu_basic import compact
     from spark_rapids_tpu.columnar.batch import DeviceBatch
-    from spark_rapids_tpu.expr import eval_tpu, ir
+    from spark_rapids_tpu.expr import ir
     from spark_rapids_tpu.plan.logical import Schema
 
     paths = sorted(os.path.join(root, p) for p in os.listdir(root))
@@ -202,10 +201,12 @@ def _build_device_pipeline(root: str):
     def one_query(arrays):
         cols, _ = decode(arrays)
         batch = DeviceBatch(wanted, list(cols), total_rows)
-        v = eval_tpu.evaluate(cond, batch)
-        filtered = compact(batch, v.data.astype(jnp.bool_) & v.validity)
-        partial = update_aggregate(filtered, groupings, aggregates,
-                                   specs)
+        # fused filter (the planner's agg.fusedFilter post-pass shape):
+        # the filter is a MASK inside the aggregate's update kernel —
+        # compaction would cost one full-capacity gather per column
+        # while the sort-based grouping is capacity-proportional anyway
+        partial = update_aggregate(batch, groupings, aggregates,
+                                   specs, condition=cond)
         out = finalize_aggregate(partial, 1, specs,
                                  ["k", "cnt", "qty", "aesp"])
         chk = (jnp.sum(out.columns[1].data,

@@ -1,0 +1,418 @@
+"""Staged-baseline benchmarks beyond q6 (BASELINE.json configs 2-3).
+
+Measures, with the same K-loop differencing harness as bench.py (see
+PERF.md for why), the engine's REAL kernels on:
+
+  - join-heavy (q14/q72/q95-class, scaled): fact JOIN item JOIN
+    warehouse -> group-by category -> count + sum, via the join execs'
+    own sort/count/emit kernels (exec/tpu_join.py) feeding the fused
+    hash aggregate.
+  - window+sort (q47/q67-class, scaled): rank() + running sum over
+    (item) ordered by month (exec/tpu_window.py kernels), then a total
+    ORDER BY (exec/tpu_sort.py kernels).
+
+Prints one JSON line per config: {"metric", "value" (GB/s of raw input
+bytes), "unit", "vs_baseline" (CPU-engine wall / device per-query),
+"tpu_pipeline_ms", "cpu_wall_s", "rows_match"}.  Row/value parity
+against the engine's CPU path is asserted before any number is
+reported.  Run `python bench_extra.py [--smoke]`.
+"""
+
+import json
+import sys
+import time
+
+import numpy as np
+import pyarrow as pa
+
+ITERS_LOOP = 6
+
+
+def _gen_join_data(n_fact: int, seed: int = 7):
+    rng = np.random.default_rng(seed)
+    fact = pa.table({
+        "item_sk": pa.array(rng.integers(1, 18001, n_fact)
+                            .astype(np.int64)),
+        "warehouse_sk": pa.array(rng.integers(1, 21, n_fact)
+                                 .astype(np.int64)),
+        "qty": pa.array(rng.integers(1, 100, n_fact).astype(np.int64)),
+    })
+    items = pa.table({
+        "item_sk": pa.array(np.arange(1, 18001, dtype=np.int64)),
+        "category": pa.array(rng.integers(0, 10, 18000)
+                             .astype(np.int64)),
+    })
+    warehouses = pa.table({
+        "warehouse_sk": pa.array(np.arange(1, 21, dtype=np.int64)),
+        "state": pa.array(rng.integers(0, 5, 20).astype(np.int64)),
+    })
+    return fact, items, warehouses
+
+
+def _gen_window_data(n: int, seed: int = 9):
+    rng = np.random.default_rng(seed)
+    return pa.table({
+        "item_sk": pa.array(rng.integers(1, 1001, n).astype(np.int64)),
+        "month": pa.array(rng.integers(0, 120, n).astype(np.int64)),
+        "sales": pa.array(
+            np.round(rng.uniform(1.0, 500.0, n), 2)),
+    })
+
+
+# ---------------------------------------------------------------------------
+# join-heavy config
+# ---------------------------------------------------------------------------
+
+def _join_query_cpu(s, fact, items, warehouses):
+    import spark_rapids_tpu.api.functions as F
+    from spark_rapids_tpu import col
+    f = s.create_dataframe(fact)
+    i = s.create_dataframe(items.rename_columns(["item_sk2",
+                                                 "category"]))
+    w = s.create_dataframe(warehouses.rename_columns(["warehouse_sk2",
+                                                      "state"]))
+    j = f.join(i, on=(col("item_sk") == col("item_sk2")),
+               how="inner") \
+         .join(w, on=(col("warehouse_sk") == col("warehouse_sk2")),
+               how="inner")
+    return j.group_by("category").agg(
+        F.count("*").alias("cnt"), F.sum("qty").alias("sq"))
+
+
+def _build_join_pipeline(fact, items, warehouses):
+    import jax
+    import jax.numpy as jnp
+    from spark_rapids_tpu.columnar.batch import (bucket_rows, from_arrow,
+                                                 DeviceBatch)
+    from spark_rapids_tpu.exec import sortkeys
+    from spark_rapids_tpu.exec.tpu_join import (_count_kernel,
+                                                _emit_kernel,
+                                                _join_sort_key)
+    from spark_rapids_tpu.exec.tpu_aggregate import (
+        finalize_aggregate, make_spec, update_aggregate)
+    from spark_rapids_tpu.expr import ir
+
+    fb = from_arrow(fact)
+    ib = from_arrow(items)
+    wb = from_arrow(warehouses)
+
+    def join_once(build: DeviceBatch, stream: DeviceBatch,
+                  bkey: str, skey: str, out_cap: int) -> DeviceBatch:
+        """Inner join with the execs' kernels at a STATIC emit cap (the
+        engine sizes it per batch via the count kernel; the loop
+        harness pre-sizes it once the same way)."""
+        bnames = [f"__b{i}" for i in range(build.num_cols)]
+        snames = [f"__s{i}" for i in range(stream.num_cols)]
+        bk = [bnames[build.names.index(bkey)]]
+        sk = [snames[stream.names.index(skey)]]
+        b2 = DeviceBatch(bnames, build.columns, build.num_rows)
+        s2 = DeviceBatch(snames, stream.columns, stream.num_rows)
+        seg0, packed = _join_sort_key(b2, s2, bk, sk)[3:5]
+        order = sortkeys.shared_lexsort(jnp.reshape(packed, (1, -1)))
+        out = _emit_kernel(b2, s2, order, seg0, bk, sk, "inner",
+                           out_cap, bnames, snames, False)
+        names = (stream.names +
+                 [f"b_{n}" for n in build.names])
+        return DeviceBatch(names, out.columns, out.num_rows)
+
+    # static emit caps: count once on host (exactly what the engine's
+    # count kernel does per batch)
+    def _count(build, stream, bkey, skey):
+        bnames = [f"__b{i}" for i in range(build.num_cols)]
+        snames = [f"__s{i}" for i in range(stream.num_cols)]
+        bk = [bnames[build.names.index(bkey)]]
+        sk = [snames[stream.names.index(skey)]]
+        b2 = DeviceBatch(bnames, build.columns, build.num_rows)
+        s2 = DeviceBatch(snames, stream.columns, stream.num_rows)
+        seg0, packed = _join_sort_key(b2, s2, bk, sk)[3:5]
+        order = sortkeys.shared_lexsort(jnp.reshape(packed, (1, -1)))
+        return int(_count_kernel(b2, s2, order, seg0, bk, sk, "inner"))
+
+    n1 = _count(ib, fb, "item_sk", "item_sk")
+    cap1 = bucket_rows(n1)
+
+    def stage1(f_in):
+        return join_once(ib, f_in, "item_sk", "item_sk", cap1)
+
+    j1_probe = jax.jit(stage1)(fb)
+    n2 = _count(wb, j1_probe, "warehouse_sk", "warehouse_sk")
+    cap2 = bucket_rows(n2)
+
+    schema_names = None
+    g = ir.UnresolvedAttribute("b_category")
+    aggs = [ir.Count(None), ir.Sum(ir.UnresolvedAttribute("qty"))]
+
+    def pipeline(f_in):
+        j1 = stage1(f_in)
+        j2 = join_once(wb, j1, "warehouse_sk", "warehouse_sk", cap2)
+        names = j2.names
+        dtypes = [c.dtype for c in j2.columns]
+        nullables = [True] * len(names)
+        gb = ir.bind(ir.UnresolvedAttribute("b_category"), names,
+                     dtypes, nullables)
+        ags = []
+        for a in [ir.Count(None),
+                  ir.Sum(ir.bind(ir.UnresolvedAttribute("qty"), names,
+                                 dtypes, nullables))]:
+            a.resolve()
+            ags.append(a)
+        specs = [make_spec(a) for a in ags]
+        partial = update_aggregate(j2, [gb], ags, specs)
+        out = finalize_aggregate(partial, 1, specs,
+                                 ["category", "cnt", "sq"])
+        return out
+
+    return fb, pipeline
+
+
+def bench_join(n_fact: int, label: str):
+    import jax
+    import jax.numpy as jnp
+    from spark_rapids_tpu import TpuSparkSession
+    from spark_rapids_tpu.columnar.batch import to_arrow
+
+    fact, items, warehouses = _gen_join_data(n_fact)
+    nbytes = fact.nbytes + items.nbytes + warehouses.nbytes
+
+    # CPU leg
+    s = TpuSparkSession({"spark.rapids.tpu.sql.enabled": False})
+    cpu_q = lambda: _join_query_cpu(s, fact, items, warehouses).collect()
+    cpu_out = cpu_q()
+    times = []
+    for _ in range(3):
+        t0 = time.perf_counter()
+        cpu_out = cpu_q()
+        times.append(time.perf_counter() - t0)
+    cpu_time = min(times)
+
+    fb, pipeline = _build_join_pipeline(fact, items, warehouses)
+
+    out_batch = jax.jit(pipeline)(fb)
+    tpu_out = to_arrow(out_batch)
+
+    cpu_s = cpu_out.sort_by("category")
+    tpu_s = tpu_out.rename_columns(
+        list(cpu_out.column_names)).sort_by("category")
+    rows_match = (cpu_s.num_rows == tpu_s.num_rows and
+                  cpu_s.column("cnt").equals(tpu_s.column("cnt")) and
+                  cpu_s.column("sq").equals(tpu_s.column("sq")))
+
+    def loop(f_in, k):
+        def body(_, carry):
+            chk, d0 = carry
+            cols = list(f_in.columns)
+            from spark_rapids_tpu.columnar.batch import (DeviceBatch,
+                                                         DeviceColumn)
+            c0 = cols[0]
+            data = jnp.where(chk == jnp.int32(-123456789),
+                             c0.data + 1, c0.data)
+            cols[0] = DeviceColumn(c0.dtype, data, c0.validity,
+                                   c0.lengths, c0.elem_validity)
+            fb2 = DeviceBatch(f_in.names, cols, f_in.num_rows)
+            out = pipeline(fb2)
+            chk2 = (jnp.sum(out.columns[1].data,
+                            where=out.columns[1].validity)
+                    ).astype(jnp.int32)
+            return chk ^ chk2, d0
+        chk, _ = jax.lax.fori_loop(0, k, body,
+                                   (jnp.int32(0), jnp.int32(0)))
+        return chk
+
+    f1 = jax.jit(lambda b: loop(b, 1))
+    fN = jax.jit(lambda b: loop(b, ITERS_LOOP))
+
+    def timed_read(f):
+        t0 = time.perf_counter()
+        int(np.asarray(f(fb)))
+        return time.perf_counter() - t0
+
+    timed_read(f1)
+    timed_read(fN)
+    t1 = min(timed_read(f1) for _ in range(2))
+    tN = min(timed_read(fN) for _ in range(2))
+    per = max((tN - t1) / (ITERS_LOOP - 1), 1e-9)
+
+    if not rows_match:
+        print(json.dumps({"metric": label, "rows_match": False,
+                          "error": "parity mismatch"}))
+        return
+    print(json.dumps({
+        "metric": label, "value": round(nbytes / per / 1e9, 3),
+        "unit": "GB/s", "vs_baseline": round(cpu_time / per, 3),
+        "tpu_pipeline_ms": round(per * 1e3, 2),
+        "cpu_wall_s": round(cpu_time, 4),
+        "rows_match": True}), flush=True)
+
+
+# ---------------------------------------------------------------------------
+# window+sort config
+# ---------------------------------------------------------------------------
+
+def _window_query_cpu(s, t):
+    import spark_rapids_tpu.api.functions as F
+    from spark_rapids_tpu.api.window import Window
+    from spark_rapids_tpu import col
+    w = Window.partition_by("item_sk").order_by("month")
+    df = s.create_dataframe(t)
+    return df.select(
+        "item_sk", "month", "sales",
+        F.rank().over(w).alias("rk"),
+        F.sum("sales").over(w).alias("run")) \
+        .sort(col("item_sk"), col("rk"))
+
+
+def bench_window(n: int, label: str):
+    import jax
+    import jax.numpy as jnp
+    from spark_rapids_tpu import TpuSparkSession
+    from spark_rapids_tpu.columnar.batch import from_arrow, to_arrow
+    from spark_rapids_tpu.exec import sortkeys
+    from spark_rapids_tpu.exec.tpu_sort import TpuSortExec
+    from spark_rapids_tpu.exec.tpu_window import TpuWindowExec
+    from spark_rapids_tpu.expr import ir
+    from spark_rapids_tpu.plan.logical import Schema, SortOrder
+
+    t = _gen_window_data(n)
+    nbytes = t.nbytes
+
+    s = TpuSparkSession({"spark.rapids.tpu.sql.enabled": False,
+                         "spark.rapids.tpu.sql.variableFloatAgg.enabled":
+                         True})
+    cpu_q = lambda: _window_query_cpu(s, t).collect()
+    cpu_out = cpu_q()
+    times = []
+    for _ in range(3):
+        t0 = time.perf_counter()
+        cpu_out = cpu_q()
+        times.append(time.perf_counter() - t0)
+    cpu_time = min(times)
+
+    batch = from_arrow(t)
+    schema = Schema.from_arrow(t.schema)
+
+    def b(e):
+        return ir.bind(e, schema.names, schema.dtypes, schema.nullables)
+
+    from spark_rapids_tpu.plan.logical import Field
+    part = [b(ir.UnresolvedAttribute("item_sk"))]
+
+    def orders():
+        return [SortOrder(b(ir.UnresolvedAttribute("month")))]
+    rank_fn = ir.Rank()
+    rank_fn.resolve()
+    sum_fn = ir.Sum(b(ir.UnresolvedAttribute("sales")))
+    sum_fn.resolve()
+    wes = [
+        ir.WindowExpression(rank_fn, part, orders(), None),
+        ir.WindowExpression(sum_fn, part, orders(),
+                            ir.WindowFrame("range", None, 0)),
+    ]
+    for we in wes:
+        we.resolve()
+    out_names = ["rk", "run"]
+    out_fields = list(schema.fields) + [
+        Field("rk", wes[0].dtype, True),
+        Field("run", wes[1].dtype, True)]
+    wschema = Schema(out_fields)
+    wexec = TpuWindowExec.__new__(TpuWindowExec)
+    wexec.window_exprs = wes
+    wexec.out_names = out_names
+    wexec._schema = wschema
+
+    sort_orders = [SortOrder(ir.bind(ir.UnresolvedAttribute("item_sk"),
+                                     wschema.names, wschema.dtypes,
+                                     [True] * len(wschema.names))),
+                   SortOrder(ir.bind(ir.UnresolvedAttribute("rk"),
+                                     wschema.names, wschema.dtypes,
+                                     [True] * len(wschema.names)))]
+
+    def pipeline(batch_in):
+        orders = tuple(
+            sortkeys.shared_lexsort(wexec._keys_impl(gi, batch_in))
+            for gi in range(len(wexec._spec_groups(out_names, wes))))
+        wout = wexec._impl(batch_in, orders)
+        # total ORDER BY (item_sk, rk)
+        groups = []
+        for o in sort_orders:
+            from spark_rapids_tpu.expr import eval_tpu
+            v = eval_tpu.evaluate(o.expr, wout)
+            groups.append(sortkeys.encode_keys(
+                v, o.ascending, o.nulls_first_resolved))
+        wm = sortkeys.stack_sort_words(groups, wout.row_mask())
+        order = sortkeys.shared_lexsort(wm)
+        return TpuSortExec._apply_impl(wout, order)
+
+    out_batch = jax.jit(pipeline)(batch)
+    tpu_out = to_arrow(out_batch)
+    cpu_cmp = cpu_out
+    tpu_cmp = tpu_out.rename_columns(list(cpu_out.column_names))
+    rows_match = (cpu_cmp.num_rows == tpu_cmp.num_rows and
+                  cpu_cmp.column("rk").equals(tpu_cmp.column("rk")) and
+                  np.allclose(
+                      cpu_cmp.column("run").to_numpy(
+                          zero_copy_only=False),
+                      tpu_cmp.column("run").to_numpy(
+                          zero_copy_only=False), rtol=1e-9))
+
+    def loop(b_in, k):
+        from spark_rapids_tpu.columnar.batch import (DeviceBatch,
+                                                     DeviceColumn)
+
+        def body(_, carry):
+            chk, d0 = carry
+            cols = list(b_in.columns)
+            c0 = cols[0]
+            data = jnp.where(chk == jnp.int32(-123456789),
+                             c0.data + 1, c0.data)
+            cols[0] = DeviceColumn(c0.dtype, data, c0.validity,
+                                   c0.lengths, c0.elem_validity)
+            b2 = DeviceBatch(b_in.names, cols, b_in.num_rows)
+            out = pipeline(b2)
+            chk2 = jnp.sum(out.columns[3].data).astype(jnp.int32)
+            return chk ^ chk2, d0
+        chk, _ = jax.lax.fori_loop(0, k, body,
+                                   (jnp.int32(0), jnp.int32(0)))
+        return chk
+
+    f1 = jax.jit(lambda x: loop(x, 1))
+    fN = jax.jit(lambda x: loop(x, ITERS_LOOP))
+
+    def timed_read(f):
+        t0 = time.perf_counter()
+        int(np.asarray(f(batch)))
+        return time.perf_counter() - t0
+
+    timed_read(f1)
+    timed_read(fN)
+    t1 = min(timed_read(f1) for _ in range(2))
+    tN = min(timed_read(fN) for _ in range(2))
+    per = max((tN - t1) / (ITERS_LOOP - 1), 1e-9)
+
+    if not rows_match:
+        print(json.dumps({"metric": label, "rows_match": False,
+                          "error": "parity mismatch"}))
+        return
+    print(json.dumps({
+        "metric": label, "value": round(nbytes / per / 1e9, 3),
+        "unit": "GB/s", "vs_baseline": round(cpu_time / per, 3),
+        "tpu_pipeline_ms": round(per * 1e3, 2),
+        "cpu_wall_s": round(cpu_time, 4),
+        "rows_match": True}), flush=True)
+
+
+def main():
+    smoke = "--smoke" in sys.argv
+    n_fact = 100_000 if smoke else 2_000_000
+    n_win = 100_000 if smoke else 2_000_000
+    bench_join(n_fact,
+               f"TPC-DS join-heavy q14/q72/q95-class scaled "
+               f"({n_fact} fact rows x item x warehouse -> group-by): "
+               "join sort/count/emit + fused agg kernels")
+    bench_window(n_win,
+                 f"TPC-DS window+sort q47/q67-class scaled "
+                 f"({n_win} rows, rank + running sum over (item_sk, "
+                 "month), total ORDER BY): window + sort kernels")
+
+
+if __name__ == "__main__":
+    main()

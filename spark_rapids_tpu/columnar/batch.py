@@ -36,6 +36,24 @@ import jax.numpy as jnp
 from spark_rapids_tpu import dtypes as dt
 
 
+def compact_arrays(keep: "jnp.ndarray", dest: "jnp.ndarray", data,
+                   validity, lengths=None, elem_validity=None):
+    """Stable-compaction scatter shared by every compact path (filter
+    compact, fused-filter value compact, ICI reassemble): row i moves
+    to dest[i] when keep[i], rows with dest >= len drop.  Returns
+    (data, validity, lengths, elem_validity)."""
+    d = jnp.zeros_like(data).at[dest].set(data, mode="drop")
+    v = jnp.zeros_like(validity).at[dest].set(validity & keep,
+                                              mode="drop")
+    ln = None if lengths is None else \
+        jnp.zeros_like(lengths).at[dest].set(
+            jnp.where(keep, lengths, 0), mode="drop")
+    ev = None if elem_validity is None else \
+        jnp.zeros_like(elem_validity).at[dest].set(
+            elem_validity & keep[:, None], mode="drop")
+    return d, v, ln, ev
+
+
 def bucket_rows(n: int, min_bucket: int = 16) -> int:
     """Next power-of-two capacity >= n (>= min_bucket)."""
     cap = max(int(min_bucket), 1)

@@ -86,7 +86,13 @@ class _SortedCtx:
         return ce - jnp.concatenate([ce[:1] * 0, ce[:-1]])
 
     def seg_count(self, mask: jnp.ndarray) -> jnp.ndarray:
-        return self.seg_sum(mask.astype(jnp.int64), mask)
+        # counts fit int32 (cap < 2^31): the native 32-bit cumsum skips
+        # the blocked 64-bit scan entirely; widen at the end
+        xs = self.take_sorted(mask).astype(jnp.int32)
+        c = jnp.cumsum(xs)
+        ce = jnp.take(c, self.end_pos)
+        return (ce - jnp.concatenate([ce[:1] * 0, ce[:-1]])
+                ).astype(jnp.int64)
 
     def seg_scan_reduce(self, x_sorted: jnp.ndarray, op,
                         identity) -> jnp.ndarray:
@@ -382,6 +388,14 @@ def sorted_group_ctx(key_vals: List[ColVal],
                      batch: DeviceBatch,
                      nullables: Optional[List[bool]] = None
                      ) -> _SortedCtx:
+    """Batch-shaped wrapper over _group_ctx (rows are prefix-dense:
+    row i exists iff i < num_rows)."""
+    return _group_ctx(key_vals, batch.capacity, batch.num_rows,
+                      nullables)
+
+
+def _group_ctx(key_vals: List[ColVal], cap: int, n_rows,
+               nullables: Optional[List[bool]] = None) -> _SortedCtx:
     """Group rows by key: stable LSD radix sort over bit-packed key
     digits brings equal keys adjacent, boundaries mark group starts, and
     every downstream reduction is scan+gather (see _SortedCtx).
@@ -391,15 +405,13 @@ def sorted_group_ctx(key_vals: List[ColVal],
     operand XLA sort compile (20-180 s measured) that forced round 3's
     hash-probe grouping is gone, and so are that path's per-iteration
     scatter rounds."""
-    cap = batch.capacity
-    row_mask = batch.row_mask()
+    row_mask = jnp.arange(cap) < n_rows
     i32 = jnp.arange(cap, dtype=jnp.int32)
     if not key_vals:
-        # global aggregation: one group holding every real row (rows
-        # are prefix-dense, so no sort is needed)
-        count = jnp.sum(row_mask.astype(jnp.int32))
-        end = jnp.zeros((cap,), jnp.int32).at[0].set(
-            jnp.maximum(count - 1, 0))
+        # global aggregation: one group holding every selected row (no
+        # sort needed; the single segment spans the whole capacity so a
+        # fused-filter mask with gaps still sums correctly)
+        end = jnp.full((cap,), 0, jnp.int32).at[0].set(cap - 1)
         return _SortedCtx(
             order=i32, new=(i32 == 0), gid_sorted=jnp.zeros_like(i32),
             start_pos=jnp.zeros((cap,), jnp.int32), end_pos=end,
@@ -504,32 +516,121 @@ def _laddered(batch: DeviceBatch, fn):
         if int(nr) <= rung:
             return _pad_batch(fn(_slice_batch(batch, rung)), cap)
         return fn(batch)
+    # traced counts pick via one lax.cond: both branches compile once
+    # (safe since exec/scans.py keeps 64-bit scans out of the
+    # pathological in-control-flow cumsum lowering), outputs pad back
+    # to cap
     return jax.lax.cond(
         nr <= rung,
         lambda: _pad_batch(fn(_slice_batch(batch, rung)), cap),
         lambda: fn(batch))
 
 
+def _slice_val(v: Optional[ColVal], n: int) -> Optional[ColVal]:
+    if v is None:
+        return None
+    return ColVal(
+        v.dtype, v.data[:n], v.validity[:n],
+        None if v.lengths is None else v.lengths[:n],
+        None if v.elem_validity is None else v.elem_validity[:n])
+
+
+def _compact_vals(keep: jnp.ndarray, vals: List[Optional[ColVal]],
+                  cap: int) -> Tuple[List[Optional[ColVal]], jnp.ndarray]:
+    """Stable-compact ONLY the evaluated value vectors (scatter to
+    prefix positions) — the fused-filter analog of tpu_basic.compact
+    that skips every batch column the aggregate never reads."""
+    from spark_rapids_tpu.columnar.batch import compact_arrays
+    count = jnp.sum(keep.astype(jnp.int32))
+    dest = jnp.where(keep, jnp.cumsum(keep.astype(jnp.int32)) - 1, cap)
+
+    def one(v: Optional[ColVal]) -> Optional[ColVal]:
+        if v is None:
+            return None
+        return ColVal(v.dtype, *compact_arrays(
+            keep, dest, v.data, v.validity, v.lengths,
+            v.elem_validity))
+
+    return [one(v) for v in vals], count
+
+
+def _laddered_vals(key_vals: List[ColVal],
+                   agg_vals: List[Optional[ColVal]],
+                   cap: int, n_rows, fn) -> DeviceBatch:
+    """Value-vector capacity ladder (see _laddered): when the live rows
+    fit in cap/4 — the common case under a fused selective filter — the
+    whole grouping runs at the statically smaller rung."""
+    rung = cap // 4
+    if rung < (1 << 18):
+        return fn(key_vals, agg_vals, cap, n_rows)
+
+    def small():
+        out = fn([_slice_val(v, rung) for v in key_vals],
+                 [_slice_val(v, rung) for v in agg_vals],
+                 rung, n_rows)
+        return _pad_batch(out, cap)
+
+    def big():
+        return fn(key_vals, agg_vals, cap, n_rows)
+
+    if isinstance(n_rows, (int, np.integer)):
+        return small() if int(n_rows) <= rung else big()
+    return jax.lax.cond(n_rows <= rung, small, big)
+
+
 def update_aggregate(batch: DeviceBatch,
                      groupings: Sequence[ir.Expression],
                      aggregates: Sequence[ir.AggregateExpression],
-                     specs: Sequence[_AggSpec]) -> DeviceBatch:
-    """Per-batch update phase: groupBy().aggregate(updateAggs) analog."""
-    def run(b: DeviceBatch) -> DeviceBatch:
-        key_vals = [normalize_key(eval_tpu.evaluate(g, b))
-                    for g in groupings]
-        ctx = sorted_group_ctx(key_vals, b,
-                               nullables=[g.nullable for g in groupings])
-        cols = gather_group_keys(key_vals, ctx)
+                     specs: Sequence[_AggSpec],
+                     condition: Optional[ir.Expression] = None
+                     ) -> DeviceBatch:
+    """Per-batch update phase: groupBy().aggregate(updateAggs) analog.
+
+    ``condition`` is a fused pre-filter (Filter directly under the
+    aggregate): the filter compacts ONLY the evaluated key/agg value
+    vectors (tpu_basic.compact would move every batch column), and the
+    prefix-dense survivors let the capacity ladder run the sort-based
+    grouping at a rung sized to the SELECTED rows — for the q6 bench's
+    25%-selective filter that is cap/4 for every sort pass, gather and
+    scan."""
+    def run(kv, av, cap2, nr):
+        ctx = _group_ctx(kv, cap2, nr,
+                         nullables=[g.nullable for g in groupings])
+        cols = gather_group_keys(kv, ctx)
         names = [f"__k{i}" for i in range(len(cols))]
-        bufs_per_spec = []
-        for agg, spec in zip(aggregates, specs):
-            v = eval_tpu.evaluate(agg.child, b) \
-                if agg.child is not None else None
-            bufs_per_spec.append(spec.update(v, ctx))
+        bufs_per_spec = [spec.update(v, ctx)
+                         for v, spec in zip(av, specs)]
         _append_buffers(cols, names, bufs_per_spec, specs, ctx)
         return DeviceBatch(names, cols, ctx.n_groups)
-    return _laddered(batch, run)
+
+    def eval_vals(b: DeviceBatch):
+        kv = [normalize_key(eval_tpu.evaluate(g, b))
+              for g in groupings]
+        av = [eval_tpu.evaluate(a.child, b)
+              if a.child is not None else None for a in aggregates]
+        return kv, av
+
+    if condition is None:
+        # batch-shaped ladder: expression evaluation itself runs at the
+        # rung when live rows fit (strings/regex children are per-row
+        # elementwise work worth 4x)
+        def run_batch(b: DeviceBatch) -> DeviceBatch:
+            kv, av = eval_vals(b)
+            return run(kv, av, b.capacity, b.num_rows)
+        return _laddered(batch, run_batch)
+
+    # fused filter: the condition must see every row, so evaluate at
+    # full capacity, compact the value vectors only, and ladder on the
+    # prefix-dense survivors
+    key_vals, agg_vals = eval_vals(batch)
+    cap = batch.capacity
+    cv = eval_tpu.evaluate(condition, batch)
+    keep = cv.data.astype(jnp.bool_) & cv.validity & batch.row_mask()
+    compacted, n_rows = _compact_vals(
+        keep, list(key_vals) + list(agg_vals), cap)
+    key_vals = compacted[:len(key_vals)]
+    agg_vals = compacted[len(key_vals):]
+    return _laddered_vals(key_vals, agg_vals, cap, n_rows, run)
 
 
 def merge_aggregate(batch: DeviceBatch, n_keys: int,
@@ -580,6 +681,12 @@ class TpuHashAggregateExec(TpuExec):
         # per_partition: aggregate each child partition independently
         # (the distributed plan shape over a hash exchange on the keys)
         self.per_partition = per_partition
+        # a Filter that sat directly below this aggregate, fused in by
+        # the overrides post-pass: rows failing it are MASKED instead
+        # of compacted (compact costs one full-capacity gather per
+        # column; the sort-based grouping is capacity-proportional
+        # either way)
+        self.fused_condition: Optional[ir.Expression] = None
         self._update_kernel = None
         self._merge_kernel = None
 
@@ -587,9 +694,15 @@ class TpuHashAggregateExec(TpuExec):
     def schema(self) -> Schema:
         return self._schema
 
+    def simple_string(self) -> str:
+        if self.fused_condition is not None:
+            return (f"TpuHashAggregateExec(fusedFilter="
+                    f"{self.fused_condition.sql()})")
+        return "TpuHashAggregateExec"
+
     def _update_impl(self, batch: DeviceBatch) -> DeviceBatch:
         return update_aggregate(batch, self.groupings, self.aggregates,
-                                self.specs)
+                                self.specs, self.fused_condition)
 
     def _merge_impl(self, batch: DeviceBatch) -> DeviceBatch:
         return merge_aggregate(batch, len(self.groupings), self.specs)
@@ -607,12 +720,18 @@ class TpuHashAggregateExec(TpuExec):
             sig = (kc.exprs_sig(self.groupings),
                    kc.exprs_sig(self.aggregates),
                    tuple(self._schema.names))
+            # only the UPDATE kernel evaluates the fused condition;
+            # merge/final kernels are identical across filters and must
+            # share one compile (aggregate sorts cost ~17-20 s each)
+            usig = sig + (kc.expr_sig(self.fused_condition)
+                          if self.fused_condition is not None else None,)
             shim = types.SimpleNamespace(
                 groupings=self.groupings, aggregates=self.aggregates,
-                specs=self.specs, _schema=self._schema)
+                specs=self.specs, _schema=self._schema,
+                fused_condition=self.fused_condition)
             cls = type(self)
             self._update_kernel = kc.get_kernel(
-                ("agg_update", sig),
+                ("agg_update", usig),
                 lambda: functools.partial(cls._update_impl, shim))
             self._merge_kernel = kc.get_kernel(
                 ("agg_merge", sig),

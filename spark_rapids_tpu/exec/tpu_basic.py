@@ -179,25 +179,14 @@ def compact(batch: DeviceBatch, keep: jnp.ndarray) -> DeviceBatch:
     mask for destination slots, then SCATTER kept rows (dropped rows
     scatter out of bounds).  No sort — XLA sort compiles are minutes-
     scale on TPU at SQL batch sizes, scatter is milliseconds."""
+    from spark_rapids_tpu.columnar.batch import compact_arrays
     cap = batch.capacity
     keep = keep & batch.row_mask()
     count = jnp.sum(keep.astype(jnp.int32))
     dest = jnp.where(keep, jnp.cumsum(keep.astype(jnp.int32)) - 1, cap)
-    cols = []
-    for c in batch.columns:
-        data = jnp.zeros_like(c.data).at[dest].set(
-            c.data, mode="drop")
-        validity = jnp.zeros_like(c.validity).at[dest].set(
-            c.validity & keep, mode="drop")
-        lengths = None
-        ev = None
-        if c.lengths is not None:
-            lengths = jnp.zeros_like(c.lengths).at[dest].set(
-                jnp.where(keep, c.lengths, 0), mode="drop")
-        if c.elem_validity is not None:
-            ev = jnp.zeros_like(c.elem_validity).at[dest].set(
-                c.elem_validity & keep[:, None], mode="drop")
-        cols.append(DeviceColumn(c.dtype, data, validity, lengths, ev))
+    cols = [DeviceColumn(c.dtype, *compact_arrays(
+        keep, dest, c.data, c.validity, c.lengths, c.elem_validity))
+        for c in batch.columns]
     return DeviceBatch(batch.names, cols, count)
 
 

@@ -693,6 +693,8 @@ class TpuOverrides:
               ) -> "OverrideResult":
         meta = wrap_and_tag(cpu_plan, conf)
         plan = _convert(meta, conf)
+        if conf.get(cfg.AGG_FUSED_FILTER):
+            _fuse_filters_into_aggregates(plan)
         if plan.is_tpu:
             plan = tpub.DeviceToHostExec(plan)
         if _plan_uses_input_file(cpu_plan):
@@ -711,6 +713,29 @@ class TpuOverrides:
             if lines:
                 print("\n".join(lines))
         return OverrideResult(plan, meta)
+
+
+def _fuse_filters_into_aggregates(plan: PhysicalPlan) -> None:
+    """Post-conversion pass: a TpuFilterExec DIRECTLY under a
+    TpuHashAggregateExec becomes a fused mask inside the aggregate's
+    update kernel (see TpuHashAggregateExec.fused_condition).  The
+    reference keeps the nodes separate because cudf compacts cheaply;
+    on TPU the compact's per-column full-capacity gathers cost more
+    than the whole masked aggregation."""
+    from spark_rapids_tpu.exec.tpu_aggregate import TpuHashAggregateExec
+    from spark_rapids_tpu.exec.tpu_basic import TpuFilterExec
+
+    def rec(n: PhysicalPlan) -> None:
+        if isinstance(n, TpuHashAggregateExec) and \
+                n.fused_condition is None and \
+                isinstance(n.children[0], TpuFilterExec):
+            f = n.children[0]
+            n.fused_condition = f.condition
+            n.children = (f.children[0],)
+        for c in n.children:
+            rec(c)
+
+    rec(plan)
 
 
 @dataclass

@@ -138,18 +138,10 @@ def reassemble(names: Sequence[str], stacked_cols: List[DeviceColumn],
     count = jnp.sum(valid.astype(jnp.int32))
     dest = jnp.where(valid, jnp.cumsum(valid.astype(jnp.int32)) - 1,
                      tcap)
-    cols = []
-    for c in flat_cols:
-        data = jnp.zeros_like(c.data).at[dest].set(c.data, mode="drop")
-        validity = jnp.zeros_like(c.validity).at[dest].set(
-            c.validity & valid, mode="drop")
-        lengths = jnp.zeros_like(c.lengths).at[dest].set(
-            jnp.where(valid, c.lengths, 0), mode="drop") \
-            if c.lengths is not None else None
-        ev = jnp.zeros_like(c.elem_validity).at[dest].set(
-            c.elem_validity & valid[:, None], mode="drop") \
-            if c.elem_validity is not None else None
-        cols.append(DeviceColumn(c.dtype, data, validity, lengths, ev))
+    from spark_rapids_tpu.columnar.batch import compact_arrays
+    cols = [DeviceColumn(c.dtype, *compact_arrays(
+        valid, dest, c.data, c.validity, c.lengths, c.elem_validity))
+        for c in flat_cols]
     return DeviceBatch(names, cols, count)
 
 

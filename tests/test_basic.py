@@ -176,3 +176,51 @@ def test_empty_input():
         lambda s: s.create_dataframe(
             {"a": pa.array([], type=pa.int32())})
         .filter(col("a") > 0).select((col("a") + 1).alias("b")))
+
+
+def test_filter_fuses_into_aggregate():
+    """A Filter directly under a hash aggregate fuses into the update
+    kernel as a mask (overrides post-pass) — and still matches CPU."""
+    import numpy as np
+    from tests.parity import (assert_tables_equal, collect_plans,
+                              with_cpu_session)
+    from spark_rapids_tpu import TpuSparkSession, col, functions as F
+    rng = np.random.default_rng(21)
+    t = pa.table({
+        "k": pa.array(rng.integers(0, 9, 400), type=pa.int32()),
+        "v": pa.array(rng.integers(-50, 50, 400), type=pa.int64()),
+    })
+
+    def q(s):
+        df = s.create_dataframe(t, num_partitions=2)
+        return df.filter(col("v") > 0).group_by("k").agg(
+            F.count("*").alias("c"), F.sum("v").alias("sv"))
+
+    cpu = with_cpu_session(lambda s: q(s).collect())
+    s = TpuSparkSession(
+        {"spark.rapids.tpu.sql.variableFloatAgg.enabled": True})
+    captured = collect_plans(s)
+    got = q(s).collect()
+    assert_tables_equal(cpu, got, ignore_order=True)
+    from spark_rapids_tpu.exec.tpu_aggregate import TpuHashAggregateExec
+    from spark_rapids_tpu.exec.tpu_basic import TpuFilterExec
+    aggs, filters = [], []
+    captured[-1].plan.foreach(
+        lambda x: aggs.append(x) if isinstance(x, TpuHashAggregateExec)
+        else filters.append(x) if isinstance(x, TpuFilterExec) else None)
+    assert aggs and any(a.fused_condition is not None for a in aggs)
+    assert not filters, "filter should have fused away"
+    assert "fusedFilter" in captured[-1].plan.tree_string()
+
+    # kill switch restores the unfused shape
+    s2 = TpuSparkSession({
+        "spark.rapids.tpu.sql.agg.fusedFilter.enabled": False,
+        "spark.rapids.tpu.sql.variableFloatAgg.enabled": True})
+    captured2 = collect_plans(s2)
+    got2 = q(s2).collect()
+    assert_tables_equal(cpu, got2, ignore_order=True)
+    filters2 = []
+    captured2[-1].plan.foreach(
+        lambda x: filters2.append(x) if isinstance(x, TpuFilterExec)
+        else None)
+    assert filters2
