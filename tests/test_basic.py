@@ -224,3 +224,34 @@ def test_filter_fuses_into_aggregate():
         lambda x: filters2.append(x) if isinstance(x, TpuFilterExec)
         else None)
     assert filters2
+
+
+def test_fused_filter_ladder_both_branches(monkeypatch):
+    """Cover BOTH lax.cond ladder branches of the fused-filter
+    permutation compact at suite scale by lowering the engagement
+    threshold (normally only the 4M-row bench reaches it)."""
+    import numpy as np
+    from spark_rapids_tpu.exec import tpu_aggregate as agg
+    from tests.parity import assert_tables_equal, with_cpu_session
+    from spark_rapids_tpu import TpuSparkSession, col, functions as F
+
+    monkeypatch.setattr(agg, "_LADDER_MIN_RUNG", 8)
+    rng = np.random.default_rng(33)
+    n = 512  # cap 512, rung 128
+    t = pa.table({
+        "k": pa.array(rng.integers(0, 7, n), type=pa.int64()),
+        "v": pa.array(rng.integers(-9, 9, n), type=pa.int64()),
+    })
+
+    def q(s, thresh):
+        df = s.create_dataframe(t)
+        return df.filter(col("v") > thresh).group_by("k").agg(
+            F.count("*").alias("c"), F.sum("v").alias("sv"),
+            F.max("v").alias("mx"))
+
+    for thresh in (7, -10):   # selective -> small branch; all -> big
+        cpu = with_cpu_session(lambda s: q(s, thresh).collect())
+        got = TpuSparkSession(
+            {"spark.rapids.tpu.sql.variableFloatAgg.enabled": True})
+        out = q(got, thresh).collect()
+        assert_tables_equal(cpu, out, ignore_order=True)
