@@ -59,6 +59,60 @@ def _gen_window_data(n: int, seed: int = 9):
     })
 
 
+
+
+def _dispatch_train_time(jit_fn, arg, checksum, iters=6):
+    """Per-query seconds via dispatch-train differencing.
+
+    The fori-loop harness (bench.py) embeds the pipeline body K times in
+    ONE program; for the join/window pipelines that body contains
+    multiple full-capacity sorts, and compiling the looped variants
+    through the remote-AOT tunnel adds two more multi-minute compiles on
+    top of the parity compile.  Instead this reuses the ALREADY-compiled
+    pipeline executable: after the first device->host read the runtime
+    is synchronous (~72 ms fixed per dispatch, measured — PERF.md), so
+    per-query time = (wall of N dispatches - wall of 1) / (N-1), with
+    the residual fixed dispatch overhead calibrated out by timing a
+    trivial kernel the same way.  Separate dispatches of the same
+    executable cannot be elided or batched by XLA (each is an
+    independent execution), so unlike the in-program loop no data
+    dependence is needed.
+    """
+    import jax
+    import jax.numpy as jnp
+
+    def run_n(n):
+        t0 = time.perf_counter()
+        out = None
+        for _ in range(n):
+            out = jit_fn(arg)
+        int(np.asarray(checksum(out)))
+        return time.perf_counter() - t0
+
+    run_n(1)                      # ensure executable + sync mode
+    t1 = min(run_n(1) for _ in range(2))
+    tn = min(run_n(iters) for _ in range(2))
+    per = (tn - t1) / (iters - 1)
+
+    triv = jax.jit(lambda x: x + 1)
+    z = jnp.zeros((8,), jnp.int32)
+    triv(z)
+
+    def run_triv(n):
+        t0 = time.perf_counter()
+        out = None
+        for _ in range(n):
+            out = triv(z)
+        int(np.asarray(out[0]))
+        return time.perf_counter() - t0
+
+    run_triv(1)
+    o1 = min(run_triv(1) for _ in range(2))
+    on = min(run_triv(iters) for _ in range(2))
+    overhead = max((on - o1) / (iters - 1), 0.0)
+    return max(per - overhead, 1e-9)
+
+
 # ---------------------------------------------------------------------------
 # join-heavy config
 # ---------------------------------------------------------------------------
@@ -197,40 +251,13 @@ def bench_join(n_fact: int, label: str):
                   cpu_s.column("cnt").equals(tpu_s.column("cnt")) and
                   cpu_s.column("sq").equals(tpu_s.column("sq")))
 
-    def loop(f_in, k):
-        def body(_, carry):
-            chk, d0 = carry
-            cols = list(f_in.columns)
-            from spark_rapids_tpu.columnar.batch import (DeviceBatch,
-                                                         DeviceColumn)
-            c0 = cols[0]
-            data = jnp.where(chk == jnp.int32(-123456789),
-                             c0.data + 1, c0.data)
-            cols[0] = DeviceColumn(c0.dtype, data, c0.validity,
-                                   c0.lengths, c0.elem_validity)
-            fb2 = DeviceBatch(f_in.names, cols, f_in.num_rows)
-            out = pipeline(fb2)
-            chk2 = (jnp.sum(out.columns[1].data,
-                            where=out.columns[1].validity)
-                    ).astype(jnp.int32)
-            return chk ^ chk2, d0
-        chk, _ = jax.lax.fori_loop(0, k, body,
-                                   (jnp.int32(0), jnp.int32(0)))
-        return chk
+    jp = jax.jit(pipeline)
 
-    f1 = jax.jit(lambda b: loop(b, 1))
-    fN = jax.jit(lambda b: loop(b, ITERS_LOOP))
+    def checksum(out):
+        return jnp.sum(out.columns[1].data,
+                       where=out.columns[1].validity).astype(jnp.int32)
 
-    def timed_read(f):
-        t0 = time.perf_counter()
-        int(np.asarray(f(fb)))
-        return time.perf_counter() - t0
-
-    timed_read(f1)
-    timed_read(fN)
-    t1 = min(timed_read(f1) for _ in range(2))
-    tN = min(timed_read(fN) for _ in range(2))
-    per = max((tN - t1) / (ITERS_LOOP - 1), 1e-9)
+    per = _dispatch_train_time(jp, fb, checksum, ITERS_LOOP)
 
     if not rows_match:
         print(json.dumps({"metric": label, "rows_match": False,
@@ -354,39 +381,12 @@ def bench_window(n: int, label: str):
                       tpu_cmp.column("run").to_numpy(
                           zero_copy_only=False), rtol=1e-9))
 
-    def loop(b_in, k):
-        from spark_rapids_tpu.columnar.batch import (DeviceBatch,
-                                                     DeviceColumn)
+    jp = jax.jit(pipeline)
 
-        def body(_, carry):
-            chk, d0 = carry
-            cols = list(b_in.columns)
-            c0 = cols[0]
-            data = jnp.where(chk == jnp.int32(-123456789),
-                             c0.data + 1, c0.data)
-            cols[0] = DeviceColumn(c0.dtype, data, c0.validity,
-                                   c0.lengths, c0.elem_validity)
-            b2 = DeviceBatch(b_in.names, cols, b_in.num_rows)
-            out = pipeline(b2)
-            chk2 = jnp.sum(out.columns[3].data).astype(jnp.int32)
-            return chk ^ chk2, d0
-        chk, _ = jax.lax.fori_loop(0, k, body,
-                                   (jnp.int32(0), jnp.int32(0)))
-        return chk
+    def checksum(out):
+        return jnp.sum(out.columns[3].data).astype(jnp.int32)
 
-    f1 = jax.jit(lambda x: loop(x, 1))
-    fN = jax.jit(lambda x: loop(x, ITERS_LOOP))
-
-    def timed_read(f):
-        t0 = time.perf_counter()
-        int(np.asarray(f(batch)))
-        return time.perf_counter() - t0
-
-    timed_read(f1)
-    timed_read(fN)
-    t1 = min(timed_read(f1) for _ in range(2))
-    tN = min(timed_read(fN) for _ in range(2))
-    per = max((tN - t1) / (ITERS_LOOP - 1), 1e-9)
+    per = _dispatch_train_time(jp, batch, checksum, ITERS_LOOP)
 
     if not rows_match:
         print(json.dumps({"metric": label, "rows_match": False,

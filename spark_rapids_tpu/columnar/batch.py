@@ -86,6 +86,18 @@ class DeviceColumn:
     validity: jnp.ndarray          # bool [capacity]
     lengths: Optional[jnp.ndarray] = None  # int32 [capacity], string/list
     elem_validity: Optional[jnp.ndarray] = None  # bool [cap, max_len], list
+    # static value-range hint for integer-backed columns: every VALID
+    # value v satisfies -2^(vbits-1) <= v < 2^(vbits-1).  Set by scans
+    # from host-known facts (dictionary pages, parquet chunk statistics),
+    # bucketed to {8,16,...,56} so jit cache keys stay stable across
+    # files; None = unknown.  Lets the aggregate/sort layers encode
+    # narrow radix keys or direct-bin group ids (the analog of cudf's
+    # hash-vs-sort groupby choice, which this engine makes per compile).
+    vbits: Optional[int] = None
+    # static no-nulls hint: validity is True at every live row (i < the
+    # batch row count).  Set by scans when every page's def levels were
+    # all-valid; lets reductions skip validity gathers entirely.
+    nonnull: bool = False
 
     # -- pytree protocol so columns/batches can cross jit boundaries --------
     def tree_flatten(self):
@@ -95,16 +107,19 @@ class DeviceColumn:
         if self.elem_validity is not None:
             leaves.append(self.elem_validity)
         return tuple(leaves), (self.dtype, self.lengths is not None,
-                               self.elem_validity is not None)
+                               self.elem_validity is not None, self.vbits,
+                               self.nonnull)
 
     @classmethod
     def tree_unflatten(cls, aux, children):
-        dtype, has_len, has_ev = aux
+        dtype, has_len, has_ev = aux[0], aux[1], aux[2]
+        vbits = aux[3] if len(aux) > 3 else None
+        nonnull = aux[4] if len(aux) > 4 else False
         it = iter(children)
         data, validity = next(it), next(it)
         lengths = next(it) if has_len else None
         ev = next(it) if has_ev else None
-        return cls(dtype, data, validity, lengths, ev)
+        return cls(dtype, data, validity, lengths, ev, vbits, nonnull)
 
     @property
     def capacity(self) -> int:
@@ -124,8 +139,19 @@ class DeviceColumn:
         return int(n)
 
     def gather(self, indices: jnp.ndarray, valid: jnp.ndarray) -> "DeviceColumn":
-        """Row gather; `valid` masks rows whose source index is meaningful."""
-        data = jnp.take(self.data, indices, axis=0)
+        """Row gather; `valid` masks rows whose source index is meaningful.
+
+        vbits<=32 integer-backed 8-byte columns gather through an i32
+        view and widen after — an emulated-i64 gather costs 3x an i32
+        one on TPU (PERF.md) and the hint guarantees losslessness."""
+        if (self.vbits is not None and self.vbits <= 32 and
+                self.data.ndim == 1 and
+                self.data.dtype.itemsize == 8 and
+                jnp.issubdtype(self.data.dtype, jnp.integer)):
+            data = jnp.take(self.data.astype(jnp.int32), indices
+                            ).astype(self.data.dtype)
+        else:
+            data = jnp.take(self.data, indices, axis=0)
         validity = jnp.take(self.validity, indices, axis=0) & valid
         lengths = None
         ev = None
@@ -141,7 +167,8 @@ class DeviceColumn:
         if self.elem_validity is not None:
             ev = jnp.take(self.elem_validity, indices, axis=0) & \
                 valid[:, None]
-        return DeviceColumn(self.dtype, data, validity, lengths, ev)
+        return DeviceColumn(self.dtype, data, validity, lengths, ev,
+                            self.vbits)
 
 
 def _bcast(mask: jnp.ndarray, like: jnp.ndarray) -> jnp.ndarray:
@@ -332,13 +359,48 @@ def from_arrow(table: pa.Table, min_bucket: int = 16,
             dtype = dt.BOOL  # void columns materialize as all-null bool
         data, validity, lengths, ev = _np_column_from_arrow(col, dtype, cap)
         names.append(field_.name)
+        vb, nn = _upload_hints(dtype, data, validity, n)
         cols.append(DeviceColumn(
             dtype,
             jnp.asarray(data),
             jnp.asarray(validity),
             jnp.asarray(lengths) if lengths is not None else None,
-            jnp.asarray(ev) if ev is not None else None))
+            jnp.asarray(ev) if ev is not None else None,
+            vbits=vb, nonnull=nn))
     return DeviceBatch(names, cols, n)
+
+
+_VBIT_BUCKETS = (8, 16, 24, 32, 40, 48, 56)
+
+
+def bits_for_range(lo: int, hi: int):
+    """Smallest vbits bucket whose signed range covers [lo, hi]
+    (None when none does); the shared bucket table keeps jit cache
+    keys stable across files/uploads with nearby ranges."""
+    for b in _VBIT_BUCKETS:
+        if -(1 << (b - 1)) <= lo and hi < (1 << (b - 1)):
+            return b
+    return None
+
+
+def _upload_hints(dtype: dt.DType, data: np.ndarray,
+                  validity: np.ndarray, n: int):
+    """Static hints for an uploaded column: one O(n) host pass over the
+    numpy buffers bounds the valid values (see DeviceColumn.vbits) —
+    negligible next to the upload itself, and it unlocks the narrow
+    sort/aggregate/gather fast paths for in-memory DataFrames the same
+    way parquet statistics do for scans."""
+    if n == 0:
+        return None, True
+    live_valid = validity[:n]
+    nn = bool(live_valid.all())
+    if (dtype.is_string or dtype.is_bool or dtype.is_list or
+            not np.issubdtype(np.asarray(data).dtype, np.integer)):
+        return None, nn
+    vals = data[:n][live_valid] if not nn else data[:n]
+    if vals.size == 0:
+        return _VBIT_BUCKETS[0], nn
+    return bits_for_range(int(vals.min()), int(vals.max())), nn
 
 
 def _pack_wire_key(d: jnp.ndarray) -> str:
@@ -596,6 +658,14 @@ def to_arrow(batch: DeviceBatch,
     return pa.Table.from_arrays(arrays, schema=pa.schema(fields))
 
 
+def _combined_hints(cols: Sequence[DeviceColumn]):
+    """Hint union for concatenated columns: the widest vbits if every
+    input carries one, nonnull only if every input is."""
+    vbs = [c.vbits for c in cols]
+    vb = max(vbs) if all(v is not None for v in vbs) else None
+    return vb, all(c.nonnull for c in cols)
+
+
 def concat_batches(batches: Sequence[DeviceBatch],
                    min_bucket: int = 16) -> DeviceBatch:
     """Device-side concatenation (analog of Table.concatenate used by
@@ -660,13 +730,15 @@ def concat_batches(batches: Sequence[DeviceBatch],
             out_cols.append(DeviceColumn(dtype, data, validity, lengths,
                                          ev))
         else:
+            vb, nn = _combined_hints([b.columns[ci] for b in batches])
             data = jnp.concatenate([b.columns[ci].data[:int(b.num_rows)]
                                     for b in batches])
             data = jnp.pad(data, (0, cap - total))
             validity = jnp.pad(
                 jnp.concatenate([b.columns[ci].validity[:int(b.num_rows)]
                                  for b in batches]), (0, cap - total))
-            out_cols.append(DeviceColumn(dtype, data, validity, None))
+            out_cols.append(DeviceColumn(dtype, data, validity, None,
+                                         vbits=vb, nonnull=nn))
     return DeviceBatch(names, out_cols, total)
 
 
@@ -762,7 +834,13 @@ def _concat_nosync_impl(batches, cap: int) -> DeviceBatch:
                 None)
         # gather() zeroes data/lengths/ev where the mask is False, so
         # the padding-rows-are-zeroed batch contract holds as-is
-        out_cols.append(col.gather(order, sorted_exists))
+        gcol = col.gather(order, sorted_exists)
+        if not dtype.has_lengths:
+            # the compaction maps live outputs to live inputs, so the
+            # inputs' hints survive (gather() alone can't know that)
+            vb, nn = _combined_hints([b.columns[ci] for b in batches])
+            gcol = replace(gcol, vbits=vb, nonnull=nn)
+        out_cols.append(gcol)
     total = sum(jnp.asarray(b.num_rows, dtype=jnp.int32)
                 for b in batches)
     return DeviceBatch(names, out_cols, total)

@@ -136,6 +136,15 @@ CAST_STRING_TO_FLOAT = conf(
     "ulp for full-precision decimal strings (reference flags GPU "
     "castStringToFloat incompatible for the same reason).", bool)
 
+CAST_FLOAT_TO_STRING = conf(
+    "spark.rapids.tpu.sql.castFloatToString.enabled", True,
+    "Enable float-to-string casts on TPU. The device Ryu kernel "
+    "(expr/ryu.py) produces the engine's exact shortest-round-trip "
+    "repr formatting, bit-identical to the CPU path; disable only to "
+    "force the CPU fallback (reference gates GPU castFloatToString "
+    "behind the same kind of flag because Java formatting differs).",
+    bool)
+
 ALLOW_INCOMPAT_UTC_ONLY = conf(
     "spark.rapids.tpu.sql.castStringToTimestamp.enabled", False,
     "Enable string-to-timestamp casts (UTC only).", bool)

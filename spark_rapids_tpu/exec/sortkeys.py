@@ -21,7 +21,7 @@ hash-aggregate and the sort-merge join.
 
 from __future__ import annotations
 
-from typing import List, Tuple
+from typing import List, Optional, Tuple
 
 import numpy as np
 
@@ -95,6 +95,21 @@ def encode_keys(v: ColVal, ascending: bool = True,
     return keys
 
 
+def narrow_int_bits(v: ColVal) -> Optional[int]:
+    """Effective bit width encode_fields uses for an integer-backed
+    column (dtype width capped by the vbits range hint), or None for
+    non-integer / full-width columns.  Callers use it to decide narrow
+    fast paths (single-digit sorts, i32 segment sums, key inversion)."""
+    d = v.dtype
+    if d.is_string or d.is_floating or d.is_bool:
+        return None
+    npd = np.dtype(d.to_np())
+    if not np.issubdtype(npd, np.integer):
+        return None
+    vb = min(getattr(v, "vbits", None) or 64, npd.itemsize * 8)
+    return vb if vb < 64 else None
+
+
 def encode_fields(v: ColVal, ascending: bool = True,
                   nulls_first: bool = True, nullable: bool = True
                   ) -> List[Tuple[int, jnp.ndarray]]:
@@ -151,11 +166,21 @@ def encode_fields(v: ColVal, ascending: bool = True,
         vals.append((1, v.data.astype(jnp.uint64)))
     else:
         npd = np.dtype(d.to_np())
-        if npd.itemsize <= 4:
-            key = (v.data.astype(jnp.int64) +
-                   jnp.int64(1 << 31)).astype(jnp.uint64) & \
-                jnp.uint64(0xFFFFFFFF)
-            vals.append((32, key))
+        vb = v.vbits if getattr(v, "vbits", None) else None
+        # the dtype's own width is a free static bound (int16 fits 16)
+        vb = min(vb or 64, npd.itemsize * 8)
+        if vb < 64:
+            # static range hint (DeviceColumn.vbits): all valid values
+            # fit signed vb bits, so the biased value (v + 2^(vb-1))
+            # is an order-preserving unsigned vb-bit key — fewer radix
+            # digits than the full-width encoding
+            biased = (v.data.astype(jnp.int64) +
+                      jnp.int64(1 << (vb - 1))).astype(jnp.uint64)
+            if vb <= 32:
+                vals.append((vb, biased))
+            else:
+                vals.append((vb - 32, biased >> jnp.uint64(32)))
+                vals.append((32, biased & jnp.uint64(0xFFFFFFFF)))
         else:
             vals.extend(split64(_int_key(v.data)))
 
@@ -286,6 +311,58 @@ def stack_sort_words(key_groups: List[List[jnp.ndarray]],
         flat.extend(group)
     pad_key = (~row_mask).astype(jnp.uint64)
     return jnp.stack([pad_key] + flat)
+
+
+def stack_sort_digits(field_groups: List[List[Tuple[int, jnp.ndarray]]],
+                      row_mask: jnp.ndarray) -> jnp.ndarray:
+    """Bit-width-aware u32 digit matrix for a full sort spec: the
+    padding flag leads (so padding rows always sort last), then each
+    column's encode_fields output in priority order.  Narrow fields
+    (vbits hints, dtype widths, 1-bit null flags) pack densely, so the
+    digit count — and with it the number of radix passes and digit
+    gathers — is typically 2-3x smaller than the u64-word encoding."""
+    fields: List[Tuple[int, jnp.ndarray]] = [
+        (1, (~row_mask).astype(jnp.uint64))]
+    for g in field_groups:
+        fields.extend(g)
+    return fields_to_digits(fields)
+
+
+def _digit_sort_impl(digits: jnp.ndarray) -> jnp.ndarray:
+    if digits.shape[0] == 1:
+        # everything fits one u32: a single direct stable pair sort
+        _, perm = jax.lax.sort(
+            (digits[0], jnp.arange(digits.shape[1], dtype=jnp.int32)),
+            num_keys=1, is_stable=True)
+        return perm
+    return radix_order_digits(digits)
+
+
+def shared_digit_sort(digits: jnp.ndarray) -> jnp.ndarray:
+    """Stable order for a [d, cap] u32 digit matrix (LSB digit first)
+    via the shared per-(d, cap) kernel."""
+    from spark_rapids_tpu.exec import kernel_cache as kc
+    d, cap = int(digits.shape[0]), int(digits.shape[1])
+    fn = kc.get_kernel(("shared_digit_sort", d, cap),
+                       lambda: _digit_sort_impl)
+    return fn(digits)
+
+
+def digit_boundaries(digits: jnp.ndarray, order: jnp.ndarray,
+                     row_mask: jnp.ndarray) -> jnp.ndarray:
+    """After sorting with ``order``, mark rows whose key differs from
+    the previous row's (group starts) — the digits analog of
+    group_boundaries.  Padding rows always start their own group."""
+    n = order.shape[0]
+    sorted_mask = jnp.take(row_mask, order)
+    new_group = jnp.zeros((n,), dtype=jnp.bool_).at[0].set(True)
+    for di in range(digits.shape[0]):
+        ds = jnp.take(digits[di], order)
+        new_group = new_group | jnp.concatenate(
+            [jnp.ones((1,), jnp.bool_), ds[1:] != ds[:-1]])
+    prev_mask = jnp.concatenate(
+        [jnp.ones((1,), jnp.bool_), sorted_mask[:-1]])
+    return new_group | (sorted_mask != prev_mask)
 
 
 def shared_lexsort(wm: jnp.ndarray) -> jnp.ndarray:

@@ -69,31 +69,66 @@ class _SortedCtx:
     cap: int
     row_mask: jnp.ndarray     # original-space "row exists"
     n_groups: jnp.ndarray     # scalar
+    # narrow fast path: the fully-packed sorted u32 key, and (when the
+    # single key is invertibly encoded) its (vbits, nullable, dtype)
+    # layout — lets gather_group_keys reconstruct representative keys
+    # arithmetically instead of through original-row gathers
+    sorted_key: Optional[jnp.ndarray] = None
+    key_inverse: Optional[Tuple] = None
 
     # -- scatter-free segment reductions -------------------------------
+    #
+    # Cost discipline (all numbers measured on the bench chip, see
+    # PERF.md): gathers dominate — ~7.6 ms per 1M u32/i32/f64 lookups
+    # and 3x that for x64-emulated i64 — so every reduction pre-masks
+    # in ORIGINAL row space (dense elementwise, ~1 ms per 4M) and pays
+    # exactly ONE value gather into sorted space; i64 end-position
+    # gathers are narrowed to i32 whenever a vbits hint bounds the sum.
     def take_sorted(self, x: jnp.ndarray) -> jnp.ndarray:
         return jnp.take(x, self.order, axis=0)
 
-    def seg_sum(self, x: jnp.ndarray, mask: jnp.ndarray) -> jnp.ndarray:
-        """Per-group sum of x over rows where mask (original space).
+    def seg_sum(self, x: jnp.ndarray, mask: jnp.ndarray,
+                out_np=None, narrow_bits: Optional[int] = None
+                ) -> jnp.ndarray:
+        """Per-group sum over rows where mask (both original space).
 
-        Integers use global cumsum + end-position differences (exact
-        under two's-complement wraparound).  Floats use the segmented
-        scan instead: a global float cumsum would leak +/-inf and
-        rounding error across group boundaries through the differences.
-        """
-        xs = jnp.where(self.take_sorted(mask), self.take_sorted(x),
-                       jnp.zeros((), dtype=x.dtype))
-        if jnp.issubdtype(xs.dtype, jnp.floating):
-            return self.seg_scan_reduce(xs, jnp.add, 0)
-        c = scans.cumsum(xs)
+        ``x`` stays in its input dtype through the gather (narrow
+        gathers are 3x cheaper than emulated-i64 ones) and widens to
+        ``out_np`` after.  Integers use global cumsum + end-position
+        differences (exact under two's-complement wraparound); a
+        ``narrow_bits`` hint with narrow_bits+log2(cap) <= 31 keeps the
+        whole chain in native i32.  Floats use the segmented scan: a
+        global float cumsum would leak +/-inf and rounding error across
+        group boundaries through the differences."""
+        out_np = out_np or x.dtype
+        if jnp.issubdtype(jnp.dtype(out_np), jnp.floating):
+            # cast before the gather: f64 gathers are native-cheap while
+            # i64 ones pay the pair emulation (and per-element casts
+            # commute with the gather)
+            xm = jnp.where(mask, x.astype(out_np),
+                           jnp.zeros((), out_np))
+            return self.seg_scan_reduce(self.take_sorted(xm),
+                                        jnp.add, 0)
+        narrow = (narrow_bits is not None and
+                  narrow_bits + max(self.cap - 1, 1).bit_length() <= 31)
+        if narrow:
+            xm = jnp.where(mask, x, jnp.zeros((), x.dtype)
+                           ).astype(jnp.int32)
+            c = jnp.cumsum(self.take_sorted(xm))
+        else:
+            xm = jnp.where(mask, x, jnp.zeros((), x.dtype))
+            c = scans.cumsum(self.take_sorted(xm).astype(out_np))
         ce = jnp.take(c, self.end_pos)
-        return ce - jnp.concatenate([ce[:1] * 0, ce[:-1]])
+        return (ce - jnp.concatenate([ce[:1] * 0, ce[:-1]])
+                ).astype(out_np)
 
     def seg_count(self, mask: jnp.ndarray) -> jnp.ndarray:
         # counts fit int32 (cap < 2^31): the native 32-bit cumsum skips
         # the blocked 64-bit scan entirely; widen at the end
-        xs = self.take_sorted(mask).astype(jnp.int32)
+        if mask is self.row_mask:   # COUNT(*): already have it sorted
+            xs = self.sorted_mask.astype(jnp.int32)
+        else:
+            xs = self.take_sorted(mask).astype(jnp.int32)
         c = jnp.cumsum(xs)
         ce = jnp.take(c, self.end_pos)
         return (ce - jnp.concatenate([ce[:1] * 0, ce[:-1]])
@@ -109,15 +144,15 @@ class _SortedCtx:
 
     def seg_min_of(self, x: jnp.ndarray, mask: jnp.ndarray,
                    fill) -> jnp.ndarray:
-        xs = jnp.where(self.take_sorted(mask), self.take_sorted(x),
-                       jnp.asarray(fill, dtype=x.dtype))
-        return self.seg_scan_reduce(xs, jnp.minimum, fill)
+        xm = jnp.where(mask, x, jnp.asarray(fill, dtype=x.dtype))
+        return self.seg_scan_reduce(self.take_sorted(xm),
+                                    jnp.minimum, fill)
 
     def seg_max_of(self, x: jnp.ndarray, mask: jnp.ndarray,
                    fill) -> jnp.ndarray:
-        xs = jnp.where(self.take_sorted(mask), self.take_sorted(x),
-                       jnp.asarray(fill, dtype=x.dtype))
-        return self.seg_scan_reduce(xs, jnp.maximum, fill)
+        xm = jnp.where(mask, x, jnp.asarray(fill, dtype=x.dtype))
+        return self.seg_scan_reduce(self.take_sorted(xm),
+                                    jnp.maximum, fill)
 
 
 class _AggSpec:
@@ -148,7 +183,7 @@ class _CountSpec(_AggSpec):
         return [dt.INT64]
 
     def update(self, v, ctx):
-        if v is None:  # COUNT(*)
+        if v is None or v.nonnull:  # COUNT(*) / provably null-free
             mask = ctx.row_mask
         else:
             mask = v.validity & ctx.row_mask
@@ -170,21 +205,24 @@ class _SumSpec(_AggSpec):
     def buffer_dtypes(self):
         return [self.agg.dtype, dt.INT64]
 
-    def _sum(self, data, validity, ctx):
+    def _sum(self, data, validity, ctx, narrow_bits=None):
         tgt = self.agg.dtype.to_np()
-        mask = validity & ctx.row_mask
-        s = ctx.seg_sum(data.astype(tgt), mask)
+        mask = validity if validity is ctx.row_mask \
+            else validity & ctx.row_mask
+        s = ctx.seg_sum(data, mask, out_np=tgt, narrow_bits=narrow_bits)
         c = ctx.seg_count(mask)
         return [(s, c > 0), (c, jnp.ones((ctx.cap,), dtype=jnp.bool_))]
 
     def update(self, v, ctx):
-        return self._sum(v.data, v.validity, ctx)
+        return self._sum(v.data,
+                         ctx.row_mask if v.nonnull else v.validity,
+                         ctx, narrow_bits=sortkeys.narrow_int_bits(v))
 
     def merge(self, bufs, ctx):
         tgt = self.agg.dtype.to_np()
-        s = ctx.seg_sum(bufs[0].data.astype(tgt),
-                        bufs[0].validity & ctx.row_mask)
-        c = ctx.seg_sum(bufs[1].data, ctx.row_mask)
+        s = ctx.seg_sum(bufs[0].data, bufs[0].validity & ctx.row_mask,
+                        out_np=tgt)
+        c = ctx.seg_sum(bufs[1].data, ctx.row_mask, out_np=np.int64)
         return [(s, c > 0), (c, jnp.ones((ctx.cap,), dtype=jnp.bool_))]
 
     def finalize(self, bufs):
@@ -227,7 +265,8 @@ class _MinMaxSpec(_AggSpec):
     def _reduce(self, data, validity, lengths, ctx):
         d = self.agg.dtype
         tgt = d.to_np()
-        considered = validity & ctx.row_mask
+        considered = validity if validity is ctx.row_mask \
+            else validity & ctx.row_mask
         if d.is_string:
             return self._reduce_string(data, validity, lengths, ctx)
         if d.is_floating:
@@ -261,7 +300,9 @@ class _MinMaxSpec(_AggSpec):
         return [(jnp.where(has, red, 0), has)]
 
     def update(self, v, ctx):
-        return self._reduce(v.data, v.validity, v.lengths, ctx)
+        return self._reduce(v.data,
+                            ctx.row_mask if v.nonnull else v.validity,
+                            v.lengths, ctx)
 
     def merge(self, bufs, ctx):
         return self._reduce(bufs[0].data, bufs[0].validity,
@@ -279,15 +320,16 @@ class _AverageSpec(_AggSpec):
         return [dt.FLOAT64, dt.INT64]
 
     def update(self, v, ctx):
-        considered = v.validity & ctx.row_mask
-        s = ctx.seg_sum(v.data.astype(jnp.float64), considered)
+        considered = ctx.row_mask if v.nonnull \
+            else v.validity & ctx.row_mask
+        s = ctx.seg_sum(v.data, considered, out_np=np.float64)
         c = ctx.seg_count(considered)
         ones = jnp.ones((ctx.cap,), dtype=jnp.bool_)
         return [(s, ones), (c, ones)]
 
     def merge(self, bufs, ctx):
-        s = ctx.seg_sum(bufs[0].data, ctx.row_mask)
-        c = ctx.seg_sum(bufs[1].data, ctx.row_mask)
+        s = ctx.seg_sum(bufs[0].data, ctx.row_mask, out_np=np.float64)
+        c = ctx.seg_sum(bufs[1].data, ctx.row_mask, out_np=np.int64)
         ones = jnp.ones((ctx.cap,), dtype=jnp.bool_)
         return [(s, ones), (c, ones)]
 
@@ -390,17 +432,13 @@ def normalize_key(v: ColVal) -> ColVal:
 
 
 def sorted_group_ctx(key_vals: List[ColVal],
-                     batch: DeviceBatch,
-                     nullables: Optional[List[bool]] = None
-                     ) -> _SortedCtx:
+                     batch: DeviceBatch) -> _SortedCtx:
     """Batch-shaped wrapper over _group_ctx (rows are prefix-dense:
     row i exists iff i < num_rows)."""
-    return _group_ctx(key_vals, batch.capacity, batch.num_rows,
-                      nullables)
+    return _group_ctx(key_vals, batch.capacity, batch.num_rows)
 
 
-def _group_ctx(key_vals: List[ColVal], cap: int, n_rows,
-               nullables: Optional[List[bool]] = None) -> _SortedCtx:
+def _group_ctx(key_vals: List[ColVal], cap: int, n_rows) -> _SortedCtx:
     """Group rows by key: stable LSD radix sort over bit-packed key
     digits brings equal keys adjacent, boundaries mark group starts, and
     every downstream reduction is scan+gather (see _SortedCtx).
@@ -424,20 +462,43 @@ def _group_ctx(key_vals: List[ColVal], cap: int, n_rows,
             n_groups=jnp.int32(1))
 
     fields = [(1, (~row_mask).astype(jnp.uint64))]  # padding sorts last
+    total_bits = 1
+    eff_nullables = []
     for ki, v in enumerate(key_vals):
-        nullable = nullables[ki] if nullables is not None else True
-        fields.extend(sortkeys.encode_fields(v, True, True,
-                                             nullable=nullable))
+        # drop the null flag only on the propagated no-null hint —
+        # schema nullability is metadata and can be stale (a falsely
+        # non-nullable key would group null rows with the zero value)
+        nullable = not v.nonnull
+        eff_nullables.append(nullable)
+        kf = sortkeys.encode_fields(v, True, True, nullable=nullable)
+        fields.extend(kf)
+        total_bits += sum(w for w, _ in kf)
     digits = sortkeys.fields_to_digits(fields)
-    order = sortkeys.radix_order_digits(digits)
 
-    sorted_mask = jnp.take(row_mask, order)
-    new = i32 == 0
-    for di in range(digits.shape[0]):
-        ds = jnp.take(digits[di], order)
-        new = new | jnp.concatenate(
-            [jnp.ones((1,), jnp.bool_), ds[1:] != ds[:-1]])
-    new = new & sorted_mask
+    if digits.shape[0] == 1:
+        # narrow-key fast path (vbits hints pack every key + null flags
+        # + the padding bit into one u32): ONE direct stable pair sort,
+        # and because the padding flag is the MSB of the key itself,
+        # sorted_mask and group boundaries come from the sorted keys —
+        # zero digit gathers (measured: each 1M-row digit gather costs
+        # as much as 5 pair sorts)
+        ks, order = jax.lax.sort(
+            (digits[0], i32), num_keys=1, is_stable=True)
+        sorted_mask = (ks >> jnp.uint32(total_bits - 1)) == 0
+        new = jnp.concatenate(
+            [jnp.ones((1,), jnp.bool_), ks[1:] != ks[:-1]])
+        new = new & sorted_mask
+        sorted_key_u32 = ks
+    else:
+        order = sortkeys.radix_order_digits(digits)
+        sorted_mask = jnp.take(row_mask, order)
+        new = i32 == 0
+        for di in range(digits.shape[0]):
+            ds = jnp.take(digits[di], order)
+            new = new | jnp.concatenate(
+                [jnp.ones((1,), jnp.bool_), ds[1:] != ds[:-1]])
+        new = new & sorted_mask
+        sorted_key_u32 = None
     gid_sorted = jnp.cumsum(new.astype(jnp.int32)) - 1
     gid_sorted = jnp.maximum(gid_sorted, 0)
     n_groups = jnp.sum(new.astype(jnp.int32))
@@ -451,10 +512,17 @@ def _group_ctx(key_vals: List[ColVal], cap: int, n_rows,
         jnp.where(new, gid_sorted, cap)].set(i32, mode="drop")
     end_pos = jnp.zeros((cap,), jnp.int32).at[
         jnp.where(is_end, gid_sorted, cap)].set(i32, mode="drop")
+    key_inverse = None
+    if sorted_key_u32 is not None and len(key_vals) == 1:
+        v0 = key_vals[0]
+        vb = sortkeys.narrow_int_bits(v0)
+        if vb is not None:
+            key_inverse = (vb, eff_nullables[0], v0.dtype, v0.vbits)
     return _SortedCtx(order=order, new=new, gid_sorted=gid_sorted,
                       start_pos=start_pos, end_pos=end_pos,
                       sorted_mask=sorted_mask, cap=cap,
-                      row_mask=row_mask, n_groups=n_groups)
+                      row_mask=row_mask, n_groups=n_groups,
+                      sorted_key=sorted_key_u32, key_inverse=key_inverse)
 
 
 def gather_group_keys(key_vals: List[ColVal],
@@ -462,8 +530,23 @@ def gather_group_keys(key_vals: List[ColVal],
     """Representative key row per group (first sorted row)."""
     if not key_vals:
         return []
-    orig = jnp.take(ctx.order, ctx.start_pos)
     group_exists = jnp.arange(ctx.cap) < ctx.n_groups
+    if ctx.key_inverse is not None:
+        # single narrow int key: unbias the packed sorted key at group
+        # starts — one u32 gather replaces the order gather + per-key
+        # data/validity gathers (the data gather is 3x a u32 gather for
+        # int64 keys under x64 pair emulation)
+        vb, nullable, kdt, kvbits = ctx.key_inverse
+        kg = jnp.take(ctx.sorted_key, ctx.start_pos)
+        value = (kg & jnp.uint32((1 << vb) - 1)).astype(jnp.int64) - \
+            jnp.int64(1 << (vb - 1))
+        valid = group_exists
+        if nullable:
+            valid = valid & (((kg >> jnp.uint32(vb)) & 1) == 1)
+        data = jnp.where(valid, value, 0).astype(kdt.to_np())
+        return [DeviceColumn(kdt, data, valid, vbits=kvbits,
+                             nonnull=not nullable)]
+    orig = jnp.take(ctx.order, ctx.start_pos)
     return [v.to_column().gather(orig, group_exists) for v in key_vals]
 
 
@@ -486,7 +569,8 @@ def _slice_batch(batch: DeviceBatch, n2: int) -> DeviceBatch:
     cols = [DeviceColumn(
         c.dtype, c.data[:n2], c.validity[:n2],
         None if c.lengths is None else c.lengths[:n2],
-        None if c.elem_validity is None else c.elem_validity[:n2])
+        None if c.elem_validity is None else c.elem_validity[:n2],
+        c.vbits, c.nonnull)
         for c in batch.columns]
     return DeviceBatch(batch.names, cols, batch.num_rows)
 
@@ -498,7 +582,8 @@ def _pad_batch(batch: DeviceBatch, cap: int) -> DeviceBatch:
         return jnp.concatenate(
             [a, jnp.zeros((cap - a.shape[0],) + a.shape[1:], a.dtype)])
     cols = [DeviceColumn(c.dtype, pad(c.data), pad(c.validity),
-                         pad(c.lengths), pad(c.elem_validity))
+                         pad(c.lengths), pad(c.elem_validity),
+                         c.vbits, c.nonnull)
             for c in batch.columns]
     return DeviceBatch(batch.names, cols, batch.num_rows)
 
@@ -535,16 +620,26 @@ def _gather_val(v: ColVal, sel: jnp.ndarray,
                 live: jnp.ndarray) -> ColVal:
     """Gather a value vector through a selected-row index map (the
     fused-filter permutation compact); rows beyond the live count zero
-    out."""
-    data = jnp.take(v.data, sel, axis=0)
+    out.  Hint-driven narrowing: i64 gathers cost 3x an i32 one under
+    the pair emulation, so vbits<=32 data gathers through an i32 view
+    and widens after; nonnull columns skip the validity gather (sel
+    maps live outputs to live source rows)."""
+    vb = sortkeys.narrow_int_bits(v)
+    if (vb is not None and vb <= 32 and v.data.ndim == 1 and
+            np.dtype(v.dtype.to_np()).itemsize == 8):
+        data = jnp.take(v.data.astype(jnp.int32), sel
+                        ).astype(v.data.dtype)
+    else:
+        data = jnp.take(v.data, sel, axis=0)
     data = jnp.where(live if data.ndim == 1 else live[:, None], data,
                      jnp.zeros((), data.dtype))
-    validity = jnp.take(v.validity, sel) & live
+    validity = live if v.nonnull else jnp.take(v.validity, sel) & live
     lengths = None if v.lengths is None else \
         jnp.where(live, jnp.take(v.lengths, sel), 0)
     ev = None if v.elem_validity is None else \
         jnp.take(v.elem_validity, sel, axis=0) & live[:, None]
-    return ColVal(v.dtype, data, validity, lengths, ev)
+    return ColVal(v.dtype, data, validity, lengths, ev, vbits=v.vbits,
+                  nonnull=v.nonnull)
 
 
 def update_aggregate(batch: DeviceBatch,
@@ -563,8 +658,7 @@ def update_aggregate(batch: DeviceBatch,
     25%-selective filter that is cap/4 for every sort pass, gather and
     scan."""
     def run(kv, av, cap2, nr):
-        ctx = _group_ctx(kv, cap2, nr,
-                         nullables=[g.nullable for g in groupings])
+        ctx = _group_ctx(kv, cap2, nr)
         cols = gather_group_keys(kv, ctx)
         names = [f"__k{i}" for i in range(len(cols))]
         bufs_per_spec = [spec.update(v, ctx)
@@ -599,9 +693,12 @@ def update_aggregate(batch: DeviceBatch,
     cv = eval_tpu.evaluate(condition, batch)
     keep = cv.data.astype(jnp.bool_) & cv.validity & batch.row_mask()
     n_rows = jnp.sum(keep.astype(jnp.int32))
-    dest = jnp.where(keep, jnp.cumsum(keep.astype(jnp.int32)) - 1, cap)
-    sel = jnp.zeros((cap,), jnp.int32).at[dest].set(
-        jnp.arange(cap, dtype=jnp.int32), mode="drop")
+    # selected-row index map via ONE single-operand u32 sort (surviving
+    # row positions ascend, so the sort is the stable compaction);
+    # measured ~3x cheaper than the full-capacity scatter it replaces
+    pos = jnp.where(keep, jnp.arange(cap, dtype=jnp.uint32),
+                    jnp.uint32(0xFFFFFFFF))
+    sel = jnp.sort(pos).astype(jnp.int32)
 
     def gather_rung(cap2):
         s = sel[:cap2]
@@ -632,7 +729,8 @@ def merge_aggregate(batch: DeviceBatch, n_keys: int,
     """Merge phase over concatenated partials: mergeAggs analog."""
     def run(b: DeviceBatch) -> DeviceBatch:
         key_cols = b.columns[:n_keys]
-        key_vals = [ColVal(c.dtype, c.data, c.validity, c.lengths)
+        key_vals = [ColVal(c.dtype, c.data, c.validity, c.lengths,
+                            vbits=c.vbits, nonnull=c.nonnull)
                     for c in key_cols]
         ctx = sorted_group_ctx(key_vals, b)
         cols = gather_group_keys(key_vals, ctx)

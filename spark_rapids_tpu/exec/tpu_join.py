@@ -42,6 +42,7 @@ from spark_rapids_tpu.expr.eval_tpu import ColVal
 from spark_rapids_tpu.plan.logical import Schema
 
 _BIG = np.int64(1 << 62)
+_BIG32 = np.int32(np.iinfo(np.int32).max)  # > any position (cap-1)
 
 
 def _gather(child: PhysicalPlan) -> Optional[DeviceBatch]:
@@ -172,30 +173,34 @@ class _JoinCtx:
         self.sorted_null_key = jnp.take(null_key, order)
         self.is_build = sorted_exists & (sorted_side == 0)
         self.is_stream = sorted_exists & (sorted_side == 1)
-        pos = jnp.arange(cap, dtype=jnp.int64)
+        # counts/positions fit i32 (cap < 2^31): i64 scatters cost ~14x
+        # under the pair emulation, and an i64 cumsum inside lax.cond
+        # trips the 19.09M scoped-VMEM lowering (PERF.md, exec/scans.py)
+        pos = jnp.arange(cap, dtype=jnp.int32)
 
         match_build = self.is_build & ~self.sorted_null_key
         self.b_count = jax.ops.segment_sum(
-            match_build.astype(jnp.int64), seg, num_segments=cap)
+            match_build.astype(jnp.int32), seg, num_segments=cap)
         self.build_start = jax.ops.segment_min(
-            jnp.where(match_build, pos, _BIG), seg, num_segments=cap)
+            jnp.where(match_build, pos, _BIG32), seg, num_segments=cap)
         match_stream = self.is_stream & ~self.sorted_null_key
         self.s_count = jax.ops.segment_sum(
-            match_stream.astype(jnp.int64), seg, num_segments=cap)
+            match_stream.astype(jnp.int32), seg, num_segments=cap)
 
         # per sorted-row match count (stream rows only)
         self.m = jnp.where(self.is_stream & ~self.sorted_null_key,
                            jnp.take(self.b_count, seg), 0)
 
 
-def _pairs_layout(ctx: _JoinCtx, outer: bool):
-    """Per-sorted-row emission count + inclusive cumsum."""
+def _pairs_layout(ctx: _JoinCtx, outer: bool, with_incl: bool = True):
+    """Per-sorted-row emission count + inclusive cumsum (i32: the emit
+    kernel only runs after the host has checked the i64 total fits)."""
     m_out = ctx.m
     if outer:
         m_out = jnp.where(ctx.is_stream, jnp.maximum(ctx.m, 1), 0)
     else:
         m_out = jnp.where(ctx.is_stream, ctx.m, 0)
-    incl = jnp.cumsum(m_out)
+    incl = jnp.cumsum(m_out) if with_incl else None
     return m_out, incl
 
 
@@ -204,12 +209,17 @@ def _count_kernel(build, stream, order, seg0, build_keys, stream_keys,
     ctx = _JoinCtx(build, stream, build_keys, stream_keys, order=order,
                    seg0=seg0)
     outer = how in ("left", "right", "full")
-    m_out, incl = _pairs_layout(ctx, outer)
-    total = incl[-1]
+    m_out, _ = _pairs_layout(ctx, outer, with_incl=False)
+    # the TRUE pair total needs i64: per-row counts fit i32 but a
+    # many-to-many join's total is bounded by cap_b*cap_s, not cap.
+    # A plain i64 reduction is safe anywhere (only i64 *scans* trip the
+    # scoped-VMEM lowering); the host refuses totals past the i32 range
+    # before the emit kernel's i32 cumsum ever sees them.
+    total = jnp.sum(m_out, dtype=jnp.int64)
     if how == "full":
         unmatched_build = ctx.is_build & \
             (jnp.take(ctx.s_count, ctx.seg) == 0)
-        total = total + jnp.sum(unmatched_build.astype(jnp.int64))
+        total = total + jnp.sum(unmatched_build, dtype=jnp.int64)
     return total
 
 
@@ -223,7 +233,7 @@ def _emit_kernel(build, stream, order, seg0, build_keys, stream_keys,
     m_out, incl = _pairs_layout(ctx, outer)
     total_pairs = incl[-1]
 
-    k = jnp.arange(out_cap, dtype=jnp.int64)
+    k = jnp.arange(out_cap, dtype=jnp.int32)
     r = jnp.searchsorted(incl, k, side="right")  # sorted pos of stream row
     r = jnp.clip(r, 0, ctx.cap - 1)
     prev = jnp.take(incl, r) - jnp.take(m_out, r)
@@ -244,7 +254,7 @@ def _emit_kernel(build, stream, order, seg0, build_keys, stream_keys,
         # append unmatched build rows after the pairs (rank->row map via
         # cumsum+scatter, no sort)
         unmatched = ctx.is_build & (jnp.take(ctx.s_count, ctx.seg) == 0)
-        u_count = jnp.sum(unmatched.astype(jnp.int64))
+        u_count = jnp.sum(unmatched.astype(jnp.int32), dtype=jnp.int32)
         u_dest = jnp.where(
             unmatched, jnp.cumsum(unmatched.astype(jnp.int32)) - 1,
             ctx.cap)
@@ -278,7 +288,7 @@ def _semi_kernel(build, stream, order, seg0, build_keys, stream_keys,
     ctx = _JoinCtx(build, stream, build_keys, stream_keys, order=order,
                    seg0=seg0)
     # scatter per-sorted-row match count back to original stream rows
-    m_orig = jnp.zeros((ctx.cap,), dtype=jnp.int64).at[ctx.order].set(ctx.m)
+    m_orig = jnp.zeros((ctx.cap,), dtype=jnp.int32).at[ctx.order].set(ctx.m)
     m_stream = m_orig[ctx.cap_b:ctx.cap_b + ctx.cap_s]
     keep = (m_stream == 0) if anti else (m_stream > 0)
     return compact(stream, keep)
@@ -401,6 +411,14 @@ class _HashJoinBase(TpuExec):
             order, seg0 = self._sort_order(build, stream, bkeys, skeys)
             total = int(self._kernels[ckey](build, stream, order,
                                             seg0))
+        if total >= (1 << 31):
+            # the emit kernel's per-row layout runs in i32 (i64 chains
+            # are 3-14x slower under the pair emulation); a >2^31-row
+            # single join output cannot be materialized as one batch
+            # anyway — fail loudly instead of wrapping silently
+            raise MemoryError(
+                f"join output of {total} rows exceeds the single-batch "
+                f"2^31 limit; repartition the inputs")
         out_cap = bucket_rows(total)
         ekey = ("emit", emit_how, out_cap, tuple(bkeys), tuple(skeys),
                 build_first, build.schema_key(), stream.schema_key())

@@ -22,13 +22,17 @@ from spark_rapids_tpu.expr import eval_tpu
 from spark_rapids_tpu.plan.logical import Schema, SortOrder
 
 
-def sorted_indices(batch: DeviceBatch, orders: Sequence[SortOrder]):
+def _field_groups(batch: DeviceBatch, orders: Sequence[SortOrder]):
     groups = []
     for o in orders:
         v = eval_tpu.evaluate(o.expr, batch)
-        groups.append(sortkeys.encode_keys(v, o.ascending,
-                                           o.nulls_first_resolved))
-    return sortkeys.lexsort_indices(groups, batch.row_mask())
+        # trust only the PROPAGATED no-null hint for dropping the null
+        # field: schema nullability can be stale (it is metadata; the
+        # hint is derived from the actual upload/scan)
+        groups.append(sortkeys.encode_fields(
+            v, o.ascending, o.nulls_first_resolved,
+            nullable=not v.nonnull))
+    return groups
 
 
 class TpuSortExec(TpuExec):
@@ -56,12 +60,8 @@ class TpuSortExec(TpuExec):
         return [REQUIRE_SINGLE_BATCH]
 
     def _keys_impl(self, batch: DeviceBatch) -> jnp.ndarray:
-        groups = []
-        for o in self.orders:
-            v = eval_tpu.evaluate(o.expr, batch)
-            groups.append(sortkeys.encode_keys(
-                v, o.ascending, o.nulls_first_resolved))
-        return sortkeys.stack_sort_words(groups, batch.row_mask())
+        return sortkeys.stack_sort_digits(
+            _field_groups(batch, self.orders), batch.row_mask())
 
     @staticmethod
     def _apply_impl(batch: DeviceBatch,
@@ -104,8 +104,8 @@ class TpuSortExec(TpuExec):
                 for h in handles:
                     h.close()
             with timed(self.metrics):
-                wm = keys_kernel(whole)
-                order = sortkeys.shared_lexsort(wm)
+                digits = keys_kernel(whole)
+                order = sortkeys.shared_digit_sort(digits)
                 apply_kernel = kc.get_kernel(
                     ("sort_apply", whole.schema_key()),
                     lambda: type(self)._apply_impl)

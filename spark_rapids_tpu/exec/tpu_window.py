@@ -29,7 +29,7 @@ from jax import lax
 from spark_rapids_tpu import dtypes as dt
 from spark_rapids_tpu.columnar.batch import DeviceBatch, DeviceColumn, \
     concat_batches
-from spark_rapids_tpu.exec import sortkeys
+from spark_rapids_tpu.exec import scans, sortkeys
 from spark_rapids_tpu.exec.base import (PhysicalPlan, REQUIRE_SINGLE_BATCH,
                                         TpuExec, timed)
 from spark_rapids_tpu.exec.tpu_aggregate import normalize_key
@@ -38,18 +38,26 @@ from spark_rapids_tpu.expr.eval_tpu import ColVal
 from spark_rapids_tpu.plan.logical import Schema
 
 
-def _seg_scan(op, x, seg):
-    """Segmented inclusive scan (standard associative formulation)."""
-    def combine(a, b):
-        va, sa = a
-        vb, sb = b
-        return jnp.where(sa == sb, op(va, vb), vb), sb
-    v, _ = lax.associative_scan(combine, (x, seg))
-    return v
+def _seg_scan(op, x, seg, identity):
+    """Segmented inclusive scan over partition ids.
+
+    Delegates to exec/scans.seg_scan (boundary-flag formulation) whose
+    capacity-blocked form keeps wide (8-byte) dtypes compilable at any
+    size — a full-capacity ``lax.associative_scan`` over i64/f64 is a
+    minutes-scale XLA compile at 4M (PERF.md)."""
+    flags = jnp.concatenate([jnp.ones((1,), jnp.bool_),
+                             seg[1:] != seg[:-1]])
+    return scans.seg_scan(op, flags, x, identity)
 
 
 def _boundaries_to_seg(new_flag: jnp.ndarray) -> jnp.ndarray:
     return jnp.cumsum(new_flag.astype(jnp.int32)) - 1
+
+
+def _win_fields(v, asc, nf):
+    # null field dropped only on the propagated no-null hint (schema
+    # nullability alone is metadata and can be stale)
+    return sortkeys.encode_fields(v, asc, nf, nullable=not v.nonnull)
 
 
 class _WinCtx:
@@ -64,21 +72,36 @@ class _WinCtx:
                  for e in part_exprs]
         ovals = [normalize_key(eval_tpu.evaluate(e, batch))
                  for e in order_exprs]
-        pgroups = [sortkeys.encode_keys(v, True, True) for v in pvals]
-        ogroups = [sortkeys.encode_keys(v, asc, nf)
+        pfields = [_win_fields(v, True, True) for v in pvals]
+        ofields = [_win_fields(v, asc, nf)
                    for v, (asc, nf) in zip(ovals, order_dirs)]
+        full_digits = sortkeys.stack_sort_digits(pfields + ofields,
+                                                 row_mask)
         # the sort order is normally computed OUTSIDE this (jitted)
-        # kernel via sortkeys.shared_lexsort — embedding the sort here
-        # would recompile a minutes-scale XLA sort per window spec
+        # kernel via sortkeys.shared_digit_sort — embedding the sort
+        # here would recompile a minutes-scale XLA sort per window spec
         self.order = order if order is not None else \
-            sortkeys.lexsort_indices(pgroups + ogroups, row_mask)
-        new_part = sortkeys.group_boundaries(pgroups, self.order, row_mask)
-        new_peer = sortkeys.group_boundaries(pgroups + ogroups, self.order,
+            sortkeys._digit_sort_impl(full_digits)
+        base = jnp.zeros((cap,), jnp.bool_).at[0].set(True)
+        sorted_mask = jnp.take(row_mask, self.order)
+        mask_edge = jnp.concatenate(
+            [jnp.ones((1,), jnp.bool_),
+             sorted_mask[1:] != sorted_mask[:-1]])
+        if pfields:
+            pdigits = sortkeys.fields_to_digits(
+                [f for g in pfields for f in g])
+            new_part = sortkeys.digit_boundaries(pdigits, self.order,
+                                                 row_mask)
+        else:
+            new_part = base | mask_edge
+        new_peer = sortkeys.digit_boundaries(full_digits, self.order,
                                              row_mask)
         self.part_seg = _boundaries_to_seg(new_part)
         self.peer_seg = _boundaries_to_seg(new_peer)
         self.new_peer = new_peer
-        pos = jnp.arange(cap, dtype=jnp.int64)
+        # i32 positions: i64 segment min/max scatters cost ~14x under
+        # the pair emulation (PERF.md)
+        pos = jnp.arange(cap, dtype=jnp.int32)
         self.pos = pos
         self.part_start = jnp.take(
             jax.ops.segment_min(pos, self.part_seg, num_segments=cap),
@@ -100,7 +123,8 @@ class _WinCtx:
 
     def sorted_val(self, v: ColVal) -> ColVal:
         c = v.to_column().gather(self.order, self.sorted_exists)
-        return ColVal(c.dtype, c.data, c.validity, c.lengths)
+        return ColVal(c.dtype, c.data, c.validity, c.lengths,
+                      vbits=c.vbits)
 
 
 def _seg_searchsorted(vals: jnp.ndarray, lo0: jnp.ndarray,
@@ -214,7 +238,10 @@ def _frame_bounds(ctx: _WinCtx, frame: ir.WindowFrame):
 
 
 def _prefix(x: jnp.ndarray) -> jnp.ndarray:
-    return jnp.concatenate([jnp.zeros((1,), x.dtype), jnp.cumsum(x)])
+    # scans.cumsum blocks wide (8-byte) dtypes: a bare i64/f64
+    # jnp.cumsum inside any control flow trips the 19.09M scoped-VMEM
+    # pair lowering on TPU (PERF.md / exec/scans.py)
+    return jnp.concatenate([jnp.zeros((1,), x.dtype), scans.cumsum(x)])
 
 
 def _range_sum(x: jnp.ndarray, a: jnp.ndarray, b: jnp.ndarray
@@ -267,9 +294,9 @@ def _window_agg(fn: ir.AggregateExpression, ctx: _WinCtx,
     nonempty = b >= a
 
     if isinstance(fn, ir.Count):
-        ones = valid.astype(jnp.int64)
-        P = _prefix(ones)
-        out = jnp.take(P, b + 1) - jnp.take(P, a)
+        # counts fit i32 (cap < 2^31): native cumsum + narrow gathers
+        P = _prefix(valid.astype(jnp.int32))
+        out = (jnp.take(P, b + 1) - jnp.take(P, a)).astype(jnp.int64)
         out = jnp.where(nonempty, out, 0)  # empty frame -> count 0
         return ColVal(dt.INT64, out, jnp.ones((ctx.cap,), jnp.bool_))
 
@@ -283,7 +310,7 @@ def _window_agg(fn: ir.AggregateExpression, ctx: _WinCtx,
             # sum the non-NaN part and re-inject NaN per frame
             isnan = jnp.isnan(data) & valid
             x = jnp.where(isnan, 0.0, x)
-            nanP = _prefix(isnan.astype(jnp.int64))
+            nanP = _prefix(isnan.astype(jnp.int32))
             frame_has_nan = (jnp.take(nanP, b + 1) - jnp.take(nanP, a)) > 0
         else:
             frame_has_nan = jnp.zeros((ctx.cap,), dtype=jnp.bool_)
@@ -292,8 +319,9 @@ def _window_agg(fn: ir.AggregateExpression, ctx: _WinCtx,
         else:
             P = _prefix(x)
             s = jnp.take(P, b + 1) - jnp.take(P, a)
-        cnt = _prefix(valid.astype(jnp.int64))
-        c = jnp.maximum(jnp.take(cnt, b + 1) - jnp.take(cnt, a), 0)
+        cnt = _prefix(valid.astype(jnp.int32))
+        c = jnp.maximum((jnp.take(cnt, b + 1) -
+                         jnp.take(cnt, a)).astype(jnp.int64), 0)
         c = jnp.where(nonempty, c, 0)
         if is_float:
             s = jnp.where(frame_has_nan, jnp.float64(np.nan), s)
@@ -314,11 +342,11 @@ def _window_agg(fn: ir.AggregateExpression, ctx: _WinCtx,
             fill = np.array(np.inf if is_min else -np.inf, dtype=tgt)
             x = jnp.where(valid & ~isnan, data.astype(tgt), fill)
             run = _seg_scan(jnp.minimum if is_min else jnp.maximum, x,
-                            ctx.part_seg)
+                            ctx.part_seg, fill)
             any_nonnan = _seg_scan(jnp.logical_or, valid & ~isnan,
-                                   ctx.part_seg)
+                                   ctx.part_seg, False)
             any_nan = _seg_scan(jnp.logical_or, valid & isnan,
-                                ctx.part_seg)
+                                ctx.part_seg, False)
             run_b = jnp.take(run, b)
             nonnan_b = jnp.take(any_nonnan, b)
             nan_b = jnp.take(any_nan, b)
@@ -332,16 +360,16 @@ def _window_agg(fn: ir.AggregateExpression, ctx: _WinCtx,
         if d.is_bool:
             x = jnp.where(valid, data, not is_min)
             run = _seg_scan(jnp.logical_and if is_min else jnp.logical_or,
-                            x, ctx.part_seg)
-            hasv = _seg_scan(jnp.logical_or, valid, ctx.part_seg)
+                            x, ctx.part_seg, not is_min)
+            hasv = _seg_scan(jnp.logical_or, valid, ctx.part_seg, False)
             return ColVal(d, jnp.take(run, b),
                           jnp.take(hasv, b) & (b >= a))
         info = np.iinfo(tgt)
         fill = np.array(info.max if is_min else info.min, dtype=tgt)
         x = jnp.where(valid, data.astype(tgt), fill)
         run = _seg_scan(jnp.minimum if is_min else jnp.maximum, x,
-                        ctx.part_seg)
-        hasv = _seg_scan(jnp.logical_or, valid, ctx.part_seg)
+                        ctx.part_seg, fill)
+        hasv = _seg_scan(jnp.logical_or, valid, ctx.part_seg, False)
         out = jnp.take(run, b)
         has = jnp.take(hasv, b) & (b >= a)
         return ColVal(d, jnp.where(has, out, 0), has)
@@ -436,11 +464,11 @@ class TpuWindowExec(TpuExec):
                  for e in we0.partition_exprs]
         ovals = [normalize_key(eval_tpu.evaluate(e, batch))
                  for e in we0.order_exprs]
-        pgroups = [sortkeys.encode_keys(v, True, True) for v in pvals]
-        ogroups = [sortkeys.encode_keys(v, asc, nf)
+        pfields = [_win_fields(v, True, True) for v in pvals]
+        ofields = [_win_fields(v, asc, nf)
                    for v, (asc, nf) in zip(ovals, we0.order_dirs)]
-        return sortkeys.stack_sort_words(pgroups + ogroups,
-                                         batch.row_mask())
+        return sortkeys.stack_sort_digits(pfields + ofields,
+                                          batch.row_mask())
 
     def _impl(self, batch: DeviceBatch, orders) -> DeviceBatch:
         spec_groups = self._spec_groups(self.out_names,
@@ -455,8 +483,8 @@ class TpuWindowExec(TpuExec):
             for name, we in items:
                 v = _window_value(we, ctx, batch)
                 # scatter back to original row order
-                inv = jnp.zeros((ctx.cap,), dtype=jnp.int64).at[
-                    ctx.order].set(jnp.arange(ctx.cap, dtype=jnp.int64))
+                inv = jnp.zeros((ctx.cap,), dtype=jnp.int32).at[
+                    ctx.order].set(jnp.arange(ctx.cap, dtype=jnp.int32))
                 col = v.to_column().gather(inv, batch.row_mask())
                 new_cols[name] = col
         # emit in the last spec's sorted order (Spark emits sorted)
@@ -499,7 +527,7 @@ class TpuWindowExec(TpuExec):
             whole = concat_batches(batches)
             with timed(self.metrics):
                 orders = tuple(
-                    sortkeys.shared_lexsort(k(whole))
+                    sortkeys.shared_digit_sort(k(whole))
                     for k in keys_kernels)
                 out = apply_kernel(whole, orders)
             self.metrics.add_rows(out.num_rows)

@@ -43,10 +43,16 @@ class ColVal:
     validity: jnp.ndarray
     lengths: Optional[jnp.ndarray] = None
     elem_validity: Optional[jnp.ndarray] = None
+    # static value-range hint (see DeviceColumn.vbits); survives only
+    # range-preserving ops (column refs, gathers, aliases)
+    vbits: Optional[int] = None
+    # static no-nulls hint (see DeviceColumn.nonnull)
+    nonnull: bool = False
 
     def to_column(self) -> DeviceColumn:
         return DeviceColumn(self.dtype, self.data, self.validity,
-                            self.lengths, self.elem_validity)
+                            self.lengths, self.elem_validity, self.vbits,
+                            self.nonnull)
 
 
 def evaluate(e: ir.Expression, batch: DeviceBatch) -> ColVal:
@@ -57,7 +63,7 @@ def evaluate(e: ir.Expression, batch: DeviceBatch) -> ColVal:
     v = fn(e, batch)
     # padding rows are never valid
     v = ColVal(v.dtype, v.data, v.validity & batch.row_mask(), v.lengths,
-               v.elem_validity)
+               v.elem_validity, v.vbits, v.nonnull)
     return v
 
 
@@ -164,7 +170,8 @@ def _eval_literal(e: ir.Literal, batch: DeviceBatch) -> ColVal:
 
 def _eval_bound(e: ir.BoundReference, batch: DeviceBatch) -> ColVal:
     c = batch.columns[e.ordinal]
-    return ColVal(c.dtype, c.data, c.validity, c.lengths, c.elem_validity)
+    return ColVal(c.dtype, c.data, c.validity, c.lengths, c.elem_validity,
+                  c.vbits, c.nonnull)
 
 
 def _eval_alias(e: ir.Alias, batch: DeviceBatch) -> ColVal:
@@ -609,6 +616,8 @@ def _eval_cast(e, batch):
             return _cast_date_to_string(c)
         if src.id == dt.TypeId.TIMESTAMP_US:
             return _cast_timestamp_to_string(c)
+        if src.is_floating:
+            return _cast_float_to_string(c)
         raise NotImplementedError(f"cast {src.name}->string on TPU")
     if src.id == dt.TypeId.DATE32 and tgt.id == dt.TypeId.TIMESTAMP_US:
         return ColVal(tgt, c.data.astype(jnp.int64) * _US_PER_DAY, c.validity)
@@ -938,6 +947,17 @@ def _cast_int_to_string(c: ColVal) -> ColVal:
     data = jnp.where(keep & c.validity[:, None], data, 0)
     return ColVal(dt.STRING, data,
                   c.validity, jnp.where(c.validity, lens, 0))
+
+
+def _cast_float_to_string(c: ColVal) -> ColVal:
+    """Exact Python-repr shortest decimal on device (expr/ryu.py; the
+    reference's GpuCast.scala:190-861 castFloatingPointToString analog).
+    float32 widens to f64 first — the CPU oracle is repr(float(x)),
+    which sees the widened double."""
+    from spark_rapids_tpu.expr import ryu
+    data, lens = ryu.f64_to_string(c.data.astype(jnp.float64),
+                                   c.validity)
+    return ColVal(dt.STRING, data, c.validity, lens)
 
 
 def _cast_bool_to_string(c: ColVal) -> ColVal:

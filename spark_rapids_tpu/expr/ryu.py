@@ -1,0 +1,415 @@
+"""Device float->string: exact shortest round-trip decimal (Ryu).
+
+The engine's documented cast semantics for float->string is Python's
+``repr`` (shortest decimal that parses back to the same double; the
+CPU oracle is ``repr(float(x))`` in expr/eval_cpu.py::_spark_str — a
+deliberate, documented delta from Spark's Java ``Double.toString``,
+whose digit selection is identical and whose formatting thresholds
+differ).  The reference runs this cast on device (GpuCast.scala:190-861
+castFloatingPointToString); round 3 left it CPU-only because shortest
+repr needs exact 128-bit arithmetic.  This module implements the Ryu
+algorithm (Adams, PLDI 2018) with vectorized 64-bit lanes:
+
+  * all per-row state is ``uint64`` vectors (XLA emulates them as u32
+    pairs on TPU — elementwise, so throughput stays vector-shaped),
+  * the 64x128->top-64 ``mulShift`` is built from 32x32->64 partial
+    products (`_umul128`),
+  * divisions by 5/10 use multiply-high magic constants (no emulated
+    64-bit division anywhere),
+  * the data-dependent digit-removal loops become fixed 18-trip
+    ``fori_loop``s with per-row active masks,
+  * the 5^q / 5^-q tables (326 + 292 x 128-bit) are computed exactly
+    with Python ints at import and uploaded once as [n, 2] u64.
+
+Output is the engine's device string layout: (bytes [n, 32] u8,
+lengths i32).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+_MANT_BITS = 52
+_BIAS = 1023
+_POW5_INV_BITCOUNT = 125
+_POW5_BITCOUNT = 125
+_MAX_LEN = 32          # "-2.2250738585072014e-308" is 24; bucket 32
+
+
+def _pow5bits(e: int) -> int:
+    return ((e * 1217359) >> 19) + 1
+
+
+def _build_tables():
+    inv = np.zeros((292, 2), dtype=np.uint64)    # floor(2^k/5^q)+1
+    for q in range(292):
+        pow5 = 5 ** q
+        k = _pow5bits(q) + _POW5_INV_BITCOUNT - 1
+        v = (1 << k) // pow5 + 1
+        inv[q, 0] = v & 0xFFFFFFFFFFFFFFFF
+        inv[q, 1] = v >> 64
+    pw = np.zeros((326, 2), dtype=np.uint64)     # floor(5^i/2^(b-121))
+    for i in range(326):
+        pow5 = 5 ** i
+        k = _pow5bits(i) - _POW5_BITCOUNT
+        v = pow5 >> k if k >= 0 else pow5 << -k
+        pw[i, 0] = v & 0xFFFFFFFFFFFFFFFF
+        pw[i, 1] = v >> 64
+    # multipleOfPowerOf5 via modular inverse: value % 5^p == 0 iff
+    # value * inv5^p (mod 2^64) <= (2^64 - 1) / 5^p
+    inv5 = pow(5, -1, 1 << 64)
+    minv = np.zeros((24,), dtype=np.uint64)
+    mbound = np.zeros((24,), dtype=np.uint64)
+    for p in range(24):
+        minv[p] = pow(inv5, p, 1 << 64)
+        mbound[p] = ((1 << 64) - 1) // (5 ** p)
+    return inv, pw, minv, mbound
+
+
+_INV_TAB, _POW_TAB, _MODINV5, _MODBOUND5 = _build_tables()
+
+_M32 = np.uint64(0xFFFFFFFF)
+
+
+def _umul128(a, b):
+    """Full 64x64 -> (lo, hi) via four 32x32->64 partials."""
+    a0 = a & _M32
+    a1 = a >> np.uint64(32)
+    b0 = b & _M32
+    b1 = b >> np.uint64(32)
+    p00 = a0 * b0
+    p01 = a0 * b1
+    p10 = a1 * b0
+    p11 = a1 * b1
+    mid = (p00 >> np.uint64(32)) + (p01 & _M32) + (p10 & _M32)
+    lo = (p00 & _M32) | (mid << np.uint64(32))
+    hi = p11 + (p01 >> np.uint64(32)) + (p10 >> np.uint64(32)) + \
+        (mid >> np.uint64(32))
+    return lo, hi
+
+
+def _umulhi(a, b):
+    return _umul128(a, b)[1]
+
+
+_DIV_MAGIC = np.uint64(0xCCCCCCCCCCCCCCCD)
+
+
+def _div5(x):
+    return _umulhi(x, _DIV_MAGIC) >> np.uint64(2)
+
+
+def _div10(x):
+    return _umulhi(x, _DIV_MAGIC) >> np.uint64(3)
+
+
+def _mod10(x):
+    return x - np.uint64(10) * _div10(x)
+
+
+def _mul_shift64(m, mul_lo, mul_hi, j):
+    """(m * (mul_hi<<64 | mul_lo)) >> j, for 64 < j < 128."""
+    lo0, hi0 = _umul128(m, mul_lo)
+    lo2, hi2 = _umul128(m, mul_hi)
+    s_lo = hi0 + lo2
+    carry = (s_lo < hi0).astype(jnp.uint64)
+    s_hi = hi2 + carry
+    dist = (j - np.uint64(64)).astype(jnp.uint64)
+    # 0 < dist < 64 for all double inputs
+    return (s_hi << (np.uint64(64) - dist)) | (s_lo >> dist)
+
+
+def _multiple_of_pow5(value, p):
+    """value % 5^p == 0 for p in [0, 23], via the mod-inverse trick."""
+    inv = jnp.take(jnp.asarray(_MODINV5), p)
+    bound = jnp.take(jnp.asarray(_MODBOUND5), p)
+    prod = value * inv      # mod 2^64
+    return prod <= bound
+
+
+def _log10_pow2(e):
+    return (e * 78913) >> 18
+
+
+def _log10_pow5(e):
+    return (e * 732923) >> 20
+
+
+def _d2d(bits):
+    """Core Ryu: IEEE754 bits (u64, finite nonzero) -> (digits u64,
+    exp i32) with digits the shortest decimal mantissa and
+    value == digits * 10^exp."""
+    ieee_mant = bits & jnp.uint64((1 << 52) - 1)
+    ieee_exp = ((bits >> jnp.uint64(52)) &
+                jnp.uint64(0x7FF)).astype(jnp.int32)
+
+    subnormal = ieee_exp == 0
+    e2 = jnp.where(subnormal, 1 - _BIAS - _MANT_BITS - 2,
+                   ieee_exp - _BIAS - _MANT_BITS - 2)
+    m2 = jnp.where(subnormal, ieee_mant,
+                   ieee_mant | jnp.uint64(1 << 52))
+    even = (m2 & jnp.uint64(1)) == 0
+    accept = even
+    mv = jnp.uint64(4) * m2
+    mm_shift = ((ieee_mant != 0) | (ieee_exp <= 1)).astype(jnp.uint64)
+
+    # ---- e2 >= 0 branch --------------------------------------------
+    e2u = jnp.maximum(e2, 0)
+    q_a = _log10_pow2(e2u) - (e2u > 3).astype(jnp.int32)
+    q_a_u = jnp.maximum(q_a, 0)
+    pb_a = ((q_a_u * 1217359) >> 19) + 1
+    k_a = _POW5_INV_BITCOUNT + pb_a - 1
+    i_a = (-e2u + q_a_u + k_a).astype(jnp.uint64)
+    mul_a = jnp.asarray(_INV_TAB)
+    qa_idx = jnp.clip(q_a_u, 0, _INV_TAB.shape[0] - 1)
+    a_lo = jnp.take(mul_a[:, 0], qa_idx)
+    a_hi = jnp.take(mul_a[:, 1], qa_idx)
+    vr_a = _mul_shift64(mv, a_lo, a_hi, i_a)
+    vp_a = _mul_shift64(mv + jnp.uint64(2), a_lo, a_hi, i_a)
+    vm_a = _mul_shift64(mv - jnp.uint64(1) - mm_shift, a_lo, a_hi, i_a)
+    qp = jnp.clip(q_a_u, 0, 23)
+    mv_mod5 = mv - jnp.uint64(5) * _div5(mv)
+    vr_tz_a = (q_a_u <= 21) & (mv_mod5 == 0) & \
+        _multiple_of_pow5(mv, qp)
+    vm_tz_a = (q_a_u <= 21) & (mv_mod5 != 0) & accept & \
+        _multiple_of_pow5(mv - jnp.uint64(1) - mm_shift, qp)
+    vp_a = vp_a - jnp.where(
+        (q_a_u <= 21) & (mv_mod5 != 0) & ~accept &
+        _multiple_of_pow5(mv + jnp.uint64(2), qp),
+        jnp.uint64(1), jnp.uint64(0))
+    e10_a = q_a
+
+    # ---- e2 < 0 branch ---------------------------------------------
+    ne2 = jnp.maximum(-e2, 0)
+    q_b = _log10_pow5(ne2) - (ne2 > 1).astype(jnp.int32)
+    q_b_u = jnp.maximum(q_b, 0)
+    i_b = ne2 - q_b_u
+    i_b_idx = jnp.clip(i_b, 0, _POW_TAB.shape[0] - 1)
+    pb_b = ((i_b_idx * 1217359) >> 19) + 1
+    k_b = pb_b - _POW5_BITCOUNT
+    j_b = jnp.maximum(q_b_u - k_b, 65).astype(jnp.uint64)
+    mul_b = jnp.asarray(_POW_TAB)
+    b_lo = jnp.take(mul_b[:, 0], i_b_idx)
+    b_hi = jnp.take(mul_b[:, 1], i_b_idx)
+    vr_b = _mul_shift64(mv, b_lo, b_hi, j_b)
+    vp_b = _mul_shift64(mv + jnp.uint64(2), b_lo, b_hi, j_b)
+    vm_b = _mul_shift64(mv - jnp.uint64(1) - mm_shift, b_lo, b_hi, j_b)
+    vr_tz_b = jnp.where(
+        q_b_u <= 1, jnp.ones_like(even),
+        (q_b_u < 63) &
+        ((mv & ((jnp.uint64(1) << jnp.clip(q_b_u, 0, 63)
+                 .astype(jnp.uint64)) - jnp.uint64(1))) == 0))
+    vm_tz_b = (q_b_u <= 1) & accept & (mm_shift == 1)
+    vp_b = vp_b - jnp.where((q_b_u <= 1) & ~accept,
+                            jnp.uint64(1), jnp.uint64(0))
+    e10_b = q_b + e2
+
+    pos = e2 >= 0
+    vr = jnp.where(pos, vr_a, vr_b)
+    vp = jnp.where(pos, vp_a, vp_b)
+    vm = jnp.where(pos, vm_a, vm_b)
+    vr_tz = jnp.where(pos, vr_tz_a, vr_tz_b)
+    vm_tz = jnp.where(pos, vm_tz_a, vm_tz_b)
+    e10 = jnp.where(pos, e10_a, e10_b)
+
+    # ---- digit removal ---------------------------------------------
+    any_tz = vm_tz | vr_tz
+
+    def body1(_, st):
+        vr, vp, vm, vm_tz, vr_tz, last, removed = st
+        go = _div10(vp) > _div10(vm)
+        vm_tz2 = vm_tz & (_mod10(vm) == 0)
+        vr_tz2 = vr_tz & (last == 0)
+        last2 = _mod10(vr).astype(jnp.int32)
+        return (jnp.where(go, _div10(vr), vr),
+                jnp.where(go, _div10(vp), vp),
+                jnp.where(go, _div10(vm), vm),
+                jnp.where(go, vm_tz2, vm_tz),
+                jnp.where(go, vr_tz2, vr_tz),
+                jnp.where(go, last2, last),
+                removed + go.astype(jnp.int32))
+
+    st = (vr, vp, vm, vm_tz, vr_tz, jnp.zeros_like(e10),
+          jnp.zeros_like(e10))
+    vr, vp, vm, vm_tz, vr_tz, last, removed = jax.lax.fori_loop(
+        0, 18, body1, st)
+
+    def body2(_, st):
+        vr, vp, vm, vr_tz, last, removed = st
+        go = _mod10(vm) == 0
+        vr_tz2 = vr_tz & (last == 0)
+        last2 = _mod10(vr).astype(jnp.int32)
+        return (jnp.where(go, _div10(vr), vr),
+                jnp.where(go, _div10(vp), vp),
+                jnp.where(go, _div10(vm), vm),
+                jnp.where(go, vr_tz2, vr_tz),
+                jnp.where(go, last2, last),
+                removed + go.astype(jnp.int32))
+
+    # second loop only runs for rows where vm had trailing zeros
+    st2 = (vr, vp, vm, vr_tz, last, removed)
+    vr2, _vp2, vm2, vr_tz2, last2, removed2 = jax.lax.fori_loop(
+        0, 18, body2, st2)
+    use2 = vm_tz
+    vr = jnp.where(use2, vr2, vr)
+    vm = jnp.where(use2, vm2, vm)
+    vr_tz = jnp.where(use2, vr_tz2, vr_tz)
+    last = jnp.where(use2, last2, last)
+    removed = jnp.where(use2, removed2, removed)
+
+    # round-to-even correction for exact halves
+    last = jnp.where(vr_tz & (last == 5) & ((vr & jnp.uint64(1)) == 0),
+                     jnp.int32(4), last)
+    need_inc = ((vr == vm) & (~accept | ~vm_tz)) | (last >= 5)
+    out = vr + need_inc.astype(jnp.uint64)
+    del any_tz
+    return out, e10 + removed
+
+
+def _digits_of(out):
+    """out u64 (1..17 digits) -> ([n, 17] u8 digit chars MSD-first
+    right-aligned is awkward; return LSD-indexable digits + count)."""
+    ds = []
+    x = out
+    for _ in range(17):
+        ds.append(_mod10(x).astype(jnp.uint8))
+        x = _div10(x)
+    dig = jnp.stack(ds, axis=-1)          # [n, 17], LSD first
+    length = jnp.ones(out.shape, jnp.int32)
+    p = out
+    for i in range(1, 17):
+        p = _div10(p)
+        length = length + (p > 0).astype(jnp.int32)
+    return dig, length
+
+
+def f64_to_string(data: jnp.ndarray, validity: jnp.ndarray):
+    """Python-repr format of f64 -> (bytes [n, 32] u8, lengths i32).
+
+    Specials: NaN / Infinity / -Infinity / 0.0 / -0.0 (repr style).
+    Finite nonzero: shortest digits D of length L with decimal point
+    exponent dexp; fixed notation for -4 <= dexp < 16, else
+    scientific  d[.ddd]e(+|-)XX  with >= 2 exponent digits.
+    """
+    from spark_rapids_tpu.expr.eval_tpu import f64_bits
+    n = data.shape[0]
+    bits = f64_bits(data)
+    sign = (bits >> jnp.uint64(63)) != 0
+    absbits = bits & jnp.uint64((1 << 63) - 1)
+    ieee_exp = (absbits >> jnp.uint64(52)).astype(jnp.int32)
+    is_nan = (ieee_exp == 0x7FF) & ((absbits &
+                                     jnp.uint64((1 << 52) - 1)) != 0)
+    is_inf = (ieee_exp == 0x7FF) & ~is_nan
+    is_zero = absbits == 0
+
+    digits, exp = _d2d(absbits)
+    dig, L = _digits_of(digits)
+    dexp = exp + L - 1                    # exponent of first digit
+
+    sci = (dexp < -4) | (dexp >= 16)
+    cols = jnp.arange(_MAX_LEN, dtype=jnp.int32)[None, :]
+    s_off = sign.astype(jnp.int32)[:, None]     # '-' column shift
+    Lc = L[:, None]
+    dx = dexp[:, None]
+
+    def dchar(idx_from_msd):
+        """ASCII digit k positions after the most significant digit."""
+        sel = jnp.clip(Lc - 1 - idx_from_msd, 0, 16)
+        d = jnp.take_along_axis(dig, sel, axis=1)
+        return d + np.uint8(ord("0"))
+
+    zero_ch = np.uint8(ord("0"))
+    dot = np.uint8(ord("."))
+
+    # ---- fixed notation --------------------------------------------
+    # dexp >= 0:  D[0..dexp] (zero-padded) '.' D[dexp+1..] (or '0')
+    # dexp < 0 :  '0' '.' zeros(-dexp-1) D[0..]
+    ip_len = jnp.where(dx >= 0, dx + 1, 1)          # integer digits
+    fr_len = jnp.where(dx >= 0, jnp.maximum(Lc - (dx + 1), 1),
+                       (-dx - 1) + Lc)
+    fix_len = ip_len + 1 + fr_len
+    j = cols - s_off
+    in_int = (j >= 0) & (j < ip_len)
+    at_dot = j == ip_len
+    in_frac = (j > ip_len) & (j < fix_len)
+    fj = j - ip_len - 1                              # fraction index
+    int_digit = jnp.where((dx >= 0) & (j < Lc), dchar(j), zero_ch)
+    # for dexp >= 0 the k-th fraction char is digit (dexp+1+k); for
+    # dexp < 0 it's zeros until k == -dexp-1 then digit (k + dexp + 1)
+    frac_pos = fj + dx + 1
+    frac_digit = jnp.where(
+        (frac_pos >= 0) & (frac_pos < Lc), dchar(frac_pos), zero_ch)
+    fixed_ch = jnp.where(
+        in_int, int_digit,
+        jnp.where(at_dot, dot, jnp.where(in_frac, frac_digit,
+                                         np.uint8(0))))
+
+    # ---- scientific notation ---------------------------------------
+    # d '.' rest | 'e' sign dd[d]
+    has_frac = Lc > 1
+    mant_len = jnp.where(has_frac, Lc + 1, 1)
+    aexp = jnp.abs(dx)
+    e_digits = jnp.where(aexp >= 100, 3, 2)
+    sci_len = mant_len + 2 + e_digits
+    at_d0 = j == 0
+    at_sdot = (j == 1) & has_frac
+    in_mant = (j >= 2) & (j < mant_len)
+    at_e = j == mant_len
+    at_esign = j == mant_len + 1
+    in_exp = (j >= mant_len + 2) & (j < sci_len)
+    mant_digit = dchar(j - 1)
+    ej = j - mant_len - 2
+    e1 = aexp // 100
+    e2_ = (aexp // 10) % 10
+    e3 = aexp % 10
+    exp_digit = jnp.where(
+        e_digits == 3,
+        jnp.where(ej == 0, e1, jnp.where(ej == 1, e2_, e3)),
+        jnp.where(ej == 0, e2_, e3)).astype(jnp.uint8) + zero_ch
+    sci_ch = jnp.where(
+        at_d0, dchar(jnp.zeros_like(j)),
+        jnp.where(at_sdot, dot,
+                  jnp.where(in_mant, mant_digit,
+                            jnp.where(at_e, np.uint8(ord("e")),
+                                      jnp.where(at_esign,
+                                                jnp.where(dx < 0,
+                                                          np.uint8(ord("-")),
+                                                          np.uint8(ord("+"))),
+                                                jnp.where(in_exp, exp_digit,
+                                                          np.uint8(0)))))))
+
+    ch = jnp.where(sci[:, None], sci_ch, fixed_ch)
+    length = jnp.where(sci, sci_len[:, 0], fix_len[:, 0]) + \
+        sign.astype(jnp.int32)
+    # sign column
+    ch = jnp.where((cols == 0) & sign[:, None], np.uint8(ord("-")), ch)
+
+    # ---- specials ---------------------------------------------------
+    def _lit(s):
+        b = np.zeros((_MAX_LEN,), np.uint8)
+        b[:len(s)] = np.frombuffer(s.encode(), dtype=np.uint8)
+        return jnp.asarray(b)[None, :], len(s)
+
+    nan_b, nan_l = _lit("NaN")
+    inf_b, inf_l = _lit("Infinity")
+    ninf_b, ninf_l = _lit("-Infinity")
+    z_b, z_l = _lit("0.0")
+    nz_b, nz_l = _lit("-0.0")
+
+    for m, b, le in ((is_nan, nan_b, nan_l),
+                     (is_inf & ~sign, inf_b, inf_l),
+                     (is_inf & sign, ninf_b, ninf_l),
+                     (is_zero & ~sign, z_b, z_l),
+                     (is_zero & sign, nz_b, nz_l)):
+        ch = jnp.where(m[:, None], b, ch)
+        length = jnp.where(m, le, length)
+
+    valid = validity
+    ch = jnp.where(valid[:, None], ch, np.uint8(0))
+    length = jnp.where(valid, length, 0)
+    # zero out columns past each row's length (device string contract)
+    ch = jnp.where(cols < length[:, None], ch, np.uint8(0))
+    return ch, length

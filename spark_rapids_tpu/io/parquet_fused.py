@@ -62,7 +62,8 @@ import jax.numpy as jnp
 
 from spark_rapids_tpu import dtypes as dt
 from spark_rapids_tpu.columnar.batch import (DeviceBatch, DeviceColumn,
-                                             _bucket_strlen, bucket_rows,
+                                             _VBIT_BUCKETS, _bucket_strlen,
+                                             bits_for_range, bucket_rows,
                                              from_arrow)
 from spark_rapids_tpu.io import parquet_meta as pm
 from spark_rapids_tpu.io.device_parquet import (ChunkPlan, RunTable,
@@ -117,6 +118,41 @@ class _FusedPlan:
     stream_path: List[Tuple[str, int]] = field(default_factory=list)
     nslcap: int = 1       # unroll count of the slice path
     widths: Tuple[Tuple[int, int], ...] = ()   # (width, Ncap) sorted
+    # per-column static value-range hint (DeviceColumn.vbits) computed
+    # from host-known dictionary pages / PLAIN buffers; None = unknown
+    col_vbits: Tuple[Optional[int], ...] = ()
+
+
+def _column_vbits(out_dtype: dt.DType,
+                  col_plans: List[Optional[ChunkPlan]]) -> Optional[int]:
+    """Host-known value range of one fused column: dictionary pages
+    hold every referenceable value, PLAIN buffers hold every stored
+    value — min/max over them bounds all VALID decoded values (null
+    slots store nothing in either encoding)."""
+    if out_dtype.is_string or out_dtype.is_floating or out_dtype.is_bool:
+        return None
+    if not np.issubdtype(np.dtype(out_dtype.to_np()), np.integer):
+        return None
+    lo, hi = 0, 0
+    seen = False
+    for p in col_plans:
+        if p is None or p.mode == "null":
+            continue   # all-null segment: no value constraint
+        if p.mode == "dict":
+            buf = p.dict_np
+        elif p.mode == "plain":
+            buf = p.plain_np
+        else:
+            return None
+        if buf is None or not np.issubdtype(buf.dtype, np.integer):
+            return None
+        if buf.shape[0]:
+            lo = min(lo, int(buf.min())) if seen else int(buf.min())
+            hi = max(hi, int(buf.max())) if seen else int(buf.max())
+            seen = True
+    if not seen:
+        return _VBIT_BUCKETS[0]
+    return bits_for_range(lo, hi)
 
 
 def _all_valid(runs: RunTable) -> bool:
@@ -336,9 +372,11 @@ def assemble(plans: List[List[Optional[ChunkPlan]]],
         arrays["dict_" + key] = _pad_np(
             buf, bucket_rows(buf.shape[0] + pad, 64))
 
-    key = ("pq_fused4", tuple(names),
+    col_vbits = tuple(_column_vbits(out_dtypes[ci], plans[ci])
+                      for ci in range(len(plans)))
+    key = ("pq_fused5", tuple(names),
            tuple(d.name for d in out_dtypes), K, vcap, cap,
-           nslcap, rcap, tuple(stream_path), tuple(w_caps),
+           nslcap, rcap, tuple(stream_path), tuple(w_caps), col_vbits,
            tuple((a, arrays[a].shape, str(arrays[a].dtype))
                  for a in sorted(arrays)),
            tuple(tuple((s.mode, s.nullable, s.def_stream, s.val_stream,
@@ -348,7 +386,8 @@ def assemble(plans: List[List[Optional[ChunkPlan]]],
     return _FusedPlan(key=key, specs=specs, out_dtypes=out_dtypes,
                       names=names, arrays=arrays, n_rows=list(n_rows),
                       cap=cap, vcap=vcap, stream_path=stream_path,
-                      nslcap=nslcap, widths=tuple(w_caps))
+                      nslcap=nslcap, widths=tuple(w_caps),
+                      col_vbits=col_vbits)
 
 
 # ---------------------------------------------------------------------------
@@ -626,13 +665,18 @@ def _make_kernel(fp: _FusedPlan):
                     seg_valid.append(out[1])
 
             valid = stitch(seg_valid, False)
+            vb = fp.col_vbits[ci] if fp.col_vbits else None
+            nn = all(not s.nullable and s.mode != "null"
+                     for s in col_specs)
             if odt.is_string:
                 data = stitch(seg_data, np.uint8(0))
                 lens = stitch(seg_lens, np.int32(0))
-                cols.append(DeviceColumn(odt, data, valid, lens))
+                cols.append(DeviceColumn(odt, data, valid, lens,
+                                         nonnull=nn))
             else:
                 data = stitch(seg_data, np.zeros((), np_t)[()])
-                cols.append(DeviceColumn(odt, data, valid))
+                cols.append(DeviceColumn(odt, data, valid, vbits=vb,
+                                         nonnull=nn))
         return tuple(cols), total
 
     return kernel

@@ -216,7 +216,18 @@ class Union(LogicalPlan):
 
     @property
     def schema(self) -> Schema:
-        return self.children[0].schema
+        # a column is nullable if ANY branch's is (Spark unions
+        # nullability the same way); taking branch 0's alone mis-marks
+        # e.g. lit("x") UNION lit(None) as non-nullable, which breaks
+        # every downstream null-aware path (sort null placement,
+        # null-flag key encoding)
+        s0 = self.children[0].schema
+        fields = []
+        for i, f in enumerate(s0.fields):
+            nullable = any(c.schema.fields[i].nullable
+                           for c in self.children)
+            fields.append(Field(f.name, f.dtype, nullable))
+        return Schema(fields)
 
 
 def rewrite_distinct_aggregates(plan: LogicalPlan, groupings, exprs):

@@ -134,13 +134,12 @@ def _check_expr_node(e: ir.Expression, conf: RapidsTpuConf
                     e.to.id in (dt.TypeId.DATE32,
                                 dt.TypeId.TIMESTAMP_US)):
                 return f"cast string->{e.to.name} not supported on TPU yet"
-            if e.to.is_string and src.is_floating:
-                # Java Double.toString shortest-repr semantics
-                # (reference marks GPU float->string incompatible too)
-                return ("cast float->string formatting differs from "
-                        "Spark; not supported on TPU yet")
+            if e.to.is_string and src.is_floating and \
+                    not conf.get(cfg.CAST_FLOAT_TO_STRING):
+                return ("cast float->string disabled; enable "
+                        f"{cfg.CAST_FLOAT_TO_STRING.key}")
             if e.to.is_string and not (
-                    src.is_bool or src.is_integral or
+                    src.is_bool or src.is_integral or src.is_floating or
                     src.id in (dt.TypeId.DATE32,
                                dt.TypeId.TIMESTAMP_US)):
                 return f"cast {src.name}->string not supported on TPU yet"
