@@ -178,9 +178,14 @@ def _build_join_pipeline(fact, items, warehouses):
         sk = [snames[stream.names.index(skey)]]
         b2 = DeviceBatch(bnames, build.columns, build.num_rows)
         s2 = DeviceBatch(snames, stream.columns, stream.num_rows)
-        seg0, packed = _join_sort_key(b2, s2, bk, sk)[3:5]
-        order = sortkeys.shared_lexsort(jnp.reshape(packed, (1, -1)))
-        return int(_count_kernel(b2, s2, order, seg0, bk, sk, "inner"))
+
+        # ONE jitted program: running this eagerly dispatches hundreds
+        # of individual ops through the tunnel (~minutes of wall each)
+        def f(b2, s2):
+            seg0, packed = _join_sort_key(b2, s2, bk, sk)[3:5]
+            order = sortkeys.shared_lexsort(jnp.reshape(packed, (1, -1)))
+            return _count_kernel(b2, s2, order, seg0, bk, sk, "inner")
+        return int(jax.jit(f)(b2, s2))
 
     n1 = _count(ib, fb, "item_sk", "item_sk")
     cap1 = bucket_rows(n1)

@@ -8,6 +8,7 @@ different algorithms (same philosophy as eval_cpu vs eval_tpu).
 
 from __future__ import annotations
 
+import bisect as _bisect
 import functools
 import math
 from typing import Any, List, Optional, Sequence, Tuple
@@ -41,6 +42,8 @@ def _cmp_scalar(a, b, asc: bool, nulls_first: bool) -> int:
     if ra[0] == 1:  # both non-null
         if ra[1] != rb[1]:  # NaN greatest within values
             c = -1 if ra[1] < rb[1] else 1
+        elif ra[1] == 1:
+            c = 0   # NaN == NaN (Double.compare semantics)
         elif a == b:
             c = 0
         else:
@@ -54,6 +57,79 @@ def _order_cmp(keys_a, keys_b, dirs) -> int:
         c = _cmp_scalar(a, b, asc, nf)
         if c != 0:
             return c
+    return 0
+
+
+def _fast_order_and_parts(pvals, plists, ovals, olists, dirs, n):
+    """Vectorized ordering + partition boundaries via Arrow's stable
+    multi-key sort — semantics identical to the _order_cmp comparator
+    (per-key null flag columns give per-key null placement; Arrow sorts
+    NaN greatest among values, the same rank _cmp_scalar assigns).
+
+    The comparator path is O(n log n) PYTHON comparisons — minutes at
+    millions of rows — and stays as the fallback for value types Arrow
+    cannot sort.  Returns (order ndarray, parts [(start, end)]).
+    """
+    import pyarrow.compute as pc
+    all_vals = list(zip(pvals, plists, [(True, True)] * len(pvals))) + \
+        list(zip(ovals, olists, list(dirs or ())))
+    if not all_vals:
+        return np.arange(n, dtype=np.int64), [(0, n)]
+    cols = {}
+    keys = []
+    for i, (cv, vlist, (asc, nf)) in enumerate(all_vals):
+        valid = np.asarray(cv.valid, dtype=bool)
+        flag = np.where(valid, 1, 0) if nf else np.where(valid, 0, 1)
+        cols[f"f{i}"] = pa.array(flag.astype(np.int8))
+        arr = pa.array(vlist)               # None-mapped values
+        cols[f"v{i}"] = arr
+        d = "ascending" if asc else "descending"
+        keys.append((f"f{i}", "ascending"))
+        if pa.types.is_floating(arr.type):
+            # Spark ranks NaN greatest among values in BOTH directions;
+            # Arrow sorts NaN after values regardless of direction, so
+            # the NaN rank rides its own direction-following key
+            data = np.asarray(cv.data, dtype=np.float64)
+            cols[f"g{i}"] = pa.array(
+                (valid & np.isnan(data)).astype(np.int8))
+            keys.append((f"g{i}", d))
+        keys.append((f"v{i}", d))
+    table = pa.table(cols)
+    order = pc.sort_indices(table, sort_keys=keys).to_numpy(
+        zero_copy_only=False).astype(np.int64)
+
+    # partition boundaries: adjacent sorted rows differ in any
+    # partition key (flag catches null-vs-value; NaN==NaN for floats)
+    flags_diff = np.zeros(n, dtype=bool)
+    if n:
+        flags_diff[0] = True
+    for i in range(len(pvals)):
+        fl = np.asarray(cols[f"f{i}"])[order]
+        flags_diff[1:] |= fl[1:] != fl[:-1]
+        filled = pc.fill_null(
+            cols[f"v{i}"],
+            _null_fill_for(table.schema.field(f"v{i}").type))
+        vv = filled.to_numpy(zero_copy_only=False)[order]
+        neq = vv[1:] != vv[:-1]
+        if vv.dtype.kind == "f":
+            neq &= ~(np.isnan(vv[1:].astype(np.float64)) &
+                     np.isnan(vv[:-1].astype(np.float64)))
+        flags_diff[1:] |= neq
+    starts = np.flatnonzero(flags_diff)
+    parts = [(int(s), int(e)) for s, e in
+             zip(starts, list(starts[1:]) + [n])]
+    return order, parts
+
+
+def _null_fill_for(t: pa.DataType):
+    if pa.types.is_string(t) or pa.types.is_large_string(t):
+        return ""
+    if pa.types.is_boolean(t):
+        return False
+    if pa.types.is_floating(t):
+        return 0.0
+    if pa.types.is_null(t):
+        raise TypeError("all-null key: comparator fallback")
     return 0
 
 
@@ -104,35 +180,43 @@ class CpuWindowExec(PhysicalPlan):
 
             for (_, _, dirs), items in groups.items():
                 we0 = items[0][1]
-                pvals = [_vals(eval_cpu.evaluate(e, t))
-                         for e in we0.partition_exprs]
-                ovals = [_vals(eval_cpu.evaluate(e, t))
-                         for e in we0.order_exprs]
+                pcv = [eval_cpu.evaluate(e, t)
+                       for e in we0.partition_exprs]
+                ocv = [eval_cpu.evaluate(e, t) for e in we0.order_exprs]
+                pvals = [_vals(v) for v in pcv]
+                ovals = [_vals(v) for v in ocv]
 
                 def key_of(i):
                     return tuple(p[i] for p in pvals), \
                         tuple(o[i] for o in ovals)
 
-                def cmp(i, j):
-                    pa_, oa = key_of(i)
-                    pb, ob = key_of(j)
-                    c = _order_cmp(pa_, pb, [(True, True)] * len(pa_))
-                    if c != 0:
-                        return c
-                    return _order_cmp(oa, ob, dirs or ())
+                try:
+                    order, parts = _fast_order_and_parts(
+                        pcv, pvals, ocv, ovals, dirs, n)
+                    order = list(order)
+                except (pa.ArrowInvalid, pa.ArrowNotImplementedError,
+                        TypeError):
+                    def cmp(i, j):
+                        pa_, oa = key_of(i)
+                        pb, ob = key_of(j)
+                        c = _order_cmp(pa_, pb,
+                                       [(True, True)] * len(pa_))
+                        if c != 0:
+                            return c
+                        return _order_cmp(oa, ob, dirs or ())
 
-                order = sorted(range(n), key=functools.cmp_to_key(cmp))
+                    order = sorted(range(n),
+                                   key=functools.cmp_to_key(cmp))
+                    parts = []
+                    ps = 0
+                    for i in range(1, n + 1):
+                        if i == n or _order_cmp(
+                                key_of(order[i])[0],
+                                key_of(order[ps])[0],
+                                [(True, True)] * len(pvals)) != 0:
+                            parts.append((ps, i))
+                            ps = i
                 final_order = order
-
-                # partition slices and peer groups in sorted space
-                parts: List[Tuple[int, int]] = []
-                ps = 0
-                for i in range(1, n + 1):
-                    if i == n or _order_cmp(
-                            key_of(order[i])[0], key_of(order[ps])[0],
-                            [(True, True)] * len(pvals)) != 0:
-                        parts.append((ps, i))
-                        ps = i
 
                 for name, we in items:
                     out_sorted = self._compute(we, t, order, parts, dirs)
@@ -159,19 +243,24 @@ class CpuWindowExec(PhysicalPlan):
         self._range_dirs = we.order_dirs
         ovals = [_vals(eval_cpu.evaluate(e, t)) for e in we.order_exprs]
 
+        # peer groups once per spec (the per-row while-loop scan was
+        # O(n * peer_size)): one adjacent comparison per sorted row
+        qs_arr = np.zeros(n, dtype=np.int64)
+        qe_arr = np.zeros(n, dtype=np.int64)
+        for ps, pe in parts:
+            gs = ps
+            for i in range(ps + 1, pe + 1):
+                if i == pe or _order_cmp(
+                        tuple(o[order[i]] for o in ovals),
+                        tuple(o[order[i - 1]] for o in ovals),
+                        dirs or ()) != 0:
+                    qs_arr[gs:i] = gs
+                    qe_arr[gs:i] = i
+                    gs = i
+
         def peers(ps, pe, i):
             """peer range [qs, qe) of sorted index i within [ps, pe)."""
-            def same(a, b):
-                return _order_cmp(
-                    tuple(o[order[a]] for o in ovals),
-                    tuple(o[order[b]] for o in ovals), dirs or ()) == 0
-            qs = i
-            while qs > ps and same(qs - 1, i):
-                qs -= 1
-            qe = i + 1
-            while qe < pe and same(qe, i):
-                qe += 1
-            return qs, qe
+            return int(qs_arr[i]), int(qe_arr[i])
 
         out = [None] * n
         if isinstance(fn, (ir.RowNumber, ir.Rank, ir.DenseRank)):
@@ -202,18 +291,28 @@ class CpuWindowExec(PhysicalPlan):
             return out
 
         if isinstance(fn, ir.AggregateExpression):
-            src = _vals(eval_cpu.evaluate(fn.child, t)) \
-                if fn.child is not None else [1] * t.num_rows
+            a_arr = np.empty(n, dtype=np.int64)
+            b_arr = np.empty(n, dtype=np.int64)
             for ps, pe in parts:
                 # partition-level range-scan stats are row-independent:
                 # hoist them out of the per-row loop (O(n) not O(n^2))
                 stats = self._range_stats(frame, ps, pe, ovals, order)
                 for i in range(ps, pe):
-                    a, b = self._bounds(frame, ps, pe, i, peers, ovals,
-                                        order, stats)
-                    window = [src[order[j]] for j in range(a, b + 1)] \
-                        if b >= a else []
-                    out[i] = _agg_py(fn, window)
+                    a_arr[i], b_arr[i] = self._bounds(
+                        frame, ps, pe, i, peers, ovals, order, stats)
+            cv = eval_cpu.evaluate(fn.child, t) \
+                if fn.child is not None else None
+            res = _agg_windows(fn, cv, order, a_arr, b_arr)
+            if res is not None:
+                return res
+            # fallback (non-numeric sources): per-row materialization
+            src = _vals(eval_cpu.evaluate(fn.child, t)) \
+                if fn.child is not None else [1] * t.num_rows
+            for i in range(n):
+                a, b = int(a_arr[i]), int(b_arr[i])
+                window = [src[order[j]] for j in range(a, b + 1)] \
+                    if b >= a else []
+                out[i] = _agg_py(fn, window)
             return out
 
         raise NotImplementedError(type(fn).__name__)
@@ -298,6 +397,9 @@ class CpuWindowExec(PhysicalPlan):
         lo = w + frame.start if frame.start is not None else None
         hi = w + frame.end if frame.end is not None else None
 
+        # the finite run [flo, fhi] is ascending in w-space, so the
+        # first >= lo / last <= hi rows bisect in O(log) instead of the
+        # former O(partition) linear scan per row
         if frame.start is None:
             a = ps
         else:
@@ -305,10 +407,11 @@ class CpuWindowExec(PhysicalPlan):
                 a = fhi + 1        # NaN run satisfies >= any finite bound
             else:
                 a = pe - ntrailing  # trailing null run (pe when none)
-            for j in range(flo, fhi + 1):
-                if wvals[j - ps] >= lo:
+            if flo <= fhi:
+                j = ps + _bisect.bisect_left(wvals, lo, flo - ps,
+                                             fhi - ps + 1)
+                if j <= fhi:
                     a = j
-                    break
         if frame.end is None:
             b = pe - 1
         else:
@@ -316,11 +419,104 @@ class CpuWindowExec(PhysicalPlan):
                 b = flo - 1        # NaN run (in w-space) precedes finites
             else:
                 b = ps + nleading - 1  # leading null run (ps-1 when none)
-            for j in range(fhi, flo - 1, -1):
-                if wvals[j - ps] <= hi:
+            if flo <= fhi:
+                j = ps + _bisect.bisect_right(wvals, hi, flo - ps,
+                                              fhi - ps + 1) - 1
+                if j >= flo:
                     b = j
-                    break
         return a, b
+
+
+def _agg_windows(fn: ir.AggregateExpression, cv, order,
+                 a_arr: np.ndarray, b_arr: np.ndarray):
+    """Vectorized per-row window aggregation over [a, b] bounds —
+    identical results to _agg_py (wrapping i64 sums, Spark NaN/null
+    ranking) computed with prefix sums and ufunc.reduceat instead of
+    materializing every window (the old path was O(rows x frame) in
+    Python).  Returns None for source types it does not cover (the
+    caller falls back to _agg_py)."""
+    n = a_arr.shape[0]
+    empty = b_arr < a_arr
+    if cv is None:                       # COUNT(*)
+        if not isinstance(fn, ir.Count):
+            return None
+        ln = np.where(empty, 0, b_arr - a_arr + 1)
+        return [int(v) for v in ln]
+    data0 = np.asarray(cv.data)
+    if data0.dtype.kind not in "iufb":
+        return None
+    order_np = np.asarray(order, dtype=np.int64)
+    data = data0[order_np]
+    valid = np.asarray(cv.valid, dtype=bool)[order_np]
+    is_f = data.dtype.kind == "f"
+    nanm = (np.isnan(data) & valid) if is_f else np.zeros(n, bool)
+    finite = valid & ~nanm
+
+    aa = np.where(empty, 0, a_arr)
+    bb1 = np.where(empty, 1, b_arr + 1)
+
+    def pdiff(x32):
+        p = np.concatenate([[0], np.cumsum(x32.astype(np.int64))])
+        return np.where(empty, 0, p[bb1] - p[aa])
+
+    def win_reduce(x, ufunc, fill):
+        xpad = np.concatenate([x, np.asarray([fill], dtype=x.dtype)])
+        idx = np.empty(2 * n, dtype=np.int64)
+        idx[0::2] = aa
+        idx[1::2] = bb1
+        if n == 0:
+            return np.asarray([], dtype=x.dtype)
+        r = ufunc.reduceat(xpad, idx)[0::2]
+        return np.where(empty, fill, r)
+
+    cnt_valid = pdiff(valid)
+    if isinstance(fn, ir.Count):
+        return [int(v) for v in cnt_valid]
+    if isinstance(fn, ir.Sum):
+        if is_f:
+            x = np.where(valid, data.astype(np.float64), 0.0)
+            s = win_reduce(x, np.add, 0.0)
+            return [float(v) if c else None
+                    for v, c in zip(s, cnt_valid)]
+        with np.errstate(over="ignore"):
+            x = np.where(valid, data.astype(np.int64), 0)
+            p = np.concatenate([[0], np.cumsum(x)])
+            s = np.where(empty, 0, p[bb1] - p[aa])
+        return [np.int64(v) if c else None
+                for v, c in zip(s, cnt_valid)]
+    if isinstance(fn, ir.Average):
+        x = np.where(valid, data.astype(np.float64), 0.0)
+        s = win_reduce(x, np.add, 0.0)
+        return [(float(v) / int(c)) if c else None
+                for v, c in zip(s, cnt_valid)]
+    if isinstance(fn, (ir.Min, ir.Max)):
+        is_min = isinstance(fn, ir.Min)
+        cnt_fin = pdiff(finite)
+        cnt_nan = pdiff(nanm)
+        if is_f:
+            fill = np.inf if is_min else -np.inf
+            x = np.where(finite, data.astype(np.float64), fill)
+            m = win_reduce(x, np.minimum if is_min else np.maximum,
+                           fill)
+            out = []
+            for v, cf, cn in zip(m, cnt_fin, cnt_nan):
+                if (cn and not is_min) or (cn and is_min and not cf):
+                    out.append(float("nan"))
+                elif cf:
+                    out.append(float(v))
+                else:
+                    out.append(None)
+            return out
+        info = np.iinfo(np.int64)
+        fill = info.max if is_min else info.min
+        x = np.where(finite, data.astype(np.int64), fill)
+        m = win_reduce(x, np.minimum if is_min else np.maximum, fill)
+        if data.dtype.kind == "b":
+            return [bool(v) if c else None
+                    for v, c in zip(m, cnt_fin)]
+        return [data0.dtype.type(v) if c else None
+                for v, c in zip(m, cnt_fin)]
+    return None
 
 
 def _agg_py(fn: ir.AggregateExpression, window: List[Any]):

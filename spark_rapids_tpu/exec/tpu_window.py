@@ -225,10 +225,15 @@ def _range_frame_bounds(ctx: _WinCtx, frame: ir.WindowFrame):
 def _frame_bounds(ctx: _WinCtx, frame: ir.WindowFrame):
     """Inclusive sorted-position bounds [a, b] per row."""
     if frame.kind == "rows":
+        # host-side saturation: offsets are Python ints (Spark longs);
+        # ctx.pos is i32 and an offset beyond +-cap clamps to the same
+        # partition bound as the unclamped value would
+        def sat(off):
+            return max(min(int(off), ctx.cap), -ctx.cap)
         a = ctx.part_start if frame.start is None else \
-            jnp.maximum(ctx.part_start, ctx.pos + frame.start)
+            jnp.maximum(ctx.part_start, ctx.pos + sat(frame.start))
         b = ctx.part_end if frame.end is None else \
-            jnp.minimum(ctx.part_end, ctx.pos + frame.end)
+            jnp.minimum(ctx.part_end, ctx.pos + sat(frame.end))
         return a, b
     if frame.start is None and frame.end == 0:
         return ctx.part_start, ctx.peer_end

@@ -396,13 +396,37 @@ def assemble(plans: List[List[Optional[ChunkPlan]]],
 
 def _unpack_width(bytes_arr: jnp.ndarray, w: int, ncap: int) -> jnp.ndarray:
     """Phase 0: dense bit-unpack of one width's byte buffer to [ncap]
-    uint32 values — reshape + shift/mask + weighted sum, no gathers.
-    Parquet packs LSB-first, which is exactly byte >> bit & 1 order."""
+    uint32 values, no gathers.
+
+    Parquet packs LSB-first (bit k of the stream is byte[k>>3]>>(k&7)),
+    and hybrid bit-packed runs always hold multiples of 8 values, so
+    the byte regions concatenate into one value-aligned bitstring.
+
+    Fast path: 32 consecutive values span exactly w little-endian u32
+    words, so reshaping the words to [ncap/32, w] makes every value j
+    in a group a STATIC (word, shift) slot — w vectorized shift/or ops
+    over [ncap/32] lanes, ~10x less memory traffic than expanding to
+    one byte per bit."""
+    if w == 1:
+        bits = ((bytes_arr[:, None] >>
+                 jnp.arange(8, dtype=jnp.uint8)) & 1)      # [B, 8]
+        return bits.reshape(-1).astype(jnp.uint32)
+    if ncap % 32 == 0 and bytes_arr.shape[0] % 4 == 0:
+        words = (bytes_arr.reshape(-1, 4).astype(jnp.uint32) <<
+                 jnp.arange(0, 32, 8, dtype=jnp.uint32)[None, :]
+                 ).sum(axis=1, dtype=jnp.uint32)           # LE u32 words
+        W = words.reshape(ncap // 32, w)
+        mask = jnp.uint32((1 << w) - 1)
+        outs = []
+        for j in range(32):
+            a, s = (j * w) >> 5, (j * w) & 31
+            v = W[:, a] >> jnp.uint32(s)
+            if s + w > 32:
+                v = v | (W[:, a + 1] << jnp.uint32(32 - s))
+            outs.append(v & mask)
+        return jnp.stack(outs, axis=1).reshape(-1)
     bits = ((bytes_arr[:, None] >>
              jnp.arange(8, dtype=jnp.uint8)) & 1)          # [B, 8]
-    bits = bits.reshape(-1)                                # [ncap * w]
-    if w == 1:
-        return bits.astype(jnp.uint32)
     vals = bits.reshape(ncap, w).astype(jnp.uint32)
     return jnp.sum(vals << jnp.arange(w, dtype=jnp.uint32)[None, :],
                    axis=1)
