@@ -31,8 +31,9 @@ import jax.numpy as jnp
 
 from spark_rapids_tpu import dtypes as dt
 from spark_rapids_tpu.columnar.batch import (DeviceBatch, DeviceColumn,
-                                             bucket_rows, concat_batches)
-from spark_rapids_tpu.exec import sortkeys
+                                             _combined_hints, bucket_rows,
+                                             concat_batches)
+from spark_rapids_tpu.exec import scans, sortkeys
 from spark_rapids_tpu.exec.base import (PhysicalPlan, REQUIRE_SINGLE_BATCH,
                                         TpuExec, timed)
 from spark_rapids_tpu.exec.tpu_basic import compact
@@ -72,7 +73,8 @@ def _key_vals(batch: DeviceBatch, key_names: Sequence[str]) -> List[ColVal]:
     for k in key_names:
         c = batch.column(k)
         out.append(normalize_key(ColVal(c.dtype, c.data, c.validity,
-                                        c.lengths)))
+                                        c.lengths, vbits=c.vbits,
+                                        nonnull=c.nonnull)))
     return out
 
 
@@ -92,13 +94,42 @@ def _concat_colvals(a: ColVal, b: ColVal) -> ColVal:
                       jnp.concatenate([a.lengths, b.lengths]))
     out_dt = a.dtype if a.dtype == b.dtype else dt.promote(a.dtype, b.dtype)
     tgt = out_dt.to_np()
+    vb, nn = _combined_hints([a, b])
     merged = ColVal(out_dt,
                     jnp.concatenate([a.data.astype(tgt),
                                      b.data.astype(tgt)]),
-                    jnp.concatenate([a.validity, b.validity]))
+                    jnp.concatenate([a.validity, b.validity]),
+                    vbits=vb, nonnull=nn)
     # re-normalize: an int->float promotion can introduce nothing new, but
     # float inputs promoted from float32 need canonical NaN/-0.0 again
     return normalize_key(merged)
+
+
+def _narrow_key_codes(combined, pad: int):
+    """Equality-preserving per-row key code for narrow hinted keys.
+
+    When every join key is integer-backed with a vbits range hint and
+    the biased fields + null flags pack into 62 bits, the combined code
+    itself IS the group value — equal keys share a code — so the
+    hash-grouping while_loop (linear-probe scatter claims over a 2x
+    table, the joins' dominant pre-sort cost) is skipped entirely.
+    None -> caller falls back to hash_group_ids."""
+    fields = []
+    total = 0
+    for v in combined:
+        vb = sortkeys.narrow_int_bits(v)
+        if vb is None or vb > 32:
+            return None
+        kf = sortkeys.encode_fields(v, True, True, nullable=True)
+        fields.extend(kf)
+        total += sum(w for w, _ in kf)
+    if not fields or total > 62:         # code << 1 | side fits u64
+        return None
+    code = None
+    for w, vals in fields:               # MSB-first fold
+        code = vals if code is None else \
+            (code << jnp.uint64(w)) | vals
+    return jnp.pad(code, (0, pad))
 
 
 def _join_sort_key(build: DeviceBatch, stream: DeviceBatch,
@@ -126,6 +157,8 @@ def _join_sort_key(build: DeviceBatch, stream: DeviceBatch,
     side = jnp.pad(jnp.concatenate([
         jnp.zeros((cap_b,), dtype=jnp.uint64),
         jnp.ones((cap_s,), dtype=jnp.uint64)]), (0, pad))
+    if seg0 is None:
+        seg0 = _narrow_key_codes(combined, pad)
     if seg0 is None:
         key_groups = [sortkeys.encode_keys(v, True, True)
                       for v in combined]
@@ -173,19 +206,30 @@ class _JoinCtx:
         self.sorted_null_key = jnp.take(null_key, order)
         self.is_build = sorted_exists & (sorted_side == 0)
         self.is_stream = sorted_exists & (sorted_side == 1)
-        # counts/positions fit i32 (cap < 2^31): i64 scatters cost ~14x
-        # under the pair emulation, and an i64 cumsum inside lax.cond
-        # trips the 19.09M scoped-VMEM lowering (PERF.md, exec/scans.py)
+        # counts/positions fit i32 (cap < 2^31), and every per-group
+        # reduction is SCATTER-FREE sorted-space work (cumsum diffs +
+        # one set-scatter of group end positions + a segmented i32
+        # min-scan) — segment_sum/min scatter-adds at full capacity
+        # measured ~100 ms each per 4M rows (PERF.md)
         pos = jnp.arange(cap, dtype=jnp.int32)
+        nxt_new = jnp.concatenate([new_group[1:],
+                                   jnp.ones((1,), jnp.bool_)])
+        end_pos = jnp.zeros((cap,), jnp.int32).at[
+            jnp.where(nxt_new, seg, cap)].set(pos, mode="drop")
+
+        def per_group_count(mask):
+            c = jnp.cumsum(mask.astype(jnp.int32))
+            ce = jnp.take(c, end_pos)
+            return ce - jnp.concatenate([ce[:1] * 0, ce[:-1]])
 
         match_build = self.is_build & ~self.sorted_null_key
-        self.b_count = jax.ops.segment_sum(
-            match_build.astype(jnp.int32), seg, num_segments=cap)
-        self.build_start = jax.ops.segment_min(
-            jnp.where(match_build, pos, _BIG32), seg, num_segments=cap)
+        self.b_count = per_group_count(match_build)
+        run_min = scans.seg_scan(
+            jnp.minimum, new_group,
+            jnp.where(match_build, pos, _BIG32), _BIG32)
+        self.build_start = jnp.take(run_min, end_pos)
         match_stream = self.is_stream & ~self.sorted_null_key
-        self.s_count = jax.ops.segment_sum(
-            match_stream.astype(jnp.int32), seg, num_segments=cap)
+        self.s_count = per_group_count(match_stream)
 
         # per sorted-row match count (stream rows only)
         self.m = jnp.where(self.is_stream & ~self.sorted_null_key,
@@ -234,10 +278,18 @@ def _emit_kernel(build, stream, order, seg0, build_keys, stream_keys,
     total_pairs = incl[-1]
 
     k = jnp.arange(out_cap, dtype=jnp.int32)
-    r = jnp.searchsorted(incl, k, side="right")  # sorted pos of stream row
+    # slot -> sorted stream row: scatter each emitting row's index at
+    # its first output slot, forward-fill with a running max (row
+    # indices ascend along slots).  Replaces searchsorted, whose
+    # log2(cap) binary-search gathers per slot cost ~300 ms at 2M
+    starts = incl - m_out
+    has = m_out > 0
+    marks = jnp.zeros((out_cap,), jnp.int32).at[
+        jnp.where(has, starts, out_cap)].max(
+        jnp.arange(ctx.cap, dtype=jnp.int32), mode="drop")
+    r = jax.lax.cummax(marks)
     r = jnp.clip(r, 0, ctx.cap - 1)
-    prev = jnp.take(incl, r) - jnp.take(m_out, r)
-    j = k - prev
+    j = k - jnp.take(starts, r)
     valid_pair = k < total_pairs
 
     stream_orig = jnp.take(ctx.order, r) - ctx.cap_b
