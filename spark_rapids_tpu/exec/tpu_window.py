@@ -10,10 +10,12 @@ TPU formulation: one total-order lexsort by (partition keys, order keys)
 turns every window primitive into segment arithmetic over sorted rows —
 partition/peer boundaries from key-change detection, ranking functions
 from positions, frame aggregates from prefix sums (sum/count/avg over
-arbitrary row frames via prefix differences) and segmented associative
-scans (running min/max).  This is the "segmented scan kernels" plan of
-SURVEY.md §2d.  Bounded-start min/max and finite range offsets fall back
-to CPU (tagged in overrides) until a sparse-table kernel lands.
+arbitrary row frames via prefix differences), segmented associative
+scans (running min/max), and a log-stride sparse table for bounded-start
+min/max frames (O(1) per row: min/max is idempotent, so two overlapping
+power-of-two blocks cover any range exactly — the cudf rolling-window
+analog of GpuWindowExpression.scala:233-269 `aggregateWindows`).  This
+is the "segmented scan kernels" plan of SURVEY.md §2d.
 """
 
 from __future__ import annotations
@@ -249,6 +251,20 @@ def _prefix(x: jnp.ndarray) -> jnp.ndarray:
     return jnp.concatenate([jnp.zeros((1,), x.dtype), scans.cumsum(x)])
 
 
+def _log_table(op, x: jnp.ndarray, pad, levels: int) -> list:
+    """Log-stride table: level ``lvl`` holds op over x[i : i+2^lvl],
+    padded past the end with ``pad`` (the op's identity)."""
+    cap = x.shape[0]
+    tables = [x]
+    for lvl in range(1, levels):
+        half = 1 << (lvl - 1)
+        prev = tables[-1]
+        tail = jnp.full((min(half, cap),), pad, prev.dtype)
+        shifted = jnp.concatenate([prev[half:], tail])[:cap]
+        tables.append(op(prev, shifted))
+    return tables
+
+
 def _range_sum(x: jnp.ndarray, a: jnp.ndarray, b: jnp.ndarray
                ) -> jnp.ndarray:
     """Subtraction-free per-row range sum over inclusive [a, b].
@@ -264,13 +280,7 @@ def _range_sum(x: jnp.ndarray, a: jnp.ndarray, b: jnp.ndarray
     """
     cap = x.shape[0]
     levels = max(int(np.ceil(np.log2(cap))), 0) + 1 if cap > 1 else 1
-    tables = [x]
-    for lvl in range(1, levels):
-        half = 1 << (lvl - 1)
-        prev = tables[-1]
-        shifted = jnp.concatenate(
-            [prev[half:], jnp.zeros((min(half, cap),), prev.dtype)])[:cap]
-        tables.append(prev + shifted)
+    tables = _log_table(jnp.add, x, 0, levels)
     end = b.astype(jnp.int64) + 1
     p = a.astype(jnp.int64)
     acc = jnp.zeros(a.shape, x.dtype)
@@ -281,6 +291,32 @@ def _range_sum(x: jnp.ndarray, a: jnp.ndarray, b: jnp.ndarray
         acc = acc + jnp.where(take, val, 0)
         p = jnp.where(take, p + size, p)
     return acc
+
+
+def _range_minmax(op, x: jnp.ndarray, a: jnp.ndarray, b: jnp.ndarray,
+                  fill, max_len: Optional[int] = None) -> jnp.ndarray:
+    """Per-row range min/max over inclusive sorted positions [a, b].
+
+    Log-stride sparse table: level ``lvl`` holds op over x[i : i+2^lvl].
+    Because min/max is idempotent, any range [a, b] is covered EXACTLY by
+    the two (overlapping) blocks [a, a+2^k-1] and [b-2^k+1, b] with
+    k = floor(log2(len)) — one gather pair per row, no log-length loop at
+    query time.  ``max_len`` caps the table depth when the frame spec
+    statically bounds the range length (ROWS k PRECEDING .. m FOLLOWING).
+    Frame bounds are pre-clamped to partition bounds, and both query
+    blocks lie inside [a, b], so the table may span partitions freely.
+    """
+    cap = x.shape[0]
+    limit = cap if max_len is None else max(min(max_len, cap), 1)
+    levels = int(np.floor(np.log2(limit))) + 1 if limit > 1 else 1
+    flat = jnp.reshape(jnp.stack(_log_table(op, x, fill, levels)), (-1,))
+    ln = jnp.maximum((b - a + 1).astype(jnp.int32), 1)
+    k = jnp.minimum(31 - lax.clz(ln), levels - 1)
+    size = jnp.left_shift(jnp.int32(1), k)
+    base = k * jnp.int32(cap)
+    lo = base + jnp.clip(a, 0, cap - 1).astype(jnp.int32)
+    hi = base + jnp.clip(b + 1 - size, 0, cap - 1).astype(jnp.int32)
+    return op(jnp.take(flat, lo), jnp.take(flat, hi))
 
 
 def _window_agg(fn: ir.AggregateExpression, ctx: _WinCtx,
@@ -337,24 +373,41 @@ def _window_agg(fn: ir.AggregateExpression, ctx: _WinCtx,
         return ColVal(fn.dtype, s.astype(fn.dtype.to_np()), c > 0)
 
     if isinstance(fn, (ir.Min, ir.Max)):
-        # prefix-only frames (a == part_start): running segmented scan,
-        # indexed at b
+        # prefix frames (a == part_start): running segmented scan indexed
+        # at b.  Bounded-start frames: sparse-table range query (cudf
+        # rolling-window analog, GpuWindowExpression.scala:233-269).
         is_min = isinstance(fn, ir.Min)
         d = fn.dtype
         tgt = d.to_np()
+        bounded = frame.start is not None
+        max_len = None
+        if bounded and frame.kind == "rows" and frame.end is not None:
+            max_len = int(frame.end) - int(frame.start) + 1
+
+        def agg_at_b(op, x, fill):
+            if not bounded:
+                return jnp.take(_seg_scan(op, x, ctx.part_seg, fill), b)
+            if frame.end is None:
+                # b == part_end: suffix running scan (O(cap), no table)
+                suf = _seg_scan(op, x[::-1], ctx.part_seg[::-1], fill)
+                return jnp.take(suf[::-1], a)
+            return _range_minmax(op, x, a, b, fill, max_len)
+
+        def any_at_b(mask):
+            if bounded:
+                P = _prefix(mask.astype(jnp.int32))
+                return (jnp.take(P, b + 1) - jnp.take(P, a)) > 0
+            return jnp.take(
+                _seg_scan(jnp.logical_or, mask, ctx.part_seg, False), b)
+
         if d.is_floating:
             isnan = jnp.isnan(data)
             fill = np.array(np.inf if is_min else -np.inf, dtype=tgt)
             x = jnp.where(valid & ~isnan, data.astype(tgt), fill)
-            run = _seg_scan(jnp.minimum if is_min else jnp.maximum, x,
-                            ctx.part_seg, fill)
-            any_nonnan = _seg_scan(jnp.logical_or, valid & ~isnan,
-                                   ctx.part_seg, False)
-            any_nan = _seg_scan(jnp.logical_or, valid & isnan,
-                                ctx.part_seg, False)
-            run_b = jnp.take(run, b)
-            nonnan_b = jnp.take(any_nonnan, b)
-            nan_b = jnp.take(any_nan, b)
+            run_b = agg_at_b(jnp.minimum if is_min else jnp.maximum, x,
+                             fill)
+            nonnan_b = any_at_b(valid & ~isnan)
+            nan_b = any_at_b(valid & isnan)
             nanv = np.array(np.nan, dtype=tgt)
             if is_min:
                 val = jnp.where(nonnan_b, run_b, nanv)
@@ -363,20 +416,16 @@ def _window_agg(fn: ir.AggregateExpression, ctx: _WinCtx,
             has = nonnan_b | nan_b
             return ColVal(d, jnp.where(has, val, 0), has & (b >= a))
         if d.is_bool:
-            x = jnp.where(valid, data, not is_min)
-            run = _seg_scan(jnp.logical_and if is_min else jnp.logical_or,
-                            x, ctx.part_seg, not is_min)
-            hasv = _seg_scan(jnp.logical_or, valid, ctx.part_seg, False)
-            return ColVal(d, jnp.take(run, b),
-                          jnp.take(hasv, b) & (b >= a))
+            # identity of AND (min) is True, of OR (max) is False
+            x = jnp.where(valid, data, is_min)
+            run_b = agg_at_b(
+                jnp.logical_and if is_min else jnp.logical_or, x, is_min)
+            return ColVal(d, run_b, any_at_b(valid) & (b >= a))
         info = np.iinfo(tgt)
         fill = np.array(info.max if is_min else info.min, dtype=tgt)
         x = jnp.where(valid, data.astype(tgt), fill)
-        run = _seg_scan(jnp.minimum if is_min else jnp.maximum, x,
-                        ctx.part_seg, fill)
-        hasv = _seg_scan(jnp.logical_or, valid, ctx.part_seg, False)
-        out = jnp.take(run, b)
-        has = jnp.take(hasv, b) & (b >= a)
+        out = agg_at_b(jnp.minimum if is_min else jnp.maximum, x, fill)
+        has = any_at_b(valid) & (b >= a)
         return ColVal(d, jnp.where(has, out, 0), has)
 
     raise NotImplementedError(type(fn).__name__)

@@ -292,9 +292,6 @@ def _tag_window(n, conf) -> List[str]:
                 if od is not None and not (od.is_numeric or od.is_temporal):
                     out.append(f"finite RANGE frames need a numeric or "
                                f"temporal order key, got {od.name}")
-        if isinstance(fn, (ir.Min, ir.Max)) and fr.start is not None:
-            out.append("bounded-start min/max window frames not supported "
-                       "on TPU yet")
         if isinstance(fn, ir.AggregateExpression):
             if not isinstance(fn, (ir.Count, ir.Sum, ir.Average, ir.Min,
                                    ir.Max)):

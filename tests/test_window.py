@@ -244,6 +244,91 @@ def test_finite_range_desc_double_order_key():
     assert_tpu_and_cpu_are_equal_collect(q, ignore_order=True)
 
 
+# -- bounded-start min/max frames on device (sparse-table kernel; cudf
+# aggregateWindows analog, GpuWindowExpression.scala:233-269) ----------
+
+def test_sliding_min_max_on_tpu_plan():
+    w = Window.partition_by("k").order_by("o").rows_between(-3, 0)
+
+    def q(s):
+        df = gen_df(s, [int_key_gen, IntGen(32, lo=0, hi=60), long_gen],
+                    ["k", "o", "v"], n=200, seed=31)
+        return df.select("k", "o", F.min("v").over(w).alias("mn"),
+                         F.max("v").over(w).alias("mx"))
+
+    assert_tpu_and_cpu_are_equal_collect(q, ignore_order=True)
+    from tests.parity import with_tpu_session
+    plan = with_tpu_session(lambda s: q(s).explain_string("physical"))
+    assert "TpuWindowExec" in plan, plan
+    assert "CpuWindowExec" not in plan, plan
+
+
+def test_sliding_min_max_two_sided():
+    w = Window.partition_by("k").order_by("o").rows_between(-2, 2)
+    assert_tpu_and_cpu_are_equal_collect(
+        lambda s: gen_df(s, [int_key_gen, IntGen(32, lo=0, hi=60),
+                             long_gen], ["k", "o", "v"], n=150, seed=32)
+        .select("k", "o", F.min("v").over(w).alias("mn"),
+                F.max("v").over(w).alias("mx")),
+        ignore_order=True)
+
+
+def test_sliding_min_max_floats():
+    # double values incl. NaN/null runs: Spark treats NaN as largest
+    w = Window.partition_by("k").order_by("o").rows_between(-3, 1)
+    assert_tpu_and_cpu_are_equal_collect(
+        lambda s: gen_df(s, [int_key_gen, IntGen(32, lo=0, hi=50),
+                             double_gen], ["k", "o", "v"], n=200, seed=33)
+        .select("k", "o", F.min("v").over(w).alias("mn"),
+                F.max("v").over(w).alias("mx")),
+        ignore_order=True)
+
+
+def test_sliding_min_max_bool():
+    from tests.data_gen import boolean_gen
+    w = Window.partition_by("k").order_by("o").rows_between(-2, 0)
+    assert_tpu_and_cpu_are_equal_collect(
+        lambda s: gen_df(s, [int_key_gen, IntGen(32, lo=0, hi=40),
+                             boolean_gen], ["k", "o", "v"], n=150, seed=34)
+        .select("k", "o", F.min("v").over(w).alias("mn"),
+                F.max("v").over(w).alias("mx")),
+        ignore_order=True)
+
+
+def test_running_min_max_bool():
+    # prefix-frame bool min/max (regression: the AND/OR identity was
+    # inverted in the running-scan path)
+    from tests.data_gen import boolean_gen
+    assert_tpu_and_cpu_are_equal_collect(
+        lambda s: gen_df(s, [int_key_gen, IntGen(32, lo=0, hi=40),
+                             boolean_gen], ["k", "o", "v"], n=150, seed=37)
+        .select("k", "o", F.min("v").over(_w()).alias("mn"),
+                F.max("v").over(_w()).alias("mx")),
+        ignore_order=True)
+
+
+def test_bounded_start_unbounded_end_min_max():
+    w = Window.partition_by("k").order_by("o").rows_between(
+        -1, Window.unbounded_following)
+    assert_tpu_and_cpu_are_equal_collect(
+        lambda s: gen_df(s, [int_key_gen, IntGen(32, lo=0, hi=40),
+                             long_gen], ["k", "o", "v"], n=150, seed=35)
+        .select("k", "o", F.min("v").over(w).alias("mn"),
+                F.max("v").over(w).alias("mx")),
+        ignore_order=True)
+
+
+def test_finite_range_min_max():
+    w = Window.partition_by("k").order_by("o").range_between(-5, 5)
+    assert_tpu_and_cpu_are_equal_collect(
+        lambda s: gen_df(s, [int_key_gen,
+                             IntGen(32, lo=0, hi=30, null_prob=0.15),
+                             long_gen], ["k", "o", "v"], n=180, seed=36)
+        .select("k", "o", F.min("v").over(w).alias("mn"),
+                F.max("v").over(w).alias("mx")),
+        ignore_order=True)
+
+
 def test_window_sum_int64_overflow_wraps():
     # SUM over values near int64 max must wrap with pinned Java-long
     # semantics on BOTH engines (VERDICT r2 weak #5: the oracle used a
