@@ -262,7 +262,15 @@ SHUFFLE_TRANSPORT = conf(
     "transport), or 'ici_ring' (like 'ici' but broadcast builds "
     "replicate via collective_permute ring hops — the point-to-point "
     "plane; reference: tag-matched per-peer pulls, "
-    "UCXConnection.scala:385).")
+    "UCXConnection.scala:385), or 'process' (map stages execute in "
+    "spawned executor OS processes that serve their catalogs over the "
+    "TCP transport; the cross-process executor-fleet data plane, "
+    "RapidsShuffleInternalManager.scala:90-186).")
+
+SHUFFLE_PROCESS_EXECUTORS = conf(
+    "spark.rapids.tpu.shuffle.transport.processExecutors", 2,
+    "Number of executor processes the 'process' shuffle transport "
+    "spawns (the executor fleet the RapidsShuffleManager spans).", int)
 
 SHUFFLE_COMPRESSION_CODEC = conf(
     "spark.rapids.tpu.shuffle.compression.codec", "none",

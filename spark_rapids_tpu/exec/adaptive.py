@@ -181,6 +181,21 @@ class _JoinAdaptiveState:
         self.batches: List[List[List]] = [[], []]
         self._refs: List[Dict[int, int]] = [{}, {}]
 
+    # join fragments ship to executor processes (transport='process');
+    # the lock and any pulled device buffers are process-local
+    def __getstate__(self):
+        d = dict(self.__dict__)
+        d.pop("_lock", None)
+        d["specs"] = None
+        d["batches"] = [[], []]
+        d["_refs"] = [{}, {}]
+        return d
+
+    def __setstate__(self, d):
+        import threading
+        self.__dict__.update(d)
+        self._lock = threading.Lock()
+
     def ensure(self) -> None:
         with self._lock:
             return self._ensure_locked()

@@ -90,6 +90,23 @@ class Metrics:
                 self._rows_pending.clear()
             return self._rows_host
 
+    # plans ship to executor processes (shuffle/executor_proc.py); the
+    # lock is process-local state and pending device scalars must be
+    # resolved before crossing the boundary
+    def __getstate__(self):
+        d = dict(self.__dict__)
+        d.pop("_rows_lock", None)
+        if d.get("_rows_pending"):
+            d["_rows_host"] = self.num_output_rows
+            d["_rows_pending"] = []
+        return d
+
+    def __setstate__(self, d):
+        self.__dict__.update(d)
+        self._rows_lock = threading.Lock()
+        if not hasattr(self, "_rows_pending"):
+            self._rows_pending = []
+
     @num_output_rows.setter
     def num_output_rows(self, v) -> None:
         with self._rows_lock:
@@ -104,6 +121,16 @@ class PhysicalPlan:
 
     def __init__(self):
         self.metrics = Metrics()
+
+    def __getstate__(self):
+        # plan fragments ship to executor processes
+        # (shuffle/executor_proc.py); jitted-kernel caches (any _kernel*
+        # attribute) are process-local and must not travel
+        d = dict(self.__dict__)
+        for k, v in list(d.items()):
+            if k.startswith("_") and "kernel" in k:
+                d[k] = {} if isinstance(v, dict) else None
+        return d
 
     @property
     def schema(self) -> Schema:

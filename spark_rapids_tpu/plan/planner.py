@@ -62,8 +62,8 @@ def plan_cpu(node: lp.LogicalPlan, conf: RapidsTpuConf) -> PhysicalPlan:
         # final stage pair around GpuShuffleExchangeExec)
         two_stage = bool(groupings) and (
             conf.get(cfg.AGG_EXCHANGE)
-            or str(conf.get(cfg.SHUFFLE_TRANSPORT)) in ("ici",
-                                                        "ici_ring"))
+            or str(conf.get(cfg.SHUFFLE_TRANSPORT)) in ("ici", "ici_ring",
+                                                        "process"))
         if two_stage and all(g.dtype is not None and not g.dtype.is_nested
                              for g in groupings):
             from spark_rapids_tpu.shuffle import exchange as ex

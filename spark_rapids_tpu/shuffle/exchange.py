@@ -404,6 +404,12 @@ class TpuShuffleExchangeExec(TpuExec):
     executors, fetched back over the transport SPI's tag-matched
     client/server protocol (the full RapidsShuffleManager data plane,
     RapidsShuffleInternalManager.scala:90-186).
+    transport='process': map stages execute in spawned executor OS
+    processes (shuffle/executor_proc.py) that register output in their
+    own catalogs and serve reducer pulls over TcpShuffleTransport, with
+    fetch-failed -> map-stage-retry on executor death — the planned
+    query genuinely crosses process boundaries (the executor-JVM fleet,
+    RapidsShuffleInternalManager.scala:90-186 + UCX.scala:53-533).
     """
 
     def __init__(self, child: PhysicalPlan, partitioning: Partitioning,
@@ -527,6 +533,221 @@ class TpuShuffleExchangeExec(TpuExec):
     # read exercises both the local-catalog and the remote-fetch paths
     _MANAGER_EXECUTORS = 2
 
+    def run_map_stage(self, shuffle_id: int, catalog, n_execs: int,
+                      exec_idx: int) -> List[int]:
+        """Map side of this exchange inside ONE executor process
+        (RapidsCachingWriter.write analog,
+        RapidsShuffleInternalManager.scala:90-155): executes this
+        executor's share of input partitions (map task = input partition,
+        ``p % n_execs == exec_idx``), partitions each batch on device,
+        and registers the slices in the executor-local catalog.  Returns
+        the completed map ids."""
+        n_parts = self.partitioning.num_partitions
+        its = self.children[0].execute()
+        if isinstance(self.partitioning, RangePartitioning):
+            # global-rank bounds need the whole input (same contract as
+            # the in-process path): one map task, on executor 0
+            if exec_idx != 0:
+                return []
+            batches = []
+            for it in its:
+                batches.extend(b for b in it if int(b.num_rows))
+            shares = [(0, batches and [concat_batches(batches)] or [])]
+        else:
+            shares = [(p, its[p]) for p in range(len(its))
+                      if p % n_execs == exec_idx]
+        maps: List[int] = []
+        for map_id, it in shares:
+            rows_seen = 0
+            for batch in it:
+                if not int(batch.num_rows):
+                    continue
+                reordered, counts = self._partition_one(batch, rows_seen)
+                rows_seen += int(batch.num_rows)
+                off = 0
+                for pidx in range(n_parts):
+                    c = int(counts[pidx])
+                    if c:
+                        catalog.register_batch(
+                            shuffle_id, map_id, pidx,
+                            self._slice(reordered, off, c))
+                    off += c
+            maps.append(map_id)
+        return maps
+
+    _process_sids = itertools.count(1)
+
+    def _execute_process(self):
+        """Cross-process data plane: map stages run in spawned executor
+        daemons (shuffle/executor_proc.py) whose catalogs serve reducer
+        pulls over ``TcpShuffleTransport``; this (driver) process runs
+        only the reduce side through the standard client/iterator state
+        machines.  A dead executor surfaces as fetch-failed and its map
+        stage is re-run on a respawned executor (the Spark stage-retry
+        semantics, RapidsShuffleIterator.scala:188)."""
+        import threading
+        from spark_rapids_tpu.shuffle.catalogs import \
+            ShuffleReceivedBufferCatalog
+        from spark_rapids_tpu.shuffle.client import RapidsShuffleClient
+        from spark_rapids_tpu.shuffle.iterator import (
+            RapidsShuffleFetchFailedException, RapidsShuffleIterator,
+            RapidsShuffleTimeoutException, RemoteSource)
+        from spark_rapids_tpu.shuffle.procpool import get_executor_pool
+        from spark_rapids_tpu.shuffle.tcp import TcpShuffleTransport
+
+        n_parts = self.partitioning.num_partitions
+        n_execs = max(int(self.conf_obj.get(
+            cfg.SHUFFLE_PROCESS_EXECUTORS)), 1)
+        state = {"done": False, "sid": None, "pool": None,
+                 "transport": None, "received": None, "maps": {},
+                 "clients": {}, "reads_left": n_parts, "epoch": 0}
+        lock = threading.Lock()
+
+        def client_for(eid: str):
+            """One RapidsShuffleClient per peer (its transfer-tag counter
+            must be shared by every fetch on the connection); rebuilt if
+            the connection died (ShuffleEnv.client_for idiom)."""
+            c = state["clients"].get(eid)
+            if c is not None and getattr(c.connection, "closed", False):
+                c = None
+            if c is None:
+                c = RapidsShuffleClient(
+                    state["transport"].make_client(eid),
+                    state["received"])
+                state["clients"][eid] = c
+            return c
+
+        def submit(pool, exec_idx: int, sid: int):
+            """Ship this exchange's map stage for executor ``exec_idx``;
+            returns completed map ids (raises on task failure)."""
+            h = pool.handle(exec_idx)
+            reply = h.call({"op": "map_stage", "exchange": self,
+                            "shuffle_id": sid, "n_execs": n_execs,
+                            "exec_idx": exec_idx})
+            if not reply.get("ok"):
+                raise RuntimeError(
+                    f"map stage on {h.executor_id} failed: "
+                    f"{reply.get('error')}\n{reply.get('traceback', '')}")
+            return h, reply["maps"]
+
+        def materialize():
+            with lock:
+                if state["done"]:
+                    return
+                pool = get_executor_pool(n_execs)
+                sid = next(self._process_sids)
+                with timed(self.metrics):
+                    # map stages run concurrently across the fleet; each
+                    # handle's pipe is independent
+                    results: List[Any] = [None] * n_execs
+
+                    def run(e):
+                        try:
+                            results[e] = submit(pool, e, sid)
+                        except BaseException as ex:
+                            results[e] = ex
+                    ts = [threading.Thread(target=run, args=(e,))
+                          for e in range(n_execs)]
+                    for t in ts:
+                        t.start()
+                    for t in ts:
+                        t.join()
+                    for e, r in enumerate(results):
+                        if isinstance(r, BaseException):
+                            raise r
+                        h, mids = r
+                        if mids:
+                            state["maps"][h.executor_id] = (e, list(mids))
+                state["sid"] = sid
+                state["pool"] = pool
+                state["received"] = ShuffleReceivedBufferCatalog()
+                state["transport"] = TcpShuffleTransport(
+                    f"driver-{sid}", {"peers": pool.peers()})
+                self.metrics.extra["process_executors"] = \
+                    len(state["maps"]) or n_execs
+                state["done"] = True
+
+        def recover(seen_epoch: int) -> bool:
+            """Re-run map stages lost with dead executors on respawned
+            ones (MapOutputTracker invalidation + stage retry).  Returns
+            True if the caller should retry its read — because this call
+            recovered something, or a concurrent reader already did."""
+            with lock:
+                if state["epoch"] != seen_epoch:
+                    return True
+                pool = state["pool"]
+                live = {h.executor_id for h in
+                        pool.live_handles().values()}
+                lost = [(eid, ei) for eid, (ei, _) in state["maps"].items()
+                        if eid not in live]
+                for eid, exec_idx in lost:
+                    del state["maps"][eid]
+                    h, mids = submit(pool, exec_idx, state["sid"])
+                    if mids:
+                        state["maps"][h.executor_id] = (exec_idx,
+                                                        list(mids))
+                    state["transport"].add_peer(h.executor_id,
+                                                "127.0.0.1", h.port)
+                if lost:
+                    state["epoch"] += 1
+                return bool(lost)
+
+        def release():
+            with lock:
+                state["reads_left"] -= 1
+                if state["reads_left"] != 0:
+                    return
+                # last reader out: free the executor-resident map output
+                # (ShuffleManager.unregisterShuffle analog — the pool is
+                # a long-lived fleet, so blocks must not accumulate)
+                if state["pool"] is not None:
+                    for h in state["pool"].live_handles().values():
+                        h.call({"op": "unregister",
+                                "shuffle_id": state["sid"]})
+                if state["transport"] is not None:
+                    state["transport"].shutdown()
+
+        def reader(pidx: int) -> Iterator[DeviceBatch]:
+            materialize()
+            tables = None
+            for _attempt in range(n_execs + 2):
+                with lock:
+                    sid = state["sid"]
+                    recv = state["received"]
+                    maps = dict(state["maps"])
+                    epoch = state["epoch"]
+                    remotes = [
+                        RemoteSource(eid, client_for(eid), list(mids))
+                        for eid, (_ei, mids) in sorted(maps.items())]
+                if not remotes:
+                    return
+                it = RapidsShuffleIterator(sid, pidx, None, remotes,
+                                           recv, timeout_s=30.0)
+                try:
+                    tables = [t for t in it if t.num_rows]
+                    break
+                except (RapidsShuffleFetchFailedException,
+                        RapidsShuffleTimeoutException):
+                    if not recover(epoch):
+                        raise   # nothing dead: a real protocol failure
+            else:
+                # retries exhausted (crash-looping executor): surface the
+                # failure — an empty yield would silently drop rows
+                raise RapidsShuffleFetchFailedException(
+                    f"shuffle {state['sid']} reduce {pidx}: map stage "
+                    f"retries exhausted after {n_execs + 2} attempts")
+            if not tables:
+                return
+            t = concat_tables(tables, self.schema)
+            with timed(self.metrics):
+                b = from_arrow(t, self.min_bucket)
+            self.metrics.num_output_rows += t.num_rows
+            self.metrics.add_batches()
+            yield b
+
+        return [_ReleasingIter(reader(p), release)
+                for p in range(n_parts)]
+
     def _execute_ici(self):
         """ICI data plane: the whole exchange is ONE lax.all_to_all over
         the device mesh (reference: the UCX peer-to-peer transport,
@@ -609,6 +830,8 @@ class TpuShuffleExchangeExec(TpuExec):
     def execute(self):
         if self.transport in ("ici", "ici_ring"):
             return self._execute_ici()
+        if self.transport == "process":
+            return self._execute_process()
         import threading
         n_parts = self.partitioning.num_partitions
         state = {"done": False, "store": None, "dev_slices": None,

@@ -1,0 +1,114 @@
+"""Executor process daemon: runs shipped map stages, serves their output.
+
+Reference analog: a Spark executor JVM running ShuffleMapTasks whose
+``RapidsCachingWriter`` registers map output in the executor-local
+``ShuffleBufferCatalog``, then serves remote reducer pulls over the
+transport (RapidsShuffleInternalManager.scala:90-155, UCX.scala:53-533).
+Here the "task ship" is a pickled physical subplan over a length-prefixed
+pipe protocol (the pyworker framing idiom), the catalog/server/transport
+stack is the engine's own (shuffle/catalogs.py, shuffle/server.py,
+shuffle/tcp.py), and the parent's reducers pull through the standard
+client/iterator state machines — a planned query genuinely crossing OS
+process boundaries.
+
+Protocol (stdin/stdout, binary): frame := u32 len, len pickle bytes.
+First frame OUT is the hello ``{"port": p, "pid": n}``.  Frames IN:
+``{"op": "map_stage", ...}`` -> runs the exchange's map side against the
+local catalog, replies ``{"ok": True, "maps": [...]}``;
+``{"op": "ping"}`` -> ``{"ok": True}``; ``{"op": "stop"}`` -> exits.
+"""
+
+from __future__ import annotations
+
+import pickle
+import struct
+import sys
+from typing import BinaryIO, Optional
+
+_LEN = struct.Struct("<I")
+
+
+def write_frame(stream: BinaryIO, obj) -> None:
+    payload = pickle.dumps(obj, protocol=pickle.HIGHEST_PROTOCOL)
+    stream.write(_LEN.pack(len(payload)))
+    stream.write(payload)
+    stream.flush()
+
+
+def read_frame(stream: BinaryIO) -> Optional[dict]:
+    hdr = stream.read(_LEN.size)
+    if len(hdr) < _LEN.size:
+        return None
+    (n,) = _LEN.unpack(hdr)
+    payload = stream.read(n)
+    if len(payload) < n:
+        return None
+    return pickle.loads(payload)
+
+
+def _run_map_stage(task: dict, catalog) -> dict:
+    """Execute the shipped exchange's map side for this executor's share
+    of input partitions, registering slices in the local catalog."""
+    exch = task["exchange"]
+    # nested exchanges inside the shipped fragment execute in-process:
+    # an executor must not recursively spawn its own executor fleet
+    def _localize(n):
+        if getattr(n, "transport", None) == "process" and n is not exch:
+            n.transport = "local"
+    exch.foreach(_localize)
+    maps = exch.run_map_stage(
+        shuffle_id=task["shuffle_id"], catalog=catalog,
+        n_execs=task["n_execs"], exec_idx=task["exec_idx"])
+    return {"ok": True, "maps": maps}
+
+
+def main() -> None:
+    import jax
+    if "--cpu" in sys.argv:
+        jax.config.update("jax_platforms", "cpu")
+    executor_id = sys.argv[sys.argv.index("--executor-id") + 1]
+
+    from spark_rapids_tpu.shuffle.catalogs import ShuffleBufferCatalog
+    from spark_rapids_tpu.shuffle.server import ShuffleServer
+    from spark_rapids_tpu.shuffle.tcp import TcpShuffleTransport
+
+    inp = sys.stdin.buffer
+    out = sys.stdout.buffer
+    # anything the shipped plan prints must not corrupt the frame stream
+    sys.stdout = sys.stderr
+
+    catalog = ShuffleBufferCatalog()
+    transport = TcpShuffleTransport(executor_id, {"listen_port": 0})
+    srv_conn = transport.server()
+    ShuffleServer(executor_id, catalog, srv_conn)
+    write_frame(out, {"port": srv_conn.port, "pid": __import__("os").getpid()})
+
+    while True:
+        msg = read_frame(inp)
+        if msg is None or msg.get("op") == "stop":
+            break
+        try:
+            if msg["op"] == "map_stage":
+                write_frame(out, _run_map_stage(msg, catalog))
+            elif msg["op"] == "unregister":
+                catalog.unregister_shuffle(msg["shuffle_id"])
+                write_frame(out, {"ok": True})
+            elif msg["op"] == "stats":
+                with catalog._lock:
+                    nblocks = len(catalog._blocks)
+                write_frame(out, {"ok": True, "blocks": nblocks})
+            elif msg["op"] == "ping":
+                write_frame(out, {"ok": True})
+            else:
+                write_frame(out, {"ok": False,
+                                  "error": f"unknown op {msg['op']!r}"})
+        except Exception as e:   # surface task failures, keep serving
+            import traceback
+            write_frame(out, {"ok": False,
+                              "error": f"{type(e).__name__}: {e}",
+                              "traceback": traceback.format_exc()})
+    transport.shutdown()
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,141 @@
+"""Pool of executor processes for the cross-process shuffle data plane.
+
+Reference analog: the Spark executor fleet the RapidsShuffleManager
+spans — each executor serves its cached map output over the transport
+while the driver tracks MapStatus topology
+(RapidsShuffleInternalManager.scala:163-186).  The pool spawns
+``spark_rapids_tpu.shuffle.executor_proc`` daemons, ships map-stage
+tasks over the pipe protocol, and hands out TCP clients for the reduce
+side.  ``kill(i)`` exists so tests can exercise the fetch-failed ->
+map-stage-retry path (RapidsShuffleIterator.scala:188 semantics).
+"""
+
+from __future__ import annotations
+
+import subprocess
+import sys
+import threading
+from typing import Dict, List, Optional
+
+from spark_rapids_tpu.shuffle.executor_proc import read_frame, write_frame
+
+
+class ExecutorHandle:
+    """One live executor daemon."""
+
+    def __init__(self, executor_id: str, proc: subprocess.Popen, port: int):
+        self.executor_id = executor_id
+        self.proc = proc
+        self.port = port
+        self._lock = threading.Lock()
+
+    @property
+    def alive(self) -> bool:
+        return self.proc.poll() is None
+
+    def call(self, msg: dict) -> dict:
+        """One request/response over the pipe (serialized per handle)."""
+        with self._lock:
+            if not self.alive:
+                return {"ok": False,
+                        "error": f"executor {self.executor_id} is dead"}
+            try:
+                write_frame(self.proc.stdin, msg)
+                reply = read_frame(self.proc.stdout)
+            except (BrokenPipeError, OSError) as e:
+                return {"ok": False, "error": f"pipe: {e}"}
+            if reply is None:
+                return {"ok": False,
+                        "error": f"executor {self.executor_id} closed the "
+                                 "pipe mid-request"}
+            return reply
+
+    def kill(self) -> None:
+        self.proc.kill()
+        self.proc.wait()
+
+
+class ExecutorPool:
+    """Spawns and tracks N executor daemons on this host."""
+
+    def __init__(self, n_execs: int, cpu_jax: bool = True):
+        self.n_execs = n_execs
+        self.cpu_jax = cpu_jax
+        self._handles: List[Optional[ExecutorHandle]] = [None] * n_execs
+        self._lock = threading.Lock()
+
+    def _spawn(self, idx: int) -> ExecutorHandle:
+        eid = f"exec-{idx}"
+        args = [sys.executable, "-m",
+                "spark_rapids_tpu.shuffle.executor_proc",
+                "--executor-id", eid]
+        if self.cpu_jax:
+            args.append("--cpu")
+        proc = subprocess.Popen(args, stdin=subprocess.PIPE,
+                                stdout=subprocess.PIPE)
+        hello = read_frame(proc.stdout)
+        if hello is None:
+            proc.kill()
+            raise RuntimeError(f"executor {eid} died before hello")
+        return ExecutorHandle(eid, proc, hello["port"])
+
+    def handle(self, idx: int) -> ExecutorHandle:
+        """The executor at ``idx``, respawning it if dead (Spark's
+        executor-replacement; a respawned executor has an empty catalog,
+        so callers must re-run lost map stages)."""
+        with self._lock:
+            h = self._handles[idx]
+            if h is None or not h.alive:
+                h = self._spawn(idx)
+                self._handles[idx] = h
+            return h
+
+    def live_handles(self) -> Dict[int, ExecutorHandle]:
+        with self._lock:
+            return {i: h for i, h in enumerate(self._handles)
+                    if h is not None and h.alive}
+
+    def kill(self, idx: int) -> None:
+        """Test hook: hard-kill one executor (fetch-failed injection)."""
+        with self._lock:
+            h = self._handles[idx]
+        if h is not None:
+            h.kill()
+
+    def peers(self) -> Dict[str, tuple]:
+        with self._lock:
+            return {h.executor_id: ("127.0.0.1", h.port)
+                    for h in self._handles if h is not None and h.alive}
+
+    def shutdown(self) -> None:
+        with self._lock:
+            handles, self._handles = self._handles, \
+                [None] * self.n_execs
+        for h in handles:
+            if h is not None and h.alive:
+                h.call({"op": "stop"})
+                h.proc.wait(timeout=5)
+
+
+_pool: Optional[ExecutorPool] = None
+_pool_lock = threading.Lock()
+
+
+def get_executor_pool(n_execs: int) -> ExecutorPool:
+    """Process-wide pool (executor-singleton idiom, GpuShuffleEnv.scala:26).
+    Grows if a larger fleet is requested."""
+    global _pool
+    with _pool_lock:
+        if _pool is None or _pool.n_execs < n_execs:
+            old, _pool = _pool, ExecutorPool(n_execs)
+            if old is not None:
+                old.shutdown()
+        return _pool
+
+
+def reset_executor_pool() -> None:
+    global _pool
+    with _pool_lock:
+        if _pool is not None:
+            _pool.shutdown()
+        _pool = None
