@@ -207,12 +207,28 @@ class _JoinAdaptiveState:
         per_side_sizes = []
         per_side_rows = []
         for side, child in enumerate(self.children):
+            # ICI-plane reducers hand out batches committed to their
+            # owning mesh device; the adaptive reader re-slices them
+            # across partitions, so colocate at pull time (the cost the
+            # reference's AQE pays as remote map-output fetches)
+            colocate = getattr(child, "transport", None) in ("ici",
+                                                             "ici_ring")
+            tgt = jax.devices()[0] if colocate else None
             sizes: List[int] = []
             rows: List[int] = []
             handles: List[List] = []
             for it in child.execute():
                 bs = [b for b in it]
-                sizes.append(sum(int(b.nbytes()) for b in bs))
+                if colocate:
+                    bs = [b if tgt in b.columns[0].data.devices()
+                          else jax.device_put(b, tgt) for b in bs]
+                # effective bytes = occupancy-scaled: capacity padding
+                # (ICI shards all share the mesh-shard capacity; buckets
+                # pad up to 2x) must not mask real size skew
+                sizes.append(sum(
+                    int(b.nbytes() * (int(b.num_rows) /
+                                      max(int(b.capacity), 1)))
+                    for b in bs))
                 rows.append(sum(int(b.num_rows) for b in bs))
                 handles.append([register_or_hold(b) for b in bs])
             self.batches[side] = handles
@@ -339,11 +355,6 @@ def wrap_join_children(left: PhysicalPlan, right: PhysicalPlan, how: str,
     from spark_rapids_tpu.shuffle.exchange import (HashPartitioning,
                                                    TpuShuffleExchangeExec)
     if not conf_obj.get(cfg.ADAPTIVE_ENABLED):
-        return left, right
-    # the ICI plane keeps reducer batches committed to their owning mesh
-    # device; the adaptive reader's cross-partition coalesce would force
-    # cross-device concats, so exchanges ride ICI un-wrapped
-    if str(conf_obj.get(cfg.SHUFFLE_TRANSPORT)) in ("ici", "ici_ring"):
         return left, right
     if not (isinstance(left, TpuShuffleExchangeExec)
             and isinstance(right, TpuShuffleExchangeExec)

@@ -474,6 +474,161 @@ def test_planned_distributed_window_parity():
     assert_tables_equal(cpu, tpu, ignore_order=True)
 
 
+def test_planned_distributed_generate_parity():
+    """Generate (explode) downstream of an ICI hash exchange: rows fan
+    out per shard after the collective moves them."""
+    rng = np.random.default_rng(21)
+    n = 240
+    tbl = pa.table({
+        "k": pa.array(rng.integers(0, 10, n), type=pa.int64()),
+        "arr": pa.array([[int(x) for x in
+                          rng.integers(0, 50, rng.integers(0, 4))]
+                         if i % 7 else None for i in range(n)],
+                        type=pa.list_(pa.int64())),
+    })
+
+    def q(s):
+        from spark_rapids_tpu import col
+        df = s.create_dataframe(tbl, num_partitions=3)
+        return (df.repartition(4, col("k"))
+                .select("k", F.explode("arr").alias("x")).collect())
+
+    cpu = _cpu_collect(q)
+    tpu, captured = _ici_collect(q)
+    _assert_has_ici_exchange(captured)
+    from spark_rapids_tpu.exec.generate import TpuGenerateExec
+    gens = []
+    captured[-1].plan.foreach(
+        lambda x: gens.append(x) if isinstance(x, TpuGenerateExec)
+        else None)
+    assert gens, captured[-1].plan.tree_string()
+    assert_tables_equal(cpu, tpu, ignore_order=True)
+
+
+def test_planned_distributed_expand_parity():
+    """Expand (N projections per row) over ICI-exchanged shards,
+    composed at the physical level (no frontend constructs Expand yet):
+    exchange -> expand -> host, vs a pyarrow oracle."""
+    import jax
+    from jax.sharding import Mesh
+    from spark_rapids_tpu.columnar.batch import to_arrow
+    from spark_rapids_tpu.config import RapidsTpuConf
+    from spark_rapids_tpu.exec.cpu import CpuScanExec
+    from spark_rapids_tpu.exec.tpu_basic import (HostToDeviceExec,
+                                                 TpuExpandExec)
+    from spark_rapids_tpu.plan.logical import Field, Schema
+    from spark_rapids_tpu.shuffle.exchange import (HashPartitioning,
+                                                   TpuShuffleExchangeExec)
+
+    rng = np.random.default_rng(22)
+    n = 300
+    tbl = pa.table({
+        "k": pa.array(rng.integers(0, 8, n), type=pa.int64()),
+        "v": pa.array(rng.integers(0, 100, n), type=pa.int64()),
+    })
+    conf = RapidsTpuConf({"spark.rapids.tpu.shuffle.transport": "ici"})
+    h2d = HostToDeviceExec(CpuScanExec(tbl, num_partitions=3))
+    names = ["k", "v"]
+    dts = [f.dtype for f in h2d.schema.fields]
+
+    def b(name):
+        return ir.bind(ir.UnresolvedAttribute(name), names, dts,
+                       [True, True])
+    exch = TpuShuffleExchangeExec(h2d, HashPartitioning(4, [b("k")]),
+                                  conf)
+    lit0 = ir.Literal(0, dt.INT64)
+    lit1 = ir.Literal(1, dt.INT64)
+    out_schema = Schema([Field("k", dt.INT64, True),
+                         Field("v", dt.INT64, True),
+                         Field("gid", dt.INT64, False)])
+    expand = TpuExpandExec(exch, [[b("k"), b("v"), lit0],
+                                  [b("k"), b("v"), lit1]], out_schema)
+    got = []
+    for it in expand.execute():
+        got.extend(to_arrow(x) for x in it)
+    merged = pa.concat_tables([g for g in got if g.num_rows])
+    assert merged.num_rows == 2 * n
+    exp = pa.concat_tables([
+        tbl.append_column("gid", pa.array(np.zeros(n, np.int64))),
+        tbl.append_column("gid", pa.array(np.ones(n, np.int64)))])
+    keys = [("k", "ascending"), ("v", "ascending"), ("gid", "ascending")]
+    assert merged.sort_by(keys).equals(exp.sort_by(keys))
+
+
+def test_planned_distributed_global_limit():
+    """Global LIMIT over ICI-exchanged partitions (no sort): row count
+    is exact and every row comes from the full result set."""
+    rng = np.random.default_rng(23)
+    n = 500
+    tbl = pa.table({
+        "k": pa.array(rng.integers(0, 37, n), type=pa.int64()),
+        "v": pa.array(rng.integers(0, 1000, n), type=pa.int64()),
+    })
+
+    def q(s):
+        df = s.create_dataframe(tbl, num_partitions=4)
+        return (df.group_by("k").agg(F.sum("v").alias("sv"))
+                .limit(11).collect())
+
+    def full(s):
+        df = s.create_dataframe(tbl, num_partitions=4)
+        return df.group_by("k").agg(F.sum("v").alias("sv")).collect()
+
+    tpu, captured = _ici_collect(q)
+    _assert_has_ici_exchange(captured)
+    assert tpu.num_rows == 11
+    allowed = set(zip(_cpu_collect(full).column("k").to_pylist(),
+                      _cpu_collect(full).column("sv").to_pylist()))
+    got = set(zip(tpu.column("k").to_pylist(),
+                  tpu.column("sv").to_pylist()))
+    assert got <= allowed and len(got) == 11
+
+
+def test_planned_distributed_aqe_skew_split():
+    """AQE skew-split over the ICI plane: the adaptive join reader
+    splits the hot partition into per-map slices while the other side
+    replicates, with full parity."""
+    from spark_rapids_tpu.exec.adaptive import (SkewSplitSpec,
+                                                TpuAdaptiveJoinReaderExec)
+    rng = np.random.default_rng(24)
+    n = 20_000
+    keys = np.where(rng.random(n) < 0.6, 7,
+                    rng.integers(0, 300, n)).astype(np.int64)
+    fact = pa.table({"k": keys,
+                     "v": pa.array(rng.integers(0, 100, n))})
+    dim = pa.table({"k2": np.arange(300, dtype=np.int64),
+                    "w": pa.array(rng.integers(0, 9, 300))})
+    conf = {
+        "spark.rapids.tpu.sql.autoBroadcastJoinThreshold": -1,
+        "spark.rapids.tpu.sql.shuffle.partitions": 8,
+        "spark.rapids.tpu.sql.adaptive.advisoryPartitionSizeInBytes":
+            64 << 10,
+        "spark.rapids.tpu.sql.adaptive.skewJoin."
+        "skewedPartitionThresholdInBytes": 32 << 10,
+    }
+
+    def q(s):
+        from spark_rapids_tpu import col
+        f = s.create_dataframe(fact, num_partitions=4)
+        d = s.create_dataframe(dim)
+        return (f.join(d, col("k") == col("k2"))
+                .group_by("k").agg(F.sum("v").alias("sv"),
+                                   F.count("*").alias("c")).collect())
+
+    cpu = _cpu_collect(q)
+    tpu, captured = _ici_collect(q, conf)
+    _assert_has_ici_exchange(captured)
+    readers = []
+    captured[-1].plan.foreach(
+        lambda x: readers.append(x)
+        if isinstance(x, TpuAdaptiveJoinReaderExec) else None)
+    assert readers, captured[-1].plan.tree_string()
+    specs = readers[0].state.specs
+    assert specs and any(isinstance(s[0], SkewSplitSpec) for s in specs), \
+        specs
+    assert_tables_equal(cpu, tpu, ignore_order=True)
+
+
 def test_planned_distributed_sort_then_limit():
     """ORDER BY + LIMIT over the distributed sort keeps global order
     (limit drains range partitions in partition order)."""
