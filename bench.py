@@ -161,10 +161,15 @@ def _build_device_pipeline(root: str):
     paths = sorted(os.path.join(root, p) for p in os.listdir(root))
     t0 = time.perf_counter()
     pfs = [papq.ParquetFile(p) for p in paths]
-    schema = Schema.from_arrow(pfs[0].schema_arrow)
+    full = Schema.from_arrow(pfs[0].schema_arrow)
     sources = [(pf, p, rg) for pf, p in zip(pfs, paths)
                for rg in range(pf.metadata.num_row_groups)]
-    wanted = [f.name for f in schema.fields]
+    # the planner's column pruning (plan/optimizer.py) narrows the scan
+    # to the query's referenced columns; the loop harness decodes the
+    # same pruned set
+    wanted = ["ss_item_sk", "ss_quantity", "ss_sales_price",
+              "ss_ext_sales_price"]
+    schema = Schema([full.field(c) for c in wanted])
     plans = []
     for c in wanted:
         col_plans = []

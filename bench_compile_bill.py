@@ -1,0 +1,66 @@
+"""Measure the full-suite compile bill (PERF.md "compile bill").
+
+Runs every TPC-DS-like query once on the attached device with
+``SRT_COMPILE_LOG`` instrumentation enabled (exec/kernel_cache.py):
+each first (kernel, arg-shape) call is timed — on the tunneled runtime
+that wall is dominated by trace + remote XLA compile.  Prints one JSON
+line: total queries, wall, compile events, total compile seconds, and
+the top-10 most expensive kernels.
+
+Run: ``python bench_compile_bill.py [--sf 0.002]`` (set JAX_PLATFORMS
+and the device as usual; the driver's bench chip is the target).
+"""
+
+import json
+import os
+import sys
+import time
+
+os.environ.setdefault("SRT_COMPILE_LOG", "1")
+
+
+def main() -> None:
+    sf = 0.002
+    if "--sf" in sys.argv:
+        sf = float(sys.argv[sys.argv.index("--sf") + 1])
+
+    from spark_rapids_tpu import TpuSparkSession
+    from spark_rapids_tpu.bench import tpcds
+    from spark_rapids_tpu.exec import kernel_cache as kc
+
+    data = tpcds.generate(sf, seed=13)
+    s = TpuSparkSession(
+        {"spark.rapids.tpu.sql.variableFloatAgg.enabled": True})
+    tables = tpcds.setup(s, data)
+
+    t0 = time.perf_counter()
+    errors = {}
+    for name in sorted(tpcds.QUERIES, key=lambda q: int(q[1:])):
+        try:
+            tpcds.QUERIES[name](tables).collect()
+        except Exception as e:   # report, keep measuring the rest
+            errors[name] = f"{type(e).__name__}: {e}"
+    wall = time.perf_counter() - t0
+
+    log = kc.dump_compile_log()
+    total_compile = sum(dt for _, _, dt in log)
+    by_kernel = {}
+    for key, _, dt in log:
+        by_kernel[key] = by_kernel.get(key, 0.0) + dt
+    top = sorted(by_kernel.items(), key=lambda kv: -kv[1])[:10]
+
+    print(json.dumps({
+        "metric": "TPC-DS 99-query compile bill "
+                  f"(sf={sf}, one fresh process)",
+        "queries": len(tpcds.QUERIES),
+        "errors": errors,
+        "suite_wall_s": round(wall, 1),
+        "compile_events": len(log),
+        "compile_total_s": round(total_compile, 1),
+        "top10": [{"kernel": k[:100], "s": round(v, 1)}
+                  for k, v in top],
+    }), flush=True)
+
+
+if __name__ == "__main__":
+    main()

@@ -138,10 +138,10 @@ def _build_join_pipeline(fact, items, warehouses):
     import jax.numpy as jnp
     from spark_rapids_tpu.columnar.batch import (bucket_rows, from_arrow,
                                                  DeviceBatch)
-    from spark_rapids_tpu.exec import sortkeys
-    from spark_rapids_tpu.exec.tpu_join import (_count_kernel,
-                                                _emit_kernel,
-                                                _join_sort_key)
+    from spark_rapids_tpu.exec.tpu_join import (_PROBE_MAX_BITS,
+                                                _probe_code_bits,
+                                                _probe_count_kernel,
+                                                _probe_emit_unique_kernel)
     from spark_rapids_tpu.exec.tpu_aggregate import (
         finalize_aggregate, make_spec, update_aggregate)
     from spark_rapids_tpu.expr import ir
@@ -150,52 +150,59 @@ def _build_join_pipeline(fact, items, warehouses):
     ib = from_arrow(items)
     wb = from_arrow(warehouses)
 
-    def join_once(build: DeviceBatch, stream: DeviceBatch,
-                  bkey: str, skey: str, out_cap: int) -> DeviceBatch:
-        """Inner join with the execs' kernels at a STATIC emit cap (the
-        engine sizes it per batch via the count kernel; the loop
-        harness pre-sizes it once the same way)."""
+    def _renamed(build, stream, bkey, skey):
         bnames = [f"__b{i}" for i in range(build.num_cols)]
         snames = [f"__s{i}" for i in range(stream.num_cols)]
         bk = [bnames[build.names.index(bkey)]]
         sk = [snames[stream.names.index(skey)]]
         b2 = DeviceBatch(bnames, build.columns, build.num_rows)
         s2 = DeviceBatch(snames, stream.columns, stream.num_rows)
-        seg0, packed = _join_sort_key(b2, s2, bk, sk)[3:5]
-        order = sortkeys.shared_lexsort(jnp.reshape(packed, (1, -1)))
-        out = _emit_kernel(b2, s2, order, seg0, bk, sk, "inner",
-                           out_cap, bnames, snames, False)
+        return b2, s2, bk, sk, bnames, snames
+
+    def join_once(build: DeviceBatch, stream: DeviceBatch,
+                  bkey: str, skey: str, out_cap: int,
+                  variant: str) -> DeviceBatch:
+        """Inner join with the execs' direct-address probe kernels at a
+        STATIC emit cap and host-chosen variant (the engine sizes and
+        picks per batch via the probe count kernel; the loop harness
+        pre-decides once the same way).  The dims' keys are unique, so
+        this is the same unique fast path the planner's join execs
+        take."""
+        b2, s2, bk, sk, bnames, snames = _renamed(build, stream, bkey,
+                                                  skey)
+        bits = _probe_code_bits(b2, s2, bk, sk)
+        assert bits is not None and bits <= _PROBE_MAX_BITS, bits
+        out = _probe_emit_unique_kernel(b2, s2, bk, sk, variant,
+                                        out_cap, bnames, snames, False,
+                                        bits)
         names = (stream.names +
                  [f"b_{n}" for n in build.names])
         return DeviceBatch(names, out.columns, out.num_rows)
 
     # static emit caps: count once on host (exactly what the engine's
-    # count kernel does per batch)
+    # probe count kernel does per batch)
     def _count(build, stream, bkey, skey):
-        bnames = [f"__b{i}" for i in range(build.num_cols)]
-        snames = [f"__s{i}" for i in range(stream.num_cols)]
-        bk = [bnames[build.names.index(bkey)]]
-        sk = [snames[stream.names.index(skey)]]
-        b2 = DeviceBatch(bnames, build.columns, build.num_rows)
-        s2 = DeviceBatch(snames, stream.columns, stream.num_rows)
+        b2, s2, bk, sk, _, _ = _renamed(build, stream, bkey, skey)
+        bits = _probe_code_bits(b2, s2, bk, sk)
+        assert bits is not None and bits <= _PROBE_MAX_BITS, bits
 
-        # ONE jitted program: running this eagerly dispatches hundreds
-        # of individual ops through the tunnel (~minutes of wall each)
         def f(b2, s2):
-            seg0, packed = _join_sort_key(b2, s2, bk, sk)[3:5]
-            order = sortkeys.shared_lexsort(jnp.reshape(packed, (1, -1)))
-            return _count_kernel(b2, s2, order, seg0, bk, sk, "inner")
-        return int(jax.jit(f)(b2, s2))
+            return _probe_count_kernel(b2, s2, bk, sk, "inner", bits)
+        total, maxm = jax.jit(f)(b2, s2)
+        assert int(maxm) <= 1, int(maxm)
+        return int(total)
 
     n1 = _count(ib, fb, "item_sk", "item_sk")
-    cap1 = bucket_rows(n1)
+    v1 = "inner_inplace" if n1 == int(fb.num_rows) else "inner"
+    cap1 = fb.capacity if v1 == "inner_inplace" else bucket_rows(n1)
 
     def stage1(f_in):
-        return join_once(ib, f_in, "item_sk", "item_sk", cap1)
+        return join_once(ib, f_in, "item_sk", "item_sk", cap1, v1)
 
     j1_probe = jax.jit(stage1)(fb)
     n2 = _count(wb, j1_probe, "warehouse_sk", "warehouse_sk")
-    cap2 = bucket_rows(n2)
+    v2 = "inner_inplace" if n2 == n1 else "inner"
+    cap2 = cap1 if v2 == "inner_inplace" else bucket_rows(n2)
 
     schema_names = None
     g = ir.UnresolvedAttribute("b_category")
@@ -203,7 +210,7 @@ def _build_join_pipeline(fact, items, warehouses):
 
     def pipeline(f_in):
         j1 = stage1(f_in)
-        j2 = join_once(wb, j1, "warehouse_sk", "warehouse_sk", cap2)
+        j2 = join_once(wb, j1, "warehouse_sk", "warehouse_sk", cap2, v2)
         names = j2.names
         dtypes = [c.dtype for c in j2.columns]
         nullables = [True] * len(names)

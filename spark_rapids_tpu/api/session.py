@@ -126,6 +126,9 @@ class TpuSparkSession:
 
     # -- planning & execution ----------------------------------------------
     def _plan_physical(self, plan: lp.LogicalPlan) -> OverrideResult:
+        if self.conf.get(cfg.COLUMN_PRUNING):
+            from spark_rapids_tpu.plan.optimizer import prune_columns
+            plan = prune_columns(plan)
         cpu_plan = plan_cpu(plan, self.conf)
         result = TpuOverrides.apply(cpu_plan, self.conf)
         if self.conf.test_enabled:

@@ -267,6 +267,13 @@ SHUFFLE_TRANSPORT = conf(
     "TCP transport; the cross-process executor-fleet data plane, "
     "RapidsShuffleInternalManager.scala:90-186).")
 
+COLUMN_PRUNING = conf(
+    "spark.rapids.tpu.sql.columnPruning.enabled", True,
+    "Prune unreferenced columns out of file and in-memory scans before "
+    "physical planning (Catalyst ColumnPruning analog; on TPU this "
+    "skips whole device parquet column-chunk decodes and HBM uploads).",
+    bool)
+
 SHUFFLE_PROCESS_EXECUTORS = conf(
     "spark.rapids.tpu.shuffle.transport.processExecutors", 2,
     "Number of executor processes the 'process' shuffle transport "

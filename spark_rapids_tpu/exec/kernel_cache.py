@@ -91,6 +91,71 @@ def exprs_sig(exprs) -> Any:
     return tuple(expr_sig(e) for e in exprs)
 
 
+# -- compile-bill instrumentation (PERF.md "compile bill") ------------------
+# When SRT_COMPILE_LOG is set, every kernel call whose (key, arg-shape)
+# combination is new is timed and recorded — jax.jit compiles lazily per
+# shape bucket, so the first call's wall is trace+compile (+ one async
+# dispatch, negligible on the tunneled runtime).  dump_compile_log()
+# returns [(kernel key repr, shape sig repr, seconds)].
+import os as _os
+import time as _time
+
+COMPILE_LOG_ENABLED = bool(_os.environ.get("SRT_COMPILE_LOG"))
+_COMPILE_LOG: list = []
+
+
+def _shape_sig(args, kwargs):
+    def leaf_sig(x):
+        shp = getattr(x, "shape", None)
+        dty = getattr(x, "dtype", None)
+        return (tuple(shp), str(dty)) if shp is not None else repr(x)[:32]
+    leaves, treedef = jax.tree_util.tree_flatten((args, kwargs))
+    return (str(treedef), tuple(leaf_sig(x) for x in leaves))
+
+
+def _instrument(key, fn):
+    seen = set()
+    lock = threading.Lock()
+
+    def wrapped(*args, **kwargs):
+        sig = _shape_sig(args, kwargs)
+        with lock:
+            first = sig not in seen
+            if first:
+                seen.add(sig)
+        if not first:
+            return fn(*args, **kwargs)
+        t0 = _time.perf_counter()
+        out = fn(*args, **kwargs)
+        dt_ = _time.perf_counter() - t0
+        with _LOCK:
+            _COMPILE_LOG.append((repr(key)[:160], repr(sig[1])[:120],
+                                 dt_))
+        return out
+    return wrapped
+
+
+def dump_compile_log() -> list:
+    with _LOCK:
+        return list(_COMPILE_LOG)
+
+
+def _with_oom_recovery(fn):
+    """Retry a kernel dispatch once after an HBM-exhaustion error, with
+    the spill catalog's synchronous device-tier eviction in between (the
+    RMM onAllocFailure retry loop, DeviceMemoryEventHandler.scala:42-70,
+    restructured for an allocator the engine doesn't own)."""
+    def run(*args, **kwargs):
+        try:
+            return fn(*args, **kwargs)
+        except Exception as e:
+            from spark_rapids_tpu.mem import spill as _spill
+            if not _spill.hbm_oom_recover(e):
+                raise
+            return fn(*args, **kwargs)
+    return run
+
+
 def get_kernel(key: Any, builder: Callable[[], Callable],
                **jit_kwargs) -> Callable:
     """Return the cached jitted kernel for ``key``, building+jitting via
@@ -100,7 +165,9 @@ def get_kernel(key: Any, builder: Callable[[], Callable],
         if fn is not None:
             _CACHE.move_to_end(key)
             return fn
-    fn = jax.jit(builder(), **jit_kwargs)
+    fn = _with_oom_recovery(jax.jit(builder(), **jit_kwargs))
+    if COMPILE_LOG_ENABLED:
+        fn = _instrument(key, fn)
     with _LOCK:
         cur = _CACHE.setdefault(key, fn)
         if len(_CACHE) > _MAX_ENTRIES:

@@ -347,6 +347,189 @@ def _semi_kernel(build, stream, order, seg0, build_keys, stream_keys,
 
 
 
+# ---------------------------------------------------------------------------
+# Direct-address probe path (narrow keys)
+# ---------------------------------------------------------------------------
+#
+# When every join key is integer-backed with a narrow vbits range hint,
+# the biased key fields pack into one u32 code and the hash table of
+# cudf's hash join (GpuHashJoin.scala:193-326) becomes a DENSE
+# direct-address table: one i32 scatter per build row, ONE gather per
+# stream row to find its match range.  This removes the combined-space
+# sort entirely — the sort-merge path's dominant cost is the (cap_b +
+# cap_s)-sized sort plus ~10 bookkeeping gathers per row; the probe path
+# pays 1-2 table gathers per stream row and per-output-column gathers
+# only.  Falls back to the sort path for wide/float/string keys or full
+# outer joins.
+
+_PROBE_MAX_BITS = 22    # direct table <= 4M entries (2 x 16 MiB i32)
+
+
+def _probe_code_bits(build: DeviceBatch, stream: DeviceBatch,
+                     build_keys: Sequence[str],
+                     stream_keys: Sequence[str]) -> Optional[int]:
+    """Static (host-side) width of the packed direct-address code, or
+    None when the narrow encoding does not apply.  Mirrors the field
+    widths `_narrow_key_codes` produces (encode_fields with
+    nullable=True: 1 null bit + vbits value bits per key)."""
+    total = 0
+    for kb, ks in zip(build_keys, stream_keys):
+        b, s = build.column(kb), stream.column(ks)
+        for c in (b, s):
+            if c.dtype.is_string or c.dtype.is_floating or \
+                    c.dtype.is_bool or c.dtype.is_nested or \
+                    c.dtype.is_temporal:
+                return None
+        out_dt = b.dtype if b.dtype == s.dtype \
+            else dt.promote(b.dtype, s.dtype)
+        if not out_dt.is_numeric or out_dt.is_floating:
+            return None
+        vb, _nn = _combined_hints([b, s])
+        npd = np.dtype(out_dt.to_np())
+        vb = min(vb or 64, npd.itemsize * 8)
+        if vb > 32 or vb >= 64:
+            return None
+        total += vb + 1                     # null flag + biased value
+    return total if total else None
+
+
+def _probe_tables(build: DeviceBatch, stream: DeviceBatch,
+                  build_keys: Sequence[str], stream_keys: Sequence[str],
+                  bits: int):
+    """Shared probe-side prologue: per-side u32 codes, valid masks, and
+    the dense per-code build count table."""
+    bk = _key_vals(build, build_keys)
+    sk = _key_vals(stream, stream_keys)
+    combined = [_concat_colvals(b, s) for b, s in zip(bk, sk)]
+    code = _narrow_key_codes(combined, 0)
+    null_key = jnp.zeros((code.shape[0],), dtype=jnp.bool_)
+    for v in combined:
+        null_key = null_key | ~v.validity
+    cap_b = build.capacity
+    code = code.astype(jnp.uint32)
+    T = 1 << bits
+    bcode = code[:cap_b].astype(jnp.int32)
+    scode = code[cap_b:].astype(jnp.int32)
+    bvalid = build.row_mask() & ~null_key[:cap_b]
+    svalid = stream.row_mask() & ~null_key[cap_b:]
+    cnt = jnp.zeros((T,), jnp.int32).at[
+        jnp.where(bvalid, bcode, T)].add(1, mode="drop")
+    m = jnp.where(svalid, jnp.take(cnt, scode), 0)
+    return bcode, scode, bvalid, svalid, cnt, m
+
+
+def _probe_count_kernel(build, stream, build_keys, stream_keys, how,
+                        bits):
+    """(total output rows i64, max per-stream-row match count i32)."""
+    _, _, _, _, _, m = _probe_tables(build, stream, build_keys,
+                                     stream_keys, bits)
+    m_out = jnp.where(stream.row_mask(), jnp.maximum(m, 1), 0) \
+        if how == "left" else m
+    return jnp.sum(m_out, dtype=jnp.int64), jnp.max(m)
+
+
+def _probe_emit_unique_kernel(build, stream, build_keys, stream_keys,
+                              how, out_cap, build_names, stream_names,
+                              build_first_in_output, bits):
+    """Emit when every build key is unique (max match count <= 1): the
+    dense table maps code -> build row directly, output rows are stream
+    rows (left: in place; inner: compacted), no expansion machinery."""
+    bcode, scode, bvalid, svalid, _cnt, _m = _probe_tables(
+        build, stream, build_keys, stream_keys, bits)
+    T = 1 << bits
+    cap_b, cap_s = build.capacity, stream.capacity
+    # row+1 sentinel table: 0 = no build row, ONE gather gives both the
+    # match flag and the row
+    rows1 = jnp.zeros((T,), jnp.int32).at[
+        jnp.where(bvalid, bcode, T)].set(
+        jnp.arange(cap_b, dtype=jnp.int32) + 1, mode="drop")
+    hit = jnp.where(svalid, jnp.take(rows1, scode), 0)
+    matched = hit > 0
+    build_row = jnp.clip(hit - 1, 0, cap_b - 1)
+
+    if how in ("left", "inner_inplace"):
+        # inner_inplace: the host saw total == stream rows (FK join,
+        # every stream row matched) — output rows ARE the stream rows,
+        # so skip the compaction and all stream-column gathers
+        s_cols = list(stream.columns)
+        b_cols = [c.gather(build_row, matched) for c in build.columns]
+        total_out = stream.num_rows
+    else:
+        keep = matched
+        # stable compaction of (stream cols, gathered build cols) to
+        # out_cap (cumsum destinations + scatter, the compact() idiom)
+        count = jnp.sum(keep.astype(jnp.int32))
+        dest = jnp.where(keep, jnp.cumsum(keep.astype(jnp.int32)) - 1,
+                         out_cap)
+        src = jnp.zeros((out_cap,), jnp.int32).at[dest].set(
+            jnp.arange(cap_s, dtype=jnp.int32), mode="drop")
+        out_valid = jnp.arange(out_cap, dtype=jnp.int32) < count
+        s_cols = [c.gather(src, out_valid) for c in stream.columns]
+        br = jnp.take(build_row, src)
+        b_cols = [c.gather(br, out_valid) for c in build.columns]
+        total_out = count
+    if build_first_in_output:
+        names = list(build_names) + list(stream_names)
+        cols = b_cols + s_cols
+    else:
+        names = list(stream_names) + list(build_names)
+        cols = s_cols + b_cols
+    return DeviceBatch(names, cols, total_out)
+
+
+def _probe_emit_dup_kernel(build, stream, border, build_keys,
+                           stream_keys, how, out_cap, build_names,
+                           stream_names, build_first_in_output, bits):
+    """Emit with duplicated build keys: build rows grouped by code via
+    the (small) build-side sort ``border``, match ranges from the dense
+    start/count tables, output expansion via cumsum + set-scatter +
+    cummax forward fill (no combined-space sort)."""
+    bcode, scode, bvalid, svalid, cnt, m = _probe_tables(
+        build, stream, build_keys, stream_keys, bits)
+    T = 1 << bits
+    cap_b, cap_s = build.capacity, stream.capacity
+    starts_tbl = jnp.concatenate(
+        [jnp.zeros((1,), jnp.int32), jnp.cumsum(cnt)[:-1]])
+    # build rows grouped by code: border sorts (invalid-last) bcode
+    grouped_rows = border                  # sorted build row ids
+    st = jnp.where(svalid, jnp.take(starts_tbl, scode), 0)
+
+    m_out = jnp.where(stream.row_mask(), jnp.maximum(m, 1), 0) \
+        if how == "left" else m
+    incl = jnp.cumsum(m_out)
+    total_out = incl[-1]
+    starts_out = incl - m_out
+    has = m_out > 0
+    k = jnp.arange(out_cap, dtype=jnp.int32)
+    marks = jnp.zeros((out_cap,), jnp.int32).at[
+        jnp.where(has, starts_out, out_cap)].max(
+        jnp.arange(cap_s, dtype=jnp.int32), mode="drop")
+    r = jnp.clip(jax.lax.cummax(marks), 0, cap_s - 1)
+    j = k - jnp.take(starts_out, r)
+    valid_pair = k < total_out
+    has_match = jnp.take(m, r) > 0
+    bpos = jnp.clip(jnp.take(st, r) + j, 0, cap_b - 1)
+    build_row = jnp.clip(jnp.take(grouped_rows, bpos), 0, cap_b - 1)
+    s_cols = [c.gather(r, valid_pair) for c in stream.columns]
+    b_cols = [c.gather(build_row, valid_pair & has_match)
+              for c in build.columns]
+    if build_first_in_output:
+        names = list(build_names) + list(stream_names)
+        cols = b_cols + s_cols
+    else:
+        names = list(stream_names) + list(build_names)
+        cols = s_cols + b_cols
+    return DeviceBatch(names, cols, total_out)
+
+
+def _probe_semi_kernel(build, stream, build_keys, stream_keys, anti,
+                       bits):
+    _, _, _, _, _, m = _probe_tables(build, stream, build_keys,
+                                     stream_keys, bits)
+    keep = (m == 0) if anti else (m > 0)
+    return compact(stream, keep & stream.row_mask())
+
+
 class _BroadcastBuildMixin:
     """Caches the one-time gather of the broadcast (build) side."""
 
@@ -410,6 +593,73 @@ class _HashJoinBase(TpuExec):
         order = sortkeys.shared_lexsort(jnp.reshape(packed, (1, -1)))
         return order, seg0
 
+    def _probe_pair(self, build: DeviceBatch, stream: DeviceBatch,
+                    bkeys, skeys, emit_how: str, build_first: bool,
+                    bits: int):
+        """Direct-address probe join (narrow keys): count -> host picks
+        the unique or duplicated-build-key emit variant."""
+        from spark_rapids_tpu.exec import kernel_cache as kc
+        sig = (bits, emit_how, tuple(bkeys), tuple(skeys),
+               build.schema_key(), stream.schema_key())
+        ckey = ("probe_count",) + sig
+        if ckey not in self._kernels:
+            self._kernels[ckey] = kc.get_kernel(
+                ckey, lambda: lambda b, s: _probe_count_kernel(
+                    b, s, bkeys, skeys, emit_how, bits))
+        with timed(self.metrics):
+            total, maxm = self._kernels[ckey](build, stream)
+            total, maxm = int(total), int(maxm)
+        if total >= (1 << 31):
+            raise MemoryError(
+                f"join output of {total} rows exceeds the single-batch "
+                f"2^31 limit; repartition the inputs")
+        if maxm <= 1:
+            emit_variant = emit_how
+            if emit_how == "inner" and \
+                    isinstance(stream.num_rows, (int, np.integer)) and \
+                    total == int(stream.num_rows):
+                emit_variant = "inner_inplace"   # FK join: all rows match
+            out_cap = stream.capacity if emit_variant != "inner" \
+                else bucket_rows(total)
+            ekey = ("probe_emit_u", emit_variant, out_cap,
+                    build_first) + sig
+            if ekey not in self._kernels:
+                self._kernels[ekey] = kc.get_kernel(
+                    ekey, lambda: lambda b, s: _probe_emit_unique_kernel(
+                        b, s, bkeys, skeys, emit_variant, out_cap,
+                        build.names, stream.names, build_first, bits))
+            with timed(self.metrics):
+                out = self._kernels[ekey](build, stream)
+        else:
+            out_cap = bucket_rows(total)
+            pkey = ("probe_bpack",) + sig
+            if pkey not in self._kernels:
+                def bpack(b, s):
+                    bcode, _, bvalid, _, _, _ = _probe_tables(
+                        b, s, bkeys, skeys, bits)
+                    key = jnp.where(bvalid, bcode.astype(jnp.uint64),
+                                    jnp.uint64(0xFFFFFFFF))
+                    return jnp.reshape(key, (1, -1))
+                self._kernels[pkey] = kc.get_kernel(pkey,
+                                                    lambda: bpack)
+            ekey = ("probe_emit_d", out_cap, build_first) + sig
+            if ekey not in self._kernels:
+                self._kernels[ekey] = kc.get_kernel(
+                    ekey, lambda: lambda b, s, o: _probe_emit_dup_kernel(
+                        b, s, o, bkeys, skeys, emit_how, out_cap,
+                        build.names, stream.names, build_first, bits))
+            with timed(self.metrics):
+                border = sortkeys.shared_lexsort(
+                    self._kernels[pkey](build, stream))
+                out = self._kernels[ekey](build, stream, border)
+        out = DeviceBatch(self._schema.names, out.columns, out.num_rows)
+        if self.condition is not None:
+            v = eval_tpu.evaluate(self.condition, out)
+            out = compact(out, v.data.astype(jnp.bool_) & v.validity)
+        self.metrics.add_rows(out.num_rows)
+        self.metrics.add_batches()
+        yield out
+
     def _join_pair(self, left: DeviceBatch, right: DeviceBatch,
                    build_side: str = "right"):
         """Join two single batches; yields 0 or 1 output batches."""
@@ -424,16 +674,28 @@ class _HashJoinBase(TpuExec):
 
         if how in ("semi", "anti"):
             from spark_rapids_tpu.exec import kernel_cache as kc
-            key = ("semi", how, tuple(lkeys), tuple(rkeys),
-                   left.schema_key(), right.schema_key())
-            if key not in self._kernels:
-                self._kernels[key] = kc.get_kernel(
-                    key, lambda: lambda b, s, o, g: _semi_kernel(
-                        b, s, o, g, rkeys, lkeys, how == "anti"))
-            with timed(self.metrics):
-                order, seg0 = self._sort_order(right, left, rkeys,
-                                               lkeys)
-                out = self._kernels[key](right, left, order, seg0)
+            bits = _probe_code_bits(right, left, rkeys, lkeys)
+            if bits is not None and bits <= _PROBE_MAX_BITS:
+                key = ("probe_semi", how, bits, tuple(lkeys),
+                       tuple(rkeys), left.schema_key(),
+                       right.schema_key())
+                if key not in self._kernels:
+                    self._kernels[key] = kc.get_kernel(
+                        key, lambda: lambda b, s: _probe_semi_kernel(
+                            b, s, rkeys, lkeys, how == "anti", bits))
+                with timed(self.metrics):
+                    out = self._kernels[key](right, left)
+            else:
+                key = ("semi", how, tuple(lkeys), tuple(rkeys),
+                       left.schema_key(), right.schema_key())
+                if key not in self._kernels:
+                    self._kernels[key] = kc.get_kernel(
+                        key, lambda: lambda b, s, o, g: _semi_kernel(
+                            b, s, o, g, rkeys, lkeys, how == "anti"))
+                with timed(self.metrics):
+                    order, seg0 = self._sort_order(right, left, rkeys,
+                                                   lkeys)
+                    out = self._kernels[key](right, left, order, seg0)
             self.metrics.add_rows(out.num_rows)
             self.metrics.add_batches()
             yield DeviceBatch(self._schema.names, out.columns,
@@ -453,6 +715,12 @@ class _HashJoinBase(TpuExec):
             build_first = False
 
         from spark_rapids_tpu.exec import kernel_cache as kc
+        bits = _probe_code_bits(build, stream, bkeys, skeys)
+        if bits is not None and bits <= _PROBE_MAX_BITS and \
+                emit_how in ("inner", "left"):
+            yield from self._probe_pair(build, stream, bkeys, skeys,
+                                        emit_how, build_first, bits)
+            return
         ckey = ("count", emit_how, tuple(bkeys), tuple(skeys),
                 build.schema_key(), stream.schema_key())
         if ckey not in self._kernels:

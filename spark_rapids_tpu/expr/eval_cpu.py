@@ -268,6 +268,14 @@ def _in(e, table):
                 math.isnan(item):
             hit |= np.isnan(v.data)
         else:
+            if v.dtype.id == dt.TypeId.DATE32 and \
+                    not isinstance(item, (int, np.integer)):
+                item = int((np.datetime64(item, "D") -
+                            np.datetime64(0, "D")).astype(int))
+            elif v.dtype.id == dt.TypeId.TIMESTAMP_US and \
+                    not isinstance(item, (int, np.integer)):
+                item = int((np.datetime64(item, "us") -
+                            np.datetime64(0, "us")).astype(int))
             hit |= (v.data == np.array(item).astype(v.data.dtype))
     valid = v.valid & (hit | (not has_null))
     return CpuVal(dt.BOOL, hit, valid)

@@ -399,6 +399,24 @@ def is_enabled() -> bool:
         return _GLOBAL_ENABLED
 
 
+def hbm_oom_recover(e: BaseException) -> bool:
+    """Alloc-failure-driven spill (DeviceMemoryEventHandler.onAllocFailure
+    analog, reference: DeviceMemoryEventHandler.scala:42-70).
+
+    XLA owns HBM, so instead of an in-allocator callback the engine
+    catches the failed dispatch/read, synchronously spills EVERY
+    device-tier registered buffer to host, and tells the caller to
+    retry.  Returns True when the error is an HBM exhaustion and bytes
+    were actually freed."""
+    msg = str(e)
+    if "RESOURCE_EXHAUSTED" not in msg and \
+            "out of memory" not in msg.lower():
+        return False
+    cat = get_catalog()
+    freed = cat.spill_to_fit(1 << 62)     # evict the whole device tier
+    return freed > 0
+
+
 def get_catalog() -> BufferCatalog:
     global _GLOBAL
     with _GLOBAL_LOCK:

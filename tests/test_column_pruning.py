@@ -1,0 +1,140 @@
+"""Column pruning (plan/optimizer.py — Catalyst ColumnPruning analog).
+
+Covers the round-5 review repro: nodes that derive their schema from
+child.schema (Join, Window) must see the NARROWED scan schema, or their
+ordinal offsets silently select wrong columns.
+"""
+
+import os
+import tempfile
+
+import numpy as np
+import pyarrow as pa
+import pyarrow.parquet as papq
+import pytest
+
+from spark_rapids_tpu import TpuSparkSession, col, functions as F
+from tests.parity import assert_tables_equal, collect_plans
+
+
+@pytest.fixture(scope="module")
+def roots():
+    d = tempfile.mkdtemp(prefix="prune_")
+    rng = np.random.default_rng(0)
+    a = pa.table({"x": pa.array(rng.integers(0, 100, 500)),
+                  "k": pa.array(rng.integers(0, 20, 500)),
+                  "z": pa.array(rng.uniform(0, 1, 500))})
+    b = pa.table({"y": pa.array(rng.integers(100, 200, 20)),
+                  "k2": pa.array(np.arange(20)),
+                  "w": pa.array(rng.uniform(0, 1, 20))})
+    pa_dir, pb_dir = os.path.join(d, "a"), os.path.join(d, "b")
+    os.makedirs(pa_dir), os.makedirs(pb_dir)
+    papq.write_table(a, os.path.join(pa_dir, "a.parquet"))
+    papq.write_table(b, os.path.join(pb_dir, "b.parquet"))
+    return pa_dir, pb_dir, a, b
+
+
+def _both(q):
+    tpu = q(TpuSparkSession(
+        {"spark.rapids.tpu.sql.variableFloatAgg.enabled": True}))
+    cpu = q(TpuSparkSession({"spark.rapids.tpu.sql.enabled": False}))
+    return cpu.collect(), tpu.collect()
+
+
+def _scan_columns(session_q):
+    s = TpuSparkSession(
+        {"spark.rapids.tpu.sql.variableFloatAgg.enabled": True})
+    captured = collect_plans(s)
+    session_q(s).collect()
+    cols = []
+    captured[-1].plan.foreach(
+        lambda n: cols.append([f.name for f in n.schema.fields])
+        if "Scan" in type(n).__name__ else None)
+    return cols
+
+
+def test_scan_prunes_to_referenced(roots):
+    pa_dir, _, a, _ = roots
+
+    def q(s):
+        return (s.read.parquet(pa_dir).filter(col("z") > 0.5)
+                .group_by("k").agg(F.sum("x").alias("sx")))
+    cpu, tpu = _both(q)
+    assert_tables_equal(cpu, tpu, ignore_order=True)
+    assert _scan_columns(q) == [["x", "k", "z"]]
+
+    def q2(s):
+        return s.read.parquet(pa_dir).group_by("k").agg(
+            F.count("*").alias("c"))
+    cpu, tpu = _both(q2)
+    assert_tables_equal(cpu, tpu, ignore_order=True)
+    (cols2,) = _scan_columns(q2)
+    assert len(cols2) < 3 and "k" in cols2
+
+
+def test_join_above_pruned_scans(roots):
+    """Round-5 review repro: the Join derives ordinals from its
+    children's schemas, so a pruned scan must narrow its logical schema
+    or the join projects the wrong columns."""
+    pa_dir, pb_dir, a, b = roots
+
+    def q(s):
+        ta = s.read.parquet(pa_dir)
+        tb = s.read.parquet(pb_dir)
+        return (ta.join(tb, on=(col("k") == col("k2")), how="inner")
+                .select("x", "y"))
+    cpu, tpu = _both(q)
+    assert_tables_equal(cpu, tpu, ignore_order=True)
+    # ground truth: y values come from b.y, not a displaced column
+    ys = set(tpu.column("y").to_pylist())
+    assert ys <= set(b.column("y").to_pylist()), ys
+    for cols in _scan_columns(q):
+        assert "z" not in cols and "w" not in cols, cols
+
+
+def test_window_above_pruned_scan(roots):
+    pa_dir, _, a, _ = roots
+    from spark_rapids_tpu.api.window import Window
+
+    def q(s):
+        w = Window.partition_by("k").order_by("x")
+        return (s.read.parquet(pa_dir)
+                .select("k", "x", F.row_number().over(w).alias("rn")))
+    cpu, tpu = _both(q)
+    assert_tables_equal(cpu, tpu, ignore_order=True)
+    for cols in _scan_columns(q):
+        assert "z" not in cols, cols
+
+
+def test_union_branches_prune_internally(roots):
+    pa_dir, _, a, _ = roots
+
+    def q(s):
+        lo = s.read.parquet(pa_dir).filter(col("x") < 50).select("k")
+        hi = s.read.parquet(pa_dir).filter(col("x") >= 50).select("k")
+        return lo.union(hi).group_by("k").agg(F.count("*").alias("c"))
+    cpu, tpu = _both(q)
+    assert_tables_equal(cpu, tpu, ignore_order=True)
+    for cols in _scan_columns(q):
+        assert "z" not in cols, cols
+
+
+def test_pruning_kill_switch(roots):
+    pa_dir, _, a, _ = roots
+
+    def q(s):
+        return s.read.parquet(pa_dir).group_by("k").agg(
+            F.sum("x").alias("sx"))
+    s = TpuSparkSession(
+        {"spark.rapids.tpu.sql.columnPruning.enabled": False,
+         "spark.rapids.tpu.sql.variableFloatAgg.enabled": True})
+    captured = collect_plans(s)
+    out = q(s).collect()
+    cols = []
+    captured[-1].plan.foreach(
+        lambda n: cols.append([f.name for f in n.schema.fields])
+        if "Scan" in type(n).__name__ else None)
+    assert cols == [["x", "k", "z"]]
+    cpu = q(TpuSparkSession(
+        {"spark.rapids.tpu.sql.enabled": False})).collect()
+    assert_tables_equal(cpu, out, ignore_order=True)

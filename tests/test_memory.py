@@ -338,3 +338,61 @@ def test_join_query_under_tiny_device_budget():
         assert len(got) == len(want)
         assert sorted(got["v"] + got["w"]) == \
             sorted(want["v"] + want["w"])
+
+
+def test_hbm_oom_recover_spills_and_retries():
+    """The alloc-failure recovery hook (DeviceMemoryEventHandler
+    analog): a RESOURCE_EXHAUSTED from a cached-kernel dispatch evicts
+    the whole device tier and retries once.  Hermetic: the OOM is
+    simulated (the tunneled bench runtime hangs instead of raising on
+    real HBM exhaustion — see test_tpu_hw.py), the spill and retry are
+    real."""
+    import jax.numpy as jnp
+    import pyarrow as pa
+
+    from spark_rapids_tpu.columnar.batch import from_arrow
+    from spark_rapids_tpu.exec import kernel_cache as kc
+    from spark_rapids_tpu.mem import spill
+
+    spill.init_catalog(device_budget=1 << 30, host_budget=1 << 30)
+    cat = spill.get_catalog()
+    before = cat.spilled_device_bytes
+    batch = from_arrow(pa.table({"v": list(range(256))}))
+    handle = cat.register(batch)
+    assert cat.device_bytes > 0
+
+    calls = {"n": 0}
+
+    def flaky_impl(b):
+        calls["n"] += 1
+        if calls["n"] == 1:
+            raise RuntimeError(
+                "RESOURCE_EXHAUSTED: Out of memory while trying to "
+                "allocate 123 bytes (simulated)")
+        return jnp.sum(b.columns[0].data,
+                       where=b.columns[0].validity)
+
+    k = kc.get_kernel(("oom_recovery_probe", id(flaky_impl)),
+                      lambda: flaky_impl)
+    out = int(k(batch))
+    assert out == sum(range(256))
+    assert calls["n"] == 2, calls                  # failed, then retried
+    # the failure synchronously evicted the registered device buffer
+    assert cat.spilled_device_bytes > before
+    t = handle.get()                               # rematerializes
+    assert int(t.num_rows) == 256
+    handle.close()
+
+    # a non-OOM error must NOT be retried
+    calls2 = {"n": 0}
+
+    def always_bad(b):
+        calls2["n"] += 1
+        raise ValueError("unrelated failure")
+
+    k2 = kc.get_kernel(("oom_recovery_probe2", id(always_bad)),
+                       lambda: always_bad)
+    import pytest as _pytest
+    with _pytest.raises(ValueError):
+        k2(batch)
+    assert calls2["n"] == 1, calls2

