@@ -657,11 +657,22 @@ def update_aggregate(batch: DeviceBatch,
     grouping at a rung sized to the SELECTED rows — for the q6 bench's
     25%-selective filter that is cap/4 for every sort pass, gather and
     scan."""
-    def run(kv, av, cap2, nr):
+    def run(kv, av, cap2, nr, sel_s=None, full_mask=None):
+        """One grouped update at capacity cap2.  In the fused-filter
+        path ``av`` stays in ORIGINAL row space: the sorted-space value
+        gather composes the selection map with the sort order
+        (sel∘order -> original rows), so each value vector pays ONE
+        rung-sized gather total instead of a rung compact + a sorted
+        gather."""
+        from dataclasses import replace as _dc_replace
         ctx = _group_ctx(kv, cap2, nr)
         cols = gather_group_keys(kv, ctx)
         names = [f"__k{i}" for i in range(len(cols))]
-        bufs_per_spec = [spec.update(v, ctx)
+        vctx = ctx
+        if sel_s is not None:
+            vctx = _dc_replace(ctx, order=jnp.take(sel_s, ctx.order),
+                               row_mask=full_mask)
+        bufs_per_spec = [spec.update(v, vctx)
                          for v, spec in zip(av, specs)]
         _append_buffers(cols, names, bufs_per_spec, specs, ctx)
         return DeviceBatch(names, cols, ctx.n_groups)
@@ -700,26 +711,23 @@ def update_aggregate(batch: DeviceBatch,
                     jnp.uint32(0xFFFFFFFF))
     sel = jnp.sort(pos).astype(jnp.int32)
 
-    def gather_rung(cap2):
+    def gather_keys(cap2):
         s = sel[:cap2]
         live = jnp.arange(cap2) < n_rows
-        kv = [_gather_val(v, s, live) for v in key_vals]
-        av = [None if v is None else _gather_val(v, s, live)
-              for v in agg_vals]
-        return kv, av
+        return [_gather_val(v, s, live) for v in key_vals], s
 
     rung = cap // 4
     if rung < _LADDER_MIN_RUNG:
-        kv, av = gather_rung(cap)
-        return run(kv, av, cap, n_rows)
+        kv, s = gather_keys(cap)
+        return run(kv, agg_vals, cap, n_rows, s, keep)
 
     def small():
-        kv, av = gather_rung(rung)
-        return _pad_batch(run(kv, av, rung, n_rows), cap)
+        kv, s = gather_keys(rung)
+        return _pad_batch(run(kv, agg_vals, rung, n_rows, s, keep), cap)
 
     def big():
-        kv, av = gather_rung(cap)
-        return run(kv, av, cap, n_rows)
+        kv, s = gather_keys(cap)
+        return run(kv, agg_vals, cap, n_rows, s, keep)
 
     return jax.lax.cond(n_rows <= rung, small, big)
 
