@@ -206,8 +206,47 @@ class Limit(LogicalPlan):
         return f"Limit({self.n})"
 
 
+def widen_union_branches(children: Sequence["LogicalPlan"]
+                         ) -> List["LogicalPlan"]:
+    """Spark's WidenSetOperationTypes: mismatched numeric columns across
+    UNION branches promote to a common type via inserted cast
+    projections; non-promotable mismatches raise as before."""
+    schemas = [c.schema for c in children]
+    n = len(schemas[0].names)
+    if any(len(s.names) != n for s in schemas[1:]):
+        raise TypeError("UNION requires the same column count")
+    targets = []
+    for i in range(n):
+        t = schemas[0].dtypes[i]
+        for s in schemas[1:]:
+            d = s.dtypes[i]
+            if d == t:
+                continue
+            if d.is_numeric and t.is_numeric:
+                t = dt.promote(t, d)
+            else:
+                raise TypeError(
+                    f"UNION column {schemas[0].names[i]!r}: "
+                    f"incompatible types {t.name} vs {d.name}")
+        targets.append(t)
+    out = []
+    for c, s in zip(children, schemas):
+        if list(s.dtypes) == targets:
+            out.append(c)
+            continue
+        exprs = []
+        for i, name in enumerate(s.names):
+            e: ir.Expression = ir.UnresolvedAttribute(name)
+            if s.dtypes[i] != targets[i]:
+                e = ir.Cast(e, targets[i])
+            exprs.append(ir.Alias(e, name))
+        out.append(Project(c, exprs))
+    return out
+
+
 class Union(LogicalPlan):
     def __init__(self, children: Sequence[LogicalPlan]):
+        children = widen_union_branches(list(children))
         self.children = tuple(children)
         s0 = children[0].schema
         for c in children[1:]:

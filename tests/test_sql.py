@@ -465,10 +465,9 @@ _QA_SWEEP2 = [
     "SELECT dt FROM qa ORDER BY year(dt) DESC, month(dt) ASC "
     "LIMIT 20",
     # union + distinct over mixed widths
-    # the engine requires matching UNION schemas (no implicit widening;
-    # documented PARITY.md delta), so widen explicitly
-    "SELECT CAST(b AS smallint) AS v FROM qa UNION ALL "
-    "SELECT s AS v FROM qa",
+    # implicit UNION widening (WidenSetOperationTypes analog): byte
+    # branch promotes to the smallint branch's type
+    "SELECT b AS v FROM qa UNION ALL SELECT s AS v FROM qa",
     "SELECT DISTINCT CAST(b AS int) AS v FROM qa UNION ALL "
     "SELECT DISTINCT i AS v FROM qa",
     "SELECT DISTINCT dt FROM qa WHERE dt IS NOT NULL",
@@ -555,3 +554,32 @@ def test_sql_nulls_last_ground_truth():
     out2 = with_cpu_session(
         lambda s: run2(s).collect()).column("x").to_pylist()
     assert out2 == [None, None, 3, 2, 1], out2
+
+
+def test_union_implicit_widening():
+    """WidenSetOperationTypes analog: mismatched numeric UNION branches
+    promote to a common type; incompatible mismatches still raise."""
+    import pyarrow as pa
+    import pytest as _pytest
+    from spark_rapids_tpu import TpuSparkSession
+
+    s = TpuSparkSession(
+        {"spark.rapids.tpu.sql.variableFloatAgg.enabled": True})
+    a = s.create_dataframe(pa.table(
+        {"v": pa.array([1, 2], type=pa.int8())}))
+    b = s.create_dataframe(pa.table(
+        {"v": pa.array([1.5, 2.5], type=pa.float64())}))
+    out = a.union(b).collect()
+    assert str(out.schema.field("v").type) == "double"
+    assert out.column("v").to_pylist() == [1.0, 2.0, 1.5, 2.5]
+    # parity with the CPU engine
+    sc = TpuSparkSession({"spark.rapids.tpu.sql.enabled": False})
+    a2 = sc.create_dataframe(pa.table(
+        {"v": pa.array([1, 2], type=pa.int8())}))
+    b2 = sc.create_dataframe(pa.table(
+        {"v": pa.array([1.5, 2.5], type=pa.float64())}))
+    assert a2.union(b2).collect().equals(out)
+
+    c = s.create_dataframe(pa.table({"v": ["x", "y"]}))
+    with _pytest.raises(TypeError, match="incompatible"):
+        a.union(c)
