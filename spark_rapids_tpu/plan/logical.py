@@ -9,6 +9,7 @@ device placement in the physical planner/overrides.
 
 from __future__ import annotations
 
+import copy
 from dataclasses import dataclass
 from typing import List, Optional, Sequence, Tuple
 
@@ -292,10 +293,7 @@ def rewrite_distinct_aggregates(plan: LogicalPlan, groupings, exprs):
     same_child = all(ir.expr_eq(a.child, distincts[0].child)
                      for a in distincts[1:])
     if not same_child or len(distincts) != len(all_aggs):
-        raise NotImplementedError(
-            "only a single distinct child expression, with no "
-            "non-distinct aggregates alongside, is supported (Spark's "
-            "Expand-based multi-distinct rewrite is not implemented)")
+        return _rewrite_multi_distinct(plan, groupings, exprs)
     x = distincts[0].child
     xname = "__distinct_val"
     inner = Aggregate(plan, list(groupings) + [ir.Alias(x, xname)], [])
@@ -314,6 +312,135 @@ def rewrite_distinct_aggregates(plan: LogicalPlan, groupings, exprs):
         return None
 
     new_exprs = [ir.transform(e, repl) for e in exprs]
+    return inner, new_groupings, new_exprs
+
+
+def _rewrite_multi_distinct(plan: LogicalPlan, groupings, exprs):
+    """Expand-based multi-distinct rewrite (Spark's
+    RewriteDistinctAggregates general shape,
+    RewriteDistinctAggregates.scala): replicate each input row once per
+    distinct-child group with a ``gid`` tag (Expand), pre-aggregate on
+    (grouping keys, gid, distinct values) so each distinct value
+    survives once per group, then finish with gid-filtered plain
+    aggregates — ``AGG(if(gid = j, d_j, null))`` for the distinct
+    functions and merge forms over the gid-0 partials for the plain
+    ones (Average splits into Sum/Count partials)."""
+    all_aggs = [a for e in exprs for a in ir.collect(
+        e, lambda n: isinstance(n, ir.AggregateExpression))]
+    distincts = [a for a in all_aggs if getattr(a, "distinct", False)]
+    plains = [a for a in all_aggs if not getattr(a, "distinct", False)]
+    for a in plains:
+        if not isinstance(a, (ir.Count, ir.Sum, ir.Average, ir.Min,
+                              ir.Max, ir.First, ir.Last)):
+            raise NotImplementedError(
+                f"{type(a).__name__} alongside DISTINCT aggregates is "
+                f"not supported")
+
+    # unique distinct children -> gid groups 1..k
+    dchildren: List[ir.Expression] = []
+    for a in distincts:
+        if not any(ir.expr_eq(a.child, c) for c in dchildren):
+            dchildren.append(a.child)
+
+    g_names = [ir.output_name(g) for g in groupings]
+    d_names = [f"__d{j}" for j in range(len(dchildren))]
+    schema = plan.schema
+
+    def b(e):
+        return ir.bind(e, schema.names, schema.dtypes, schema.nullables)
+
+    d_dtypes = [b(copy.deepcopy(c)).dtype for c in dchildren]
+    # plain-agg inputs (Count(*) needs no input column)
+    p_names: List[str] = []
+    p_children: List[ir.Expression] = []
+    for m, a in enumerate(plains):
+        p_names.append(f"__p{m}")
+        p_children.append(a.child)
+    p_dtypes = [dt.INT32 if c is None else b(copy.deepcopy(c)).dtype
+                for c in p_children]
+
+    # Expand projections over [g..., gid, d..., p...]
+    out_names = g_names + ["__gid"] + d_names + p_names
+    projections = []
+    base = [copy.deepcopy(g) for g in groupings]
+    proj0 = base + [ir.Literal(0, dt.INT32)] + \
+        [ir.Literal(None, d) for d in d_dtypes] + \
+        [ir.Literal(1, dt.INT32) if c is None else copy.deepcopy(c)
+         for c in p_children]
+    projections.append(proj0)
+    for j, c in enumerate(dchildren):
+        projections.append(
+            [copy.deepcopy(g) for g in groupings] +
+            [ir.Literal(j + 1, dt.INT32)] +
+            [copy.deepcopy(c) if jj == j else ir.Literal(None, d)
+             for jj, d in enumerate(d_dtypes)] +
+            [ir.Literal(None, d) for d in p_dtypes])
+    expanded = Expand(plan, projections, out_names)
+
+    # inner pre-aggregate: group by (g, gid, d...), partials for plains
+    inner_groupings: List[ir.Expression] = [
+        ir.UnresolvedAttribute(n) for n in g_names + ["__gid"] + d_names]
+    inner_aggs: List[ir.Expression] = []
+    buf_names: List[List[str]] = []
+    for m, a in enumerate(plains):
+        pm = ir.UnresolvedAttribute(p_names[m])
+        if isinstance(a, ir.Count):
+            # Count(*) counts the gid-0 lit(1); Count(x) counts
+            # non-null x — both are Count over __pm (null elsewhere)
+            bufs = [(f"__b{m}_0", ir.Count(pm))]
+        elif isinstance(a, ir.Average):
+            bufs = [(f"__b{m}_0", ir.Sum(pm)),
+                    (f"__b{m}_1", ir.Count(pm))]
+        else:
+            bufs = [(f"__b{m}_0", type(a)(pm))]
+        buf_names.append([n for n, _ in bufs])
+        inner_aggs.extend(ir.Alias(e, n) for n, e in bufs)
+    inner = Aggregate(expanded, inner_groupings, inner_aggs)
+
+    # outer: group by g, gid-filtered aggregates
+    gid = ir.UnresolvedAttribute("__gid")
+
+    def _if_gid(j: int, value: ir.Expression, d: dt.DType):
+        return ir.If(ir.EqualTo(copy.deepcopy(gid), ir.Literal(j, dt.INT32)),
+                     value, ir.Literal(None, d))
+
+    new_groupings = [ir.UnresolvedAttribute(n) for n in g_names]
+    inner_schema = inner.schema
+
+    def repl(node):
+        for gi, g in enumerate(groupings):
+            if ir.expr_eq(node, g):
+                return ir.UnresolvedAttribute(g_names[gi])
+        if isinstance(node, ir.AggregateExpression) and \
+                getattr(node, "distinct", False):
+            j = next(jj for jj, c in enumerate(dchildren)
+                     if ir.expr_eq(node.child, c))
+            r = node.with_children([_if_gid(
+                j + 1, ir.UnresolvedAttribute(d_names[j]),
+                d_dtypes[j])])
+            r.distinct = False
+            return r
+        if isinstance(node, ir.AggregateExpression):
+            m = next(mm for mm, a in enumerate(plains)
+                     if a is node or ir.expr_eq(a, node))
+            bufs = buf_names[m]
+
+            def buf(i):
+                d = inner_schema.field(bufs[i]).dtype
+                return _if_gid(0, ir.UnresolvedAttribute(bufs[i]), d)
+            a = plains[m]
+            if isinstance(a, ir.Count):
+                return ir.Sum(buf(0))
+            if isinstance(a, ir.Average):
+                return ir.Divide(
+                    ir.Cast(ir.Sum(buf(0)), dt.FLOAT64),
+                    ir.Cast(ir.Sum(buf(1)), dt.FLOAT64))
+            return type(a)(buf(0))
+        return None
+
+    new_exprs = [ir.transform(e, repl) for e in exprs]
+    # groupings must reach the Expand by their original shapes: alias
+    # them in a pre-projection so complex grouping exprs stay intact
     return inner, new_groupings, new_exprs
 
 
