@@ -223,18 +223,19 @@ def test_avg_distinct_global():
     assert with_cpu_session(fn).column("ad")[0].as_py() == 3.0
 
 
-def test_mixed_distinct_raises():
-    import pytest
-    t = pa.table({"g": ["a"], "v": [1]})
+def test_mixed_distinct_now_supported():
+    # round 5: the Expand-based multi-distinct rewrite handles DISTINCT
+    # aggregates alongside plain ones (was NotImplementedError)
+    t = pa.table({"g": ["a", "a", "b"], "v": [1, 1, 2]})
 
     def fn(session):
         df = session.create_dataframe(t)
-        with pytest.raises(NotImplementedError):
-            df.group_by("g").agg(F.count_distinct(col("v")),
-                                 F.count("*"))
-        return True
+        return df.group_by("g").agg(
+            F.count_distinct(col("v")).alias("cd"),
+            F.count("*").alias("n")).collect()
 
-    assert with_cpu_session(fn)
+    out = with_cpu_session(fn).to_pandas().sort_values("g")
+    assert out["cd"].tolist() == [1, 1] and out["n"].tolist() == [2, 1]
 
 
 def test_distinct_over_window_raises():
@@ -244,19 +245,20 @@ def test_distinct_over_window_raises():
         F.count_distinct(col("v")).over(Window.partition_by("g"))
 
 
-def test_distinct_different_casts_rejected():
-    import pytest
-    t = pa.table({"g": ["a"], "v": [1]})
+def test_distinct_different_casts_now_supported():
+    # round 5: distinct aggregates over DIFFERENT children each get
+    # their own Expand gid group (was NotImplementedError)
+    t = pa.table({"g": ["a", "a"], "v": [1, 1]})
 
     def fn(session):
         df = session.create_dataframe(t)
-        with pytest.raises(NotImplementedError):
-            df.group_by("g").agg(
-                F.sum_distinct(col("v").cast("int")),
-                F.sum_distinct(col("v").cast("double")))
-        return True
+        return df.group_by("g").agg(
+            F.sum_distinct(col("v").cast("int")).alias("si"),
+            F.sum_distinct(col("v").cast("double")).alias("sd")).collect()
 
-    assert with_cpu_session(fn)
+    out = with_cpu_session(fn)
+    assert out.column("si").to_pylist() == [1]
+    assert out.column("sd").to_pylist() == [1.0]
 
 
 def test_sql_count_distinct_output_name():
