@@ -279,6 +279,14 @@ SHUFFLE_PROCESS_EXECUTORS = conf(
     "Number of executor processes the 'process' shuffle transport "
     "spawns (the executor fleet the RapidsShuffleManager spans).", int)
 
+SHUFFLE_PROCESS_NESTED_TRANSPORT = conf(
+    "spark.rapids.tpu.shuffle.transport.processNestedTransport", "local",
+    "Data plane for exchanges NESTED inside a shipped map stage when "
+    "shuffle.transport=process: 'local' (in-process store) or 'ici' / "
+    "'ici_ring' (each executor runs the nested exchange as collectives "
+    "over its own device mesh — the DCN-over-ICI composition: "
+    "intra-slice collectives per executor, TCP between executors).")
+
 SHUFFLE_COMPRESSION_CODEC = conf(
     "spark.rapids.tpu.shuffle.compression.codec", "none",
     "Codec for serialized shuffle partitions: none, lz4 (pyarrow IPC "

@@ -598,6 +598,8 @@ class TpuShuffleExchangeExec(TpuExec):
         n_parts = self.partitioning.num_partitions
         n_execs = max(int(self.conf_obj.get(
             cfg.SHUFFLE_PROCESS_EXECUTORS)), 1)
+        nested_transport = str(self.conf_obj.get(
+            cfg.SHUFFLE_PROCESS_NESTED_TRANSPORT))
         state = {"done": False, "sid": None, "pool": None,
                  "transport": None, "received": None, "maps": {},
                  "clients": {}, "reads_left": n_parts, "epoch": 0}
@@ -634,7 +636,7 @@ class TpuShuffleExchangeExec(TpuExec):
             with lock:
                 if state["done"]:
                     return
-                pool = get_executor_pool(n_execs)
+                pool = get_executor_pool(n_execs, nested_transport)
                 sid = next(self._process_sids)
                 with timed(self.metrics):
                     # map stages run concurrently across the fleet; each

@@ -46,20 +46,27 @@ def read_frame(stream: BinaryIO) -> Optional[dict]:
     return pickle.loads(payload)
 
 
-def _run_map_stage(task: dict, catalog) -> dict:
+def _run_map_stage(task: dict, catalog, nested_transport: str) -> dict:
     """Execute the shipped exchange's map side for this executor's share
     of input partitions, registering slices in the local catalog."""
     exch = task["exchange"]
-    # nested exchanges inside the shipped fragment execute in-process:
-    # an executor must not recursively spawn its own executor fleet
+    # nested exchanges inside the shipped fragment execute in-process —
+    # an executor must not recursively spawn its own executor fleet.
+    # With --nested-transport=ici they ride the executor's OWN device
+    # mesh instead (the DCN-over-ICI composition: collectives inside
+    # each executor, TCP between executors — a TPU pod slice per
+    # executor host with DCN across slices).
+    nested: list = []
+
     def _localize(n):
         if getattr(n, "transport", None) == "process" and n is not exch:
-            n.transport = "local"
+            n.transport = nested_transport
+            nested.append(nested_transport)
     exch.foreach(_localize)
     maps = exch.run_map_stage(
         shuffle_id=task["shuffle_id"], catalog=catalog,
         n_execs=task["n_execs"], exec_idx=task["exec_idx"])
-    return {"ok": True, "maps": maps}
+    return {"ok": True, "maps": maps, "nested_transports": nested}
 
 
 def main() -> None:
@@ -67,6 +74,10 @@ def main() -> None:
     if "--cpu" in sys.argv:
         jax.config.update("jax_platforms", "cpu")
     executor_id = sys.argv[sys.argv.index("--executor-id") + 1]
+    nested_transport = "local"
+    if "--nested-transport" in sys.argv:
+        nested_transport = sys.argv[
+            sys.argv.index("--nested-transport") + 1]
 
     from spark_rapids_tpu.shuffle.catalogs import ShuffleBufferCatalog
     from spark_rapids_tpu.shuffle.server import ShuffleServer
@@ -89,7 +100,8 @@ def main() -> None:
             break
         try:
             if msg["op"] == "map_stage":
-                write_frame(out, _run_map_stage(msg, catalog))
+                write_frame(out, _run_map_stage(msg, catalog,
+                                                nested_transport))
             elif msg["op"] == "unregister":
                 catalog.unregister_shuffle(msg["shuffle_id"])
                 write_frame(out, {"ok": True})

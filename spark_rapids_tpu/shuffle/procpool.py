@@ -56,23 +56,41 @@ class ExecutorHandle:
 
 
 class ExecutorPool:
-    """Spawns and tracks N executor daemons on this host."""
+    """Spawns and tracks N executor daemons on this host.
 
-    def __init__(self, n_execs: int, cpu_jax: bool = True):
+    ``nested_transport='ici'`` gives every executor an n-device virtual
+    mesh and keeps nested exchanges on it — the DCN-over-ICI
+    composition (collectives inside each executor process, TCP between
+    them; one pod slice per executor host with DCN across slices)."""
+
+    def __init__(self, n_execs: int, cpu_jax: bool = True,
+                 nested_transport: str = "local",
+                 mesh_devices: int = 8):
         self.n_execs = n_execs
         self.cpu_jax = cpu_jax
+        self.nested_transport = nested_transport
+        self.mesh_devices = mesh_devices
         self._handles: List[Optional[ExecutorHandle]] = [None] * n_execs
         self._lock = threading.Lock()
 
     def _spawn(self, idx: int) -> ExecutorHandle:
+        import os
         eid = f"exec-{idx}"
         args = [sys.executable, "-m",
                 "spark_rapids_tpu.shuffle.executor_proc",
-                "--executor-id", eid]
+                "--executor-id", eid,
+                "--nested-transport", self.nested_transport]
         if self.cpu_jax:
             args.append("--cpu")
+        env = dict(os.environ)
+        if self.nested_transport in ("ici", "ici_ring"):
+            flags = [f for f in env.get("XLA_FLAGS", "").split()
+                     if "host_platform_device_count" not in f]
+            flags.append("--xla_force_host_platform_device_count="
+                         f"{self.mesh_devices}")
+            env["XLA_FLAGS"] = " ".join(flags)
         proc = subprocess.Popen(args, stdin=subprocess.PIPE,
-                                stdout=subprocess.PIPE)
+                                stdout=subprocess.PIPE, env=env)
         hello = read_frame(proc.stdout)
         if hello is None:
             proc.kill()
@@ -121,13 +139,17 @@ _pool: Optional[ExecutorPool] = None
 _pool_lock = threading.Lock()
 
 
-def get_executor_pool(n_execs: int) -> ExecutorPool:
+def get_executor_pool(n_execs: int,
+                      nested_transport: str = "local") -> ExecutorPool:
     """Process-wide pool (executor-singleton idiom, GpuShuffleEnv.scala:26).
-    Grows if a larger fleet is requested."""
+    Rebuilt if a larger fleet or a different nested transport is
+    requested."""
     global _pool
     with _pool_lock:
-        if _pool is None or _pool.n_execs < n_execs:
-            old, _pool = _pool, ExecutorPool(n_execs)
+        if _pool is None or _pool.n_execs < n_execs or \
+                _pool.nested_transport != nested_transport:
+            old, _pool = _pool, ExecutorPool(
+                n_execs, nested_transport=nested_transport)
             if old is not None:
                 old.shutdown()
         return _pool
