@@ -148,7 +148,10 @@ def _build_join_pipeline(fact, items, warehouses):
 
     fb = from_arrow(fact)
     ib = from_arrow(items)
-    wb = from_arrow(warehouses)
+    # the planner's column pruning (plan/optimizer.py) drops the
+    # unreferenced 'state' column from the warehouse scan; the loop
+    # harness mirrors the pruned build side
+    wb = from_arrow(warehouses.select(["warehouse_sk"]))
 
     def _renamed(build, stream, bkey, skey):
         bnames = [f"__b{i}" for i in range(build.num_cols)]
