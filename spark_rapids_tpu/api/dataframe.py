@@ -14,6 +14,7 @@ from typing import Any, List, Optional, Sequence, Union
 import pyarrow as pa
 
 from spark_rapids_tpu.api.column import Column, _to_expr, col
+from spark_rapids_tpu import dtypes as dt
 from spark_rapids_tpu.expr import ir
 from spark_rapids_tpu.plan import logical as lp
 from spark_rapids_tpu.plan.logical import SortOrder
@@ -116,6 +117,25 @@ class DataFrame:
         return GroupedData(self, [_as_expr(c) for c in cols])
 
     groupBy = group_by
+
+    def rollup(self, *cols) -> "GroupedData":
+        """Hierarchical subtotals: grouping sets over every key prefix
+        (reference: rollup lowered through GpuExpandExec,
+        GpuExpandExec.scala:67)."""
+        exprs = [_as_expr(c) for c in cols]
+        k = len(exprs)
+        sets = [tuple(range(i)) for i in range(k, -1, -1)]
+        return _grouping_sets(self, exprs, sets)
+
+    def cube(self, *cols) -> "GroupedData":
+        """All grouping-set combinations of the keys (GpuExpandExec
+        lowering, as rollup)."""
+        import itertools
+        exprs = [_as_expr(c) for c in cols]
+        k = len(exprs)
+        sets = [s for n in range(k, -1, -1)
+                for s in itertools.combinations(range(k), n)]
+        return _grouping_sets(self, exprs, sets)
 
     def agg(self, *aggs) -> "DataFrame":
         return GroupedData(self, []).agg(*aggs)
@@ -330,12 +350,58 @@ class DataFrame:
         return f"DataFrame[{inner}]"
 
 
+def _grouping_sets(df: DataFrame, exprs: List[ir.Expression],
+                   sets: List[tuple]) -> "GroupedData":
+    """Lower rollup/cube to Expand + Aggregate (Spark's grouping-sets
+    shape): replicate each row once per grouping set with the excluded
+    keys nulled and a Spark-compatible grouping id (bit i set = key i
+    aggregated away), group by (keys, gid), then rename the internal
+    key columns back and drop the gid."""
+    import copy as _copy
+    child = df.plan
+    s = child.schema
+    k = len(exprs)
+    bound = [ir.bind(_copy.deepcopy(e), s.names, s.dtypes, s.nullables)
+             for e in exprs]
+    g_internal = [f"__gset{i}" for i in range(k)]
+    g_public = [ir.output_name(e) for e in exprs]
+    projections = []
+    for S in sets:
+        gid = sum(1 << (k - 1 - i) for i in range(k) if i not in S)
+        projections.append(
+            [ir.UnresolvedAttribute(n) for n in s.names] +
+            [_copy.deepcopy(exprs[i]) if i in S
+             else ir.Literal(None, bound[i].dtype) for i in range(k)] +
+            [ir.Literal(gid, dt.INT64)])
+    expanded = lp.Expand(child, projections,
+                         list(s.names) + g_internal + ["__gid"])
+    gd = GroupedData(
+        DataFrame(expanded, df.session),
+        [ir.UnresolvedAttribute(n) for n in g_internal] +
+        [ir.UnresolvedAttribute("__gid")])
+    gd._gset_renames = dict(zip(g_internal, g_public))
+    return gd
+
+
 class GroupedData:
     def __init__(self, df: DataFrame, groupings: List[ir.Expression]):
         self.df = df
         self.groupings = groupings
+        # rollup/cube: internal grouping-set key names -> public names;
+        # agg() renames them and drops the __gid column
+        self._gset_renames: Optional[dict] = None
 
     def agg(self, *aggs) -> DataFrame:
+        res = self._agg_impl(*aggs)
+        if self._gset_renames:
+            # rollup/cube epilogue: public key names back, gid dropped
+            final = [ir.Alias(ir.UnresolvedAttribute(n),
+                              self._gset_renames.get(n, n))
+                     for n in res.plan.schema.names if n != "__gid"]
+            res = DataFrame(lp.Project(res.plan, final), res.session)
+        return res
+
+    def _agg_impl(self, *aggs) -> DataFrame:
         agg_exprs = [_as_expr(a) for a in aggs]
 
         # DISTINCT aggregates: shared double-aggregate rewrite (pre-alias
@@ -346,7 +412,7 @@ class GroupedData:
              else ir.Alias(e, ir.output_name(e)) for e in agg_exprs])
         if plan2 is not self.df.plan:
             return GroupedData(DataFrame(plan2, self.df.session),
-                               groupings2).agg(*exprs2)
+                               groupings2)._agg_impl(*exprs2)
 
         if all(isinstance(e.children[0] if isinstance(e, ir.Alias) else e,
                           ir.AggregateExpression) for e in agg_exprs):

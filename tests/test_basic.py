@@ -255,3 +255,76 @@ def test_fused_filter_ladder_both_branches(monkeypatch):
             {"spark.rapids.tpu.sql.variableFloatAgg.enabled": True})
         out = q(got, thresh).collect()
         assert_tables_equal(cpu, out, ignore_order=True)
+
+
+def test_rollup_subtotals():
+    """rollup: per-prefix grouping sets through the Expand lowering
+    (GpuExpandExec analog), vs a pandas ground truth on both engines."""
+    import numpy as np
+    from spark_rapids_tpu import TpuSparkSession, functions as F
+    rng = np.random.default_rng(3)
+    t = pa.table({"a": pa.array(rng.integers(0, 3, 200)),
+                  "b": pa.array(rng.integers(0, 2, 200)),
+                  "v": pa.array(rng.integers(0, 50, 200))})
+    pd_ = t.to_pandas()
+    for conf in ({"spark.rapids.tpu.sql.variableFloatAgg.enabled": True},
+                 {"spark.rapids.tpu.sql.enabled": False}):
+        s = TpuSparkSession(conf)
+        out = (s.create_dataframe(t).rollup("a", "b")
+               .agg(F.sum("v").alias("sv"), F.count("*").alias("n"))
+               .collect().to_pandas())
+        assert len(out) == len(pd_.groupby(["a", "b"])) + \
+            len(pd_.groupby("a")) + 1
+        grand = out[out["a"].isna() & out["b"].isna()]
+        assert int(grand["sv"].iloc[0]) == int(pd_["v"].sum())
+        assert int(grand["n"].iloc[0]) == len(pd_)
+        lvl1 = out[out["a"].notna() & out["b"].isna()]
+        assert sorted(lvl1["sv"]) == \
+            sorted(pd_.groupby("a")["v"].sum().tolist())
+        detail = out[out["a"].notna() & out["b"].notna()]
+        assert sorted(detail["sv"]) == \
+            sorted(pd_.groupby(["a", "b"])["v"].sum().tolist())
+
+
+def test_cube_all_combinations():
+    import numpy as np
+    from spark_rapids_tpu import TpuSparkSession, functions as F
+    rng = np.random.default_rng(4)
+    t = pa.table({"a": pa.array(rng.integers(0, 3, 150)),
+                  "b": pa.array(rng.integers(0, 2, 150)),
+                  "v": pa.array(rng.integers(0, 9, 150))})
+    pd_ = t.to_pandas()
+    s = TpuSparkSession(
+        {"spark.rapids.tpu.sql.variableFloatAgg.enabled": True})
+    out = (s.create_dataframe(t).cube("a", "b")
+           .agg(F.sum("v").alias("sv")).collect().to_pandas())
+    # cube adds the b-only subtotal level rollup lacks
+    b_only = out[out["a"].isna() & out["b"].notna()]
+    assert sorted(b_only["sv"]) == \
+        sorted(pd_.groupby("b")["v"].sum().tolist())
+    assert len(out) == len(pd_.groupby(["a", "b"])) + \
+        len(pd_.groupby("a")) + len(pd_.groupby("b")) + 1
+    # the expand lowering really runs on device
+    from tests.parity import collect_plans
+    s2 = TpuSparkSession(
+        {"spark.rapids.tpu.sql.variableFloatAgg.enabled": True})
+    captured = collect_plans(s2)
+    (s2.create_dataframe(t).cube("a", "b")
+     .agg(F.sum("v").alias("sv")).collect())
+    names = []
+    captured[-1].plan.foreach(lambda n: names.append(type(n).__name__))
+    assert "TpuExpandExec" in names, names
+
+
+def test_rollup_natural_null_keys_stay_separate():
+    """A natural null key value at the detail level must not merge with
+    the subtotal row (the grouping id keeps them distinct)."""
+    from spark_rapids_tpu import TpuSparkSession, functions as F
+    t = pa.table({"a": pa.array([1, 1, None, None], type=pa.int64()),
+                  "v": pa.array([10, 20, 5, 7], type=pa.int64())})
+    s = TpuSparkSession(
+        {"spark.rapids.tpu.sql.variableFloatAgg.enabled": True})
+    out = (s.create_dataframe(t).rollup("a")
+           .agg(F.sum("v").alias("sv")).collect().to_pandas())
+    # rows: a=1 (30), a=null detail (12), grand total (42)
+    assert sorted(out["sv"].tolist()) == [12, 30, 42]
