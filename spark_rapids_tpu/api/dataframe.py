@@ -352,34 +352,13 @@ class DataFrame:
 
 def _grouping_sets(df: DataFrame, exprs: List[ir.Expression],
                    sets: List[tuple]) -> "GroupedData":
-    """Lower rollup/cube to Expand + Aggregate (Spark's grouping-sets
-    shape): replicate each row once per grouping set with the excluded
-    keys nulled and a Spark-compatible grouping id (bit i set = key i
-    aggregated away), group by (keys, gid), then rename the internal
-    key columns back and drop the gid."""
-    import copy as _copy
-    child = df.plan
-    s = child.schema
-    k = len(exprs)
-    bound = [ir.bind(_copy.deepcopy(e), s.names, s.dtypes, s.nullables)
-             for e in exprs]
-    g_internal = [f"__gset{i}" for i in range(k)]
-    g_public = [ir.output_name(e) for e in exprs]
-    projections = []
-    for S in sets:
-        gid = sum(1 << (k - 1 - i) for i in range(k) if i not in S)
-        projections.append(
-            [ir.UnresolvedAttribute(n) for n in s.names] +
-            [_copy.deepcopy(exprs[i]) if i in S
-             else ir.Literal(None, bound[i].dtype) for i in range(k)] +
-            [ir.Literal(gid, dt.INT64)])
-    expanded = lp.Expand(child, projections,
-                         list(s.names) + g_internal + ["__gid"])
-    gd = GroupedData(
-        DataFrame(expanded, df.session),
-        [ir.UnresolvedAttribute(n) for n in g_internal] +
-        [ir.UnresolvedAttribute("__gid")])
-    gd._gset_renames = dict(zip(g_internal, g_public))
+    """Lower rollup/cube to Expand + Aggregate (shared helper
+    lp.expand_grouping_sets); agg() renames the internal key columns
+    back and drops the gid."""
+    expanded, refs, renames = lp.expand_grouping_sets(df.plan, exprs,
+                                                      sets)
+    gd = GroupedData(DataFrame(expanded, df.session), refs)
+    gd._gset_renames = renames
     return gd
 
 

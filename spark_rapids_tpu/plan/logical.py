@@ -315,6 +315,39 @@ def rewrite_distinct_aggregates(plan: LogicalPlan, groupings, exprs):
     return inner, new_groupings, new_exprs
 
 
+def expand_grouping_sets(plan: LogicalPlan,
+                         exprs: Sequence[ir.Expression],
+                         sets: Sequence[tuple]):
+    """Lower rollup/cube-style grouping sets to an Expand (GpuExpandExec
+    analog): one projection per set with the excluded keys nulled and a
+    Spark-compatible grouping-id bitmask (bit i set = key i aggregated
+    away).  Returns (expanded_plan, internal_group_refs, renames) where
+    ``internal_group_refs`` are the (keys..., __gid) grouping
+    expressions for the downstream Aggregate and ``renames`` maps the
+    internal key names back to their public output names.  Keeping the
+    gid in the grouping keys keeps natural null key values at the
+    detail level from merging with subtotal rows."""
+    s = plan.schema
+    k = len(exprs)
+    bound = [ir.bind(copy.deepcopy(e), s.names, s.dtypes, s.nullables)
+             for e in exprs]
+    g_internal = [f"__gset{i}" for i in range(k)]
+    projections = []
+    for S in sets:
+        gid = sum(1 << (k - 1 - i) for i in range(k) if i not in S)
+        projections.append(
+            [ir.UnresolvedAttribute(n) for n in s.names] +
+            [copy.deepcopy(exprs[i]) if i in S
+             else ir.Literal(None, bound[i].dtype) for i in range(k)] +
+            [ir.Literal(gid, dt.INT64)])
+    expanded = Expand(plan, projections,
+                      list(s.names) + g_internal + ["__gid"])
+    refs = [ir.UnresolvedAttribute(n) for n in g_internal] + \
+        [ir.UnresolvedAttribute("__gid")]
+    renames = dict(zip(g_internal, [ir.output_name(e) for e in exprs]))
+    return expanded, refs, renames
+
+
 def _rewrite_multi_distinct(plan: LogicalPlan, groupings, exprs):
     """Expand-based multi-distinct rewrite (Spark's
     RewriteDistinctAggregates general shape,

@@ -406,12 +406,27 @@ class Parser:
 
         group_exprs: List[ir.Expression] = []
         has_group = False
+        rollup_kind = None
         if self.kw("group", "by"):
             has_group = True
-            while True:
-                group_exprs.append(self.expr(scope))
-                if not self.accept("op", ","):
-                    break
+            t = self.peek()
+            if t.kind in ("name", "kw") and \
+                    t.value.lower() in ("rollup", "cube") and \
+                    self.peek(1).kind == "op" and \
+                    self.peek(1).value == "(":
+                rollup_kind = t.value.lower()
+                self.next()
+                self.expect("op", "(")
+                while True:
+                    group_exprs.append(self.expr(scope))
+                    if not self.accept("op", ","):
+                        break
+                self.expect("op", ")")
+            else:
+                while True:
+                    group_exprs.append(self.expr(scope))
+                    if not self.accept("op", ","):
+                        break
 
         having = None
         if self.kw("having"):
@@ -432,6 +447,41 @@ class Parser:
         is_agg = has_group or having is not None or any(
             ir.collect(e, lambda n: isinstance(n, ir.AggregateExpression))
             for e in proj_exprs)
+
+        if rollup_kind is not None:
+            # GROUP BY ROLLUP/CUBE (...): lower through the shared
+            # Expand grouping-sets helper; key references anywhere in
+            # the projection/HAVING resolve to the NULLED grouping-set
+            # key columns, not the pass-through inputs
+            import itertools
+            k = len(group_exprs)
+            if rollup_kind == "rollup":
+                sets = [tuple(range(i)) for i in range(k, -1, -1)]
+            else:
+                sets = [s for n in range(k, -1, -1)
+                        for s in itertools.combinations(range(k), n)]
+            plan, refs, _renames = lp.expand_grouping_sets(
+                plan, group_exprs, sets)
+            keys = list(group_exprs)
+
+            def _key_repl(node):
+                for i, g in enumerate(keys):
+                    if ir.expr_eq(node, g):
+                        return ir.UnresolvedAttribute(f"__gset{i}")
+                return None
+
+            def _fix(e):
+                if isinstance(e, ir.Alias):
+                    return ir.Alias(
+                        ir.transform(e.children[0], _key_repl), e.alias)
+                return ir.Alias(ir.transform(e, _key_repl),
+                                ir.output_name(e))
+
+            proj_exprs = [_fix(e) for e in proj_exprs]
+            if having is not None:
+                having = ir.transform(having, _key_repl)
+            group_exprs = refs
+            scope = _Scope(plan.schema.names)
 
         plan, out_scope = self.lower_select(
             plan, scope, proj_exprs, group_exprs, having, is_agg)

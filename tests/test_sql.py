@@ -668,3 +668,32 @@ def test_multi_distinct_sql_and_global():
     assert out2.column("ca").to_pylist() == [pd_["a"].nunique()]
     assert out2.column("cb").to_pylist() == [pd_["b"].nunique()]
     assert out2.column("sa").to_pylist() == [int(pd_["a"].sum())]
+
+
+def test_sql_group_by_rollup_cube():
+    """GROUP BY ROLLUP/CUBE lower through the shared Expand
+    grouping-sets helper; key references resolve to the nulled
+    grouping-set columns (pandas ground truth)."""
+    import numpy as np
+    from spark_rapids_tpu import TpuSparkSession
+    rng = np.random.default_rng(8)
+    t = pa.table({"a": pa.array(rng.integers(0, 3, 200)),
+                  "b": pa.array(rng.integers(0, 2, 200)),
+                  "v": pa.array(rng.integers(0, 50, 200))})
+    pd_ = t.to_pandas()
+    for conf in ({"spark.rapids.tpu.sql.variableFloatAgg.enabled": True},
+                 {"spark.rapids.tpu.sql.enabled": False}):
+        s = TpuSparkSession(conf)
+        s.create_dataframe(t).create_or_replace_temp_view("r")
+        out = s.sql("SELECT a, b, sum(v) AS sv FROM r "
+                    "GROUP BY ROLLUP(a, b)").collect().to_pandas()
+        grand = out[out["a"].isna() & out["b"].isna()]
+        assert int(grand["sv"].iloc[0]) == int(pd_["v"].sum()), conf
+        lvl1 = out[out["a"].notna() & out["b"].isna()]
+        assert sorted(lvl1["sv"]) == \
+            sorted(pd_.groupby("a")["v"].sum().tolist()), conf
+        cube = s.sql("SELECT a, b, count(*) AS n FROM r "
+                     "GROUP BY CUBE(a, b)").collect().to_pandas()
+        b_only = cube[cube["a"].isna() & cube["b"].notna()]
+        assert sorted(b_only["n"]) == \
+            sorted(pd_.groupby("b").size().tolist()), conf
