@@ -42,6 +42,10 @@ class TpuSparkSession:
                 self.conf.get(cfg.MEM_SPILL_DIR) or None)
         else:
             spill.disable_catalog()
+        from spark_rapids_tpu.pyworker import pool as pyworker_pool
+        pyworker_pool.configure(self.conf)
+        from spark_rapids_tpu.shuffle import faults
+        faults.install_plan_from_conf(self.conf, fresh=True)
         with TpuSparkSession._lock:
             TpuSparkSession._active = self
         self._plan_listeners: List = []

@@ -287,6 +287,64 @@ SHUFFLE_PROCESS_NESTED_TRANSPORT = conf(
     "over its own device mesh — the DCN-over-ICI composition: "
     "intra-slice collectives per executor, TCP between executors).")
 
+SHUFFLE_FETCH_MAX_RETRIES = conf(
+    "spark.rapids.tpu.shuffle.fetch.maxRetries", 3,
+    "Max per-peer fetch retries in the shuffle iterator before the "
+    "failure escalates (to the CPU fallback when enabled, else to a "
+    "fetch-failed exception that re-runs the map stage). 0 disables "
+    "retries: any transport fault fails the fetch immediately with the "
+    "typed shuffle exceptions.", int)
+
+SHUFFLE_FETCH_RETRY_BACKOFF_MS = conf(
+    "spark.rapids.tpu.shuffle.fetch.retryBackoffMs", 50,
+    "Base backoff between shuffle fetch retries; doubles per attempt "
+    "with deterministic jitter (exponential backoff).", int)
+
+SHUFFLE_CONNECT_TIMEOUT_MS = conf(
+    "spark.rapids.tpu.shuffle.connectTimeoutMs", 5000,
+    "TCP shuffle transport connect timeout per attempt. A failed "
+    "connect is redialed once with backoff within a fetch attempt; the "
+    "overall retry budget is governed by fetch.maxRetries at the fetch "
+    "layer.", int)
+
+SHUFFLE_READ_TIMEOUT_MS = conf(
+    "spark.rapids.tpu.shuffle.readTimeoutMs", 10000,
+    "TCP shuffle transport read-watchdog window: a connection with "
+    "in-flight requests or posted receives that stays silent for two "
+    "consecutive windows fails them all (surfacing as a retryable "
+    "fetch failure); the double window guarantees an operation posted "
+    "mid-window a full window of budget. 0 disables.", int)
+
+SHUFFLE_CPU_FALLBACK = conf(
+    "spark.rapids.tpu.shuffle.fetch.cpuFallbackEnabled", True,
+    "After shuffle fetch retries and map-stage re-runs are exhausted, "
+    "re-read the affected partitions through the CPU shuffle block "
+    "store (recomputing the map side in-process) instead of failing "
+    "the query — the fall-back-to-Spark-shuffle contract.", bool)
+
+SHUFFLE_FAULT_PLAN = conf(
+    "spark.rapids.tpu.shuffle.test.faultPlan", "",
+    "Deterministic fault-injection plan for chaos testing, e.g. "
+    "'seed=7;tcp.server.data:drop@2;procpool.map_stage:kill@1:i0'. "
+    "See spark_rapids_tpu/shuffle/faults.py for the grammar and the "
+    "named injection points. Empty disables injection.")
+
+PYWORKER_HANDSHAKE_TIMEOUT_MS = conf(
+    "spark.rapids.tpu.python.worker.handshakeTimeoutMs", 20000,
+    "How long to wait for a spawned python worker to connect back and "
+    "authenticate before the spawn fails with PythonWorkerError.", int)
+
+PYWORKER_CLOSE_TIMEOUT_MS = conf(
+    "spark.rapids.tpu.python.worker.closeTimeoutMs", 5000,
+    "How long to wait for a python worker to exit cleanly on close "
+    "before it is hard-killed.", int)
+
+PYWORKER_MAX_RESPAWNS = conf(
+    "spark.rapids.tpu.python.worker.maxRespawns", 1,
+    "How many times a python-worker batch is transparently replayed on "
+    "a fresh worker after the worker process crashes mid-batch. 0 "
+    "disables replay (a crash surfaces as PythonWorkerError).", int)
+
 SHUFFLE_COMPRESSION_CODEC = conf(
     "spark.rapids.tpu.shuffle.compression.codec", "none",
     "Codec for serialized shuffle partitions: none, lz4 (pyarrow IPC "

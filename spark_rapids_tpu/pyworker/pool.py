@@ -6,6 +6,15 @@ many Python workers may touch the device at once
 (python/PythonWorkerSemaphore.scala:41).  Workers here never touch the
 TPU (host pandas only), but the semaphore still bounds host memory and
 process fan-out the same way.
+
+Fault tolerance: a worker process that dies mid-batch raises
+:class:`PythonWorkerCrash` (carrying the exit code), and
+``borrowed_worker`` transparently respawns a fresh worker and replays
+the in-flight batch up to ``python.worker.maxRespawns`` times — UDFs
+survive worker crashes the way Spark task retries survive executor
+death.  Timeouts (handshake, close) are config-driven via
+``configure()``; the seeded fault plan's ``pyworker.batch`` point can
+kill a worker mid-batch to exercise the replay path deterministically.
 """
 
 from __future__ import annotations
@@ -23,6 +32,7 @@ from typing import List, Optional, Tuple
 import cloudpickle
 import pyarrow as pa
 
+from spark_rapids_tpu import config as cfg
 from spark_rapids_tpu.pyworker import worker as wp
 
 
@@ -30,10 +40,48 @@ class PythonWorkerError(RuntimeError):
     """UDF raised in the worker; carries the remote traceback."""
 
 
+def _cogroup_payload(left: pa.Table, right: pa.Table) -> bytes:
+    """The cogroup batch wire framing, in exactly one place."""
+    l = wp.table_to_ipc(left)
+    return struct.pack("<I", len(l)) + l + wp.table_to_ipc(right)
+
+
+class PythonWorkerCrash(PythonWorkerError):
+    """The worker PROCESS died mid-operation (distinct from a UDF
+    error, after which the worker stays healthy)."""
+
+    def __init__(self, msg: str, exit_code: Optional[int] = None):
+        super().__init__(msg)
+        self.exit_code = exit_code
+
+
+# module-level knobs, overridable per-session via configure(conf)
+_settings = {
+    "handshake_timeout_s": cfg.PYWORKER_HANDSHAKE_TIMEOUT_MS.default
+    / 1000.0,
+    "close_timeout_s": cfg.PYWORKER_CLOSE_TIMEOUT_MS.default / 1000.0,
+    "max_respawns": cfg.PYWORKER_MAX_RESPAWNS.default,
+}
+
+
+def configure(conf) -> None:
+    """Apply a RapidsTpuConf's python-worker knobs process-wide (called
+    by TpuSparkSession on construction)."""
+    _settings["handshake_timeout_s"] = float(
+        conf.get(cfg.PYWORKER_HANDSHAKE_TIMEOUT_MS)) / 1000.0
+    _settings["close_timeout_s"] = float(
+        conf.get(cfg.PYWORKER_CLOSE_TIMEOUT_MS)) / 1000.0
+    _settings["max_respawns"] = int(conf.get(cfg.PYWORKER_MAX_RESPAWNS))
+
+
 class PythonWorker:
     """One worker subprocess speaking the frame protocol."""
 
-    def __init__(self):
+    def __init__(self, handshake_timeout_s: Optional[float] = None,
+                 close_timeout_s: Optional[float] = None):
+        self._close_timeout_s = (close_timeout_s
+                                 or _settings["close_timeout_s"])
+        handshake = handshake_timeout_s or _settings["handshake_timeout_s"]
         token = secrets.token_bytes(16)
         lsock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
         lsock.bind(("127.0.0.1", 0))
@@ -46,12 +94,40 @@ class PythonWorker:
             [sys.executable, "-m", "spark_rapids_tpu.pyworker.worker",
              str(port), token.hex()],
             env=env, stdin=subprocess.DEVNULL)
-        lsock.settimeout(20.0)
-        self.sock, _ = lsock.accept()
-        lsock.close()
-        got = wp._read_exact(self.sock, len(token))
+        lsock.settimeout(handshake)
+        sock = None
+        try:
+            sock, _ = lsock.accept()
+            # the auth read is part of the handshake contract too: an
+            # accepted socket does not inherit the listener timeout
+            sock.settimeout(handshake)
+            got = wp._read_exact(sock, len(token))
+        except (socket.timeout, EOFError, OSError) as e:
+            if sock is not None:
+                try:
+                    sock.close()
+                except OSError:
+                    pass
+            self.proc.kill()
+            rc = self.proc.wait()
+            cause = ("handshake timed out"
+                     if isinstance(e, socket.timeout)
+                     else f"handshake failed ({type(e).__name__}: {e})")
+            raise PythonWorkerError(
+                f"python worker {cause} after {handshake}s "
+                f"(worker exit code {rc})") from None
+        finally:
+            lsock.close()
+        sock.settimeout(None)
         if got != token:
+            try:
+                sock.close()
+            except OSError:
+                pass
+            self.proc.kill()
+            self.proc.wait()
             raise RuntimeError("python worker auth mismatch")
+        self.sock = sock
         # strong ref: identity comparison is only safe while we prevent
         # the old fn's id from being reused by a new object
         self._current: Optional[Tuple[str, object]] = None
@@ -60,16 +136,28 @@ class PythonWorker:
         if (self._current is not None and self._current[0] == mode
                 and self._current[1] is fn):
             return
-        wp.write_frame(self.sock, wp.OP_FUNC,
-                       cloudpickle.dumps((mode, fn)))
-        op, payload = wp.read_frame(self.sock)
+        try:
+            wp.write_frame(self.sock, wp.OP_FUNC,
+                           cloudpickle.dumps((mode, fn)))
+            op, payload = wp.read_frame(self.sock)
+        except (EOFError, OSError) as e:
+            raise self._crash("function handshake", e) from e
         if op != wp.OP_OK:
             raise PythonWorkerError(payload.decode("utf-8", "replace"))
         self._current = (mode, fn)
 
+    def _crash(self, what: str, cause) -> PythonWorkerCrash:
+        rc = self.proc.poll()
+        return PythonWorkerCrash(
+            f"python worker died during {what} "
+            f"(exit code {rc}): {cause}", exit_code=rc)
+
     def run(self, payload: bytes) -> pa.Table:
-        wp.write_frame(self.sock, wp.OP_BATCH, payload)
-        op, data = wp.read_frame(self.sock)
+        try:
+            wp.write_frame(self.sock, wp.OP_BATCH, payload)
+            op, data = wp.read_frame(self.sock)
+        except (EOFError, OSError) as e:
+            raise self._crash("batch", e) from e
         if op == wp.OP_ERR:
             raise PythonWorkerError(data.decode("utf-8", "replace"))
         return wp.ipc_to_table(data)
@@ -78,9 +166,7 @@ class PythonWorker:
         return self.run(wp.table_to_ipc(table))
 
     def run_cogroup(self, left: pa.Table, right: pa.Table) -> pa.Table:
-        l = wp.table_to_ipc(left)
-        r = wp.table_to_ipc(right)
-        return self.run(struct.pack("<I", len(l)) + l + r)
+        return self.run(_cogroup_payload(left, right))
 
     @property
     def alive(self) -> bool:
@@ -90,7 +176,7 @@ class PythonWorker:
         try:
             if self.alive:
                 wp.write_frame(self.sock, wp.OP_END)
-                self.proc.wait(timeout=5)
+                self.proc.wait(timeout=self._close_timeout_s)
         except Exception:
             self.proc.kill()
         finally:
@@ -160,29 +246,90 @@ class PythonWorkerPool:
             w.close()
 
 
+class ResilientWorker:
+    """Worker facade with crash recovery: a :class:`PythonWorkerCrash`
+    mid-batch respawns a fresh worker (re-running the function
+    handshake) and replays the in-flight payload, up to
+    ``python.worker.maxRespawns`` times.  UDF errors (OP_ERR) are NOT
+    retried — the worker is healthy and the error is the answer."""
+
+    def __init__(self, pool: PythonWorkerPool, mode: str, fn,
+                 worker: PythonWorker):
+        self._pool = pool
+        self._mode = mode
+        self._fn = fn
+        self.worker = worker
+
+    def _run_with_replay(self, payload: bytes) -> pa.Table:
+        from spark_rapids_tpu.shuffle import faults
+        attempts = _settings["max_respawns"] + 1
+        last: Optional[PythonWorkerCrash] = None
+        for _attempt in range(attempts):
+            try:
+                if last is not None:
+                    # previous attempt crashed: respawn + re-handshake.
+                    # Inside the try so a crash DURING the handshake
+                    # consumes an attempt instead of escaping the loop.
+                    faults.get_fault_stats().incr("worker_respawns")
+                    self.worker = self._pool.acquire()
+                    self.worker.set_function(self._mode, self._fn)
+                plan = faults.get_fault_plan()
+                ev = plan.check("pyworker.batch") if plan else None
+                if ev is not None and \
+                        ev.action == faults.FaultAction.KILL:
+                    self.worker.proc.kill()
+                    self.worker.proc.wait()
+                return self.worker.run(payload)
+            except PythonWorkerCrash as e:
+                last = e
+                self.worker.close()
+        raise last
+
+    # the exec-facing surface mirrors PythonWorker
+    def set_function(self, mode: str, fn) -> None:
+        self._mode, self._fn = mode, fn
+        self.worker.set_function(mode, fn)
+
+    @property
+    def alive(self) -> bool:
+        return self.worker.alive
+
+    def run(self, payload: bytes) -> pa.Table:
+        return self._run_with_replay(payload)
+
+    def run_table(self, table: pa.Table) -> pa.Table:
+        return self.run(wp.table_to_ipc(table))
+
+    def run_cogroup(self, left: pa.Table, right: pa.Table) -> pa.Table:
+        return self.run(_cogroup_payload(left, right))
+
+
 class borrowed_worker:
     """``with borrowed_worker(mode, fn) as w:`` — semaphore + pool + fn
-    handshake in one scope."""
+    handshake in one scope; ``w`` is a :class:`ResilientWorker` that
+    survives worker-process crashes by respawn-and-replay."""
 
     def __init__(self, mode: str, fn):
         self.mode = mode
         self.fn = fn
         self.pool = PythonWorkerPool.get()
 
-    def __enter__(self) -> PythonWorker:
+    def __enter__(self) -> ResilientWorker:
         self.pool.semaphore.__enter__()
-        self.worker = self.pool.acquire()
+        worker = self.pool.acquire()
         try:
-            self.worker.set_function(self.mode, self.fn)
+            worker.set_function(self.mode, self.fn)
         except Exception:
             self.pool.semaphore.__exit__(None, None, None)
-            self.worker.close()
+            worker.close()
             raise
-        return self.worker
+        self.resilient = ResilientWorker(self.pool, self.mode, self.fn,
+                                         worker)
+        return self.resilient
 
     def __exit__(self, exc_type, exc, tb):
         # a failed UDF leaves the worker healthy (it replied OP_ERR);
-        # only a dead process is discarded
-        self.pool.release(self.worker)
+        # only a dead process is discarded (release() checks liveness)
+        self.pool.release(self.resilient.worker)
         self.pool.semaphore.__exit__(exc_type, exc, tb)
         return False

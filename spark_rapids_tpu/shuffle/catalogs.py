@@ -218,6 +218,13 @@ class ShuffleReceivedBufferCatalog:
                 schema=schema)
         return deserialize_table(rb.data)
 
+    def free(self, temp_id: int) -> None:
+        """Drop a received buffer without materializing it — the
+        iterator's error path releases undelivered fetches so an aborted
+        read doesn't leak catalog entries."""
+        with self._lock:
+            self._received.pop(temp_id, None)
+
     @property
     def pending(self) -> int:
         with self._lock:

@@ -13,7 +13,7 @@ from __future__ import annotations
 
 import itertools
 import threading
-from typing import Callable, List, Optional
+from typing import Callable, List, Optional, Set
 
 from spark_rapids_tpu.shuffle import meta as wire
 from spark_rapids_tpu.shuffle.catalogs import ShuffleReceivedBufferCatalog
@@ -24,7 +24,13 @@ from spark_rapids_tpu.shuffle.transport import (BounceBufferManager,
                                                 TransactionStatus,
                                                 WindowedBlockIterator)
 
-_tags = itertools.count(0x7100_0000)
+# window sequencing: window i of a transfer moves under tag base+i, so
+# a lost window leaves its posted receive unmatched (a clean, detectable
+# hole) instead of silently misaligning every later window.  The stride
+# keeps concurrent fetches' tag ranges disjoint (up to 2^20 windows per
+# transfer).
+_TAG_STRIDE = 1 << 20
+_tags = itertools.count(0x7100_0000, _TAG_STRIDE)
 
 
 def _once(fn):
@@ -43,6 +49,86 @@ def _once(fn):
 
 class ShuffleClientException(Exception):
     pass
+
+
+class FetchHandle:
+    """Live state of one ``do_fetch`` attempt.
+
+    Retry support: ``completed_buffer_ids`` records every block that was
+    fully received and registered (its wire ``buffer_id``), so a retry
+    can re-issue the fetch for only the missing map outputs.
+    ``cancel()`` detaches the attempt — late windows are dropped instead
+    of being registered, and the outstanding receive's bounce buffer /
+    inflight budget are returned (satisfying the iterator's
+    cancel-outstanding-fetches contract).
+    """
+
+    def __init__(self):
+        self.completed_buffer_ids: Set[int] = set()
+        self._live = True
+        self._lock = threading.Lock()
+        self._pending_tx: Optional[Transaction] = None
+        self._on_cancel: Optional[Callable[[], None]] = None
+
+    @property
+    def live(self) -> bool:
+        with self._lock:
+            return self._live
+
+    def set_cleanup(self, fn: Callable[[], None]) -> None:
+        """Install the cancel-time cleanup, mutually exclusive with
+        cancel(): if the attempt is already cancelled, run it now
+        instead of dropping it on the floor."""
+        with self._lock:
+            if self._live:
+                self._on_cancel = fn
+                return
+        fn()
+
+    def record_completed(self, buffer_id: int) -> bool:
+        """Atomically record a fully-received block — mutually exclusive
+        with :meth:`cancel`, so a retry's skip-set snapshot taken after
+        cancel() can never miss a block that is about to be delivered
+        (which would deliver it twice) nor include one that was dropped.
+        Returns False when the attempt was already cancelled: the caller
+        must discard the block instead of delivering it."""
+        with self._lock:
+            if not self._live:
+                return False
+            self.completed_buffer_ids.add(buffer_id)
+            return True
+
+    def _track(self, tx: Optional[Transaction]) -> None:
+        with self._lock:
+            if self._live:
+                self._pending_tx = tx
+                return
+        # posted concurrently with cancel(): the receive must not
+        # escape cancellation (it would pin its bounce buffer/inflight
+        # budget and hold the idle watchdog's has-pending check true)
+        if tx is not None and tx.status == TransactionStatus.IN_PROGRESS:
+            tx.complete(TransactionStatus.CANCELLED)
+
+    def finish(self) -> None:
+        """Mark the attempt complete: later cancel()/cleanup become
+        no-ops, so a SUCCESSFUL fetch (whose iterator still aborts in
+        its finally) never pays the cancel-time straggler discard."""
+        with self._lock:
+            self._live = False
+            self._on_cancel = None
+            self._pending_tx = None
+
+    def cancel(self) -> None:
+        with self._lock:
+            if not self._live:
+                return
+            self._live = False
+            tx, self._pending_tx = self._pending_tx, None
+            cleanup, self._on_cancel = self._on_cancel, None
+        if tx is not None and tx.status == TransactionStatus.IN_PROGRESS:
+            tx.complete(TransactionStatus.CANCELLED)
+        if cleanup is not None:
+            cleanup()
 
 
 class BufferReceiveState:
@@ -103,17 +189,34 @@ class RapidsShuffleClient:
     def do_fetch(self, shuffle_id: int, reduce_id: int,
                  map_ids: Optional[List[int]],
                  on_batch: Callable[[int], None],
-                 on_done: Callable[[Optional[str]], None]) -> None:
+                 on_done: Callable[[Optional[str]], None],
+                 skip_buffer_ids: Optional[Set[int]] = None
+                 ) -> FetchHandle:
         """Fetch all of this peer's blocks for (shuffle, reduce).
 
         ``on_batch(temp_id)`` fires per arrived block (already in the
         received catalog); ``on_done(error)`` fires once at the end with
         None on success (reference: RapidsShuffleFetchHandler).
+
+        ``skip_buffer_ids`` supports per-peer retry: blocks whose wire
+        buffer id is in the set were already delivered by a previous
+        attempt and are neither re-requested nor re-delivered, so only
+        the missing map outputs move again.  Returns a
+        :class:`FetchHandle` tracking the attempt.
         """
-        on_done = _once(on_done)
+        user_done = _once(on_done)
+        handle = FetchHandle()
+
+        def on_done(err: Optional[str]) -> None:
+            if err is None:
+                handle.finish()
+            user_done(err)
+
         req = wire.MetadataRequest(shuffle_id, reduce_id, map_ids or [])
 
         def on_meta(tx: Transaction) -> None:
+            if not handle.live:
+                return
             if tx.status != TransactionStatus.SUCCESS:
                 on_done(f"metadata fetch failed: {tx.error_message}")
                 return
@@ -122,19 +225,32 @@ class RapidsShuffleClient:
             except Exception as e:  # malformed frame = fetch failure
                 on_done(f"bad metadata response: {e}")
                 return
-            self._issue_buffer_receives(resp.tables, on_batch, on_done)
+            self._issue_buffer_receives(resp.tables, on_batch, on_done,
+                                        handle, skip_buffer_ids)
 
         self.connection.request(req.pack(), on_meta)
+        return handle
 
     # -- phase 2: buffer receives -----------------------------------------
     def _issue_buffer_receives(self, tables: List[wire.TableMeta],
-                               on_batch, on_done) -> None:
+                               on_batch, on_done, handle: FetchHandle,
+                               skip_buffer_ids: Optional[Set[int]] = None
+                               ) -> None:
         """issueBufferReceives analog (RapidsShuffleClient.scala:293)."""
-        # degenerate batches carry no payload: complete immediately
+        # degenerate batches carry no payload: complete immediately.
+        # They have no buffer id to track, so only the first attempt
+        # (skip_buffer_ids is None; retries pass a set, possibly empty)
+        # delivers them — a retry would duplicate them otherwise.
         real: List[wire.TableMeta] = []
         for tm in tables:
             if tm.is_degenerate:
-                on_batch(self.received.add(tm, b""))
+                if skip_buffer_ids is None:
+                    on_batch(self.received.add(tm, b""))
+            elif skip_buffer_ids and \
+                    tm.buffer_meta.buffer_id in skip_buffer_ids:
+                # through the handle lock: the iterator's retry-time
+                # set union must never race a bare set.add
+                handle.record_completed(tm.buffer_meta.buffer_id)
             else:
                 real.append(tm)
         if not real:
@@ -143,12 +259,21 @@ class RapidsShuffleClient:
 
         state = BufferReceiveState(real, self.bounce_window)
         tag = next(_tags)
-        pending: dict = {"tx": None}
+        win = {"i": 0}
+
+        # a cancelled transfer's stale windows (the server may keep
+        # streaming the old tag sequence) must not pin payload bytes on
+        # a healthy connection: drop this attempt's whole tag range
+        discard = getattr(self.connection, "discard_tag_range", None)
+        if discard is not None:
+            handle.set_cleanup(lambda: discard(tag, tag + _TAG_STRIDE))
 
         def post_receive() -> None:
             if not state.has_next():
                 on_done(None)
                 return
+            wtag = tag + win["i"]
+            win["i"] += 1
             if self.inflight is not None:
                 self.inflight.acquire(self.bounce_window)
             bounce = (self.recv_bounce.acquire() if self.recv_bounce
@@ -163,28 +288,31 @@ class RapidsShuffleClient:
                     self.inflight.release(self.bounce_window)
                 if tx.status == TransactionStatus.CANCELLED:
                     return
+                if not handle.live:
+                    return  # cancelled attempt: drop late windows
                 try:
                     if tx.status != TransactionStatus.SUCCESS:
                         on_done(f"buffer receive failed: {tx.error_message}")
                         return
                     for idx in state.consume_window(tx.payload):
                         tm = real[idx]
+                        if not handle.record_completed(
+                                tm.buffer_meta.buffer_id):
+                            return  # cancelled mid-window: drop the rest
                         on_batch(self.received.add(tm, state.payload(idx)))
                 except ShuffleClientException as e:
                     on_done(str(e))
                     return
                 post_receive()
 
-            pending["tx"] = self.connection.receive(
-                tag, self.bounce_window, on_window)
+            handle._track(self.connection.receive(
+                wtag, self.bounce_window, on_window))
 
         def abort(message: str) -> None:
             """Fail the fetch and cancel the outstanding receive so its
             bounce buffer and inflight budget are returned to the pools."""
             on_done(message)
-            tx = pending["tx"]
-            if tx is not None and tx.status == TransactionStatus.IN_PROGRESS:
-                tx.complete(TransactionStatus.CANCELLED)
+            handle.cancel()
 
         # post the first window's receive BEFORE asking the server to
         # stream, so no window can race past an unposted receive

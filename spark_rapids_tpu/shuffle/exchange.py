@@ -529,6 +529,22 @@ class TpuShuffleExchangeExec(TpuExec):
                                   jnp.asarray(offset, dtype=jnp.int32),
                                   jnp.asarray(count, dtype=jnp.int32))
 
+    def _input_batches(self):
+        """Device input batches for an in-process map side; range
+        partitioning needs the global rank, so its whole input coalesces
+        into one batch (same contract as total sort)."""
+        if isinstance(self.partitioning, RangePartitioning):
+            all_b = []
+            for it in self.children[0].execute():
+                all_b.extend(b for b in it if int(b.num_rows))
+            if all_b:
+                yield concat_batches(all_b)
+            return
+        for it in self.children[0].execute():
+            for b in it:
+                if int(b.num_rows):
+                    yield b
+
     # two simulated executors: map task m lands on exec-(m % 2), so every
     # read exercises both the local-catalog and the remote-fetch paths
     _MANAGER_EXECUTORS = 2
@@ -586,6 +602,7 @@ class TpuShuffleExchangeExec(TpuExec):
         stage is re-run on a respawned executor (the Spark stage-retry
         semantics, RapidsShuffleIterator.scala:188)."""
         import threading
+        from spark_rapids_tpu.shuffle import faults
         from spark_rapids_tpu.shuffle.catalogs import \
             ShuffleReceivedBufferCatalog
         from spark_rapids_tpu.shuffle.client import RapidsShuffleClient
@@ -600,23 +617,102 @@ class TpuShuffleExchangeExec(TpuExec):
             cfg.SHUFFLE_PROCESS_EXECUTORS)), 1)
         nested_transport = str(self.conf_obj.get(
             cfg.SHUFFLE_PROCESS_NESTED_TRANSPORT))
+        max_retries = int(self.conf_obj.get(cfg.SHUFFLE_FETCH_MAX_RETRIES))
+        backoff_ms = float(self.conf_obj.get(
+            cfg.SHUFFLE_FETCH_RETRY_BACKOFF_MS))
+        cpu_fallback = bool(self.conf_obj.get(cfg.SHUFFLE_CPU_FALLBACK))
+        tcp_conf_extra = {
+            "connect_timeout_ms": self.conf_obj.get(
+                cfg.SHUFFLE_CONNECT_TIMEOUT_MS),
+            "read_timeout_ms": self.conf_obj.get(
+                cfg.SHUFFLE_READ_TIMEOUT_MS),
+            # the iterator already retries whole fetch attempts
+            # (fetch.maxRetries); nesting the full budget here would
+            # square the connect attempts to a dead peer
+            "connect_max_retries": 1 if max_retries > 0 else 0,
+            "connect_backoff_ms": backoff_ms,
+        }
+        faults.install_plan_from_conf(self.conf_obj)
+        stats = faults.get_fault_stats()
         state = {"done": False, "sid": None, "pool": None,
                  "transport": None, "received": None, "maps": {},
-                 "clients": {}, "reads_left": n_parts, "epoch": 0}
+                 "clients": {}, "reads_left": n_parts, "epoch": 0,
+                 "fb_store": None, "stats_base": stats.snapshot()}
         lock = threading.Lock()
+        fb_lock = threading.Lock()  # guards only the fallback store
+
+        def stamp_fault_stats() -> None:
+            """Per-query ShuffleFaultStats view: delta of the process
+            counters since this exchange started, into Metrics.extra
+            (the explain/metrics surface).  Known limit: exchanges
+            executing concurrently in one process share the counters,
+            so their deltas can include each other's recovery work —
+            localization, not accounting."""
+            snap = stats.snapshot()
+            base = state["stats_base"]
+            for k in faults.ShuffleFaultStats.FIELDS:
+                self.metrics.extra[f"shuffle.{k}"] = \
+                    snap.get(k, 0) - base.get(k, 0)
+            if state.get("recover_error"):
+                self.metrics.extra["shuffle.recover_error"] = \
+                    state["recover_error"]
+
+        def check_map_stage_faults(pool, submitted_idx) -> None:
+            """FaultPlan consultation per completed map-stage submission
+            (generalizes the old one-off procpool.kill test hook): a
+            KILL event hard-kills the targeted executor (rule arg) or
+            the one that just ran."""
+            plan = faults.get_fault_plan()
+            if plan is None:
+                return
+            ev = plan.check("procpool.map_stage")
+            if ev is not None and ev.action == faults.FaultAction.KILL:
+                pool.kill(ev.arg if ev.arg is not None else submitted_idx)
 
         def client_for(eid: str):
             """One RapidsShuffleClient per peer (its transfer-tag counter
             must be shared by every fetch on the connection); rebuilt if
-            the connection died (ShuffleEnv.client_for idiom)."""
-            c = state["clients"].get(eid)
-            if c is not None and getattr(c.connection, "closed", False):
-                c = None
-            if c is None:
-                c = RapidsShuffleClient(
-                    state["transport"].make_client(eid),
-                    state["received"])
-                state["clients"][eid] = c
+            the connection died (ShuffleEnv.client_for idiom).  The dial
+            itself (connect timeouts + backoff sleeps) runs OUTSIDE the
+            exchange lock so a dead peer can't serialize every reader
+            behind its connect attempts; only cache access locks."""
+            with lock:
+                c = state["clients"].get(eid)
+                if c is not None and not getattr(c.connection, "closed",
+                                                 False):
+                    return c
+                state["clients"].pop(eid, None)
+                transport = state["transport"]
+                received = state["received"]
+            try:
+                conn = transport.make_client(eid)
+            except KeyError:
+                # peer vanished from the address book (killed before it
+                # was ever dialed): a data-plane error, so the fetch
+                # fails and recovery runs — not a caller crash
+                from spark_rapids_tpu.shuffle.tcp import \
+                    _DeadClientConnection
+                conn = _DeadClientConnection(f"unknown peer {eid}")
+            c = RapidsShuffleClient(conn, received)
+            with lock:
+                cur = state["clients"].get(eid)
+                if cur is not None and not getattr(
+                        cur.connection, "closed", False):
+                    winner = cur  # a concurrent dial won; use its client
+                else:
+                    state["clients"][eid] = c
+                    winner = None
+            if winner is not None:
+                # don't leak the losing dial's socket — but the
+                # transport may have deduped and handed us the winner's
+                # own connection, which must stay open
+                close = getattr(conn, "close", None)
+                if conn is not winner.connection and close is not None:
+                    try:
+                        close()
+                    except OSError:
+                        pass
+                return winner
             return c
 
         def submit(pool, exec_idx: int, sid: int):
@@ -660,11 +756,21 @@ class TpuShuffleExchangeExec(TpuExec):
                         h, mids = r
                         if mids:
                             state["maps"][h.executor_id] = (e, list(mids))
+                    # address book BEFORE fault consultation: a killed
+                    # executor must stay addressable so its death
+                    # surfaces as a (recoverable) connect failure, not
+                    # an unknown peer
+                    peers = pool.peers()
+                    # deterministic consultation order: after the join,
+                    # sequentially per executor index
+                    for e in range(n_execs):
+                        check_map_stage_faults(pool, e)
                 state["sid"] = sid
                 state["pool"] = pool
                 state["received"] = ShuffleReceivedBufferCatalog()
                 state["transport"] = TcpShuffleTransport(
-                    f"driver-{sid}", {"peers": pool.peers()})
+                    f"driver-{sid}",
+                    dict(tcp_conf_extra, peers=peers, seed=sid))
                 self.metrics.extra["process_executors"] = \
                     len(state["maps"]) or n_execs
                 state["done"] = True
@@ -683,16 +789,61 @@ class TpuShuffleExchangeExec(TpuExec):
                 lost = [(eid, ei) for eid, (ei, _) in state["maps"].items()
                         if eid not in live]
                 for eid, exec_idx in lost:
-                    del state["maps"][eid]
+                    # re-submit BEFORE dropping the dead entry: if the
+                    # respawn itself fails, readers must keep seeing the
+                    # dead peer and failing loudly — removing it first
+                    # would let them silently return partial results
                     h, mids = submit(pool, exec_idx, state["sid"])
+                    del state["maps"][eid]
                     if mids:
                         state["maps"][h.executor_id] = (exec_idx,
                                                         list(mids))
                     state["transport"].add_peer(h.executor_id,
                                                 "127.0.0.1", h.port)
+                    check_map_stage_faults(pool, exec_idx)
                 if lost:
                     state["epoch"] += 1
                 return bool(lost)
+
+        def fallback_tables(pidx: int) -> List[pa.Table]:
+            """CPU-fallback read: recompute the map side in-process into
+            a host ShuffleBlockStore (the stock sort-shuffle path) and
+            serve the partition from it — the reference's
+            fall-back-to-Spark-shuffle contract when the accelerated
+            data plane is unrecoverable."""
+            stats.incr("fallbacks")
+
+            class _StoreCatalog:
+                """register_batch adapter: lets run_map_stage write the
+                host block store, so the fallback recompute shares the
+                EXACT distributed map-side code path — identical
+                row->partition mapping by construction (round-robin's
+                per-map-task rows_seen reset included).  The store key
+                is a fresh sequence number per registered block: the
+                store's (map, reduce) key would otherwise overwrite
+                earlier batches of a multi-batch map task (the real
+                catalog appends a new block per call)."""
+
+                def __init__(self, store):
+                    self.store = store
+                    self._seq = itertools.count()
+
+                def register_batch(self, _sid, _map_id, reduce_id,
+                                   batch):
+                    self.store.put(next(self._seq), reduce_id,
+                                   to_arrow(batch))
+
+            # dedicated lock: the (potentially long) map-side recompute
+            # must not stall healthy readers that only need the
+            # exchange-wide lock for cache/bookkeeping accesses
+            with fb_lock:
+                store = state["fb_store"]
+                if store is None:
+                    store = ShuffleBlockStore(self.codec_name)
+                    self.run_map_stage(0, _StoreCatalog(store),
+                                       n_execs=1, exec_idx=0)
+                    state["fb_store"] = store
+            return store.fetch(pidx)
 
         def release():
             with lock:
@@ -718,26 +869,57 @@ class TpuShuffleExchangeExec(TpuExec):
                     recv = state["received"]
                     maps = dict(state["maps"])
                     epoch = state["epoch"]
-                    remotes = [
-                        RemoteSource(eid, client_for(eid), list(mids))
-                        for eid, (_ei, mids) in sorted(maps.items())]
+                # clients dialed outside the lock (client_for locks only
+                # around its cache accesses)
+                remotes = [
+                    RemoteSource(eid, client_for(eid), list(mids),
+                                 refresh=lambda e=eid: client_for(e))
+                    for eid, (_ei, mids) in sorted(maps.items())]
                 if not remotes:
-                    return
-                it = RapidsShuffleIterator(sid, pidx, None, remotes,
-                                           recv, timeout_s=30.0)
+                    tables = []
+                    break
+                it = RapidsShuffleIterator(
+                    sid, pidx, None, remotes, recv, timeout_s=30.0,
+                    max_retries=max_retries,
+                    retry_backoff_ms=backoff_ms)
                 try:
                     tables = [t for t in it if t.num_rows]
                     break
                 except (RapidsShuffleFetchFailedException,
                         RapidsShuffleTimeoutException):
-                    if not recover(epoch):
-                        raise   # nothing dead: a real protocol failure
+                    try:
+                        recovered = recover(epoch)
+                    except Exception as rec_exc:
+                        # respawn itself crash-looped: not recovered,
+                        # but keep the cause visible (the fallback or
+                        # the raise below must not erase a product bug)
+                        recovered = False
+                        state["recover_error"] = (
+                            f"{type(rec_exc).__name__}: {rec_exc}")
+                    if not recovered:
+                        # nothing dead: a real protocol failure — degrade
+                        # to the CPU block store instead of failing the
+                        # query (fall-back-to-Spark-shuffle contract)
+                        if cpu_fallback:
+                            tables = [t for t in fallback_tables(pidx)
+                                      if t.num_rows]
+                            break
+                        stamp_fault_stats()
+                        raise
             else:
-                # retries exhausted (crash-looping executor): surface the
-                # failure — an empty yield would silently drop rows
-                raise RapidsShuffleFetchFailedException(
-                    f"shuffle {state['sid']} reduce {pidx}: map stage "
-                    f"retries exhausted after {n_execs + 2} attempts")
+                # map-stage retries exhausted (crash-looping executor):
+                # CPU fallback if allowed, else surface the failure — an
+                # empty yield would silently drop rows
+                if cpu_fallback:
+                    tables = [t for t in fallback_tables(pidx)
+                              if t.num_rows]
+                else:
+                    stamp_fault_stats()
+                    raise RapidsShuffleFetchFailedException(
+                        f"shuffle {state['sid']} reduce {pidx}: map "
+                        f"stage retries exhausted after {n_execs + 2} "
+                        "attempts")
+            stamp_fault_stats()
             if not tables:
                 return
             t = concat_tables(tables, self.schema)
@@ -858,24 +1040,9 @@ class TpuShuffleExchangeExec(TpuExec):
             dev_slices: List[List[DeviceBatch]] = \
                 [[] for _ in range(n_parts)]
 
-            def input_batches():
-                # range partitioning needs the global rank: coalesce the
-                # whole input into one batch (same contract as total sort)
-                if isinstance(self.partitioning, RangePartitioning):
-                    all_b = []
-                    for it in self.children[0].execute():
-                        all_b.extend(b for b in it if int(b.num_rows))
-                    if all_b:
-                        yield concat_batches(all_b)
-                    return
-                for it in self.children[0].execute():
-                    for b in it:
-                        if int(b.num_rows):
-                            yield b
-
             m = 0
             rows_seen = 0
-            for batch in input_batches():
+            for batch in self._input_batches():
                 reordered, counts = self._partition_one(batch, rows_seen)
                 rows_seen += int(batch.num_rows)
                 off = 0

@@ -1,0 +1,281 @@
+"""Deterministic fault injection + fault statistics for the shuffle
+data plane.
+
+Reference analog: the reference proves its recovery paths by injecting
+failures into the transport state machines from tests
+(RapidsShuffleClientSuite / RapidsShuffleServerSuite, SURVEY.md §4.2)
+and by killing executors to exercise fetch-failed -> map-stage-retry
+(RapidsShuffleIterator.scala:188).  This module generalizes the one-off
+``procpool.kill(i)`` hook into a reusable, seeded harness: a
+config-driven :class:`FaultPlan` that production code consults at named
+injection points, so chaos runs are reproducible bit-for-bit.
+
+Injection points (consulted via ``plan.check(point)``):
+
+=====================  =====================================================
+point                  consulted
+=====================  =====================================================
+``tcp.connect``        once per client socket connect attempt (CLOSE =>
+                       the attempt fails as if refused)
+``tcp.client.data``    once per DATA frame the client reader receives
+                       (DROP discards the frame, CLOSE drops the
+                       connection, CORRUPT flips payload bytes, DELAY
+                       sleeps before delivery)
+``tcp.server.data``    once per DATA frame the server streams (DROP
+                       silently skips the send, CLOSE closes the peer
+                       socket mid-window, DELAY sleeps before sending)
+``pyworker.batch``     once per batch shipped to a python worker (KILL
+                       hard-kills the worker process mid-batch)
+``procpool.map_stage``  once per completed map-stage submission (KILL
+                       hard-kills the executor that just finished, or
+                       the one named by the rule's ``i<idx>`` field)
+=====================  =====================================================
+
+Plan spec grammar (``spark.rapids.tpu.shuffle.test.faultPlan``)::
+
+    spec      := directive (";" directive)*
+    directive := "seed=" INT
+               | point ":" action [ "@" N ] ( ":" field )*
+    field     := "x" M    max fires (default 1)
+               | "p" P    fire with probability P per consultation
+                          (seeded; alternative to "@N")
+               | "d" MS   delay milliseconds (DELAY action)
+               | "i" IDX  target index (e.g. executor index for KILL)
+
+``@N`` arms the rule starting at the Nth consultation of its point
+(1-based); it then fires on every later consultation until ``x`` fires
+have happened.  With neither ``@N`` nor ``pP`` the rule is armed from
+the first consultation.  Example::
+
+    seed=7;tcp.server.data:drop@2;tcp.client.data:close@5;pyworker.batch:kill@1
+
+drops the 2nd DATA frame streamed, closes the client socket on what
+would be the 5th DATA frame received, and kills the first python worker
+batch — identically on every run.
+"""
+
+from __future__ import annotations
+
+import enum
+import random
+import re
+import threading
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional
+
+
+class FaultAction(enum.Enum):
+    DROP = "drop"
+    DELAY = "delay"
+    CLOSE = "close"
+    CORRUPT = "corrupt"
+    KILL = "kill"
+
+
+@dataclass
+class FaultRule:
+    point: str
+    action: FaultAction
+    at: Optional[int] = None      # first consultation (1-based) to arm at
+    prob: float = 0.0             # alternative: seeded per-consult chance
+    delay_ms: float = 0.0
+    max_fires: int = 1
+    arg: Optional[int] = None     # action-specific index (e.g. executor)
+    fires: int = 0
+
+
+@dataclass(frozen=True)
+class FaultEvent:
+    """One fault decision returned by :meth:`FaultPlan.check`."""
+    point: str
+    action: FaultAction
+    delay_s: float = 0.0
+    arg: Optional[int] = None
+
+
+class ShuffleFaultStats:
+    """Per-process counter block for the recovery machinery (retries,
+    reconnects, fallbacks, ...), surfaced through ``Metrics.extra`` by
+    the exchange (the per-query view is a snapshot delta)."""
+
+    FIELDS = ("retries", "reconnects", "fallbacks", "timeouts",
+              "injected_faults", "worker_respawns")
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._counts: Dict[str, int] = {k: 0 for k in self.FIELDS}
+
+    def incr(self, name: str, n: int = 1) -> None:
+        with self._lock:
+            self._counts[name] = self._counts.get(name, 0) + n
+
+    def get(self, name: str) -> int:
+        with self._lock:
+            return self._counts.get(name, 0)
+
+    def snapshot(self) -> Dict[str, int]:
+        with self._lock:
+            return dict(self._counts)
+
+    def reset(self) -> None:
+        with self._lock:
+            self._counts = {k: 0 for k in self.FIELDS}
+
+    def __repr__(self) -> str:
+        return f"ShuffleFaultStats({self.snapshot()})"
+
+
+class FaultPlan:
+    """Seeded, deterministic fault schedule.
+
+    ``check(point)`` is cheap and thread-safe: it bumps the point's
+    consultation counter and returns the first armed rule's
+    :class:`FaultEvent` (or None).  Determinism: occurrence-based rules
+    (``@N``) depend only on consultation order at that point;
+    probability rules draw from one seeded RNG under the plan lock.
+    """
+
+    def __init__(self, rules: List[FaultRule], seed: int = 0):
+        self.rules = list(rules)
+        self.seed = seed
+        self._rng = random.Random(seed)
+        self._counts: Dict[str, int] = {}
+        self._lock = threading.Lock()
+
+    def check(self, point: str) -> Optional[FaultEvent]:
+        with self._lock:
+            n = self._counts.get(point, 0) + 1
+            self._counts[point] = n
+            for r in self.rules:
+                if r.point != point or r.fires >= r.max_fires:
+                    continue
+                if r.prob > 0.0:
+                    if self._rng.random() >= r.prob:
+                        continue
+                elif r.at is not None and n < r.at:
+                    continue
+                r.fires += 1
+                get_fault_stats().incr("injected_faults")
+                return FaultEvent(point, r.action, r.delay_ms / 1000.0,
+                                  r.arg)
+        return None
+
+    def consultations(self, point: str) -> int:
+        with self._lock:
+            return self._counts.get(point, 0)
+
+    @property
+    def total_fires(self) -> int:
+        with self._lock:
+            return sum(r.fires for r in self.rules)
+
+    @staticmethod
+    def corrupt(payload: bytes) -> bytes:
+        """Deterministically flip one bit in the middle of the payload."""
+        if not payload:
+            return payload
+        out = bytearray(payload)
+        out[len(out) // 2] ^= 0x40
+        return bytes(out)
+
+    _DIRECTIVE = re.compile(r"^(?P<point>[\w.]+):(?P<action>[a-z]+)"
+                            r"(?:@(?P<at>\d+))?$")
+
+    @classmethod
+    def parse(cls, spec: str) -> Optional["FaultPlan"]:
+        """Parse the config-string grammar (module docstring); returns
+        None for an empty spec, raises ValueError on a malformed one."""
+        spec = (spec or "").strip()
+        if not spec:
+            return None
+        seed = 0
+        rules: List[FaultRule] = []
+        for directive in spec.split(";"):
+            directive = directive.strip()
+            if not directive:
+                continue
+            if directive.startswith("seed="):
+                seed = int(directive[len("seed="):])
+                continue
+            parts = directive.split(":")
+            head = ":".join(parts[:2])
+            m = cls._DIRECTIVE.match(head)
+            if m is None:
+                raise ValueError(f"bad fault directive {directive!r}")
+            rule = FaultRule(
+                point=m.group("point"),
+                action=FaultAction(m.group("action")),
+                at=int(m.group("at")) if m.group("at") else None)
+            for f in parts[2:]:
+                f = f.strip()
+                if f.startswith("x"):
+                    rule.max_fires = int(f[1:])
+                elif f.startswith("p"):
+                    rule.prob = float(f[1:])
+                elif f.startswith("d"):
+                    rule.delay_ms = float(f[1:])
+                elif f.startswith("i"):
+                    rule.arg = int(f[1:])
+                else:
+                    raise ValueError(f"bad fault field {f!r} in "
+                                     f"{directive!r}")
+            rules.append(rule)
+        return cls(rules, seed)
+
+
+# ---------------------------------------------------------------------------
+# Process-wide plan + stats (the executor-singleton idiom)
+# ---------------------------------------------------------------------------
+
+_plan: Optional[FaultPlan] = None
+_stats = ShuffleFaultStats()
+_lock = threading.Lock()
+
+
+def get_fault_plan() -> Optional[FaultPlan]:
+    return _plan
+
+
+def set_fault_plan(plan: Optional[FaultPlan]) -> Optional[FaultPlan]:
+    """Install (or clear, with None) the process-wide fault plan."""
+    global _plan
+    with _lock:
+        _plan = plan
+    return plan
+
+
+def install_plan_from_conf(conf, fresh: bool = False
+                           ) -> Optional[FaultPlan]:
+    """Parse ``spark.rapids.tpu.shuffle.test.faultPlan`` and install it.
+
+    An empty spec leaves a directly-installed plan alone (tests set
+    plans programmatically) but CLEARS a previously conf-installed one
+    — a stale chaos plan must not leak into a later session that did
+    not ask for injection.  With ``fresh=False`` (the per-exchange
+    call) an unchanged spec keeps the installed plan's consultation
+    counters — re-installing per exchange would re-arm one-shot rules
+    and break determinism.  Session construction passes ``fresh=True``
+    so a NEW session with the same spec gets a re-armed plan instead
+    of inheriting an exhausted one."""
+    from spark_rapids_tpu import config as cfg
+    spec = str(conf.get(cfg.SHUFFLE_FAULT_PLAN) or "").strip()
+    cur = get_fault_plan()
+    if not spec:
+        if cur is not None and getattr(cur, "spec", None) is not None:
+            set_fault_plan(None)
+        return None
+    if not fresh and cur is not None and \
+            getattr(cur, "spec", None) == spec:
+        return cur
+    plan = FaultPlan.parse(spec)
+    plan.spec = spec
+    set_fault_plan(plan)
+    return plan
+
+
+def get_fault_stats() -> ShuffleFaultStats:
+    return _stats
+
+
+def reset_fault_stats() -> None:
+    _stats.reset()

@@ -6,23 +6,38 @@ and remote (fetched via transport clients), acquires the device semaphore
 per produced batch, and surfaces failures as fetch-failed / timeout
 exceptions so the scheduler can re-run the map stage
 (RapidsShuffleExceptions.scala:21-32).
+
+Recovery extensions beyond the reference:
+
+* **Per-peer fetch retry**: a failed or timed-out peer fetch is
+  re-issued up to ``max_retries`` times with exponential backoff +
+  deterministic jitter, re-requesting only the missing map outputs
+  (blocks already delivered are carried in the attempt's
+  ``FetchHandle.completed_buffer_ids`` and skipped).  ``max_retries=0``
+  restores fail-fast: the first fault raises the typed exceptions.
+* **Clean error path**: before raising, every outstanding fetch is
+  cancelled and undelivered received-buffer catalog entries are freed,
+  so late ``on_batch``/``on_done`` callbacks can neither enqueue into a
+  dead queue nor leak buffers.
 """
 
 from __future__ import annotations
 
 import queue
-import threading
+import random
+import time
 from dataclasses import dataclass
-from typing import Dict, Iterator, List, Optional, Tuple
+from typing import Callable, Dict, Iterator, List, Optional, Set, Tuple
 
 import pyarrow as pa
 
 from spark_rapids_tpu.columnar.batch import to_arrow
 from spark_rapids_tpu.mem.device import tpu_semaphore
+from spark_rapids_tpu.shuffle import faults
 from spark_rapids_tpu.shuffle.catalogs import (ShuffleBufferCatalog,
                                                ShuffleReceivedBufferCatalog)
-from spark_rapids_tpu.shuffle.client import RapidsShuffleClient
-from spark_rapids_tpu.shuffle.serializer import deserialize_table
+from spark_rapids_tpu.shuffle.client import (FetchHandle,
+                                             RapidsShuffleClient)
 
 
 class RapidsShuffleFetchFailedException(Exception):
@@ -40,6 +55,20 @@ class RemoteSource:
     peer_executor_id: str
     client: RapidsShuffleClient
     map_ids: Optional[List[int]] = None
+    # retry hook: returns a fresh client (reconnecting if the transport
+    # connection died); without it retries reuse the existing client
+    refresh: Optional[Callable[[], RapidsShuffleClient]] = None
+
+
+class _PeerFetch:
+    """Mutable per-peer retry state for one iterator read."""
+
+    def __init__(self, src: RemoteSource):
+        self.src = src
+        self.attempts = 0
+        self.handle: Optional[FetchHandle] = None
+        self.skip: Set[int] = set()
+        self.done = False
 
 
 class RapidsShuffleIterator:
@@ -50,13 +79,20 @@ class RapidsShuffleIterator:
                  local_catalog: Optional[ShuffleBufferCatalog],
                  remotes: List[RemoteSource],
                  received_catalog: ShuffleReceivedBufferCatalog,
-                 timeout_s: float = 30.0):
+                 timeout_s: float = 30.0,
+                 max_retries: int = 0,
+                 retry_backoff_ms: float = 50.0):
         self.shuffle_id = shuffle_id
         self.reduce_id = reduce_id
         self.local_catalog = local_catalog
         self.remotes = remotes
         self.received = received_catalog
         self.timeout_s = timeout_s
+        self.max_retries = max_retries
+        self.retry_backoff_s = retry_backoff_ms / 1000.0
+        # deterministic jitter: keyed by what we're reading, not wall time
+        self._rng = random.Random((shuffle_id * 1_000_003 + reduce_id)
+                                  & 0xFFFF_FFFF)
 
     def __iter__(self) -> Iterator[pa.Table]:
         # local blocks: straight from the device store
@@ -73,37 +109,145 @@ class RapidsShuffleIterator:
         # remote blocks: async fetch per peer, drain a completion queue
         if not self.remotes:
             return
-        q: "queue.Queue[Tuple[str, Optional[int], Optional[str]]]" = \
-            queue.Queue()
-        outstanding = len(self.remotes)
+        stats = faults.get_fault_stats()
+        # entries: ("batch", temp_id, None, None) or
+        #          ("done", peer_id, error, attempt_epoch)
+        q: "queue.Queue[Tuple[str, object, Optional[str], Optional[int]]]" \
+            = queue.Queue()
+        alive = {"ok": True}
+        peers: Dict[str, _PeerFetch] = {
+            src.peer_executor_id: _PeerFetch(src)
+            for src in self.remotes}
 
-        for src in self.remotes:
-            def make_cbs(peer: str):
-                def on_batch(temp_id: int) -> None:
-                    q.put(("batch", temp_id, None))
+        def drain_free() -> None:
+            while True:
+                try:
+                    kind, a, _err, _ep = q.get_nowait()
+                except queue.Empty:
+                    return
+                if kind == "batch":
+                    self.received.free(a)
 
-                def on_done(err: Optional[str]) -> None:
-                    q.put(("done", None, err))
-                return on_batch, on_done
+        def issue(p: _PeerFetch) -> None:
+            peer_id = p.src.peer_executor_id
+            epoch = p.attempts
 
-            on_batch, on_done = make_cbs(src.peer_executor_id)
-            src.client.do_fetch(self.shuffle_id, self.reduce_id,
-                                src.map_ids, on_batch, on_done)
+            def on_batch(temp_id: int) -> None:
+                if alive["ok"]:
+                    q.put(("batch", temp_id, None, None))
+                    if not alive["ok"]:
+                        # abort raced our put after its drain: whoever
+                        # observes the dead flag last cleans the queue
+                        drain_free()
+                else:
+                    # late delivery into a finished/aborted read: free
+                    # the buffer instead of enqueueing into a dead queue
+                    self.received.free(temp_id)
 
+            def on_done(err: Optional[str]) -> None:
+                if alive["ok"]:
+                    q.put(("done", peer_id, err, epoch))
+
+            client = p.src.client
+            if p.attempts and p.src.refresh is not None:
+                client = p.src.refresh()
+                p.src.client = client
+            # None = first attempt; a retry passes a (possibly empty)
+            # set so the client suppresses degenerate re-delivery even
+            # when no real block completed before the failure
+            p.handle = client.do_fetch(
+                self.shuffle_id, self.reduce_id, p.src.map_ids,
+                on_batch, on_done,
+                skip_buffer_ids=set(p.skip) if p.attempts else None)
+
+        def abort() -> None:
+            """Error-path cleanup: cancel outstanding fetches, then
+            drain and free every received-but-unyielded buffer."""
+            alive["ok"] = False
+            for p in peers.values():
+                if p.handle is not None:
+                    p.handle.cancel()
+            drain_free()
+
+        def backoff(attempts: int) -> None:
+            from spark_rapids_tpu.shuffle.transport import backoff_delay_s
+            time.sleep(backoff_delay_s(self.retry_backoff_s, attempts,
+                                       self._rng, cap_s=5.0))
+
+        def retry(p: _PeerFetch, do_sleep: bool = True) -> bool:
+            """Cancel the failed attempt and re-issue the fetch for only
+            the missing map outputs; False when retries are exhausted."""
+            if p.attempts >= self.max_retries:
+                return False
+            if p.handle is not None:
+                # cancel FIRST: freezes completed_buffer_ids, so every
+                # block counted as delivered stays delivered exactly once
+                p.handle.cancel()
+                p.skip |= p.handle.completed_buffer_ids
+            p.attempts += 1
+            stats.incr("retries")
+            if do_sleep:
+                backoff(p.attempts)
+            issue(p)
+            return True
+
+        for p in peers.values():
+            issue(p)
+        outstanding = len(peers)
+
+        try:
+            yield from self._drain_remote(q, peers, outstanding, alive,
+                                          retry, abort, backoff, stats)
+        finally:
+            # every exit — completion, error, or an abandoned read
+            # (GeneratorExit) — cancels what's still in flight and frees
+            # undelivered buffers; a no-op after a clean drain
+            abort()
+
+    def _drain_remote(self, q, peers, outstanding, alive, retry, abort,
+                      backoff, stats) -> Iterator[pa.Table]:
         while outstanding > 0:
             try:
-                kind, temp_id, err = q.get(timeout=self.timeout_s)
+                kind, a, err, epoch = q.get(timeout=self.timeout_s)
             except queue.Empty:
+                stats.incr("timeouts")
+                stalled = [p for p in peers.values() if not p.done]
+                if stalled and all(p.attempts < self.max_retries
+                                   for p in stalled):
+                    # one shared sleep for the whole stalled group, not
+                    # a per-peer sum of sequential backoffs
+                    backoff(max(p.attempts for p in stalled) + 1)
+                    for p in stalled:
+                        retry(p, do_sleep=False)
+                    continue
+                abort()
                 raise RapidsShuffleTimeoutException(
                     f"shuffle {self.shuffle_id} reduce {self.reduce_id}: "
                     f"no progress for {self.timeout_s}s "
                     f"({outstanding} peers outstanding)")
             if kind == "done":
-                outstanding -= 1
-                if err is not None:
+                p = peers[a]
+                if epoch != p.attempts or p.done:
+                    continue  # stale completion from a cancelled attempt
+                if err is None:
+                    p.done = True
+                    outstanding -= 1
+                elif not retry(p):
+                    abort()
                     raise RapidsShuffleFetchFailedException(
                         f"shuffle {self.shuffle_id} reduce "
-                        f"{self.reduce_id}: {err}")
+                        f"{self.reduce_id}: {err} "
+                        f"(after {p.attempts} retries)")
             else:
-                with tpu_semaphore():
-                    yield self.received.materialize(temp_id)
+                try:
+                    with tpu_semaphore():
+                        t = self.received.materialize(a)
+                except Exception as e:
+                    # a corrupted payload decodes to garbage: that is a
+                    # data-plane failure (stage retry), not a crash
+                    abort()
+                    raise RapidsShuffleFetchFailedException(
+                        f"shuffle {self.shuffle_id} reduce "
+                        f"{self.reduce_id}: undecodable received "
+                        f"block: {e}") from e
+                yield t

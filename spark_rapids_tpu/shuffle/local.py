@@ -53,6 +53,12 @@ class _TagChannel:
         self._completions: deque = deque()
         self._draining = False
         self._failed: Optional[str] = None
+        # tag ranges of cancelled transfers: late sends into them are
+        # dropped instead of queued (tags are never reused, so entries
+        # stay valid).  Bounded: beyond 256 cancelled transfers on one
+        # connection, the oldest ranges age out and their (by then
+        # ancient) stragglers merely queue as before.
+        self._discarded: deque = deque(maxlen=256)
 
     def _dispatch(self, completions) -> None:
         """completions: (tx, status, payload, error) 4-tuples."""
@@ -75,15 +81,28 @@ class _TagChannel:
 
     def send(self, tag: int, data: bytes, tx: Transaction) -> None:
         recv = None
+        discarded = False
         with self._lock:
             failed = self._failed
             if failed is None:
-                q = self._pending_recvs.get(tag)
-                if q:
-                    recv = q.popleft()
-                else:
+                discarded = any(lo <= tag < hi
+                                for (lo, hi) in self._discarded)
+                q = None if discarded else self._pending_recvs.get(tag)
+                while q:
+                    # skip receives cancelled after posting: they must
+                    # not swallow data meant for a live receive
+                    cand = q.popleft()
+                    if cand[0].status == TransactionStatus.IN_PROGRESS:
+                        recv = cand
+                        break
+                if recv is None and not discarded:
                     self._pending_sends.setdefault(tag, deque()).append(
                         (data, tx))
+        if discarded:
+            # late window of a cancelled transfer: drop, don't pin
+            self._dispatch([(tx, TransactionStatus.CANCELLED, None,
+                             None)])
+            return
         if failed is not None:
             self._dispatch([(tx, TransactionStatus.ERROR, None, failed)])
         elif recv is not None:
@@ -111,6 +130,44 @@ class _TagChannel:
                              None),
                             (tx, TransactionStatus.SUCCESS, data,
                              None)])
+
+    def has_pending_recvs(self) -> bool:
+        """True if any posted receive is still IN_PROGRESS — the TCP
+        reader's watchdog only escalates a read timeout to a failure
+        when something is actually in flight.  Cancelled/completed
+        entries are purged here, so a cancelled fetch attempt cannot
+        pin the watchdog (or leak queue entries) forever."""
+        with self._lock:
+            live = False
+            for tag in list(self._pending_recvs):
+                kept = deque(
+                    (tx, n) for (tx, n) in self._pending_recvs[tag]
+                    if tx.status == TransactionStatus.IN_PROGRESS)
+                if kept:
+                    self._pending_recvs[tag] = kept
+                    live = True
+                else:
+                    del self._pending_recvs[tag]
+            return live
+
+    def discard_tag_range(self, lo: int, hi: int) -> None:
+        """Drop queued (unmatched) sends and receives with lo <= tag <
+        hi — a cancelled transfer's stale windows must not pin their
+        payload bytes on a still-healthy connection until it dies.
+        Orphaned send transactions complete CANCELLED (stopping any
+        send_next chain); receive transactions were cancelled by the
+        caller already."""
+        with self._lock:
+            self._discarded.append((lo, hi))
+            stale = []
+            for tag in [t for t in self._pending_sends
+                        if lo <= t < hi]:
+                stale.extend(self._pending_sends.pop(tag))
+            for tag in [t for t in self._pending_recvs
+                        if lo <= t < hi]:
+                del self._pending_recvs[tag]
+        self._dispatch([(tx, TransactionStatus.CANCELLED, None, None)
+                        for (_data, tx) in stale])
 
     def fail_all(self, error: str) -> None:
         """Fail every queued send/receive AND mark the channel terminal:
@@ -161,6 +218,9 @@ class LocalClientConnection(ClientConnection):
         tx.start(cb)
         self.channel.receive(tag, nbytes, tx)
         return tx
+
+    def discard_tag_range(self, lo: int, hi: int) -> None:
+        self.channel.discard_tag_range(lo, hi)
 
 
 class LocalServerConnection(ServerConnection):

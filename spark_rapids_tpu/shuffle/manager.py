@@ -27,6 +27,8 @@ import pyarrow as pa
 
 from spark_rapids_tpu.columnar.batch import DeviceBatch
 from spark_rapids_tpu.config import (SHUFFLE_COMPRESSION_CODEC,
+                                     SHUFFLE_FETCH_MAX_RETRIES,
+                                     SHUFFLE_FETCH_RETRY_BACKOFF_MS,
                                      RapidsTpuConf)
 from spark_rapids_tpu.shuffle.catalogs import (ShuffleBufferCatalog,
                                                ShuffleReceivedBufferCatalog)
@@ -146,13 +148,17 @@ class TpuShuffleManager:
         for info in infos:
             if info.executor_id != executor_id:
                 peers.setdefault(info.executor_id, []).append(info.map_id)
-        remotes = [RemoteSource(peer, env.client_for(peer), map_ids)
+        remotes = [RemoteSource(peer, env.client_for(peer), map_ids,
+                                refresh=lambda p=peer: env.client_for(p))
                    for peer, map_ids in sorted(peers.items())]
         local = env.catalog if any(
             i.executor_id == executor_id for i in infos) else None
         return iter(RapidsShuffleIterator(
             shuffle_id, reduce_id, local, remotes, env.received,
-            timeout_s=timeout_s))
+            timeout_s=timeout_s,
+            max_retries=int(self.conf.get(SHUFFLE_FETCH_MAX_RETRIES)),
+            retry_backoff_ms=float(
+                self.conf.get(SHUFFLE_FETCH_RETRY_BACKOFF_MS))))
 
     def unregister_shuffle(self, shuffle_id: int) -> None:
         with self._lock:

@@ -100,8 +100,12 @@ class ShuffleServer:
                 return  # receiver vanished; stop streaming
             if not state.has_next():
                 return
+            # window i moves under tag receive_tag+i (the receiver posts
+            # the same sequence): a lost window is a detectable hole,
+            # never a silent misalignment of later windows
+            wtag = req.receive_tag + state.windows_sent
             data = state.next_window()
-            tx = self.connection.send(peer_executor_id, req.receive_tag,
+            tx = self.connection.send(peer_executor_id, wtag,
                                       data, send_next)
 
         # kick off the stream; subsequent windows chain off completions

@@ -31,11 +31,14 @@ expected-tag semantics.
 
 from __future__ import annotations
 
+import random
 import socket
 import struct
 import threading
+import time
 from typing import Callable, Dict, List, Optional, Tuple
 
+from spark_rapids_tpu.shuffle import faults
 from spark_rapids_tpu.shuffle.local import _TagChannel
 from spark_rapids_tpu.shuffle.transport import (ClientConnection,
                                                 ServerConnection,
@@ -47,65 +50,165 @@ _HELLO, _REQ, _RESP, _DATA, _ERR = 0, 1, 2, 3, 4
 _HDR = struct.Struct("<BQI")
 
 
+class ShuffleTransportError(OSError):
+    """A socket fault on the shuffle data plane, tagged with the peer
+    executor id so callers can distinguish peer death from local bugs.
+    Subclasses OSError: existing ``except OSError`` recovery paths keep
+    working; new code can catch this type and read ``peer_executor_id``.
+    """
+
+    def __init__(self, msg: str, peer_executor_id: Optional[str] = None):
+        super().__init__(msg)
+        self.peer_executor_id = peer_executor_id
+
+    def __str__(self) -> str:
+        base = super().__str__()
+        if self.peer_executor_id:
+            return f"[peer {self.peer_executor_id}] {base}"
+        return base
+
+
+class _IdleTimeout(Exception):
+    """Read timed out on a frame boundary (no bytes consumed): benign on
+    a connection with nothing in flight, fatal otherwise."""
+
+
 def _send_frame(sock: socket.socket, kind: int, tag: int,
-                payload: bytes, lock: threading.Lock) -> None:
-    with lock:
-        sock.sendall(_HDR.pack(kind, tag, len(payload)))
-        if payload:
-            sock.sendall(payload)
+                payload: bytes, lock: threading.Lock,
+                peer: Optional[str] = None) -> None:
+    try:
+        with lock:
+            sock.sendall(_HDR.pack(kind, tag, len(payload)))
+            if payload:
+                sock.sendall(payload)
+    except ShuffleTransportError:
+        raise
+    except OSError as e:
+        raise ShuffleTransportError(f"send failed: {e}", peer) from e
 
 
-def _recv_exact(sock: socket.socket, n: int) -> Optional[bytes]:
+def _recv_exact(sock: socket.socket, n: int,
+                idle_ok: bool = False) -> Optional[bytes]:
     buf = bytearray()
     while len(buf) < n:
-        chunk = sock.recv(n - len(buf))
+        try:
+            chunk = sock.recv(n - len(buf))
+        except socket.timeout:
+            if idle_ok and not buf:
+                raise _IdleTimeout() from None
+            # bytes already consumed: resuming would desync the framing
+            raise ShuffleTransportError(
+                f"read timed out mid-frame ({len(buf)}/{n} bytes)") \
+                from None
         if not chunk:
             return None
         buf += chunk
     return bytes(buf)
 
 
-def _read_frame(sock: socket.socket
+def _read_frame(sock: socket.socket, peer: Optional[str] = None,
+                idle_ok: bool = False
                 ) -> Optional[Tuple[int, int, bytes]]:
-    hdr = _recv_exact(sock, _HDR.size)
-    if hdr is None:
-        return None
-    kind, tag, ln = _HDR.unpack(hdr)
-    payload = _recv_exact(sock, ln) if ln else b""
+    try:
+        hdr = _recv_exact(sock, _HDR.size, idle_ok=idle_ok)
+        if hdr is None:
+            return None
+        kind, tag, ln = _HDR.unpack(hdr)
+        payload = _recv_exact(sock, ln) if ln else b""
+    except (ShuffleTransportError, _IdleTimeout):
+        raise
+    except OSError as e:
+        raise ShuffleTransportError(f"read failed: {e}", peer) from e
     if ln and payload is None:
         return None
     return kind, tag, payload
 
 
 class TcpClientConnection(ClientConnection):
-    """Reducer-side connection to one mapper executor over one socket."""
+    """Reducer-side connection to one mapper executor over one socket.
 
-    def __init__(self, local_executor_id: str, host: str, port: int):
+    ``read_timeout_s`` arms a watchdog: a read timeout while requests or
+    tagged receives are in flight fails them all (a retryable fetch
+    failure); an idle-connection timeout is benign and just re-arms.
+    """
+
+    def __init__(self, local_executor_id: str, host: str, port: int,
+                 peer_executor_id: Optional[str] = None,
+                 connect_timeout_s: float = 30.0,
+                 read_timeout_s: Optional[float] = None):
         self.local_executor_id = local_executor_id
+        self.peer_executor_id = peer_executor_id
         self.channel = _TagChannel()
-        self._sock = socket.create_connection((host, port), timeout=30)
-        self._sock.settimeout(None)
+        self._sock = socket.create_connection(
+            (host, port), timeout=connect_timeout_s)
+        self._read_timeout_s = read_timeout_s or None
+        self._sock.settimeout(self._read_timeout_s)
         self._wlock = threading.Lock()
         self._reqs: Dict[int, Transaction] = {}
         self._req_lock = threading.Lock()
         self._next_req = 0
         self._closed = False
         _send_frame(self._sock, _HELLO, 0,
-                    local_executor_id.encode(), self._wlock)
+                    local_executor_id.encode(), self._wlock,
+                    peer=peer_executor_id)
         self._reader = threading.Thread(target=self._read_loop,
                                         daemon=True)
         self._reader.start()
 
+    def _has_pending(self) -> bool:
+        with self._req_lock:
+            if self._reqs:
+                return True
+        return self.channel.has_pending_recvs()
+
     def _read_loop(self) -> None:
+        # the recv timer starts at re-arm, not when an operation is
+        # posted — an op issued late in an idle window would otherwise
+        # get an arbitrarily small budget.  Two consecutive expiries
+        # with pending ops guarantee every op at least one full window.
+        pending_strikes = 0
         while True:
             try:
-                frame = _read_frame(self._sock)
-            except OSError:
-                frame = None
+                frame = _read_frame(self._sock,
+                                    peer=self.peer_executor_id,
+                                    idle_ok=True)
+            except _IdleTimeout:
+                if not self._has_pending():
+                    pending_strikes = 0
+                    continue  # idle connection: benign, keep listening
+                pending_strikes += 1
+                if pending_strikes < 2:
+                    continue
+                faults.get_fault_stats().incr("timeouts")
+                self._fail_all(
+                    f"read timeout after {2 * self._read_timeout_s}s "
+                    "with in-flight operations")
+                self.close()
+                return
+            except OSError as e:
+                # keep the typed diagnostics (peer id, mid-frame
+                # timeout) instead of a generic "connection closed"
+                self._fail_all(f"connection error: {e}")
+                return
             if frame is None:
                 self._fail_all("connection closed")
                 return
+            pending_strikes = 0  # byte progress: re-arm the watchdog
             kind, tag, payload = frame
+            if kind == _DATA:
+                plan = faults.get_fault_plan()
+                ev = plan.check("tcp.client.data") if plan else None
+                if ev is not None:
+                    if ev.action == faults.FaultAction.DROP:
+                        continue
+                    if ev.action == faults.FaultAction.CLOSE:
+                        self._fail_all("fault injected: client close")
+                        self.close()
+                        return
+                    if ev.action == faults.FaultAction.CORRUPT:
+                        payload = faults.FaultPlan.corrupt(payload)
+                    elif ev.action == faults.FaultAction.DELAY:
+                        time.sleep(ev.delay_s)
             if kind == _RESP:
                 with self._req_lock:
                     tx = self._reqs.pop(tag, None)
@@ -126,6 +229,9 @@ class TcpClientConnection(ClientConnection):
                 self.channel.send(tag, payload, stx)
 
     def _fail_all(self, msg: str) -> None:
+        tag = f"[peer {self.peer_executor_id}]"
+        if self.peer_executor_id and tag not in msg:
+            msg = f"{tag} {msg}"
         with self._req_lock:
             self._closed = True
             pending = list(self._reqs.values())
@@ -152,7 +258,8 @@ class TcpClientConnection(ClientConnection):
                         error="connection closed")
             return tx
         try:
-            _send_frame(self._sock, _REQ, rid, data, self._wlock)
+            _send_frame(self._sock, _REQ, rid, data, self._wlock,
+                        peer=self.peer_executor_id)
         except OSError as e:
             with self._req_lock:
                 self._reqs.pop(rid, None)
@@ -168,6 +275,9 @@ class TcpClientConnection(ClientConnection):
             return tx
         self.channel.receive(tag, nbytes, tx)
         return tx
+
+    def discard_tag_range(self, lo: int, hi: int) -> None:
+        self.channel.discard_tag_range(lo, hi)
 
     @property
     def closed(self) -> bool:
@@ -294,8 +404,26 @@ class TcpServerConnection(ServerConnection):
                         error=f"no connection from {peer_executor_id}")
             return tx
         sock, wlock = peer
+        plan = faults.get_fault_plan()
+        ev = plan.check("tcp.server.data") if plan else None
+        if ev is not None:
+            if ev.action == faults.FaultAction.DROP:
+                # frame silently lost: the stream keeps going, leaving a
+                # hole the receiver must detect and re-fetch
+                tx.complete(TransactionStatus.SUCCESS)
+                return tx
+            if ev.action == faults.FaultAction.CLOSE:
+                try:
+                    sock.close()  # peer sees a mid-window disconnect
+                except OSError:
+                    pass
+            elif ev.action == faults.FaultAction.CORRUPT:
+                data = faults.FaultPlan.corrupt(data)
+            elif ev.action == faults.FaultAction.DELAY:
+                time.sleep(ev.delay_s)
         try:
-            _send_frame(sock, _DATA, tag, data, wlock)
+            _send_frame(sock, _DATA, tag, data, wlock,
+                        peer=peer_executor_id)
             tx.complete(TransactionStatus.SUCCESS)
         except OSError as e:
             tx.complete(TransactionStatus.ERROR, error=str(e))
@@ -325,6 +453,11 @@ class TcpShuffleTransport(ShuffleTransport):
       * ``peers``: {executor_id: (host, port)} address book; entries can
         be added later via ``add_peer`` (the analog of discovering a
         peer's port from MapStatus topology)
+      * ``connect_timeout_ms`` (default 30000) / ``read_timeout_ms``
+        (default 10000, 0 disables): per-socket timeouts
+      * ``connect_max_retries`` (default 2) / ``connect_backoff_ms``
+        (default 50): bounded reconnect with exponential backoff +
+        deterministic jitter (``seed``, default 0)
     """
 
     def __init__(self, executor_id: str, conf=None):
@@ -334,33 +467,80 @@ class TcpShuffleTransport(ShuffleTransport):
         self._peers: Dict[str, Tuple[str, int]] = dict(
             get("peers", {}) or {})
         self._listen_port = int(get("listen_port", 0) or 0)
+        self._connect_timeout_s = float(
+            get("connect_timeout_ms", 30_000) or 30_000) / 1000.0
+        self._read_timeout_s = float(
+            get("read_timeout_ms", 10_000) or 0) / 1000.0
+        self._connect_retries = int(get("connect_max_retries", 2) or 0)
+        self._backoff_s = float(
+            get("connect_backoff_ms", 50) or 50) / 1000.0
+        self._rng = random.Random(int(get("seed", 0) or 0))
         self._server: Optional[TcpServerConnection] = None
         self._clients: Dict[str, TcpClientConnection] = {}
+        self._clients_lock = threading.Lock()
 
     def add_peer(self, executor_id: str, host: str, port: int) -> None:
         self._peers[executor_id] = (host, port)
 
-    def make_client(self, peer_executor_id: str) -> TcpClientConnection:
-        cached = self._clients.get(peer_executor_id)
-        if cached is not None:
-            if not cached.closed:
-                return cached
-            # dead connection (peer restarted / network drop): reconnect
-            # to the current address book entry
-            cached.close()
-            del self._clients[peer_executor_id]
+    def _connect(self, peer_executor_id: str, host: str,
+                 port: int) -> TcpClientConnection:
+        """Bounded reconnect: exponential backoff + jitter per attempt
+        (the reference's UCX mgmt-connection retry loop analog)."""
+        from spark_rapids_tpu.shuffle.transport import backoff_delay_s
+        stats = faults.get_fault_stats()
+        last: Optional[OSError] = None
+        for attempt in range(self._connect_retries + 1):
+            if attempt:
+                time.sleep(backoff_delay_s(self._backoff_s, attempt,
+                                           self._rng))
+                stats.incr("reconnects")
+            plan = faults.get_fault_plan()
+            ev = plan.check("tcp.connect") if plan else None
+            if ev is not None and ev.action in (faults.FaultAction.CLOSE,
+                                                faults.FaultAction.DROP):
+                last = ShuffleTransportError(
+                    "fault injected: connect refused", peer_executor_id)
+                continue
+            try:
+                return TcpClientConnection(
+                    self.executor_id, host, port,
+                    peer_executor_id=peer_executor_id,
+                    connect_timeout_s=self._connect_timeout_s,
+                    read_timeout_s=self._read_timeout_s or None)
+            except OSError as e:
+                last = e
+        raise ShuffleTransportError(
+            f"connect to {peer_executor_id} at {host}:{port} failed "
+            f"after {self._connect_retries + 1} attempts: {last}",
+            peer_executor_id)
+
+    def make_client(self, peer_executor_id: str) -> ClientConnection:
+        with self._clients_lock:
+            cached = self._clients.get(peer_executor_id)
+            if cached is not None:
+                if not cached.closed:
+                    return cached
+                # dead connection (peer restarted / network drop):
+                # reconnect to the current address book entry
+                cached.close()
+                del self._clients[peer_executor_id]
+                faults.get_fault_stats().incr("reconnects")
         if peer_executor_id not in self._peers:
             raise KeyError(f"unknown peer {peer_executor_id}; "
                            f"add_peer() or conf['peers'] required")
         host, port = self._peers[peer_executor_id]
         try:
-            c = TcpClientConnection(self.executor_id, host, port)
+            # dialing (timeouts + backoff sleeps) happens unlocked
+            c = self._connect(peer_executor_id, host, port)
         except OSError as e:
             # do NOT cache: the next make_client retries the connect
-            return _DeadClientConnection(
-                f"connect to {peer_executor_id} at {host}:{port} "
-                f"failed: {e}")
-        self._clients[peer_executor_id] = c
+            return _DeadClientConnection(str(e))
+        with self._clients_lock:
+            cur = self._clients.get(peer_executor_id)
+            if cur is not None and not cur.closed:
+                c.close()  # concurrent dial won: don't leak the loser
+                return cur
+            self._clients[peer_executor_id] = c
         return c
 
     def server(self) -> TcpServerConnection:

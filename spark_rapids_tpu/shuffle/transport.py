@@ -102,6 +102,17 @@ class Transaction:
         return self._done.wait(timeout)
 
 
+def backoff_delay_s(base_s: float, attempt: int, rng,
+                    cap_s: Optional[float] = None) -> float:
+    """Shared exponential-backoff schedule: base doubled per attempt
+    (1-based) with +0-25% seeded jitter, optionally capped.  One
+    implementation so the deterministic-chaos timing policy cannot
+    silently diverge between the transport and fetch layers."""
+    delay = base_s * (2 ** max(attempt - 1, 0))
+    delay *= 1.0 + 0.25 * rng.random()
+    return min(delay, cap_s) if cap_s is not None else delay
+
+
 class ClientConnection:
     """Reducer-side connection to one mapper executor."""
 

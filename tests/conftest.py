@@ -68,3 +68,7 @@ def pytest_configure(config):
         "markers",
         "tpu_hw: touches the real TPU chip (skips hermetically when "
         "no accelerator is present)")
+    config.addinivalue_line(
+        "markers",
+        "faults: deterministic fault-injection tests exercising the "
+        "shuffle retry/recovery/fallback machinery (tier-1 safe)")

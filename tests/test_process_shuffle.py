@@ -198,6 +198,38 @@ def test_dcn_over_ici_composition():
     h.call({"op": "unregister", "shuffle_id": 990})
 
 
+@pytest.mark.faults
+def test_chaos_executor_kill_matches_fault_free():
+    """Seeded chaos smoke test: a FaultPlan (installed through the
+    ``shuffle.test.faultPlan`` conf string) kills executor 0 right after
+    its map stage completes; the reducers must recover through
+    fetch-failed -> respawn -> map-stage re-run and produce exactly the
+    fault-free answer, with the recovery visible in ShuffleFaultStats."""
+    from spark_rapids_tpu.shuffle import faults
+
+    t = _data(n=2000, seed=31)
+    fault_free = _agg_query(TpuSparkSession(_CONF), t).collect()
+    faults.reset_fault_stats()
+    try:
+        conf = dict(_CONF, **{
+            "spark.rapids.tpu.shuffle.test.faultPlan":
+                "seed=5;procpool.map_stage:kill@1:i0",
+            "spark.rapids.tpu.shuffle.fetch.maxRetries": 1,
+            "spark.rapids.tpu.shuffle.fetch.retryBackoffMs": 20,
+            "spark.rapids.tpu.shuffle.connectTimeoutMs": 1000,
+        })
+        chaos = _agg_query(TpuSparkSession(conf), t).collect()
+        assert_tables_equal(fault_free, chaos, ignore_order=True)
+        stats = faults.get_fault_stats()
+        assert stats.get("injected_faults") == 1
+        # the dead executor surfaced and was recovered from (either via
+        # fetch retries or a map-stage re-run on the respawned executor)
+        assert stats.get("retries") + stats.get("reconnects") >= 1
+    finally:
+        faults.set_fault_plan(None)
+        faults.reset_fault_stats()
+
+
 def test_executor_respawn_after_kill():
     pool = procpool.get_executor_pool(2)
     h0 = pool.handle(0)

@@ -1,0 +1,404 @@
+"""Deterministic fault injection over the shuffle data plane.
+
+Exercises the retry/recovery machinery end to end against REAL TCP
+sockets (client and server in one process, like the reference's
+RapidsShuffleClientSuite driving real transports): a seeded
+``FaultPlan`` drops/closes/corrupts frames and kills workers at named
+injection points, and the tests assert that results match the
+fault-free run while ``ShuffleFaultStats`` records the recovery work.
+Reference analog: fetch-failed -> stage-retry semantics
+(RapidsShuffleIterator.scala:49-365) plus the fall-back-to-Spark-shuffle
+contract when the accelerated plane is unrecoverable.
+"""
+
+import sys
+import time
+
+import numpy as np
+import pyarrow as pa
+import pytest
+
+from spark_rapids_tpu.columnar.batch import from_arrow
+from spark_rapids_tpu.shuffle import faults
+from spark_rapids_tpu.shuffle.catalogs import (ShuffleBufferCatalog,
+                                               ShuffleReceivedBufferCatalog,
+                                               build_table_meta)
+from spark_rapids_tpu.shuffle.client import RapidsShuffleClient
+from spark_rapids_tpu.shuffle.iterator import (
+    RapidsShuffleFetchFailedException, RapidsShuffleIterator,
+    RapidsShuffleTimeoutException, RemoteSource)
+from spark_rapids_tpu.shuffle.server import ShuffleServer
+from spark_rapids_tpu.shuffle.tcp import (ShuffleTransportError,
+                                          TcpShuffleTransport)
+
+pytestmark = pytest.mark.faults
+
+
+@pytest.fixture(autouse=True)
+def _clean_fault_state():
+    faults.set_fault_plan(None)
+    faults.reset_fault_stats()
+    yield
+    faults.set_fault_plan(None)
+    faults.reset_fault_stats()
+
+
+# ---------------------------------------------------------------------------
+# FaultPlan grammar + determinism
+# ---------------------------------------------------------------------------
+
+def test_fault_plan_parse_grammar():
+    plan = faults.FaultPlan.parse(
+        "seed=9;tcp.server.data:drop@2;procpool.map_stage:kill@1:i1:x3;"
+        "tcp.client.data:delay@4:d250")
+    assert plan.seed == 9
+    r0, r1, r2 = plan.rules
+    assert (r0.point, r0.action, r0.at, r0.max_fires) == \
+        ("tcp.server.data", faults.FaultAction.DROP, 2, 1)
+    assert (r1.action, r1.arg, r1.max_fires) == \
+        (faults.FaultAction.KILL, 1, 3)
+    assert (r2.action, r2.delay_ms) == (faults.FaultAction.DELAY, 250.0)
+    assert faults.FaultPlan.parse("") is None
+    assert faults.FaultPlan.parse("   ") is None
+    with pytest.raises(ValueError):
+        faults.FaultPlan.parse("tcp.client.data:explode@1")
+    with pytest.raises(ValueError):
+        faults.FaultPlan.parse("nonsense")
+
+
+def test_fault_plan_occurrence_counting_is_deterministic():
+    plan = faults.FaultPlan.parse("p:drop@3:x2")
+    fired = [plan.check("p") is not None for _ in range(6)]
+    # armed at the 3rd consultation, fires twice, then exhausted
+    assert fired == [False, False, True, True, False, False]
+    assert plan.consultations("p") == 6
+    assert faults.get_fault_stats().get("injected_faults") == 2
+
+
+# ---------------------------------------------------------------------------
+# TCP fixtures: a real mapper server + reducer client in one process
+# ---------------------------------------------------------------------------
+
+def _table(n, seed):
+    rng = np.random.default_rng(seed)
+    return pa.table({
+        "v": pa.array(rng.integers(0, 1 << 30, n)),
+        "s": pa.array([f"row-{i}" for i in range(n)]),
+    })
+
+
+@pytest.fixture()
+def mapper():
+    """Catalog with two map blocks for (shuffle=1, reduce=0), served
+    over a real TCP socket."""
+    cat = ShuffleBufferCatalog()
+    t0, t1 = _table(2000, 3), _table(500, 4)
+    cat.register_batch(1, 0, 0, from_arrow(t0))
+    cat.register_batch(1, 1, 0, from_arrow(t1))
+    tr = TcpShuffleTransport("mapper", {"listen_port": 0})
+    ShuffleServer("mapper", cat, tr.server())
+    yield tr, tr.server().port, [t0, t1]
+    tr.shutdown()
+
+
+def _reducer(port, read_timeout_ms=400, retries=2, backoff_ms=20):
+    tr = TcpShuffleTransport("reducer", {
+        "peers": {"mapper": ("127.0.0.1", port)},
+        "read_timeout_ms": read_timeout_ms,
+        "connect_max_retries": retries,
+        "connect_backoff_ms": backoff_ms,
+    })
+    recv = ShuffleReceivedBufferCatalog()
+
+    def make_client():
+        return RapidsShuffleClient(tr.make_client("mapper"), recv,
+                                   bounce_window=4096)
+
+    it = RapidsShuffleIterator(
+        1, 0, None,
+        [RemoteSource("mapper", make_client(), refresh=make_client)],
+        recv, timeout_s=10.0, max_retries=retries,
+        retry_backoff_ms=backoff_ms)
+    return tr, recv, it
+
+
+def _assert_matches(got_tables, expected_tables):
+    got = pa.concat_tables(got_tables).sort_by(
+        [("v", "ascending"), ("s", "ascending")])
+    exp = pa.concat_tables(expected_tables).sort_by(
+        [("v", "ascending"), ("s", "ascending")])
+    assert got.equals(exp)
+
+
+# ---------------------------------------------------------------------------
+# Satellite scenarios: drop / close / fail-fast / leak-free error path
+# ---------------------------------------------------------------------------
+
+def test_dropped_data_frame_retry_succeeds(mapper):
+    _tr, port, expected = mapper
+    faults.set_fault_plan(faults.FaultPlan.parse(
+        "seed=1;tcp.server.data:drop@2"))
+    tr, recv, it = _reducer(port)
+    got = list(it)
+    _assert_matches(got, expected)
+    stats = faults.get_fault_stats()
+    assert stats.get("injected_faults") == 1
+    assert stats.get("retries") >= 1
+    assert recv.pending == 0  # nothing leaked in the received catalog
+    tr.shutdown()
+
+
+def test_peer_socket_close_mid_window_reconnects(mapper):
+    _tr, port, expected = mapper
+    faults.set_fault_plan(faults.FaultPlan.parse(
+        "seed=2;tcp.server.data:close@2"))
+    tr, recv, it = _reducer(port)
+    got = list(it)
+    _assert_matches(got, expected)
+    stats = faults.get_fault_stats()
+    assert stats.get("retries") >= 1
+    assert stats.get("reconnects") >= 1
+    assert recv.pending == 0
+    tr.shutdown()
+
+
+def test_client_side_drop_recovers_too(mapper):
+    _tr, port, expected = mapper
+    faults.set_fault_plan(faults.FaultPlan.parse(
+        "seed=3;tcp.client.data:drop@3"))
+    tr, recv, it = _reducer(port)
+    _assert_matches(list(it), expected)
+    assert faults.get_fault_stats().get("retries") >= 1
+    tr.shutdown()
+
+
+def test_retries_disabled_fails_fast_with_typed_exception(mapper):
+    _tr, port, _expected = mapper
+    faults.set_fault_plan(faults.FaultPlan.parse(
+        "seed=4;tcp.server.data:close@1"))
+    tr, recv, it = _reducer(port, retries=0)
+    t0 = time.monotonic()
+    with pytest.raises((RapidsShuffleFetchFailedException,
+                        RapidsShuffleTimeoutException)):
+        list(it)
+    assert time.monotonic() - t0 < 5.0  # fail fast, not stall-to-timeout
+    assert faults.get_fault_stats().get("retries") == 0
+    assert recv.pending == 0  # error path drained the catalog
+    tr.shutdown()
+
+
+def test_timeout_error_path_frees_late_batches():
+    """Satellite regression: after the iterator dies, late on_batch
+    callbacks must not enqueue into the dead queue and their buffers
+    must be freed, not leaked."""
+    recv = ShuffleReceivedBufferCatalog()
+    captured = {}
+
+    class HalfClient:
+        def do_fetch(self, sid, rid, mids, on_batch, on_done,
+                     skip_buffer_ids=None):
+            from spark_rapids_tpu.shuffle.client import FetchHandle
+            captured["on_batch"] = on_batch
+            return FetchHandle()  # never completes: stalls the iterator
+
+    it = RapidsShuffleIterator(
+        1, 0, None, [RemoteSource("ghost", HalfClient())], recv,
+        timeout_s=0.05)
+    with pytest.raises(RapidsShuffleTimeoutException):
+        list(it)
+    # a late delivery lands after the failure: freed immediately
+    t = _table(3, 5)
+    tm = build_table_meta(1, 3, t, payload_size=10)
+    tid = recv.add(tm, b"x" * 10)
+    captured["on_batch"](tid)
+    assert recv.pending == 0
+
+
+def test_transport_error_is_typed_with_peer_id():
+    """Satellite: raw socket faults surface as ShuffleTransportError
+    carrying the peer executor id (and it stays an OSError so existing
+    recovery paths are unaffected)."""
+    lsock_port = 1  # port 1: connect refused without a listener
+    tr = TcpShuffleTransport("reducer", {
+        "peers": {"ghost-exec": ("127.0.0.1", lsock_port)},
+        "connect_max_retries": 1, "connect_backoff_ms": 5,
+        "connect_timeout_ms": 500,
+    })
+    with pytest.raises(ShuffleTransportError) as ei:
+        tr._connect("ghost-exec", "127.0.0.1", lsock_port)
+    assert ei.value.peer_executor_id == "ghost-exec"
+    assert isinstance(ei.value, OSError)
+    # make_client degrades the same failure to a dead connection whose
+    # operations complete with ERROR naming the peer
+    conn = tr.make_client("ghost-exec")
+    done = []
+    conn.request(b"x", done.append)
+    assert done and "ghost-exec" in done[0].error_message
+    tr.shutdown()
+
+
+# ---------------------------------------------------------------------------
+# Python worker: handshake timeout + crash respawn-and-replay
+# ---------------------------------------------------------------------------
+
+def test_worker_handshake_timeout_typed_error(monkeypatch):
+    """Satellite: the 20s hardcoded handshake wait is config-driven and
+    a timeout raises PythonWorkerError with the worker's exit code."""
+    import subprocess as sp
+    from spark_rapids_tpu.pyworker import pool as pool_mod
+    real_popen = sp.Popen
+
+    def never_connects(args, **kw):
+        return real_popen([sys.executable, "-c",
+                           "import time; time.sleep(10)"], **kw)
+
+    monkeypatch.setattr(pool_mod.subprocess, "Popen", never_connects)
+    with pytest.raises(pool_mod.PythonWorkerError,
+                       match="handshake timed out"):
+        pool_mod.PythonWorker(handshake_timeout_s=0.3)
+
+
+def test_worker_kill_mid_batch_respawns_and_replays():
+    from spark_rapids_tpu.pyworker.pool import borrowed_worker
+    faults.set_fault_plan(faults.FaultPlan.parse(
+        "seed=6;pyworker.batch:kill@1"))
+    t = pa.table({"a": pa.array([1, 2, 3], type=pa.int64())})
+    with borrowed_worker("table", lambda df: df + 1) as w:
+        out = w.run_table(t)
+    assert out.column("a").to_pylist() == [2, 3, 4]
+    stats = faults.get_fault_stats()
+    assert stats.get("injected_faults") == 1
+    assert stats.get("worker_respawns") == 1
+
+
+# ---------------------------------------------------------------------------
+# Process-transport queries: CPU fallback + the acceptance scenario
+# ---------------------------------------------------------------------------
+
+_PROC_CONF = {
+    "spark.rapids.tpu.shuffle.transport": "process",
+    "spark.rapids.tpu.shuffle.transport.processExecutors": 2,
+    "spark.rapids.tpu.sql.shuffle.partitions": 3,
+    "spark.rapids.tpu.shuffle.readTimeoutMs": 300,
+    "spark.rapids.tpu.shuffle.fetch.maxRetries": 2,
+    "spark.rapids.tpu.shuffle.fetch.retryBackoffMs": 20,
+    "spark.rapids.tpu.shuffle.connectTimeoutMs": 2000,
+}
+
+
+def _proc_data(n=3000, seed=21):
+    rng = np.random.default_rng(seed)
+    return pa.table({
+        "k": pa.array(rng.integers(0, 11, n).astype(np.int64)),
+        "v": pa.array(rng.integers(0, 1000, n).astype(np.int64)),
+    })
+
+
+def _agg(s, t):
+    from spark_rapids_tpu import functions as F
+    return (s.create_dataframe(t, num_partitions=3)
+            .group_by("k")
+            .agg(F.count("*").alias("c"), F.sum("v").alias("sv")))
+
+
+@pytest.fixture(scope="module")
+def _proc_pool_teardown():
+    yield
+    from spark_rapids_tpu.shuffle import procpool
+    procpool.reset_executor_pool()
+
+
+def _collect_plan_exchanges(s):
+    from tests.parity import collect_plans
+    return collect_plans(s)
+
+
+def test_retries_exhausted_cpu_fallback_matches(_proc_pool_teardown):
+    """Every DATA frame the driver receives is dropped: retries and
+    map-stage re-runs cannot help (nothing is dead), so the exchange
+    degrades to the CPU block store and the query still answers
+    correctly, with the fallback counted in the fault stats."""
+    from spark_rapids_tpu import TpuSparkSession
+    from tests.parity import assert_tables_equal
+
+    t = _proc_data()
+    cpu = _agg(TpuSparkSession(
+        {"spark.rapids.tpu.sql.enabled": False}), t).collect()
+
+    faults.set_fault_plan(faults.FaultPlan.parse(
+        "seed=7;tcp.client.data:drop@1:x100000"))
+    # tight timeouts: every fetch attempt is doomed, so don't wait long
+    s = TpuSparkSession(dict(_PROC_CONF, **{
+        "spark.rapids.tpu.shuffle.readTimeoutMs": 150,
+        "spark.rapids.tpu.shuffle.fetch.maxRetries": 1,
+    }))
+    captured = _collect_plan_exchanges(s)
+    got = _agg(s, t).collect()
+    assert_tables_equal(cpu, got, ignore_order=True)
+    assert faults.get_fault_stats().get("fallbacks") >= 1
+
+    # round-robin: the fallback recompute must use the SAME
+    # row->partition mapping as the distributed map side (regression:
+    # per-map-task rows_seen reset) — a divergence duplicates/loses rows
+    def q2(sess):
+        return sess.create_dataframe(t, num_partitions=2).repartition(3)
+    cpu2 = q2(TpuSparkSession(
+        {"spark.rapids.tpu.sql.enabled": False})).collect()
+    got2 = q2(s).collect()
+    assert_tables_equal(cpu2, got2, ignore_order=True)
+    # the per-query counter block rides the exchange's metrics
+    exch = []
+    captured[-1].plan.foreach(
+        lambda n: exch.append(n) if type(n).__name__ ==
+        "TpuShuffleExchangeExec" else None)
+    assert exch and exch[0].metrics.extra.get("shuffle.fallbacks", 0) >= 1
+
+
+def test_acceptance_drop_close_kill_identical_results(
+        _proc_pool_teardown):
+    """Acceptance: one dropped frame + one peer-socket close + one
+    worker kill under a seeded plan — the TCP-transport shuffle query
+    completes with results identical to the fault-free run and
+    ShuffleFaultStats reports the recovery work."""
+    from spark_rapids_tpu import TpuSparkSession
+    from spark_rapids_tpu.pyworker.pool import borrowed_worker
+    from tests.parity import assert_tables_equal
+
+    t = _proc_data(seed=22)
+    healthy = _agg(TpuSparkSession(dict(_PROC_CONF)), t).collect()
+    faults.reset_fault_stats()
+
+    faults.set_fault_plan(faults.FaultPlan.parse(
+        "seed=8;tcp.client.data:drop@2;tcp.client.data:close@4;"
+        "pyworker.batch:kill@1"))
+    s = TpuSparkSession(dict(_PROC_CONF))
+    got = _agg(s, t).collect()
+    assert_tables_equal(healthy, got, ignore_order=True)
+    # the worker-kill leg of the plan, through the resilient UDF path
+    with borrowed_worker("table", lambda df: df) as w:
+        out = w.run_table(pa.table({"x": pa.array([7])}))
+    assert out.column("x").to_pylist() == [7]
+
+    stats = faults.get_fault_stats()
+    assert stats.get("injected_faults") == 3
+    assert stats.get("retries") >= 1
+    assert stats.get("worker_respawns") == 1
+
+
+def test_acceptance_same_plan_retries_disabled_fails_fast(
+        _proc_pool_teardown):
+    """Acceptance flip side: with retries and the CPU fallback disabled
+    the same fault plan fails fast with the existing typed exceptions."""
+    from spark_rapids_tpu import TpuSparkSession
+
+    t = _proc_data(seed=23)
+    faults.set_fault_plan(faults.FaultPlan.parse(
+        "seed=8;tcp.client.data:drop@2:x100000"))
+    conf = dict(_PROC_CONF, **{
+        "spark.rapids.tpu.shuffle.fetch.maxRetries": 0,
+        "spark.rapids.tpu.shuffle.fetch.cpuFallbackEnabled": False,
+    })
+    s = TpuSparkSession(conf)
+    with pytest.raises((RapidsShuffleFetchFailedException,
+                        RapidsShuffleTimeoutException)):
+        _agg(s, t).collect()

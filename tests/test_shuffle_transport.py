@@ -237,7 +237,8 @@ def test_client_happy_path_windowed_blocks():
     while state.has_next():
         assert len(conn.receives) == i + 1, "one receive posted at a time"
         tag, nbytes, tx = conn.receives[i]
-        assert tag == xfer.receive_tag
+        # window i is tag-sequenced at receive_tag + i (hole detection)
+        assert tag == xfer.receive_tag + i
         tx.complete(TransactionStatus.SUCCESS, payload=state.next_window())
         i += 1
     assert dones == [None]
