@@ -42,6 +42,10 @@ class TpuSparkSession:
                 self.conf.get(cfg.MEM_SPILL_DIR) or None)
         else:
             spill.disable_catalog()
+        from spark_rapids_tpu.io import scan_cache
+        scan_cache.configure(
+            self.conf.get(cfg.SCAN_METADATA_CACHE_ENABLED),
+            self.conf.get(cfg.SCAN_METADATA_CACHE_MAX_BYTES))
         from spark_rapids_tpu.pyworker import pool as pyworker_pool
         pyworker_pool.configure(self.conf)
         from spark_rapids_tpu.shuffle import faults

@@ -204,6 +204,36 @@ PARQUET_FUSED_DECODE = conf(
     "per-column decode per row group when off or when "
     "input_file_name() is used.", bool)
 
+SCAN_METADATA_CACHE_ENABLED = conf(
+    "spark.rapids.tpu.sql.scan.metadataCache.enabled", True,
+    "Cache scan host-prep artifacts (parsed parquet footers, Thrift "
+    "page descriptors, RLE run tables) process-wide, keyed on (path, "
+    "mtime, size, column, options) so repeat scans of unchanged files "
+    "skip the page-header walks entirely (the footer-cache analog of "
+    "the reference's multi-file reader; host-side sibling of the "
+    "compiled-kernel cache).", bool)
+
+SCAN_METADATA_CACHE_MAX_BYTES = conf(
+    "spark.rapids.tpu.sql.scan.metadataCache.maxBytes", 256 << 20,
+    "Byte budget for the scan metadata/plan cache; least-recently-used "
+    "files evict (whole-file granularity) when cached run tables and "
+    "packed page buffers exceed it.", int)
+
+SCAN_HOST_PREP_THREADS = conf(
+    "spark.rapids.tpu.sql.scan.hostPrep.threads", 4,
+    "Thread-pool size for parallel scan host prep: page-header and RLE "
+    "run-boundary walks across (column, row-group) pairs run "
+    "concurrently instead of sequentially (page reads and codec "
+    "decompression release the GIL). 1 disables the pool.", int)
+
+SCAN_PREFETCH_DEPTH = conf(
+    "spark.rapids.tpu.sql.scan.prefetch.depth", 2,
+    "Bounded look-ahead for the fused parquet scan: up to this many "
+    "batches' host prep + packed-page upload run ahead of the "
+    "dispatch-only device decode of the current batch (prep of batch "
+    "k+1 overlaps decode of batch k; no device->host read happens "
+    "before the terminal barrier). 0 disables pipelining.", int)
+
 ORC_DEVICE_DECODE = conf(
     "spark.rapids.tpu.sql.format.orc.deviceDecode.enabled", True,
     "Decode ORC stripes on the TPU: CPU parses stripe footers and RLEv2 "

@@ -185,3 +185,19 @@ def timed(metrics: Metrics):
         def __exit__(self, *a):
             metrics.total_time_ns += time.perf_counter_ns() - self.t0
     return _T()
+
+
+def timed_extra(metrics: Metrics, key: str):
+    """Time a sub-phase into ``Metrics.extra[key]`` (seconds) WITHOUT
+    touching total_time_ns — for phases that overlap the operator's
+    main timing (scan host prep / upload running on a prefetch thread
+    while the consumer's ``timed`` covers the dispatch)."""
+    class _T:
+        def __enter__(self):
+            self.t0 = time.perf_counter_ns()
+            return self
+
+        def __exit__(self, *a):
+            metrics.add_extra(
+                key, (time.perf_counter_ns() - self.t0) / 1e9)
+    return _T()

@@ -42,6 +42,7 @@ def materialize(relation: CachedRelation, conf) -> None:
     otherwise host Arrow encodes."""
     if relation.materialized:
         return
+    relation._blob_keys = None   # content digests memoized per build
     from spark_rapids_tpu.plan.overrides import TpuOverrides
     from spark_rapids_tpu.plan.planner import plan_cpu
     from spark_rapids_tpu.exec.cpu import concat_tables
@@ -138,23 +139,35 @@ class TpuInMemoryTableScanExec(TpuExec):
 
     def execute(self):
         from spark_rapids_tpu.io import device_parquet as devpq
+        from spark_rapids_tpu.io import scan_cache as sc
         materialize(self.relation, self.conf)
         schema = self.schema
+        # blob decodes reuse the scan-plan cache (content-keyed): a
+        # re-collected cached relation skips the page walks.  Digests
+        # memoize on the relation — blobs are immutable, so K collects
+        # must not pay K full-blob sha1 passes
+        keys = getattr(self.relation, "_blob_keys", None)
+        if keys is None or len(keys) != len(self.relation.blobs):
+            keys = [sc.blob_key(b) for b in self.relation.blobs]
+            self.relation._blob_keys = keys
 
-        def part(blob: bytes):
-            pf = papq.ParquetFile(io.BytesIO(blob))
+        def part(blob: bytes, skey):
+            pf = sc.blob_footer(blob)
+            if not sc.enabled():
+                skey = None
             for rg in range(pf.metadata.num_row_groups):
                 with tpu_semaphore():
                     with timed(self.metrics):
                         batch, fallbacks = devpq.decode_row_group(
-                            blob, rg, schema, parquet_file=pf)
+                            blob, rg, schema, parquet_file=pf,
+                            source_key=skey, metrics=self.metrics)
                     self.metrics.extra["fallbackColumns"] += \
                         len(fallbacks)
                     self.metrics.add_rows(batch.num_rows)
                     self.metrics.add_batches()
                     yield batch
 
-        return [part(b) for b in self.relation.blobs]
+        return [part(b, k) for b, k in zip(self.relation.blobs, keys)]
 
     def simple_string(self) -> str:
         return (f"TpuInMemoryTableScanExec("

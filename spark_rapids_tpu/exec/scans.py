@@ -1,4 +1,6 @@
-"""Capacity-safe prefix scans for wide (8-byte) dtypes.
+"""Capacity-safe prefix scans for wide (8-byte) dtypes, plus the
+pipelined scan prefetcher (bounded look-ahead host prep for file
+scans — see ScanPrefetcher below).
 
 TPU emulates 64-bit integers (and x64 floats) as pairs of 32-bit
 lanes, and both stock prefix-scan formulations break at capacity
@@ -25,10 +27,125 @@ TPU formulation of the same segmented-reduction building block.
 
 from __future__ import annotations
 
+import threading
+from typing import Callable, List, Optional, Sequence
+
 import jax
 import jax.numpy as jnp
 
 _BLOCK = 1 << 15          # per-step scan length
+
+
+class ScanPrefetcher:
+    """Bounded look-ahead runner for scan host prep.
+
+    Given one thunk per scan batch (each performing host-side prep +
+    device upload — e.g. ``io/parquet_fused.prepare_fused`` — and NO
+    device->host read, per PERF.md's no-mid-stream-read discipline),
+    runs up to ``depth`` of them ahead of the consumer on a small
+    thread pool, so batch k+1's footer/page walks and packed-page
+    uploads overlap batch k's dispatch-only device decode.
+
+    ``get(i)`` returns thunk i's result exactly once, blocking if it
+    isn't ready (counted into ``metrics.extra['scan.prefetchStalls']``
+    — a stall means the consumer outran the prepared window).
+    Consumers may arrive out of order (partition iterators drain on a
+    task pool); an index past the submitted window forces submission
+    so no ``get`` can deadlock.  A thunk's exception is re-raised at
+    its ``get``.  In-flight prepared-but-unconsumed batches — and so
+    the held host artifacts and uploaded page buffers — are bounded by
+    ``max(depth, concurrent consumers)``: the forced submissions mean
+    a task pool wider than ``depth`` raises the bound to its own
+    width (the engine's pool is ``concurrentTpuTasks``, default 2).
+
+    Abandonment safety: if the consumer never drains every index (an
+    error mid-query, a short-circuiting collect), ``close()`` — also
+    wired as a GC finalizer — cancels undispatched thunks and runs
+    ``cleanup`` on every prepared-but-unconsumed result (e.g. closing
+    file handles), then shuts the pool down."""
+
+    def __init__(self, thunks: Sequence[Callable[[], object]],
+                 depth: int, metrics=None,
+                 stall_key: str = "scan.prefetchStalls",
+                 cleanup: Optional[Callable[[object], None]] = None):
+        import concurrent.futures as cf
+        import weakref
+        self._thunks: List[Callable[[], object]] = list(thunks)
+        self._depth = max(1, int(depth))
+        self._metrics = metrics
+        self._stall_key = stall_key
+        self._lock = threading.Lock()
+        self._futures = {}
+        self._next = 0
+        self._consumed = 0
+        self._parts_done = 0
+        self._pool: Optional[object] = None
+        if self._thunks:
+            self._pool = cf.ThreadPoolExecutor(
+                max_workers=self._depth,
+                thread_name_prefix="scan-prefetch")
+            # args must not reference self (that would pin it forever)
+            self._finalizer = weakref.finalize(
+                self, ScanPrefetcher._close_impl, self._lock,
+                self._futures, self._pool, cleanup)
+            with self._lock:
+                self._fill_locked()
+
+    def _fill_locked(self) -> None:
+        while (self._next < len(self._thunks) and
+               len(self._futures) < self._depth):
+            i = self._next
+            self._next += 1
+            self._futures[i] = self._pool.submit(self._thunks[i])
+
+    def part_done(self) -> None:
+        """Consumer-side completion mark, called once per index from
+        the partition iterator's ``finally`` (success OR failure).
+        Once every consumer has finished, prepared-but-unconsumed
+        results are released deterministically — without waiting for
+        the GC finalizer — covering queries that die mid-drain."""
+        with self._lock:
+            self._parts_done += 1
+            done = self._parts_done >= len(self._thunks)
+        if done:
+            self.close()
+
+    def get(self, i: int):
+        with self._lock:
+            # out-of-order consumer past the window: submit through i
+            while self._next <= i:
+                j = self._next
+                self._next += 1
+                self._futures[j] = self._pool.submit(self._thunks[j])
+            fut = self._futures.pop(i)
+        if not fut.done() and self._metrics is not None:
+            self._metrics.add_extra(self._stall_key, 1)
+        try:
+            return fut.result()
+        finally:
+            with self._lock:
+                self._consumed += 1
+                self._fill_locked()
+                if self._consumed >= len(self._thunks):
+                    self._pool.shutdown(wait=False)
+
+    @staticmethod
+    def _close_impl(lock, futures, pool, cleanup) -> None:
+        with lock:
+            pending = list(futures.values())
+            futures.clear()
+        for fut in pending:
+            if not fut.cancel() and cleanup is not None:
+                try:
+                    cleanup(fut.result())
+                except Exception:
+                    pass   # the thunk itself failed: nothing to clean
+        pool.shutdown(wait=False)
+
+    def close(self) -> None:
+        """Release prepared-but-unconsumed results (idempotent)."""
+        if self._pool is not None:
+            self._finalizer()
 
 
 def _to_blocks(x: jnp.ndarray, fill) -> jnp.ndarray:

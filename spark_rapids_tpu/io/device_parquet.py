@@ -473,7 +473,15 @@ def plan_chunk(chunk: pm.ChunkPages, out_dtype: dt.DType,
 def decode_chunk(chunk: pm.ChunkPages, out_dtype: dt.DType,
                  cap: int) -> DeviceColumn:
     """Decode one flat column chunk into a DeviceColumn of capacity cap."""
-    p = plan_chunk(chunk, out_dtype, allow_mixed=True)
+    return decode_plan(plan_chunk(chunk, out_dtype, allow_mixed=True), cap)
+
+
+def decode_plan(p: "ChunkPlan", cap: int) -> DeviceColumn:
+    """Decode one host-walked ChunkPlan (possibly served by the scan
+    -plan cache — io/scan_cache.py) into a DeviceColumn of capacity
+    cap.  Treats the plan as immutable: plans are shared across
+    queries and threads."""
+    out_dtype = p.out_dtype
     n_rows = p.n_rows
 
     # -- device expansion ---------------------------------------------------
@@ -590,24 +598,39 @@ def leaf_index_map(pf) -> dict:
     return out
 
 
+def leaf_map(pf) -> dict:
+    """leaf_index_map with the cached-footer fast path (FooterInfo
+    memoizes its map; a plain ParquetFile recomputes)."""
+    if hasattr(pf, "leaf_of"):
+        return pf.leaf_of()
+    return leaf_index_map(pf)
+
+
 def decode_row_group(path: str, row_group: int, schema: Schema,
                      columns: Optional[List[str]] = None,
-                     parquet_file: Optional[papq.ParquetFile] = None
-                     ) -> Tuple[DeviceBatch, List[str]]:
+                     parquet_file: Optional[papq.ParquetFile] = None,
+                     source_key: Optional[tuple] = None,
+                     metrics=None) -> Tuple[DeviceBatch, List[str]]:
     """Decode one row group to a DeviceBatch.
 
     Returns (batch, fallback_columns) — fallback columns were host-decoded
     (Arrow) because their chunks use unsupported encodings/types.
 
     ``path`` may also be an in-memory parquet blob (bytes) — the cached
-    -batch decode path (ParquetCachedBatchSerializer analog)."""
+    -batch decode path (ParquetCachedBatchSerializer analog).
+
+    ``source_key`` (io/scan_cache.source_key) enables the scan-plan
+    cache for the flat-column page walks; pass None to force fresh
+    walks.  ``parquet_file`` may be a real ParquetFile or a cached
+    ``scan_cache.FooterInfo`` (only ``.metadata``/``.schema_arrow``/
+    ``.read_row_group`` are used)."""
+    from spark_rapids_tpu.io import scan_cache as sc
     if parquet_file is None and isinstance(path,
                                            (bytes, bytearray, memoryview)):
-        import io as _io
-        parquet_file = papq.ParquetFile(_io.BytesIO(path))
+        parquet_file = sc.blob_footer(path)
     pf = parquet_file or papq.ParquetFile(path)
     md = pf.metadata
-    leaf_of = leaf_index_map(pf)
+    leaf_of = leaf_map(pf)
     wanted = columns or [f.name for f in schema.fields]
     n_rows = md.row_group(row_group).num_rows
     cap = bucket_rows(max(n_rows, 1))
@@ -615,6 +638,15 @@ def decode_row_group(path: str, row_group: int, schema: Schema,
     cols: List[DeviceColumn] = []
     out_names: List[str] = []
     fallbacks: List[str] = []
+    fb_pf = None    # one transient open shared by all fallback columns
+
+    def _fb_reader():
+        nonlocal fb_pf
+        if fb_pf is None:
+            fb_pf = papq.ParquetFile(path) \
+                if isinstance(pf, sc.FooterInfo) else pf
+        return fb_pf
+
     for name in wanted:
         f = schema.field(name)
         if name not in leaf_of:
@@ -640,22 +672,29 @@ def decode_row_group(path: str, row_group: int, schema: Schema,
             continue
         ci = leaf_of[name]
         try:
-            chunk = pm.read_chunk_pages(path, row_group, ci,
-                                        parquet_file=pf)
             if f.dtype.is_list:
+                # nested chunks aren't plan-cacheable (ChunkPlan covers
+                # flat columns only): walk fresh
+                chunk = pm.read_chunk_pages(path, row_group, ci,
+                                            parquet_file=pf)
                 col = decode_list_chunk(chunk, f.dtype, cap,
                                         f.nullable)
             else:
-                col = decode_chunk(chunk, f.dtype, cap)
+                plan = sc.get_chunk_plan(source_key, path, row_group,
+                                         ci, f.dtype, True, pf,
+                                         metrics=metrics)
+                col = decode_plan(plan, cap)
         except Exception:
             # UnsupportedChunk or any malformed-page surprise: this column
             # decodes on host; the rest of the batch stays on device
             fallbacks.append(name)
-            t = pf.read_row_group(row_group, columns=[name])
+            t = _fb_reader().read_row_group(row_group, columns=[name])
             sub = from_arrow(_cast_one(t, f), capacity=cap)
             col = sub.columns[0]
         cols.append(col)
         out_names.append(name)
+    if fb_pf is not None and fb_pf is not pf:
+        fb_pf.close()
     return DeviceBatch(out_names, cols, n_rows), fallbacks
 
 

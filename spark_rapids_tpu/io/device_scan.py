@@ -16,7 +16,6 @@ from __future__ import annotations
 from typing import Iterator, List, Optional
 
 import numpy as np
-import pyarrow.parquet as papq
 
 import jax.numpy as jnp
 
@@ -128,17 +127,21 @@ class TpuParquetScanExec(TpuExec):
                 yield out
 
     def _open(self, path: str):
-        return path, papq.ParquetFile(path)
+        from spark_rapids_tpu.io import scan_cache as sc
+        return path, sc.open_source(path, metrics=self.metrics)
 
     def _num_chunks(self, fctx) -> int:
         return fctx[1].metadata.num_row_groups
 
     def _decode_chunk(self, fctx, idx: int, file_schema: Schema,
                       file_cols):
+        from spark_rapids_tpu.io import scan_cache as sc
         path, pf = fctx
         return devpq.decode_row_group(path, idx, file_schema,
                                       columns=file_cols,
-                                      parquet_file=pf)
+                                      parquet_file=pf,
+                                      source_key=sc.handle_key(pf, path),
+                                      metrics=self.metrics)
 
     def execute(self) -> List[Iterator[DeviceBatch]]:
         if (self.fmt == "parquet" and self.allow_fused and
@@ -157,13 +160,14 @@ class TpuParquetScanExec(TpuExec):
         Files open only transiently here (footer metadata) and lazily
         again inside each group's iterator — a scan over thousands of
         files must not hold thousands of descriptors for the query."""
+        from spark_rapids_tpu.io import scan_cache as sc
         max_rows = int(self.conf.get(cfg.MAX_READER_BATCH_SIZE_ROWS))
         max_bytes = int(self.conf.get(cfg.MAX_READER_BATCH_SIZE_BYTES))
         pv_list = self.scan.options.get("part_values") or []
         groups = []
         cur, cur_rows, cur_bytes, cur_pv = [], 0, 0, None
         for fi, path in enumerate(self.scan.paths):
-            pf = papq.ParquetFile(path)
+            pf = sc.open_source(path, metrics=self.metrics)
             pv = pv_list[fi] if fi < len(pv_list) else {}
             pv_key = tuple(sorted(pv.items()))
             md = pf.metadata
@@ -188,50 +192,102 @@ class TpuParquetScanExec(TpuExec):
         return groups
 
     def _execute_fused(self) -> List[Iterator[DeviceBatch]]:
-        from spark_rapids_tpu.io.parquet_fused import \
-            decode_row_groups_fused
+        from spark_rapids_tpu.exec.scans import ScanPrefetcher
+        from spark_rapids_tpu.io import parquet_fused as pqf
+        from spark_rapids_tpu.io import scan_cache as sc
 
         wanted = [f.name for f in self._schema.fields]
         part_cols = [c for c in wanted if c in self.part_fields]
         file_cols = [c for c in wanted if c not in self.part_fields]
         file_schema = Schema([self._schema.field(c) for c in file_cols])
+        host_threads = max(1, int(self.conf.get(
+            cfg.SCAN_HOST_PREP_THREADS)))
+        depth = max(0, int(self.conf.get(cfg.SCAN_PREFETCH_DEPTH)))
+        groups = self._fused_groups()
 
-        def group_part(path_rgs, pv) -> Iterator[DeviceBatch]:
-            from spark_rapids_tpu.exec.context import set_input_file
-            paths = {p for p, _ in path_rgs}
-            pfs = {p: papq.ParquetFile(p) for p in paths}
-            sources = [(pfs[p], p, rg) for p, rg in path_rgs]
+        def prepare(path_rgs):
+            """Host prep + packed-page upload for one batch (NO device
+            read — safe on the prefetch thread)."""
+            handles = {p: sc.open_source(p, metrics=self.metrics)
+                       for p in {p for p, _ in path_rgs}}
+            sources = [(handles[p], p, rg) for p, rg in path_rgs]
             try:
-                with tpu_semaphore():
-                    with timed(self.metrics):
-                        batch, fallbacks = decode_row_groups_fused(
-                            sources, file_schema, columns=file_cols)
-                    self.metrics.add_extra("fallbackColumns",
-                                           len(fallbacks))
-                    cap = batch.capacity
-                    names = list(batch.names)
-                    cols = list(batch.columns)
-                    for c in part_cols:
-                        d = self.part_fields[c]
-                        names.append(c)
-                        cols.append(_const_column(
-                            d, pv.get(c), cap, int(batch.num_rows)))
-                    order = [names.index(c) for c in wanted]
-                    out = DeviceBatch([names[i] for i in order],
-                                      [cols[i] for i in order],
-                                      batch.num_rows)
-                    self.metrics.num_output_rows += int(out.num_rows)
-                    self.metrics.add_batches()
-                    set_input_file(paths.pop() if len(paths) == 1
-                                   else "")
-                    yield out
+                return pqf.prepare_fused(
+                    sources, file_schema, columns=file_cols,
+                    host_threads=host_threads,
+                    metrics=self.metrics), handles
+            except BaseException:
+                for h in handles.values():
+                    h.close()
+                raise
+
+        def finish(prepared, pv) -> DeviceBatch:
+            """Dispatch the prepared batch (caller holds the TPU
+            semaphore)."""
+            prep, handles = prepared
+            try:
+                with timed(self.metrics):
+                    batch, fallbacks = pqf.finish_fused(prep)
+                self.metrics.add_extra("fallbackColumns",
+                                       len(fallbacks))
+                cap = batch.capacity
+                names = list(batch.names)
+                cols = list(batch.columns)
+                for c in part_cols:
+                    d = self.part_fields[c]
+                    names.append(c)
+                    cols.append(_const_column(
+                        d, pv.get(c), cap, int(batch.num_rows)))
+                order = [names.index(c) for c in wanted]
+                out = DeviceBatch([names[i] for i in order],
+                                  [cols[i] for i in order],
+                                  batch.num_rows)
+                self.metrics.num_output_rows += int(out.num_rows)
+                self.metrics.add_batches()
+                return out
+            finally:
+                for h in handles.values():
+                    h.close()
+
+        prefetcher = None
+        if depth > 0 and len(groups) > 1:
+            # bounded look-ahead: host prep + upload of batch k+1
+            # overlaps the dispatch-only decode of batch k
+            prefetcher = ScanPrefetcher(
+                [(lambda prgs=srcs: prepare(prgs))
+                 for srcs, _pv in groups],
+                depth=depth, metrics=self.metrics,
+                cleanup=lambda prepared: [
+                    h.close() for h in prepared[1].values()])
+
+        def group_part(idx, path_rgs, pv) -> Iterator[DeviceBatch]:
+            from spark_rapids_tpu.exec.context import set_input_file
+            try:
+                if prefetcher is not None:
+                    prepared = prefetcher.get(idx)
+                    with tpu_semaphore():
+                        out = finish(prepared, pv)
+                else:
+                    # no pipelining: the whole prep+upload+dispatch runs
+                    # under the semaphore, preserving the pre-prefetch
+                    # concurrent-device-work bound
+                    with tpu_semaphore():
+                        prepared = prepare(path_rgs)
+                        out = finish(prepared, pv)
+                paths = {p for p, _ in path_rgs}
+                # set right before the yield so the consumer evaluates
+                # input_file_name() against THIS batch's file
+                set_input_file(paths.pop() if len(paths) == 1 else "")
+                yield out
             finally:
                 set_input_file("")
-                for pf in pfs.values():
-                    pf.close()
+                if prefetcher is not None:
+                    # once every partition has finished (or failed),
+                    # unconsumed prepared batches release immediately
+                    prefetcher.part_done()
 
-        return [group_part(srcs, pv)
-                for srcs, pv in self._fused_groups()]
+        return [group_part(i, srcs, pv)
+                for i, (srcs, pv) in enumerate(groups)]
 
     def simple_string(self) -> str:
         return (f"{type(self).__name__}"

@@ -55,7 +55,6 @@ from typing import Any, Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
 import pyarrow as pa
-import pyarrow.parquet as papq
 
 import jax
 import jax.numpy as jnp
@@ -68,7 +67,7 @@ from spark_rapids_tpu.columnar.batch import (DeviceBatch, DeviceColumn,
 from spark_rapids_tpu.io import parquet_meta as pm
 from spark_rapids_tpu.io.device_parquet import (ChunkPlan, RunTable,
                                                 UnsupportedChunk, _cast_one,
-                                                _pad_np, leaf_index_map,
+                                                _pad_np, leaf_map,
                                                 plan_chunk)
 from spark_rapids_tpu.plan.logical import Schema
 
@@ -707,7 +706,9 @@ def _make_kernel(fp: _FusedPlan):
 
 
 # ---------------------------------------------------------------------------
-# Public entry
+# Public entry: host-prep (prepare) split from device dispatch (finish)
+# so a prefetching scan can run batch k+1's footer/page walks + packed
+# -page uploads while batch k's decode program is being dispatched
 # ---------------------------------------------------------------------------
 
 def _fused_list_column(sources, f, n_rows) -> Optional[DeviceColumn]:
@@ -718,7 +719,7 @@ def _fused_list_column(sources, f, n_rows) -> Optional[DeviceColumn]:
     try:
         per = []
         for (pf, path, rg), nr in zip(sources, n_rows):
-            leaf_of = leaf_index_map(pf)
+            leaf_of = leaf_map(pf)
             if f.name not in leaf_of:
                 return None
             chunk = pm.read_chunk_pages(path, rg, leaf_of[f.name],
@@ -733,21 +734,73 @@ def _fused_list_column(sources, f, n_rows) -> Optional[DeviceColumn]:
         return None
 
 
-def decode_row_groups_fused(sources: Sequence[Tuple[Any, str, int]],
-                            schema: Schema,
-                            columns: Optional[List[str]] = None
-                            ) -> Tuple[DeviceBatch, List[str]]:
-    """Decode several (parquet_file, path, row_group) sources into ONE
-    DeviceBatch with one fused kernel (+ a host-decoded column merge for
-    anything the device path can't cover).
+@dataclass
+class PreparedScan:
+    """Everything a fused scan batch needs EXCEPT the decode dispatch:
+    assembled plan, device-resident upload set, list columns (already
+    dispatch-only device work) and host-decoded fallback columns
+    (already uploaded).  ``finish_fused`` turns it into a DeviceBatch
+    with one kernel call — no device->host read anywhere."""
+    wanted: List[str]
+    total: int
+    cap: int
+    fp: Optional[_FusedPlan]
+    dev_arrays: Optional[Dict[str, Any]]
+    dev_cols: List[str]
+    extra_cols: Dict[str, DeviceColumn]
+    fallbacks: List[str]
 
-    Returns (batch, fallback_column_names)."""
-    wanted = columns or [f.name for f in schema.fields]
-    out_dtypes = [schema.field(c).dtype for c in wanted]
+
+def _collect_plans(sources, schema, wanted, host_threads: int,
+                   metrics=None) -> Tuple[List, List[str],
+                                          Dict[str, DeviceColumn]]:
+    """Walk (or cache-fetch) every flat column chunk's ChunkPlan, the
+    parallel host-prep stage: a thread pool of ``host_threads`` walks
+    page headers / run boundaries across (column, row-group) pairs
+    concurrently.  Page reads and codec decompression release the GIL,
+    so the walks genuinely overlap."""
+    from spark_rapids_tpu.io import scan_cache as sc
+
+    # key on the stamp each footer was PARSED under (handle_key), not a
+    # fresh stat: a file rewritten mid-scan must never cache plans built
+    # from the stale footer's offsets under its new (mtime, size) key
+    skeys = {path: sc.handle_key(pf, path)
+             for pf, path, _ in sources}
+
+    flat_cols = [c for c in wanted if not schema.field(c).dtype.is_list]
+
+    def plan_one(c, si):
+        pf, path, rg = sources[si]
+        leaf_of = leaf_map(pf)
+        if c not in leaf_of:
+            return None
+        return sc.get_chunk_plan(skeys[path], path, rg, leaf_of[c],
+                                 schema.field(c).dtype, False, pf,
+                                 metrics=metrics)
+
+    def run(item):
+        c, si = item
+        try:
+            return plan_one(c, si)
+        except Exception as e:
+            return e
+
+    tasks = [(c, si) for c in flat_cols for si in range(len(sources))]
+    results: Dict[Tuple[str, int], Any] = {}
+    if host_threads > 1 and len(tasks) > 1:
+        import concurrent.futures as cf
+        with cf.ThreadPoolExecutor(
+                max_workers=min(host_threads, len(tasks)),
+                thread_name_prefix="scan-hostprep") as pool:
+            outs = list(pool.map(run, tasks))
+    else:
+        outs = [run(t) for t in tasks]
+    for (c, si), out in zip(tasks, outs):
+        results[(c, si)] = out
+
     n_rows = [pf.metadata.row_group(rg).num_rows
               for pf, _, rg in sources]
-
-    plans: List[List[Optional[ChunkPlan]]] = []
+    plans: List[Optional[List[Optional[ChunkPlan]]]] = []
     fallbacks: List[str] = []
     list_cols: Dict[str, DeviceColumn] = {}
     for c in wanted:
@@ -762,62 +815,131 @@ def decode_row_groups_fused(sources: Sequence[Tuple[Any, str, int]],
                 fallbacks.append(c)
             plans.append(None)
             continue
-        col_plans: List[Optional[ChunkPlan]] = []
-        try:
-            for pf, path, rg in sources:
-                leaf_of = leaf_index_map(pf)
-                if c not in leaf_of:
-                    col_plans.append(None)
-                    continue
-                chunk = pm.read_chunk_pages(path, rg, leaf_of[c],
-                                            parquet_file=pf)
-                col_plans.append(plan_chunk(chunk, f.dtype))
-        except Exception:
+        col_plans = [results[(c, si)] for si in range(len(sources))]
+        if any(isinstance(p, Exception) for p in col_plans):
             fallbacks.append(c)
-            col_plans = None
-        plans.append(col_plans)
+            plans.append(None)
+        else:
+            plans.append(col_plans)
+    return plans, fallbacks, list_cols
 
-    dev_cols = [c for c, p in zip(wanted, plans) if p is not None]
-    dev_dtypes = [d for d, p in zip(out_dtypes, plans) if p is not None]
-    dev_plans = [p for p in plans if p is not None]
 
-    total = sum(n_rows)
-    cap = bucket_rows(max(total, 1))
+def prepare_fused(sources: Sequence[Tuple[Any, str, int]],
+                  schema: Schema,
+                  columns: Optional[List[str]] = None,
+                  host_threads: int = 1,
+                  metrics=None) -> PreparedScan:
+    """Host half of the fused decode: footer/page walks (through the
+    scan-plan cache when enabled), fused-plan assembly, packed-page
+    upload, and the host-Arrow fallback decode.  Safe to run on a
+    prefetch thread: it never reads device memory."""
+    import contextlib
+    from spark_rapids_tpu.columnar.batch import from_arrow as _fa
+    from spark_rapids_tpu.exec.base import timed_extra
 
-    cols_by_name: Dict[str, DeviceColumn] = dict(list_cols)
-    if dev_plans:
-        fp = assemble(dev_plans, dev_dtypes, dev_cols, n_rows)
-        from spark_rapids_tpu.exec import kernel_cache as kc
-        kern = kc.get_kernel(fp.key, lambda: _make_kernel(fp))
-        dev_arrays = {k: jnp.asarray(v) for k, v in fp.arrays.items()}
-        out_cols, _ = kern(dev_arrays)
-        for name, col in zip(dev_cols, out_cols):
-            cols_by_name[name] = col
+    def phase(key):
+        return timed_extra(metrics, key) if metrics is not None \
+            else contextlib.nullcontext()
 
-    if fallbacks:
-        tables = []
-        for pf, path, rg in sources:
-            leaf_of2 = leaf_index_map(pf)
-            present = [c for c in fallbacks if c in leaf_of2]
-            t = pf.read_row_group(rg, columns=present) if present \
-                else pa.table({})
-            arrs = []
-            for c in fallbacks:
-                f = schema.field(c)
-                if c in present:
-                    arrs.append(_cast_one(t.select([c]), f).column(0))
-                else:
-                    arrs.append(pa.nulls(t.num_rows if present
+    wanted = columns or [f.name for f in schema.fields]
+    out_dtypes = [schema.field(c).dtype for c in wanted]
+    n_rows = [pf.metadata.row_group(rg).num_rows
+              for pf, _, rg in sources]
+
+    with phase("scan.hostPrepTime"):
+        plans, fallbacks, list_cols = _collect_plans(
+            sources, schema, wanted, host_threads, metrics=metrics)
+
+        dev_cols = [c for c, p in zip(wanted, plans) if p is not None]
+        dev_dtypes = [d for d, p in zip(out_dtypes, plans)
+                      if p is not None]
+        dev_plans = [p for p in plans if p is not None]
+
+        total = sum(n_rows)
+        cap = bucket_rows(max(total, 1))
+
+        fp = assemble(dev_plans, dev_dtypes, dev_cols, n_rows) \
+            if dev_plans else None
+
+    with phase("scan.uploadTime"):
+        dev_arrays = {k: jnp.asarray(v) for k, v in fp.arrays.items()} \
+            if fp is not None else None
+
+        extra_cols: Dict[str, DeviceColumn] = dict(list_cols)
+        if fallbacks:
+            import pyarrow.parquet as papq
+            from spark_rapids_tpu.io import scan_cache as sc
+            opened: Dict[str, Any] = {}
+
+            def reader(pf, path):
+                # one transient open per path for the whole fallback
+                # merge (FooterInfo.read_row_group re-opens per call)
+                if isinstance(pf, sc.FooterInfo):
+                    if path not in opened:
+                        opened[path] = papq.ParquetFile(path)
+                    return opened[path]
+                return pf
+            try:
+                tables = []
+                for pf, path, rg in sources:
+                    leaf_of2 = leaf_map(pf)
+                    present = [c for c in fallbacks if c in leaf_of2]
+                    t = reader(pf, path).read_row_group(
+                        rg, columns=present) if present else pa.table({})
+                    arrs = []
+                    for c in fallbacks:
+                        f = schema.field(c)
+                        if c in present:
+                            arrs.append(
+                                _cast_one(t.select([c]), f).column(0))
+                        else:
+                            arrs.append(
+                                pa.nulls(t.num_rows if present
                                          else pf.metadata.row_group(rg)
                                          .num_rows,
                                          type=f.dtype.to_arrow()))
-            tables.append(pa.Table.from_arrays(
-                arrs, names=list(fallbacks)))
-        merged = pa.concat_tables(tables)
-        fb = from_arrow(merged, capacity=cap)
-        for name, col in zip(fb.names, fb.columns):
-            cols_by_name[name] = col
+                    tables.append(pa.Table.from_arrays(
+                        arrs, names=list(fallbacks)))
+            finally:
+                for f in opened.values():
+                    f.close()
+            merged = pa.concat_tables(tables)
+            fb = _fa(merged, capacity=cap)
+            for name, col in zip(fb.names, fb.columns):
+                extra_cols[name] = col
 
+    return PreparedScan(wanted=wanted, total=total, cap=cap, fp=fp,
+                        dev_arrays=dev_arrays, dev_cols=dev_cols,
+                        extra_cols=extra_cols, fallbacks=fallbacks)
+
+
+def finish_fused(prep: PreparedScan) -> Tuple[DeviceBatch, List[str]]:
+    """Device half: ONE fused kernel dispatch over the prepared upload
+    set (dispatch-only — the terminal collect barrier does the read)."""
+    cols_by_name: Dict[str, DeviceColumn] = dict(prep.extra_cols)
+    if prep.fp is not None:
+        from spark_rapids_tpu.exec import kernel_cache as kc
+        fp = prep.fp
+        kern = kc.get_kernel(fp.key, lambda: _make_kernel(fp))
+        out_cols, _ = kern(prep.dev_arrays)
+        for name, col in zip(prep.dev_cols, out_cols):
+            cols_by_name[name] = col
     out = DeviceBatch(
-        wanted, [cols_by_name[c] for c in wanted], total)
-    return out, fallbacks
+        prep.wanted, [cols_by_name[c] for c in prep.wanted], prep.total)
+    return out, prep.fallbacks
+
+
+def decode_row_groups_fused(sources: Sequence[Tuple[Any, str, int]],
+                            schema: Schema,
+                            columns: Optional[List[str]] = None,
+                            host_threads: int = 1,
+                            metrics=None
+                            ) -> Tuple[DeviceBatch, List[str]]:
+    """Decode several (parquet_file, path, row_group) sources into ONE
+    DeviceBatch with one fused kernel (+ a host-decoded column merge for
+    anything the device path can't cover).
+
+    Returns (batch, fallback_column_names)."""
+    return finish_fused(prepare_fused(sources, schema, columns=columns,
+                                      host_threads=host_threads,
+                                      metrics=metrics))

@@ -17,10 +17,28 @@ and recursive skip of unknown fields.
 from __future__ import annotations
 
 import struct
+import threading
 from dataclasses import dataclass, field
 from typing import Dict, List, Optional, Tuple
 
 import pyarrow.parquet as papq
+
+# page-walk probe: every chunk whose page headers are actually walked
+# bumps this counter, so tests (and the scan-plan cache acceptance
+# criterion) can assert a warm scan performs ZERO walks
+_WALK_LOCK = threading.Lock()
+_WALK_COUNT = 0
+
+
+def walk_count() -> int:
+    with _WALK_LOCK:
+        return _WALK_COUNT
+
+
+def _note_walk() -> None:
+    global _WALK_COUNT
+    with _WALK_LOCK:
+        _WALK_COUNT += 1
 
 # Thrift compact type nibbles
 _T_BOOL_TRUE = 1
@@ -218,6 +236,7 @@ def read_chunk_pages(path: str, row_group: int, col_idx: int,
                     parquet_file: Optional[papq.ParquetFile] = None
                     ) -> ChunkPages:
     """Read one column chunk's raw bytes and index its pages on CPU."""
+    _note_walk()
     pf = parquet_file or papq.ParquetFile(path)
     md = pf.metadata
     cc = md.row_group(row_group).column(col_idx)

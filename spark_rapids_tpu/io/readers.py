@@ -101,7 +101,10 @@ def infer_schema(fmt: str, paths: List[str],
                  options: Optional[dict] = None) -> Schema:
     options = options or {}
     if fmt == "parquet":
-        return Schema.from_arrow(papq.read_schema(paths[0]))
+        # one footer parse serves schema inference AND the scan: the
+        # cached FooterInfo is what TpuParquetScanExec re-opens
+        from spark_rapids_tpu.io import scan_cache as sc
+        return Schema.from_arrow(sc.get_footer(paths[0]).schema_arrow)
     if fmt == "orc":
         return Schema.from_arrow(paorc.ORCFile(paths[0]).schema)
     if fmt == "csv":

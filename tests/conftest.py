@@ -72,3 +72,13 @@ def pytest_configure(config):
         "markers",
         "faults: deterministic fault-injection tests exercising the "
         "shuffle retry/recovery/fallback machinery (tier-1 safe)")
+    config.addinivalue_line(
+        "markers",
+        "perf: performance-oriented tests (e.g. the scan-plan cache "
+        "byte-budget eviction drill) — runnable standalone via "
+        "`pytest -m perf`")
+    config.addinivalue_line(
+        "markers",
+        "slow: excluded from the tier-1 budgeted run (ROADMAP.md runs "
+        "-m 'not slow'); the heaviest distributed-plan parity drills "
+        "live here — run them via `pytest -m slow`")

@@ -209,6 +209,7 @@ def _agg_query(n_parts):
     return q
 
 
+@pytest.mark.slow
 def test_planned_distributed_groupby_parity():
     q = _agg_query(4)
     cpu = _cpu_collect(q)
@@ -217,6 +218,7 @@ def test_planned_distributed_groupby_parity():
     assert_tables_equal(cpu, tpu, ignore_order=True)
 
 
+@pytest.mark.slow
 def test_planned_distributed_join_parity():
     rng = np.random.default_rng(8)
     n = 600
@@ -243,7 +245,10 @@ def test_planned_distributed_join_parity():
     assert_tables_equal(cpu, tpu, ignore_order=True)
 
 
-@pytest.mark.parametrize("how", ["left", "full", "leftsemi", "leftanti"])
+@pytest.mark.parametrize("how", [
+    pytest.param("left", marks=pytest.mark.slow),
+    pytest.param("full", marks=pytest.mark.slow),
+    "leftsemi", "leftanti"])
 def test_planned_distributed_join_types(how):
     rng = np.random.default_rng(9)
     left = pa.table({
@@ -283,6 +288,7 @@ def test_planned_repartition_roundtrip():
     assert_tables_equal(cpu, tpu, ignore_order=True)
 
 
+@pytest.mark.slow
 def test_planned_distributed_agg_then_join():
     """Composite: distributed agg feeding a distributed join."""
     rng = np.random.default_rng(11)
@@ -331,6 +337,7 @@ def test_ring_broadcast_batch_replicates():
             sorted(t.column("s").to_pylist())
 
 
+@pytest.mark.slow
 def test_planned_broadcast_join_ici_ring():
     """Broadcast hash join with the build side replicated over the
     ppermute ring instead of one mesh broadcast — planner-reachable via
@@ -441,6 +448,7 @@ def test_planned_distributed_total_sort():
     assert_tables_equal(cpu, tpu, ignore_order=False)
 
 
+@pytest.mark.slow
 def test_planned_distributed_window_parity():
     """Window over PARTITION BY keys: hash exchange on the keys (ICI
     plane) + per-shard window evaluation."""
@@ -584,6 +592,7 @@ def test_planned_distributed_global_limit():
     assert got <= allowed and len(got) == 11
 
 
+@pytest.mark.slow
 def test_planned_distributed_aqe_skew_split():
     """AQE skew-split over the ICI plane: the adaptive join reader
     splits the hot partition into per-map slices while the other side
