@@ -187,7 +187,8 @@ def _build_device_pipeline(root: str):
                                  host_threads=4, metrics=m)
         assert not prep.fallbacks, \
             f"bench columns fell back: {prep.fallbacks}"
-        return prep.fp, m.extra["scan.hostPrepTime"]
+        # timed_extra accumulates NANOSECONDS; convert at report time
+        return prep.fp, m.extra_s("scan.hostPrepTime")
 
     sc.clear()  # cold: fresh process semantics even under repeat runs
     fp, host_prep_s = host_prep()
@@ -282,6 +283,26 @@ def _device_pipeline_metric(root: str):
     return max(per_query, 1e-9), host_prep, tpu_table
 
 
+def _write_profile(root: str, out_path: str):
+    """One profiled engine collect of the bench query with tracing on:
+    the QueryProfile JSON (+ its Chrome trace alongside) lands next to
+    the BENCH results so the perf trajectory is self-explaining."""
+    from spark_rapids_tpu import TpuSparkSession
+    s = TpuSparkSession({
+        "spark.rapids.tpu.sql.variableFloatAgg.enabled": True,
+        "spark.rapids.tpu.obs.trace.enabled": True})
+    out = _query(s, root).collect()
+    prof = s.last_query_profile()
+    assert prof is not None and prof.result_rows == out.num_rows, \
+        "query profile rows disagree with the collected result"
+    with open(out_path, "w") as f:
+        f.write(prof.to_json())
+    prof.dump_chrome_trace(out_path + ".trace.json")
+    from spark_rapids_tpu.obs import trace as obs_trace
+    obs_trace.configure(False)  # don't trace the rest of the bench
+    return out_path
+
+
 def main() -> None:
     import spark_rapids_tpu  # noqa: F401 (x64, compile cache)
 
@@ -289,10 +310,16 @@ def main() -> None:
     n = int(args[0]) if args else 2_880_000  # SF1 store_sales slice
     files = 8
     smoke = "--smoke" in sys.argv
+    profile_out = None
+    for a in sys.argv[1:]:
+        if a.startswith("--profile-out="):
+            profile_out = a.split("=", 1)[1]
     if smoke:
         n = 160_000
     with tempfile.TemporaryDirectory(prefix="tpcds_q6_") as root:
         nbytes = _write_dataset(root, n, files)
+        if profile_out:
+            _write_profile(root, profile_out)
         cpu_time, cpu_table = _time_engine_cpu(root)
         per_query, (host_prep_s, host_prep_warm_s), tpu_table = \
             _device_pipeline_metric(root)
@@ -342,6 +369,7 @@ def main() -> None:
         "rows_match": bool(rows_match),
         "e2e_tunnel_wall_s": round(e2e, 2) if e2e else None,
         "vs_baseline_e2e": round(cpu_time / e2e, 4) if e2e else None,
+        "profile_out": profile_out,
     }))
 
 

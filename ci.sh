@@ -7,7 +7,8 @@ cd "$(dirname "$0")"
 echo "== lint (syntax + import sanity) =="
 python -m compileall -q spark_rapids_tpu tests bench.py __graft_entry__.py
 if python -c "import pyflakes" 2>/dev/null; then
-    python -m pyflakes spark_rapids_tpu bench.py __graft_entry__.py || exit 1
+    python -m pyflakes spark_rapids_tpu tests bench.py __graft_entry__.py \
+        || exit 1
 fi
 
 echo "== generated docs up to date =="
@@ -33,7 +34,26 @@ jax.jit(fn)(*args)
 g.dryrun_multichip(8)
 EOF
 
-echo "== smoke bench =="
-python bench.py --smoke
+echo "== smoke bench (tracing enabled) =="
+python bench.py --smoke --profile-out=/tmp/bench_profile.json
+
+echo "== emitted profile/trace JSON validates =="
+python - <<'EOF'
+import json
+prof = json.load(open("/tmp/bench_profile.json"))
+for k in ("query_id", "status", "plan", "metrics", "wall_breakdown",
+          "spans", "phases"):
+    assert k in prof, f"profile missing top-level key {k!r}"
+assert prof["status"] == "success", prof.get("error")
+assert prof["spans"], "no spans recorded despite obs.trace.enabled=true"
+for sec in ("scan", "shuffle", "semaphore", "spill"):
+    assert sec in prof["metrics"], f"profile missing {sec} section"
+trace = json.load(open("/tmp/bench_profile.json.trace.json"))
+evs = trace["traceEvents"]
+assert evs, "empty chrome trace"
+b = sum(1 for e in evs if e["ph"] == "B")
+e = sum(1 for e in evs if e["ph"] == "E")
+assert b == e and b > 0, f"unmatched B/E events: {b} vs {e}"
+EOF
 
 echo "CI GREEN"

@@ -339,6 +339,15 @@ class DataFrame:
     def explain_string(self, mode: str = "physical") -> str:
         if mode == "logical":
             return self.plan.tree_string()
+        if mode == "profile":
+            # metrics-annotated plan of the last executed action
+            # (obs/profile.py; run collect() first)
+            prof = self.session.last_query_profile()
+            if prof is None:
+                return ("no query profile recorded — run an action "
+                        "(collect) first, with "
+                        "spark.rapids.tpu.obs.profile.enabled=true")
+            return prof.tree_string()
         result = self.session._plan_physical(self.plan)
         if mode == "tpu":
             return result.explain_string(all_=True)

@@ -8,6 +8,8 @@ the TPU overrides.
 
 from __future__ import annotations
 
+import contextlib
+import itertools
 import threading
 from typing import Any, Dict, List, Optional, Sequence, Union
 
@@ -50,10 +52,17 @@ class TpuSparkSession:
         pyworker_pool.configure(self.conf)
         from spark_rapids_tpu.shuffle import faults
         faults.install_plan_from_conf(self.conf, fresh=True)
+        from spark_rapids_tpu.obs import trace as obs_trace
+        obs_trace.configure(
+            bool(self.conf.get(cfg.OBS_TRACE_ENABLED)),
+            int(self.conf.get(cfg.OBS_TRACE_BUFFER_SPANS)))
         with TpuSparkSession._lock:
             TpuSparkSession._active = self
         self._plan_listeners: List = []
+        self._query_listeners: List = []
         self._views: Dict[str, lp.LogicalPlan] = {}
+        self._last_profile = None
+        self._query_ids = itertools.count(1)
 
     # -- builder-compatible construction -----------------------------------
     class Builder:
@@ -165,12 +174,62 @@ class TpuSparkSession:
         return [x for p in parts for x in p]
 
     def _execute(self, plan: lp.LogicalPlan) -> pa.Table:
+        """Execute an action with the observability envelope: a
+        QueryRun captures wall phases, the per-query registry delta and
+        span window; the assembled QueryProfile lands on
+        ``last_query_profile()`` and fans out to the registered query
+        listeners (on success AND on failure)."""
+        run = None
+        if self.conf.get(cfg.OBS_PROFILE_ENABLED):
+            from spark_rapids_tpu.obs.profile import QueryRun
+            run = QueryRun(next(self._query_ids))
+        try:
+            result, table = self._execute_inner(plan, run)
+        except BaseException as e:
+            if run is not None:
+                # run.planned was stashed right after planning, so a
+                # failure profile still carries the plan tree and the
+                # explain report whenever planning itself succeeded
+                self._finish_query(run, run.planned, None, e)
+            raise
+        if run is not None:
+            self._finish_query(run, result, table, None)
+        elif self.conf.get(cfg.OBS_TRACE_ENABLED):
+            # tracing without profiling: the chromePath contract still
+            # holds (the whole ring stands in for the query window)
+            from spark_rapids_tpu.obs import trace as obs_trace
+            chrome = str(self.conf.get(cfg.OBS_TRACE_CHROME_PATH) or "")
+            if chrome and obs_trace.is_enabled():
+                with contextlib.suppress(OSError):
+                    obs_trace.dump_chrome_trace(chrome)
+        return table
+
+    def _finish_query(self, run, result, table,
+                      error: Optional[BaseException]) -> None:
+        from spark_rapids_tpu.obs import listener as obs_listener
+        from spark_rapids_tpu.obs import trace as obs_trace
+        prof = run.finish(result=result, table=table, error=error)
+        self._last_profile = prof
+        obs_listener.notify(self._query_listeners, prof, error)
+        chrome = str(self.conf.get(cfg.OBS_TRACE_CHROME_PATH) or "")
+        if chrome and obs_trace.is_enabled():
+            with contextlib.suppress(OSError):
+                prof.dump_chrome_trace(chrome)
+
+    def _phase(self, run, name: str):
+        return run.phase(name) if run is not None \
+            else contextlib.nullcontext()
+
+    def _execute_inner(self, plan: lp.LogicalPlan, run):
         # executor-longevity guard (see kernel_cache docstring)
         from spark_rapids_tpu.exec import kernel_cache
         kernel_cache.maybe_clear_for_map_pressure()
         from spark_rapids_tpu.exec.context import set_input_file
         set_input_file("")  # fresh query: no stale input_file_name()
-        result = self._plan_physical(plan)
+        with self._phase(run, "plan"):
+            result = self._plan_physical(plan)
+        if run is not None:
+            run.planned = result
         p = result.plan
         from spark_rapids_tpu.exec.tpu_basic import DeviceToHostExec
         if isinstance(p, DeviceToHostExec):
@@ -179,11 +238,22 @@ class TpuSparkSession:
             # (a mid-stream read-back would serialize it — and on
             # remote-device runtimes permanently degrade dispatch)
             from spark_rapids_tpu.columnar.batch import to_arrow_all
-            batches = self._drain_partitions(p.children[0].execute())
-            tables = to_arrow_all(batches)
-            return concat_tables(tables, p.schema)
-        tables = self._drain_partitions(p.execute())
-        return concat_tables(tables, result.plan.schema)
+            with self._phase(run, "execute"):
+                batches = self._drain_partitions(p.children[0].execute())
+            with self._phase(run, "collect"):
+                tables = to_arrow_all(batches)
+                table = concat_tables(tables, p.schema)
+            # the terminal download exec never ran execute(); stamp it
+            # with the collected result so the profile's root rows are
+            # the rows the user got
+            p.metrics.add_rows(table.num_rows)
+            p.metrics.add_batches(len(tables))
+            return result, table
+        with self._phase(run, "execute"):
+            tables = self._drain_partitions(p.execute())
+        with self._phase(run, "collect"):
+            table = concat_tables(tables, result.plan.schema)
+        return result, table
 
     def _execute_device(self, plan: lp.LogicalPlan):
         """ColumnarRdd-style handoff: device batches, no host round-trip."""
@@ -204,6 +274,22 @@ class TpuSparkSession:
 
     def remove_plan_listener(self, fn) -> None:
         self._plan_listeners.remove(fn)
+
+    # -- observability surface ---------------------------------------------
+    def last_query_profile(self):
+        """The QueryProfile of the most recent action (None before the
+        first action, or while ``obs.profile.enabled=false`` has kept
+        new profiles from being assembled)."""
+        return self._last_profile
+
+    def register_query_listener(self, listener) -> None:
+        """Register a QueryExecutionListener analog: ``on_success(
+        profile)`` / ``on_failure(profile, exception)`` fire after
+        every action (obs/listener.py)."""
+        self._query_listeners.append(listener)
+
+    def remove_query_listener(self, listener) -> None:
+        self._query_listeners.remove(listener)
 
 
 class DataFrameReader:

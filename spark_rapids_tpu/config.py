@@ -475,6 +475,33 @@ METRICS_ENABLED = conf(
     "Collect per-operator metrics (totalTime, numOutputRows/Batches, "
     "peakDevMemory). (reference: GpuExec.scala:27-56)", bool)
 
+OBS_TRACE_ENABLED = conf(
+    "spark.rapids.tpu.obs.trace.enabled", False,
+    "Record execution spans (scan prep/upload/dispatch, exchange "
+    "phases, semaphore waits, pyworker batches) into the bounded "
+    "in-process ring buffer. Disabled, the instrumented paths take a "
+    "single-bool-check no-op. Spans surface through the per-query "
+    "profile and the Chrome trace exporter "
+    "(obs/trace.py; open in Perfetto or chrome://tracing).", bool)
+
+OBS_TRACE_BUFFER_SPANS = conf(
+    "spark.rapids.tpu.obs.trace.bufferSpans", 65536,
+    "Capacity of the span ring buffer; when a query outruns it the "
+    "oldest spans drop (bounded memory, never the process).", int)
+
+OBS_TRACE_CHROME_PATH = conf(
+    "spark.rapids.tpu.obs.trace.chromePath", "",
+    "When set (and tracing is enabled), every query's span window is "
+    "also written to this path as Chrome trace-event JSON, overwriting "
+    "the previous query's file.")
+
+OBS_PROFILE_ENABLED = conf(
+    "spark.rapids.tpu.obs.profile.enabled", True,
+    "Assemble a QueryProfile after every action (annotated plan tree, "
+    "wall breakdown, per-query registry delta, explain report) — "
+    "surfaced via session.last_query_profile(), "
+    "DataFrame.explain('profile'), and query listeners.", bool)
+
 
 class RapidsTpuConf:
     """Accessor over a settings map; analog of ``new RapidsConf(conf)``."""

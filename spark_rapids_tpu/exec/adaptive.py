@@ -314,7 +314,7 @@ class TpuAdaptiveJoinReaderExec(TpuExec):
                 group = [h.get() for p in range(spec.start, spec.end)
                          for h in batches[p]]
                 if group:
-                    with timed(self.metrics):
+                    with timed(self.metrics, "adaptive.coalesce"):
                         out = group[0] if len(group) == 1 \
                             else concat_batches(group)
                     self.metrics.add_rows(out.num_rows)
@@ -328,7 +328,7 @@ class TpuAdaptiveJoinReaderExec(TpuExec):
                 count = spec.row_end - spec.row_start
                 if hs and count > 0:
                     first = hs[0].get()
-                    with timed(self.metrics):
+                    with timed(self.metrics, "adaptive.split"):
                         # a replica spec spanning the whole partition
                         # (the non-split side) reuses the batch as-is
                         if spec.row_start == 0 and \

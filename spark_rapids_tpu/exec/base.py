@@ -19,6 +19,7 @@ import time
 from dataclasses import dataclass, field
 from typing import Any, Dict, Iterator, List, Optional, Tuple
 
+from spark_rapids_tpu.obs import trace as _trace
 from spark_rapids_tpu.plan.logical import Schema
 
 
@@ -45,6 +46,12 @@ REQUIRE_SINGLE_BATCH = RequireSingleBatch()
 
 # ---------------------------------------------------------------------------
 # Metrics (reference: GpuMetricNames, GpuExec.scala:27-56)
+#
+# Unit contract: every time-valued metric is NANOSECONDS internally —
+# ``total_time_ns`` and every ``extra`` key written by ``timed_extra``
+# (keys end in "Time"/"Ns" by convention).  Seconds exist only at
+# report time, via the explicit ``total_time_s`` / ``extra_s``
+# conversions (and the QueryProfile's ``*_s`` rendering).
 # ---------------------------------------------------------------------------
 
 @dataclass
@@ -80,6 +87,29 @@ class Metrics:
     def add_extra(self, key: str, n: float) -> None:
         with self._rows_lock:
             self.extra[key] = self.extra.get(key, 0) + n
+
+    def add_time_ns(self, ns: int) -> None:
+        """Locked total_time_ns accumulation (partition iterators run
+        concurrently under the task pool)."""
+        with self._rows_lock:
+            self.total_time_ns += ns
+
+    def max_peak(self, v: int) -> None:
+        """Locked high-water update of peak_dev_memory (concurrent
+        executor-reply merges race an unlocked read-modify-write)."""
+        with self._rows_lock:
+            if v > self.peak_dev_memory:
+                self.peak_dev_memory = v
+
+    @property
+    def total_time_s(self) -> float:
+        """Report-time seconds conversion (ns internally)."""
+        return self.total_time_ns / 1e9
+
+    def extra_s(self, key: str) -> float:
+        """Report-time seconds view of a time-valued ``extra`` entry
+        (``timed_extra`` accumulates nanoseconds)."""
+        return self.extra.get(key, 0) / 1e9
 
     @property
     def num_output_rows(self) -> int:
@@ -176,28 +206,115 @@ class TpuExec(PhysicalPlan):
         return True
 
 
-def timed(metrics: Metrics):
-    class _T:
-        def __enter__(self):
-            self.t0 = time.perf_counter_ns()
-            return self
+class _Timed:
+    """Accumulates elapsed ns into ``metrics.total_time_ns`` and, when
+    tracing is enabled and a span name was given, records the interval
+    as a span (obs/trace.py; the disabled path costs one bool check)."""
 
-        def __exit__(self, *a):
-            metrics.total_time_ns += time.perf_counter_ns() - self.t0
-    return _T()
+    __slots__ = ("metrics", "name", "t0")
+
+    def __init__(self, metrics: Metrics, name: Optional[str]):
+        self.metrics = metrics
+        self.name = name
+
+    def __enter__(self):
+        self.t0 = time.perf_counter_ns()
+        return self
+
+    def __exit__(self, *a):
+        dur = time.perf_counter_ns() - self.t0
+        self.metrics.add_time_ns(dur)
+        if self.name is not None:
+            _trace.record(self.name, self.t0, dur)
+
+
+def timed(metrics: Metrics, name: Optional[str] = None):
+    return _Timed(metrics, name)
+
+
+class _TimedExtra:
+    __slots__ = ("metrics", "key", "t0")
+
+    def __init__(self, metrics: Metrics, key: str):
+        self.metrics = metrics
+        self.key = key
+
+    def __enter__(self):
+        self.t0 = time.perf_counter_ns()
+        return self
+
+    def __exit__(self, *a):
+        dur = time.perf_counter_ns() - self.t0
+        self.metrics.add_extra(self.key, dur)
+        _trace.record(self.key, self.t0, dur)
 
 
 def timed_extra(metrics: Metrics, key: str):
-    """Time a sub-phase into ``Metrics.extra[key]`` (seconds) WITHOUT
-    touching total_time_ns — for phases that overlap the operator's
-    main timing (scan host prep / upload running on a prefetch thread
-    while the consumer's ``timed`` covers the dispatch)."""
-    class _T:
-        def __enter__(self):
-            self.t0 = time.perf_counter_ns()
-            return self
+    """Time a sub-phase into ``Metrics.extra[key]`` (NANOSECONDS; read
+    back in seconds via ``Metrics.extra_s``) WITHOUT touching
+    total_time_ns — for phases that overlap the operator's main timing
+    (scan host prep / upload running on a prefetch thread while the
+    consumer's ``timed`` covers the dispatch).  Also recorded as a span
+    named ``key`` when tracing is enabled."""
+    return _TimedExtra(metrics, key)
 
-        def __exit__(self, *a):
-            metrics.add_extra(
-                key, (time.perf_counter_ns() - self.t0) / 1e9)
-    return _T()
+
+# ---------------------------------------------------------------------------
+# Executor-side metrics round trip (shuffle/executor_proc.py ships plan
+# fragments whose Metrics would otherwise never return to the driver)
+# ---------------------------------------------------------------------------
+
+def collect_plan_metrics(plan: PhysicalPlan) -> List[dict]:
+    """Flatten a plan tree's Metrics in pre-order (``foreach`` order).
+    The pre-order index IS the plan node id: the driver's tree and the
+    executor's unpickled copy share the structure, so index + class
+    name key the merge."""
+    out: List[dict] = []
+
+    def one(n: PhysicalPlan) -> None:
+        m = n.metrics
+        out.append({
+            "name": type(n).__name__,
+            "rows": int(m.num_output_rows),
+            "batches": int(m.num_output_batches),
+            "time_ns": int(m.total_time_ns),
+            "peak_dev_memory": int(m.peak_dev_memory),
+            "extra": {k: v for k, v in m.extra.items()
+                      if isinstance(v, (int, float))},
+        })
+    plan.foreach(one)
+    return out
+
+
+def merge_plan_metrics(plan: PhysicalPlan,
+                       recorded: Optional[List[dict]],
+                       skip_root: bool = False) -> None:
+    """Merge executor-side metrics back into the driver-side tree
+    (keyed by pre-order node id + class name; a shape mismatch drops
+    the payload rather than corrupting driver metrics).  Additive, so
+    every executor's share of a map stage accumulates.
+
+    ``skip_root``: leave the root node untouched — the process-shuffle
+    driver already times the whole map stage on its own exchange node,
+    so merging the executor copy's exchange-node time on top would
+    double-count the same work."""
+    if not recorded:
+        return
+    nodes: List[PhysicalPlan] = []
+    plan.foreach(nodes.append)
+    if len(nodes) != len(recorded):
+        return
+    for i, (n, r) in enumerate(zip(nodes, recorded)):
+        if (skip_root and i == 0) or r.get("name") != type(n).__name__:
+            continue
+        m = n.metrics
+        if r.get("rows"):
+            m.add_rows(int(r["rows"]))
+        if r.get("batches"):
+            m.add_batches(int(r["batches"]))
+        if r.get("time_ns"):
+            m.add_time_ns(int(r["time_ns"]))
+        if r.get("peak_dev_memory"):
+            m.max_peak(int(r["peak_dev_memory"]))
+        for k, v in (r.get("extra") or {}).items():
+            m.add_extra(k, v)

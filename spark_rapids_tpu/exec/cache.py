@@ -156,8 +156,8 @@ class TpuInMemoryTableScanExec(TpuExec):
             if not sc.enabled():
                 skey = None
             for rg in range(pf.metadata.num_row_groups):
-                with tpu_semaphore():
-                    with timed(self.metrics):
+                with tpu_semaphore(self.metrics):
+                    with timed(self.metrics, "cache.decode"):
                         batch, fallbacks = devpq.decode_row_group(
                             blob, rg, schema, parquet_file=pf,
                             source_key=skey, metrics=self.metrics)

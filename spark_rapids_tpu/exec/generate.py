@@ -162,7 +162,7 @@ class TpuGenerateExec(TpuExec):
                 if ckey not in self._kernels:
                     self._kernels[ckey] = kc.get_kernel(
                         ckey, lambda: count_fn)
-                with timed(self.metrics):
+                with timed(self.metrics, "generate.count"):
                     total = int(self._kernels[ckey](b))
                 out_cap = bucket_rows(total)
                 ekey = ("gen_emit", gsig, out_cap, with_pos, outer,
@@ -172,7 +172,7 @@ class TpuGenerateExec(TpuExec):
                         ekey, lambda: lambda bb: _generate_kernel(
                             bb, gen, out_cap, self._schema, with_pos,
                             outer))
-                with timed(self.metrics):
+                with timed(self.metrics, "generate.emit"):
                     out = self._kernels[ekey](b)
                 self.metrics.add_rows(out.num_rows)
                 self.metrics.add_batches()

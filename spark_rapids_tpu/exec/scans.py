@@ -28,10 +28,14 @@ TPU formulation of the same segmented-reduction building block.
 from __future__ import annotations
 
 import threading
+import time
 from typing import Callable, List, Optional, Sequence
 
 import jax
 import jax.numpy as jnp
+
+from spark_rapids_tpu.obs import registry as obsreg
+from spark_rapids_tpu.obs import trace as obstrace
 
 _BLOCK = 1 << 15          # per-step scan length
 
@@ -91,12 +95,25 @@ class ScanPrefetcher:
             with self._lock:
                 self._fill_locked()
 
+    def _run_thunk(self, i: int):
+        """Thunk wrapper: the prefetch work itself shows up in the
+        trace (prep+upload of batch i on the prefetch thread) and in
+        the registry's prefetch histogram."""
+        t0 = time.perf_counter_ns()
+        try:
+            return self._thunks[i]()
+        finally:
+            dur = time.perf_counter_ns() - t0
+            obstrace.record("scan.prefetch", t0, dur, cat="scan",
+                            args={"batch": i})
+            obsreg.get_registry().observe("scan.prefetchNs", dur)
+
     def _fill_locked(self) -> None:
         while (self._next < len(self._thunks) and
                len(self._futures) < self._depth):
             i = self._next
             self._next += 1
-            self._futures[i] = self._pool.submit(self._thunks[i])
+            self._futures[i] = self._pool.submit(self._run_thunk, i)
 
     def part_done(self) -> None:
         """Consumer-side completion mark, called once per index from
@@ -116,13 +133,25 @@ class ScanPrefetcher:
             while self._next <= i:
                 j = self._next
                 self._next += 1
-                self._futures[j] = self._pool.submit(self._thunks[j])
+                self._futures[j] = self._pool.submit(self._run_thunk, j)
             fut = self._futures.pop(i)
-        if not fut.done() and self._metrics is not None:
-            self._metrics.add_extra(self._stall_key, 1)
+        stalled = not fut.done()
+        t0 = 0
+        if stalled:
+            # the consumer outran the prepared window: a stall, timed
+            # so the profile shows where the pipeline starved
+            if self._metrics is not None:
+                self._metrics.add_extra(self._stall_key, 1)
+            obsreg.get_registry().inc("scan.prefetchStalls")
+            t0 = time.perf_counter_ns()
         try:
             return fut.result()
         finally:
+            if stalled:
+                dur = time.perf_counter_ns() - t0
+                obstrace.record("scan.prefetchStall", t0, dur,
+                                cat="scan", args={"batch": i})
+                obsreg.get_registry().inc("scan.prefetchStallNs", dur)
             with self._lock:
                 self._consumed += 1
                 self._fill_locked()

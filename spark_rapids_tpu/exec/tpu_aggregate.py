@@ -856,7 +856,7 @@ class TpuHashAggregateExec(TpuExec):
                         if isinstance(nr, (int, np.integer)) \
                                 and nr == 0 and self.groupings:
                             continue
-                        with timed(self.metrics):
+                        with timed(self.metrics, "agg.update"):
                             partial = self._update_kernel(b)
                         partials.append(register_or_hold(partial))
                 if not partials:
@@ -870,7 +870,7 @@ class TpuHashAggregateExec(TpuExec):
                     merged = partials[0].get()
                 else:
                     whole = concat_batches([p.get() for p in partials])
-                    with timed(self.metrics):
+                    with timed(self.metrics, "agg.merge"):
                         merged = self._merge_kernel(whole)
                 out = self._final_kernel(merged)
                 self.metrics.add_rows(out.num_rows)

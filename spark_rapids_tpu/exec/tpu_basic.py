@@ -87,8 +87,8 @@ class HostToDeviceExec(TpuExec):
         def run(it):
             for t in it:
                 for piece in self._split_for_strings(t):
-                    with tpu_semaphore():
-                        with timed(self.metrics):
+                    with tpu_semaphore(self.metrics):
+                        with timed(self.metrics, "transition.upload"):
                             b = from_arrow(piece, self.min_bucket)
                         self.metrics.num_output_rows += piece.num_rows
                         self.metrics.add_batches()
@@ -159,7 +159,7 @@ class TpuProjectExec(TpuExec):
         def run(pid, it):
             offset = 0
             for b in it:
-                with timed(self.metrics):
+                with timed(self.metrics, "project.eval"):
                     out = self._kernel(b, jnp.int32(pid),
                                        jnp.int64(offset))
                 if needs_ctx:
@@ -217,7 +217,7 @@ class TpuFilterExec(TpuExec):
 
         def run(it):
             for b in it:
-                with timed(self.metrics):
+                with timed(self.metrics, "filter.eval"):
                     out = self._kernel(b)
                 yield out
         return [run(it) for it in self.children[0].execute()]

@@ -606,7 +606,7 @@ class _HashJoinBase(TpuExec):
             self._kernels[ckey] = kc.get_kernel(
                 ckey, lambda: lambda b, s: _probe_count_kernel(
                     b, s, bkeys, skeys, emit_how, bits))
-        with timed(self.metrics):
+        with timed(self.metrics, "join.probeCount"):
             total, maxm = self._kernels[ckey](build, stream)
             total, maxm = int(total), int(maxm)
         if total >= (1 << 31):
@@ -628,7 +628,7 @@ class _HashJoinBase(TpuExec):
                     ekey, lambda: lambda b, s: _probe_emit_unique_kernel(
                         b, s, bkeys, skeys, emit_variant, out_cap,
                         build.names, stream.names, build_first, bits))
-            with timed(self.metrics):
+            with timed(self.metrics, "join.probeEmit"):
                 out = self._kernels[ekey](build, stream)
         else:
             out_cap = bucket_rows(total)
@@ -648,7 +648,7 @@ class _HashJoinBase(TpuExec):
                     ekey, lambda: lambda b, s, o: _probe_emit_dup_kernel(
                         b, s, o, bkeys, skeys, emit_how, out_cap,
                         build.names, stream.names, build_first, bits))
-            with timed(self.metrics):
+            with timed(self.metrics, "join.probeEmit"):
                 border = sortkeys.shared_lexsort(
                     self._kernels[pkey](build, stream))
                 out = self._kernels[ekey](build, stream, border)
@@ -683,7 +683,7 @@ class _HashJoinBase(TpuExec):
                     self._kernels[key] = kc.get_kernel(
                         key, lambda: lambda b, s: _probe_semi_kernel(
                             b, s, rkeys, lkeys, how == "anti", bits))
-                with timed(self.metrics):
+                with timed(self.metrics, "join.semi"):
                     out = self._kernels[key](right, left)
             else:
                 key = ("semi", how, tuple(lkeys), tuple(rkeys),
@@ -692,7 +692,7 @@ class _HashJoinBase(TpuExec):
                     self._kernels[key] = kc.get_kernel(
                         key, lambda: lambda b, s, o, g: _semi_kernel(
                             b, s, o, g, rkeys, lkeys, how == "anti"))
-                with timed(self.metrics):
+                with timed(self.metrics, "join.semi"):
                     order, seg0 = self._sort_order(right, left, rkeys,
                                                    lkeys)
                     out = self._kernels[key](right, left, order, seg0)
@@ -727,7 +727,7 @@ class _HashJoinBase(TpuExec):
             self._kernels[ckey] = kc.get_kernel(
                 ckey, lambda: lambda b, s, o, g: _count_kernel(
                     b, s, o, g, bkeys, skeys, emit_how))
-        with timed(self.metrics):
+        with timed(self.metrics, "join.count"):
             order, seg0 = self._sort_order(build, stream, bkeys, skeys)
             total = int(self._kernels[ckey](build, stream, order,
                                             seg0))
@@ -747,7 +747,7 @@ class _HashJoinBase(TpuExec):
                 ekey, lambda: lambda b, s, o, g: _emit_kernel(
                     b, s, o, g, bkeys, skeys, emit_how, out_cap,
                     build.names, stream.names, build_first))
-        with timed(self.metrics):
+        with timed(self.metrics, "join.emit"):
             out = self._kernels[ekey](build, stream, order, seg0)
         out = DeviceBatch(self._schema.names, out.columns, out.num_rows)
         if self.condition is not None:
@@ -954,7 +954,7 @@ class _NestedLoopBase(TpuExec):
                                   v.validity)
                 return out
             self._kernels[key] = kc.get_kernel(key, lambda: impl)
-        with timed(self.metrics):
+        with timed(self.metrics, "join.nestedLoop"):
             out = self._kernels[key](left, right)
         self.metrics.add_rows(out.num_rows)
         self.metrics.add_batches()

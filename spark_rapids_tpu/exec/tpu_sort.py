@@ -103,7 +103,7 @@ class TpuSortExec(TpuExec):
             finally:
                 for h in handles:
                     h.close()
-            with timed(self.metrics):
+            with timed(self.metrics, "sort.exec"):
                 digits = keys_kernel(whole)
                 order = sortkeys.shared_digit_sort(digits)
                 apply_kernel = kc.get_kernel(

@@ -579,7 +579,7 @@ class TpuWindowExec(TpuExec):
             if not batches:
                 return
             whole = concat_batches(batches)
-            with timed(self.metrics):
+            with timed(self.metrics, "window.eval"):
                 orders = tuple(
                     sortkeys.shared_digit_sort(k(whole))
                     for k in keys_kernels)

@@ -74,7 +74,6 @@ class TpuParquetScanExec(TpuExec):
         # GpuParquetScan.scala canUseCoalesceFilesReader)
         self.allow_fused = True
         self.metrics.extra["fallbackColumns"] = 0
-        self.metrics.extra["decodeTime"] = 0.0
 
     @property
     def schema(self) -> Schema:
@@ -103,8 +102,8 @@ class TpuParquetScanExec(TpuExec):
         file_schema = Schema([self._schema.field(c) for c in file_cols])
         fctx = self._open(path)  # one open/footer parse per file
         for rg in range(self._num_chunks(fctx)):
-            with tpu_semaphore():
-                with timed(self.metrics):
+            with tpu_semaphore(self.metrics):
+                with timed(self.metrics, "scan.decode"):
                     batch, fallbacks = self._decode_chunk(
                         fctx, rg, file_schema, file_cols)
                 self.metrics.add_extra("fallbackColumns",
@@ -226,7 +225,7 @@ class TpuParquetScanExec(TpuExec):
             semaphore)."""
             prep, handles = prepared
             try:
-                with timed(self.metrics):
+                with timed(self.metrics, "scan.dispatch"):
                     batch, fallbacks = pqf.finish_fused(prep)
                 self.metrics.add_extra("fallbackColumns",
                                        len(fallbacks))
@@ -265,13 +264,13 @@ class TpuParquetScanExec(TpuExec):
             try:
                 if prefetcher is not None:
                     prepared = prefetcher.get(idx)
-                    with tpu_semaphore():
+                    with tpu_semaphore(self.metrics):
                         out = finish(prepared, pv)
                 else:
                     # no pipelining: the whole prep+upload+dispatch runs
                     # under the semaphore, preserving the pre-prefetch
                     # concurrent-device-work bound
-                    with tpu_semaphore():
+                    with tpu_semaphore(self.metrics):
                         prepared = prepare(path_rgs)
                         out = finish(prepared, pv)
                 paths = {p for p, _ in path_rgs}
@@ -347,8 +346,8 @@ class TpuCsvScanExec(TpuExec):
         wanted = [f.name for f in self._schema.fields]
         opts = self.scan.options
         try:
-            with tpu_semaphore():
-                with timed(self.metrics):
+            with tpu_semaphore(self.metrics):
+                with timed(self.metrics, "scan.csvDecode"):
                     try:
                         batch, fallbacks = dcsv.decode_csv(
                             path, self.scan.schema, columns=wanted,

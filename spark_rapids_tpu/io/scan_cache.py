@@ -45,6 +45,8 @@ from typing import Any, Dict, Optional, Tuple
 
 import pyarrow.parquet as papq
 
+from spark_rapids_tpu.obs import registry as _obsreg
+
 _LOCK = threading.RLock()
 _ENABLED = True
 _MAX_BYTES = 256 << 20
@@ -381,6 +383,10 @@ def _bump_hits(metrics) -> None:
     with _LOCK:
         _HITS += 1
     _count(metrics, "scan.planCacheHits")
+    # mirrored into the unified metrics registry: the scan-cache
+    # counters were one of the three disjoint stat channels the obs
+    # layer folds together (obs/registry.py)
+    _obsreg.get_registry().inc("scan.planCacheHits")
 
 
 def _bump_misses(metrics) -> None:
@@ -388,6 +394,7 @@ def _bump_misses(metrics) -> None:
     with _LOCK:
         _MISSES += 1
     _count(metrics, "scan.planCacheMisses")
+    _obsreg.get_registry().inc("scan.planCacheMisses")
 
 
 def open_source(path: str, metrics=None):

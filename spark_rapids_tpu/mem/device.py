@@ -15,7 +15,11 @@ from __future__ import annotations
 
 import contextlib
 import threading
+import time
 from typing import Optional
+
+from spark_rapids_tpu.obs import registry as obsreg
+from spark_rapids_tpu.obs import trace as obstrace
 
 _LOCK = threading.Lock()
 _SEM: Optional[threading.Semaphore] = None
@@ -38,9 +42,34 @@ def _get() -> threading.Semaphore:
 
 
 @contextlib.contextmanager
-def tpu_semaphore():
+def tpu_semaphore(metrics=None):
+    """Acquire one device-concurrency slot, measuring acquisition count
+    and acquire-blocked nanoseconds so concurrency-limit starvation is
+    visible per query: process-wide into the metrics registry
+    (``semaphore.acquires`` / ``semaphore.waitNs``), per-exec into
+    ``metrics.extra`` when the caller passes its Metrics, and as a
+    ``semaphore.wait`` span when tracing is on.  Per-acquisition
+    bookkeeping cost: a non-blocking acquire, a clock read, and ONE
+    registry-lock dict update (plus the caller's Metrics lock when
+    passed) — sub-microsecond against the multi-ms device dispatches
+    the semaphore gates."""
     sem = _get()
-    sem.acquire()
+    wait_ns = 0
+    if not sem.acquire(blocking=False):
+        t0 = time.perf_counter_ns()
+        sem.acquire()
+        wait_ns = time.perf_counter_ns() - t0
+        obstrace.record("semaphore.wait", t0, wait_ns, cat="semaphore")
+    reg = obsreg.get_registry()
+    if wait_ns:
+        reg.inc_many(("semaphore.acquires", 1),
+                     ("semaphore.waitNs", wait_ns))
+    else:
+        reg.inc("semaphore.acquires")
+    if metrics is not None:
+        metrics.add_extra("semaphore.acquires", 1)
+        if wait_ns:
+            metrics.add_extra("semaphore.waitNs", wait_ns)
     try:
         yield
     finally:

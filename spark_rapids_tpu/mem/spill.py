@@ -36,6 +36,7 @@ import jax.numpy as jnp
 from spark_rapids_tpu import dtypes as dt
 from spark_rapids_tpu.columnar.batch import DeviceBatch, DeviceColumn
 from spark_rapids_tpu.mem.host_arena import HostArena
+from spark_rapids_tpu.obs import registry as obsreg
 
 
 class StorageTier(enum.IntEnum):
@@ -134,6 +135,8 @@ class BufferCatalog:
             buf = _Buffer(bid, batch, priority)
             self._buffers[bid] = buf
             self.device_bytes += buf.size
+        obsreg.get_registry().gauge_max("spill.deviceBytesHwm",
+                                        self.device_bytes)
         self._maybe_spill()
         return SpillableBatch(self, bid)
 
@@ -175,6 +178,10 @@ class BufferCatalog:
             self.device_bytes -= size
             self.host_bytes += payload.nbytes()
             self.spilled_device_bytes += size
+        reg = obsreg.get_registry()
+        reg.inc("spill.events")
+        reg.inc("spill.deviceToHostBytes", size)
+        reg.gauge_max("spill.hostBytesHwm", self.host_bytes)
         self._maybe_spill_host()
         return size
 
@@ -208,6 +215,9 @@ class BufferCatalog:
         with self._lock:
             self.host_bytes -= nbytes
             self.spilled_disk_bytes += nbytes
+        reg = obsreg.get_registry()
+        reg.inc("spill.events")
+        reg.inc("spill.hostToDiskBytes", nbytes)
 
     # -- access ------------------------------------------------------------
     def acquire(self, buffer_id: int) -> DeviceBatch:
@@ -219,6 +229,7 @@ class BufferCatalog:
                 return buf.device_batch
             if buf.tier == StorageTier.DISK:
                 self._disk_to_host_locked(buf)
+            obsreg.get_registry().inc("spill.unspills")
             batch = _host_to_device(buf.host, buf.meta)
             # promote back to device tier
             nbytes = buf.host.nbytes()

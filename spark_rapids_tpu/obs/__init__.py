@@ -1,0 +1,27 @@
+"""Observability subsystem: span tracing, unified metrics registry,
+query profiles, and query-execution listeners.
+
+The reference plugin's debuggability story is per-operator GpuMetrics in
+the Spark UI plus plan-time ``explain`` fallback reasons
+(GpuExec.scala:27-56, GpuOverrides).  This package is the whole-query
+view the rebuild needs on top of that: where wall time went, which
+partition stalled, what the prefetcher / device semaphore / spill
+catalog were doing — the Theseus lesson (PAPERS.md) that accelerated
+query engines bottleneck on *data movement between stages*, which
+per-operator counters alone cannot show.
+
+Layers (leaf modules only — nothing here imports the engine, so every
+engine layer may import ``obs`` freely):
+
+  * :mod:`spark_rapids_tpu.obs.trace` — low-overhead span tracer with a
+    Chrome trace-event exporter (open in Perfetto / chrome://tracing).
+  * :mod:`spark_rapids_tpu.obs.registry` — process-wide metrics
+    registry (counters / gauges / time histograms) that per-query views
+    are carved out of.
+  * :mod:`spark_rapids_tpu.obs.profile` — the per-query
+    :class:`QueryProfile` assembled after each collect.
+  * :mod:`spark_rapids_tpu.obs.listener` — QueryExecutionListener
+    analog registered on the session.
+"""
+
+from spark_rapids_tpu.obs import registry, trace  # noqa: F401

@@ -27,12 +27,15 @@ import struct
 import subprocess
 import sys
 import threading
+import time
 from typing import List, Optional, Tuple
 
 import cloudpickle
 import pyarrow as pa
 
 from spark_rapids_tpu import config as cfg
+from spark_rapids_tpu.obs import registry as obsreg
+from spark_rapids_tpu.obs import trace as obstrace
 from spark_rapids_tpu.pyworker import worker as wp
 
 
@@ -279,7 +282,16 @@ class ResilientWorker:
                         ev.action == faults.FaultAction.KILL:
                     self.worker.proc.kill()
                     self.worker.proc.wait()
-                return self.worker.run(payload)
+                t0 = time.perf_counter_ns()
+                out = self.worker.run(payload)
+                dur = time.perf_counter_ns() - t0
+                reg = obsreg.get_registry()
+                reg.inc("pyworker.batches")
+                reg.inc("pyworker.bytesIn", len(payload))
+                reg.observe("pyworker.batchNs", dur)
+                obstrace.record("pyworker.batch", t0, dur,
+                                cat="pyworker")
+                return out
             except PythonWorkerCrash as e:
                 last = e
                 self.worker.close()

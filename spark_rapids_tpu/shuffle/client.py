@@ -15,6 +15,7 @@ import itertools
 import threading
 from typing import Callable, List, Optional, Set
 
+from spark_rapids_tpu.obs import registry as obsreg
 from spark_rapids_tpu.shuffle import meta as wire
 from spark_rapids_tpu.shuffle.catalogs import ShuffleReceivedBufferCatalog
 from spark_rapids_tpu.shuffle.transport import (BounceBufferManager,
@@ -294,6 +295,9 @@ class RapidsShuffleClient:
                     if tx.status != TransactionStatus.SUCCESS:
                         on_done(f"buffer receive failed: {tx.error_message}")
                         return
+                    obsreg.get_registry().inc_many(
+                        ("shuffle.fetchBytes", len(tx.payload)),
+                        ("shuffle.fetchFrames", 1))
                     for idx in state.consume_window(tx.payload):
                         tm = real[idx]
                         if not handle.record_completed(

@@ -251,6 +251,10 @@ class ShuffleBlockStore:
             return
         data = serialize_table(table, self.codec)
         self.bytes_written += len(data)
+        from spark_rapids_tpu.obs import registry as obsreg
+        obsreg.get_registry().inc_many(
+            ("shuffle.bytesWritten", len(data)),
+            ("shuffle.blocksWritten", 1))
         self._blocks[(map_idx, reduce_idx)] = data
 
     def fetch(self, reduce_idx: int) -> List[pa.Table]:
@@ -510,7 +514,7 @@ class TpuShuffleExchangeExec(TpuExec):
                 return DeviceBatch(b.names, cols, b.num_rows), counts
             self._kernels[akey] = kc.get_kernel(akey,
                                                 lambda: apply_order)
-        with timed(self.metrics):
+        with timed(self.metrics, "exchange.partition"):
             t = self._compute_targets(batch, rows_seen)
             order = sortkeys.shared_partition_order(t)
             reordered, counts = self._kernels[akey](batch, t, order)
@@ -726,6 +730,20 @@ class TpuShuffleExchangeExec(TpuExec):
                 raise RuntimeError(
                     f"map stage on {h.executor_id} failed: "
                     f"{reply.get('error')}\n{reply.get('traceback', '')}")
+            # executor-side Metrics come home with the map results and
+            # merge into THIS driver-side tree by plan node id — without
+            # this, everything timed/counted inside the shipped fragment
+            # is invisible to the query profile.  skip_root: the driver
+            # already times the whole map stage on this exchange node
+            # (exchange.mapStages), so the executor copy's own node time
+            # must not land on top.  Merging is additive across submits:
+            # a map stage RE-RUN after an executor death re-executed the
+            # work, so its metrics count again — the
+            # shuffle.mapStageReruns stamp (recover()) flags profiles
+            # where subtree rows exceed rows delivered for that reason.
+            from spark_rapids_tpu.exec.base import merge_plan_metrics
+            merge_plan_metrics(self, reply.get("metrics"),
+                               skip_root=True)
             return h, reply["maps"]
 
         def materialize():
@@ -734,7 +752,7 @@ class TpuShuffleExchangeExec(TpuExec):
                     return
                 pool = get_executor_pool(n_execs, nested_transport)
                 sid = next(self._process_sids)
-                with timed(self.metrics):
+                with timed(self.metrics, "exchange.mapStages"):
                     # map stages run concurrently across the fleet; each
                     # handle's pipe is independent
                     results: List[Any] = [None] * n_execs
@@ -794,6 +812,7 @@ class TpuShuffleExchangeExec(TpuExec):
                     # dead peer and failing loudly — removing it first
                     # would let them silently return partial results
                     h, mids = submit(pool, exec_idx, state["sid"])
+                    self.metrics.add_extra("shuffle.mapStageReruns", 1)
                     del state["maps"][eid]
                     if mids:
                         state["maps"][h.executor_id] = (exec_idx,
@@ -923,7 +942,7 @@ class TpuShuffleExchangeExec(TpuExec):
             if not tables:
                 return
             t = concat_tables(tables, self.schema)
-            with timed(self.metrics):
+            with timed(self.metrics, "exchange.upload"):
                 b = from_arrow(t, self.min_bucket)
             self.metrics.num_output_rows += t.num_rows
             self.metrics.add_batches()
@@ -963,7 +982,7 @@ class TpuShuffleExchangeExec(TpuExec):
                 batches.extend(b for b in it if int(b.num_rows))
             if batches:
                 g = concat_batches(batches)
-                with timed(self.metrics):
+                with timed(self.metrics, "exchange.ici"):
                     targets = self._compute_targets(g, 0)
                     dev, mesh = ici.exchange_batch(g, targets,
                                                    self.min_bucket)
@@ -998,7 +1017,7 @@ class TpuShuffleExchangeExec(TpuExec):
                     return compact(batch, part == pid)
                 self._kernels[key] = kc.get_kernel(
                     key, lambda: extract)
-            with timed(self.metrics):
+            with timed(self.metrics, "exchange.iciExtract"):
                 out = self._kernels[key](b, jnp.int32(pidx))
             if int(out.num_rows) == 0:
                 return
@@ -1083,7 +1102,7 @@ class TpuShuffleExchangeExec(TpuExec):
                     if not tables:
                         return
                     t = concat_tables(tables, self.schema)
-                    with timed(self.metrics):
+                    with timed(self.metrics, "exchange.upload"):
                         b = from_arrow(t, self.min_bucket)
                     self.metrics.num_output_rows += t.num_rows
                     self.metrics.add_batches()
@@ -1101,7 +1120,7 @@ class TpuShuffleExchangeExec(TpuExec):
                     return
                 # ShuffleCoalesce: concat host-serialized slices, upload once
                 t = concat_tables(tables, self.schema)
-                with timed(self.metrics):
+                with timed(self.metrics, "exchange.upload"):
                     b = from_arrow(t, self.min_bucket)
                 self.metrics.num_output_rows += t.num_rows
                 self.metrics.add_batches()
@@ -1110,7 +1129,7 @@ class TpuShuffleExchangeExec(TpuExec):
                 slices = state["dev_slices"][pidx]
                 if not slices:
                     return
-                with timed(self.metrics):
+                with timed(self.metrics, "exchange.concat"):
                     b = concat_batches(slices)
                 self.metrics.add_rows(b.num_rows)
                 self.metrics.add_batches()

@@ -66,7 +66,13 @@ def _run_map_stage(task: dict, catalog, nested_transport: str) -> dict:
     maps = exch.run_map_stage(
         shuffle_id=task["shuffle_id"], catalog=catalog,
         n_execs=task["n_execs"], exec_idx=task["exec_idx"])
-    return {"ok": True, "maps": maps, "nested_transports": nested}
+    # per-node Metrics accumulated while running this fragment go home
+    # with the reply (keyed by pre-order node id) — the driver merges
+    # them into its own tree so executor-side work is not dropped from
+    # the query profile (exec/base.merge_plan_metrics)
+    from spark_rapids_tpu.exec.base import collect_plan_metrics
+    return {"ok": True, "maps": maps, "nested_transports": nested,
+            "metrics": collect_plan_metrics(exch)}
 
 
 def main() -> None:

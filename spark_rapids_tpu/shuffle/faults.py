@@ -63,6 +63,8 @@ import threading
 from dataclasses import dataclass, field
 from typing import Dict, List, Optional
 
+from spark_rapids_tpu.obs import registry as _obsreg
+
 
 class FaultAction(enum.Enum):
     DROP = "drop"
@@ -108,6 +110,10 @@ class ShuffleFaultStats:
     def incr(self, name: str, n: int = 1) -> None:
         with self._lock:
             self._counts[name] = self._counts.get(name, 0) + n
+        # mirror into the unified metrics registry so the recovery
+        # counters appear in per-query profiles next to the scan/spill/
+        # semaphore channels (obs/registry.py)
+        _obsreg.get_registry().inc(f"shuffle.{name}", n)
 
     def get(self, name: str) -> int:
         with self._lock:

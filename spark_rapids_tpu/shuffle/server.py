@@ -11,6 +11,7 @@ from __future__ import annotations
 import threading
 from typing import Callable, Dict, List, Optional
 
+from spark_rapids_tpu.obs import registry as obsreg
 from spark_rapids_tpu.shuffle import meta as wire
 from spark_rapids_tpu.shuffle.catalogs import ShuffleBufferCatalog
 from spark_rapids_tpu.shuffle.transport import (BounceBufferManager,
@@ -105,8 +106,11 @@ class ShuffleServer:
             # never a silent misalignment of later windows
             wtag = req.receive_tag + state.windows_sent
             data = state.next_window()
-            tx = self.connection.send(peer_executor_id, wtag,
-                                      data, send_next)
+            obsreg.get_registry().inc_many(
+                ("shuffle.serveBytes", len(data)),
+                ("shuffle.serveFrames", 1))
+            self.connection.send(peer_executor_id, wtag,
+                                 data, send_next)
 
         # kick off the stream; subsequent windows chain off completions
         send_next(None)

@@ -18,7 +18,6 @@ from __future__ import annotations
 import math
 from typing import Callable, List, Optional
 
-import numpy as np
 import pyarrow as pa
 
 from spark_rapids_tpu import TpuSparkSession

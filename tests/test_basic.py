@@ -2,13 +2,11 @@
 aggregate/sort/limit (SURVEY.md §7 phases 2-3 milestone tests)."""
 
 import pyarrow as pa
-import pytest
-
 from spark_rapids_tpu import TpuSparkSession, col, lit, functions as F
 from tests.parity import (assert_tpu_and_cpu_are_equal_collect,
-                          assert_tables_equal, with_tpu_session)
+                          assert_tables_equal)
 from tests.data_gen import (gen_df, int_gen, long_gen, double_gen,
-                            int_key_gen, string_gen, boolean_gen)
+                            int_key_gen, boolean_gen)
 
 
 def test_select_arithmetic(session):

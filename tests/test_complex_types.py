@@ -7,7 +7,7 @@ GpuGenerateExec.scala:101 (explode/posexplode), collection ops.
 import pyarrow as pa
 import pytest
 
-from spark_rapids_tpu import TpuSparkSession, col, functions as F
+from spark_rapids_tpu import col, functions as F
 from tests.parity import assert_tpu_and_cpu_are_equal_collect
 
 

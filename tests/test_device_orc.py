@@ -4,7 +4,6 @@ orc_test.py; decode in HBM must match host Arrow decode exactly)."""
 import numpy as np
 import pyarrow as pa
 import pyarrow.orc as paorc
-import pytest
 
 from spark_rapids_tpu.columnar.batch import to_arrow
 from spark_rapids_tpu.io import device_orc as dorc

@@ -4,9 +4,7 @@ integration_tests parquet_test.py — CPU-vs-accelerated equality)."""
 import numpy as np
 import pyarrow as pa
 import pyarrow.parquet as papq
-import pytest
 
-from spark_rapids_tpu import dtypes as dt
 from spark_rapids_tpu.columnar.batch import to_arrow
 from spark_rapids_tpu.io import device_parquet as devpq
 from spark_rapids_tpu.io import parquet_meta as pm

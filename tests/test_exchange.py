@@ -5,7 +5,6 @@ and the join-strategy selection Spark performs above the plugin
 (broadcast vs shuffled hash vs nested loop vs cartesian).
 """
 
-import numpy as np
 import pyarrow as pa
 import pytest
 
@@ -188,7 +187,6 @@ def test_cartesian_parity():
 def test_join_strategy_selection():
     from spark_rapids_tpu.plan import planner
     from spark_rapids_tpu.config import RapidsTpuConf
-    import spark_rapids_tpu.plan.logical as lp
 
     s = TpuSparkSession(SHUF)
     big = s.create_dataframe(

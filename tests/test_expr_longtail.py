@@ -5,13 +5,11 @@ datetimeExpressions.scala, GpuInputFileBlock.scala)."""
 
 import hashlib
 
-import numpy as np
 import pyarrow as pa
 
 from spark_rapids_tpu.api import functions as F
-from spark_rapids_tpu.api.column import col, lit
-from tests.parity import (assert_tables_equal,
-                          assert_tpu_and_cpu_are_equal_collect,
+from spark_rapids_tpu.api.column import col
+from tests.parity import (assert_tpu_and_cpu_are_equal_collect,
                           with_cpu_session, with_tpu_session)
 
 

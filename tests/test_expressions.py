@@ -6,8 +6,8 @@ import pytest
 
 from spark_rapids_tpu import col, lit, functions as F
 from tests.parity import assert_tpu_and_cpu_are_equal_collect
-from tests.data_gen import (gen_df, byte_gen, short_gen, int_gen, long_gen,
-                            float_gen, double_gen, boolean_gen, string_gen,
+from tests.data_gen import (gen_df, int_gen, long_gen,
+                            double_gen, boolean_gen, string_gen,
                             date_gen, timestamp_gen, StringGen, IntGen)
 
 

@@ -2,10 +2,9 @@
 GpuShuffledHashJoinExec/GpuBroadcastHashJoinExec — currently CPU fallback
 until the TPU join exec lands)."""
 
-import pyarrow as pa
 import pytest
 
-from spark_rapids_tpu import col, functions as F
+from spark_rapids_tpu import col
 from tests.parity import assert_tpu_and_cpu_are_equal_collect
 from tests.data_gen import gen_df, int_key_gen, long_gen, string_key_gen
 

@@ -5,7 +5,6 @@ SURVEY.md §4.1)."""
 
 import numpy as np
 import pyarrow as pa
-import pytest
 
 from spark_rapids_tpu.columnar.batch import from_arrow, to_arrow
 from spark_rapids_tpu.mem.host_arena import HostArena
@@ -144,7 +143,6 @@ def test_agg_query_under_tiny_device_budget():
     """End-to-end: grouped aggregate still correct when every partial is
     forced through the spill path."""
     from spark_rapids_tpu import TpuSparkSession, functions as F
-    from tests.parity import assert_tables_equal
     s = TpuSparkSession({
         "spark.rapids.tpu.memory.device.batchStorageSize": 1,  # force spill
         "spark.rapids.tpu.sql.variableFloatAgg.enabled": True,

@@ -1,0 +1,428 @@
+"""Observability layer: span tracer, metrics registry, query profiles,
+listeners, and the instrumented engine paths (ISSUE 3 acceptance)."""
+
+import json
+import os
+import threading
+import time
+from concurrent.futures import ThreadPoolExecutor
+
+import pyarrow as pa
+import pyarrow.parquet as papq
+import pytest
+
+from spark_rapids_tpu import TpuSparkSession, col, functions as F
+from spark_rapids_tpu.exec.base import (Metrics, collect_plan_metrics,
+                                        merge_plan_metrics, timed,
+                                        timed_extra)
+from spark_rapids_tpu.obs import listener as obslistener
+from spark_rapids_tpu.obs import registry as obsreg
+from spark_rapids_tpu.obs import trace
+
+
+@pytest.fixture(autouse=True)
+def _trace_hygiene():
+    """Every test leaves the process-wide tracer off and empty."""
+    yield
+    trace.configure(False)
+    trace.clear()
+
+
+def _obs_session(**extra):
+    conf = {
+        "spark.rapids.tpu.sql.variableFloatAgg.enabled": True,
+        "spark.rapids.tpu.obs.trace.enabled": True,
+    }
+    conf.update(extra)
+    return TpuSparkSession(conf)
+
+
+def _write_parquet(tmp_path, n=600, files=2):
+    root = str(tmp_path / "data")
+    os.makedirs(root, exist_ok=True)
+    per = n // files
+    for i in range(files):
+        papq.write_table(pa.table({
+            "k": pa.array([(j % 7) for j in range(per)], pa.int64()),
+            "v": pa.array([float(j + i) for j in range(per)]),
+        }), os.path.join(root, f"p{i}.parquet"), row_group_size=128)
+    return root
+
+
+def _validate_chrome(doc):
+    """Valid trace-event JSON: matched B/E counts AND per-tid stack
+    discipline (every E closes the most recent open B)."""
+    evs = doc["traceEvents"]
+    assert evs
+    b = [e for e in evs if e["ph"] == "B"]
+    e = [e for e in evs if e["ph"] == "E"]
+    assert len(b) == len(e)
+    stacks = {}
+    for ev in evs:
+        st = stacks.setdefault(ev["tid"], [])
+        if ev["ph"] == "B":
+            st.append(ev["name"])
+        else:
+            assert st, f"E without open B on tid {ev['tid']}"
+            assert st.pop() == ev["name"], "E closes a non-top span"
+    for tid, st in stacks.items():
+        assert not st, f"unclosed spans on tid {tid}: {st}"
+
+
+# ---------------------------------------------------------------------------
+# tracer
+# ---------------------------------------------------------------------------
+
+def test_span_nesting_and_depth():
+    trace.configure(True, 4096)
+    trace.clear()
+    with trace.span("outer"):
+        with trace.span("inner"):
+            time.sleep(0.001)
+    spans = trace.snapshot()
+    by_name = {s[2]: s for s in spans}
+    assert set(by_name) == {"outer", "inner"}
+    assert by_name["outer"][6] == 1 and by_name["inner"][6] == 2
+    # inner is contained in outer
+    o, i = by_name["outer"], by_name["inner"]
+    assert o[4] <= i[4] and i[4] + i[5] <= o[4] + o[5]
+
+
+def test_tracer_thread_safety_and_chrome_export():
+    trace.configure(True, 1 << 16)
+    trace.clear()
+
+    def work(t):
+        for j in range(50):
+            with trace.span(f"t{t}.outer", args={"j": j}):
+                with trace.span(f"t{t}.inner"):
+                    pass
+
+    with ThreadPoolExecutor(max_workers=4) as pool:
+        list(pool.map(work, range(4)))
+    spans = trace.snapshot()
+    assert len(spans) == 4 * 50 * 2
+    doc = json.loads(json.dumps(trace.chrome_trace(spans)))
+    _validate_chrome(doc)
+    assert len(doc["traceEvents"]) == len(spans) * 2
+
+
+def test_tracer_ring_is_bounded():
+    trace.configure(True, 64)
+    trace.clear()
+    for i in range(500):
+        trace.record(f"s{i}", i * 10, 5)
+    spans = trace.snapshot()
+    assert len(spans) <= 64
+    assert spans[-1][2] == "s499"      # newest survives, oldest drop
+    trace.configure(True, trace.DEFAULT_BUFFER_SPANS)
+
+
+def test_disabled_path_records_nothing_and_allocates_nothing():
+    trace.configure(False)
+    trace.clear()
+    mark = trace.mark()
+    # zero-allocation no-op: the shared singleton context manager
+    assert trace.span("a") is trace.span("b")
+    with trace.span("x"):
+        trace.record("y", 0, 1)
+    m = Metrics()
+    with timed(m, "z"):
+        pass
+    with timed_extra(m, "zTime"):
+        pass
+    assert trace.spans_since(mark) == []
+
+
+# ---------------------------------------------------------------------------
+# registry
+# ---------------------------------------------------------------------------
+
+def test_registry_counters_gauges_histograms_and_view():
+    reg = obsreg.get_registry()
+    view = reg.view()
+    reg.inc("test.count", 2)
+    reg.inc("test.count")
+    reg.gauge_max("test.hwm", 10)
+    reg.gauge_max("test.hwm", 7)           # hwm keeps the max
+    reg.observe("test.latNs", 100)
+    reg.observe("test.latNs", 300)
+    d = view.delta()
+    assert d["counters"]["test.count"] == 3
+    assert d["gauges"]["test.hwm"] == 10
+    h = d["histograms"]["test.latNs"]
+    assert h["count"] == 2 and h["sum"] == 400 and h["mean"] == 200
+    # a second view sees only what happens after it
+    view2 = reg.view()
+    reg.inc("test.count", 5)
+    assert view2.delta()["counters"]["test.count"] == 5
+
+
+def test_registry_thread_safety():
+    reg = obsreg.get_registry()
+    view = reg.view()
+
+    def work(_):
+        for _i in range(200):
+            reg.inc("test.race")
+
+    with ThreadPoolExecutor(max_workers=8) as pool:
+        list(pool.map(work, range(8)))
+    assert view.delta()["counters"]["test.race"] == 1600
+
+
+# ---------------------------------------------------------------------------
+# Metrics unit contract (satellite: ns everywhere internally)
+# ---------------------------------------------------------------------------
+
+def test_timed_extra_accumulates_nanoseconds():
+    m = Metrics()
+    with timed_extra(m, "xTime"):
+        time.sleep(0.01)
+    # 10ms is 1e7 ns; were this seconds it would be ~0.01
+    assert m.extra["xTime"] > 1e6
+    assert 0.001 < m.extra_s("xTime") < 10.0
+    with timed(m):
+        time.sleep(0.005)
+    assert m.total_time_ns > 1e6
+    assert m.total_time_s == m.total_time_ns / 1e9
+
+
+# ---------------------------------------------------------------------------
+# query profile (the acceptance drill)
+# ---------------------------------------------------------------------------
+
+def test_query_profile_parity_sections_and_chrome(tmp_path):
+    root = _write_parquet(tmp_path)
+    s = _obs_session()
+    out = (s.read.parquet(root).filter(col("v") > 1.0)
+           .group_by("k").agg(F.count("*").alias("c"),
+                              F.sum("v").alias("sv"))).collect()
+    prof = s.last_query_profile()
+    assert prof is not None and prof.status == "success"
+    # per-exec rows match the collected result at the root
+    assert prof.result_rows == out.num_rows
+    assert prof.plan.rows == out.num_rows
+    # scan, shuffle, semaphore, spill sections exist
+    for sec in ("scan", "shuffle", "semaphore", "spill"):
+        assert sec in prof.metrics, prof.metrics.keys()
+    assert prof.metrics["semaphore"].get("semaphore.acquires", 0) >= 1
+    assert prof.metrics["scan"].get("scan.planCacheHits", 0) + \
+        prof.metrics["scan"].get("scan.planCacheMisses", 0) > 0
+    # a scan node carries host-prep/upload extras (ns internally)
+    scans = [n for n in prof.plan.walk() if "ScanExec" in n.name]
+    assert scans and "scan.hostPrepTime" in scans[0].extra
+    # wall breakdown is present and self-consistent
+    wb = prof.wall_breakdown
+    for key in ("host_prep_s", "upload_s", "dispatch_s", "shuffle_s",
+                "semaphore_wait_s"):
+        assert key in wb
+    assert wb["host_prep_s"] >= 0
+    # spans recorded; chrome dump parses with matched, nested B/E
+    assert prof.spans
+    p = str(tmp_path / "trace.json")
+    prof.dump_chrome_trace(p)
+    with open(p) as f:
+        _validate_chrome(json.load(f))
+    # JSON round trip of the whole profile
+    d = json.loads(prof.to_json())
+    for k in ("query_id", "status", "plan", "metrics", "wall_breakdown",
+              "spans", "phases"):
+        assert k in d
+    # explain("profile") renders the annotated tree
+    tree = (s.read.parquet(root)).explain_string("profile")
+    assert "QueryProfile" in tree and "rows=" in tree
+
+
+def test_profile_disabled_records_nothing(tmp_path):
+    root = _write_parquet(tmp_path)
+    s = TpuSparkSession({
+        "spark.rapids.tpu.sql.variableFloatAgg.enabled": True,
+        "spark.rapids.tpu.obs.profile.enabled": False})
+    s.read.parquet(root).collect()
+    assert s.last_query_profile() is None
+
+
+def test_trace_disabled_engine_paths_record_no_spans(tmp_path):
+    root = _write_parquet(tmp_path)
+    s = TpuSparkSession({
+        "spark.rapids.tpu.sql.variableFloatAgg.enabled": True})
+    mark = trace.mark()
+    out = (s.read.parquet(root).group_by("k")
+           .agg(F.count("*").alias("c"))).collect()
+    assert out.num_rows
+    assert trace.spans_since(mark) == []
+    # the profile still assembles (profiling and tracing are separate)
+    prof = s.last_query_profile()
+    assert prof is not None and prof.spans == []
+
+
+def test_chrome_path_knob_writes_per_query(tmp_path):
+    root = _write_parquet(tmp_path)
+    chrome = str(tmp_path / "q.trace.json")
+    s = _obs_session(**{"spark.rapids.tpu.obs.trace.chromePath": chrome})
+    s.read.parquet(root).collect()
+    with open(chrome) as f:
+        _validate_chrome(json.load(f))
+
+
+def test_chrome_path_works_without_profiling(tmp_path):
+    """The chromePath contract conditions on tracing alone — profiling
+    off must not silently disable the trace dump."""
+    root = _write_parquet(tmp_path)
+    chrome = str(tmp_path / "np.trace.json")
+    s = _obs_session(**{
+        "spark.rapids.tpu.obs.trace.chromePath": chrome,
+        "spark.rapids.tpu.obs.profile.enabled": False})
+    s.read.parquet(root).collect()
+    assert s.last_query_profile() is None
+    with open(chrome) as f:
+        _validate_chrome(json.load(f))
+
+
+# ---------------------------------------------------------------------------
+# listeners
+# ---------------------------------------------------------------------------
+
+class _Capture(obslistener.QueryExecutionListener):
+    def __init__(self):
+        self.successes = []
+        self.failures = []
+
+    def on_success(self, profile):
+        self.successes.append(profile)
+
+    def on_failure(self, profile, exception):
+        self.failures.append((profile, exception))
+
+
+def test_listener_fires_on_success_and_failure(tmp_path):
+    root = _write_parquet(tmp_path, files=1)
+    s = _obs_session()
+    cap = _Capture()
+    s.register_query_listener(cap)
+    df_ok = s.read.parquet(root)
+    out = df_ok.collect()
+    assert len(cap.successes) == 1
+    assert cap.successes[0].result_rows == out.num_rows
+
+    df_bad = s.read.parquet(root)          # schema read while file exists
+    os.unlink(os.path.join(root, "p0.parquet"))
+    with pytest.raises(Exception) as ei:
+        df_bad.collect()
+    assert len(cap.failures) == 1
+    prof, exc = cap.failures[0]
+    assert prof.status == "failure"
+    assert exc is ei.value
+    assert type(exc).__name__ in prof.error
+    # planning succeeded before the scan blew up: the failure profile
+    # still carries the plan tree and the explain report
+    assert prof.plan is not None
+    assert any("ScanExec" in n.name for n in prof.plan.walk())
+    # a broken listener must not fail the query
+    s.remove_query_listener(cap)
+
+    class _Broken(obslistener.QueryExecutionListener):
+        def on_success(self, profile):
+            raise RuntimeError("listener bug")
+    s.register_query_listener(_Broken())
+    root2 = _write_parquet(tmp_path / "again", files=1)
+    assert s.read.parquet(root2).collect().num_rows
+
+
+# ---------------------------------------------------------------------------
+# semaphore wait metric (satellite)
+# ---------------------------------------------------------------------------
+
+def test_tpu_semaphore_wait_metric():
+    from spark_rapids_tpu.mem import device as devmgr
+    devmgr.initialize(1)
+    try:
+        reg = obsreg.get_registry()
+        view = reg.view()
+        m = Metrics()
+        release = threading.Event()
+        inside = threading.Event()
+
+        def holder():
+            with devmgr.tpu_semaphore():
+                inside.set()
+                release.wait(5.0)
+
+        t = threading.Thread(target=holder)
+        t.start()
+        inside.wait(5.0)
+        # take the contended path on this thread, releasing the holder
+        # shortly after we start blocking
+        threading.Timer(0.05, release.set).start()
+        with devmgr.tpu_semaphore(m):
+            pass
+        t.join(5.0)
+        d = view.delta()["counters"]
+        assert d.get("semaphore.acquires", 0) >= 2
+        assert d.get("semaphore.waitNs", 0) > 1e6   # blocked >= ~1ms
+        assert m.extra.get("semaphore.acquires") == 1
+        assert m.extra.get("semaphore.waitNs", 0) > 1e6
+    finally:
+        devmgr.initialize(2)
+
+
+# ---------------------------------------------------------------------------
+# executor-side metrics round trip (satellite)
+# ---------------------------------------------------------------------------
+
+def test_collect_and_merge_plan_metrics(tmp_path):
+    root = _write_parquet(tmp_path, files=1)
+    s = _obs_session()
+    result = s._plan_physical(s.read.parquet(root).plan)
+    plan = result.plan
+    nodes = []
+    plan.foreach(nodes.append)
+    # simulate the executor: same tree shape, metrics accumulated there
+    nodes[0].metrics.add_rows(10)
+    nodes[0].metrics.add_time_ns(5000)
+    nodes[0].metrics.add_extra("scan.hostPrepTime", 1000)
+    recorded = collect_plan_metrics(plan)
+    assert recorded[0]["rows"] == 10
+    assert recorded[0]["name"] == type(nodes[0]).__name__
+    # merge back into a "driver" tree of the same shape
+    result2 = s._plan_physical(s.read.parquet(root).plan)
+    merge_plan_metrics(result2.plan, recorded)
+    n2 = []
+    result2.plan.foreach(n2.append)
+    assert n2[0].metrics.num_output_rows == 10
+    assert n2[0].metrics.total_time_ns == 5000
+    assert n2[0].metrics.extra["scan.hostPrepTime"] == 1000
+    # shape mismatch drops the payload instead of corrupting
+    merge_plan_metrics(result2.plan, recorded[:-1])
+    assert n2[0].metrics.num_output_rows == 10
+
+
+def test_process_shuffle_returns_executor_metrics():
+    """Plan fragments shipped to executor processes accumulate Metrics
+    that must come home: after a process-transport exchange, the
+    driver-side exchange subtree shows the executor-side rows."""
+    s = TpuSparkSession({
+        "spark.rapids.tpu.sql.variableFloatAgg.enabled": True,
+        "spark.rapids.tpu.shuffle.transport": "process",
+        "spark.rapids.tpu.shuffle.transport.processExecutors": 2,
+    })
+    captured = []
+    s.add_plan_listener(lambda r: captured.append(r.plan))
+    df = s.create_dataframe(
+        {"k": list(range(40)), "v": [float(i) for i in range(40)]},
+        num_partitions=2).repartition(4, "k")
+    out = df.collect()
+    assert out.num_rows == 40
+    exch = []
+    captured[-1].foreach(
+        lambda p: exch.append(p)
+        if type(p).__name__ == "TpuShuffleExchangeExec" else None)
+    assert exch
+    # the map side ran ONLY in executor processes; nonzero time here
+    # proves the merge brought those Metrics home
+    assert exch[0].metrics.total_time_ns > 0
+    kids = []
+    exch[0].children[0].foreach(kids.append)
+    assert any(k.metrics.num_output_rows > 0 for k in kids), \
+        "executor-side child metrics were dropped"

@@ -7,7 +7,6 @@ import io
 import numpy as np
 import pyarrow as pa
 import pyarrow.orc as paorc
-import pytest
 
 from spark_rapids_tpu import TpuSparkSession
 from spark_rapids_tpu.columnar.batch import from_arrow

@@ -8,7 +8,6 @@ the windowing math is covered standalone
 fetch runs over the in-process tag-matched transport — no real network.
 """
 
-import threading
 
 import numpy as np
 import pyarrow as pa

@@ -12,7 +12,7 @@ from spark_rapids_tpu.expr import ir
 from spark_rapids_tpu.udf import UdfCompileError, compile_udf
 from tests.parity import assert_tpu_and_cpu_are_equal_collect
 from tests.data_gen import (gen_df, int_gen, long_gen, double_gen,
-                            string_gen, boolean_gen)
+                            string_gen)
 
 
 def _compiles(f, nargs=1):

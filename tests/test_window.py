@@ -1,14 +1,13 @@
 """Window function parity suite (reference analog: WindowFunctionSuite,
 window_function_test.py)."""
 
-import pytest
 
-from spark_rapids_tpu import col, lit, functions as F
+from spark_rapids_tpu import col, functions as F
 from spark_rapids_tpu.api.window import Window
 from tests.parity import (assert_tpu_and_cpu_are_equal_collect,
                           collect_plans)
-from tests.data_gen import (gen_df, int_key_gen, int_gen, long_gen,
-                            double_gen, IntGen, StringGen)
+from tests.data_gen import (gen_df, int_key_gen, long_gen,
+                            double_gen, IntGen)
 
 
 def _w():
