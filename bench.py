@@ -93,6 +93,87 @@ def _query(session, path):
                  F.avg("ss_ext_sales_price").alias("aesp")))
 
 
+def _probe_query(session, path):
+    """q6-class pipeline WITH its expression prologue un-collapsed: two
+    computed columns and a filter between scan and aggregate, i.e. the
+    project/filter chain shape whole-stage fusion exists for (the
+    headline ``_query`` is the minimal filter+agg form the loop harness
+    times)."""
+    from spark_rapids_tpu import col, functions as F
+    return (session.read.parquet(path)
+            .with_column("net", col("ss_ext_sales_price") -
+                         col("ss_list_price"))
+            .filter(col("ss_sales_price") > 150.0)
+            .with_column("net_qty", col("net") * col("ss_quantity"))
+            .group_by("ss_item_sk")
+            .agg(F.count("*").alias("cnt"),
+                 F.sum("net_qty").alias("nq")))
+
+
+def _dispatch_count_probe(n: int = 160_000, files: int = 2) -> dict:
+    """Per-query jit dispatch count + distinct-kernel count from the
+    obs registry, fusion on vs off, over a small q6-class dataset.
+
+    Asserts (1) fused and unfused results match row-for-row (the
+    fallback path is a correctness oracle, not just a knob) and (2)
+    fusion cuts the per-query dispatch count by >= 30% — the fused
+    numbers land in the bench JSON so the dispatch reduction is a
+    measured number, not a claim."""
+    from spark_rapids_tpu import TpuSparkSession
+    from spark_rapids_tpu.obs import registry as obsreg
+
+    def run(root, fusion_enabled: bool):
+        s = TpuSparkSession({
+            "spark.rapids.tpu.sql.variableFloatAgg.enabled": True,
+            "spark.rapids.tpu.sql.fusion.enabled": fusion_enabled})
+        cold = obsreg.get_registry().view()
+        _probe_query(s, root).collect()  # warm: compiles off the count
+        cold_misses = cold.delta()["counters"].get(
+            "kernel.cache.misses", 0)
+        view = obsreg.get_registry().view()
+        out = _probe_query(s, root).collect()
+        d = view.delta()["counters"]
+        return out, {
+            "dispatches": int(d.get("kernel.dispatches", 0)),
+            # INCREMENTAL: new compiles during this run only.  The
+            # kernel cache is process-wide and the fused run goes
+            # first, so the unfused number excludes every kernel the
+            # two paths share (scan decode, agg update/merge/final) —
+            # it is NOT a standalone compile-breadth figure; compare
+            # compile bills via bench_compile_bill.py fresh processes
+            "kernels_compiled_incremental": int(cold_misses),
+            "dispatches_saved":
+                int(d.get("fusion.dispatchesSaved", 0)),
+            "fused_stages": int(d.get("fusion.stages", 0)),
+            "agg_prologues_inlined":
+                int(d.get("fusion.aggProloguesInlined", 0)),
+        }
+
+    with tempfile.TemporaryDirectory(prefix="q6_dispatch_") as root:
+        _write_dataset(root, n, files)
+        fused_t, fused = run(root, True)
+        plain_t, plain = run(root, False)
+
+    fs = fused_t.sort_by("ss_item_sk")
+    ps = plain_t.sort_by("ss_item_sk")
+    rows_match = (fs.num_rows == ps.num_rows and
+                  fs.column("cnt").equals(ps.column("cnt")) and
+                  np.allclose(fs.column("nq").to_numpy(
+                      zero_copy_only=False),
+                      ps.column("nq").to_numpy(zero_copy_only=False),
+                      rtol=1e-9, equal_nan=True))
+    assert rows_match, ("fusion on/off results diverge — whole-stage "
+                        "fusion is broken")
+    drop = 1.0 - fused["dispatches"] / max(plain["dispatches"], 1)
+    assert drop >= 0.30, (
+        f"fusion cut q6-class dispatches only {drop:.0%} "
+        f"({plain['dispatches']} -> {fused['dispatches']}); "
+        f"the >=30% contract failed")
+    return {"fused": fused, "unfused": plain,
+            "dispatch_drop_pct": round(100 * drop, 1),
+            "rows_match": True}
+
+
 def _time_engine_cpu(path: str, iters: int = 3):
     """Engine CPU (pyarrow) leg: min wall over iters + the result."""
     from spark_rapids_tpu import TpuSparkSession
@@ -353,6 +434,12 @@ def main() -> None:
                           "rows_match": False}))
         sys.exit(1)
 
+    # fusion-on vs fusion-off dispatch counts on their own small
+    # dataset (asserts parity + the >=30% dispatch-reduction contract);
+    # AFTER the rows_match gate so a probe assertion can never mask the
+    # structured mismatch report downstream tooling parses
+    dispatch_probe = _dispatch_count_probe()
+
     gbps = nbytes / per_query / 1e9
     print(json.dumps({
         "metric": "TPC-DS q6-class device pipeline over parquet "
@@ -367,6 +454,7 @@ def main() -> None:
         "host_prep_s": round(host_prep_s, 3),
         "host_prep_warm_s": round(host_prep_warm_s, 3),
         "rows_match": bool(rows_match),
+        "dispatch_probe": dispatch_probe,
         "e2e_tunnel_wall_s": round(e2e, 2) if e2e else None,
         "vs_baseline_e2e": round(cpu_time / e2e, 4) if e2e else None,
         "profile_out": profile_out,

@@ -33,14 +33,30 @@ def main() -> None:
         {"spark.rapids.tpu.sql.variableFloatAgg.enabled": True})
     tables = tpcds.setup(s, data)
 
+    from spark_rapids_tpu.obs import registry as obsreg
+
     t0 = time.perf_counter()
     errors = {}
+    # per-query dispatch + newly-compiled-kernel counts carved from the
+    # obs registry (snapshot deltas), so the whole-stage fusion layer's
+    # dispatch reduction shows up per query next to the compile bill
+    per_query = {}
     for name in sorted(tpcds.QUERIES, key=lambda q: int(q[1:])):
+        view = obsreg.get_registry().view()
         try:
             tpcds.QUERIES[name](tables).collect()
         except Exception as e:   # report, keep measuring the rest
             errors[name] = f"{type(e).__name__}: {e}"
+        d = view.delta()["counters"]
+        per_query[name] = {
+            "dispatches": int(d.get("kernel.dispatches", 0)),
+            "kernels_compiled": int(d.get("kernel.cache.misses", 0)),
+            "fused_stages": int(d.get("fusion.stages", 0)),
+            "dispatches_saved":
+                int(d.get("fusion.dispatchesSaved", 0)),
+        }
     wall = time.perf_counter() - t0
+    reg_totals = obsreg.get_registry().snapshot()["counters"]
 
     log = kc.dump_compile_log()
     total_compile = sum(dt for _, _, dt in log)
@@ -57,6 +73,12 @@ def main() -> None:
         "suite_wall_s": round(wall, 1),
         "compile_events": len(log),
         "compile_total_s": round(total_compile, 1),
+        "dispatches_total": int(reg_totals.get("kernel.dispatches", 0)),
+        "distinct_kernels":
+            int(reg_totals.get("kernel.cache.misses", 0)),
+        "fusion_dispatches_saved":
+            int(reg_totals.get("fusion.dispatchesSaved", 0)),
+        "per_query": per_query,
         "top10": [{"kernel": k[:100], "s": round(v, 1)}
                   for k, v in top],
     }), flush=True)

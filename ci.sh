@@ -34,6 +34,48 @@ jax.jit(fn)(*args)
 g.dryrun_multichip(8)
 EOF
 
+echo "== fusion fallback parity (sql.fusion.enabled=false vs fused) =="
+python - <<'EOF'
+# the unfused per-node path is the fused path's correctness oracle;
+# running one real query both ways in CI keeps the fallback from
+# silently rotting (and asserts fusion actually engages + saves
+# dispatches, via the obs registry)
+import os
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+from spark_rapids_tpu import TpuSparkSession, col, functions as F
+from spark_rapids_tpu.obs import registry as obsreg
+
+def query(s):
+    df = s.create_dataframe(
+        {"k": [i % 7 for i in range(2000)],
+         "x": [float(i % 100) for i in range(2000)],
+         "s": [f"v{i % 13}" for i in range(2000)]},
+        num_partitions=3)
+    return (df.with_column("y", col("x") * 2.0 + 1.0)
+              .filter(col("y") > 20.0)
+              .with_column("z", col("y") - col("k"))
+              .group_by("k")
+              .agg(F.count("*").alias("n"), F.sum("z").alias("sz"))
+              .sort("k"))
+
+runs = {}
+for fused in (True, False):
+    s = TpuSparkSession({
+        "spark.rapids.tpu.sql.variableFloatAgg.enabled": True,
+        "spark.rapids.tpu.sql.fusion.enabled": fused})
+    view = obsreg.get_registry().view()
+    runs[fused] = (query(s).collect(),
+                   view.delta()["counters"].get("kernel.dispatches", 0))
+fused_t, fused_d = runs[True]
+plain_t, plain_d = runs[False]
+assert fused_t.equals(plain_t), (
+    "fusion on/off results diverge:\n"
+    f"fused={fused_t.to_pydict()}\nunfused={plain_t.to_pydict()}")
+assert fused_d < plain_d, (
+    f"fusion saved no dispatches ({fused_d} vs {plain_d})")
+print(f"fusion parity OK; dispatches {plain_d} -> {fused_d}")
+EOF
+
 echo "== smoke bench (tracing enabled) =="
 python bench.py --smoke --profile-out=/tmp/bench_profile.json
 

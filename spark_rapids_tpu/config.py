@@ -436,6 +436,42 @@ AGG_FUSED_FILTER = conf(
     "compaction costs one full-capacity gather per column — measured "
     "~315 ms of the 738 ms round-4 q6 pipeline).", bool)
 
+FUSION_ENABLED = conf(
+    "spark.rapids.tpu.sql.fusion.enabled", True,
+    "Whole-stage kernel fusion: collapse maximal chains of dispatch-only "
+    "execs (Project/Filter) into a single TpuFusedStageExec whose one "
+    "cached kernel evaluates the composed expression DAG with at most "
+    "one stream compaction, and inline projection prologues directly "
+    "under a hash aggregate into the aggregate's own update kernel. "
+    "Each per-exec jit dispatch costs ~72 ms on the tunneled runtime "
+    "(PERF.md), so an N-exec chain pays N-1 fewer dispatches per batch. "
+    "Disable for parity testing against the unfused per-node path "
+    "(Spark's whole-stage codegen / the reference's tiered project, "
+    "basicPhysicalOperators.scala).", bool)
+
+FUSION_MAX_EXPRS = conf(
+    "spark.rapids.tpu.sql.fusion.maxExprs", 256,
+    "Ceiling on the total expression-node count of one fused stage's "
+    "composed output+condition DAG.  Substituting a projection into "
+    "its consumers duplicates shared subtrees, so unguarded fusion "
+    "could blow up trace time and compile breadth (the TPC-DS compile "
+    "bill is pure breadth, PERF.md round 5); past the ceiling the "
+    "chain stays unfused.", int)
+
+FUSION_DONATE = conf(
+    "spark.rapids.tpu.sql.fusion.donateInputs", True,
+    "Donate the input batch's device buffers to fused-stage / project / "
+    "filter dispatches (jax donate_argnums) when the producing exec is "
+    "known not to retain them, letting XLA reuse the input HBM for the "
+    "output and cutting peak memory for deep chains.  Donated "
+    "dispatches skip the HBM-OOM retry path (the retry would replay "
+    "consumed buffers).  Automatically stands down while the "
+    "persistent XLA compilation cache is active: cache-RELOADED "
+    "executables mis-apply the donation aliasing table on this jax "
+    "(exec/fused_stage._persistent_cache_active has the minimal "
+    "repro), so donation only arms for fresh-compiled kernels "
+    "(e.g. under SPARK_RAPIDS_TPU_NO_COMPILE_CACHE=1).", bool)
+
 AGG_EXCHANGE = conf(
     "spark.rapids.tpu.sql.agg.exchange.enabled", False,
     "Plan grouped aggregates as a hash exchange on the grouping keys "

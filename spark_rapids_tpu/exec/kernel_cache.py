@@ -31,10 +31,19 @@ _LOCK = threading.Lock()
 _ID_PINNED: dict = {}
 
 
+# output-name attributes cannot change a compiled program: a
+# BoundReference reads by ordinal and an Alias only labels its child,
+# so identical projections under different aliases must share one
+# compile (kernels that DO emit names either take them from the input
+# batch at runtime or carry an explicit name tuple in their cache key)
+_NAME_ATTRS = ("ref_name", "alias", "attr_name")
+
+
 def expr_sig(e) -> Any:
     """Canonical hashable signature of an expression tree (class, dtype,
     scalar params, children) — the kernel-cache key component for any
-    closed-over expression."""
+    closed-over expression.  Canonical: ordinals and dtypes only, never
+    column/alias names."""
     if e is None:
         return None
     if isinstance(e, ir.Expression):
@@ -42,7 +51,7 @@ def expr_sig(e) -> Any:
                  e.dtype.name if e.dtype is not None else "?",
                  bool(e.nullable)]
         for k in sorted(e.__dict__):
-            if k in ("children", "dtype", "nullable"):
+            if k in ("children", "dtype", "nullable") or k in _NAME_ATTRS:
                 continue
             parts.append((k, _value_sig(e.__dict__[k])))
         parts.append(tuple(expr_sig(c) for c in e.children))
@@ -156,16 +165,53 @@ def _with_oom_recovery(fn):
     return run
 
 
+def _family(key: Any) -> str:
+    if isinstance(key, tuple) and key and isinstance(key[0], str):
+        return key[0]
+    return "other"
+
+
+def _count_dispatches(key: Any, fn: Callable) -> Callable:
+    """Per-call registry counters: ``kernel.dispatches`` is the ground
+    truth the fusion layer's dispatch-reduction claims are measured
+    against (bench.py / tests assert the fused-vs-unfused delta on it;
+    one lock bump per ~72 ms dispatch is noise)."""
+    from spark_rapids_tpu.obs import registry as _obsreg
+    fam = _family(key)
+
+    def wrapped(*args, **kwargs):
+        _obsreg.get_registry().inc_many(
+            ("kernel.dispatches", 1), (f"kernel.dispatches.{fam}", 1))
+        return fn(*args, **kwargs)
+    return wrapped
+
+
 def get_kernel(key: Any, builder: Callable[[], Callable],
-               **jit_kwargs) -> Callable:
+               oom_retry: bool = True, **jit_kwargs) -> Callable:
     """Return the cached jitted kernel for ``key``, building+jitting via
-    ``builder`` on first use (LRU-bounded)."""
+    ``builder`` on first use (LRU-bounded).
+
+    ``oom_retry=False`` skips the HBM-OOM retry wrapper — required when
+    the kernel donates input buffers (a retry would replay arguments
+    the failed dispatch may already have consumed).  Call sites that
+    donate must fold the donation into ``key``: the same signature
+    jitted with and without ``donate_argnums`` is two executables."""
+    from spark_rapids_tpu.obs import registry as _obsreg
+    fam = _family(key)
     with _LOCK:
         fn = _CACHE.get(key)
         if fn is not None:
             _CACHE.move_to_end(key)
+            _obsreg.get_registry().inc_many(
+                ("kernel.cache.hits", 1),
+                (f"kernel.cache.hits.{fam}", 1))
             return fn
-    fn = _with_oom_recovery(jax.jit(builder(), **jit_kwargs))
+    _obsreg.get_registry().inc_many(
+        ("kernel.cache.misses", 1), (f"kernel.cache.misses.{fam}", 1))
+    fn = jax.jit(builder(), **jit_kwargs)
+    if oom_retry:
+        fn = _with_oom_recovery(fn)
+    fn = _count_dispatches(key, fn)
     if COMPILE_LOG_ENABLED:
         fn = _instrument(key, fn)
     with _LOCK:

@@ -787,6 +787,14 @@ class TpuHashAggregateExec(TpuExec):
         # column; the sort-based grouping is capacity-proportional
         # either way)
         self.fused_condition: Optional[ir.Expression] = None
+        # execs the whole-stage fusion pass inlined into this
+        # aggregate's prologue (plan/fusion.py R2)
+        self.fused_prologue_execs: int = 0
+        # the subset of those that are REAL savings vs the fusion-off
+        # baseline: a lone filter directly under the aggregate is
+        # absorbed by the legacy _fuse_filters_into_aggregates post-pass
+        # either way, so counting it would overstate fusion's benefit
+        self.fused_prologue_saved: int = 0
         self._update_kernel = None
         self._merge_kernel = None
 
@@ -842,6 +850,8 @@ class TpuHashAggregateExec(TpuExec):
 
         def run(its):
             from spark_rapids_tpu.mem.spill import register_or_hold
+            from spark_rapids_tpu.obs import registry as obsreg
+            reg = obsreg.get_registry()
             # buffered partials stay spillable between update and merge
             # (reference: aggregate.scala buffers partial results;
             # SpillableColumnarBatch keeps them evictable)
@@ -858,6 +868,9 @@ class TpuHashAggregateExec(TpuExec):
                             continue
                         with timed(self.metrics, "agg.update"):
                             partial = self._update_kernel(b)
+                        if self.fused_prologue_saved:
+                            reg.inc("fusion.dispatchesSaved",
+                                    self.fused_prologue_saved)
                         partials.append(register_or_hold(partial))
                 if not partials:
                     if self.groupings:

@@ -128,44 +128,59 @@ class TpuProjectExec(TpuExec):
     def schema(self) -> Schema:
         return self._schema
 
-    def _impl(self, batch: DeviceBatch, pid, offset) -> DeviceBatch:
+    def _impl(self, batch: DeviceBatch, nr, pid, offset) -> DeviceBatch:
         from spark_rapids_tpu.exec import context
+        from spark_rapids_tpu.exec.fused_stage import canonical_names
         # pid/offset are tracers here: one compiled kernel serves every
-        # partition (partition-dependent exprs read them via the context)
+        # partition (partition-dependent exprs read them via the context).
+        # nr is the real row count, passed OUTSIDE the (possibly donated)
+        # batch pytree — see fused_stage.rows_detached.
+        # Output names are POSITIONAL placeholders: the kernel-cache key
+        # carries no column names (identical projections under different
+        # aliases share one compile) and execute() restamps the real
+        # schema names host-side.
+        batch.num_rows = nr
         with context.task_context(pid, offset):
             cols = [eval_tpu.evaluate(e, batch).to_column()
                     for e in self.exprs]
-        return DeviceBatch(self._schema.names, cols, batch.num_rows)
+        return DeviceBatch(canonical_names(len(cols)), cols,
+                           batch.num_rows)
 
     def execute(self):
-        if self._kernel is None:
-            import functools
-            import types
-            from spark_rapids_tpu.exec import kernel_cache as kc
-            # detach from self: the cached closure must not pin the exec
-            # instance (and through it the whole child plan subtree)
-            shim = types.SimpleNamespace(exprs=self.exprs,
-                                         _schema=self._schema)
-            self._kernel = kc.get_kernel(
-                ("project", kc.exprs_sig(self.exprs),
-                 tuple(self._schema.names)),
-                lambda: functools.partial(type(self)._impl, shim))
+        import functools
+        import types
+        from spark_rapids_tpu.exec import fused_stage as fs
+        from spark_rapids_tpu.exec import kernel_cache as kc
+        from spark_rapids_tpu.obs import registry as obsreg
+        donate = fs.donate_ok(self.children[0],
+                              getattr(self, "_donate_enabled", False))
+        # detach from self: the cached closure must not pin the exec
+        # instance (and through it the whole child plan subtree)
+        shim = types.SimpleNamespace(exprs=self.exprs)
+        fs.build_kernel(
+            self, ("project", kc.exprs_sig(self.exprs)),
+            lambda: functools.partial(type(self)._impl, shim), donate)
 
         needs_ctx = any(
             ir.collect(e, lambda n: isinstance(
                 n, (ir.SparkPartitionID, ir.MonotonicallyIncreasingID)))
             for e in self.exprs)
+        names = self._schema.names
 
         def run(pid, it):
+            reg = obsreg.get_registry()
             offset = 0
             for b in it:
-                with timed(self.metrics, "project.eval"):
-                    out = self._kernel(b, jnp.int32(pid),
-                                       jnp.int64(offset))
                 if needs_ctx:
                     # row-offset tracking costs one host sync per batch;
                     # only pay it when a partition-dependent expr exists
-                    offset += int(b.num_rows)
+                    # (read BEFORE dispatch — donation consumes b)
+                    nr = int(b.num_rows)
+                out = fs.dispatch(self, "project.eval", donate, reg,
+                                  b, pid, offset)
+                out = DeviceBatch(names, out.columns, out.num_rows)
+                if needs_ctx:
+                    offset += nr
                 self.metrics.add_batches()
                 yield out
         return [run(pid, it) for pid, it in
@@ -201,26 +216,51 @@ class TpuFilterExec(TpuExec):
     def schema(self) -> Schema:
         return self.children[0].schema
 
-    def _impl(self, batch: DeviceBatch) -> DeviceBatch:
-        v = eval_tpu.evaluate(self.condition, batch)
+    def _impl(self, batch: DeviceBatch, nr, pid, offset) -> DeviceBatch:
+        from spark_rapids_tpu.exec import context
+        # a standalone filter must see the task context too: a
+        # partition-dependent condition (spark_partition_id(),
+        # monotonically_increasing_id()) otherwise evaluates against
+        # the context DEFAULT (0, 0) inside the jitted kernel and
+        # silently keeps/drops the wrong rows on every partition
+        batch.num_rows = nr
+        with context.task_context(pid, offset):
+            v = eval_tpu.evaluate(self.condition, batch)
         return compact(batch, v.data.astype(jnp.bool_) & v.validity)
 
     def execute(self):
-        if self._kernel is None:
-            import functools
-            import types
-            from spark_rapids_tpu.exec import kernel_cache as kc
-            shim = types.SimpleNamespace(condition=self.condition)
-            self._kernel = kc.get_kernel(
-                ("filter", kc.expr_sig(self.condition)),
-                lambda: functools.partial(type(self)._impl, shim))
+        import functools
+        import types
+        from spark_rapids_tpu.exec import fused_stage as fs
+        from spark_rapids_tpu.exec import kernel_cache as kc
+        from spark_rapids_tpu.obs import registry as obsreg
+        donate = fs.donate_ok(self.children[0],
+                              getattr(self, "_donate_enabled", False))
+        shim = types.SimpleNamespace(condition=self.condition)
+        fs.build_kernel(
+            self, ("filter", kc.expr_sig(self.condition)),
+            lambda: functools.partial(type(self)._impl, shim), donate)
 
-        def run(it):
+        needs_ctx = bool(ir.collect(
+            self.condition, lambda n: isinstance(
+                n, (ir.SparkPartitionID, ir.MonotonicallyIncreasingID))))
+
+        def run(pid, it):
+            reg = obsreg.get_registry()
+            offset = 0
             for b in it:
-                with timed(self.metrics, "filter.eval"):
-                    out = self._kernel(b)
+                if needs_ctx:
+                    # offset accumulates INPUT rows (the condition sees
+                    # pre-compaction positions); host sync only on the
+                    # partition-dependent path, read BEFORE dispatch
+                    nr = int(b.num_rows)
+                out = fs.dispatch(self, "filter.eval", donate, reg,
+                                  b, pid, offset)
+                if needs_ctx:
+                    offset += nr
                 yield out
-        return [run(it) for it in self.children[0].execute()]
+        return [run(pid, it) for pid, it in
+                enumerate(self.children[0].execute())]
 
 
 class TpuRangeExec(TpuExec):

@@ -29,7 +29,8 @@ from spark_rapids_tpu.obs import trace as obstrace
 # registry sections the profile always surfaces, even when empty — the
 # acceptance contract is "includes scan, shuffle, semaphore, and spill
 # sections" whether or not the query touched them
-SECTIONS = ("scan", "shuffle", "semaphore", "spill", "pyworker")
+SECTIONS = ("scan", "shuffle", "semaphore", "spill", "pyworker",
+            "fusion")
 
 
 @dataclass
@@ -171,7 +172,7 @@ def _breakdown(plan: Optional[ExecNodeProfile],
                wall_ns: int) -> Dict[str, float]:
     """Wall-clock breakdown in seconds: host prep vs upload vs dispatch
     vs shuffle vs semaphore wait, plus spill traffic in bytes."""
-    host_prep = upload = dispatch = shuffle = 0.0
+    host_prep = upload = dispatch = shuffle = fused = 0.0
     if plan is not None:
         for n in plan.walk():
             host_prep += n.extra.get("scan.hostPrepTime", 0) / 1e9
@@ -180,6 +181,13 @@ def _breakdown(plan: Optional[ExecNodeProfile],
                 shuffle += n.time_ns / 1e9
             elif n.is_tpu:
                 dispatch += n.time_ns / 1e9
+                if n.name.startswith("TpuFusedStageExec"):
+                    # fused-stage share of dispatch time, so the
+                    # whole-stage fusion layer's cost/benefit is
+                    # attributable per query (also counted in
+                    # dispatch_s — this is an attribution, not a
+                    # disjoint phase)
+                    fused += n.time_ns / 1e9
     sem = sections.get("semaphore", {})
     spill = sections.get("spill", {})
     return {
@@ -187,6 +195,7 @@ def _breakdown(plan: Optional[ExecNodeProfile],
         "host_prep_s": host_prep,
         "upload_s": upload,
         "dispatch_s": dispatch,
+        "fused_stage_s": fused,
         "shuffle_s": shuffle,
         "semaphore_wait_s": sem.get("semaphore.waitNs", 0) / 1e9,
         "spill_device_to_host_bytes":

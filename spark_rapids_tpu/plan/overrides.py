@@ -689,10 +689,26 @@ class TpuOverrides:
               ) -> "OverrideResult":
         meta = wrap_and_tag(cpu_plan, conf)
         plan = _convert(meta, conf)
+        if conf.get(cfg.FUSION_ENABLED):
+            # whole-stage fusion: collapse Project/Filter chains into
+            # single dispatches and inline aggregate prologues
+            # (plan/fusion.py) before the lone-filter post-pass below
+            from spark_rapids_tpu.plan.fusion import fuse_stages
+            plan = fuse_stages(plan, conf)
         if conf.get(cfg.AGG_FUSED_FILTER):
             _fuse_filters_into_aggregates(plan)
         if plan.is_tpu:
             plan = tpub.DeviceToHostExec(plan)
+        # stamp the session's donation setting on every node: execs read
+        # their OWN plan's flag (fused_stage.donate_ok), so concurrent
+        # sessions with different sql.fusion.donateInputs stay
+        # independent and fragments shipped to executor processes carry
+        # the driver's conf through pickle
+        donate = bool(conf.get(cfg.FUSION_DONATE))
+
+        def _stamp(n):
+            n._donate_enabled = donate
+        plan.foreach(_stamp)
         if _plan_uses_input_file(cpu_plan):
             # fused multi-file batches can't answer input_file_name();
             # reference: GpuParquetScan falls back from the coalescing
@@ -720,11 +736,17 @@ def _fuse_filters_into_aggregates(plan: PhysicalPlan) -> None:
     than the whole masked aggregation."""
     from spark_rapids_tpu.exec.tpu_aggregate import TpuHashAggregateExec
     from spark_rapids_tpu.exec.tpu_basic import TpuFilterExec
+    # the aggregate's update kernel runs WITHOUT the task context a
+    # standalone filter threads through, so a partition-dependent or
+    # position-dependent condition must stay outside (same barrier set
+    # the whole-stage fusion pass enforces for its R2 inlining)
+    from spark_rapids_tpu.plan.fusion import _AGG_BARRIERS, _has_barrier
 
     def rec(n: PhysicalPlan) -> None:
         if isinstance(n, TpuHashAggregateExec) and \
                 n.fused_condition is None and \
-                isinstance(n.children[0], TpuFilterExec):
+                isinstance(n.children[0], TpuFilterExec) and \
+                not _has_barrier([n.children[0].condition], _AGG_BARRIERS):
             f = n.children[0]
             n.fused_condition = f.condition
             n.children = (f.children[0],)

@@ -3,6 +3,16 @@
 Plays the role Spark's query planner plays above the reference plugin: it
 produces the "stock" CPU physical plan that TpuOverrides then rewrites
 (reference call stack: SURVEY.md §3.1).
+
+Planning pipeline (session ``_plan_physical``): ``prune_columns``
+(plan/optimizer.py) -> ``plan_cpu`` (here) -> ``TpuOverrides.apply``
+(plan/overrides.py), which converts to Tpu execs and then runs the
+whole-stage fusion pass (plan/fusion.py) — Project/Filter chains
+collapse into single-dispatch ``TpuFusedStageExec`` nodes and
+aggregate prologues inline into the update kernel.  Fusion must see
+the CONVERTED plan (it fuses Tpu execs, not the CPU nodes built
+here), which is why it lives behind the overrides rather than in this
+module.
 """
 
 from __future__ import annotations
