@@ -174,6 +174,54 @@ def _dispatch_count_probe(n: int = 160_000, files: int = 2) -> dict:
             "rows_match": True}
 
 
+def _concurrent_probe(root: str, n_queries: int) -> dict:
+    """N mixed q6-class queries through the concurrent scheduler
+    (sched/service.py): a serial pass first (the parity oracle and the
+    compile warm-up), then every query submitted at once via
+    ``collect_async`` under ``sched.maxConcurrent=3``.  Reports
+    queries/sec and p50/p95 queue wait (from each future's admission
+    wait) into the bench JSON; serial-vs-concurrent results must match
+    row for row."""
+    from spark_rapids_tpu import TpuSparkSession
+    max_concurrent = 3
+    s = TpuSparkSession({
+        "spark.rapids.tpu.sql.variableFloatAgg.enabled": True,
+        "spark.rapids.tpu.sched.maxConcurrent": max_concurrent})
+    # mixed shapes: the minimal filter+agg form and the computed-column
+    # prologue form alternate, so admitted queries differ in plan shape
+    queries = [(_query if i % 2 == 0 else _probe_query)(s, root)
+               for i in range(n_queries)]
+
+    t0 = time.perf_counter()
+    serial = [q.collect() for q in queries]
+    serial_wall = time.perf_counter() - t0
+
+    t0 = time.perf_counter()
+    futs = [q.collect_async() for q in queries]
+    tables = [f.result(timeout=900) for f in futs]
+    wall = time.perf_counter() - t0
+
+    for i, (a, b) in enumerate(zip(serial, tables)):
+        assert a.sort_by("ss_item_sk").equals(b.sort_by("ss_item_sk")), \
+            f"concurrent query {i} diverges from its serial run"
+    waits_ms = sorted(f.queue_wait_ns / 1e6 for f in futs)
+
+    def pct(p: float) -> float:
+        return waits_ms[min(len(waits_ms) - 1,
+                            int(p * (len(waits_ms) - 1) + 0.5))]
+
+    return {
+        "n_queries": n_queries,
+        "max_concurrent": max_concurrent,
+        "wall_s": round(wall, 3),
+        "serial_wall_s": round(serial_wall, 3),
+        "queries_per_sec": round(n_queries / wall, 3),
+        "queue_wait_p50_ms": round(pct(0.50), 2),
+        "queue_wait_p95_ms": round(pct(0.95), 2),
+        "rows_match": True,
+    }
+
+
 def _time_engine_cpu(path: str, iters: int = 3):
     """Engine CPU (pyarrow) leg: min wall over iters + the result."""
     from spark_rapids_tpu import TpuSparkSession
@@ -392,9 +440,12 @@ def main() -> None:
     files = 8
     smoke = "--smoke" in sys.argv
     profile_out = None
+    concurrent_n = 0
     for a in sys.argv[1:]:
         if a.startswith("--profile-out="):
             profile_out = a.split("=", 1)[1]
+        elif a.startswith("--concurrent="):
+            concurrent_n = int(a.split("=", 1)[1])
     if smoke:
         n = 160_000
     with tempfile.TemporaryDirectory(prefix="tpcds_q6_") as root:
@@ -419,6 +470,10 @@ def main() -> None:
                           tpu_sorted.column("aesp").to_numpy(
                               zero_copy_only=False),
                           rtol=1e-9, equal_nan=True))
+
+        concurrent = None
+        if concurrent_n:
+            concurrent = _concurrent_probe(root, concurrent_n)
 
         e2e = None
         if not smoke:
@@ -455,6 +510,7 @@ def main() -> None:
         "host_prep_warm_s": round(host_prep_warm_s, 3),
         "rows_match": bool(rows_match),
         "dispatch_probe": dispatch_probe,
+        "concurrent": concurrent,
         "e2e_tunnel_wall_s": round(e2e, 2) if e2e else None,
         "vs_baseline_e2e": round(cpu_time / e2e, 4) if e2e else None,
         "profile_out": profile_out,

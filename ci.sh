@@ -76,6 +76,66 @@ assert fused_d < plain_d, (
 print(f"fusion parity OK; dispatches {plain_d} -> {fused_d}")
 EOF
 
+echo "== concurrency smoke (8 async queries, sched.maxConcurrent=3) =="
+timeout 300 python - <<'EOF'
+# N=8 mixed TPC-like queries through the concurrent query scheduler
+# (sched/service.py): serial first (the oracle), then all submitted at
+# once via collect_async under sched.maxConcurrent=3.  Asserts
+# bit-identical results, zero deadlocks (the outer `timeout 300` is the
+# hard wall-clock bound, each future waits at most 120s), and that at
+# least one profile attributes real queue wait.
+import os, time
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+from spark_rapids_tpu import TpuSparkSession, col, functions as F
+
+s = TpuSparkSession({
+    "spark.rapids.tpu.sql.variableFloatAgg.enabled": True,
+    "spark.rapids.tpu.sched.maxConcurrent": 3})
+
+def base(n):
+    return s.create_dataframe(
+        {"k": [i % 7 for i in range(n)],
+         "x": [float(i % 100) for i in range(n)],
+         "s": [f"v{i % 13}" for i in range(n)]},
+        num_partitions=3)
+
+def q_filter_agg(n):
+    return (base(n).with_column("y", col("x") * 2.0 + 1.0)
+            .filter(col("y") > 20.0).group_by("k")
+            .agg(F.count("*").alias("c"), F.sum("y").alias("sy"))
+            .sort("k"))
+
+def q_shuffle_agg(n):
+    return (base(n).repartition(4, "k").group_by("k")
+            .agg(F.avg("x").alias("ax")).sort("k"))
+
+def q_project_sort(n):
+    return (base(n).with_column("z", col("x") - col("k"))
+            .filter(col("z") > 5.0).sort("z", "k").limit(50))
+
+def q_distinct(n):
+    return base(n).select("s").distinct().sort("s")
+
+queries = [q(1500 + 100 * i) for i, q in enumerate(
+    [q_filter_agg, q_shuffle_agg, q_project_sort, q_distinct] * 2)]
+serial = [q.collect() for q in queries]
+
+futs = [q.collect_async() for q in queries]
+tables = [f.result(timeout=120) for f in futs]
+for i, (a, b) in enumerate(zip(serial, tables)):
+    assert a.equals(b), (
+        f"query {i}: concurrent result differs from serial\n"
+        f"serial={a.to_pydict()}\nconcurrent={b.to_pydict()}")
+
+waits = [(f.profile.metrics["sched"]["sched.queueWaitNs"]
+          if f.profile is not None else 0) for f in futs]
+assert any(w > 0 for w in waits), (
+    "no query recorded queue wait despite 8 submissions at "
+    f"maxConcurrent=3: {waits}")
+print(f"concurrency smoke OK: 8/8 bit-identical, "
+      f"max queue wait {max(waits) / 1e6:.1f}ms")
+EOF
+
 echo "== smoke bench (tracing enabled) =="
 python bench.py --smoke --profile-out=/tmp/bench_profile.json
 

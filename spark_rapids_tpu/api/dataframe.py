@@ -310,8 +310,28 @@ class DataFrame:
     # -- actions -----------------------------------------------------------
     def collect(self) -> pa.Table:
         """Execute and return an Arrow table (the terminal device->host
-        transition, GpuBringBackToHost analog)."""
+        transition, GpuBringBackToHost analog).  Runs through the
+        concurrent query scheduler — literally
+        ``collect_async().result()`` — so admission control and
+        deadlines govern blocking collects too."""
         return self.session._execute(self.plan)
+
+    def collect_async(self, priority: int = 0,
+                      timeout_ms: Optional[int] = None,
+                      estimate_bytes: Optional[int] = None):
+        """Submit this query to the session's QueryService and return a
+        QueryFuture immediately (sched/service.py): ``result(timeout)``
+        blocks for the Arrow table, ``cancel()`` unwinds the query at
+        its next cooperative checkpoint, ``done()``/``state`` inspect,
+        and ``profile`` carries the QueryProfile once complete.  Higher
+        ``priority`` admits first; ``timeout_ms`` overrides
+        ``sched.defaultTimeoutMs``; ``estimate_bytes`` overrides the
+        admission HBM estimate for this submission."""
+        return self.session.submit(self.plan, priority=priority,
+                                   timeout_ms=timeout_ms,
+                                   estimate_bytes=estimate_bytes)
+
+    collectAsync = collect_async
 
     def to_pandas(self):
         return self.collect().to_pandas()

@@ -8,6 +8,7 @@ the TPU overrides.
 
 from __future__ import annotations
 
+import collections
 import contextlib
 import itertools
 import threading
@@ -61,8 +62,18 @@ class TpuSparkSession:
         self._plan_listeners: List = []
         self._query_listeners: List = []
         self._views: Dict[str, lp.LogicalPlan] = {}
-        self._last_profile = None
         self._query_ids = itertools.count(1)
+        # per-query profiles: bounded ring keyed by query id, plus the
+        # most recently COMPLETED one — concurrent collects no longer
+        # race a single last-profile slot
+        self._profile_lock = threading.Lock()
+        self._profiles: "collections.OrderedDict[int, Any]" = \
+            collections.OrderedDict()
+        self._profile_ring = max(1, int(self.conf.get(
+            cfg.SCHED_PROFILE_RING)))
+        self._last_profile = None
+        from spark_rapids_tpu.sched.service import QueryService
+        self._query_service = QueryService(self)
 
     # -- builder-compatible construction -----------------------------------
     class Builder:
@@ -167,22 +178,80 @@ class TpuSparkSession:
                 out.extend(it)
             return out
         from concurrent.futures import ThreadPoolExecutor
+        from spark_rapids_tpu.sched import cancel as sched_cancel
+        tok = sched_cancel.current()
+
+        def drain(it):
+            # task threads inherit the query's CancelToken explicitly
+            # (pool threads don't propagate thread-locals)
+            with sched_cancel.install(tok):
+                return list(it)
         with ThreadPoolExecutor(
                 max_workers=min(n_tasks, len(its)),
                 thread_name_prefix="tpu-task") as pool:
-            parts = list(pool.map(list, its))
+            parts = list(pool.map(drain, its))
         return [x for p in parts for x in p]
 
+    # -- scheduler surface ---------------------------------------------------
+    def _next_query_id(self) -> int:
+        return next(self._query_ids)
+
+    @property
+    def scheduler(self):
+        """The session's QueryService (sched/service.py): admission
+        stats, controller, estimate book."""
+        return self._query_service
+
+    def submit(self, df_or_plan, priority: int = 0,
+               timeout_ms: Optional[int] = None,
+               estimate_bytes: Optional[int] = None):
+        """Submit a query for asynchronous execution; returns a
+        QueryFuture (result/cancel/done, profile attached on
+        completion).  Accepts a DataFrame or a logical plan.  Higher
+        ``priority`` admits first; ``timeout_ms`` overrides
+        ``sched.defaultTimeoutMs``; ``estimate_bytes`` overrides the
+        admission working-set estimate."""
+        plan = getattr(df_or_plan, "plan", df_or_plan)
+        return self._query_service.submit(
+            plan, priority=priority, timeout_ms=timeout_ms,
+            estimate_bytes=estimate_bytes)
+
     def _execute(self, plan: lp.LogicalPlan) -> pa.Table:
+        """The blocking action path: literally ``submit().result()``
+        through the concurrent query scheduler (sched/service.py) —
+        admission control, deadline, cancellation, and per-query
+        profile attribution all apply to plain ``collect()`` too.
+
+        An interrupt of the blocking wait (Ctrl-C in a REPL) cancels
+        the submitted query: pre-scheduler, collect ran on the calling
+        thread and unwound with the interrupt — a worker that kept
+        running headless, holding its admission slot, would regress
+        that.  (cancel() is a no-op when the raise came from the query
+        itself, which has already finished.)"""
+        fut = self._query_service.submit(plan)
+        try:
+            return fut.result()
+        except BaseException:
+            fut.cancel("blocking collect interrupted")
+            raise
+
+    def _execute_attributed(self, plan: lp.LogicalPlan,
+                            query_id: Optional[int] = None,
+                            sched_extra: Optional[Dict[str, Any]] = None):
         """Execute an action with the observability envelope: a
         QueryRun captures wall phases, the per-query registry delta and
-        span window; the assembled QueryProfile lands on
-        ``last_query_profile()`` and fans out to the registered query
-        listeners (on success AND on failure)."""
+        span window; the assembled QueryProfile lands in the profile
+        ring / ``last_query_profile()`` and fans out to the registered
+        query listeners (on success AND on failure).  Returns
+        ``(table, profile)`` (profile None when profiling is off).
+        Called by the QueryService worker with the query's CancelToken
+        already installed on the thread."""
         run = None
         if self.conf.get(cfg.OBS_PROFILE_ENABLED):
             from spark_rapids_tpu.obs.profile import QueryRun
-            run = QueryRun(next(self._query_ids))
+            run = QueryRun(query_id if query_id is not None
+                           else self._next_query_id(),
+                           sched_extra=sched_extra)
         try:
             result, table = self._execute_inner(plan, run)
         except BaseException as e:
@@ -192,8 +261,9 @@ class TpuSparkSession:
                 # explain report whenever planning itself succeeded
                 self._finish_query(run, run.planned, None, e)
             raise
+        prof = None
         if run is not None:
-            self._finish_query(run, result, table, None)
+            prof = self._finish_query(run, result, table, None)
         elif self.conf.get(cfg.OBS_TRACE_ENABLED):
             # tracing without profiling: the chromePath contract still
             # holds (the whole ring stands in for the query window)
@@ -202,19 +272,26 @@ class TpuSparkSession:
             if chrome and obs_trace.is_enabled():
                 with contextlib.suppress(OSError):
                     obs_trace.dump_chrome_trace(chrome)
-        return table
+        return table, prof
 
     def _finish_query(self, run, result, table,
-                      error: Optional[BaseException]) -> None:
+                      error: Optional[BaseException]):
         from spark_rapids_tpu.obs import listener as obs_listener
         from spark_rapids_tpu.obs import trace as obs_trace
         prof = run.finish(result=result, table=table, error=error)
-        self._last_profile = prof
+        with self._profile_lock:
+            self._profiles[run.query_id] = prof
+            while len(self._profiles) > self._profile_ring:
+                self._profiles.popitem(last=False)
+            # completion order under the lock: "last" is the most
+            # recently COMPLETED query, stable under concurrent collects
+            self._last_profile = prof
         obs_listener.notify(self._query_listeners, prof, error)
         chrome = str(self.conf.get(cfg.OBS_TRACE_CHROME_PATH) or "")
         if chrome and obs_trace.is_enabled():
             with contextlib.suppress(OSError):
                 prof.dump_chrome_trace(chrome)
+        return prof
 
     def _phase(self, run, name: str):
         return run.phase(name) if run is not None \
@@ -277,10 +354,21 @@ class TpuSparkSession:
 
     # -- observability surface ---------------------------------------------
     def last_query_profile(self):
-        """The QueryProfile of the most recent action (None before the
-        first action, or while ``obs.profile.enabled=false`` has kept
-        new profiles from being assembled)."""
-        return self._last_profile
+        """The QueryProfile of the most recently COMPLETED action (None
+        before the first action, or while
+        ``obs.profile.enabled=false`` has kept new profiles from being
+        assembled).  Under concurrent collects this is completion
+        order, not submission order — use :meth:`query_profile` with a
+        QueryFuture's ``query_id`` for a specific query."""
+        with self._profile_lock:
+            return self._last_profile
+
+    def query_profile(self, query_id: int):
+        """The QueryProfile for ``query_id`` from the bounded per-query
+        ring (``sched.profileRing`` entries; None once evicted or when
+        profiling is off)."""
+        with self._profile_lock:
+            return self._profiles.get(query_id)
 
     def register_query_listener(self, listener) -> None:
         """Register a QueryExecutionListener analog: ``on_success(

@@ -531,6 +531,53 @@ OBS_TRACE_CHROME_PATH = conf(
     "also written to this path as Chrome trace-event JSON, overwriting "
     "the previous query's file.")
 
+SCHED_MEMORY_BUDGET = conf(
+    "spark.rapids.tpu.sched.memoryBudget", 0,
+    "HBM byte budget the admission controller packs query estimates "
+    "into: queries are admitted while the sum of their declared "
+    "working-set estimates stays under it (sched.maxConcurrent is the "
+    "hard count cap); excess queries queue instead of OOMing. 0 "
+    "derives the budget from the device manager's HBM pool "
+    "(bytes_limit x memory.pool.fraction; 8 GiB when the backend "
+    "reports no limit).", int)
+
+SCHED_MAX_CONCURRENT = conf(
+    "spark.rapids.tpu.sched.maxConcurrent", 4,
+    "Hard cap on concurrently RUNNING queries in the per-session "
+    "QueryService, regardless of memory estimates (the inter-query "
+    "layer above sql.concurrentTpuTasks, which still bounds "
+    "device-task concurrency inside admitted queries).", int)
+
+SCHED_DEFAULT_TIMEOUT_MS = conf(
+    "spark.rapids.tpu.sched.defaultTimeoutMs", 0,
+    "Default per-query deadline in milliseconds, covering queue wait "
+    "AND execution; on expiry the query's CancelToken fires with "
+    "timed_out=true and the query unwinds (admission slot released, "
+    "prefetcher drained, shuffle fetches cancelled, spill entries "
+    "freed), raising QueryTimeoutError from result(). 0 disables; "
+    "submit(timeout_ms=...) overrides per query.", int)
+
+SCHED_MAX_QUEUED = conf(
+    "spark.rapids.tpu.sched.maxQueued", 1024,
+    "Bound on the admission wait queue; submissions past it are "
+    "rejected with QueryRejectedError (back-pressure instead of an "
+    "unbounded thread pile-up).", int)
+
+SCHED_QUERY_ESTIMATE_BYTES = conf(
+    "spark.rapids.tpu.sched.queryEstimateBytes", 0,
+    "Fixed HBM working-set estimate per query for admission control. "
+    "0 (default) derives batchSizeBytes x (concurrentTpuTasks + "
+    "scan.prefetch.depth), then refines per plan shape from the spill "
+    "catalog's device-bytes high-water mark of prior runs; "
+    "submit(estimate_bytes=...) overrides per query.", int)
+
+SCHED_PROFILE_RING = conf(
+    "spark.rapids.tpu.sched.profileRing", 64,
+    "How many completed QueryProfiles the session retains, keyed by "
+    "query id (concurrent collects no longer race one last-profile "
+    "slot; last_query_profile() returns the most recently COMPLETED "
+    "query's profile).", int)
+
 OBS_PROFILE_ENABLED = conf(
     "spark.rapids.tpu.obs.profile.enabled", True,
     "Assemble a QueryProfile after every action (annotated plan tree, "

@@ -21,6 +21,7 @@ from typing import Any, Dict, Iterator, List, Optional, Tuple
 
 from spark_rapids_tpu.obs import trace as _trace
 from spark_rapids_tpu.plan.logical import Schema
+from spark_rapids_tpu.sched import cancel as _cancel
 
 
 # ---------------------------------------------------------------------------
@@ -209,7 +210,13 @@ class TpuExec(PhysicalPlan):
 class _Timed:
     """Accumulates elapsed ns into ``metrics.total_time_ns`` and, when
     tracing is enabled and a span name was given, records the interval
-    as a span (obs/trace.py; the disabled path costs one bool check)."""
+    as a span (obs/trace.py; the disabled path costs one bool check).
+
+    Entry doubles as the engine's per-batch cooperative cancellation
+    checkpoint: every exec's batch loop opens ``timed`` around its
+    device work, so a fired CancelToken (sched/cancel.py) unwinds the
+    query here at batch granularity — one thread-local read + one bool
+    check when no cancellation is pending."""
 
     __slots__ = ("metrics", "name", "t0")
 
@@ -218,6 +225,7 @@ class _Timed:
         self.name = name
 
     def __enter__(self):
+        _cancel.check_current()
         self.t0 = time.perf_counter_ns()
         return self
 
@@ -240,6 +248,7 @@ class _TimedExtra:
         self.key = key
 
     def __enter__(self):
+        _cancel.check_current()   # prefetch-thread batch checkpoint
         self.t0 = time.perf_counter_ns()
         return self
 

@@ -36,6 +36,7 @@ import jax.numpy as jnp
 
 from spark_rapids_tpu.obs import registry as obsreg
 from spark_rapids_tpu.obs import trace as obstrace
+from spark_rapids_tpu.sched import cancel as _cancel
 
 _BLOCK = 1 << 15          # per-step scan length
 
@@ -84,6 +85,11 @@ class ScanPrefetcher:
         self._consumed = 0
         self._parts_done = 0
         self._pool: Optional[object] = None
+        # cancellation: capture the submitting query's token here (the
+        # prefetch pool's threads don't inherit thread-locals) and
+        # install it around every thunk — a cancelled query stops
+        # prepping/uploading look-ahead batches at the next checkpoint
+        self._token = _cancel.current()
         if self._thunks:
             self._pool = cf.ThreadPoolExecutor(
                 max_workers=self._depth,
@@ -96,12 +102,15 @@ class ScanPrefetcher:
                 self._fill_locked()
 
     def _run_thunk(self, i: int):
-        """Thunk wrapper: the prefetch work itself shows up in the
-        trace (prep+upload of batch i on the prefetch thread) and in
-        the registry's prefetch histogram."""
+        """Thunk wrapper: the thread inherits the query's CancelToken,
+        and the prefetch work itself shows up in the trace (prep+upload
+        of batch i on the prefetch thread) and in the registry's
+        prefetch histogram."""
         t0 = time.perf_counter_ns()
         try:
-            return self._thunks[i]()
+            with _cancel.install(self._token):
+                _cancel.check_current()
+                return self._thunks[i]()
         finally:
             dur = time.perf_counter_ns() - t0
             obstrace.record("scan.prefetch", t0, dur, cat="scan",
@@ -128,6 +137,7 @@ class ScanPrefetcher:
             self.close()
 
     def get(self, i: int):
+        _cancel.check_current()   # don't block on a cancelled query
         with self._lock:
             # out-of-order consumer past the window: submit through i
             while self._next <= i:

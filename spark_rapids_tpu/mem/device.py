@@ -20,25 +20,27 @@ from typing import Optional
 
 from spark_rapids_tpu.obs import registry as obsreg
 from spark_rapids_tpu.obs import trace as obstrace
+from spark_rapids_tpu.sched import cancel as _cancel
+from spark_rapids_tpu.sched.admission import TaskGate
 
 _LOCK = threading.Lock()
-_SEM: Optional[threading.Semaphore] = None
+_GATE: Optional[TaskGate] = None
 _SLOTS = 2
 
 
 def initialize(concurrent_tasks: int) -> None:
-    global _SEM, _SLOTS
+    global _GATE, _SLOTS
     with _LOCK:
         _SLOTS = max(1, int(concurrent_tasks))
-        _SEM = threading.BoundedSemaphore(_SLOTS)
+        _GATE = TaskGate(_SLOTS)
 
 
-def _get() -> threading.Semaphore:
-    global _SEM
+def _get() -> TaskGate:
+    global _GATE
     with _LOCK:
-        if _SEM is None:
-            _SEM = threading.BoundedSemaphore(_SLOTS)
-        return _SEM
+        if _GATE is None:
+            _GATE = TaskGate(_SLOTS)
+        return _GATE
 
 
 @contextlib.contextmanager
@@ -52,16 +54,33 @@ def tpu_semaphore(metrics=None):
     bookkeeping cost: a non-blocking acquire, a clock read, and ONE
     registry-lock dict update (plus the caller's Metrics lock when
     passed) — sub-microsecond against the multi-ms device dispatches
-    the semaphore gates."""
-    sem = _get()
-    wait_ns = 0
-    if not sem.acquire(blocking=False):
-        t0 = time.perf_counter_ns()
-        sem.acquire()
-        wait_ns = time.perf_counter_ns() - t0
-        obstrace.record("semaphore.wait", t0, wait_ns, cat="semaphore")
+    the semaphore gates.
+
+    The slot source is the scheduler's re-entrant
+    :class:`~spark_rapids_tpu.sched.admission.TaskGate`: a thread that
+    already holds a slot (scan prefetch finishing under an exchange)
+    re-enters for FREE — no second slot (which deadlocked at 1 slot)
+    and no double-counted blocked-ns; re-entries count into
+    ``semaphore.reentries`` instead of ``semaphore.acquires``.  A
+    cancelled query raises at the acquire instead of taking (or
+    waiting on) a slot."""
+    _cancel.check_current()
+    gate = _get()
+    wait_ns, reentrant = gate.acquire()
     reg = obsreg.get_registry()
+    if reentrant:
+        reg.inc("semaphore.reentries")
+        if metrics is not None:
+            metrics.add_extra("semaphore.reentries", 1)
+        try:
+            yield
+        finally:
+            gate.release()
+        return
     if wait_ns:
+        obstrace.record("semaphore.wait",
+                        time.perf_counter_ns() - wait_ns, wait_ns,
+                        cat="semaphore")
         reg.inc_many(("semaphore.acquires", 1),
                      ("semaphore.waitNs", wait_ns))
     else:
@@ -73,7 +92,7 @@ def tpu_semaphore(metrics=None):
     try:
         yield
     finally:
-        sem.release()
+        gate.release()
 
 
 class TpuDeviceManager:

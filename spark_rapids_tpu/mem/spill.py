@@ -125,6 +125,7 @@ class BufferCatalog:
         self.host_bytes = 0
         self.spilled_device_bytes = 0  # metrics (memoryBytesSpilled analog)
         self.spilled_disk_bytes = 0
+        self._hwm_trackers: List["HighWaterTracker"] = []
 
     # -- registration ------------------------------------------------------
     def register(self, batch: DeviceBatch,
@@ -135,10 +136,32 @@ class BufferCatalog:
             buf = _Buffer(bid, batch, priority)
             self._buffers[bid] = buf
             self.device_bytes += buf.size
+            self._note_device_bytes_locked()
         obsreg.get_registry().gauge_max("spill.deviceBytesHwm",
                                         self.device_bytes)
         self._maybe_spill()
         return SpillableBatch(self, bid)
+
+    # -- per-window device-bytes high water (admission refinement) ---------
+    def _note_device_bytes_locked(self) -> None:
+        for t in self._hwm_trackers:
+            t._note(self.device_bytes)
+
+    def track_high_water(self) -> "HighWaterTracker":
+        """Open a device-bytes high-water window (the scheduler's
+        estimate-refinement probe: one per running query).  Under
+        concurrency the window sees OTHER queries' registered bytes too
+        — a conservative over-estimate, which is the safe direction for
+        admission control."""
+        with self._lock:
+            t = HighWaterTracker(self, self.device_bytes)
+            self._hwm_trackers.append(t)
+            return t
+
+    def _end_high_water(self, t: "HighWaterTracker") -> None:
+        with self._lock:
+            if t in self._hwm_trackers:
+                self._hwm_trackers.remove(t)
 
     # -- spill logic -------------------------------------------------------
     def _spill_candidates(self) -> List[_Buffer]:
@@ -240,6 +263,7 @@ class BufferCatalog:
         with self._lock:
             self.host_bytes -= nbytes
             self.device_bytes += buf.size
+            self._note_device_bytes_locked()
         self._maybe_spill()
         return batch
 
@@ -276,6 +300,40 @@ class BufferCatalog:
             elif buf.disk_path and os.path.exists(buf.disk_path):
                 os.unlink(buf.disk_path)
             buf.device_batch = None
+
+
+class HighWaterTracker:
+    """One device-bytes high-water window over the catalog (see
+    :meth:`BufferCatalog.track_high_water`)."""
+
+    __slots__ = ("_catalog", "_start", "_peak", "_closed")
+
+    def __init__(self, catalog: "BufferCatalog", start_bytes: int):
+        self._catalog = catalog
+        self._start = start_bytes
+        self._peak = start_bytes
+        self._closed = False
+
+    def _note(self, device_bytes: int) -> None:
+        if device_bytes > self._peak:
+            self._peak = device_bytes
+
+    def peak(self) -> int:
+        return self._peak
+
+    def delta(self) -> int:
+        """Peak GROWTH over the window (peak - start): what this
+        query's run added on top of whatever was already resident
+        (cached blobs, other queries' working sets) — the admission
+        estimate refines on this, not the absolute catalog peak, so a
+        cheap query that merely ran next to a heavyweight one is not
+        booked at the neighbour's footprint."""
+        return self._peak - self._start
+
+    def close(self) -> None:
+        if not self._closed:
+            self._closed = True
+            self._catalog._end_high_water(self)
 
 
 class SpillableBatch:
@@ -426,6 +484,21 @@ def hbm_oom_recover(e: BaseException) -> bool:
     cat = get_catalog()
     freed = cat.spill_to_fit(1 << 62)     # evict the whole device tier
     return freed > 0
+
+
+def handle_memory_pressure(bytes_needed: int) -> int:
+    """Admission-control memory-pressure hook: when the scheduler
+    admits a query into the top of the memory budget, proactively
+    spill lowest-priority registered device batches so real HBM backs
+    the newly admitted estimate (the DeviceMemoryEventHandler role,
+    driven from admission instead of an alloc failure).  Returns bytes
+    freed; a no-op while spill is disabled."""
+    if not is_enabled() or bytes_needed <= 0:
+        return 0
+    freed = get_catalog().spill_to_fit(int(bytes_needed))
+    if freed:
+        obsreg.get_registry().inc("spill.pressureSpills")
+    return freed
 
 
 def get_catalog() -> BufferCatalog:

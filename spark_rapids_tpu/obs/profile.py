@@ -30,7 +30,7 @@ from spark_rapids_tpu.obs import trace as obstrace
 # acceptance contract is "includes scan, shuffle, semaphore, and spill
 # sections" whether or not the query touched them
 SECTIONS = ("scan", "shuffle", "semaphore", "spill", "pyworker",
-            "fusion")
+            "fusion", "sched")
 
 
 @dataclass
@@ -190,8 +190,10 @@ def _breakdown(plan: Optional[ExecNodeProfile],
                     fused += n.time_ns / 1e9
     sem = sections.get("semaphore", {})
     spill = sections.get("spill", {})
+    sched = sections.get("sched", {})
     return {
         "wall_s": wall_ns / 1e9,
+        "queue_wait_s": sched.get("sched.queueWaitNs", 0) / 1e9,
         "host_prep_s": host_prep,
         "upload_s": upload,
         "dispatch_s": dispatch,
@@ -226,16 +228,29 @@ class _Phase:
 class QueryRun:
     """Per-query capture opened by the session before planning."""
 
-    def __init__(self, query_id: int):
+    def __init__(self, query_id: int,
+                 sched_extra: Optional[Dict[str, Any]] = None):
         self.query_id = query_id
         self.phases: Dict[str, int] = {}
         # the session stashes the planner's OverrideResult here as soon
         # as planning succeeds, so a mid-execution failure still
         # profiles the plan (the on_failure contract carries the tree)
         self.planned = None
+        # scheduler attribution (queue wait, admission estimate,
+        # priority) — recorded by the QueryService BEFORE this run
+        # opened its registry view, so it rides the profile explicitly
+        # instead of the (later) per-query delta carve
+        self.sched_extra: Dict[str, Any] = dict(sched_extra or {})
         self._view = obsreg.get_registry().view()
         self._span_mark = obstrace.mark()
         self._t0 = time.perf_counter_ns()
+        wait = self.sched_extra.get("sched.queueWaitNs", 0)
+        if wait:
+            # re-record the pre-execution queue wait inside this
+            # query's span window, so its trace shows the wait
+            obstrace.record("sched.queueWait", self._t0 - int(wait),
+                            int(wait), cat="sched",
+                            args={"query": query_id})
 
     def phase(self, name: str) -> _Phase:
         return _Phase(self, name)
@@ -255,6 +270,12 @@ class QueryRun:
                 explain_lines = result.meta.explain_lines(all_=True)
         delta = self._view.delta()
         sections = _sectioned(delta)
+        if self.sched_extra:
+            sec = sections.setdefault("sched", {})
+            for k, v in self.sched_extra.items():
+                sec[k] = v
+                if isinstance(v, (int, float)) and k.endswith("Ns"):
+                    sec[k + "_s"] = v / 1e9
         # arena / spill high-water marks ride the spill section
         with contextlib.suppress(Exception):
             from spark_rapids_tpu.mem import spill as spillmod

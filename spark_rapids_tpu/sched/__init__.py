@@ -1,0 +1,28 @@
+"""Concurrent query scheduler: async submission, memory-aware
+admission control, cooperative cancellation.
+
+Layers (each its own module):
+
+* :mod:`~spark_rapids_tpu.sched.cancel` — per-query
+  :class:`CancelToken` + the thread-local checkpoint the exec hot
+  paths poll.
+* :mod:`~spark_rapids_tpu.sched.queue` — priority + FIFO wait queue.
+* :mod:`~spark_rapids_tpu.sched.admission` — memory-budget admission
+  (``sched.memoryBudget`` / ``sched.maxConcurrent``) with plan-shape
+  estimate refinement, plus the re-entrant device :class:`TaskGate`
+  behind ``mem/device.tpu_semaphore``.
+* :mod:`~spark_rapids_tpu.sched.service` — per-session
+  :class:`QueryService`: ``submit() -> QueryFuture``, deadlines,
+  profile attachment; ``DataFrame.collect()`` == ``submit().result()``.
+"""
+
+from spark_rapids_tpu.sched.admission import (AdmissionController,  # noqa
+                                              AdmissionRequest,
+                                              EstimateBook,
+                                              QueryRejectedError,
+                                              TaskGate, plan_shape_key)
+from spark_rapids_tpu.sched.cancel import (CancelToken,  # noqa
+                                           QueryCancelledError,
+                                           QueryTimeoutError)
+from spark_rapids_tpu.sched.service import (QueryFuture,  # noqa
+                                            QueryService, QueryState)

@@ -1,0 +1,297 @@
+"""Per-session concurrent query execution service.
+
+``QueryService.submit(plan)`` returns a :class:`QueryFuture`
+immediately; the query runs on its own daemon thread once the
+memory-aware :class:`~spark_rapids_tpu.sched.admission
+.AdmissionController` admits it.  ``DataFrame.collect()`` is now
+literally ``submit().result()`` and ``DataFrame.collect_async()``
+exposes the future — the execution-service layer between the API and
+the exec layer the ROADMAP's multi-tenant north star hangs off.
+
+Lifecycle of one query::
+
+    submit -> QUEUED --admission--> RUNNING --+--> SUCCESS (result+profile)
+        |         |                           +--> FAILED  (exception)
+        |         +--> TIMED_OUT / CANCELLED (unwound via CancelToken
+        |              checkpoints: admission slot released, prefetcher
+        +--> rejected  drained, shuffle fetches cancelled, spill-catalog
+                       entries freed)
+
+Deadlines: ``sched.defaultTimeoutMs`` (0 = none) or the per-submit
+``timeout_ms`` arm a ``threading.Timer`` that fires the query's
+CancelToken with ``timed_out=True`` — one mechanism covers both a
+query stuck in the wait queue and one already running.
+
+Nested execution: a collect issued from INSIDE a running query (a
+listener, user code in a pandas UDF callback) executes inline under the
+parent's admission slot and token — re-admitting it would deadlock a
+``maxConcurrent=1`` engine on its own child.
+"""
+
+from __future__ import annotations
+
+import enum
+import threading
+import time
+from typing import Any, Dict, Optional
+
+from spark_rapids_tpu.obs import registry as obsreg
+from spark_rapids_tpu.sched import cancel as _cancel
+from spark_rapids_tpu.sched.admission import (AdmissionController,
+                                              AdmissionRequest,
+                                              EstimateBook,
+                                              plan_shape_key)
+
+
+class QueryState(enum.Enum):
+    QUEUED = "queued"
+    RUNNING = "running"
+    SUCCESS = "success"
+    FAILED = "failed"
+    CANCELLED = "cancelled"
+    TIMED_OUT = "timed_out"
+
+
+class QueryFuture:
+    """Handle to one submitted query.
+
+    ``result(timeout)`` blocks for completion and re-raises the query's
+    own exception; a ``timeout`` elapsing raises the stdlib
+    :class:`TimeoutError` WITHOUT cancelling the query (call
+    ``cancel()`` for that).  ``profile`` carries the QueryProfile once
+    the query completes (None while running or when
+    ``obs.profile.enabled=false``)."""
+
+    def __init__(self, query_id: int, token: _cancel.CancelToken):
+        self.query_id = query_id
+        self.token = token
+        self._cond = threading.Condition()
+        self._state = QueryState.QUEUED
+        self._result = None
+        self._error: Optional[BaseException] = None
+        self.profile = None
+        self.queue_wait_ns = 0
+
+    # -- inspection ----------------------------------------------------------
+    @property
+    def state(self) -> QueryState:
+        with self._cond:
+            return self._state
+
+    def done(self) -> bool:
+        with self._cond:
+            return self._state not in (QueryState.QUEUED,
+                                       QueryState.RUNNING)
+
+    def cancelled(self) -> bool:
+        with self._cond:
+            return self._state in (QueryState.CANCELLED,
+                                   QueryState.TIMED_OUT)
+
+    # -- control -------------------------------------------------------------
+    def cancel(self, reason: str = "cancelled by user") -> bool:
+        """Fire the query's CancelToken.  True when the query had not
+        completed yet (cancellation will take effect at its next
+        checkpoint); False when it already finished."""
+        if self.done():
+            return False
+        self.token.cancel(reason)
+        return True
+
+    def result(self, timeout: Optional[float] = None):
+        with self._cond:
+            if not self._cond.wait_for(self.done, timeout=timeout):
+                raise TimeoutError(
+                    f"query {self.query_id} still "
+                    f"{self._state.value} after {timeout}s")
+            if self._error is not None:
+                raise self._error
+            return self._result
+
+    def exception(self, timeout: Optional[float] = None
+                  ) -> Optional[BaseException]:
+        with self._cond:
+            if not self._cond.wait_for(self.done, timeout=timeout):
+                raise TimeoutError(
+                    f"query {self.query_id} still "
+                    f"{self._state.value} after {timeout}s")
+            return self._error
+
+    # -- service side --------------------------------------------------------
+    def _set_running(self) -> None:
+        with self._cond:
+            if self._state is QueryState.QUEUED:
+                self._state = QueryState.RUNNING
+
+    def _finish(self, state: QueryState, result=None,
+                error: Optional[BaseException] = None,
+                profile=None) -> None:
+        with self._cond:
+            self._state = state
+            self._result = result
+            self._error = error
+            if profile is not None:
+                self.profile = profile
+            self._cond.notify_all()
+
+
+class QueryService:
+    """One per TpuSparkSession (see module docstring)."""
+
+    def __init__(self, session):
+        from spark_rapids_tpu import config as cfg
+        self._session = session
+        conf = session.conf
+        budget = int(conf.get(cfg.SCHED_MEMORY_BUDGET))
+        if budget <= 0:
+            budget = self._derived_budget()
+        self.memory_budget = budget
+        self.max_concurrent = int(conf.get(cfg.SCHED_MAX_CONCURRENT))
+        self.default_timeout_ms = int(
+            conf.get(cfg.SCHED_DEFAULT_TIMEOUT_MS))
+        self._default_estimate = int(
+            conf.get(cfg.SCHED_QUERY_ESTIMATE_BYTES))
+        from spark_rapids_tpu.mem import spill
+        self.controller = AdmissionController(
+            budget, self.max_concurrent,
+            max_queued=int(conf.get(cfg.SCHED_MAX_QUEUED)),
+            pressure_cb=spill.handle_memory_pressure)
+        self.book = EstimateBook()
+        self._tls = threading.local()
+
+    @staticmethod
+    def _derived_budget() -> int:
+        """Default budget: the device manager's HBM pool (XLA's
+        bytes_limit x pool fraction; 8 GiB when the backend reports no
+        limit — the CPU test platform)."""
+        try:
+            from spark_rapids_tpu.mem.device import TpuDeviceManager
+            return int(TpuDeviceManager.get().hbm_budget)
+        except Exception:
+            return 8 << 30
+
+    # -- estimates -----------------------------------------------------------
+    def _estimate(self, plan, explicit: Optional[int]) -> int:
+        """Working-set estimate in bytes: explicit per-submit override >
+        refined observation for this plan shape > conservative
+        derivation (batch size x concurrent scan/shuffle depth), all
+        capped at the budget so a single query always remains
+        admissible."""
+        from spark_rapids_tpu import config as cfg
+        if explicit is not None:
+            return min(max(0, int(explicit)), self.memory_budget)
+        if self._default_estimate > 0:
+            # an operator-pinned fixed estimate beats refinement
+            return min(self._default_estimate, self.memory_budget)
+        refined = self.book.estimate(plan_shape_key(plan))
+        if refined is not None:
+            return min(refined, self.memory_budget)
+        conf = self._session.conf
+        depth = (int(conf.get(cfg.CONCURRENT_TPU_TASKS)) +
+                 int(conf.get(cfg.SCAN_PREFETCH_DEPTH)))
+        derived = int(conf.get(cfg.BATCH_SIZE_BYTES)) * max(1, depth)
+        return min(derived, self.memory_budget)
+
+    def _observe(self, plan, hwm_bytes: int) -> None:
+        self.book.record(plan_shape_key(plan), hwm_bytes)
+
+    # -- submission ----------------------------------------------------------
+    def submit(self, plan, priority: int = 0,
+               timeout_ms: Optional[int] = None,
+               estimate_bytes: Optional[int] = None) -> QueryFuture:
+        reg = obsreg.get_registry()
+        qid = self._session._next_query_id()
+        # nested collect inside a running query: execute inline under
+        # the parent's slot/token (re-admission would self-deadlock)
+        if getattr(self._tls, "in_query", False):
+            tok = _cancel.current() or _cancel.CancelToken(qid)
+            fut = QueryFuture(qid, tok)
+            fut._set_running()
+            try:
+                table, prof = self._session._execute_attributed(
+                    plan, query_id=qid, sched_extra={"sched.nested": 1})
+            except BaseException as e:
+                fut._finish(QueryState.FAILED, error=e,
+                            profile=self._session.query_profile(qid))
+                raise
+            fut._finish(QueryState.SUCCESS, result=table, profile=prof)
+            return fut
+        reg.inc("sched.submitted")
+        token = _cancel.CancelToken(qid)
+        fut = QueryFuture(qid, token)
+        req = AdmissionRequest(
+            qid, self._estimate(plan, estimate_bytes),
+            priority=priority, token=token)
+        ms = self.default_timeout_ms if timeout_ms is None \
+            else int(timeout_ms)
+        timer = None
+        if ms and ms > 0:
+            timer = threading.Timer(
+                ms / 1e3, token.cancel,
+                kwargs={"reason": f"deadline {ms}ms exceeded",
+                        "timed_out": True})
+            timer.daemon = True
+            timer.start()
+        t = threading.Thread(target=self._run,
+                             args=(fut, plan, req, timer),
+                             name=f"sched-q{qid}", daemon=True)
+        t.start()
+        return fut
+
+    # -- the worker ----------------------------------------------------------
+    def _run(self, fut: QueryFuture, plan, req: AdmissionRequest,
+             timer) -> None:
+        reg = obsreg.get_registry()
+        self._tls.in_query = True
+        tracker = None
+        try:
+            try:
+                slot = self.controller.acquire(req)
+            except _cancel.QueryCancelledError as e:
+                fut._finish(QueryState.TIMED_OUT
+                            if isinstance(e, _cancel.QueryTimeoutError)
+                            else QueryState.CANCELLED, error=e)
+                return
+            except BaseException as e:   # rejected / internal
+                fut._finish(QueryState.FAILED, error=e)
+                return
+            fut.queue_wait_ns = req.queue_wait_ns
+            fut._set_running()
+            sched_extra = {
+                "sched.queueWaitNs": req.queue_wait_ns,
+                "sched.estimateBytes": req.estimate,
+                "sched.priority": req.priority,
+            }
+            try:
+                from spark_rapids_tpu.mem import spill
+                if spill.is_enabled():
+                    tracker = spill.get_catalog().track_high_water()
+                with slot, _cancel.install(fut.token):
+                    table, prof = self._session._execute_attributed(
+                        plan, query_id=fut.query_id,
+                        sched_extra=sched_extra)
+            except _cancel.QueryCancelledError as e:
+                timed = isinstance(e, _cancel.QueryTimeoutError) or \
+                    fut.token.timed_out
+                reg.inc("sched.timedOut" if timed else "sched.cancelled")
+                fut._finish(QueryState.TIMED_OUT if timed
+                            else QueryState.CANCELLED, error=e,
+                            profile=self._session.query_profile(
+                                fut.query_id))
+                return
+            except BaseException as e:
+                reg.inc("sched.failed")
+                fut._finish(QueryState.FAILED, error=e,
+                            profile=self._session.query_profile(
+                                fut.query_id))
+                return
+            reg.inc("sched.completed")
+            if tracker is not None:
+                self._observe(plan, tracker.delta())
+            fut._finish(QueryState.SUCCESS, result=table, profile=prof)
+        finally:
+            if tracker is not None:
+                tracker.close()
+            if timer is not None:
+                timer.cancel()
+            self._tls.in_query = False

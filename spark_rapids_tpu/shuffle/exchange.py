@@ -50,6 +50,7 @@ from spark_rapids_tpu.exec.cpu import concat_tables, _empty_table
 from spark_rapids_tpu.expr import eval_cpu, eval_tpu, ir
 from spark_rapids_tpu.expr.eval_tpu import ColVal
 from spark_rapids_tpu.plan.logical import Schema, SortOrder
+from spark_rapids_tpu.sched import cancel as _cancel
 from spark_rapids_tpu.shuffle.serializer import (deserialize_table,
                                                  get_codec, serialize_table)
 
@@ -580,6 +581,7 @@ class TpuShuffleExchangeExec(TpuExec):
         for map_id, it in shares:
             rows_seen = 0
             for batch in it:
+                _cancel.check_current()  # per-batch map-side checkpoint
                 if not int(batch.num_rows):
                     continue
                 reordered, counts = self._partition_one(batch, rows_seen)
@@ -754,12 +756,15 @@ class TpuShuffleExchangeExec(TpuExec):
                 sid = next(self._process_sids)
                 with timed(self.metrics, "exchange.mapStages"):
                     # map stages run concurrently across the fleet; each
-                    # handle's pipe is independent
+                    # handle's pipe is independent; the submit threads
+                    # inherit this query's CancelToken explicitly
                     results: List[Any] = [None] * n_execs
+                    tok = _cancel.current()
 
                     def run(e):
                         try:
-                            results[e] = submit(pool, e, sid)
+                            with _cancel.install(tok):
+                                results[e] = submit(pool, e, sid)
                         except BaseException as ex:
                             results[e] = ex
                     ts = [threading.Thread(target=run, args=(e,))
@@ -1062,6 +1067,7 @@ class TpuShuffleExchangeExec(TpuExec):
             m = 0
             rows_seen = 0
             for batch in self._input_batches():
+                _cancel.check_current()  # per-batch map-side checkpoint
                 reordered, counts = self._partition_one(batch, rows_seen)
                 rows_seen += int(batch.num_rows)
                 off = 0

@@ -33,6 +33,7 @@ import pyarrow as pa
 
 from spark_rapids_tpu.columnar.batch import to_arrow
 from spark_rapids_tpu.mem.device import tpu_semaphore
+from spark_rapids_tpu.sched import cancel as _cancel
 from spark_rapids_tpu.shuffle import faults
 from spark_rapids_tpu.shuffle.catalogs import (ShuffleBufferCatalog,
                                                ShuffleReceivedBufferCatalog)
@@ -195,18 +196,35 @@ class RapidsShuffleIterator:
             issue(p)
         outstanding = len(peers)
 
+        # cancellation wake-up: a fired CancelToken pushes a sentinel
+        # into the completion queue so a reader blocked in q.get() stops
+        # immediately instead of riding out the progress timeout; the
+        # drain loop then aborts (FetchHandle.cancel per peer + freeing
+        # every received-but-unyielded catalog buffer) and re-raises
+        token = _cancel.current()
+        waker = None
+        if token is not None:
+            def waker() -> None:
+                q.put(("cancel", None, None, None))
+            token.add_callback(waker)
         try:
             yield from self._drain_remote(q, peers, outstanding, alive,
-                                          retry, abort, backoff, stats)
+                                          retry, abort, backoff, stats,
+                                          token)
         finally:
+            if token is not None and waker is not None:
+                token.remove_callback(waker)
             # every exit — completion, error, or an abandoned read
             # (GeneratorExit) — cancels what's still in flight and frees
             # undelivered buffers; a no-op after a clean drain
             abort()
 
     def _drain_remote(self, q, peers, outstanding, alive, retry, abort,
-                      backoff, stats) -> Iterator[pa.Table]:
+                      backoff, stats, token=None) -> Iterator[pa.Table]:
         while outstanding > 0:
+            if token is not None and token.is_cancelled:
+                abort()
+                token.check()
             try:
                 kind, a, err, epoch = q.get(timeout=self.timeout_s)
             except queue.Empty:
@@ -225,6 +243,13 @@ class RapidsShuffleIterator:
                     f"shuffle {self.shuffle_id} reduce {self.reduce_id}: "
                     f"no progress for {self.timeout_s}s "
                     f"({outstanding} peers outstanding)")
+            if kind == "cancel":
+                abort()
+                if token is not None:
+                    token.check()
+                raise _cancel.QueryCancelledError(
+                    f"shuffle {self.shuffle_id} reduce "
+                    f"{self.reduce_id}: read cancelled")
             if kind == "done":
                 p = peers[a]
                 if epoch != p.attempts or p.done:

@@ -1,0 +1,752 @@
+"""Concurrent query scheduler: async submission, memory-aware
+admission, priority, deadlines, cooperative cancellation.
+
+Covers the sched/ subsystem end to end: submit parity vs blocking
+collect, the admission controller's budget math (small budget =>
+serialized, large => overlapped, via the ``sched.running`` high-water
+gauge), priority + FIFO ordering, deadline timeouts that free their
+slots, and leak-free cancellation before admission / mid-scan /
+mid-shuffle (including the PR-1 fault-injection points for an
+in-flight TCP fetch).
+"""
+
+import threading
+import time
+
+import pyarrow as pa
+import pytest
+
+from spark_rapids_tpu import TpuSparkSession, col, functions as F
+from spark_rapids_tpu.mem import device as devmgr
+from spark_rapids_tpu.mem import spill
+from spark_rapids_tpu.obs import registry as obsreg
+from spark_rapids_tpu.sched import cancel as sched_cancel
+from spark_rapids_tpu.sched.admission import (EstimateBook, TaskGate,
+                                              plan_shape_key)
+from spark_rapids_tpu.sched.cancel import (CancelToken,
+                                           QueryCancelledError,
+                                           QueryTimeoutError)
+from spark_rapids_tpu.sched.queue import WaitEntry, WaitQueue
+from spark_rapids_tpu.sched.service import QueryState
+from spark_rapids_tpu.shuffle import faults
+
+
+@pytest.fixture(autouse=True)
+def _fresh_sched_state():
+    """Gauges like sched.runningHwm are process-lifetime high waters;
+    admission assertions need a clean registry."""
+    obsreg.reset_registry()
+    faults.set_fault_plan(None)
+    faults.reset_fault_stats()
+    yield
+    obsreg.reset_registry()
+    faults.set_fault_plan(None)
+    faults.reset_fault_stats()
+
+
+def _session(extra=None):
+    conf = {"spark.rapids.tpu.sql.variableFloatAgg.enabled": True}
+    conf.update(extra or {})
+    return TpuSparkSession(conf)
+
+
+def _df(s, n=400, parts=2, tag="v"):
+    return s.create_dataframe(
+        {"k": [i % 7 for i in range(n)],
+         "x": [float(i % 50) for i in range(n)]},
+        num_partitions=parts).with_column(tag, col("x") * 2.0)
+
+
+def _query(s, n=400, tag="v"):
+    # the tag rides the output schema (agg alias) so the Parker's
+    # admission-order log can tell queries apart
+    return (_df(s, n, tag=tag).filter(col("x") > 3.0)
+            .group_by("k").agg(F.sum(tag).alias("c"),
+                               F.count("*").alias(tag)).sort("k"))
+
+
+class Parker:
+    """Plan listener that parks queries at plan time — inside the
+    admitted window — until released.  Cancellation-aware: a fired
+    CancelToken unparks immediately so the query unwinds at its next
+    checkpoint.  Records admission order by each plan's output tag."""
+
+    def __init__(self, park=True):
+        self.park = park
+        self.order = []
+        self.release = threading.Event()
+        self.parked = threading.Semaphore(0)
+        self._lock = threading.Lock()
+
+    def __call__(self, result):
+        with self._lock:
+            self.order.append(result.plan.schema.names[-1])
+        if not self.park:
+            return
+        self.parked.release()
+        tok = sched_cancel.current()
+        deadline = time.time() + 30
+        while not self.release.is_set() and time.time() < deadline:
+            if tok is not None and tok.is_cancelled:
+                return
+            time.sleep(0.005)
+
+
+def _assert_clean(s):
+    """No leaked admission slots / queue entries / device-gate slots."""
+    stats = s.scheduler.controller.stats()
+    assert stats["running"] == 0, stats
+    assert stats["queued"] == 0, stats
+    assert stats["admitted_bytes"] == 0, stats
+    gate = devmgr._get()
+    assert gate.available() == gate.slots
+
+
+# ---------------------------------------------------------------------------
+# unit layers
+# ---------------------------------------------------------------------------
+
+def test_wait_queue_priority_then_fifo():
+    q = WaitQueue()
+    a, b, c, d = (WaitEntry(0, "a"), WaitEntry(5, "b"),
+                  WaitEntry(5, "c"), WaitEntry(0, "d"))
+    for e in (a, b, c, d):
+        q.push(e)
+    assert len(q) == 4
+    q.remove(c)  # lazy removal skipped at peek
+    order = []
+    while q:
+        order.append(q.pop_head().payload)
+    assert order == ["b", "a", "d"]  # priority 5 first, FIFO within 0
+
+
+def test_cancel_token_checkpoints_and_callbacks():
+    tok = CancelToken(query_id=7)
+    fired = []
+    tok.add_callback(lambda: fired.append(1))
+    with sched_cancel.install(tok):
+        sched_cancel.check_current()       # not cancelled: no raise
+        assert tok.cancel("stop") is True
+        assert tok.cancel("again") is False  # idempotent
+        assert fired == [1]
+        with pytest.raises(QueryCancelledError):
+            sched_cancel.check_current()
+    # late registration on a fired token runs immediately
+    tok.add_callback(lambda: fired.append(2))
+    assert fired == [1, 2]
+    # timeout flavor raises the precise subclass
+    t2 = CancelToken()
+    t2.cancel("deadline", timed_out=True)
+    with pytest.raises(QueryTimeoutError):
+        t2.check()
+    assert sched_cancel.current() is None  # install() restored
+
+
+def test_estimate_book_refines_and_pads():
+    book = EstimateBook(max_entries=2)
+    assert book.estimate("shape-a") is None
+    book.record("shape-a", 100 << 20)
+    # a lower observation decays halfway instead of being ignored: one
+    # inflated run (a heavyweight neighbour in the same window) must
+    # not pin the shape's estimate forever
+    book.record("shape-a", 80 << 20)
+    est = book.estimate("shape-a")
+    assert est == int((90 << 20) * EstimateBook.HEADROOM)
+    book.record("shape-a", 120 << 20)  # a new high is taken as-is
+    assert book.estimate("shape-a") == int(
+        (120 << 20) * EstimateBook.HEADROOM)
+    book.record("tiny", 1)             # floor applies
+    assert book.estimate("tiny") == EstimateBook.FLOOR
+    book.record("shape-c", 5 << 20)    # LRU eviction at 2 entries
+    assert len(book) == 2
+
+
+def test_plan_shape_key_structural():
+    s = _session()
+    k1 = plan_shape_key(_query(s, n=100).plan)
+    k2 = plan_shape_key(_query(s, n=300).plan)   # same shape, more rows
+    k3 = plan_shape_key(_df(s).plan)             # different shape
+    assert k1 == k2
+    assert k1 != k3
+
+
+# ---------------------------------------------------------------------------
+# tpu_semaphore re-entrancy (satellite regression)
+# ---------------------------------------------------------------------------
+
+def test_semaphore_reentrant_same_thread_no_deadlock():
+    devmgr.initialize(1)   # one slot: a second real acquire would hang
+    try:
+        from spark_rapids_tpu.exec.base import Metrics
+        m = Metrics()
+        with devmgr.tpu_semaphore(m):
+            with devmgr.tpu_semaphore(m):    # scan-under-exchange shape
+                with devmgr.tpu_semaphore(m):
+                    pass
+        assert m.extra.get("semaphore.acquires") == 1
+        assert m.extra.get("semaphore.reentries") == 2
+        gate = devmgr._get()
+        assert gate.available() == 1         # fully released
+    finally:
+        devmgr.initialize(2)
+
+
+def test_semaphore_reentry_blocked_ns_not_double_counted():
+    """A re-entering holder must not log blocked-ns even while another
+    thread is genuinely waiting on the slot."""
+    devmgr.initialize(1)
+    try:
+        from spark_rapids_tpu.exec.base import Metrics
+        holder = Metrics()
+        waiter = Metrics()
+        entered = threading.Event()
+        release = threading.Event()
+
+        def hold():
+            with devmgr.tpu_semaphore(holder):
+                entered.set()
+                release.wait(10)
+                with devmgr.tpu_semaphore(holder):   # re-entry under
+                    time.sleep(0.05)                 # contention
+
+        def wait_for_slot():
+            entered.wait(10)
+            with devmgr.tpu_semaphore(waiter):
+                pass
+
+        th, tw = (threading.Thread(target=hold),
+                  threading.Thread(target=wait_for_slot))
+        th.start(); tw.start()
+        time.sleep(0.15)          # let the waiter block on the slot
+        release.set()
+        th.join(10); tw.join(10)
+        assert holder.extra.get("semaphore.waitNs", 0) == 0
+        assert holder.extra.get("semaphore.reentries") == 1
+        assert waiter.extra.get("semaphore.waitNs", 0) > 0
+    finally:
+        devmgr.initialize(2)
+
+
+def test_taskgate_acquire_cancellable_while_blocked():
+    gate = TaskGate(1)
+    gate.acquire()
+    tok = CancelToken()
+    errs = []
+
+    def blocked():
+        with sched_cancel.install(tok):
+            try:
+                gate.acquire()
+            except QueryCancelledError as e:
+                errs.append(e)
+
+    t = threading.Thread(target=blocked)
+    t.start()
+    time.sleep(0.1)
+    tok.cancel("stop waiting")
+    t.join(5)
+    assert not t.is_alive() and len(errs) == 1
+    gate.release()
+    assert gate.available() == 1
+
+
+# ---------------------------------------------------------------------------
+# async submission + parity
+# ---------------------------------------------------------------------------
+
+def test_async_submit_parity_vs_blocking_collect():
+    s = _session()
+    q = _query(s)
+    blocking = q.collect()
+    fut = q.collect_async()
+    assert fut.result(timeout=120).equals(blocking)
+    assert fut.done() and fut.state is QueryState.SUCCESS
+    assert fut.cancel() is False          # too late to cancel
+    assert fut.profile is not None
+    assert fut.profile.query_id == fut.query_id
+    _assert_clean(s)
+
+
+def test_future_result_timeout_does_not_cancel():
+    s = _session({"spark.rapids.tpu.sched.maxConcurrent": 1})
+    parker = Parker()
+    s.add_plan_listener(parker)
+    fut = _query(s).collect_async()
+    try:
+        assert parker.parked.acquire(timeout=20)
+        with pytest.raises(TimeoutError):
+            fut.result(timeout=0.05)      # non-cancelling wait
+        assert not fut.done()
+    finally:
+        parker.release.set()
+    assert fut.result(timeout=120).num_rows > 0
+    _assert_clean(s)
+
+
+def test_profile_ring_under_concurrent_collects():
+    s = _session({"spark.rapids.tpu.sched.maxConcurrent": 4})
+    futs = [_query(s, n=200 + 40 * i).collect_async() for i in range(4)]
+    for f in futs:
+        f.result(timeout=180)
+    # every query's profile is retrievable by id (no last-slot race)
+    for f in futs:
+        prof = s.query_profile(f.query_id)
+        assert prof is not None and prof.query_id == f.query_id
+        assert f.profile is prof
+    # the last-completed profile is one of the completed ones
+    assert s.last_query_profile().query_id in {f.query_id for f in futs}
+    _assert_clean(s)
+
+
+def test_concurrency_smoke_serial_vs_concurrent_bit_identical():
+    """The ci.sh concurrency-smoke contract: N=8 mixed queries,
+    serial first, then all submitted at once via collect_async under
+    maxConcurrent=3 — results bit-identical, no deadlock (bounded
+    waits), queue wait attributed in at least one profile."""
+    s = _session({"spark.rapids.tpu.sched.maxConcurrent": 3})
+
+    def q_agg(n, tag):
+        return _query(s, n=n, tag=tag)
+
+    def q_shuffle(n, tag):
+        return (_df(s, n=n, tag=tag).repartition(4, "k")
+                .group_by("k").agg(F.avg(tag).alias("a")).sort("k"))
+
+    def q_sort(n, tag):
+        return (_df(s, n=n, tag=tag).filter(col("x") > 5.0)
+                .sort(tag, "k").limit(40))
+
+    def q_distinct(n, tag):
+        return _df(s, n=n, tag=tag).select("k").distinct().sort("k")
+
+    makers = [q_agg, q_shuffle, q_sort, q_distinct] * 2
+    queries = [m(300 + 50 * i, f"t{i}") for i, m in enumerate(makers)]
+    serial = [q.collect() for q in queries]
+    futs = [q.collect_async() for q in queries]
+    tables = [f.result(timeout=180) for f in futs]
+    for i, (a, b) in enumerate(zip(serial, tables)):
+        assert a.equals(b), f"query {i} serial/concurrent diverge"
+    waits = [(f.profile.metrics["sched"]["sched.queueWaitNs"]
+              if f.profile is not None else 0) for f in futs]
+    assert any(w > 0 for w in waits), waits
+    _assert_clean(s)
+
+
+def test_nested_collect_inline_no_self_deadlock():
+    """A collect issued from inside a running query (here: a plan
+    listener) executes inline under the parent's slot instead of
+    re-admitting — maxConcurrent=1 must not deadlock on its own
+    child."""
+    s = _session({"spark.rapids.tpu.sched.maxConcurrent": 1})
+    inner = {}
+
+    def listener(result):
+        if "done" not in inner:
+            inner["done"] = True   # guard: the nested collect re-plans
+            inner["rows"] = _df(s, n=50).collect().num_rows
+
+    s.add_plan_listener(listener)
+    out = _query(s).collect()
+    assert out.num_rows > 0 and inner["rows"] == 50
+    _assert_clean(s)
+
+
+# ---------------------------------------------------------------------------
+# admission: memory budget + maxConcurrent
+# ---------------------------------------------------------------------------
+
+def test_small_budget_serializes():
+    s = _session({"spark.rapids.tpu.sched.memoryBudget": 1 << 30,
+                  "spark.rapids.tpu.sched.maxConcurrent": 3})
+    parker = Parker()
+    s.add_plan_listener(parker)
+    est = 700 << 20     # 2 x 700MB > 1GB: admission must serialize
+    futs = [_query(s, tag=t).collect_async(estimate_bytes=est)
+            for t in ("q_a", "q_b")]
+    try:
+        assert parker.parked.acquire(timeout=20)
+        time.sleep(0.3)  # give the second query time to (wrongly) admit
+        stats = s.scheduler.controller.stats()
+        assert stats["running"] == 1 and stats["queued"] == 1, stats
+    finally:
+        parker.release.set()
+    for f in futs:
+        f.result(timeout=120)
+    assert obsreg.get_registry().gauge("sched.runningHwm") == 1
+    _assert_clean(s)
+
+
+def test_large_budget_overlaps():
+    s = _session({"spark.rapids.tpu.sched.memoryBudget": 4 << 30,
+                  "spark.rapids.tpu.sched.maxConcurrent": 3})
+    parker = Parker()
+    s.add_plan_listener(parker)
+    est = 100 << 20     # 3 x 100MB well under 4GB: all admit
+    futs = [_query(s, tag=f"q_{i}").collect_async(estimate_bytes=est)
+            for i in range(3)]
+    try:
+        for _ in range(3):
+            assert parker.parked.acquire(timeout=30)
+        stats = s.scheduler.controller.stats()
+        assert stats["running"] == 3, stats
+        assert stats["admitted_bytes"] == 3 * est, stats
+    finally:
+        parker.release.set()
+    for f in futs:
+        f.result(timeout=180)
+    assert obsreg.get_registry().gauge("sched.runningHwm") >= 3
+    _assert_clean(s)
+
+
+def test_progress_guarantee_oversized_estimate_runs_alone():
+    """A query estimated over the whole budget still runs (alone) —
+    graceful degradation leans on the spill catalog, never deadlock."""
+    s = _session({"spark.rapids.tpu.sched.memoryBudget": 64 << 20})
+    out = _query(s).collect_async(estimate_bytes=1 << 40).result(
+        timeout=120)
+    assert out.num_rows > 0
+    _assert_clean(s)
+
+
+def test_priority_ordering_and_fifo():
+    s = _session({"spark.rapids.tpu.sched.maxConcurrent": 1})
+    parker = Parker()
+    s.add_plan_listener(parker)
+    filler = _query(s, tag="q_fill").collect_async()
+
+    def submit_and_wait_queued(tag, priority, n_queued):
+        fut = _query(s, tag=tag).collect_async(priority=priority)
+        deadline = time.time() + 20
+        while (s.scheduler.controller.stats()["queued"] < n_queued and
+               time.time() < deadline):
+            time.sleep(0.01)
+        assert s.scheduler.controller.stats()["queued"] == n_queued
+        return fut
+
+    try:
+        assert parker.parked.acquire(timeout=20)
+        # sequential enqueue (each confirmed queued before the next
+        # submit) so FIFO seq order is deterministic
+        lo1 = submit_and_wait_queued("q_lo1", 0, 1)
+        lo2 = submit_and_wait_queued("q_lo2", 0, 2)
+        hi = submit_and_wait_queued("q_hi", 10, 3)
+    finally:
+        parker.release.set()
+    for f in (filler, lo1, lo2, hi):
+        f.result(timeout=120)
+    # admission order: filler first (held the slot), then the high
+    # priority submission, then the two low-priority ones in FIFO order
+    assert parker.order == ["q_fill", "q_hi", "q_lo1", "q_lo2"]
+    _assert_clean(s)
+
+
+def test_queue_full_rejected():
+    s = _session({"spark.rapids.tpu.sched.maxConcurrent": 1,
+                  "spark.rapids.tpu.sched.maxQueued": 1})
+    parker = Parker()
+    s.add_plan_listener(parker)
+    filler = _query(s, tag="q_fill").collect_async()
+    try:
+        assert parker.parked.acquire(timeout=20)
+        q2 = _query(s, tag="q_two").collect_async()
+        deadline = time.time() + 20
+        while (s.scheduler.controller.stats()["queued"] < 1 and
+               time.time() < deadline):
+            time.sleep(0.01)
+        q3 = _query(s, tag="q_three").collect_async()
+        # the third submission fails fast with the rejection error
+        from spark_rapids_tpu.sched.admission import QueryRejectedError
+        with pytest.raises(QueryRejectedError):
+            q3.result(timeout=30)
+        assert obsreg.get_registry().counter("sched.rejected") == 1
+    finally:
+        parker.release.set()
+    filler.result(timeout=120)
+    q2.result(timeout=120)
+    _assert_clean(s)
+
+
+def test_queue_wait_attribution_in_profile():
+    s = _session({"spark.rapids.tpu.sched.maxConcurrent": 1})
+    parker = Parker()
+    s.add_plan_listener(parker)
+    first = _query(s, tag="q_one").collect_async()
+    try:
+        assert parker.parked.acquire(timeout=20)
+        second = _query(s, tag="q_two").collect_async()
+        time.sleep(0.25)   # accrue measurable queue wait
+    finally:
+        parker.release.set()
+    first.result(timeout=120)
+    second.result(timeout=120)
+    sched_sec = second.profile.metrics["sched"]
+    assert sched_sec["sched.queueWaitNs"] > 0.2e9
+    assert second.profile.wall_breakdown["queue_wait_s"] > 0.2
+    assert second.queue_wait_ns == sched_sec["sched.queueWaitNs"]
+    # the first query was admitted instantly
+    assert first.profile.metrics["sched"]["sched.queueWaitNs"] < 0.1e9
+    _assert_clean(s)
+
+
+# ---------------------------------------------------------------------------
+# deadlines
+# ---------------------------------------------------------------------------
+
+def test_deadline_timeout_while_queued_frees_slot():
+    s = _session({"spark.rapids.tpu.sched.maxConcurrent": 1})
+    parker = Parker()
+    s.add_plan_listener(parker)
+    filler = _query(s, tag="q_fill").collect_async()
+    try:
+        assert parker.parked.acquire(timeout=20)
+        doomed = _query(s, tag="q_doom").collect_async(timeout_ms=250)
+        with pytest.raises(QueryTimeoutError):
+            doomed.result(timeout=30)
+        assert doomed.state is QueryState.TIMED_OUT
+        assert s.scheduler.controller.stats()["queued"] == 0
+        assert obsreg.get_registry().counter("sched.timedOut") >= 1
+    finally:
+        parker.release.set()
+    filler.result(timeout=120)
+    _assert_clean(s)
+
+
+def test_deadline_timeout_while_running_unwinds():
+    s = _session()
+    parker = Parker()
+    s.add_plan_listener(parker)
+    fut = _query(s).collect_async(timeout_ms=300)
+    assert parker.parked.acquire(timeout=20)   # running, parked
+    with pytest.raises(QueryTimeoutError):
+        fut.result(timeout=30)
+    assert fut.state is QueryState.TIMED_OUT
+    assert fut.cancelled()
+    _assert_clean(s)
+    parker.release.set()
+
+
+# ---------------------------------------------------------------------------
+# cancellation: before admission / mid-scan / mid-shuffle, leak-free
+# ---------------------------------------------------------------------------
+
+def test_cancel_before_admission_leak_free():
+    s = _session({"spark.rapids.tpu.sched.maxConcurrent": 1})
+    parker = Parker()
+    s.add_plan_listener(parker)
+    filler = _query(s, tag="q_fill").collect_async()
+    try:
+        assert parker.parked.acquire(timeout=20)
+        queued = _query(s, tag="q_queued").collect_async()
+        deadline = time.time() + 20
+        while (s.scheduler.controller.stats()["queued"] < 1 and
+               time.time() < deadline):
+            time.sleep(0.01)
+        assert queued.cancel() is True
+        with pytest.raises(QueryCancelledError):
+            queued.result(timeout=30)
+        assert queued.state is QueryState.CANCELLED
+        assert s.scheduler.controller.stats()["queued"] == 0
+    finally:
+        parker.release.set()
+    filler.result(timeout=120)
+    # the slot the cancelled query never took is usable immediately
+    assert _query(s).collect().num_rows > 0
+    assert "q_queued" not in parker.order   # never admitted
+    _assert_clean(s)
+
+
+def test_cancel_mid_scan_unwinds_leak_free(tmp_path):
+    """Cancel during a prefetching file scan: the prefetcher's thunks
+    see the token, prepared-but-unconsumed uploads release, and no
+    spill-catalog entries or admission/device slots leak."""
+    import numpy as np
+    import pyarrow.parquet as papq
+    for i in range(4):
+        papq.write_table(pa.table({
+            "a": np.arange(20_000, dtype=np.int64) + i,
+            "b": np.random.default_rng(i).uniform(size=20_000)}),
+            str(tmp_path / f"p{i}.parquet"))
+    s = _session({"spark.rapids.tpu.sql.scan.prefetch.depth": 2})
+    cat_baseline = len(spill.get_catalog()._buffers)
+    parker = Parker()
+    s.add_plan_listener(parker)
+    q = (s.read.parquet(str(tmp_path)).filter(col("b") > 0.5)
+         .group_by("a").agg(F.count("*").alias("c")))
+    fut = q.collect_async()
+    assert parker.parked.acquire(timeout=30)
+    # fire the token while the query is mid-flight, then let it run
+    # into its next checkpoint (plan is done; scan is next)
+    fut.cancel("mid-scan cancel")
+    parker.release.set()
+    with pytest.raises(QueryCancelledError):
+        fut.result(timeout=60)
+    assert fut.state is QueryState.CANCELLED
+    _assert_clean(s)
+    # prefetch pool wound down (close() shut it down) and nothing
+    # stayed registered in the spill catalog
+    deadline = time.time() + 10
+    while time.time() < deadline:
+        alive = [t for t in threading.enumerate()
+                 if t.name.startswith("scan-prefetch") and t.is_alive()]
+        if not alive and len(spill.get_catalog()._buffers) <= \
+                cat_baseline:
+            break
+        time.sleep(0.05)
+    assert len(spill.get_catalog()._buffers) <= cat_baseline
+    # the session still executes fresh queries (nothing poisoned)
+    assert q.collect().num_rows > 0
+
+
+def test_cancel_mid_scan_prefetcher_drains_unconsumed():
+    """ScanPrefetcher under a cancelled token: queued thunks stop
+    running, prepared results get their cleanup, get() raises."""
+    from spark_rapids_tpu.exec.scans import ScanPrefetcher
+    cleaned = []
+    started = threading.Event()
+    gate = threading.Event()
+
+    def thunk(i):
+        def run():
+            started.set()
+            gate.wait(10)
+            return f"prepared-{i}"
+        return run
+
+    tok = CancelToken()
+    with sched_cancel.install(tok):
+        pf = ScanPrefetcher([thunk(i) for i in range(6)], depth=2,
+                            cleanup=cleaned.append)
+    assert started.wait(10)
+    tok.cancel("abandon scan")
+    gate.set()                      # in-flight thunks finish preparing
+    pf.close()                      # consumer never drains: close frees
+    time.sleep(0.2)
+    # the in-flight thunks' results were cleaned up, and thunks that
+    # had not started yet either got cancelled or raised at their
+    # cancellation checkpoint — nothing is left prepared
+    assert all(c.startswith("prepared-") for c in cleaned)
+    with sched_cancel.install(tok):
+        with pytest.raises(QueryCancelledError):
+            pf.get(5)
+
+
+def test_cancel_mid_shuffle_fetch_no_leaked_buffers():
+    """Cancel while a remote fetch is in flight: the iterator cancels
+    the FetchHandle, frees received-but-unyielded catalog buffers, and
+    raises the cancellation error."""
+    from spark_rapids_tpu.shuffle.catalogs import (
+        ShuffleReceivedBufferCatalog, build_table_meta)
+    from spark_rapids_tpu.shuffle.iterator import (RapidsShuffleIterator,
+                                                   RemoteSource)
+    from spark_rapids_tpu.shuffle.serializer import (get_codec,
+                                                     serialize_table)
+    received = ShuffleReceivedBufferCatalog()
+    table = pa.table({"v": [1, 2, 3]})
+    payload = serialize_table(table, get_codec("none"))
+
+    class StallingHandle:
+        def __init__(self):
+            self.cancelled = threading.Event()
+            self.completed_buffer_ids = set()
+
+        def cancel(self):
+            self.cancelled.set()
+
+    class StallingClient:
+        """Delivers one block then never completes (a peer that went
+        silent mid-transfer)."""
+
+        def __init__(self):
+            self.handle = StallingHandle()
+
+        def do_fetch(self, shuffle_id, reduce_id, map_ids, on_batch,
+                     on_done, skip_buffer_ids=None):
+            tid = received.add(
+                build_table_meta(1, 3, table, len(payload)), payload)
+            on_batch(tid)
+            return self.handle       # on_done never fires
+
+    client = StallingClient()
+    it = RapidsShuffleIterator(
+        1, 0, None, [RemoteSource("exec-stall", client)], received,
+        timeout_s=30.0)
+    tok = CancelToken(query_id=42)
+    out, errs = [], []
+
+    def consume():
+        with sched_cancel.install(tok):
+            try:
+                for t in it:
+                    out.append(t)
+            except QueryCancelledError as e:
+                errs.append(e)
+
+    t = threading.Thread(target=consume)
+    t.start()
+    deadline = time.time() + 10
+    while not out and time.time() < deadline:
+        time.sleep(0.01)
+    assert len(out) == 1              # one block delivered, fetch live
+    tok.cancel("user cancel mid-fetch")
+    t.join(15)
+    assert not t.is_alive()
+    assert len(errs) == 1             # raised the cancellation
+    assert client.handle.cancelled.is_set()   # in-flight fetch cancelled
+    assert received.pending == 0      # no leaked catalog buffers
+
+
+def test_cancel_mid_shuffle_process_transport_leak_free():
+    """Service-level cancel while TCP fetches are stalled by the PR-1
+    fault-injection DELAY point: the query unwinds without leaking
+    admission or device slots, and the session stays usable."""
+    s = _session({
+        "spark.rapids.tpu.shuffle.transport": "process",
+        "spark.rapids.tpu.shuffle.transport.processExecutors": 2,
+        "spark.rapids.tpu.shuffle.fetch.maxRetries": 50,
+        "spark.rapids.tpu.shuffle.readTimeoutMs": 400,
+        "spark.rapids.tpu.shuffle.fetch.retryBackoffMs": 100,
+        # every server DATA frame stalls 300ms: fetches crawl, so the
+        # cancel reliably lands while transfers are in flight
+        "spark.rapids.tpu.shuffle.test.faultPlan":
+            "seed=11;tcp.server.data:delay@1:d300:x10000",
+    })
+    try:
+        df = _df(s, n=4000, parts=2)
+        fut = df.repartition(4, "k").group_by("k").agg(
+            F.sum("x").alias("sx")).collect_async()
+        # wait until the exchange is actually fetching, then cancel
+        reg = obsreg.get_registry()
+        deadline = time.time() + 60
+        while (reg.counter("shuffle.fetchFrames") == 0 and
+               not fut.done() and time.time() < deadline):
+            time.sleep(0.05)
+        fut.cancel("mid-shuffle cancel")
+        with pytest.raises(QueryCancelledError):
+            fut.result(timeout=90)
+        assert fut.state is QueryState.CANCELLED
+        _assert_clean(s)
+    finally:
+        from spark_rapids_tpu.shuffle import procpool
+        procpool.reset_executor_pool()
+    # the engine still answers (fault plan off, fresh local transport)
+    s2 = _session()
+    assert _query(s2).collect().num_rows > 0
+    _assert_clean(s2)
+
+
+# ---------------------------------------------------------------------------
+# estimate refinement end to end
+# ---------------------------------------------------------------------------
+
+def test_estimate_refines_from_observed_high_water():
+    s = _session({"spark.rapids.tpu.sched.memoryBudget": 2 << 30})
+    q = _query(s, n=600, tag="q_refine")
+    first_est = s.scheduler._estimate(q.plan, None)
+    q.collect()
+    refined = s.scheduler.book.estimate(plan_shape_key(q.plan))
+    if refined is not None:     # a batch was registered in the catalog
+        assert refined <= first_est
+        assert s.scheduler._estimate(q.plan, None) == min(
+            refined, s.scheduler.memory_budget)
+    # explicit estimates always win
+    assert s.scheduler._estimate(q.plan, 123 << 20) == 123 << 20
