@@ -440,7 +440,7 @@ def main() -> None:
     files = 8
     smoke = "--smoke" in sys.argv
     profile_out = None
-    concurrent_n = 0
+    concurrent_n = None    # None = flag absent; 0 = explicitly off
     for a in sys.argv[1:]:
         if a.startswith("--profile-out="):
             profile_out = a.split("=", 1)[1]
@@ -448,6 +448,13 @@ def main() -> None:
             concurrent_n = int(a.split("=", 1)[1])
     if smoke:
         n = 160_000
+        if concurrent_n is None:
+            # the trend file tracks queue-wait percentiles; a smoke run
+            # (the CI path) exercises a small concurrent batch so the
+            # scheduler columns are populated, not null — an explicit
+            # --concurrent=0 still suppresses the probe
+            concurrent_n = 4
+    concurrent_n = concurrent_n or 0
     with tempfile.TemporaryDirectory(prefix="tpcds_q6_") as root:
         nbytes = _write_dataset(root, n, files)
         if profile_out:
@@ -496,7 +503,7 @@ def main() -> None:
     dispatch_probe = _dispatch_count_probe()
 
     gbps = nbytes / per_query / 1e9
-    print(json.dumps({
+    result = {
         "metric": "TPC-DS q6-class device pipeline over parquet "
                   f"({n} rows, {files} files, {nbytes >> 20} MiB): "
                   "page decode+filter+hash-agg per query "
@@ -514,7 +521,54 @@ def main() -> None:
         "e2e_tunnel_wall_s": round(e2e, 2) if e2e else None,
         "vs_baseline_e2e": round(cpu_time / e2e, 4) if e2e else None,
         "profile_out": profile_out,
-    }))
+    }
+    print(json.dumps(result))
+    _write_trend_file(result, n=n, files=files, smoke=smoke)
+
+
+def _write_trend_file(result: dict, n: int, files: int,
+                      smoke: bool) -> str:
+    """Machine-readable trend record at the repo root (BENCH_pr6.json):
+    suite timings, dispatch counts, and queue-wait percentiles in one
+    stable schema, so the perf trajectory is greppable across PRs
+    instead of living only in prose."""
+    probe = result.get("dispatch_probe") or {}
+    conc = result.get("concurrent") or {}
+    trend = {
+        "schema": "spark-rapids-tpu-bench-trend/1",
+        "generated_unix": time.time(),
+        "config": {"rows": n, "files": files, "smoke": smoke},
+        "suite_timings": {
+            "tpu_pipeline_ms": result.get("tpu_pipeline_ms"),
+            "cpu_wall_s": result.get("cpu_wall_s"),
+            "host_prep_s": result.get("host_prep_s"),
+            "host_prep_warm_s": result.get("host_prep_warm_s"),
+            "e2e_tunnel_wall_s": result.get("e2e_tunnel_wall_s"),
+            "throughput_gbps": result.get("value"),
+            "vs_baseline": result.get("vs_baseline"),
+        },
+        "dispatch_counts": {
+            "fused": (probe.get("fused") or {}).get("dispatches"),
+            "unfused": (probe.get("unfused") or {}).get("dispatches"),
+            "dispatch_drop_pct": probe.get("dispatch_drop_pct"),
+            "dispatches_saved":
+                (probe.get("fused") or {}).get("dispatches_saved"),
+        },
+        "queue_wait": {
+            "n_queries": conc.get("n_queries"),
+            "max_concurrent": conc.get("max_concurrent"),
+            "queries_per_sec": conc.get("queries_per_sec"),
+            "p50_ms": conc.get("queue_wait_p50_ms"),
+            "p95_ms": conc.get("queue_wait_p95_ms"),
+        },
+        "rows_match": result.get("rows_match"),
+    }
+    path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                        "BENCH_pr6.json")
+    with open(path, "w") as f:
+        json.dump(trend, f, indent=2)
+        f.write("\n")
+    return path
 
 
 if __name__ == "__main__":

@@ -76,21 +76,35 @@ assert fused_d < plain_d, (
 print(f"fusion parity OK; dispatches {plain_d} -> {fused_d}")
 EOF
 
-echo "== concurrency smoke (8 async queries, sched.maxConcurrent=3) =="
+echo "== concurrency smoke (8 async queries, sched.maxConcurrent=3, live /metrics + /queries scrape) =="
 timeout 300 python - <<'EOF'
 # N=8 mixed TPC-like queries through the concurrent query scheduler
 # (sched/service.py): serial first (the oracle), then all submitted at
 # once via collect_async under sched.maxConcurrent=3.  Asserts
 # bit-identical results, zero deadlocks (the outer `timeout 300` is the
 # hard wall-clock bound, each future waits at most 120s), and that at
-# least one profile attributes real queue wait.
-import os, time
+# least one profile attributes real queue wait.  The telemetry endpoint
+# (obs/server.py) serves throughout: /metrics and /queries are scraped
+# DURING the concurrent batch and validated after it — Prometheus
+# exposition must parse and the query table must account for every
+# submission.
+import json, os, time, urllib.request
 os.environ.setdefault("JAX_PLATFORMS", "cpu")
 from spark_rapids_tpu import TpuSparkSession, col, functions as F
+# the one exposition validator (also exercised by tests/test_obs_live.py)
+from spark_rapids_tpu.obs.server import parse_prometheus
 
 s = TpuSparkSession({
     "spark.rapids.tpu.sql.variableFloatAgg.enabled": True,
-    "spark.rapids.tpu.sched.maxConcurrent": 3})
+    "spark.rapids.tpu.sched.maxConcurrent": 3,
+    "spark.rapids.tpu.obs.http.enabled": True})
+
+_base_url = f"http://127.0.0.1:{s.obs_server.port}"
+def scrape(path):
+    with urllib.request.urlopen(_base_url + path, timeout=10) as r:
+        return r.read().decode()
+
+parse_prometheus(scrape("/metrics"))  # serves before any query
 
 def base(n):
     return s.create_dataframe(
@@ -121,6 +135,23 @@ queries = [q(1500 + 100 * i) for i, q in enumerate(
 serial = [q.collect() for q in queries]
 
 futs = [q.collect_async() for q in queries]
+# live scrape DURING the concurrent batch: the running count must
+# respect sched.maxConcurrent and the table must see the submissions.
+# The bound is asserted on the sched.running GAUGE (published under
+# the controller lock, refreshed at scrape time) — per-row future
+# states have a benign finish window where a completing query still
+# reads "running" after its admission slot was already released, so a
+# row-count assert would be flaky.
+seen_running = 0
+while not all(f.done() for f in futs):
+    live = parse_prometheus(scrape("/metrics"))
+    running = live.get("spark_rapids_tpu_sched_running", 0)
+    assert running <= 3, f"maxConcurrent=3 violated: {running}"
+    seen_running = max(seen_running, int(running))
+    rows = json.loads(scrape("/queries"))["queries"]
+    assert all(r["state"] in ("queued", "running", "success")
+               for r in rows), rows
+    time.sleep(0.05)
 tables = [f.result(timeout=120) for f in futs]
 for i, (a, b) in enumerate(zip(serial, tables)):
     assert a.equals(b), (
@@ -132,8 +163,26 @@ waits = [(f.profile.metrics["sched"]["sched.queueWaitNs"]
 assert any(w > 0 for w in waits), (
     "no query recorded queue wait despite 8 submissions at "
     f"maxConcurrent=3: {waits}")
+
+# post-run endpoint validation: the exposition's submitted counter and
+# the query table must both account for every submission this session
+# made (8 serial collects + 8 async = 16, no queued/running leftovers)
+metrics = parse_prometheus(scrape("/metrics"))
+submitted = metrics.get("spark_rapids_tpu_sched_submitted", 0)
+assert submitted == 16, f"sched_submitted={submitted}, expected 16"
+assert metrics.get("spark_rapids_tpu_sched_running") == 0
+rows = json.loads(scrape("/queries"))["queries"]
+done = [r for r in rows if r["state"] == "success"]
+assert len(done) == 16, [r["state"] for r in rows]
+assert not [r for r in rows if r["state"] in ("queued", "running")]
+# the profile ring serves over HTTP too
+qid = done[-1]["query_id"]
+prof = json.loads(scrape(f"/profiles/{qid}"))
+assert prof["query_id"] == qid and prof["status"] == "success"
+s.obs_server.shutdown()
 print(f"concurrency smoke OK: 8/8 bit-identical, "
-      f"max queue wait {max(waits) / 1e6:.1f}ms")
+      f"max queue wait {max(waits) / 1e6:.1f}ms, "
+      f"peak running seen {seen_running}, endpoint validated")
 EOF
 
 echo "== smoke bench (tracing enabled) =="

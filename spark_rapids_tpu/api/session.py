@@ -74,6 +74,40 @@ class TpuSparkSession:
         self._last_profile = None
         from spark_rapids_tpu.sched.service import QueryService
         self._query_service = QueryService(self)
+        # -- always-on operational layer (obs/server.py, obs/recorder.py):
+        # both fully off by default — no socket, no recorder ring, the
+        # event hooks cost one bool check
+        from spark_rapids_tpu.obs import recorder as obs_recorder
+        self._recorder = None
+        rec_dir = str(self.conf.get(cfg.OBS_RECORDER_DIR) or "")
+        if rec_dir:
+            # configuring REPLACES any previous session's recorder
+            # (whose listener then stands down via _stale()); a session
+            # with no recorder dir leaves an existing recorder alone —
+            # helper sessions (bench oracles, tests) must not disarm a
+            # live sibling's flight recorder
+            self._recorder = obs_recorder.configure(
+                rec_dir,
+                max_events=int(self.conf.get(
+                    cfg.OBS_RECORDER_MAX_EVENTS)),
+                config_snapshot=dict(self.conf._settings))
+            self._query_listeners.append(self._recorder)
+        if not self.conf.get(cfg.OBS_PROFILE_ENABLED) and (
+                rec_dir or int(self.conf.get(cfg.OBS_SLOW_QUERY_MS))):
+            # both features ride the QueryProfile assembly path; with
+            # profiling off they would be silently inert
+            import logging
+            logging.getLogger("spark_rapids_tpu.obs").warning(
+                "obs.recorder.dir / obs.slowQueryMs are configured but "
+                "obs.profile.enabled=false: flight-recorder bundles "
+                "and the slow-query log require per-query profiles "
+                "and will not fire")
+        self._obs_server = None
+        if self.conf.get(cfg.OBS_HTTP_ENABLED):
+            from spark_rapids_tpu.obs.server import ObsHttpServer
+            self._obs_server = ObsHttpServer(
+                self, host=str(self.conf.get(cfg.OBS_HTTP_HOST)),
+                port=int(self.conf.get(cfg.OBS_HTTP_PORT)))
 
     # -- builder-compatible construction -----------------------------------
     class Builder:
@@ -287,11 +321,53 @@ class TpuSparkSession:
             # recently COMPLETED query, stable under concurrent collects
             self._last_profile = prof
         obs_listener.notify(self._query_listeners, prof, error)
+        self._maybe_log_slow_query(prof)
         chrome = str(self.conf.get(cfg.OBS_TRACE_CHROME_PATH) or "")
         if chrome and obs_trace.is_enabled():
             with contextlib.suppress(OSError):
                 prof.dump_chrome_trace(chrome)
         return prof
+
+    def _maybe_log_slow_query(self, prof) -> None:
+        """Structured slow-query log: one JSONL record per query at or
+        over ``obs.slowQueryMs`` (failures included — a query that died
+        slowly is still slow), appended to ``obs.slowQueryPath`` or
+        routed through the ``spark_rapids_tpu.obs.slowquery`` logger.
+        Never fails the query."""
+        threshold_ms = int(self.conf.get(cfg.OBS_SLOW_QUERY_MS))
+        if threshold_ms <= 0 or prof.wall_ns < threshold_ms * 1e6:
+            return
+        try:
+            import json as _json
+            import time as _time
+            # one rendering of the profile exists (to_dict): the log
+            # record is a field subset of it plus the log-only extras,
+            # so the two JSON surfaces cannot drift apart
+            d = prof.to_dict()
+            record = {"ts_unix": _time.time(),
+                      "threshold_ms": threshold_ms,
+                      "queue_wait_s": prof.metrics.get("sched", {}).get(
+                          "sched.queueWaitNs", 0) / 1e9}
+            for key in ("query_id", "status", "error", "wall_s",
+                        "result_rows", "phases", "wall_breakdown"):
+                record[key] = d[key]
+            line = _json.dumps(record, default=str)
+            from spark_rapids_tpu.obs import recorder as obs_recorder
+            from spark_rapids_tpu.obs import registry as obsreg
+            obsreg.get_registry().inc("obs.slowQueries")
+            obs_recorder.record_event("query.slow",
+                                      query=prof.query_id,
+                                      wall_s=record["wall_s"])
+            path = str(self.conf.get(cfg.OBS_SLOW_QUERY_PATH) or "")
+            if path:
+                with open(path, "a") as f:
+                    f.write(line + "\n")
+            else:
+                import logging
+                logging.getLogger(
+                    "spark_rapids_tpu.obs.slowquery").warning(line)
+        except Exception:
+            pass
 
     def _phase(self, run, name: str):
         return run.phase(name) if run is not None \
@@ -353,6 +429,19 @@ class TpuSparkSession:
         self._plan_listeners.remove(fn)
 
     # -- observability surface ---------------------------------------------
+    @property
+    def obs_server(self):
+        """The live telemetry endpoint (obs/server.ObsHttpServer) when
+        ``obs.http.enabled=true``; None otherwise.  ``obs_server.port``
+        is the bound port (ephemeral under ``obs.http.port=0``)."""
+        return self._obs_server
+
+    @property
+    def flight_recorder(self):
+        """The flight recorder (obs/recorder.FlightRecorder) when
+        ``obs.recorder.dir`` is set; None otherwise."""
+        return self._recorder
+
     def last_query_profile(self):
         """The QueryProfile of the most recently COMPLETED action (None
         before the first action, or while

@@ -578,6 +578,62 @@ SCHED_PROFILE_RING = conf(
     "slot; last_query_profile() returns the most recently COMPLETED "
     "query's profile).", int)
 
+OBS_HTTP_ENABLED = conf(
+    "spark.rapids.tpu.obs.http.enabled", False,
+    "Serve the live operational telemetry endpoint from a background "
+    "daemon thread: /metrics (Prometheus text exposition of the "
+    "MetricsRegistry plus live scheduler gauges), /queries (the "
+    "QueryService's queued/running/recently-completed table), and "
+    "/profiles/<qid> (QueryProfile JSON from the profile ring). Off by "
+    "default: nothing binds a socket and the serving path costs "
+    "nothing.", bool)
+
+OBS_HTTP_PORT = conf(
+    "spark.rapids.tpu.obs.http.port", 0,
+    "TCP port for the telemetry endpoint when obs.http.enabled=true. "
+    "0 binds an ephemeral port (discover it via "
+    "session.obs_server.port — the CI scrape idiom).", int)
+
+OBS_HTTP_HOST = conf(
+    "spark.rapids.tpu.obs.http.host", "127.0.0.1",
+    "Bind address for the telemetry endpoint (loopback by default; "
+    "widen deliberately, the endpoint is unauthenticated).")
+
+OBS_RECORDER_DIR = conf(
+    "spark.rapids.tpu.obs.recorder.dir", "",
+    "Directory for flight-recorder diagnostic bundles. Non-empty "
+    "enables the recorder: a bounded in-memory ring of recent engine "
+    "events (admission decisions, spill/arena traffic, OOM retries, "
+    "query lifecycle) is kept, and on query failure, timeout, "
+    "cancellation, or an OOM-retried success a self-contained bundle "
+    "(profile.json + trace.json + events.jsonl + config.json + "
+    "registry.json) is written here. Empty (default) disables the "
+    "recorder entirely; event hooks cost one bool check. Bundles ride "
+    "the QueryProfile assembly path, so obs.profile.enabled must stay "
+    "true (its default) for them to fire.")
+
+OBS_RECORDER_MAX_EVENTS = conf(
+    "spark.rapids.tpu.obs.recorder.maxEvents", 4096,
+    "Capacity of the flight recorder's in-memory event ring; the "
+    "oldest events drop when a busy engine outruns it (bounded memory, "
+    "never the process).", int)
+
+OBS_SLOW_QUERY_MS = conf(
+    "spark.rapids.tpu.obs.slowQueryMs", 0,
+    "Wall-clock threshold in milliseconds for the structured "
+    "slow-query log: a completed (or failed) query at or over it emits "
+    "ONE JSONL record (ts, query_id, status, error, wall_s, "
+    "queue_wait_s, result_rows, phases, wall_breakdown) to "
+    "obs.slowQueryPath, or through the "
+    "'spark_rapids_tpu.obs.slowquery' python logger when no path is "
+    "set. 0 (default) disables. Rides the QueryProfile assembly path, "
+    "so obs.profile.enabled must stay true (its default).", int)
+
+OBS_SLOW_QUERY_PATH = conf(
+    "spark.rapids.tpu.obs.slowQueryPath", "",
+    "Append-mode file for slow-query JSONL records (one JSON object "
+    "per line). Empty routes records to the python logger instead.")
+
 OBS_PROFILE_ENABLED = conf(
     "spark.rapids.tpu.obs.profile.enabled", True,
     "Assemble a QueryProfile after every action (annotated plan tree, "

@@ -50,6 +50,7 @@ holding two copies of every intermediate.
 
 from __future__ import annotations
 
+import threading
 import warnings
 from typing import List, Optional, Sequence
 
@@ -84,6 +85,36 @@ _DONATE_SAFE_PRODUCERS = frozenset({
     "TpuFusedStageExec", "TpuRangeExec", "TpuParquetScanExec",
     "TpuOrcScanExec", "TpuCsvScanExec",
 })
+
+
+_disarm_noted = False
+_disarm_lock = threading.Lock()
+
+
+def _note_donation_disarmed() -> None:
+    """One-time operator-visible record that donation auto-disarmed:
+    a warning log plus the ``fusion.donationDisarmed`` registry counter
+    (scrapeable from /metrics) plus a flight-recorder event — the
+    silent stand-down left operators unable to see why donation was
+    off in steady state."""
+    global _disarm_noted
+    with _disarm_lock:
+        if _disarm_noted:
+            return
+        _disarm_noted = True
+    import logging
+    from spark_rapids_tpu.obs import recorder as obsrec
+    from spark_rapids_tpu.obs import registry as obsreg
+    reason = ("persistent XLA compile cache is active and "
+              "cache-reloaded donating executables mis-apply the "
+              "aliasing table on jax 0.4.37 "
+              "(exec/fused_stage._persistent_cache_active)")
+    logging.getLogger("spark_rapids_tpu.fusion").warning(
+        "input-buffer donation auto-disarmed: %s; re-arm with "
+        "SPARK_RAPIDS_TPU_NO_COMPILE_CACHE=1 or disable explicitly "
+        "via spark.rapids.tpu.sql.fusion.donateInputs=false", reason)
+    obsreg.get_registry().inc("fusion.donationDisarmed")
+    obsrec.record_event("fusion.donationDisarmed", reason=reason)
 
 
 def _persistent_cache_active() -> bool:
@@ -129,7 +160,12 @@ def donate_ok(child: PhysicalPlan, enabled: bool) -> bool:
     entry-computation output leaves are distinct buffers even when two
     outputs compute the same value (checked empirically on this jax:
     jit(lambda x: (x*2, x*2)) returns distinct buffer pointers)."""
-    if not enabled or _persistent_cache_active():
+    if not enabled:
+        return False
+    if _persistent_cache_active():
+        # donation was WANTED here (plan-stamped on) but stood down:
+        # make the stand-down visible once, not silent forever
+        _note_donation_disarmed()
         return False
     while isinstance(child, TpuFusedStageExec) and child.is_passthrough:
         ords = [e.ordinal for e in child.out_exprs]

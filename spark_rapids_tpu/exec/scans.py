@@ -72,10 +72,15 @@ class ScanPrefetcher:
     def __init__(self, thunks: Sequence[Callable[[], object]],
                  depth: int, metrics=None,
                  stall_key: str = "scan.prefetchStalls",
-                 cleanup: Optional[Callable[[object], None]] = None):
+                 cleanup: Optional[Callable[[object], None]] = None,
+                 labels: Optional[Sequence[str]] = None):
         import concurrent.futures as cf
         import weakref
         self._thunks: List[Callable[[], object]] = list(thunks)
+        # per-thunk source labels (file/row-group ids) so stall spans
+        # name WHAT stalled — an anonymous stall count makes prefetch
+        # tuning guesswork
+        self._labels: List[str] = list(labels or ())
         self._depth = max(1, int(depth))
         self._metrics = metrics
         self._stall_key = stall_key
@@ -101,6 +106,12 @@ class ScanPrefetcher:
             with self._lock:
                 self._fill_locked()
 
+    def _span_args(self, i: int) -> dict:
+        args = {"batch": i}
+        if i < len(self._labels):
+            args["src"] = self._labels[i]
+        return args
+
     def _run_thunk(self, i: int):
         """Thunk wrapper: the thread inherits the query's CancelToken,
         and the prefetch work itself shows up in the trace (prep+upload
@@ -114,7 +125,7 @@ class ScanPrefetcher:
         finally:
             dur = time.perf_counter_ns() - t0
             obstrace.record("scan.prefetch", t0, dur, cat="scan",
-                            args={"batch": i})
+                            args=self._span_args(i))
             obsreg.get_registry().observe("scan.prefetchNs", dur)
 
     def _fill_locked(self) -> None:
@@ -159,8 +170,10 @@ class ScanPrefetcher:
         finally:
             if stalled:
                 dur = time.perf_counter_ns() - t0
+                # the stall span names its source (path#rg), so a trace
+                # shows WHICH batch the consumer starved on
                 obstrace.record("scan.prefetchStall", t0, dur,
-                                cat="scan", args={"batch": i})
+                                cat="scan", args=self._span_args(i))
                 obsreg.get_registry().inc("scan.prefetchStallNs", dur)
             with self._lock:
                 self._consumed += 1

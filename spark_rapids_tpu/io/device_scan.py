@@ -56,6 +56,20 @@ def _const_column(dtype: dt.DType, raw: Optional[str], cap: int,
     return DeviceColumn(dtype, data, row_valid)
 
 
+def _group_label(srcs) -> str:
+    """Short source id for one coalesced scan group — the prefetcher
+    stamps it into prefetch/stall span args so a trace names WHICH
+    file/row-group the consumer starved on."""
+    import os as _os
+    if not srcs:
+        return ""
+    path, rg = srcs[0]
+    label = f"{_os.path.basename(str(path))}#rg{rg}"
+    if len(srcs) > 1:
+        label += f"+{len(srcs) - 1}"
+    return label
+
+
 class TpuParquetScanExec(TpuExec):
     """Device-decoding parquet scan (is_tpu — yields DeviceBatch)."""
 
@@ -257,7 +271,8 @@ class TpuParquetScanExec(TpuExec):
                  for srcs, _pv in groups],
                 depth=depth, metrics=self.metrics,
                 cleanup=lambda prepared: [
-                    h.close() for h in prepared[1].values()])
+                    h.close() for h in prepared[1].values()],
+                labels=[_group_label(srcs) for srcs, _pv in groups])
 
         def group_part(idx, path_rgs, pv) -> Iterator[DeviceBatch]:
             from spark_rapids_tpu.exec.context import set_input_file

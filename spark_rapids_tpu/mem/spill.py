@@ -36,6 +36,7 @@ import jax.numpy as jnp
 from spark_rapids_tpu import dtypes as dt
 from spark_rapids_tpu.columnar.batch import DeviceBatch, DeviceColumn
 from spark_rapids_tpu.mem.host_arena import HostArena
+from spark_rapids_tpu.obs import recorder as obsrec
 from spark_rapids_tpu.obs import registry as obsreg
 
 
@@ -205,6 +206,8 @@ class BufferCatalog:
         reg.inc("spill.events")
         reg.inc("spill.deviceToHostBytes", size)
         reg.gauge_max("spill.hostBytesHwm", self.host_bytes)
+        obsrec.record_event("spill.deviceToHost", buffer=buf.id,
+                            bytes=size, host_bytes=self.host_bytes)
         self._maybe_spill_host()
         return size
 
@@ -241,6 +244,8 @@ class BufferCatalog:
         reg = obsreg.get_registry()
         reg.inc("spill.events")
         reg.inc("spill.hostToDiskBytes", nbytes)
+        obsrec.record_event("spill.hostToDisk", buffer=buf.id,
+                            bytes=nbytes)
 
     # -- access ------------------------------------------------------------
     def acquire(self, buffer_id: int) -> DeviceBatch:
@@ -483,6 +488,13 @@ def hbm_oom_recover(e: BaseException) -> bool:
         return False
     cat = get_catalog()
     freed = cat.spill_to_fit(1 << 62)     # evict the whole device tier
+    if freed > 0:
+        # the flight recorder bundles a SUCCESSFUL query whose window
+        # moved this counter — surviving only by evicting the whole
+        # device tier is a diagnosis waiting to happen
+        obsreg.get_registry().inc("mem.oomRetries")
+        obsrec.record_event("mem.oomRetry", freed_bytes=freed,
+                            error=msg[:200])
     return freed > 0
 
 

@@ -22,6 +22,16 @@ engine layer may import ``obs`` freely):
     :class:`QueryProfile` assembled after each collect.
   * :mod:`spark_rapids_tpu.obs.listener` — QueryExecutionListener
     analog registered on the session.
+  * :mod:`spark_rapids_tpu.obs.recorder` — flight recorder: bounded
+    ring of recent engine events + self-contained diagnostic bundles
+    on query failure/timeout/cancellation (opt-in via
+    ``obs.recorder.dir``).
+  * :mod:`spark_rapids_tpu.obs.server` — live telemetry endpoint:
+    Prometheus ``/metrics``, ``/queries``, ``/profiles/<qid>`` from a
+    background daemon thread (opt-in via ``obs.http.enabled``).
+
+(``server`` holds a reference to the session it serves but imports no
+engine module; the package stays an import leaf.)
 """
 
 from spark_rapids_tpu.obs import registry, trace  # noqa: F401

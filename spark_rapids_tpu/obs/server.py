@@ -1,0 +1,236 @@
+"""Always-on operational telemetry endpoint (opt-in HTTP server).
+
+PR 3's obs layer is per-query and post-hoc; a long-lived multi-tenant
+engine (ROADMAP item 1) needs its live state scrapeable while queries
+run.  ``ObsHttpServer`` serves, from a background daemon thread:
+
+  ``GET /metrics``          Prometheus text exposition (version 0.0.4)
+                            of the process MetricsRegistry — counters,
+                            gauges, histograms (as ``_count``/``_sum``
+                            summaries) — with the scheduler's live
+                            queued/running/admitted-bytes gauges
+                            refreshed at scrape time.
+  ``GET /queries``          JSON: the QueryService's live table —
+                            queued/running plus a bounded
+                            recently-completed window, with states,
+                            priorities, admitted estimates and queue
+                            wait (sched/service.QueryService
+                            .query_table).
+  ``GET /profiles/<qid>``   QueryProfile JSON from the session's
+                            profile ring; 404 once evicted or unknown.
+  ``GET /healthz``          liveness probe.
+
+Off by default (``obs.http.enabled=false``): nothing binds a socket
+and no code on the query path changes.  The endpoint is read-only and
+unauthenticated — it binds loopback unless ``obs.http.host`` says
+otherwise.
+"""
+
+from __future__ import annotations
+
+import json
+import re
+import threading
+import weakref
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Any, Dict, Optional
+
+from spark_rapids_tpu.obs import registry as obsreg
+
+_NAME_PREFIX = "spark_rapids_tpu_"
+_SANITIZE = re.compile(r"[^a-zA-Z0-9_:]")
+
+
+def _prom_name(name: str) -> str:
+    return _NAME_PREFIX + _SANITIZE.sub("_", name)
+
+
+def _prom_value(v: Any) -> str:
+    f = float(v)
+    if f == int(f) and abs(f) < 1e15:
+        return str(int(f))
+    return repr(f)
+
+
+def render_prometheus(snapshot: Dict[str, Any]) -> str:
+    """Render a MetricsRegistry snapshot as Prometheus text exposition
+    (one ``# TYPE`` line per family; histograms surface as summaries:
+    ``_count``/``_sum`` plus ``_min``/``_max`` gauges)."""
+    lines = []
+    for name in sorted(snapshot.get("counters", {})):
+        n = _prom_name(name)
+        lines.append(f"# TYPE {n} counter")
+        lines.append(f"{n} {_prom_value(snapshot['counters'][name])}")
+    for name in sorted(snapshot.get("gauges", {})):
+        n = _prom_name(name)
+        lines.append(f"# TYPE {n} gauge")
+        lines.append(f"{n} {_prom_value(snapshot['gauges'][name])}")
+    for name in sorted(snapshot.get("histograms", {})):
+        h = snapshot["histograms"][name]
+        n = _prom_name(name)
+        lines.append(f"# TYPE {n} summary")
+        lines.append(f"{n}_count {_prom_value(h.get('count', 0))}")
+        lines.append(f"{n}_sum {_prom_value(h.get('sum', 0))}")
+        for bound in ("min", "max"):
+            if h.get(bound) is not None:
+                lines.append(f"# TYPE {n}_{bound} gauge")
+                lines.append(f"{n}_{bound} {_prom_value(h[bound])}")
+    return "\n".join(lines) + "\n"
+
+
+_SAMPLE_LINE = re.compile(
+    r"^[a-zA-Z_:][a-zA-Z0-9_:]*(\{[^}]*\})? [-+0-9.eEnaif]+$")
+
+
+def parse_prometheus(text: str) -> Dict[str, float]:
+    """Validate Prometheus text exposition and return the unlabeled
+    samples as ``{name: value}``.  Raises ``ValueError`` on a malformed
+    sample line or an empty exposition — the single validator the tests
+    and the ci.sh scrape both lean on, so the format check cannot
+    silently diverge from the renderer."""
+    samples: Dict[str, float] = {}
+    n = 0
+    for line in text.splitlines():
+        if not line or line.startswith("#"):
+            continue
+        if not _SAMPLE_LINE.match(line):
+            raise ValueError(f"bad exposition line: {line!r}")
+        n += 1
+        if "{" not in line:
+            name, value = line.split(" ", 1)
+            samples[name] = float(value)
+    if n == 0:
+        raise ValueError("empty exposition")
+    return samples
+
+
+class ObsHttpServer:
+    """One per session when ``obs.http.enabled=true`` (api/session.py
+    keeps it on ``session.obs_server``); ``port`` is the bound port
+    (ephemeral when ``obs.http.port=0``)."""
+
+    def __init__(self, session, host: str = "127.0.0.1",
+                 port: int = 0):
+        # weakref: the serving thread must not pin the session (and its
+        # profile ring full of results) forever — when the session is
+        # collected, the finalizer stops the server and frees the port
+        self._session_ref = weakref.ref(session)
+        handler = self._make_handler()
+        self._httpd = ThreadingHTTPServer((host, int(port)), handler)
+        self._httpd.daemon_threads = True
+        self.host = host
+        self.port = int(self._httpd.server_address[1])
+        self._thread = threading.Thread(
+            target=self._httpd.serve_forever,
+            name=f"obs-http-{self.port}", daemon=True)
+        self._thread.start()
+        self._finalizer = weakref.finalize(
+            session, ObsHttpServer._shutdown_httpd, self._httpd)
+
+    @staticmethod
+    def _shutdown_httpd(httpd) -> None:
+        try:
+            httpd.shutdown()
+            httpd.server_close()
+        except OSError:
+            pass
+
+    def _session_obj(self):
+        """The served session, or None once it was collected (the
+        finalizer is stopping the server; a racing request gets 503)."""
+        return self._session_ref()
+
+    # -- route payloads ----------------------------------------------------
+    def _metrics_text(self, session) -> str:
+        reg = obsreg.get_registry()
+        try:
+            # live scheduler gauges at scrape time: a scrape between
+            # queries must still see the current queue/running levels,
+            # not the last admission's stale publish
+            st = session.scheduler.controller.stats()
+            reg.set_gauge("sched.queued", st["queued"])
+            reg.set_gauge("sched.running", st["running"])
+            reg.set_gauge("sched.admittedBytes", st["admitted_bytes"])
+        except Exception:
+            pass
+        return render_prometheus(reg.snapshot())
+
+    @staticmethod
+    def _queries_json(session) -> str:
+        return json.dumps(
+            {"queries": session.scheduler.query_table()},
+            default=str)
+
+    @staticmethod
+    def _profile_json(session, qid: int) -> Optional[str]:
+        prof = session.query_profile(qid)
+        if prof is None:
+            return None
+        return prof.to_json(indent=None)
+
+    def _make_handler(self):
+        server = self
+
+        class Handler(BaseHTTPRequestHandler):
+            def log_message(self, *a):   # no stderr chatter per scrape
+                pass
+
+            def _send(self, code: int, body: str,
+                      ctype: str = "application/json") -> None:
+                payload = body.encode("utf-8")
+                self.send_response(code)
+                self.send_header("Content-Type",
+                                 ctype + "; charset=utf-8")
+                self.send_header("Content-Length", str(len(payload)))
+                self.end_headers()
+                self.wfile.write(payload)
+
+            def do_GET(self):
+                try:
+                    session = server._session_obj()
+                    if session is None:
+                        self._send(503, json.dumps(
+                            {"error": "session gone; server stopping"}))
+                        return
+                    path = self.path.split("?", 1)[0].rstrip("/") or "/"
+                    if path == "/metrics":
+                        # version 0.0.4 — the text exposition content
+                        # type Prometheus scrapers negotiate
+                        self._send(200, server._metrics_text(session),
+                                   "text/plain; version=0.0.4")
+                    elif path == "/queries":
+                        self._send(200, server._queries_json(session))
+                    elif path.startswith("/profiles/"):
+                        tail = path.rsplit("/", 1)[1]
+                        body = (server._profile_json(session, int(tail))
+                                if tail.isdigit() else None)
+                        if body is None:
+                            self._send(404, json.dumps(
+                                {"error": f"no profile for {tail!r} "
+                                          "(evicted or unknown)"}))
+                        else:
+                            self._send(200, body)
+                    elif path in ("/", "/healthz"):
+                        self._send(200, json.dumps(
+                            {"ok": True,
+                             "routes": ["/metrics", "/queries",
+                                        "/profiles/<qid>",
+                                        "/healthz"]}))
+                    else:
+                        self._send(404, json.dumps(
+                            {"error": f"unknown route {path!r}"}))
+                except (BrokenPipeError, ConnectionResetError):
+                    pass
+                except Exception as e:   # a bad scrape must not kill
+                    try:                 # the serving thread
+                        self._send(500, json.dumps(
+                            {"error": f"{type(e).__name__}: {e}"}))
+                    except OSError:
+                        pass
+
+        return Handler
+
+    def shutdown(self) -> None:
+        """Stop serving and release the socket (idempotent; also fired
+        automatically when the served session is garbage-collected)."""
+        self._shutdown_httpd(self._httpd)

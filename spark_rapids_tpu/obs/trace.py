@@ -40,6 +40,21 @@ _seq = itertools.count()
 _lock = threading.Lock()
 _tls = threading.local()
 
+# cross-process stitching state: synthetic lane ids for spans merged
+# from other processes (executor map stages), plus human labels the
+# Chrome exporter renders as thread_name metadata.  Real tids are
+# CPython thread idents (pthread pointers, far above this range), so
+# small synthetic ids cannot collide with them.  Bounded: labels embed
+# executor pids, so a long-lived driver restarting pools mints fresh
+# keys — past _MAX_LANES the oldest mapping evicts (its spans keep the
+# label in args["lane"]; only the chrome thread_name metadata for a
+# lane that old is lost).
+_MAX_LANES = 1024
+_lane_ids = itertools.count(1)
+_lane_map: Dict[Tuple[str, int], int] = {}   # (label, foreign tid) -> lane
+_lane_counts: Dict[str, int] = {}            # label -> lanes minted
+_tid_labels: Dict[int, str] = {}
+
 
 def configure(enabled: bool, buffer_spans: Optional[int] = None) -> None:
     """Process-wide tracer switch (called by TpuSparkSession from the
@@ -128,6 +143,58 @@ def span(name: str, cat: str = "exec",
     return _Span(name, cat, args)
 
 
+def record_foreign(spans: Sequence[Span], offset_ns: int,
+                   label: str) -> int:
+    """Merge spans recorded in ANOTHER process into this ring (the
+    cross-process trace stitch): each foreign timestamp is shifted by
+    ``offset_ns`` (foreign clock -> this process's perf_counter_ns
+    domain, aligned by the caller from the request/reply envelope) and
+    each foreign thread maps to a stable synthetic lane labeled
+    ``label`` (``label/t0``, ``label/t1``, ... when the foreign process
+    used several threads) that the Chrome exporter names via
+    thread_name metadata — executor map stages render as their own
+    lanes in Perfetto.  Returns the number of spans merged.  No-op when
+    tracing is disabled."""
+    if not _enabled or not spans:
+        return 0
+    n = 0
+    with _lock:
+        for s in spans:
+            seq_, ftid, name, cat, t0, dur, depth, args = s
+            key = (label, ftid)
+            lane = _lane_map.get(key)
+            if lane is None:
+                lane = next(_lane_ids)
+                _lane_map[key] = lane
+                nth = _lane_counts.get(label, 0)
+                _lane_counts[label] = nth + 1
+                _tid_labels[lane] = (label if nth == 0
+                                     else f"{label}/t{nth}")
+                while len(_lane_map) > _MAX_LANES:
+                    old_key = next(iter(_lane_map))
+                    _tid_labels.pop(_lane_map.pop(old_key), None)
+                    # drop a label's mint counter with its last lane —
+                    # labels embed executor pids, so a long-lived
+                    # driver would otherwise leak one counter per pool
+                    # generation forever
+                    old_label = old_key[0]
+                    if all(k[0] != old_label for k in _lane_map):
+                        _lane_counts.pop(old_label, None)
+            a = dict(args) if args else {}
+            a.setdefault("lane", _tid_labels[lane])
+            _ring.append((next(_seq), lane, name, cat,
+                          int(t0) + int(offset_ns), int(dur),
+                          int(depth), a))
+            n += 1
+    return n
+
+
+def lane_label(tid: int) -> Optional[str]:
+    """Human label of a synthetic (stitched) lane; None for real
+    threads."""
+    return _tid_labels.get(tid)
+
+
 def snapshot() -> List[Span]:
     with _lock:
         return list(_ring)
@@ -171,6 +238,13 @@ def chrome_trace(spans: Optional[Sequence[Span]] = None
     by_tid: Dict[int, List[Span]] = {}
     for s in spans:
         by_tid.setdefault(s[1], []).append(s)
+    # stitched executor lanes get their human name (thread_name
+    # metadata events — Perfetto renders the label on the lane)
+    for tid in sorted(by_tid):
+        label = _tid_labels.get(tid)
+        if label is not None:
+            events.append({"name": "thread_name", "ph": "M", "pid": 0,
+                           "tid": tid, "args": {"name": label}})
     for tid, ss in sorted(by_tid.items()):
         ivs = sorted(((s[4], s[4] + s[5], s[0], s) for s in ss),
                      key=lambda x: (x[0], -x[1], x[2]))

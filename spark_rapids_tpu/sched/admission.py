@@ -31,6 +31,7 @@ import threading
 import time
 from typing import Any, Callable, Dict, Optional
 
+from spark_rapids_tpu.obs import recorder as obsrec
 from spark_rapids_tpu.obs import registry as obsreg
 from spark_rapids_tpu.obs import trace as obstrace
 from spark_rapids_tpu.sched import cancel as _cancel
@@ -268,6 +269,9 @@ class AdmissionController:
         with self._cond:
             if len(self._queue) >= self.max_queued:
                 reg.inc("sched.rejected")
+                obsrec.record_event("sched.rejected",
+                                    query=req.query_id,
+                                    queued=len(self._queue))
                 raise QueryRejectedError(
                     f"query {req.query_id}: wait queue full "
                     f"({self.max_queued} queued)")
@@ -287,6 +291,12 @@ class AdmissionController:
                         self._running[req.query_id] = req.estimate
                         self.admitted_bytes += req.estimate
                         reg.inc("sched.admitted")
+                        obsrec.record_event(
+                            "sched.admitted", query=req.query_id,
+                            estimate_bytes=req.estimate,
+                            priority=req.priority,
+                            running=len(self._running),
+                            admitted_bytes=self.admitted_bytes)
                         self._publish_locked()
                         # wake the NEW head: budget may fit it too —
                         # without this, back-to-back admissions staircase
@@ -327,6 +337,9 @@ class AdmissionController:
             reg.inc("sched.timedOut")
         else:
             reg.inc("sched.cancelled")
+        obsrec.record_event(
+            "sched.cancelledWhileQueued", query=req.query_id,
+            timed_out=bool(req.token.timed_out))
         try:
             req.token.check()
         except _cancel.QueryCancelledError as e:
@@ -349,6 +362,9 @@ class AdmissionController:
             if freed:
                 obsreg.get_registry().inc("sched.pressureSpillBytes",
                                           freed)
+                obsrec.record_event("sched.pressureSpill",
+                                    freed_bytes=freed,
+                                    headroom_bytes=headroom)
 
     def _release(self, req: AdmissionRequest) -> None:
         with self._cond:
@@ -357,3 +373,6 @@ class AdmissionController:
                 self.admitted_bytes -= est
             self._publish_locked()
             self._cond.notify_all()
+        if est is not None:
+            obsrec.record_event("sched.released", query=req.query_id,
+                                estimate_bytes=est)

@@ -35,6 +35,9 @@ import threading
 import time
 from typing import Any, Dict, Optional
 
+from collections import deque
+
+from spark_rapids_tpu.obs import recorder as obsrec
 from spark_rapids_tpu.obs import registry as obsreg
 from spark_rapids_tpu.sched import cancel as _cancel
 from spark_rapids_tpu.sched.admission import (AdmissionController,
@@ -158,6 +161,12 @@ class QueryService:
             pressure_cb=spill.handle_memory_pressure)
         self.book = EstimateBook()
         self._tls = threading.local()
+        # live query table (the /queries telemetry surface): every
+        # submitted future while queued/running, plus a bounded
+        # recently-completed window
+        self._track_lock = threading.Lock()
+        self._active: Dict[int, Dict[str, Any]] = {}
+        self._recent: "deque" = deque(maxlen=64)
 
     @staticmethod
     def _derived_budget() -> int:
@@ -195,6 +204,55 @@ class QueryService:
     def _observe(self, plan, hwm_bytes: int) -> None:
         self.book.record(plan_shape_key(plan), hwm_bytes)
 
+    # -- live query table (the /queries telemetry surface) -------------------
+    def _track(self, fut: QueryFuture, req: AdmissionRequest) -> None:
+        with self._track_lock:
+            self._active[fut.query_id] = {
+                "future": fut, "request": req,
+                "submitted_unix": time.time()}
+
+    def _untrack(self, fut: QueryFuture) -> None:
+        with self._track_lock:
+            info = self._active.pop(fut.query_id, None)
+            if info is not None:
+                info["finished_unix"] = time.time()
+                # freeze to the scalar row NOW: keeping the future
+                # would pin its materialized result table (and
+                # span-laden profile) in the recent window for up to
+                # 64 queries after the caller dropped it
+                self._recent.append(self._table_row(info))
+
+    @staticmethod
+    def _table_row(info: Dict[str, Any]) -> Dict[str, Any]:
+        fut, req = info["future"], info["request"]
+        row = {
+            "query_id": fut.query_id,
+            "state": fut.state.value,
+            "priority": req.priority,
+            "estimate_bytes": req.estimate,
+            "queue_wait_ms": round(req.queue_wait_ns / 1e6, 3),
+            "submitted_unix": info["submitted_unix"],
+        }
+        fin = info.get("finished_unix")
+        if fin is not None:
+            row["finished_unix"] = fin
+            row["wall_s"] = round(fin - info["submitted_unix"], 4)
+            err = fut._error
+            if err is not None:
+                row["error"] = f"{type(err).__name__}: {err}"
+        return row
+
+    def query_table(self) -> list:
+        """Queued/running queries plus the recently-completed window,
+        as JSON-friendly rows (state, priority, admitted estimate,
+        queue wait) — the ``/queries`` endpoint payload.  Completed
+        rows are pre-frozen scalar snapshots (see ``_untrack``)."""
+        with self._track_lock:
+            live = sorted(self._active.values(),
+                          key=lambda i: i["future"].query_id)
+            done = list(self._recent)
+        return [self._table_row(i) for i in live] + done
+
     # -- submission ----------------------------------------------------------
     def submit(self, plan, priority: int = 0,
                timeout_ms: Optional[int] = None,
@@ -207,14 +265,20 @@ class QueryService:
             tok = _cancel.current() or _cancel.CancelToken(qid)
             fut = QueryFuture(qid, tok)
             fut._set_running()
+            # nested runs ride the live table too (zero-estimate: they
+            # execute under the parent's admission slot)
+            self._track(fut, AdmissionRequest(qid, 0, priority=priority,
+                                              token=tok))
             try:
                 table, prof = self._session._execute_attributed(
                     plan, query_id=qid, sched_extra={"sched.nested": 1})
             except BaseException as e:
                 fut._finish(QueryState.FAILED, error=e,
                             profile=self._session.query_profile(qid))
+                self._untrack(fut)
                 raise
             fut._finish(QueryState.SUCCESS, result=table, profile=prof)
+            self._untrack(fut)
             return fut
         reg.inc("sched.submitted")
         token = _cancel.CancelToken(qid)
@@ -222,6 +286,10 @@ class QueryService:
         req = AdmissionRequest(
             qid, self._estimate(plan, estimate_bytes),
             priority=priority, token=token)
+        self._track(fut, req)
+        obsrec.record_event("sched.submitted", query=qid,
+                            priority=req.priority,
+                            estimate_bytes=req.estimate)
         ms = self.default_timeout_ms if timeout_ms is None \
             else int(timeout_ms)
         timer = None
@@ -295,3 +363,6 @@ class QueryService:
             if timer is not None:
                 timer.cancel()
             self._tls.in_query = False
+            self._untrack(fut)
+            obsrec.record_event("sched.finished", query=fut.query_id,
+                                state=fut.state.value)

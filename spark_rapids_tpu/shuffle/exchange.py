@@ -724,10 +724,24 @@ class TpuShuffleExchangeExec(TpuExec):
         def submit(pool, exec_idx: int, sid: int):
             """Ship this exchange's map stage for executor ``exec_idx``;
             returns completed map ids (raises on task failure)."""
+            import time as _time
+            from spark_rapids_tpu.obs import trace as obstrace
             h = pool.handle(exec_idx)
+            trace_on = obstrace.is_enabled()
+            clock_offset = None
+            if trace_on:
+                # NTP-style alignment: the handle brackets a
+                # lightweight clock op INSIDE its per-call lock (so
+                # another query's in-flight map stage can't inflate the
+                # measured round trip) and maps the executor clock into
+                # the driver domain as midpoint - t_ns, error bounded
+                # by half a pipe round trip — microseconds, vs the
+                # multi-ms spans it places
+                clock_offset = h.clock_sync()
             reply = h.call({"op": "map_stage", "exchange": self,
                             "shuffle_id": sid, "n_execs": n_execs,
-                            "exec_idx": exec_idx})
+                            "exec_idx": exec_idx, "trace": trace_on})
+            t_recv = _time.perf_counter_ns()
             if not reply.get("ok"):
                 raise RuntimeError(
                     f"map stage on {h.executor_id} failed: "
@@ -746,6 +760,21 @@ class TpuShuffleExchangeExec(TpuExec):
             from spark_rapids_tpu.exec.base import merge_plan_metrics
             merge_plan_metrics(self, reply.get("metrics"),
                                skip_root=True)
+            # executor-side SPANS come home too (trace stitching): shift
+            # them into the driver's clock domain and merge as labeled
+            # executor lanes, so map stages render as real lanes in the
+            # query's Chrome trace.  Fallback alignment when the clock
+            # probe failed: assume zero reply transit (clock_ns was
+            # stamped at reply construction).
+            if trace_on and reply.get("spans"):
+                off = clock_offset
+                if off is None and reply.get("clock_ns"):
+                    off = t_recv - int(reply["clock_ns"])
+                if off is not None:
+                    obstrace.record_foreign(
+                        reply["spans"], off,
+                        label=f"executor-{exec_idx} "
+                              f"pid={reply.get('pid', '?')}")
             return h, reply["maps"]
 
         def materialize():

@@ -50,6 +50,22 @@ def _run_map_stage(task: dict, catalog, nested_transport: str) -> dict:
     """Execute the shipped exchange's map side for this executor's share
     of input partitions, registering slices in the local catalog."""
     exch = task["exchange"]
+    # cross-process trace stitching: when the driver traces, this
+    # executor records its own span window for the stage and ships it
+    # home with the reply (the collect_plan_metrics idiom for spans);
+    # the driver aligns clocks from the request/reply envelope and
+    # merges the spans as executor lanes (obs/trace.record_foreign)
+    span_mark = None
+    from spark_rapids_tpu.obs import trace as obstrace
+    if task.get("trace"):
+        obstrace.configure(True)
+        span_mark = obstrace.mark()
+    elif obstrace.is_enabled():
+        # the driver stopped tracing: stand the executor tracer back
+        # down (and free its ring) — a sticky enable would pay the
+        # record() path and hold spans forever on untraced tasks
+        obstrace.configure(False)
+        obstrace.clear()
     # nested exchanges inside the shipped fragment execute in-process —
     # an executor must not recursively spawn its own executor fleet.
     # With --nested-transport=ici they ride the executor's OWN device
@@ -71,8 +87,18 @@ def _run_map_stage(task: dict, catalog, nested_transport: str) -> dict:
     # them into its own tree so executor-side work is not dropped from
     # the query profile (exec/base.merge_plan_metrics)
     from spark_rapids_tpu.exec.base import collect_plan_metrics
-    return {"ok": True, "maps": maps, "nested_transports": nested,
-            "metrics": collect_plan_metrics(exch)}
+    reply = {"ok": True, "maps": maps, "nested_transports": nested,
+             "metrics": collect_plan_metrics(exch)}
+    if span_mark is not None:
+        import os
+        import time
+        from spark_rapids_tpu.obs import trace as obstrace
+        reply["spans"] = obstrace.spans_since(span_mark)
+        # this process's clock at reply construction — the driver's
+        # zero-transit fallback alignment when the clock op was lost
+        reply["clock_ns"] = time.perf_counter_ns()
+        reply["pid"] = os.getpid()
+    return reply
 
 
 def main() -> None:
@@ -115,6 +141,13 @@ def main() -> None:
                 with catalog._lock:
                     nblocks = len(catalog._blocks)
                 write_frame(out, {"ok": True, "blocks": nblocks})
+            elif msg["op"] == "clock":
+                # NTP-style clock alignment probe: the driver brackets
+                # this short round trip with its own perf_counter_ns
+                # reads and maps executor time as midpoint - t_ns
+                import time
+                write_frame(out, {"ok": True,
+                                  "t_ns": time.perf_counter_ns()})
             elif msg["op"] == "ping":
                 write_frame(out, {"ok": True})
             else:

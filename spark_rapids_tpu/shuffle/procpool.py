@@ -50,6 +50,29 @@ class ExecutorHandle:
                                  "pipe mid-request"}
             return reply
 
+    def clock_sync(self) -> Optional[int]:
+        """NTP-midpoint clock offset (driver perf_counter_ns domain
+        minus this executor's), or None when the probe fails.  The
+        round trip is bracketed INSIDE the handle lock: under
+        concurrent queries ``call`` waits behind another query's
+        multi-second map stage, and an offset computed around that
+        wait would mis-place stitched spans by seconds — bracketed
+        here, the error is bounded by half a pipe round trip."""
+        import time
+        with self._lock:
+            if not self.alive:
+                return None
+            try:
+                t_req = time.perf_counter_ns()
+                write_frame(self.proc.stdin, {"op": "clock"})
+                reply = read_frame(self.proc.stdout)
+                t_rsp = time.perf_counter_ns()
+            except (BrokenPipeError, OSError):
+                return None
+        if not reply or not reply.get("ok"):
+            return None
+        return (t_req + t_rsp) // 2 - int(reply["t_ns"])
+
     def kill(self) -> None:
         self.proc.kill()
         self.proc.wait()
