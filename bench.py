@@ -41,6 +41,7 @@ import os
 import subprocess
 import sys
 import tempfile
+import threading
 import time
 
 import numpy as np
@@ -432,6 +433,77 @@ def _write_profile(root: str, out_path: str):
     return out_path
 
 
+def _serve_probe(root: str, n_clients: int) -> dict:
+    """N remote clients through the serving front-end (serve/): each
+    client prepares the q6-class statement once and executes it
+    repeatedly with a per-client binding — the dashboard access
+    pattern.  Repeats within a client hit the result-set cache, so the
+    probe reports both the remote queries/sec and the hit ratio, plus
+    a parity check of every remote result against the in-process
+    oracle."""
+    from spark_rapids_tpu import TpuSparkSession
+    from spark_rapids_tpu.obs import registry as obsreg
+    from spark_rapids_tpu.serve.client import ServeClient
+
+    s = TpuSparkSession({
+        "spark.rapids.tpu.sql.variableFloatAgg.enabled": True,
+        "spark.rapids.tpu.serve.enabled": True})
+    s.register_view("ss", s.read.parquet(root))
+    sql = ("select ss_item_sk, count(*) as cnt, sum(ss_quantity) as "
+           "qty from ss where ss_sales_price > :lo group by "
+           "ss_item_sk order by ss_item_sk")
+    cuts = [150.0 + 2.0 * i for i in range(n_clients)]
+    oracles = {lo: s.sql(sql.replace(":lo", repr(lo))).collect()
+               for lo in cuts}
+    repeats = 3
+    view = obsreg.get_registry().view()
+    results: dict = {}
+    errors: list = []
+
+    def run(idx: int) -> None:
+        try:
+            lo = cuts[idx]
+            with ServeClient("127.0.0.1", s.serve_server.port) as c:
+                h = c.prepare(sql, params={"lo": "double"})
+                results[idx] = [h.execute({"lo": lo})
+                                for _ in range(repeats)]
+        except Exception as e:
+            errors.append(f"client {idx}: {type(e).__name__}: {e}")
+
+    t0 = time.perf_counter()
+    threads = [threading.Thread(target=run, args=(i,))
+               for i in range(n_clients)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=900)
+    wall = time.perf_counter() - t0
+    total = n_clients * repeats
+    # a failed or hung client must fail the probe, not silently skip
+    # its parity check
+    assert not errors, errors
+    hung = [t.name for t in threads if t.is_alive()]
+    assert not hung, f"serve clients still running: {hung}"
+    for i in range(n_clients):
+        got = results.get(i, [])
+        assert len(got) == repeats, f"client {i}: {len(got)} results"
+        for r in got:
+            assert r.equals(oracles[cuts[i]]), \
+                f"serve client {i} diverges from the in-process oracle"
+    d = view.delta()["counters"]
+    s.serve_server.shutdown()
+    return {
+        "n_clients": n_clients,
+        "queries": total,
+        "wall_s": round(wall, 3),
+        "queries_per_sec": round(total / wall, 3),
+        "result_cache_hits": int(d.get("serve.resultCacheHits", 0)),
+        "result_cache_misses": int(d.get("serve.resultCacheMisses", 0)),
+        "streamed_batches": int(d.get("serve.streamedBatches", 0)),
+        "rows_match": True,
+    }
+
+
 def main() -> None:
     import spark_rapids_tpu  # noqa: F401 (x64, compile cache)
 
@@ -441,11 +513,14 @@ def main() -> None:
     smoke = "--smoke" in sys.argv
     profile_out = None
     concurrent_n = None    # None = flag absent; 0 = explicitly off
+    serve_n = 0            # --serve=N remote clients; 0 = off
     for a in sys.argv[1:]:
         if a.startswith("--profile-out="):
             profile_out = a.split("=", 1)[1]
         elif a.startswith("--concurrent="):
             concurrent_n = int(a.split("=", 1)[1])
+        elif a.startswith("--serve="):
+            serve_n = int(a.split("=", 1)[1])
     if smoke:
         n = 160_000
         if concurrent_n is None:
@@ -481,6 +556,10 @@ def main() -> None:
         concurrent = None
         if concurrent_n:
             concurrent = _concurrent_probe(root, concurrent_n)
+
+        serve = None
+        if serve_n:
+            serve = _serve_probe(root, serve_n)
 
         e2e = None
         if not smoke:
@@ -518,6 +597,7 @@ def main() -> None:
         "rows_match": bool(rows_match),
         "dispatch_probe": dispatch_probe,
         "concurrent": concurrent,
+        "serve": serve,
         "e2e_tunnel_wall_s": round(e2e, 2) if e2e else None,
         "vs_baseline_e2e": round(cpu_time / e2e, 4) if e2e else None,
         "profile_out": profile_out,

@@ -185,6 +185,123 @@ print(f"concurrency smoke OK: 8/8 bit-identical, "
       f"peak running seen {seen_running}, endpoint validated")
 EOF
 
+echo "== serving smoke (3 remote clients, prepared + ad-hoc + result-cache hit, live /metrics scrape) =="
+timeout 300 python - <<'EOF'
+# the multi-tenant serving front-end (serve/): an ephemeral-port server
+# over one engine session, driven by 3 concurrent remote clients —
+# one ad-hoc, one prepared with two bindings, one repeating a query to
+# assert a result-set-cache hit with ZERO incremental device
+# dispatches and zero scheduler submissions.  /metrics is scraped
+# DURING the run; every remote result is checked bit-identical to the
+# in-process collect() oracle.
+import json, os, tempfile, threading, urllib.request
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+import pyarrow as pa, pyarrow.parquet as papq
+from spark_rapids_tpu import TpuSparkSession
+from spark_rapids_tpu.obs import registry as obsreg
+from spark_rapids_tpu.obs.server import parse_prometheus
+from spark_rapids_tpu.serve.client import ServeClient
+
+root = tempfile.mkdtemp(prefix="serve_smoke_")
+papq.write_table(pa.table({
+    "k": [i % 9 for i in range(6000)],
+    "x": [float((i * 7) % 250) for i in range(6000)]}),
+    os.path.join(root, "t.parquet"))
+s = TpuSparkSession({
+    "spark.rapids.tpu.sql.variableFloatAgg.enabled": True,
+    "spark.rapids.tpu.serve.enabled": True,
+    "spark.rapids.tpu.obs.http.enabled": True})
+s.register_view("t", s.read.parquet(root))
+
+ADHOC = ("select k, count(*) as c, sum(x) as sx from t "
+         "where x > 40.0 group by k order by k")
+PREP = ("select k, sum(x) as sx from t where x > :lo "
+        "group by k order by k")
+HOT = "select k, max(x) as mx from t group by k order by k"
+oracle_adhoc = s.sql(ADHOC).collect()
+oracle_prep = {lo: s.sql(PREP.replace(":lo", repr(lo))).collect()
+               for lo in (30.0, 120.0)}
+oracle_hot = s.sql(HOT).collect()
+
+port = s.serve_server.port
+results, errors = {}, []
+
+def adhoc_client():
+    with ServeClient("127.0.0.1", port) as c:
+        results["adhoc"] = [c.sql(ADHOC) for _ in range(2)]
+
+def prepared_client():
+    with ServeClient("127.0.0.1", port) as c:
+        h = c.prepare(PREP, params={"lo": "double"})
+        results["prep"] = {lo: h.execute({"lo": lo})
+                           for lo in (30.0, 120.0)}
+
+def hot_client():
+    with ServeClient("127.0.0.1", port) as c:
+        first = c.sql(HOT)                 # populates the result cache
+        view = obsreg.get_registry().view()
+        second = c.sql(HOT)                # must be served from it
+        d = view.delta()["counters"]
+        assert d.get("kernel.dispatches", 0) == 0, (
+            f"result-cache hit dispatched kernels: {d}")
+        assert d.get("serve.resultCacheHits", 0) == 1, d
+        assert d.get("sched.submitted", 0) == 0, d
+        results["hot"] = [first, second]
+
+def run(fn):
+    def wrapped():
+        try:
+            fn()
+        except Exception as e:
+            errors.append(f"{fn.__name__}: {type(e).__name__}: {e}")
+    t = threading.Thread(target=wrapped)
+    t.start()
+    return t
+
+threads = [run(adhoc_client), run(prepared_client)]
+# live scrape while the first two clients are in flight: the
+# exposition must parse (parse_prometheus raises on a malformed line)
+# and already carry the serving gauges
+with urllib.request.urlopen(
+        f"http://127.0.0.1:{s.obs_server.port}/metrics", timeout=10) as r:
+    live = parse_prometheus(r.read().decode())
+assert "spark_rapids_tpu_serve_activeSessions" in live, sorted(live)[:20]
+for t in threads:
+    t.join(timeout=240)
+threads = [run(hot_client)]
+for t in threads:
+    t.join(timeout=240)
+assert not errors, errors
+
+for got in results["adhoc"]:
+    assert got.equals(oracle_adhoc), "ad-hoc result diverges"
+for lo, got in results["prep"].items():
+    assert got.equals(oracle_prep[lo]), f"prepared({lo}) diverges"
+for got in results["hot"]:
+    assert got.equals(oracle_hot), "hot-query result diverges"
+
+# post-run exposition: serving counters made it to /metrics
+with urllib.request.urlopen(
+        f"http://127.0.0.1:{s.obs_server.port}/metrics", timeout=10) as r:
+    m = parse_prometheus(r.read().decode())
+assert m.get("spark_rapids_tpu_serve_sessions", 0) >= 3, m
+assert m.get("spark_rapids_tpu_serve_statementsPrepared", 0) >= 1
+assert m.get("spark_rapids_tpu_serve_resultCacheHits", 0) >= 1
+assert m.get("spark_rapids_tpu_serve_streamedBatches", 0) >= 5
+# the live /queries table attributed the remote sessions
+with urllib.request.urlopen(
+        f"http://127.0.0.1:{s.obs_server.port}/queries", timeout=10) as r:
+    rows = json.loads(r.read().decode())["queries"]
+served = [r for r in rows if r.get("session_id")]
+assert served and all(r["plan_digest"] for r in served), rows
+s.serve_server.shutdown()
+s.obs_server.shutdown()
+print(f"serving smoke OK: 3 clients bit-identical, "
+      f"cache hit with 0 incremental dispatches, "
+      f"{int(m.get('spark_rapids_tpu_serve_streamedBatches', 0))} "
+      f"chunks streamed")
+EOF
+
 echo "== smoke bench (tracing enabled) =="
 python bench.py --smoke --profile-out=/tmp/bench_profile.json
 

@@ -108,6 +108,12 @@ class TpuSparkSession:
             self._obs_server = ObsHttpServer(
                 self, host=str(self.conf.get(cfg.OBS_HTTP_HOST)),
                 port=int(self.conf.get(cfg.OBS_HTTP_PORT)))
+        # -- multi-tenant serving front-end (serve/server.py): off by
+        # default — no socket, no threads, no result-cache mutation
+        self._serve_server = None
+        if self.conf.get(cfg.SERVE_ENABLED):
+            from spark_rapids_tpu.serve.server import ServeServer
+            self._serve_server = ServeServer(self)
 
     # -- builder-compatible construction -----------------------------------
     class Builder:
@@ -271,7 +277,8 @@ class TpuSparkSession:
 
     def _execute_attributed(self, plan: lp.LogicalPlan,
                             query_id: Optional[int] = None,
-                            sched_extra: Optional[Dict[str, Any]] = None):
+                            sched_extra: Optional[Dict[str, Any]] = None,
+                            plan_digest: Optional[str] = None):
         """Execute an action with the observability envelope: a
         QueryRun captures wall phases, the per-query registry delta and
         span window; the assembled QueryProfile lands in the profile
@@ -285,7 +292,8 @@ class TpuSparkSession:
             from spark_rapids_tpu.obs.profile import QueryRun
             run = QueryRun(query_id if query_id is not None
                            else self._next_query_id(),
-                           sched_extra=sched_extra)
+                           sched_extra=sched_extra,
+                           plan_digest=plan_digest)
         try:
             result, table = self._execute_inner(plan, run)
         except BaseException as e:
@@ -328,14 +336,55 @@ class TpuSparkSession:
                 prof.dump_chrome_trace(chrome)
         return prof
 
+    def _record_rejection(self, query_id: int,
+                          error: BaseException, req,
+                          meta: Optional[Dict[str, Any]] = None) -> None:
+        """A query refused BEFORE admission (queue-full rejection)
+        never reaches the profile assembly path, so without this hook
+        neither the flight recorder nor the slow-query log would ever
+        see it — serving overload would be undiagnosable.  Build a
+        stub QueryProfile with the same schema (status ``rejected``),
+        put it through the ring, the listener fan-out (the flight
+        recorder bundles it under reason ``rejected``) and the
+        slow-query log.  Never raises."""
+        try:
+            from spark_rapids_tpu.obs import listener as obs_listener
+            from spark_rapids_tpu.obs.profile import QueryProfile
+            meta = dict(meta or {})
+            sched = {"sched.estimateBytes": getattr(req, "estimate", 0),
+                     "sched.priority": getattr(req, "priority", 0)}
+            if meta.get("session_id") is not None:
+                sched["sched.sessionId"] = meta["session_id"]
+            prof = QueryProfile(
+                query_id=query_id,
+                status="rejected",
+                error=f"{type(error).__name__}: {error}",
+                result_rows=None, wall_ns=0, phases={}, plan=None,
+                metrics={"sched": sched},
+                wall_breakdown={}, explain_lines=[], spans=[],
+                plan_digest=meta.get("plan_digest"))
+            with self._profile_lock:
+                self._profiles[query_id] = prof
+                while len(self._profiles) > self._profile_ring:
+                    self._profiles.popitem(last=False)
+            obs_listener.notify(self._query_listeners, prof, error)
+            self._maybe_log_slow_query(prof)
+        except Exception:
+            pass
+
     def _maybe_log_slow_query(self, prof) -> None:
         """Structured slow-query log: one JSONL record per query at or
         over ``obs.slowQueryMs`` (failures included — a query that died
-        slowly is still slow), appended to ``obs.slowQueryPath`` or
+        slowly is still slow; ``rejected`` queries log regardless of
+        wall, an instant rejection being exactly the overload signal
+        the log exists for), appended to ``obs.slowQueryPath`` or
         routed through the ``spark_rapids_tpu.obs.slowquery`` logger.
         Never fails the query."""
         threshold_ms = int(self.conf.get(cfg.OBS_SLOW_QUERY_MS))
-        if threshold_ms <= 0 or prof.wall_ns < threshold_ms * 1e6:
+        if threshold_ms <= 0:
+            return
+        if prof.status != "rejected" and \
+                prof.wall_ns < threshold_ms * 1e6:
             return
         try:
             import json as _json
@@ -346,10 +395,13 @@ class TpuSparkSession:
             d = prof.to_dict()
             record = {"ts_unix": _time.time(),
                       "threshold_ms": threshold_ms,
+                      "session_id": prof.metrics.get("sched", {}).get(
+                          "sched.sessionId"),
                       "queue_wait_s": prof.metrics.get("sched", {}).get(
                           "sched.queueWaitNs", 0) / 1e9}
-            for key in ("query_id", "status", "error", "wall_s",
-                        "result_rows", "phases", "wall_breakdown"):
+            for key in ("query_id", "plan_digest", "status", "error",
+                        "wall_s", "result_rows", "phases",
+                        "wall_breakdown"):
                 record[key] = d[key]
             line = _json.dumps(record, default=str)
             from spark_rapids_tpu.obs import recorder as obs_recorder
@@ -441,6 +493,14 @@ class TpuSparkSession:
         """The flight recorder (obs/recorder.FlightRecorder) when
         ``obs.recorder.dir`` is set; None otherwise."""
         return self._recorder
+
+    @property
+    def serve_server(self):
+        """The multi-tenant serving front-end (serve/server.ServeServer)
+        when ``serve.enabled=true``; None otherwise.
+        ``serve_server.port`` is the bound port (ephemeral under
+        ``serve.port=0``)."""
+        return self._serve_server
 
     def last_query_profile(self):
         """The QueryProfile of the most recently COMPLETED action (None

@@ -634,6 +634,64 @@ OBS_SLOW_QUERY_PATH = conf(
     "Append-mode file for slow-query JSONL records (one JSON object "
     "per line). Empty routes records to the python logger instead.")
 
+SERVE_ENABLED = conf(
+    "spark.rapids.tpu.serve.enabled", False,
+    "Start the multi-tenant SQL serving front-end (serve/server.py): a "
+    "background TCP server multiplexing remote client sessions onto "
+    "this session's QueryService — length-prefixed wire protocol, "
+    "per-session conf overlays and fair-share caps, prepared "
+    "statements, a stamped result-set cache, and chunked streaming "
+    "result delivery with client-credit backpressure. Off by default: "
+    "nothing binds a socket.", bool)
+
+SERVE_PORT = conf(
+    "spark.rapids.tpu.serve.port", 0,
+    "TCP port for the serving front-end when serve.enabled=true. 0 "
+    "binds an ephemeral port (discover it via "
+    "session.serve_server.port — the CI smoke idiom).", int)
+
+SERVE_HOST = conf(
+    "spark.rapids.tpu.serve.host", "127.0.0.1",
+    "Bind address for the serving front-end (loopback by default; the "
+    "protocol is unauthenticated, widen deliberately).")
+
+SERVE_SESSION_IDLE_TIMEOUT_MS = conf(
+    "spark.rapids.tpu.serve.session.idleTimeoutMs", 600_000,
+    "Evict a client session after this much inactivity with no query "
+    "in flight (prepared statements and the session conf overlay go "
+    "with it; the next request on an evicted session gets a typed "
+    "SessionExpired error and must re-hello).", int)
+
+SERVE_SESSION_MAX_INFLIGHT = conf(
+    "spark.rapids.tpu.serve.session.maxInFlight", 4,
+    "Fair-share cap on concurrently in-flight queries per client "
+    "session; past it a request is refused with FairShareExceeded "
+    "(back-pressure to that client) so one greedy client cannot "
+    "monopolize sched.memoryBudget or the admission queue.", int)
+
+SERVE_RESULT_CACHE_ENABLED = conf(
+    "spark.rapids.tpu.serve.resultCache.enabled", True,
+    "Cache materialized query results keyed on (canonical plan digest, "
+    "output names, source file stamps): a repeated deterministic query "
+    "over unchanged files is served straight from host memory — zero "
+    "device dispatches — and invalidates automatically when a source "
+    "file's (mtime, size) stamp moves (the scan-cache contract applied "
+    "to whole results). Non-deterministic plans (rand, UDFs) and "
+    "unstampable sources never enter.", bool)
+
+SERVE_RESULT_CACHE_MAX_BYTES = conf(
+    "spark.rapids.tpu.serve.resultCache.maxBytes", 256 << 20,
+    "Byte budget for the serving result-set cache; least-recently-used "
+    "results evict past it. A single result larger than the whole "
+    "budget is never cached.", int)
+
+SERVE_STREAM_CHUNK_ROWS = conf(
+    "spark.rapids.tpu.serve.stream.chunkRows", 65536,
+    "Rows per streamed Arrow result chunk. Each chunk costs one CHUNK "
+    "frame and one client credit, so this knob trades per-frame "
+    "overhead against backpressure granularity (a slow consumer bounds "
+    "the server's read-ahead to its credit window times this).", int)
+
 OBS_PROFILE_ENABLED = conf(
     "spark.rapids.tpu.obs.profile.enabled", True,
     "Assemble a QueryProfile after every action (annotated plan tree, "

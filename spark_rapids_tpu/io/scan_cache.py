@@ -196,6 +196,22 @@ def source_key(src) -> Optional[Tuple]:
     return blob_key(src)
 
 
+def source_stamps(paths) -> Optional[Tuple[Tuple, ...]]:
+    """Current content stamps for a set of scan sources: the sorted
+    tuple of ``file_key`` stamps — the same (path, mtime_ns, size)
+    invalidation contract the scan-plan cache keys on, exposed so the
+    serving tier's result-set cache can key whole query results on it
+    (serve/result_cache.py).  None when any path can't be stat'ed: a
+    result derived from an unstampable source must not be cached."""
+    out = []
+    for p in paths:
+        k = file_key(p)
+        if k is None:
+            return None
+        out.append(k)
+    return tuple(sorted(out))
+
+
 def handle_key(pf, src) -> Optional[Tuple]:
     """Plan-cache key for chunks walked through the open handle ``pf``:
     the stamp captured when the footer was parsed (FooterInfo), NOT a

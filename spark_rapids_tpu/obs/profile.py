@@ -114,12 +114,17 @@ class QueryProfile:
     wall_breakdown: Dict[str, float]     # phase -> seconds
     explain_lines: List[str]
     spans: List[Dict[str, Any]]
+    # canonical logical-plan digest (plan/digest.py): alias-insensitive
+    # identity shared with the kernel-cache keys and the serving tier's
+    # result-set cache; also a /queries column
+    plan_digest: Optional[str] = None
     _raw_spans: List[Any] = field(default_factory=list, repr=False)
 
     # -- rendering ---------------------------------------------------------
     def to_dict(self) -> Dict[str, Any]:
         return {
             "query_id": self.query_id,
+            "plan_digest": self.plan_digest,
             "status": self.status,
             "error": self.error,
             "result_rows": self.result_rows,
@@ -229,8 +234,10 @@ class QueryRun:
     """Per-query capture opened by the session before planning."""
 
     def __init__(self, query_id: int,
-                 sched_extra: Optional[Dict[str, Any]] = None):
+                 sched_extra: Optional[Dict[str, Any]] = None,
+                 plan_digest: Optional[str] = None):
         self.query_id = query_id
+        self.plan_digest = plan_digest
         self.phases: Dict[str, int] = {}
         # the session stashes the planner's OverrideResult here as soon
         # as planning succeeds, so a mid-execution failure still
@@ -289,6 +296,7 @@ class QueryRun:
         raw_spans = obstrace.spans_since(self._span_mark)
         prof = QueryProfile(
             query_id=self.query_id,
+            plan_digest=self.plan_digest,
             status="failure" if error is not None else "success",
             error=(f"{type(error).__name__}: {error}"
                    if error is not None else None),

@@ -94,6 +94,8 @@ def _classify(exc: Optional[BaseException]) -> str:
     if exc is None:
         return "oom-retry"
     names = {c.__name__ for c in type(exc).__mro__}
+    if "QueryRejectedError" in names:
+        return "rejected"         # refused before admission (queue full)
     if "QueryTimeoutError" in names:
         return "timeout"
     if "QueryCancelledError" in names:

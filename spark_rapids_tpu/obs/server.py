@@ -153,6 +153,20 @@ class ObsHttpServer:
             reg.set_gauge("sched.admittedBytes", st["admitted_bytes"])
         except Exception:
             pass
+        try:
+            # serving-tier gauges, refreshed at scrape time like the
+            # scheduler's (a scrape between requests must see current
+            # session/cache levels, not the last mutation's publish)
+            srv = getattr(session, "serve_server", None)
+            if srv is not None:
+                from spark_rapids_tpu.serve import result_cache
+                reg.set_gauge("serve.activeSessions",
+                              len(srv.sessions()))
+                rc = result_cache.stats()
+                reg.set_gauge("serve.resultCacheBytes", rc["bytes"])
+                reg.set_gauge("serve.resultCacheEntries", rc["entries"])
+        except Exception:
+            pass
         return render_prometheus(reg.snapshot())
 
     @staticmethod

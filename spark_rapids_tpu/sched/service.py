@@ -205,10 +205,11 @@ class QueryService:
         self.book.record(plan_shape_key(plan), hwm_bytes)
 
     # -- live query table (the /queries telemetry surface) -------------------
-    def _track(self, fut: QueryFuture, req: AdmissionRequest) -> None:
+    def _track(self, fut: QueryFuture, req: AdmissionRequest,
+               meta: Optional[Dict[str, Any]] = None) -> None:
         with self._track_lock:
             self._active[fut.query_id] = {
-                "future": fut, "request": req,
+                "future": fut, "request": req, "meta": dict(meta or {}),
                 "submitted_unix": time.time()}
 
     def _untrack(self, fut: QueryFuture) -> None:
@@ -225,6 +226,7 @@ class QueryService:
     @staticmethod
     def _table_row(info: Dict[str, Any]) -> Dict[str, Any]:
         fut, req = info["future"], info["request"]
+        meta = info.get("meta") or {}
         row = {
             "query_id": fut.query_id,
             "state": fut.state.value,
@@ -232,6 +234,12 @@ class QueryService:
             "estimate_bytes": req.estimate,
             "queue_wait_ms": round(req.queue_wait_ns / 1e6, 3),
             "submitted_unix": info["submitted_unix"],
+            # serving attribution: which client session/address this
+            # query belongs to (None for in-process submissions), and
+            # the canonical plan digest (plan/digest.py)
+            "session_id": meta.get("session_id"),
+            "client_addr": meta.get("client_addr"),
+            "plan_digest": meta.get("plan_digest"),
         }
         fin = info.get("finished_unix")
         if fin is not None:
@@ -256,9 +264,22 @@ class QueryService:
     # -- submission ----------------------------------------------------------
     def submit(self, plan, priority: int = 0,
                timeout_ms: Optional[int] = None,
-               estimate_bytes: Optional[int] = None) -> QueryFuture:
+               estimate_bytes: Optional[int] = None,
+               meta: Optional[Dict[str, Any]] = None) -> QueryFuture:
+        """``meta`` carries serving attribution (``session_id``,
+        ``client_addr`` — serve/server.py) into the live query table,
+        the QueryProfile and the slow-query log; in-process submissions
+        leave it None."""
         reg = obsreg.get_registry()
         qid = self._session._next_query_id()
+        meta = dict(meta or {})
+        if "plan_digest" not in meta:
+            # the serving tier already digested the plan for its
+            # result-cache key and passes it in meta — don't walk the
+            # plan a second time on its behalf
+            from spark_rapids_tpu.plan.digest import safe_plan_digest
+            meta["plan_digest"] = safe_plan_digest(plan)
+        digest = meta["plan_digest"]
         # nested collect inside a running query: execute inline under
         # the parent's slot/token (re-admission would self-deadlock)
         if getattr(self._tls, "in_query", False):
@@ -268,10 +289,13 @@ class QueryService:
             # nested runs ride the live table too (zero-estimate: they
             # execute under the parent's admission slot)
             self._track(fut, AdmissionRequest(qid, 0, priority=priority,
-                                              token=tok))
+                                              token=tok), meta)
             try:
                 table, prof = self._session._execute_attributed(
-                    plan, query_id=qid, sched_extra={"sched.nested": 1})
+                    plan, query_id=qid,
+                    sched_extra=self._sched_extra_base(
+                        meta, {"sched.nested": 1}),
+                    plan_digest=digest)
             except BaseException as e:
                 fut._finish(QueryState.FAILED, error=e,
                             profile=self._session.query_profile(qid))
@@ -286,7 +310,7 @@ class QueryService:
         req = AdmissionRequest(
             qid, self._estimate(plan, estimate_bytes),
             priority=priority, token=token)
-        self._track(fut, req)
+        self._track(fut, req, meta)
         obsrec.record_event("sched.submitted", query=qid,
                             priority=req.priority,
                             estimate_bytes=req.estimate)
@@ -301,15 +325,27 @@ class QueryService:
             timer.daemon = True
             timer.start()
         t = threading.Thread(target=self._run,
-                             args=(fut, plan, req, timer),
+                             args=(fut, plan, req, timer, meta),
                              name=f"sched-q{qid}", daemon=True)
         t.start()
         return fut
 
+    @staticmethod
+    def _sched_extra_base(meta: Dict[str, Any],
+                          extra: Optional[Dict[str, Any]] = None
+                          ) -> Dict[str, Any]:
+        out = dict(extra or {})
+        if meta.get("session_id") is not None:
+            out["sched.sessionId"] = meta["session_id"]
+        if meta.get("client_addr") is not None:
+            out["sched.clientAddr"] = meta["client_addr"]
+        return out
+
     # -- the worker ----------------------------------------------------------
     def _run(self, fut: QueryFuture, plan, req: AdmissionRequest,
-             timer) -> None:
+             timer, meta: Optional[Dict[str, Any]] = None) -> None:
         reg = obsreg.get_registry()
+        meta = dict(meta or {})
         self._tls.in_query = True
         tracker = None
         try:
@@ -322,14 +358,23 @@ class QueryService:
                 return
             except BaseException as e:   # rejected / internal
                 fut._finish(QueryState.FAILED, error=e)
+                from spark_rapids_tpu.sched.admission import \
+                    QueryRejectedError
+                if isinstance(e, QueryRejectedError):
+                    # queue-full rejection happens BEFORE admission:
+                    # without this hook the flight recorder and
+                    # slow-query log never hear about the query at all
+                    # — serving overload would be undiagnosable
+                    self._session._record_rejection(fut.query_id, e,
+                                                    req, meta)
                 return
             fut.queue_wait_ns = req.queue_wait_ns
             fut._set_running()
-            sched_extra = {
+            sched_extra = self._sched_extra_base(meta, {
                 "sched.queueWaitNs": req.queue_wait_ns,
                 "sched.estimateBytes": req.estimate,
                 "sched.priority": req.priority,
-            }
+            })
             try:
                 from spark_rapids_tpu.mem import spill
                 if spill.is_enabled():
@@ -337,7 +382,8 @@ class QueryService:
                 with slot, _cancel.install(fut.token):
                     table, prof = self._session._execute_attributed(
                         plan, query_id=fut.query_id,
-                        sched_extra=sched_extra)
+                        sched_extra=sched_extra,
+                        plan_digest=meta.get("plan_digest"))
             except _cancel.QueryCancelledError as e:
                 timed = isinstance(e, _cancel.QueryTimeoutError) or \
                     fut.token.timed_out

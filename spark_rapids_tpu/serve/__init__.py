@@ -1,0 +1,13 @@
+"""Multi-tenant SQL serving front-end (the "millions of users" layer).
+
+A long-lived TCP server (``serve/server.py``) multiplexes many remote
+client sessions onto one engine session's QueryService: length-prefixed
+wire protocol (``serve/wire.py``), per-session conf overlays and
+fair-share caps, prepared/parameterized statements
+(``serve/statements.py``), a stamped result-set cache
+(``serve/result_cache.py``), and chunked streaming result delivery
+with client-credit backpressure.  ``serve/client.py`` is the thin
+in-repo client the tests/CI drive it with.
+"""
+
+from spark_rapids_tpu.serve.client import ServeClient, ServeError  # noqa: F401

@@ -1,0 +1,315 @@
+"""Thin in-repo serving client (the tests/CI driver for serve/server).
+
+One ``ServeClient`` owns one TCP connection and one serve session.  A
+background reader thread routes frames by request tag, so multiple
+user threads can run queries over one connection concurrently (the
+multiplexing the server is built for).  Results stream back in CHUNK
+frames under a credit window: the client grants ``credit`` chunks up
+front and replenishes one credit per chunk it consumes — a slow
+consumer therefore bounds how far ahead the server can materialize
+into the socket (the backpressure contract in serve/wire.py).
+"""
+
+from __future__ import annotations
+
+import queue
+import socket
+import threading
+from typing import Any, Dict, Iterator, List, Optional
+
+import pyarrow as pa
+
+from spark_rapids_tpu.serve import wire
+
+
+class ServeError(RuntimeError):
+    """Server-reported request failure (``code`` is the typed ERR
+    discriminator: FairShareExceeded, SessionExpired, StatementError,
+    or the engine exception's type name)."""
+
+    def __init__(self, code: str, msg: str):
+        super().__init__(f"[{code}] {msg}")
+        self.code = code
+
+
+class _ClosedError(ServeError):
+    def __init__(self, msg: str = "connection closed"):
+        super().__init__("ConnectionClosed", msg)
+
+
+class PreparedHandle:
+    """Client-side handle to one server-side prepared statement."""
+
+    __slots__ = ("client", "statement_id", "columns", "params")
+
+    def __init__(self, client: "ServeClient", desc: Dict[str, Any]):
+        self.client = client
+        self.statement_id = desc["statement_id"]
+        self.columns = list(desc.get("columns") or [])
+        self.params = dict(desc.get("params") or {})
+
+    def execute(self, params: Optional[Dict[str, Any]] = None,
+                timeout: Optional[float] = None) -> pa.Table:
+        return self.client.execute(self.statement_id, params,
+                                   timeout=timeout)
+
+    def close(self) -> None:
+        self.client._request({"op": "close_statement",
+                              "statement_id": self.statement_id})
+
+
+class ResultStream:
+    """Iterator over one query's streamed result chunks; replenishes
+    one credit per consumed chunk.  ``read_all()`` drains into one
+    table; ``summary`` holds the END payload afterwards."""
+
+    def __init__(self, client: "ServeClient", tag: int,
+                 timeout: Optional[float]):
+        self._client = client
+        self._tag = tag
+        self._timeout = timeout
+        self.summary: Optional[Dict[str, Any]] = None
+        self._done = False
+
+    def __iter__(self) -> Iterator[pa.Table]:
+        while not self._done:
+            kind, payload = self._client._next_stream_item(
+                self._tag, self._timeout)
+            if kind == wire.CHUNK:
+                self._client._grant(self._tag, 1)
+                yield wire.decode_chunk(payload)
+            elif kind == wire.END:
+                self.summary = wire.decode_msg(payload)
+                self._done = True
+            else:                      # ERR
+                self._done = True
+                err = wire.decode_msg(payload)
+                raise ServeError(err.get("type", "Error"),
+                                 err.get("error", "query failed"))
+        return
+
+    def read_all(self) -> pa.Table:
+        tables: List[pa.Table] = list(self)
+        if not tables:
+            raise ServeError("Protocol", "no result chunks received")
+        return pa.concat_tables(tables)
+
+
+class ServeClient:
+    """See module docstring.  ``conf`` is the session overlay the
+    server applies to every query this session submits:
+    ``{"priority": int, "timeoutMs": int, "estimateBytes": int}``."""
+
+    def __init__(self, host: str, port: int,
+                 conf: Optional[Dict[str, Any]] = None,
+                 connect_timeout: float = 10.0,
+                 default_credit: int = 8):
+        self._sock = socket.create_connection((host, port),
+                                              timeout=connect_timeout)
+        self._sock.settimeout(None)
+        self._wlock = threading.Lock()
+        self._tags = iter(range(1, 1 << 62))
+        self._tag_lock = threading.Lock()
+        self._pending: Dict[int, "queue.Queue"] = {}
+        self._plock = threading.Lock()
+        self._closed = False
+        self._default_credit = max(1, int(default_credit))
+        self._reader = threading.Thread(target=self._read_loop,
+                                        name="serve-client-reader",
+                                        daemon=True)
+        self._reader.start()
+        try:
+            resp = self._request({"op": "hello",
+                                  "conf": dict(conf or {})})
+        except BaseException:
+            # a failed handshake must not leak the socket and a
+            # reader thread blocked in recv() forever (abort's
+            # shutdown() is what actually wakes the reader)
+            self.abort()
+            raise
+        self.session_id = resp["session_id"]
+
+    # -- plumbing ----------------------------------------------------------
+    def _next_tag(self) -> int:
+        with self._tag_lock:
+            return next(self._tags)
+
+    def _read_loop(self) -> None:
+        try:
+            while True:
+                frame = wire.read_frame(self._sock)
+                if frame is None:
+                    break
+                kind, tag, payload = frame
+                with self._plock:
+                    q = self._pending.get(tag)
+                if q is not None:
+                    q.put((kind, payload))
+        except (wire.WireError, OSError):
+            pass
+        finally:
+            self._fail_pending()
+
+    def _fail_pending(self) -> None:
+        with self._plock:
+            self._closed = True
+            pending = list(self._pending.values())
+        err = wire.encode_msg({"type": "ConnectionClosed",
+                               "error": "connection closed"})
+        for q in pending:
+            q.put((wire.ERR, err))
+
+    def _register(self, tag: int) -> "queue.Queue":
+        q: "queue.Queue" = queue.Queue()
+        with self._plock:
+            if self._closed:
+                raise _ClosedError()
+            self._pending[tag] = q
+        return q
+
+    def _unregister(self, tag: int) -> None:
+        with self._plock:
+            self._pending.pop(tag, None)
+
+    def _send_req(self, tag: int, msg: Dict[str, Any]) -> None:
+        try:
+            wire.send_frame(self._sock, self._wlock, wire.REQ, tag,
+                            wire.encode_msg(msg))
+        except wire.WireError as e:
+            self._unregister(tag)
+            raise _ClosedError(str(e)) from e
+
+    def _grant(self, tag: int, n: int) -> None:
+        try:
+            wire.send_frame(self._sock, self._wlock, wire.CREDIT, tag,
+                            wire.encode_msg({"n": int(n)}))
+        except wire.WireError:
+            pass                       # stream will fail on its own
+
+    def _request(self, msg: Dict[str, Any],
+                 timeout: Optional[float] = 60.0) -> Dict[str, Any]:
+        """One control round trip (RESP/ERR)."""
+        tag = self._next_tag()
+        q = self._register(tag)
+        try:
+            self._send_req(tag, msg)
+            try:
+                kind, payload = q.get(timeout=timeout)
+            except queue.Empty:
+                raise ServeError(
+                    "Timeout", f"no response to {msg.get('op')!r} "
+                    f"within {timeout}s") from None
+            obj = wire.decode_msg(payload)
+            if kind == wire.ERR:
+                raise ServeError(obj.get("type", "Error"),
+                                 obj.get("error", "request failed"))
+            return obj
+        finally:
+            self._unregister(tag)
+
+    def _next_stream_item(self, tag: int, timeout: Optional[float]):
+        with self._plock:
+            q = self._pending.get(tag)
+        if q is None:
+            raise _ClosedError("stream already finished")
+        try:
+            kind, payload = q.get(
+                timeout=timeout if timeout is not None else 600.0)
+        except queue.Empty:
+            self._unregister(tag)
+            raise ServeError("Timeout",
+                             f"no stream frame within {timeout}s") \
+                from None
+        if kind in (wire.END, wire.ERR):
+            self._unregister(tag)
+        return kind, payload
+
+    def _query(self, msg: Dict[str, Any], credit: Optional[int],
+               timeout: Optional[float]) -> ResultStream:
+        tag = self._next_tag()
+        self._register(tag)
+        msg = dict(msg)
+        msg["credit"] = int(credit if credit is not None
+                            else self._default_credit)
+        try:
+            self._send_req(tag, msg)
+        except BaseException:
+            self._unregister(tag)
+            raise
+        return ResultStream(self, tag, timeout)
+
+    # -- public surface ----------------------------------------------------
+    def sql(self, sql: str, timeout: Optional[float] = None
+            ) -> pa.Table:
+        """Run one ad-hoc statement and return the full result."""
+        return self.sql_stream(sql, timeout=timeout).read_all()
+
+    def sql_stream(self, sql: str, credit: Optional[int] = None,
+                   timeout: Optional[float] = None) -> ResultStream:
+        return self._query({"op": "sql", "sql": sql}, credit, timeout)
+
+    def prepare(self, sql: str,
+                params: Optional[Dict[str, str]] = None
+                ) -> PreparedHandle:
+        """Prepare a ``:name``-parameterized statement; ``params`` maps
+        parameter name → SQL type name (int, bigint, double, string,
+        date, timestamp, ...)."""
+        return PreparedHandle(self, self._request(
+            {"op": "prepare", "sql": sql, "params": dict(params or {})}))
+
+    def execute(self, statement_id: str,
+                params: Optional[Dict[str, Any]] = None,
+                timeout: Optional[float] = None) -> pa.Table:
+        return self.execute_stream(statement_id, params,
+                                   timeout=timeout).read_all()
+
+    def execute_stream(self, statement_id: str,
+                       params: Optional[Dict[str, Any]] = None,
+                       credit: Optional[int] = None,
+                       timeout: Optional[float] = None) -> ResultStream:
+        return self._query({"op": "execute",
+                            "statement_id": statement_id,
+                            "params": dict(params or {})},
+                           credit, timeout)
+
+    def cancel(self, stream: ResultStream) -> bool:
+        return bool(self._request(
+            {"op": "cancel", "request": stream._tag}).get("cancelled"))
+
+    def ping(self) -> bool:
+        return bool(self._request({"op": "ping"}).get("ok"))
+
+    def session_info(self) -> Dict[str, Any]:
+        return self._request({"op": "session_info"})
+
+    def close(self, end_session: bool = True) -> None:
+        """Graceful close (server evicts the session when
+        ``end_session``); idempotent."""
+        if self._closed:
+            return
+        try:
+            self._request({"op": "close", "end_session": end_session},
+                          timeout=5.0)
+        except ServeError:
+            pass
+        self.abort()
+
+    def abort(self) -> None:
+        """Hard close: drop the socket (the disconnect-cancel path the
+        tests exercise).  shutdown() before close(): close() alone
+        would neither wake this client's own blocked reader nor send
+        the FIN the server's reader needs to observe the disconnect."""
+        try:
+            self._sock.shutdown(socket.SHUT_RDWR)
+        except OSError:
+            pass
+        try:
+            self._sock.close()
+        except OSError:
+            pass
+
+    def __enter__(self) -> "ServeClient":
+        return self
+
+    def __exit__(self, *a) -> None:
+        self.close()

@@ -1,0 +1,153 @@
+"""Stamped result-set cache: hot dashboard queries cost zero dispatches.
+
+Process-wide byte-budget LRU over *materialized query results*, keyed
+
+    (canonical plan digest, output column names, source stamps)
+
+where the digest comes from ``plan/digest.py`` (alias-insensitive, the
+same canonicalization the kernel cache keys on), the output names keep
+``SELECT x AS a`` and ``SELECT x AS b`` from serving each other's
+schema, and the stamps are ``io/scan_cache.source_stamps`` — the
+(path, mtime_ns, size) invalidation contract the scan-plan cache
+already lives by.  A rewritten source file changes the stamp, so the
+next lookup misses and the stale entry is purged; nothing needs to
+watch the filesystem.
+
+Only deterministic plans over stampable sources enter
+(``PlanFingerprint.cacheable``), and only when the stamps captured
+BEFORE execution still hold after it — a file rewritten mid-query must
+not freeze a half-old result under the new stamp (the scan cache's
+``handle_key`` pin, applied to whole results).
+
+Counters (registry → /metrics): ``serve.resultCacheHits`` /
+``Misses`` / ``evictedBytes`` / ``insertedBytes``.
+"""
+
+from __future__ import annotations
+
+import threading
+from collections import OrderedDict
+from typing import Dict, Optional, Tuple
+
+import pyarrow as pa
+
+from spark_rapids_tpu.obs import registry as _obsreg
+
+_LOCK = threading.Lock()
+_ENABLED = True
+_MAX_BYTES = 256 << 20
+
+# key -> (table, nbytes); LRU order (oldest first)
+_ENTRIES: "OrderedDict[Tuple, Tuple[pa.Table, int]]" = OrderedDict()
+# (digest, names) -> last stamps inserted, so a fresh-stamp insert
+# purges the stale-stamp entry immediately instead of waiting out LRU
+_STAMP_OF: Dict[Tuple, Tuple] = {}
+_TOTAL_BYTES = 0
+
+
+def configure(enabled: bool, max_bytes: int) -> None:
+    """Serve-server bootstrap hook (process-wide, last caller wins —
+    the scan_cache.configure idiom)."""
+    global _ENABLED, _MAX_BYTES
+    with _LOCK:
+        _ENABLED = bool(enabled)
+        _MAX_BYTES = int(max_bytes)
+        if not _ENABLED:
+            _clear_locked()
+        else:
+            _evict_locked()
+
+
+def enabled() -> bool:
+    return _ENABLED
+
+
+def clear() -> None:
+    with _LOCK:
+        _clear_locked()
+
+
+def _clear_locked() -> None:
+    global _TOTAL_BYTES
+    _ENTRIES.clear()
+    _STAMP_OF.clear()
+    _TOTAL_BYTES = 0
+
+
+def stats() -> Dict[str, int]:
+    with _LOCK:
+        return {"entries": len(_ENTRIES), "bytes": _TOTAL_BYTES}
+
+
+def entry_key(digest: str, names, stamps) -> Tuple:
+    return (digest, tuple(names), tuple(stamps))
+
+
+def _nbytes(table: pa.Table) -> int:
+    try:
+        return int(table.nbytes) + 4096
+    except Exception:
+        return 1 << 20
+
+
+def _evict_locked() -> None:
+    global _TOTAL_BYTES
+    reg = _obsreg.get_registry()
+    while _TOTAL_BYTES > _MAX_BYTES and _ENTRIES:
+        key, (_, nb) = _ENTRIES.popitem(last=False)
+        _TOTAL_BYTES -= nb
+        if _STAMP_OF.get(key[:2]) == key[2]:
+            del _STAMP_OF[key[:2]]
+        reg.inc("serve.resultCacheEvictedBytes", nb)
+
+
+def lookup(digest: str, names, stamps) -> Optional[pa.Table]:
+    """The cached result for (digest, names, stamps), or None.  Counts
+    a hit/miss either way — the zero-dispatch claim in CI is asserted
+    on these counters plus ``kernel.dispatches``."""
+    reg = _obsreg.get_registry()
+    if not _ENABLED or stamps is None:
+        reg.inc("serve.resultCacheMisses")
+        return None
+    key = entry_key(digest, names, stamps)
+    with _LOCK:
+        hit = _ENTRIES.get(key)
+        if hit is not None:
+            _ENTRIES.move_to_end(key)
+    if hit is None:
+        reg.inc("serve.resultCacheMisses")
+        return None
+    reg.inc("serve.resultCacheHits")
+    return hit[0]
+
+
+def insert(digest: str, names, stamps, table: pa.Table) -> bool:
+    """Insert one materialized result; returns False when the cache is
+    off, the entry alone exceeds the whole budget, or ``stamps`` is
+    None (unstampable source).  A same-(digest, names) entry under
+    OLDER stamps purges immediately."""
+    global _TOTAL_BYTES
+    if not _ENABLED or stamps is None:
+        return False
+    nb = _nbytes(table)
+    if nb > _MAX_BYTES:
+        return False
+    key = entry_key(digest, names, stamps)
+    reg = _obsreg.get_registry()
+    with _LOCK:
+        prev_stamps = _STAMP_OF.get(key[:2])
+        if prev_stamps is not None and prev_stamps != key[2]:
+            stale = _ENTRIES.pop(entry_key(digest, names, prev_stamps),
+                                 None)
+            if stale is not None:
+                _TOTAL_BYTES -= stale[1]
+        if key in _ENTRIES:
+            _ENTRIES.move_to_end(key)
+            _STAMP_OF[key[:2]] = key[2]
+            return True
+        _ENTRIES[key] = (table, nb)
+        _STAMP_OF[key[:2]] = key[2]
+        _TOTAL_BYTES += nb
+        _evict_locked()
+    reg.inc("serve.resultCacheInsertedBytes", nb)
+    return True

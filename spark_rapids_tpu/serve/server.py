@@ -1,0 +1,631 @@
+"""The serving front-end: a long-lived TCP server over QueryService.
+
+One ``ServeServer`` per engine session when ``serve.enabled=true``
+(api/session.py keeps it on ``session.serve_server``; ``serve.port=0``
+binds ephemeral, discover via ``serve_server.port``).  Layering::
+
+    ServeClient ──wire──> ServeServer ──submit(meta)──> QueryService
+                             │                             (PR 5)
+                             ├─ ServeSession  (conf overlay, fair share,
+                             │                 prepared statements,
+                             │                 idle eviction)
+                             └─ result_cache  (digest+stamp keyed)
+
+Per connection a reader thread owns the socket's inbound side; query
+ops submit asynchronously and a per-query streamer thread delivers
+CHUNK frames under the client's credit (wire.py) — the reader stays
+responsive for CREDIT and cancel frames while results stream.  A dead
+socket cancels every in-flight query through PR 5's CancelToken, so an
+abandoned query releases its admission slot, drains its prefetcher and
+frees its spill-catalog entries exactly like an explicit cancel.
+
+Fair share: at most ``serve.session.maxInFlight`` queries per session
+may be in flight; past it the request is refused with a typed
+``FairShareExceeded`` error (back-pressure to THAT client) instead of
+queueing — one greedy client cannot monopolize ``sched.memoryBudget``.
+"""
+
+from __future__ import annotations
+
+import itertools
+import socket
+import threading
+import time
+import weakref
+from typing import Any, Dict, Optional
+
+from spark_rapids_tpu.obs import recorder as obsrec
+from spark_rapids_tpu.obs import registry as obsreg
+from spark_rapids_tpu.serve import result_cache, wire
+from spark_rapids_tpu.serve.statements import (PreparedStatement,
+                                               StatementError)
+
+# a streamer blocked on client credit longer than this aborts: a
+# wedged consumer must not pin its result table and fair-share slot
+# forever (idle eviction only covers sessions with nothing in flight)
+_STREAM_STALL_S = 300.0
+
+
+class ServeError(Exception):
+    """Typed server-side request failure; ``code`` rides the ERR frame."""
+
+    def __init__(self, code: str, msg: str):
+        super().__init__(msg)
+        self.code = code
+
+
+class ServeSession:
+    """Server-side client session: id, conf overlay, prepared
+    statements, and the fair-share in-flight gate."""
+
+    __slots__ = ("session_id", "priority", "timeout_ms",
+                 "estimate_bytes", "max_inflight", "statements",
+                 "inflight", "last_active", "created_unix", "closed",
+                 "client_addr", "_lock")
+
+    def __init__(self, session_id: str, overlay: Dict[str, Any],
+                 max_inflight: int, client_addr: str):
+        self.session_id = session_id
+        self.priority = int(overlay.get("priority", 0) or 0)
+        t = overlay.get("timeoutMs")
+        self.timeout_ms = int(t) if t else None
+        e = overlay.get("estimateBytes")
+        self.estimate_bytes = int(e) if e else None
+        self.max_inflight = max(1, int(max_inflight))
+        self.statements: Dict[str, PreparedStatement] = {}
+        self.inflight = 0
+        self.created_unix = time.time()
+        self.last_active = time.monotonic()
+        self.closed = False
+        self.client_addr = client_addr
+        self._lock = threading.Lock()
+
+    def touch(self) -> None:
+        self.last_active = time.monotonic()
+
+    def try_begin_query(self) -> bool:
+        with self._lock:
+            if self.closed or self.inflight >= self.max_inflight:
+                return False
+            self.inflight += 1
+            return True
+
+    def end_query(self) -> None:
+        with self._lock:
+            self.inflight = max(0, self.inflight - 1)
+        self.touch()
+
+    def describe(self) -> Dict[str, Any]:
+        return {"session_id": self.session_id,
+                "priority": self.priority,
+                "timeout_ms": self.timeout_ms,
+                "estimate_bytes": self.estimate_bytes,
+                "max_inflight": self.max_inflight,
+                "inflight": self.inflight,
+                "statements": sorted(self.statements),
+                "client_addr": self.client_addr}
+
+
+class _Inflight:
+    """One query being answered on one connection: its future (None for
+    a result-cache hit) and the client-credit window."""
+
+    def __init__(self, tag: int, future, credit: int):
+        self.tag = tag
+        self.future = future
+        self._credit = max(0, int(credit))
+        self._cv = threading.Condition()
+        self.aborted = False
+
+    def add_credit(self, n: int) -> None:
+        with self._cv:
+            self._credit += max(0, int(n))
+            self._cv.notify_all()
+
+    def abort(self) -> None:
+        with self._cv:
+            self.aborted = True
+            self._cv.notify_all()
+
+    def take_credit(self) -> bool:
+        """Block until one CHUNK of credit is available; False when the
+        stream aborted (disconnect/cancel) or stalled out."""
+        deadline = time.monotonic() + _STREAM_STALL_S
+        with self._cv:
+            while True:
+                if self.aborted:
+                    return False
+                if self._credit > 0:
+                    self._credit -= 1
+                    return True
+                if time.monotonic() >= deadline:
+                    self.aborted = True
+                    return False
+                self._cv.wait(timeout=0.25)
+
+
+class _Conn:
+    __slots__ = ("sock", "wlock", "addr", "alive", "session",
+                 "inflight", "closed_cleanly", "_lock")
+
+    def __init__(self, sock: socket.socket, addr: str):
+        self.sock = sock
+        self.wlock = threading.Lock()
+        self.addr = addr
+        self.alive = True
+        self.session: Optional[ServeSession] = None
+        self.inflight: Dict[int, _Inflight] = {}
+        self.closed_cleanly = False
+        self._lock = threading.Lock()
+
+    def track(self, infl: _Inflight) -> None:
+        with self._lock:
+            self.inflight[infl.tag] = infl
+
+    def untrack(self, tag: int) -> None:
+        with self._lock:
+            self.inflight.pop(tag, None)
+
+    def take_all(self) -> list:
+        with self._lock:
+            out = list(self.inflight.values())
+            self.inflight.clear()
+        return out
+
+
+class ServeServer:
+    """See module docstring.  One per engine session; ``shutdown()`` is
+    idempotent and also fires when the engine session is collected."""
+
+    def __init__(self, session):
+        import hashlib
+
+        from spark_rapids_tpu import config as cfg
+        conf = session.conf
+        self._engine_ref = weakref.ref(session)
+        # semantics stamp: the engine session's result-affecting SQL
+        # configuration participates in every result-cache key, so a
+        # later session in the same process with different semantics
+        # knobs (float-agg ordering, incompat ops, cast behavior…) can
+        # never be served a result this session computed — the cache
+        # itself is process-global.  Over-invalidation (a knob that
+        # doesn't really change results) only costs a miss.
+        sql_conf = sorted((k, repr(v)) for k, v in
+                          conf._settings.items()
+                          if k.startswith("spark.rapids.tpu.sql"))
+        self._semantics_stamp = hashlib.sha1(
+            repr(sql_conf).encode()).hexdigest()[:16]
+        self._max_inflight = int(conf.get(cfg.SERVE_SESSION_MAX_INFLIGHT))
+        self._idle_timeout_s = max(
+            0.05, int(conf.get(cfg.SERVE_SESSION_IDLE_TIMEOUT_MS)) / 1e3)
+        self._chunk_rows = max(
+            1, int(conf.get(cfg.SERVE_STREAM_CHUNK_ROWS)))
+        result_cache.configure(
+            bool(conf.get(cfg.SERVE_RESULT_CACHE_ENABLED)),
+            int(conf.get(cfg.SERVE_RESULT_CACHE_MAX_BYTES)))
+        self._sessions: Dict[str, ServeSession] = {}
+        self._lock = threading.Lock()
+        self._session_seq = itertools.count(1)
+        self._stmt_seq = itertools.count(1)
+        self._stop = threading.Event()
+        host = str(conf.get(cfg.SERVE_HOST))
+        self._lsock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        self._lsock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        self._lsock.bind((host, int(conf.get(cfg.SERVE_PORT))))
+        self._lsock.listen(128)
+        self.host = host
+        self.port = self._lsock.getsockname()[1]
+        self._accept_thread = threading.Thread(
+            target=self._accept_loop, name=f"serve-accept-{self.port}",
+            daemon=True)
+        self._accept_thread.start()
+        self._janitor = threading.Thread(
+            target=self._janitor_loop, name=f"serve-janitor-{self.port}",
+            daemon=True)
+        self._janitor.start()
+        self._finalizer = weakref.finalize(
+            session, ServeServer._static_shutdown, self._lsock,
+            self._stop)
+
+    # -- lifecycle ---------------------------------------------------------
+    @staticmethod
+    def _static_shutdown(lsock, stop) -> None:
+        stop.set()
+        try:
+            lsock.close()
+        except OSError:
+            pass
+
+    def shutdown(self) -> None:
+        self._static_shutdown(self._lsock, self._stop)
+        with self._lock:
+            sessions = list(self._sessions.values())
+            self._sessions.clear()
+        for s in sessions:
+            s.closed = True
+        # release the materialized results: the cache is process-global
+        # and would otherwise pin up to its whole byte budget of
+        # pa.Tables after the serving session is gone (the semantics
+        # stamp already guarantees a later session can't be served
+        # stale semantics; this is purely about memory)
+        result_cache.clear()
+        obsreg.get_registry().set_gauge("serve.activeSessions", 0)
+
+    def _engine(self):
+        eng = self._engine_ref()
+        if eng is None:
+            raise ServeError("ServerStopping",
+                             "engine session gone; server stopping")
+        return eng
+
+    # -- session registry --------------------------------------------------
+    def sessions(self) -> Dict[str, ServeSession]:
+        with self._lock:
+            return dict(self._sessions)
+
+    def _publish_sessions(self) -> None:
+        obsreg.get_registry().set_gauge("serve.activeSessions",
+                                        len(self._sessions))
+
+    def _open_session(self, overlay: Dict[str, Any],
+                      addr: str) -> ServeSession:
+        sid = f"s-{next(self._session_seq):05d}"
+        sess = ServeSession(sid, overlay or {}, self._max_inflight, addr)
+        with self._lock:
+            self._sessions[sid] = sess
+            self._publish_sessions()
+        reg = obsreg.get_registry()
+        reg.inc("serve.sessions")
+        obsrec.record_event("serve.sessionOpened", session=sid,
+                            client_addr=addr)
+        return sess
+
+    def _evict(self, sess: ServeSession, reason: str) -> None:
+        with self._lock:
+            cur = self._sessions.get(sess.session_id)
+            if cur is not sess:
+                return
+            del self._sessions[sess.session_id]
+            self._publish_sessions()
+        sess.closed = True
+        obsreg.get_registry().inc("serve.sessionsEvicted")
+        obsrec.record_event("serve.sessionEvicted",
+                            session=sess.session_id, reason=reason)
+
+    def _janitor_loop(self) -> None:
+        interval = min(2.0, max(0.02, self._idle_timeout_s / 4))
+        while not self._stop.wait(interval):
+            now = time.monotonic()
+            for sess in list(self.sessions().values()):
+                if sess.inflight == 0 and \
+                        now - sess.last_active > self._idle_timeout_s:
+                    self._evict(sess, "idle-timeout")
+
+    # -- accept / per-connection reader ------------------------------------
+    def _accept_loop(self) -> None:
+        while not self._stop.is_set():
+            try:
+                sock, addr = self._lsock.accept()
+            except OSError:
+                return
+            threading.Thread(
+                target=self._serve_conn,
+                args=(sock, f"{addr[0]}:{addr[1]}"),
+                name=f"serve-conn-{addr[1]}", daemon=True).start()
+
+    def _serve_conn(self, sock: socket.socket, addr: str) -> None:
+        conn = _Conn(sock, addr)
+        try:
+            while not self._stop.is_set():
+                frame = wire.read_frame(sock)
+                if frame is None:
+                    return
+                kind, tag, payload = frame
+                if kind == wire.CREDIT:
+                    msg = wire.decode_msg(payload)
+                    infl = conn.inflight.get(tag)
+                    if infl is not None:
+                        infl.add_credit(int(msg.get("n", 1)))
+                elif kind == wire.REQ:
+                    if not self._handle_request(
+                            conn, tag, wire.decode_msg(payload)):
+                        return
+                # other kinds from a client are protocol noise: ignore
+        except wire.WireError:
+            pass
+        finally:
+            self._on_disconnect(conn)
+            try:
+                sock.close()
+            except OSError:
+                pass
+
+    def _on_disconnect(self, conn: _Conn) -> None:
+        conn.alive = False
+        pending = conn.take_all()
+        for infl in pending:
+            infl.abort()
+            if infl.future is not None:
+                infl.future.cancel("client disconnected")
+        if conn.session is not None:
+            conn.session.touch()
+        if not conn.closed_cleanly:
+            obsreg.get_registry().inc("serve.clientDisconnects")
+            if pending:
+                obsrec.record_event(
+                    "serve.disconnectCancelled",
+                    session=getattr(conn.session, "session_id", None),
+                    cancelled=len(pending))
+
+    # -- request dispatch --------------------------------------------------
+    def _send_resp(self, conn: _Conn, tag: int,
+                   obj: Dict[str, Any]) -> None:
+        wire.send_frame(conn.sock, conn.wlock, wire.RESP, tag,
+                        wire.encode_msg(obj))
+
+    def _send_err(self, conn: _Conn, tag: int, code: str,
+                  msg: str) -> None:
+        try:
+            wire.send_frame(conn.sock, conn.wlock, wire.ERR, tag,
+                            wire.encode_msg({"type": code,
+                                             "error": msg}))
+        except wire.WireError:
+            pass
+
+    def _handle_request(self, conn: _Conn, tag: int,
+                        msg: Dict[str, Any]) -> bool:
+        """Dispatch one REQ; returns False when the connection should
+        close (the ``close`` op)."""
+        op = str(msg.get("op", ""))
+        reg = obsreg.get_registry()
+        reg.inc("serve.requests")
+        try:
+            if op == "hello":
+                sess = self._open_session(msg.get("conf") or {},
+                                          conn.addr)
+                conn.session = sess
+                self._send_resp(conn, tag, {
+                    "session_id": sess.session_id,
+                    "protocol": wire.PROTOCOL_VERSION,
+                    "engine": "spark-rapids-tpu"})
+                return True
+            if op == "ping":
+                self._send_resp(conn, tag, {"ok": True})
+                return True
+            if op == "close":
+                conn.closed_cleanly = True
+                if conn.session is not None and \
+                        bool(msg.get("end_session", True)):
+                    self._evict(conn.session, "client-close")
+                self._send_resp(conn, tag, {"ok": True})
+                return False
+            sess = self._session_of(conn)
+            sess.touch()
+            if op == "sql":
+                plan = self._parse(str(msg.get("sql", "")))
+                self._start_query(conn, tag, sess, plan,
+                                  int(msg.get("credit", 8)))
+            elif op == "prepare":
+                stmt = self._prepare(sess, msg)
+                self._send_resp(conn, tag, stmt.describe())
+            elif op == "execute":
+                stmt = self._statement_of(sess, msg)
+                plan = stmt.bind(msg.get("params") or {})
+                self._start_query(conn, tag, sess, plan,
+                                  int(msg.get("credit", 8)))
+            elif op == "close_statement":
+                sid = str(msg.get("statement_id", ""))
+                sess.statements.pop(sid, None)
+                self._send_resp(conn, tag, {"ok": True})
+            elif op == "cancel":
+                target = int(msg.get("request", -1))
+                infl = conn.inflight.get(target)
+                cancelled = False
+                if infl is not None:
+                    infl.abort()
+                    if infl.future is not None:
+                        cancelled = infl.future.cancel(
+                            "cancelled by client")
+                self._send_resp(conn, tag, {"cancelled": cancelled})
+            elif op == "session_info":
+                self._send_resp(conn, tag, sess.describe())
+            else:
+                raise ServeError("UnknownOp",
+                                 f"unknown request op {op!r}")
+        except ServeError as e:
+            self._send_err(conn, tag, e.code, str(e))
+        except StatementError as e:
+            self._send_err(conn, tag, "StatementError", str(e))
+        except wire.WireError:
+            raise
+        except Exception as e:
+            self._send_err(conn, tag, type(e).__name__, str(e))
+        return True
+
+    def _session_of(self, conn: _Conn) -> ServeSession:
+        sess = conn.session
+        if sess is None:
+            raise ServeError("NoSession",
+                             "send a hello request before queries")
+        if sess.closed or sess.session_id not in self.sessions():
+            raise ServeError(
+                "SessionExpired",
+                f"session {sess.session_id} was evicted "
+                f"(idle > {self._idle_timeout_s:.1f}s or closed); "
+                f"send a new hello")
+        return sess
+
+    def _statement_of(self, sess: ServeSession,
+                      msg: Dict[str, Any]) -> PreparedStatement:
+        sid = str(msg.get("statement_id", ""))
+        stmt = sess.statements.get(sid)
+        if stmt is None:
+            raise ServeError("UnknownStatement",
+                             f"no prepared statement {sid!r} in "
+                             f"session {sess.session_id}")
+        return stmt
+
+    def _parse(self, sql: str):
+        if not sql.strip():
+            raise ServeError("EmptyStatement", "empty sql")
+        from spark_rapids_tpu.sql import parse_sql
+        return parse_sql(sql, self._engine().catalog)
+
+    def _prepare(self, sess: ServeSession,
+                 msg: Dict[str, Any]) -> PreparedStatement:
+        sql = str(msg.get("sql", ""))
+        if not sql.strip():
+            raise ServeError("EmptyStatement", "empty sql")
+        stmt_id = f"stmt-{next(self._stmt_seq):05d}"
+        stmt = PreparedStatement(stmt_id, sql, msg.get("params") or {},
+                                 self._engine().catalog)
+        sess.statements[stmt_id] = stmt
+        obsreg.get_registry().inc("serve.statementsPrepared")
+        return stmt
+
+    # -- query execution + streaming ---------------------------------------
+    def _start_query(self, conn: _Conn, tag: int, sess: ServeSession,
+                     plan, credit: int) -> None:
+        if not sess.try_begin_query():
+            raise ServeError(
+                "FairShareExceeded",
+                f"session {sess.session_id} already has "
+                f"{sess.max_inflight} queries in flight "
+                f"(serve.session.maxInFlight)")
+        try:
+            digest = cache_key = names = stamps = None
+            cacheable = False
+            try:
+                from spark_rapids_tpu.io.scan_cache import source_stamps
+                from spark_rapids_tpu.plan.digest import plan_fingerprint
+                fp = plan_fingerprint(plan)
+                digest = fp.digest
+                # cache entries key on (semantics stamp, plan digest):
+                # the profile//queries surface the pure digest, the
+                # cache must also see the session's SQL conf
+                cache_key = f"{self._semantics_stamp}:{fp.digest}"
+                names = tuple(plan.schema.names)
+                if fp.cacheable and result_cache.enabled():
+                    stamps = source_stamps(fp.sources)
+                    cacheable = stamps is not None
+            except Exception:
+                cacheable = False
+            if cacheable:
+                hit = result_cache.lookup(cache_key, names, stamps)
+                if hit is not None:
+                    infl = _Inflight(tag, None, credit)
+                    conn.track(infl)
+                    threading.Thread(
+                        target=self._stream_cached,
+                        args=(conn, sess, infl, hit),
+                        name=f"serve-stream-{tag}", daemon=True).start()
+                    return
+            eng = self._engine()
+            meta = {"session_id": sess.session_id,
+                    "client_addr": sess.client_addr}
+            if digest is not None:
+                meta["plan_digest"] = digest  # already computed here
+            fut = eng.scheduler.submit(
+                plan, priority=sess.priority,
+                timeout_ms=sess.timeout_ms,
+                estimate_bytes=sess.estimate_bytes,
+                meta=meta)
+            infl = _Inflight(tag, fut, credit)
+            conn.track(infl)
+            threading.Thread(
+                target=self._stream_result,
+                args=(conn, sess, infl, cache_key, names, stamps,
+                      cacheable),
+                name=f"serve-stream-{tag}", daemon=True).start()
+        except BaseException:
+            sess.end_query()
+            raise
+
+    @staticmethod
+    def _releaser(conn: _Conn, sess: ServeSession, infl: _Inflight):
+        """Once-only release of the query's fair-share slot + in-flight
+        tracking.  Called just BEFORE the END frame goes out (so a
+        client that pipelines its next query the instant END arrives
+        can never race a still-held slot into FairShareExceeded) and
+        again from the streamer's finally as the error-path net."""
+        done = threading.Event()
+
+        def release() -> None:
+            if not done.is_set():
+                done.set()
+                conn.untrack(infl.tag)
+                sess.end_query()
+        return release
+
+    def _stream_cached(self, conn: _Conn, sess: ServeSession,
+                       infl: _Inflight, table) -> None:
+        release = self._releaser(conn, sess, infl)
+        try:
+            self._stream_table(conn, infl, table, cache_hit=True,
+                               query_id=None, release=release)
+        finally:
+            release()
+
+    def _stream_result(self, conn: _Conn, sess: ServeSession,
+                       infl: _Inflight, cache_key, names, stamps,
+                       cacheable: bool) -> None:
+        fut = infl.future
+        release = self._releaser(conn, sess, infl)
+        try:
+            try:
+                table = fut.result()
+            except BaseException as e:
+                # a live connection always gets a terminal frame (an
+                # explicitly cancelled stream included — only a dead
+                # socket goes unanswered), or the client would wait on
+                # a stream that will never end
+                if conn.alive:
+                    self._send_err(conn, infl.tag, type(e).__name__,
+                                   str(e))
+                return
+            if cacheable:
+                # only freeze the result when the sources still carry
+                # the pre-execution stamps: a file rewritten mid-query
+                # must not cache a half-old result under either stamp
+                from spark_rapids_tpu.io.scan_cache import source_stamps
+                try:
+                    post = source_stamps([s[1] for s in stamps])
+                except Exception:
+                    post = None
+                if post == stamps:
+                    result_cache.insert(cache_key, names, stamps,
+                                        table)
+            self._stream_table(conn, infl, table, cache_hit=False,
+                               query_id=fut.query_id, release=release)
+        finally:
+            release()
+
+    def _stream_table(self, conn: _Conn, infl: _Inflight, table,
+                      cache_hit: bool, query_id, release) -> None:
+        reg = obsreg.get_registry()
+        chunks = wire.table_chunks(table, self._chunk_rows)
+        sent = 0
+        try:
+            for payload in chunks:
+                if not conn.alive or not infl.take_credit():
+                    if conn.alive:
+                        # aborted mid-stream (explicit cancel or credit
+                        # stall) on a live connection: terminate the
+                        # client's stream explicitly
+                        self._send_err(conn, infl.tag, "StreamAborted",
+                                       "stream cancelled or stalled")
+                    return
+                wire.send_frame(conn.sock, conn.wlock, wire.CHUNK,
+                                infl.tag, payload)
+                sent += 1
+                reg.inc("serve.streamedBatches")
+            if conn.alive and not infl.aborted:
+                release()
+                wire.send_frame(
+                    conn.sock, conn.wlock, wire.END, infl.tag,
+                    wire.encode_msg({"rows": table.num_rows,
+                                     "chunks": sent,
+                                     "cache_hit": cache_hit,
+                                     "query_id": query_id}))
+        except wire.WireError:
+            infl.abort()

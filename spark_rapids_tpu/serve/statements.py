@@ -1,0 +1,178 @@
+"""Prepared/parameterized statements: plan once, bind values per run.
+
+A ``PreparedStatement`` parses its SQL exactly once (``:name``
+placeholders lower to ``SqlParam``-valued Literals of their declared
+dtype — sql/parser.py) and keeps the resulting *plan template*.  Each
+execution deep-copies the template with the heavyweight leaves shared
+(Arrow tables, cached relations, file scans are immutable inputs) and
+swaps the markers for the bound values — a pure value substitution:
+dtypes, schemas and every downstream type resolution were fixed at
+prepare time, so binding can never re-plan.
+
+Because the kernel cache keys on canonical expression signatures
+(PR 4's alias dedup), two *different* serve sessions executing the same
+prepared statement with the same bindings land on the same compiled
+kernels — and, through the result-set cache, on the same materialized
+result.
+"""
+
+from __future__ import annotations
+
+import copy
+import datetime as _dt
+import threading
+from typing import Any, Dict, List, Optional
+
+from spark_rapids_tpu import dtypes as dt
+from spark_rapids_tpu.expr import ir
+from spark_rapids_tpu.plan import logical as lp
+from spark_rapids_tpu.plan.digest import iter_plan_exprs, walk
+from spark_rapids_tpu.sql.parser import SqlParam, parse_prepared
+
+# declared-type names accepted in a prepare request (the CAST name set)
+_PARAM_TYPE_NAMES = {
+    "boolean": dt.BOOL, "bool": dt.BOOL,
+    "tinyint": dt.INT8, "byte": dt.INT8,
+    "smallint": dt.INT16, "short": dt.INT16,
+    "int": dt.INT32, "integer": dt.INT32,
+    "bigint": dt.INT64, "long": dt.INT64,
+    "float": dt.FLOAT32, "real": dt.FLOAT32,
+    "double": dt.FLOAT64,
+    "string": dt.STRING, "varchar": dt.STRING,
+    "date": dt.DATE32, "timestamp": dt.TIMESTAMP_US,
+}
+
+
+class StatementError(ValueError):
+    """Bad prepare/bind input (unknown type, missing/mistyped value)."""
+
+
+def resolve_param_types(declared: Optional[Dict[str, str]]
+                        ) -> Dict[str, dt.DType]:
+    out: Dict[str, dt.DType] = {}
+    for name, tyname in (declared or {}).items():
+        ty = _PARAM_TYPE_NAMES.get(str(tyname).strip().lower())
+        if ty is None:
+            raise StatementError(
+                f"parameter :{name}: unknown type {tyname!r} "
+                f"(expected one of {sorted(set(_PARAM_TYPE_NAMES))})")
+        out[name] = ty
+    return out
+
+
+def copy_plan_shared_leaves(plan: lp.LogicalPlan) -> lp.LogicalPlan:
+    """Deep-copy a plan tree sharing the immutable heavyweight leaves:
+    scan nodes (their Arrow tables / path lists never change under
+    binding — parameters live in the statement's own operators, never
+    inside a catalog relation) and materialized cache nodes (a copy
+    would silently re-materialize per execution)."""
+    memo: Dict[int, Any] = {}
+    for node in walk(plan):
+        if not node.children or isinstance(node, lp.CachedRelation):
+            memo[id(node)] = node
+    return copy.deepcopy(plan, memo)
+
+
+def _coerce(name: str, value: Any, dtype: dt.DType) -> Any:
+    """Validate/convert one JSON-transported binding to its declared
+    dtype's python literal form."""
+    if value is None:
+        return None
+    try:
+        if dtype == dt.BOOL:
+            if isinstance(value, bool):
+                return value
+            raise TypeError("expected bool")
+        if dtype.is_integral:
+            if isinstance(value, bool) or not isinstance(value, int):
+                raise TypeError("expected int")
+            return int(value)
+        if dtype.is_floating:
+            if isinstance(value, bool) or \
+                    not isinstance(value, (int, float)):
+                raise TypeError("expected number")
+            return float(value)
+        if dtype == dt.STRING:
+            if not isinstance(value, str):
+                raise TypeError("expected string")
+            return value
+        if dtype == dt.DATE32:
+            if isinstance(value, _dt.date) and \
+                    not isinstance(value, _dt.datetime):
+                return value
+            return _dt.date.fromisoformat(str(value))
+        if dtype == dt.TIMESTAMP_US:
+            if isinstance(value, _dt.datetime):
+                v = value
+            else:
+                v = _dt.datetime.fromisoformat(str(value))
+            if v.tzinfo is None:
+                v = v.replace(tzinfo=_dt.timezone.utc)
+            return v
+    except (TypeError, ValueError) as e:
+        raise StatementError(
+            f"parameter :{name}: cannot bind {value!r} as "
+            f"{dtype.name}: {e}") from None
+    raise StatementError(
+        f"parameter :{name}: unsupported parameter dtype {dtype.name}")
+
+
+class PreparedStatement:
+    """One parsed statement template + its parameter declarations."""
+
+    def __init__(self, statement_id: str, sql: str,
+                 declared_types: Optional[Dict[str, str]], catalog):
+        self.statement_id = statement_id
+        self.sql = sql
+        self.param_types = resolve_param_types(declared_types)
+        self.plan_template, self.params_used = parse_prepared(
+            sql, catalog, self.param_types)
+        self._lock = threading.Lock()
+        self.executions = 0
+
+    @property
+    def schema_names(self) -> List[str]:
+        return list(self.plan_template.schema.names)
+
+    def describe(self) -> Dict[str, Any]:
+        return {
+            "statement_id": self.statement_id,
+            "columns": self.schema_names,
+            "params": {n: t.name for n, t in self.params_used.items()},
+        }
+
+    def bind(self, params: Optional[Dict[str, Any]]) -> lp.LogicalPlan:
+        """A fresh executable plan with every SqlParam marker replaced
+        by its bound (coerced) value.  Missing or surplus bindings are
+        errors — a silently unbound marker would reach a kernel."""
+        params = dict(params or {})
+        missing = sorted(set(self.params_used) - set(params))
+        if missing:
+            raise StatementError(
+                f"statement {self.statement_id}: missing bindings for "
+                f"{', '.join(':' + m for m in missing)}")
+        surplus = sorted(set(params) - set(self.params_used))
+        if surplus:
+            raise StatementError(
+                f"statement {self.statement_id}: unknown parameters "
+                f"{', '.join(':' + s for s in surplus)}")
+        coerced = {n: _coerce(n, params[n], self.params_used[n])
+                   for n in self.params_used}
+        plan = copy_plan_shared_leaves(self.plan_template)
+        bound = 0
+        for root in iter_plan_exprs(plan):
+            for node in ir.collect(
+                    root, lambda n: isinstance(n, ir.Literal)
+                    and isinstance(n.value, SqlParam)):
+                node.value = coerced[node.value.name]
+                bound += 1
+        # a marker may appear in several plan operators (e.g. a WHERE
+        # predicate duplicated into an aggregate prologue); every
+        # occurrence must have been reached
+        if self.params_used and bound == 0:
+            raise StatementError(
+                f"statement {self.statement_id}: internal error — no "
+                f"parameter markers found in the plan template copy")
+        with self._lock:
+            self.executions += 1
+        return plan

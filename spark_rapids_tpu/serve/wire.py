@@ -1,0 +1,138 @@
+"""Serving wire protocol: length-prefixed frames + Arrow result chunks.
+
+Same framing idiom as the shuffle data plane (shuffle/tcp.py — and the
+pyworker control channel before it): little-endian fixed header, then
+the payload.
+
+    frame := u8 kind, u64 tag, u32 len, len bytes
+
+    REQ    := kind 1, tag = request id, payload = JSON request
+    RESP   := kind 2, tag = request id, payload = JSON response
+    CHUNK  := kind 3, tag = request id, payload = Arrow IPC stream
+              carrying one result batch (self-contained: schema +
+              batch, so any chunk decodes alone)
+    ERR    := kind 4, tag = request id, payload = JSON
+              {"error": str, "type": str}
+    END    := kind 5, tag = request id, payload = JSON result summary
+              {"rows", "chunks", "cache_hit", "query_id"}
+    CREDIT := kind 6, tag = request id, payload = JSON {"n": k} —
+              client -> server flow-control grant: the server may send
+              k more CHUNK frames for this request (backpressure: the
+              server never gets more than the client's outstanding
+              credit ahead of what the client consumed)
+
+Every request carries ``{"op": ...}``; query-shaped ops (``sql``,
+``execute``) are answered with a CHUNK* END stream (or one ERR),
+control ops with one RESP (or ERR).
+"""
+
+from __future__ import annotations
+
+import json
+import socket
+import struct
+import threading
+from typing import Any, Dict, Iterator, Optional, Tuple
+
+import pyarrow as pa
+
+HDR = struct.Struct("<BQI")
+
+REQ, RESP, CHUNK, ERR, END, CREDIT = 1, 2, 3, 4, 5, 6
+
+PROTOCOL_VERSION = 1
+
+# a frame larger than this is a protocol violation (a desynced stream
+# read as a length prefix), not a legitimate payload
+MAX_FRAME_BYTES = 1 << 31
+
+
+class WireError(OSError):
+    """Framing/transport fault on the serving connection."""
+
+
+def send_frame(sock: socket.socket, lock: threading.Lock, kind: int,
+               tag: int, payload: bytes = b"") -> None:
+    try:
+        with lock:
+            sock.sendall(HDR.pack(kind, tag, len(payload)))
+            if payload:
+                sock.sendall(payload)
+    except OSError as e:
+        raise WireError(f"send failed: {e}") from e
+
+
+def _recv_exact(sock: socket.socket, n: int) -> Optional[bytes]:
+    buf = bytearray()
+    while len(buf) < n:
+        try:
+            chunk = sock.recv(n - len(buf))
+        except OSError as e:
+            raise WireError(f"read failed: {e}") from e
+        if not chunk:
+            if buf:
+                raise WireError(
+                    f"connection closed mid-frame ({len(buf)}/{n} bytes)")
+            return None
+        buf += chunk
+    return bytes(buf)
+
+
+def read_frame(sock: socket.socket) -> Optional[Tuple[int, int, bytes]]:
+    """One frame, or None on a clean EOF at a frame boundary."""
+    hdr = _recv_exact(sock, HDR.size)
+    if hdr is None:
+        return None
+    kind, tag, ln = HDR.unpack(hdr)
+    if ln > MAX_FRAME_BYTES:
+        raise WireError(f"frame length {ln} exceeds protocol maximum")
+    payload = _recv_exact(sock, ln) if ln else b""
+    if ln and payload is None:
+        return None
+    return kind, tag, payload
+
+
+# ---------------------------------------------------------------------------
+# JSON control payloads
+# ---------------------------------------------------------------------------
+
+def encode_msg(obj: Dict[str, Any]) -> bytes:
+    return json.dumps(obj, default=str).encode("utf-8")
+
+
+def decode_msg(payload: bytes) -> Dict[str, Any]:
+    try:
+        obj = json.loads(payload.decode("utf-8"))
+    except (UnicodeDecodeError, json.JSONDecodeError) as e:
+        raise WireError(f"malformed control payload: {e}") from e
+    if not isinstance(obj, dict):
+        raise WireError("control payload must be a JSON object")
+    return obj
+
+
+# ---------------------------------------------------------------------------
+# Arrow result chunks
+# ---------------------------------------------------------------------------
+
+def table_chunks(table: pa.Table, chunk_rows: int) -> Iterator[bytes]:
+    """Lazily slice a result table into self-contained Arrow IPC
+    stream payloads of at most ``chunk_rows`` rows each.  A generator,
+    not a list: each payload serializes only after the consumer asked
+    for it, so the credit-backpressure loop in serve/server.py bounds
+    serialized bytes in flight (a slow client must not cost the server
+    a second full copy of a large result).  A zero-row result still
+    produces one chunk (schema only) so the client can always assemble
+    a typed empty table."""
+    chunk_rows = max(1, int(chunk_rows))
+    for off in range(0, max(1, table.num_rows), chunk_rows):
+        piece = table.slice(off, chunk_rows)
+        sink = pa.BufferOutputStream()
+        with pa.ipc.new_stream(sink, table.schema) as w:
+            for b in piece.combine_chunks().to_batches():
+                w.write_batch(b)
+        yield sink.getvalue().to_pybytes()
+
+
+def decode_chunk(payload: bytes) -> pa.Table:
+    with pa.ipc.open_stream(pa.py_buffer(payload)) as r:
+        return r.read_all()

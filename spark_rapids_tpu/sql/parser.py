@@ -44,6 +44,7 @@ _TOKEN_RE = re.compile(r"""
       |\d+(?:[eE][+-]?\d+)?)
   | (?P<str>'(?:[^']|'')*')
   | (?P<name>[A-Za-z_][A-Za-z_0-9]*|`[^`]+`)
+  | (?P<param>:[A-Za-z_][A-Za-z_0-9]*)
   | (?P<op><=|>=|<>|!=|\|\||[=<>+\-*/%(),.])
 """, re.VERBOSE)
 
@@ -101,6 +102,30 @@ def tokenize(text: str) -> List[Token]:
 
 class SqlParseError(ValueError):
     pass
+
+
+class SqlParam:
+    """Placeholder VALUE carried by a ``:name`` parameter's Literal in a
+    prepared-statement plan template (serve/statements.py).  The
+    template parses and plans once with these markers in place; each
+    execution deep-copies the template and swaps the markers for the
+    bound values — the Literal's declared dtype (and therefore every
+    downstream type resolution) never changes, so binding is a value
+    substitution, not a re-plan.  Executing a template with an unbound
+    SqlParam still in it is a bug; kernels fail loudly on the marker.
+    """
+
+    def __init__(self, name_: str):
+        self.name = name_
+
+    def __repr__(self) -> str:
+        return f":{self.name}"
+
+    def __eq__(self, other) -> bool:
+        return isinstance(other, SqlParam) and other.name == self.name
+
+    def __hash__(self) -> int:
+        return hash(("SqlParam", self.name))
 
 
 # ---------------------------------------------------------------------------
@@ -264,11 +289,16 @@ class _Scope:
 
 
 class Parser:
-    def __init__(self, text: str, catalog):
+    def __init__(self, text: str, catalog, param_types=None):
         self.toks = tokenize(text)
         self.i = 0
         self.catalog = catalog        # name -> LogicalPlan
         self.ctes: Dict[str, lp.LogicalPlan] = {}
+        # prepared-statement parameter declarations: name -> DType
+        # (``:name`` atoms lower to SqlParam-valued Literals of the
+        # declared dtype; undeclared parameters are parse errors)
+        self.param_types = dict(param_types or {})
+        self.params_seen: Dict[str, object] = {}
 
     # -- token helpers ----------------------------------------------------
     def peek(self, k: int = 0) -> Token:
@@ -928,6 +958,20 @@ class Parser:
 
     def atom(self, scope) -> ir.Expression:
         t = self.peek()
+        if t.kind == "param":
+            self.next()
+            pname = t.value[1:]
+            dtype = self.param_types.get(pname)
+            if dtype is None:
+                raise SqlParseError(
+                    f"undeclared parameter :{pname} at position {t.pos}; "
+                    f"declare its type when preparing the statement")
+            lit = ir.Literal(SqlParam(pname), dtype)
+            # a parameter may be bound to NULL; plan it nullable so the
+            # template's null-handling doesn't depend on the binding
+            lit.nullable = True
+            self.params_seen[pname] = dtype
+            return lit
         if t.kind == "num":
             self.next()
             if re.fullmatch(r"\d+", t.value):
@@ -1055,6 +1099,20 @@ def _group_ref(e: ir.Expression, group_keys, group_names
     return e
 
 
-def parse_sql(text: str, catalog) -> lp.LogicalPlan:
-    """Parse one SQL query against ``catalog`` (name→LogicalPlan)."""
-    return Parser(text, catalog).parse()
+def parse_sql(text: str, catalog, param_types=None) -> lp.LogicalPlan:
+    """Parse one SQL query against ``catalog`` (name→LogicalPlan).
+
+    ``param_types`` (name → DType) declares ``:name`` prepared-statement
+    parameters; without it a ``:name`` token is a parse error."""
+    return Parser(text, catalog, param_types=param_types).parse()
+
+
+def parse_prepared(text: str, catalog, param_types) -> Tuple[
+        lp.LogicalPlan, Dict[str, object]]:
+    """Parse a parameterized statement once; returns the plan template
+    (with SqlParam-valued Literals in place) and the parameters it
+    actually references (name → DType) — the serve layer's
+    prepared-statement entry point."""
+    p = Parser(text, catalog, param_types=param_types)
+    plan = p.parse()
+    return plan, dict(p.params_seen)

@@ -1,0 +1,159 @@
+"""Canonical plan digest (plan/digest.py): alias/rename insensitivity,
+result-relevant sensitivity, fingerprint cacheability, and the
+profile//queries surfacing."""
+
+import pyarrow as pa
+import pyarrow.parquet as papq
+
+from spark_rapids_tpu import TpuSparkSession, col, functions as F
+from spark_rapids_tpu.exec.kernel_cache import expr_sig
+from spark_rapids_tpu.plan.digest import (plan_digest, plan_fingerprint,
+                                          safe_plan_digest)
+
+
+def _session(extra=None):
+    conf = {"spark.rapids.tpu.sql.variableFloatAgg.enabled": True}
+    conf.update(extra or {})
+    return TpuSparkSession(conf)
+
+
+def _df(s, n=200):
+    return s.create_dataframe(
+        {"k": [i % 5 for i in range(n)],
+         "x": [float(i % 40) for i in range(n)]})
+
+
+# ---------------------------------------------------------------------------
+# canonical identity
+# ---------------------------------------------------------------------------
+
+def test_alias_and_rename_insensitive():
+    """Two queries that differ ONLY in intermediate/output names share
+    a digest — the alias-dedup contract the kernel cache already keys
+    compiles on, lifted to whole plans."""
+    s = _session()
+    df = _df(s)
+    a = (df.with_column("y", col("x") * 2.0 + 1.0)
+           .filter(col("y") > 20.0)
+           .group_by("k").agg(F.sum("y").alias("s1")))
+    b = (df.with_column("zz", col("x") * 2.0 + 1.0)
+           .filter(col("zz") > 20.0)
+           .group_by("k").agg(F.sum("zz").alias("other_name")))
+    assert plan_digest(a.plan) == plan_digest(b.plan)
+
+
+def test_sql_alias_insensitive_and_shared_with_kernel_cache():
+    s = _session()
+    s.register_view("t", _df(s))
+    p1 = s.sql("select k, x * 2.0 as a from t where x > 3.0").plan
+    p2 = s.sql("select k, x * 2.0 as b from t where x > 3.0").plan
+    assert plan_digest(p1) == plan_digest(p2)
+    # the shared canonicalization: the projections' kernel-cache
+    # signatures are identical too (digest and kernel keys cannot
+    # diverge on aliasing)
+    assert [expr_sig(e) for e in p1.exprs] == \
+        [expr_sig(e) for e in p2.exprs]
+
+
+def test_result_relevant_changes_move_the_digest():
+    s = _session()
+    df = _df(s)
+    base = df.filter(col("x") > 3.0).group_by("k").agg(
+        F.sum("x").alias("s"))
+    d0 = plan_digest(base.plan)
+    # literal value
+    assert plan_digest(df.filter(col("x") > 4.0).group_by("k").agg(
+        F.sum("x").alias("s")).plan) != d0
+    # operator structure
+    assert plan_digest(df.group_by("k").agg(
+        F.sum("x").alias("s")).plan) != d0
+    # aggregate function
+    assert plan_digest(df.filter(col("x") > 3.0).group_by("k").agg(
+        F.max("x").alias("s")).plan) != d0
+    # sort direction
+    q = base.sort("k")
+    assert plan_digest(q.plan) != plan_digest(
+        base.sort(col("k").desc()).plan)
+
+
+def test_identical_plans_built_twice_share_a_digest():
+    s = _session()
+    q1 = _df(s).filter(col("x") > 3.0).select("k")
+    q2 = _df(s).filter(col("x") > 3.0).select("k")
+    assert q1.plan is not q2.plan
+    assert plan_digest(q1.plan) == plan_digest(q2.plan)
+
+
+def test_inmemory_scan_is_content_keyed():
+    s = _session()
+    t1 = s.create_dataframe({"a": [1, 2, 3]})
+    t2 = s.create_dataframe({"a": [1, 2, 3]})
+    t3 = s.create_dataframe({"a": [1, 2, 4]})
+    assert plan_digest(t1.plan) == plan_digest(t2.plan)
+    assert plan_digest(t1.plan) != plan_digest(t3.plan)
+
+
+# ---------------------------------------------------------------------------
+# fingerprint: sources + cacheability
+# ---------------------------------------------------------------------------
+
+def test_filescan_fingerprint_sources(tmp_path):
+    p = str(tmp_path / "f.parquet")
+    papq.write_table(pa.table({"a": [1, 2, 3], "b": [1.0, 2.0, 3.0]}), p)
+    s = _session()
+    q = s.read.parquet(p).filter(col("a") > 1)
+    fp = plan_fingerprint(q.plan)
+    assert fp.cacheable
+    assert len(fp.sources) == 1 and fp.sources[0].endswith("f.parquet")
+    # the digest moves when the file's inferred schema/paths change, and
+    # sources is what the result cache stamps
+    assert fp.digest == plan_digest(q.plan)
+
+
+def test_nondeterministic_plans_not_cacheable():
+    s = _session()
+    df = _df(s)
+    assert plan_fingerprint(df.select("k").plan).cacheable
+    fp = plan_fingerprint(df.with_column("r", F.rand(42)).plan)
+    assert not fp.cacheable
+    fp2 = plan_fingerprint(
+        df.with_column("m", F.monotonically_increasing_id()).plan)
+    assert not fp2.cacheable
+
+
+def test_udf_plans_not_cacheable():
+    from spark_rapids_tpu import dtypes as dt
+    s = _session()
+
+    def fn(pdf):
+        return pdf
+
+    df = _df(s).map_in_pandas(fn, [("k", dt.INT64), ("x", dt.FLOAT64)])
+    assert not plan_fingerprint(df.plan).cacheable
+
+
+def test_safe_plan_digest_never_raises():
+    # not a plan node at all: the canonicalizer fails internally and
+    # safe_plan_digest must swallow it (observability attribution can
+    # never fail a query)
+    assert safe_plan_digest(object()) is None
+
+
+# ---------------------------------------------------------------------------
+# surfacing: QueryProfile + /queries column
+# ---------------------------------------------------------------------------
+
+def test_profile_and_query_table_carry_plan_digest():
+    s = _session()
+    q = _df(s).filter(col("x") > 3.0).group_by("k").agg(
+        F.count("*").alias("c")).sort("k")
+    expected = plan_digest(q.plan)
+    q.collect()
+    prof = s.last_query_profile()
+    assert prof.plan_digest == expected
+    assert prof.to_dict()["plan_digest"] == expected
+    rows = [r for r in s.scheduler.query_table()
+            if r["query_id"] == prof.query_id]
+    assert rows and rows[0]["plan_digest"] == expected
+    # in-process submissions carry no serving attribution
+    assert rows[0]["session_id"] is None

@@ -1,0 +1,549 @@
+"""Multi-tenant serving front-end (serve/): wire protocol round trips,
+prepared statements, the stamped result-set cache, session lifecycle
+(idle eviction, fair share), disconnect cancellation, and the serving
+observability surfaces."""
+
+import json
+import threading
+import time
+
+import pyarrow as pa
+import pyarrow.parquet as papq
+import pytest
+
+from spark_rapids_tpu import TpuSparkSession, functions as F
+from spark_rapids_tpu.mem import device as devmgr
+from spark_rapids_tpu.mem import spill
+from spark_rapids_tpu.obs import registry as obsreg
+from spark_rapids_tpu.sched import cancel as sched_cancel
+from spark_rapids_tpu.serve import result_cache
+from spark_rapids_tpu.serve.client import ServeClient, ServeError
+
+
+@pytest.fixture(autouse=True)
+def _fresh_serve_state():
+    """Registry counters and the process-wide result cache must not
+    leak across tests (a stale cached result would skew the
+    dispatch-count assertions)."""
+    obsreg.reset_registry()
+    result_cache.clear()
+    yield
+    obsreg.reset_registry()
+    result_cache.clear()
+
+
+def _session(extra=None):
+    conf = {
+        "spark.rapids.tpu.sql.variableFloatAgg.enabled": True,
+        "spark.rapids.tpu.serve.enabled": True,
+    }
+    conf.update(extra or {})
+    return TpuSparkSession(conf)
+
+
+def _client(s, **kw) -> ServeClient:
+    return ServeClient("127.0.0.1", s.serve_server.port, **kw)
+
+
+def _register_t(s, n=900, parts=3):
+    df = s.create_dataframe(
+        {"k": [i % 7 for i in range(n)],
+         "x": [float(i % 50) for i in range(n)],
+         "v": [f"s{i % 11}" for i in range(n)]},
+        num_partitions=parts)
+    s.register_view("t", df)
+    return df
+
+
+_AGG_SQL = ("select k, count(*) as c, sum(x) as sx from t "
+            "where x > 5.0 group by k order by k")
+
+
+class Parker:
+    """Plan listener that parks queries at plan time until released
+    (cancellation-aware) — the test_scheduler idiom."""
+
+    def __init__(self):
+        self.release = threading.Event()
+        self.parked = threading.Semaphore(0)
+
+    def __call__(self, result):
+        self.parked.release()
+        tok = sched_cancel.current()
+        deadline = time.time() + 30
+        while not self.release.is_set() and time.time() < deadline:
+            if tok is not None and tok.is_cancelled:
+                return
+            time.sleep(0.005)
+
+
+def _wait_engine_clean(s, timeout=30.0):
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        st = s.scheduler.controller.stats()
+        if st["running"] == 0 and st["queued"] == 0 and \
+                st["admitted_bytes"] == 0:
+            return st
+        time.sleep(0.02)
+    raise AssertionError(
+        f"engine not clean: {s.scheduler.controller.stats()}")
+
+
+# ---------------------------------------------------------------------------
+# wire round trips
+# ---------------------------------------------------------------------------
+
+def test_adhoc_sql_parity_and_chunked_streaming():
+    s = _session({"spark.rapids.tpu.serve.stream.chunkRows": 64})
+    _register_t(s)
+    oracle = s.sql(_AGG_SQL).collect()
+    with _client(s) as c:
+        st = c.sql_stream("select k, x from t order by x, k limit 300",
+                          credit=2)
+        chunks = list(st)
+        assert len(chunks) > 1, "expected a multi-chunk stream"
+        assert st.summary["rows"] == 300
+        assert st.summary["chunks"] == len(chunks)
+        assert st.summary["cache_hit"] is False
+        got = pa.concat_tables(chunks)
+        assert got.equals(
+            s.sql("select k, x from t order by x, k limit 300")
+            .collect())
+        # aggregate parity against the in-process path
+        assert c.sql(_AGG_SQL).equals(oracle)
+    assert obsreg.get_registry().counter("serve.streamedBatches") > 1
+
+
+def test_empty_result_still_types():
+    s = _session()
+    _register_t(s)
+    with _client(s) as c:
+        t = c.sql("select k, x from t where x > 1e9")
+        assert t.num_rows == 0
+        assert t.column_names == ["k", "x"]
+
+
+def test_error_round_trip_and_connection_survives():
+    s = _session()
+    _register_t(s)
+    with _client(s) as c:
+        with pytest.raises(ServeError):
+            c.sql("select nosuch from t")
+        with pytest.raises(ServeError):
+            c.sql("this is not sql")
+        # the connection is still healthy after server-side errors
+        assert c.ping()
+        assert c.sql("select count(*) as n from t") \
+            .column("n").to_pylist() == [900]
+
+
+# ---------------------------------------------------------------------------
+# prepared statements
+# ---------------------------------------------------------------------------
+
+def test_prepared_bind_and_rebind_parity():
+    s = _session()
+    _register_t(s)
+    with _client(s) as c:
+        h = c.prepare(
+            "select k, sum(x) as sx from t where x > :lo and v = :tag "
+            "group by k order by k",
+            params={"lo": "double", "tag": "string"})
+        assert set(h.params) == {"lo", "tag"}
+        for lo, tag in ((5.0, "s3"), (20.0, "s7"), (5.0, "s3")):
+            got = h.execute({"lo": lo, "tag": tag})
+            want = s.sql(
+                f"select k, sum(x) as sx from t where x > {lo} and "
+                f"v = '{tag}' group by k order by k").collect()
+            assert got.equals(want), (lo, tag)
+
+
+def test_prepared_errors():
+    s = _session()
+    _register_t(s)
+    with _client(s) as c:
+        with pytest.raises(ServeError):       # undeclared parameter
+            c.prepare("select k from t where x > :lo")
+        with pytest.raises(ServeError):       # unknown type name
+            c.prepare("select k from t where x > :lo",
+                      params={"lo": "decimalish"})
+        h = c.prepare("select k from t where x > :lo limit 3",
+                      params={"lo": "double"})
+        with pytest.raises(ServeError):       # missing binding
+            h.execute({})
+        with pytest.raises(ServeError):       # surplus binding
+            h.execute({"lo": 1.0, "hi": 2.0})
+        with pytest.raises(ServeError):       # mistyped value
+            h.execute({"lo": "not-a-number"})
+        assert h.execute({"lo": 5}).num_rows == 3   # int coerces to double
+        with pytest.raises(ServeError):       # unknown statement id
+            c.execute("stmt-99999", {"lo": 1.0})
+
+
+def test_multi_client_interleaved_prepared_parity():
+    """Two sessions interleaving executions of the same statement with
+    different bindings: results match the in-process oracle and the
+    sessions never see each other's bindings."""
+    s = _session()
+    _register_t(s)
+    sql = ("select k, count(*) as c from t where x > :lo "
+           "group by k order by k")
+    oracles = {lo: s.sql(sql.replace(":lo", str(lo))).collect()
+               for lo in (5.0, 25.0)}
+    c1, c2 = _client(s), _client(s)
+    try:
+        assert c1.session_id != c2.session_id
+        h1 = c1.prepare(sql, params={"lo": "double"})
+        h2 = c2.prepare(sql, params={"lo": "double"})
+        results = {}
+
+        def run(name, h, lo):
+            for _ in range(3):
+                results.setdefault(name, []).append(
+                    h.execute({"lo": lo}))
+
+        t1 = threading.Thread(target=run, args=("a", h1, 5.0))
+        t2 = threading.Thread(target=run, args=("b", h2, 25.0))
+        t1.start(); t2.start(); t1.join(60); t2.join(60)
+        assert len(results["a"]) == 3 and len(results["b"]) == 3
+        for r in results["a"]:
+            assert r.equals(oracles[5.0])
+        for r in results["b"]:
+            assert r.equals(oracles[25.0])
+    finally:
+        c1.close(); c2.close()
+    assert obsreg.get_registry().counter("serve.statementsPrepared") == 2
+
+
+# ---------------------------------------------------------------------------
+# result-set cache
+# ---------------------------------------------------------------------------
+
+def _write_part(path, n, seed):
+    papq.write_table(pa.table({
+        "a": list(range(n)),
+        "b": [float((i * seed) % 97) for i in range(n)]}), path)
+
+
+def test_result_cache_hit_zero_incremental_dispatches(tmp_path):
+    p = str(tmp_path / "f.parquet")
+    _write_part(p, 4000, 3)
+    s = _session()
+    s.register_view("pq", s.read.parquet(p))
+    sql = ("select a % 10 as g, sum(b) as sb from pq where b > 10.0 "
+           "group by g order by g")
+    with _client(s) as c:
+        first = c.sql(sql)
+        view = obsreg.get_registry().view()
+        second = c.sql(sql)
+        d = view.delta()["counters"]
+        assert second.equals(first)
+        assert d.get("kernel.dispatches", 0) == 0, d
+        assert d.get("serve.resultCacheHits", 0) == 1
+        # and the engine never even saw the second query
+        assert d.get("sched.submitted", 0) == 0
+
+
+def test_result_cache_invalidates_on_file_change(tmp_path):
+    p = str(tmp_path / "f.parquet")
+    _write_part(p, 2000, 3)
+    s = _session()
+    s.register_view("pq", s.read.parquet(p))
+    sql = "select count(*) as n, sum(b) as sb from pq"
+    with _client(s) as c:
+        r1 = c.sql(sql)
+        assert c.sql(sql).equals(r1)            # warm hit
+        # rewrite the source with different content: the stamp moves,
+        # the stale entry must not serve
+        _write_part(p, 2500, 5)
+        r3 = c.sql(sql)
+        assert r3.column("n").to_pylist() == [2500]
+        assert not r3.equals(r1)
+        reg = obsreg.get_registry()
+        assert reg.counter("serve.resultCacheHits") == 1
+        assert reg.counter("serve.resultCacheMisses") >= 2
+
+
+def test_nondeterministic_queries_bypass_the_cache():
+    s = _session()
+    df = _register_t(s)
+    # a view whose plan contains rand(): every query over it is
+    # non-cacheable (PlanFingerprint.cacheable=False)
+    s.register_view("tr", df.with_column("r", F.rand(7)))
+    with _client(s) as c:
+        view = obsreg.get_registry().view()
+        c.sql("select k, r from tr limit 5")
+        c.sql("select k, r from tr limit 5")
+        d = view.delta()["counters"]
+        assert d.get("serve.resultCacheHits", 0) == 0
+        assert d.get("sched.submitted", 0) == 2
+
+
+def test_result_cache_lru_eviction_under_byte_budget(tmp_path):
+    p = str(tmp_path / "f.parquet")
+    _write_part(p, 3000, 3)
+    s = _session({
+        # budget fits roughly one materialized result
+        "spark.rapids.tpu.serve.resultCache.maxBytes": 60_000})
+    s.register_view("pq", s.read.parquet(p))
+    with _client(s) as c:
+        c.sql("select a, b from pq where b > 1.0")
+        c.sql("select a, b from pq where b > 2.0")   # evicts the first
+        view = obsreg.get_registry().view()
+        c.sql("select a, b from pq where b > 1.0")   # miss again
+        assert view.delta()["counters"].get(
+            "serve.resultCacheHits", 0) == 0
+    assert obsreg.get_registry().counter(
+        "serve.resultCacheEvictedBytes") > 0
+
+
+# ---------------------------------------------------------------------------
+# session lifecycle: idle eviction, fair share
+# ---------------------------------------------------------------------------
+
+def test_session_idle_eviction():
+    s = _session({
+        "spark.rapids.tpu.serve.session.idleTimeoutMs": 150})
+    _register_t(s)
+    c = _client(s)
+    try:
+        assert c.sql("select count(*) as n from t").num_rows == 1
+        deadline = time.time() + 10
+        while s.serve_server.sessions() and time.time() < deadline:
+            time.sleep(0.03)
+        assert not s.serve_server.sessions(), "session not evicted"
+        with pytest.raises(ServeError) as ei:
+            c.sql("select count(*) as n from t")
+        assert ei.value.code == "SessionExpired"
+        assert obsreg.get_registry().counter(
+            "serve.sessionsEvicted") >= 1
+    finally:
+        c.abort()
+
+
+def test_fair_share_cap_under_greedy_client():
+    s = _session({
+        "spark.rapids.tpu.serve.session.maxInFlight": 1,
+        # a generous idle timeout so eviction can't race the park
+        "spark.rapids.tpu.serve.session.idleTimeoutMs": 60_000,
+        # pin small admission estimates: the default derivation is
+        # budget-sized, which would serialize the two sessions at the
+        # ADMISSION layer and hide the fair-share layer under test
+        "spark.rapids.tpu.sched.queryEstimateBytes": 1 << 20})
+    _register_t(s)
+    parker = Parker()
+    s.add_plan_listener(parker)
+    greedy, polite = _client(s), _client(s)
+    try:
+        st = greedy.sql_stream(_AGG_SQL)
+        assert parker.parked.acquire(timeout=30)
+        # the greedy session is at its cap: refused, typed
+        with pytest.raises(ServeError) as ei:
+            greedy.sql("select count(*) as n from t")
+        assert ei.value.code == "FairShareExceeded"
+        # the OTHER session still gets through (parks too, then both
+        # release together) — one client cannot monopolize the engine
+        polite_stream = polite.sql_stream(
+            "select count(*) as n from t")
+        assert parker.parked.acquire(timeout=30)
+        parker.release.set()
+        assert polite_stream.read_all().column("n").to_pylist() == [900]
+        assert st.read_all().num_rows > 0
+        # with the slot free again the greedy client works too
+        assert greedy.sql("select count(*) as n from t").num_rows == 1
+    finally:
+        s.remove_plan_listener(parker)
+        parker.release.set()
+        greedy.close(); polite.close()
+
+
+# ---------------------------------------------------------------------------
+# disconnect cancellation
+# ---------------------------------------------------------------------------
+
+def test_disconnect_mid_query_cancels_leak_free():
+    s = _session()
+    _register_t(s, n=2000)
+    cat_baseline = len(spill.get_catalog()._buffers)
+    parker = Parker()
+    s.add_plan_listener(parker)
+    c = _client(s)
+    try:
+        c.sql_stream(_AGG_SQL)
+        assert parker.parked.acquire(timeout=30)
+        # hard drop: the reader thread must fire the query's
+        # CancelToken, which unparks the listener and unwinds the query
+        c.abort()
+        _wait_engine_clean(s)
+    finally:
+        s.remove_plan_listener(parker)
+        parker.release.set()
+    rows = [r for r in s.scheduler.query_table()
+            if r["state"] == "cancelled"]
+    assert rows, "disconnected query was not cancelled"
+    assert rows[0]["session_id"] is not None
+    # nothing stayed registered in the spill catalog, and the device
+    # gate is fully free
+    assert len(spill.get_catalog()._buffers) <= cat_baseline
+    gate = devmgr._get()
+    assert gate.available() == gate.slots
+    assert obsreg.get_registry().counter("serve.clientDisconnects") >= 1
+    # the engine still serves fresh clients
+    with _client(s) as c2:
+        assert c2.sql("select count(*) as n from t") \
+            .column("n").to_pylist() == [2000]
+
+
+def test_disconnect_mid_stream_aborts_cleanly():
+    s = _session({"spark.rapids.tpu.serve.stream.chunkRows": 50})
+    _register_t(s, n=1500)
+    cat_baseline = len(spill.get_catalog()._buffers)
+    c = _client(s)
+    st = c.sql_stream("select k, x, v from t order by x, k, v",
+                      credit=1)
+    it = iter(st)
+    first = next(it)
+    assert first.num_rows == 50
+    c.abort()                      # mid-stream: many chunks remain
+    _wait_engine_clean(s)
+    deadline = time.time() + 20
+    while time.time() < deadline:
+        sess = list(s.serve_server.sessions().values())
+        if not sess or all(x.inflight == 0 for x in sess):
+            break
+        time.sleep(0.02)
+    sess = list(s.serve_server.sessions().values())
+    assert all(x.inflight == 0 for x in sess), \
+        [x.describe() for x in sess]
+    assert len(spill.get_catalog()._buffers) <= cat_baseline
+    with _client(s) as c2:
+        assert c2.sql("select count(*) as n from t") \
+            .column("n").to_pylist() == [1500]
+
+
+def test_explicit_cancel_op():
+    s = _session()
+    _register_t(s)
+    parker = Parker()
+    s.add_plan_listener(parker)
+    c = _client(s)
+    try:
+        st = c.sql_stream(_AGG_SQL)
+        assert parker.parked.acquire(timeout=30)
+        assert c.cancel(st)
+        with pytest.raises(ServeError):
+            st.read_all()
+        _wait_engine_clean(s)
+    finally:
+        s.remove_plan_listener(parker)
+        parker.release.set()
+        c.close()
+
+
+# ---------------------------------------------------------------------------
+# serving observability: attribution, counters, slow-query session ids
+# ---------------------------------------------------------------------------
+
+def test_queries_table_and_metrics_attribution(tmp_path):
+    slow_path = str(tmp_path / "slow.jsonl")
+    s = _session({
+        "spark.rapids.tpu.obs.http.enabled": True,
+        "spark.rapids.tpu.obs.slowQueryMs": 1,
+        "spark.rapids.tpu.obs.slowQueryPath": slow_path})
+    _register_t(s)
+    import urllib.request
+
+    def scrape(path):
+        url = f"http://127.0.0.1:{s.obs_server.port}{path}"
+        with urllib.request.urlopen(url, timeout=10) as r:
+            return r.read().decode()
+
+    with _client(s) as c:
+        c.sql(_AGG_SQL)
+        rows = json.loads(scrape("/queries"))["queries"]
+        mine = [r for r in rows if r.get("session_id") == c.session_id]
+        assert mine, rows
+        assert mine[0]["client_addr"].startswith("127.0.0.1:")
+        assert mine[0]["plan_digest"]
+        from spark_rapids_tpu.obs.server import parse_prometheus
+        m = parse_prometheus(scrape("/metrics"))
+        assert m.get("spark_rapids_tpu_serve_sessions", 0) >= 1
+        assert m.get("spark_rapids_tpu_serve_activeSessions") == 1
+        assert m.get("spark_rapids_tpu_serve_streamedBatches", 0) >= 1
+        assert "spark_rapids_tpu_serve_resultCacheMisses" in m
+        # the profile carries the session id into the slow-query log
+        with open(slow_path) as f:
+            records = [json.loads(ln) for ln in f if ln.strip()]
+        assert any(r.get("session_id") == c.session_id
+                   for r in records), records
+
+
+def test_rejected_queries_hit_recorder_and_slow_log(tmp_path):
+    """Queue-full rejections happen BEFORE admission; the satellite
+    contract is that they still produce a flight-recorder bundle and a
+    slow-query record with the standard schema."""
+    import os
+    rec_dir = str(tmp_path / "rec")
+    slow_path = str(tmp_path / "slow.jsonl")
+    s = TpuSparkSession({
+        "spark.rapids.tpu.sql.variableFloatAgg.enabled": True,
+        "spark.rapids.tpu.sched.maxConcurrent": 1,
+        "spark.rapids.tpu.sched.maxQueued": 1,
+        "spark.rapids.tpu.obs.recorder.dir": rec_dir,
+        "spark.rapids.tpu.obs.slowQueryMs": 60_000,
+        "spark.rapids.tpu.obs.slowQueryPath": slow_path})
+    df = s.create_dataframe(
+        {"k": [i % 3 for i in range(300)],
+         "x": [float(i) for i in range(300)]}, num_partitions=2)
+    q = df.group_by("k").agg(F.sum("x").alias("s")).sort("k")
+    parker = Parker()
+    s.add_plan_listener(parker)
+    try:
+        f1 = q.collect_async()
+        assert parker.parked.acquire(timeout=30)
+        f2 = q.collect_async()             # fills the 1-slot queue
+        deadline = time.time() + 10
+        while s.scheduler.controller.stats()["queued"] < 1 and \
+                time.time() < deadline:
+            time.sleep(0.01)
+        f3 = q.collect_async()             # rejected
+        with pytest.raises(Exception, match="queue full"):
+            f3.result(timeout=30)
+        parker.release.set()
+        f1.result(timeout=60); f2.result(timeout=60)
+    finally:
+        s.remove_plan_listener(parker)
+        parker.release.set()
+    # slow-query record: status rejected, standard schema, regardless
+    # of wall (the query never ran)
+    with open(slow_path) as f:
+        records = [json.loads(ln) for ln in f if ln.strip()]
+    rej = [r for r in records if r["status"] == "rejected"]
+    assert rej, records
+    for key in ("query_id", "status", "error", "wall_s", "result_rows",
+                "phases", "wall_breakdown", "session_id",
+                "plan_digest"):
+        assert key in rej[0], key
+    assert "queue full" in rej[0]["error"]
+    # flight-recorder bundle under reason "rejected", fully formed
+    bundles = [d for d in os.listdir(rec_dir) if "-rejected-" in d]
+    assert bundles, os.listdir(rec_dir)
+    bd = os.path.join(rec_dir, bundles[0])
+    prof = json.load(open(os.path.join(bd, "profile.json")))
+    assert prof["status"] == "rejected"
+    assert os.path.exists(os.path.join(bd, "events.jsonl"))
+    # the rejected query's profile is also in the ring
+    assert s.query_profile(prof["query_id"]).status == "rejected"
+
+
+def test_session_info_and_conf_overlay():
+    s = _session()
+    _register_t(s)
+    with _client(s, conf={"priority": 7, "timeoutMs": 30_000}) as c:
+        info = c.session_info()
+        assert info["priority"] == 7
+        assert info["timeout_ms"] == 30_000
+        c.sql("select count(*) as n from t")
+        rows = [r for r in s.scheduler.query_table()
+                if r.get("session_id") == c.session_id]
+        assert rows and rows[0]["priority"] == 7
