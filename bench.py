@@ -175,6 +175,135 @@ def _dispatch_count_probe(n: int = 160_000, files: int = 2) -> dict:
             "rows_match": True}
 
 
+def _kernel_backend_probe(rows: int = 1 << 17) -> dict:
+    """Per-backend (xla vs pallas, ``kernel.backend``) timings of the
+    two gather-wall kernels this round targets, with parity asserted
+    before any number is reported (the bench's standing honesty rule):
+
+      * decode — one hybrid RLE/bit-pack stream expansion
+        (kernels/decode.expand_stream vs the window-gather XLA path)
+      * agg — one masked grouped seg_sum + seg_count through
+        ``_SortedCtx`` (kernels/segreduce single-pass vs the composed
+        gather+scan chain)
+
+    Also reports gathers-per-element: the XLA decode's count is
+    MEASURED by walking its traced jaxpr for [cap]-sized gather ops;
+    the Pallas count is by construction of the dense unpack (exactly
+    one dense-value gather inside the expand kernel).  On CPU smoke
+    runs the Pallas kernels execute under interpret=True, so the ms
+    numbers are only meaningful relative to hardware runs — the parity
+    and gather accounting are the point there."""
+    import jax
+    import jax.numpy as jnp
+    from spark_rapids_tpu.exec.tpu_aggregate import _group_ctx
+    from spark_rapids_tpu.expr.eval_tpu import ColVal
+    from spark_rapids_tpu import dtypes as dt
+    from spark_rapids_tpu.io.device_parquet import (RunTable,
+                                                    expand_runs_matrix,
+                                                    _upload_runs)
+    from spark_rapids_tpu.kernels import backend as kb
+    from spark_rapids_tpu.kernels import decode as kdec
+
+    rng = np.random.default_rng(11)
+    w = 15
+    runs = RunTable.empty()
+    packed = bytearray()
+    total = 0
+    while total < rows - 4096:
+        if rng.random() < 0.5:
+            c = int(rng.integers(100, 2000))
+            runs.counts.append(c)
+            runs.is_rle.append(True)
+            runs.values.append(int(rng.integers(0, 1 << w)))
+            runs.bit_bases.append(0)
+            runs.widths.append(w)
+        else:
+            groups = int(rng.integers(8, 64))
+            c = groups * 8
+            runs.counts.append(c)
+            runs.is_rle.append(False)
+            runs.values.append(0)
+            runs.bit_bases.append(len(packed) * 8)
+            runs.widths.append(w)
+            packed += rng.integers(0, 256, groups * w).astype(
+                np.uint8).tobytes()
+        total += c
+    cap = rows
+
+    def timed_ms(fn, reps: int = 3) -> float:
+        np.asarray(fn())          # compile/warm
+        best = None
+        for _ in range(reps):
+            t0 = time.perf_counter()
+            np.asarray(fn())
+            dt_ = time.perf_counter() - t0
+            best = dt_ if best is None else min(best, dt_)
+        return best * 1e3
+
+    out: dict = {}
+    decode_res = {}
+    for bk_name in ("xla", "pallas"):
+        with kb.backend_override(bk_name):
+            decode_res[bk_name] = np.asarray(
+                kdec.expand_stream(runs, bytes(packed), cap))[:total]
+            ms = timed_ms(lambda: kdec.expand_stream(
+                runs, bytes(packed), cap))
+        out[f"decode_{bk_name}_ms"] = round(ms, 3)
+    assert np.array_equal(decode_res["xla"], decode_res["pallas"]), \
+        "kernel.backend decode parity failed — no number is reported"
+
+    # measured gather count of the XLA expansion (per-element = output
+    # at least [cap]-sized), vs the Pallas kernel's single dense gather
+    dev = _upload_runs(runs, bytes(packed))
+
+    def _xla_expand(runs_mat, pk):
+        return expand_runs_matrix(runs_mat, pk, cap)
+    jaxpr = jax.make_jaxpr(_xla_expand)(dev["runs_mat"], dev["packed"])
+    gathers = 0
+
+    def walk(jx):
+        nonlocal gathers
+        for eq in jx.eqns:
+            if eq.primitive.name == "gather" and \
+                    eq.outvars[0].aval.shape and \
+                    eq.outvars[0].aval.shape[0] >= cap:
+                gathers += 1
+            for v in eq.params.values():
+                if hasattr(v, "jaxpr"):
+                    walk(v.jaxpr)
+    walk(jaxpr.jaxpr)
+    out["gathers_per_element"] = {
+        "xla_measured": gathers,
+        "pallas_by_construction":
+            kdec.GATHERS_PER_ELEMENT["pallas"],
+    }
+
+    # -- aggregate seg-reduce leg ------------------------------------
+    n = cap - 777
+    keys = np.zeros(cap, np.int64)
+    keys[:n] = rng.integers(0, 64, n)
+    vals = np.zeros(cap, np.float64)
+    vals[:n] = rng.uniform(-1e4, 1e4, n)
+    kv = ColVal(dt.INT64, jnp.asarray(keys),
+                jnp.ones(cap, bool), None)
+    v = jnp.asarray(vals)
+    mask = jnp.arange(cap) < n
+    agg_res = {}
+    for bk_name in ("xla", "pallas"):
+        def one(bk=bk_name):
+            ctx = _group_ctx([kv], cap, n, backend=bk)
+            return ctx.seg_sum(v, mask, out_np=np.float64) + \
+                ctx.seg_count(mask)
+        agg_fn = jax.jit(one)
+        agg_res[bk_name] = np.asarray(agg_fn())[:64]
+        out[f"agg_{bk_name}_ms"] = round(timed_ms(agg_fn), 3)
+    assert np.array_equal(agg_res["xla"], agg_res["pallas"]), \
+        "kernel.backend aggregate parity failed"
+    out["rows"] = rows
+    out["rows_match"] = True
+    return out
+
+
 def _concurrent_probe(root: str, n_queries: int) -> dict:
     """N mixed q6-class queries through the concurrent scheduler
     (sched/service.py): a serial pass first (the parity oracle and the
@@ -514,6 +643,7 @@ def main() -> None:
     profile_out = None
     concurrent_n = None    # None = flag absent; 0 = explicitly off
     serve_n = 0            # --serve=N remote clients; 0 = off
+    trend_out = "BENCH_pr9.json"   # --trend-out= overrides
     for a in sys.argv[1:]:
         if a.startswith("--profile-out="):
             profile_out = a.split("=", 1)[1]
@@ -521,6 +651,8 @@ def main() -> None:
             concurrent_n = int(a.split("=", 1)[1])
         elif a.startswith("--serve="):
             serve_n = int(a.split("=", 1)[1])
+        elif a.startswith("--trend-out="):
+            trend_out = a.split("=", 1)[1]
     if smoke:
         n = 160_000
         if concurrent_n is None:
@@ -581,6 +713,14 @@ def main() -> None:
     # structured mismatch report downstream tooling parses
     dispatch_probe = _dispatch_count_probe()
 
+    # per-backend kernel timings (kernel.backend xla vs pallas);
+    # parity-asserted inside, error-isolated so a Mosaic/interpret
+    # surprise on an unusual runtime degrades the report, not the bench
+    try:
+        kernels = _kernel_backend_probe(1 << 15 if smoke else 1 << 17)
+    except Exception as e:
+        kernels = {"error": f"{type(e).__name__}: {e}"}
+
     gbps = nbytes / per_query / 1e9
     result = {
         "metric": "TPC-DS q6-class device pipeline over parquet "
@@ -596,6 +736,7 @@ def main() -> None:
         "host_prep_warm_s": round(host_prep_warm_s, 3),
         "rows_match": bool(rows_match),
         "dispatch_probe": dispatch_probe,
+        "kernels": kernels,
         "concurrent": concurrent,
         "serve": serve,
         "e2e_tunnel_wall_s": round(e2e, 2) if e2e else None,
@@ -603,19 +744,23 @@ def main() -> None:
         "profile_out": profile_out,
     }
     print(json.dumps(result))
-    _write_trend_file(result, n=n, files=files, smoke=smoke)
+    _write_trend_file(result, n=n, files=files, smoke=smoke,
+                      out_name=trend_out)
 
 
 def _write_trend_file(result: dict, n: int, files: int,
-                      smoke: bool) -> str:
-    """Machine-readable trend record at the repo root (BENCH_pr6.json):
-    suite timings, dispatch counts, and queue-wait percentiles in one
-    stable schema, so the perf trajectory is greppable across PRs
+                      smoke: bool,
+                      out_name: str = "BENCH_pr9.json") -> str:
+    """Machine-readable trend record at the repo root (name set by
+    ``--trend-out=``, default BENCH_pr9.json): suite timings, dispatch
+    counts, per-backend kernel timings, and queue-wait percentiles in
+    one stable schema, so the perf trajectory is greppable across PRs
     instead of living only in prose."""
     probe = result.get("dispatch_probe") or {}
     conc = result.get("concurrent") or {}
+    kern = result.get("kernels") or {}
     trend = {
-        "schema": "spark-rapids-tpu-bench-trend/1",
+        "schema": "spark-rapids-tpu-bench-trend/2",
         "generated_unix": time.time(),
         "config": {"rows": n, "files": files, "smoke": smoke},
         "suite_timings": {
@@ -641,10 +786,22 @@ def _write_trend_file(result: dict, n: int, files: int,
             "p50_ms": conc.get("queue_wait_p50_ms"),
             "p95_ms": conc.get("queue_wait_p95_ms"),
         },
+        # per-backend kernel.backend timings (decode / aggregate) +
+        # gathers-per-element accounting — the PR-9 headline
+        "kernels": {
+            "decode_xla_ms": kern.get("decode_xla_ms"),
+            "decode_pallas_ms": kern.get("decode_pallas_ms"),
+            "agg_xla_ms": kern.get("agg_xla_ms"),
+            "agg_pallas_ms": kern.get("agg_pallas_ms"),
+            "gathers_per_element": kern.get("gathers_per_element"),
+            "rows": kern.get("rows"),
+            "rows_match": kern.get("rows_match"),
+            "error": kern.get("error"),
+        },
         "rows_match": result.get("rows_match"),
     }
     path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
-                        "BENCH_pr6.json")
+                        out_name)
     with open(path, "w") as f:
         json.dump(trend, f, indent=2)
         f.write("\n")

@@ -23,6 +23,9 @@ def main() -> None:
     sf = 0.002
     if "--sf" in sys.argv:
         sf = float(sys.argv[sys.argv.index("--sf") + 1])
+    backend = "xla"
+    if "--backend" in sys.argv:   # kernel.backend for the whole suite
+        backend = sys.argv[sys.argv.index("--backend") + 1]
 
     from spark_rapids_tpu import TpuSparkSession
     from spark_rapids_tpu.bench import tpcds
@@ -30,7 +33,8 @@ def main() -> None:
 
     data = tpcds.generate(sf, seed=13)
     s = TpuSparkSession(
-        {"spark.rapids.tpu.sql.variableFloatAgg.enabled": True})
+        {"spark.rapids.tpu.sql.variableFloatAgg.enabled": True,
+         "spark.rapids.tpu.kernel.backend": backend})
     tables = tpcds.setup(s, data)
 
     from spark_rapids_tpu.obs import registry as obsreg
@@ -78,6 +82,18 @@ def main() -> None:
             int(reg_totals.get("kernel.cache.misses", 0)),
         "fusion_dispatches_saved":
             int(reg_totals.get("fusion.dispatchesSaved", 0)),
+        # which kernel backend actually RAN, per dispatching family
+        # (kernel.dispatches.<family>.<pallas|xla>) plus the selection
+        # counters with fallback reasons — the per-backend compile/
+        # dispatch trend the kernel.backend knob is judged by
+        "kernel_backend": backend,
+        "backend_dispatches": {
+            k: int(v) for k, v in sorted(reg_totals.items())
+            if k.startswith("kernel.dispatches.") and
+            (k.endswith(".pallas") or k.endswith(".xla"))},
+        "pallas_selection": {
+            k: int(v) for k, v in sorted(reg_totals.items())
+            if k.startswith("kernel.backend.pallas.")},
         "per_query": per_query,
         "top10": [{"kernel": k[:100], "s": round(v, 1)}
                   for k, v in top],

@@ -76,6 +76,60 @@ assert fused_d < plain_d, (
 print(f"fusion parity OK; dispatches {plain_d} -> {fused_d}")
 EOF
 
+echo "== kernel-backend parity (kernel.backend=pallas vs =xla, interpret mode) =="
+timeout 300 python - <<'EOF'
+# the XLA composed-array-op paths are the Pallas kernels' correctness
+# oracle (the sql.fusion.enabled pattern): one real q6-class query —
+# dict-encoded parquet scan -> filter -> grouped aggregate — runs under
+# both kernel.backend settings and must be BIT-IDENTICAL.  On CPU the
+# Pallas kernels execute under interpret=True (real kernel bodies, not
+# a skip), and the registry must show actual pallas selections: a
+# silently-all-fallback run would make this gate vacuous.
+import os, tempfile
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+import numpy as np
+import pyarrow as pa, pyarrow.parquet as papq
+from spark_rapids_tpu import TpuSparkSession, col, functions as F
+from spark_rapids_tpu.obs import registry as obsreg
+
+root = tempfile.mkdtemp(prefix="kernel_parity_")
+n = 8000
+rng = np.random.default_rng(23)
+papq.write_table(pa.table({
+    "k": pa.array(rng.integers(1, 40, n).astype(np.int64)),
+    "q": pa.array(rng.integers(1, 101, n).astype(np.int32)),
+    "p": np.round(rng.uniform(0.2, 200.0, n), 2)}),
+    os.path.join(root, "t.parquet"),
+    use_dictionary=["k", "q"], data_page_size=8192)
+
+def run(backend):
+    s = TpuSparkSession({
+        "spark.rapids.tpu.sql.variableFloatAgg.enabled": True,
+        "spark.rapids.tpu.kernel.backend": backend})
+    view = obsreg.get_registry().view()
+    out = (s.read.parquet(root)
+           .filter(col("p") > 150.0)
+           .group_by("k")
+           .agg(F.count("*").alias("cnt"), F.sum("q").alias("qty"),
+                F.avg("p").alias("ap"))
+           .sort("k")).collect()
+    return out, view.delta()["counters"]
+
+xla_t, _ = run("xla")
+pal_t, d = run("pallas")
+assert xla_t.equals(pal_t), (
+    "kernel.backend=pallas diverges from =xla:\n"
+    f"xla={xla_t.to_pydict()}\npallas={pal_t.to_pydict()}")
+hits = d.get("kernel.backend.pallas.hits", 0)
+assert hits > 0, f"no pallas kernel selected — gate is vacuous: {d}"
+agg_pallas = d.get("kernel.dispatches.agg_update.pallas", 0)
+assert agg_pallas > 0, f"aggregate never dispatched on pallas: {d}"
+fams = {k for k in d if k.startswith("kernel.backend.pallas.hits.")}
+print(f"kernel-backend parity OK: bit-identical, {int(hits)} pallas "
+      f"selections across {len(fams)} families, "
+      f"{int(agg_pallas)} pallas agg dispatches")
+EOF
+
 echo "== concurrency smoke (8 async queries, sched.maxConcurrent=3, live /metrics + /queries scrape) =="
 timeout 300 python - <<'EOF'
 # N=8 mixed TPC-like queries through the concurrent query scheduler

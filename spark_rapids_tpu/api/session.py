@@ -49,6 +49,8 @@ class TpuSparkSession:
         scan_cache.configure(
             self.conf.get(cfg.SCAN_METADATA_CACHE_ENABLED),
             self.conf.get(cfg.SCAN_METADATA_CACHE_MAX_BYTES))
+        from spark_rapids_tpu.kernels import backend as kernel_backend
+        kernel_backend.configure(self.conf)
         from spark_rapids_tpu.pyworker import pool as pyworker_pool
         pyworker_pool.configure(self.conf)
         from spark_rapids_tpu.shuffle import faults

@@ -428,6 +428,27 @@ SHUFFLE_PARTITIONS = conf(
     "Default number of shuffle partitions (spark.sql.shuffle.partitions "
     "analog).", int)
 
+KERNEL_BACKEND = conf(
+    "spark.rapids.tpu.kernel.backend", "xla",
+    "Kernel backend for the gather-bound decode/aggregate hot paths: "
+    "'xla' (the composed array-op formulations) or 'pallas' "
+    "(hand-written Pallas kernels: dense phase-decomposed RLE/"
+    "bit-unpack, fused dictionary-decode+filter, single-pass segmented "
+    "reduction — spark_rapids_tpu/kernels/). Selection is per call "
+    "site with automatic per-kernel fallback to the XLA path when a "
+    "shape/dtype isn't covered (never whole-query; counted in "
+    "kernel.backend.pallas.hits/.fallbacks with reason tags). The "
+    "sql.fusion.enabled pattern: the XLA path stays the correctness "
+    "oracle and CI diffs the two backends bit-for-bit.")
+
+KERNEL_PALLAS_INTERPRET = conf(
+    "spark.rapids.tpu.kernel.pallas.interpret", "auto",
+    "Run Pallas kernels in interpreter mode: 'auto' (interpret unless "
+    "the active jax backend is a real TPU — so CPU CI executes the "
+    "real kernel bodies and parity gates are genuine, not skips), "
+    "'true' (always interpret, for debugging), 'false' (always compile "
+    "via Mosaic).")
+
 AGG_FUSED_FILTER = conf(
     "spark.rapids.tpu.sql.agg.fusedFilter.enabled", True,
     "Fuse a Filter directly under a hash aggregate into the "

@@ -171,23 +171,38 @@ def _family(key: Any) -> str:
     return "other"
 
 
-def _count_dispatches(key: Any, fn: Callable) -> Callable:
+def _count_dispatches(key: Any, fn: Callable,
+                      backend: str = None) -> Callable:
     """Per-call registry counters: ``kernel.dispatches`` is the ground
     truth the fusion layer's dispatch-reduction claims are measured
     against (bench.py / tests assert the fused-vs-unfused delta on it;
-    one lock bump per ~72 ms dispatch is noise)."""
+    one lock bump per ~72 ms dispatch is noise).
+
+    Backend-aware call sites additionally tag the family counter with
+    the backend this executable was BUILT under
+    (``kernel.dispatches.<family>.<pallas|xla>``).  Note the exact
+    semantics: a ``.pallas``-tagged dispatch ran an executable built
+    with the pallas backend REQUESTED — individual reductions inside
+    it may still have fallen back per kernel; read it together with
+    the selection counters ``kernel.backend.pallas.hits/.fallbacks``
+    (kernels/backend.py) to see whether pallas kernels actually
+    engaged inside."""
     from spark_rapids_tpu.obs import registry as _obsreg
     fam = _family(key)
+    pairs = [("kernel.dispatches", 1), (f"kernel.dispatches.{fam}", 1)]
+    if backend:
+        pairs.append((f"kernel.dispatches.{fam}.{backend}", 1))
+    pairs = tuple(pairs)
 
     def wrapped(*args, **kwargs):
-        _obsreg.get_registry().inc_many(
-            ("kernel.dispatches", 1), (f"kernel.dispatches.{fam}", 1))
+        _obsreg.get_registry().inc_many(*pairs)
         return fn(*args, **kwargs)
     return wrapped
 
 
 def get_kernel(key: Any, builder: Callable[[], Callable],
-               oom_retry: bool = True, **jit_kwargs) -> Callable:
+               oom_retry: bool = True, backend: str = None,
+               **jit_kwargs) -> Callable:
     """Return the cached jitted kernel for ``key``, building+jitting via
     ``builder`` on first use (LRU-bounded).
 
@@ -195,7 +210,12 @@ def get_kernel(key: Any, builder: Callable[[], Callable],
     the kernel donates input buffers (a retry would replay arguments
     the failed dispatch may already have consumed).  Call sites that
     donate must fold the donation into ``key``: the same signature
-    jitted with and without ``donate_argnums`` is two executables."""
+    jitted with and without ``donate_argnums`` is two executables.
+
+    ``backend`` tags this kernel's per-dispatch family counter with the
+    kernel backend ('pallas'/'xla') at backend-aware call sites; the
+    backend must already be folded into ``key`` by the caller (two
+    backends are two executables)."""
     from spark_rapids_tpu.obs import registry as _obsreg
     fam = _family(key)
     with _LOCK:
@@ -211,7 +231,7 @@ def get_kernel(key: Any, builder: Callable[[], Callable],
     fn = jax.jit(builder(), **jit_kwargs)
     if oom_retry:
         fn = _with_oom_recovery(fn)
-    fn = _count_dispatches(key, fn)
+    fn = _count_dispatches(key, fn, backend)
     if COMPILE_LOG_ENABLED:
         fn = _instrument(key, fn)
     with _LOCK:

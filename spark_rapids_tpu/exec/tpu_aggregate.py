@@ -75,6 +75,9 @@ class _SortedCtx:
     # arithmetically instead of through original-row gathers
     sorted_key: Optional[jnp.ndarray] = None
     key_inverse: Optional[Tuple] = None
+    # kernel backend for the segment reductions ('xla' | 'pallas'):
+    # per-REDUCTION selection with fallback — see kernels/segreduce.py
+    backend: str = "xla"
 
     # -- scatter-free segment reductions -------------------------------
     #
@@ -84,8 +87,27 @@ class _SortedCtx:
     # in ORIGINAL row space (dense elementwise, ~1 ms per 4M) and pays
     # exactly ONE value gather into sorted space; i64 end-position
     # gathers are narrowed to i32 whenever a vbits hint bounds the sum.
+    #
+    # Under ``kernel.backend=pallas`` the gather and the segmented scan
+    # fuse into ONE single-pass Pallas kernel (kernels/segreduce.py):
+    # the sorted copy and the standalone scan array never materialize.
+    # Each reduction selects independently; unsupported shapes/dtypes
+    # keep the XLA chain below (per-kernel fallback, never the whole
+    # aggregate).
     def take_sorted(self, x: jnp.ndarray) -> jnp.ndarray:
         return jnp.take(x, self.order, axis=0)
+
+    def _pallas_op(self, op, dtype, ndim: int = 1) -> Optional[str]:
+        """op-key when this reduction runs the Pallas kernel, else
+        None (selection + hit/fallback accounting happen here, at
+        trace time of the enclosing cached aggregate kernel)."""
+        from spark_rapids_tpu.kernels import backend as kb
+        from spark_rapids_tpu.kernels import segreduce as kseg
+        name = kseg.op_name(op)
+        ok, reason = kseg.supported(self.cap, dtype, name, ndim)
+        bk = kb.choose("agg.segreduce", self.backend, ok,
+                       reason or "unsupported")
+        return name if bk == kb.PALLAS else None
 
     def seg_sum(self, x: jnp.ndarray, mask: jnp.ndarray,
                 out_np=None, narrow_bits: Optional[int] = None
@@ -99,7 +121,12 @@ class _SortedCtx:
         ``narrow_bits`` hint with narrow_bits+log2(cap) <= 31 keeps the
         whole chain in native i32.  Floats use the segmented scan: a
         global float cumsum would leak +/-inf and rounding error across
-        group boundaries through the differences."""
+        group boundaries through the differences.  (The Pallas path
+        computes every variant as a fused gather+segmented-add — equal
+        to the cumsum-difference formulation exactly, ints being exact
+        under wraparound, and bit-identical for floats by the shared
+        block structure.)"""
+        from spark_rapids_tpu.kernels import segreduce as kseg
         out_np = out_np or x.dtype
         if jnp.issubdtype(jnp.dtype(out_np), jnp.floating):
             # cast before the gather: f64 gathers are native-cheap while
@@ -107,16 +134,29 @@ class _SortedCtx:
             # commute with the gather)
             xm = jnp.where(mask, x.astype(out_np),
                            jnp.zeros((), out_np))
-            return self.seg_scan_reduce(self.take_sorted(xm),
-                                        jnp.add, 0)
+            if self._pallas_op(jnp.add, out_np):
+                s = kseg.gather_seg_scan(xm, self.order, self.new,
+                                         "add", 0)
+                return jnp.take(s, self.end_pos)
+            return jnp.take(
+                scans.seg_scan(jnp.add, self.new,
+                               self.take_sorted(xm), 0), self.end_pos)
         narrow = (narrow_bits is not None and
                   narrow_bits + max(self.cap - 1, 1).bit_length() <= 31)
         if narrow:
             xm = jnp.where(mask, x, jnp.zeros((), x.dtype)
                            ).astype(jnp.int32)
+            if self._pallas_op(jnp.add, jnp.int32):
+                s = kseg.gather_seg_scan(xm, self.order, self.new,
+                                         "add", 0)
+                return jnp.take(s, self.end_pos).astype(out_np)
             c = jnp.cumsum(self.take_sorted(xm))
         else:
             xm = jnp.where(mask, x, jnp.zeros((), x.dtype))
+            if self._pallas_op(jnp.add, out_np):
+                s = kseg.gather_seg_scan(xm, self.order, self.new,
+                                         "add", 0, scan_np=out_np)
+                return jnp.take(s, self.end_pos)
             c = scans.cumsum(self.take_sorted(xm).astype(out_np))
         ce = jnp.take(c, self.end_pos)
         return (ce - jnp.concatenate([ce[:1] * 0, ce[:-1]])
@@ -125,9 +165,17 @@ class _SortedCtx:
     def seg_count(self, mask: jnp.ndarray) -> jnp.ndarray:
         # counts fit int32 (cap < 2^31): the native 32-bit cumsum skips
         # the blocked 64-bit scan entirely; widen at the end
+        from spark_rapids_tpu.kernels import segreduce as kseg
         if mask is self.row_mask:   # COUNT(*): already have it sorted
             xs = self.sorted_mask.astype(jnp.int32)
+            if self._pallas_op(jnp.add, jnp.int32):
+                s = kseg.seg_scan_sorted(self.new, xs, "add", 0)
+                return jnp.take(s, self.end_pos).astype(jnp.int64)
         else:
+            if self._pallas_op(jnp.add, jnp.int32):
+                s = kseg.gather_seg_scan(mask, self.order, self.new,
+                                         "add", 0, scan_np=jnp.int32)
+                return jnp.take(s, self.end_pos).astype(jnp.int64)
             xs = self.take_sorted(mask).astype(jnp.int32)
         c = jnp.cumsum(xs)
         ce = jnp.take(c, self.end_pos)
@@ -139,20 +187,32 @@ class _SortedCtx:
         """Segmented reduce via associative scan over sorted rows; the
         caller pre-fills excluded rows with op's identity (also passed
         here so the capacity-blocked scan can pad with it)."""
-        s = scans.seg_scan(op, self.new, x_sorted, identity)
+        from spark_rapids_tpu.kernels import segreduce as kseg
+        name = self._pallas_op(op, x_sorted.dtype, x_sorted.ndim)
+        if name:
+            s = kseg.seg_scan_sorted(self.new, x_sorted, name, identity)
+        else:
+            s = scans.seg_scan(op, self.new, x_sorted, identity)
         return jnp.take(s, self.end_pos)
 
     def seg_min_of(self, x: jnp.ndarray, mask: jnp.ndarray,
                    fill) -> jnp.ndarray:
-        xm = jnp.where(mask, x, jnp.asarray(fill, dtype=x.dtype))
-        return self.seg_scan_reduce(self.take_sorted(xm),
-                                    jnp.minimum, fill)
+        return self._seg_extreme(x, mask, fill, jnp.minimum, "min")
 
     def seg_max_of(self, x: jnp.ndarray, mask: jnp.ndarray,
                    fill) -> jnp.ndarray:
+        return self._seg_extreme(x, mask, fill, jnp.maximum, "max")
+
+    def _seg_extreme(self, x, mask, fill, op, name) -> jnp.ndarray:
+        from spark_rapids_tpu.kernels import segreduce as kseg
         xm = jnp.where(mask, x, jnp.asarray(fill, dtype=x.dtype))
-        return self.seg_scan_reduce(self.take_sorted(xm),
-                                    jnp.maximum, fill)
+        if self._pallas_op(op, x.dtype, xm.ndim):
+            s = kseg.gather_seg_scan(xm, self.order, self.new, name,
+                                     fill)
+            return jnp.take(s, self.end_pos)
+        return jnp.take(
+            scans.seg_scan(op, self.new, self.take_sorted(xm), fill),
+            self.end_pos)
 
 
 class _AggSpec:
@@ -432,13 +492,16 @@ def normalize_key(v: ColVal) -> ColVal:
 
 
 def sorted_group_ctx(key_vals: List[ColVal],
-                     batch: DeviceBatch) -> _SortedCtx:
+                     batch: DeviceBatch,
+                     backend: str = "xla") -> _SortedCtx:
     """Batch-shaped wrapper over _group_ctx (rows are prefix-dense:
     row i exists iff i < num_rows)."""
-    return _group_ctx(key_vals, batch.capacity, batch.num_rows)
+    return _group_ctx(key_vals, batch.capacity, batch.num_rows,
+                      backend=backend)
 
 
-def _group_ctx(key_vals: List[ColVal], cap: int, n_rows) -> _SortedCtx:
+def _group_ctx(key_vals: List[ColVal], cap: int, n_rows,
+               backend: str = "xla") -> _SortedCtx:
     """Group rows by key: stable LSD radix sort over bit-packed key
     digits brings equal keys adjacent, boundaries mark group starts, and
     every downstream reduction is scan+gather (see _SortedCtx).
@@ -459,7 +522,7 @@ def _group_ctx(key_vals: List[ColVal], cap: int, n_rows) -> _SortedCtx:
             order=i32, new=(i32 == 0), gid_sorted=jnp.zeros_like(i32),
             start_pos=jnp.zeros((cap,), jnp.int32), end_pos=end,
             sorted_mask=row_mask, cap=cap, row_mask=row_mask,
-            n_groups=jnp.int32(1))
+            n_groups=jnp.int32(1), backend=backend)
 
     fields = [(1, (~row_mask).astype(jnp.uint64))]  # padding sorts last
     total_bits = 1
@@ -522,7 +585,8 @@ def _group_ctx(key_vals: List[ColVal], cap: int, n_rows) -> _SortedCtx:
                       start_pos=start_pos, end_pos=end_pos,
                       sorted_mask=sorted_mask, cap=cap,
                       row_mask=row_mask, n_groups=n_groups,
-                      sorted_key=sorted_key_u32, key_inverse=key_inverse)
+                      sorted_key=sorted_key_u32, key_inverse=key_inverse,
+                      backend=backend)
 
 
 def gather_group_keys(key_vals: List[ColVal],
@@ -646,8 +710,8 @@ def update_aggregate(batch: DeviceBatch,
                      groupings: Sequence[ir.Expression],
                      aggregates: Sequence[ir.AggregateExpression],
                      specs: Sequence[_AggSpec],
-                     condition: Optional[ir.Expression] = None
-                     ) -> DeviceBatch:
+                     condition: Optional[ir.Expression] = None,
+                     backend: str = "xla") -> DeviceBatch:
     """Per-batch update phase: groupBy().aggregate(updateAggs) analog.
 
     ``condition`` is a fused pre-filter (Filter directly under the
@@ -665,7 +729,7 @@ def update_aggregate(batch: DeviceBatch,
         rung-sized gather total instead of a rung compact + a sorted
         gather."""
         from dataclasses import replace as _dc_replace
-        ctx = _group_ctx(kv, cap2, nr)
+        ctx = _group_ctx(kv, cap2, nr, backend=backend)
         cols = gather_group_keys(kv, ctx)
         names = [f"__k{i}" for i in range(len(cols))]
         vctx = ctx
@@ -733,14 +797,15 @@ def update_aggregate(batch: DeviceBatch,
 
 
 def merge_aggregate(batch: DeviceBatch, n_keys: int,
-                    specs: Sequence[_AggSpec]) -> DeviceBatch:
+                    specs: Sequence[_AggSpec],
+                    backend: str = "xla") -> DeviceBatch:
     """Merge phase over concatenated partials: mergeAggs analog."""
     def run(b: DeviceBatch) -> DeviceBatch:
         key_cols = b.columns[:n_keys]
         key_vals = [ColVal(c.dtype, c.data, c.validity, c.lengths,
                             vbits=c.vbits, nonnull=c.nonnull)
                     for c in key_cols]
-        ctx = sorted_group_ctx(key_vals, b)
+        ctx = sorted_group_ctx(key_vals, b, backend=backend)
         cols = gather_group_keys(key_vals, ctx)
         names = list(b.names[:n_keys])
         bufs_per_spec = []
@@ -810,10 +875,12 @@ class TpuHashAggregateExec(TpuExec):
 
     def _update_impl(self, batch: DeviceBatch) -> DeviceBatch:
         return update_aggregate(batch, self.groupings, self.aggregates,
-                                self.specs, self.fused_condition)
+                                self.specs, self.fused_condition,
+                                backend=getattr(self, "backend", "xla"))
 
     def _merge_impl(self, batch: DeviceBatch) -> DeviceBatch:
-        return merge_aggregate(batch, len(self.groupings), self.specs)
+        return merge_aggregate(batch, len(self.groupings), self.specs,
+                               backend=getattr(self, "backend", "xla"))
 
     def _final_impl(self, batch: DeviceBatch) -> DeviceBatch:
         return finalize_aggregate(batch, len(self.groupings), self.specs,
@@ -825,9 +892,20 @@ class TpuHashAggregateExec(TpuExec):
             import functools
             import types
             from spark_rapids_tpu.exec import kernel_cache as kc
+            from spark_rapids_tpu.kernels import backend as kb
+            # segment-reduction kernel backend: the plan-stamped
+            # kernel.backend (falling back to the process default for
+            # hand-built plans).  Folded into the cache keys — the two
+            # backends are two executables — and passed to get_kernel
+            # so dispatches attribute as kernel.dispatches.agg_*.<bk>
+            bk = kb.resolve(getattr(self, "_kernel_backend", None))
+            # interpret mode rides the key for pallas-built kernels so
+            # flipping kernel.pallas.interpret can't serve stale
+            # interpreter-mode executables from the process cache
             sig = (kc.exprs_sig(self.groupings),
                    kc.exprs_sig(self.aggregates),
-                   tuple(self._schema.names))
+                   tuple(self._schema.names), bk,
+                   kb.interpret() if bk == kb.PALLAS else None)
             # only the UPDATE kernel evaluates the fused condition;
             # merge/final kernels are identical across filters and must
             # share one compile (aggregate sorts cost ~17-20 s each)
@@ -836,14 +914,16 @@ class TpuHashAggregateExec(TpuExec):
             shim = types.SimpleNamespace(
                 groupings=self.groupings, aggregates=self.aggregates,
                 specs=self.specs, _schema=self._schema,
-                fused_condition=self.fused_condition)
+                fused_condition=self.fused_condition, backend=bk)
             cls = type(self)
             self._update_kernel = kc.get_kernel(
                 ("agg_update", usig),
-                lambda: functools.partial(cls._update_impl, shim))
+                lambda: functools.partial(cls._update_impl, shim),
+                backend=bk)
             self._merge_kernel = kc.get_kernel(
                 ("agg_merge", sig),
-                lambda: functools.partial(cls._merge_impl, shim))
+                lambda: functools.partial(cls._merge_impl, shim),
+                backend=bk)
             self._final_kernel = kc.get_kernel(
                 ("agg_final", sig),
                 lambda: functools.partial(cls._final_impl, shim))

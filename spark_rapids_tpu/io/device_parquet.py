@@ -45,6 +45,11 @@ from spark_rapids_tpu.io import parquet_meta as pm
 from spark_rapids_tpu.plan.logical import Schema
 
 _MAX_W = 24  # 4-byte gather window supports shift(<=7) + w bits
+# the dense phase-decomposed paths (io/parquet_fused.py and the Pallas
+# kernel backend, kernels/decode.py) unpack any width up to a full
+# 32-bit index word; plan_chunk admits those and the per-column XLA
+# expansion falls back per column at decode time when w > _MAX_W
+_MAX_W_DENSE = 32
 
 
 # ---------------------------------------------------------------------------
@@ -413,7 +418,7 @@ def plan_chunk(chunk: pm.ChunkPages, out_dtype: dt.DType,
                 raise UnsupportedChunk("dict-encoded page w/o dictionary")
             any_dict = True
             w = vals_buf[0]
-            if w > _MAX_W:
+            if w > _MAX_W_DENSE:
                 raise UnsupportedChunk(f"dict bit width {w}")
             walk_hybrid(vals_buf, 1, len(vals_buf), w, idx_packed,
                         idx_runs)
@@ -476,29 +481,36 @@ def decode_chunk(chunk: pm.ChunkPages, out_dtype: dt.DType,
     return decode_plan(plan_chunk(chunk, out_dtype, allow_mixed=True), cap)
 
 
-def decode_plan(p: "ChunkPlan", cap: int) -> DeviceColumn:
+def decode_plan(p: "ChunkPlan", cap: int,
+                backend: Optional[str] = None) -> DeviceColumn:
     """Decode one host-walked ChunkPlan (possibly served by the scan
     -plan cache — io/scan_cache.py) into a DeviceColumn of capacity
     cap.  Treats the plan as immutable: plans are shared across
-    queries and threads."""
+    queries and threads.
+
+    ``backend`` selects the stream-expansion kernel per stream
+    (``kernel.backend``): 'pallas' runs the dense phase-decomposed
+    unpack (kernels/decode.py, ~1 gather/element, widths to 32),
+    'xla'/None the window-gather path (~9 gathers/element, widths to
+    ``_MAX_W``) — with per-stream fallback between them and the
+    existing per-column host-Arrow fallback beneath both."""
+    from spark_rapids_tpu.kernels import decode as kdec
     out_dtype = p.out_dtype
     n_rows = p.n_rows
 
     # -- device expansion ---------------------------------------------------
     vcap = bucket_rows(max(n_rows, 1))
     if p.nullable:
-        dev = _upload_runs(p.def_runs, p.def_packed)
-        levels = _expand_runs_packed(dev["runs_mat"], dev["packed"],
-                                     cap=vcap)
+        levels = kdec.expand_stream(p.def_runs, p.def_packed, vcap,
+                                    backend=backend)
     else:
         levels = None
 
     np_t = out_dtype.to_np() if not out_dtype.is_string else None
 
     if p.mode in ("dict", "dict_str"):
-        dev = _upload_runs(p.val_runs, p.val_packed)
-        indices = _expand_runs_packed(dev["runs_mat"], dev["packed"],
-                                      cap=vcap)
+        indices = kdec.expand_stream(p.val_runs, p.val_packed, vcap,
+                                     backend=backend)
         if p.nullable:
             indices, valid = _def_expand(levels, indices, n_rows, cap=vcap)
         else:
@@ -515,16 +527,14 @@ def decode_plan(p: "ChunkPlan", cap: int) -> DeviceColumn:
         return _to_cap(DeviceColumn(out_dtype, data, valid), cap)
 
     if p.mode == "bool":
-        dev = _upload_runs(p.val_runs, p.val_packed)
-        bits = _expand_runs_packed(dev["runs_mat"], dev["packed"],
-                                   cap=vcap)
+        bits = kdec.expand_stream(p.val_runs, p.val_packed, vcap,
+                                  backend=backend)
         vals = bits.astype(jnp.bool_)
     elif p.mode == "mixed":
         # merge dict-coded and PLAIN page segments in page order:
         # per-value source selectors built with vectorized numpy repeat
-        dev = _upload_runs(p.val_runs, p.val_packed)
-        indices = _expand_runs_packed(dev["runs_mat"], dev["packed"],
-                                      cap=vcap)
+        indices = kdec.expand_stream(p.val_runs, p.val_packed, vcap,
+                                     backend=backend)
         d_vals = jnp.take(
             jnp.asarray(p.dict_np.astype(np_t, copy=False)),
             jnp.clip(indices.astype(jnp.int32), 0,
@@ -610,7 +620,9 @@ def decode_row_group(path: str, row_group: int, schema: Schema,
                      columns: Optional[List[str]] = None,
                      parquet_file: Optional[papq.ParquetFile] = None,
                      source_key: Optional[tuple] = None,
-                     metrics=None) -> Tuple[DeviceBatch, List[str]]:
+                     metrics=None,
+                     backend: Optional[str] = None
+                     ) -> Tuple[DeviceBatch, List[str]]:
     """Decode one row group to a DeviceBatch.
 
     Returns (batch, fallback_columns) — fallback columns were host-decoded
@@ -683,7 +695,7 @@ def decode_row_group(path: str, row_group: int, schema: Schema,
                 plan = sc.get_chunk_plan(source_key, path, row_group,
                                          ci, f.dtype, True, pf,
                                          metrics=metrics)
-                col = decode_plan(plan, cap)
+                col = decode_plan(plan, cap, backend=backend)
         except Exception:
             # UnsupportedChunk or any malformed-page surprise: this column
             # decodes on host; the rest of the batch stays on device

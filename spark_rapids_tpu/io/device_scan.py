@@ -149,12 +149,13 @@ class TpuParquetScanExec(TpuExec):
     def _decode_chunk(self, fctx, idx: int, file_schema: Schema,
                       file_cols):
         from spark_rapids_tpu.io import scan_cache as sc
+        from spark_rapids_tpu.kernels import backend as kb
         path, pf = fctx
-        return devpq.decode_row_group(path, idx, file_schema,
-                                      columns=file_cols,
-                                      parquet_file=pf,
-                                      source_key=sc.handle_key(pf, path),
-                                      metrics=self.metrics)
+        return devpq.decode_row_group(
+            path, idx, file_schema, columns=file_cols,
+            parquet_file=pf, source_key=sc.handle_key(pf, path),
+            metrics=self.metrics,
+            backend=kb.resolve(getattr(self, "_kernel_backend", None)))
 
     def execute(self) -> List[Iterator[DeviceBatch]]:
         if (self.fmt == "parquet" and self.allow_fused and
@@ -208,6 +209,7 @@ class TpuParquetScanExec(TpuExec):
         from spark_rapids_tpu.exec.scans import ScanPrefetcher
         from spark_rapids_tpu.io import parquet_fused as pqf
         from spark_rapids_tpu.io import scan_cache as sc
+        from spark_rapids_tpu.kernels import backend as kb
 
         wanted = [f.name for f in self._schema.fields]
         part_cols = [c for c in wanted if c in self.part_fields]
@@ -216,6 +218,10 @@ class TpuParquetScanExec(TpuExec):
         host_threads = max(1, int(self.conf.get(
             cfg.SCAN_HOST_PREP_THREADS)))
         depth = max(0, int(self.conf.get(cfg.SCAN_PREFETCH_DEPTH)))
+        backend = kb.resolve(getattr(self, "_kernel_backend", None))
+        # kernel 2: the consumer's condition the planner pushed down
+        # (plan/overrides._push_scan_filters); ordinals index `wanted`
+        pushed = getattr(self, "_pushed_filter", None)
         groups = self._fused_groups()
 
         def prepare(path_rgs):
@@ -228,7 +234,9 @@ class TpuParquetScanExec(TpuExec):
                 return pqf.prepare_fused(
                     sources, file_schema, columns=file_cols,
                     host_threads=host_threads,
-                    metrics=self.metrics), handles
+                    metrics=self.metrics, backend=backend,
+                    pushed_filter=pushed,
+                    scan_names=wanted), handles
             except BaseException:
                 for h in handles.values():
                     h.close()

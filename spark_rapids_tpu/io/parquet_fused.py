@@ -100,6 +100,10 @@ class _SegSpec:
     m_dict_off: int = -1
     m_dict_size: int = -1
     m_dlen_off: int = -1
+    # kernel-2 deferral: keep this dict segment as CODES through
+    # stitching; the dictionary gather runs predicated on the pushed
+    # filter's mask AFTER condition evaluation (kernels/filter_decode)
+    defer: bool = False
 
 
 @dataclass
@@ -120,6 +124,12 @@ class _FusedPlan:
     # per-column static value-range hint (DeviceColumn.vbits) computed
     # from host-known dictionary pages / PLAIN buffers; None = unknown
     col_vbits: Tuple[Optional[int], ...] = ()
+    # kernel backend for phase 0 (dense unpack) and the kernel-2
+    # deferred dictionary gather; folded into ``key``
+    backend: str = "xla"
+    # kernel 2: (condition expr, scan output-name order, deferred
+    # column names) when the pushed filter is active, else None
+    pushed: Optional[Tuple] = None
 
 
 def _column_vbits(out_dtype: dt.DType,
@@ -199,15 +209,54 @@ def _stream_quads(runs: RunTable, packed: bytes,
 
 def assemble(plans: List[List[Optional[ChunkPlan]]],
              out_dtypes: List[dt.DType], names: List[str],
-             n_rows: List[int]) -> _FusedPlan:
+             n_rows: List[int], backend: str = "xla",
+             pushed_filter=None,
+             scan_names: Optional[List[str]] = None) -> _FusedPlan:
     """Pack every segment's host structures into the fused upload set.
 
     plans[col][rg] is a ChunkPlan, or None for a column missing from
-    that file (emitted as all-null rows for that segment)."""
+    that file (emitted as all-null rows for that segment).
+
+    ``backend`` selects the phase-0 unpack kernel (kernels/decode.py).
+    ``pushed_filter`` (with ``scan_names``, the scan's full output-name
+    order the condition's ordinals index) arms kernel 2: int-dictionary
+    columns NOT referenced by the condition defer their dictionary
+    gather until after the mask is known — per-column fallback reasons
+    land in ``kernel.backend.pallas.fallbacks.scan.filterDecode.*``."""
+    from spark_rapids_tpu.kernels import backend as kb
+    from spark_rapids_tpu.kernels import filter_decode as kfd
     K = len(n_rows)
     vcap = bucket_rows(max(max(n_rows, default=1), 1))
     total = sum(n_rows)
     cap = bucket_rows(max(total, 1))
+
+    # -- kernel-2 deferral candidates (decided before specs build) ----
+    defer_cols: set = set()
+    if pushed_filter is not None and backend == kb.PALLAS and \
+            kb.pallas_available():
+        from spark_rapids_tpu.expr import ir as _ir
+        ref_names = {scan_names[b.ordinal] for b in _ir.collect(
+            pushed_filter, lambda e: isinstance(e, _ir.BoundReference))}
+        for ci, col_plans in enumerate(plans):
+            modes = {p.mode for p in col_plans if p is not None}
+            if modes != {"dict"}:
+                continue
+            if names[ci] in ref_names:
+                kb.fallback("scan.filterDecode", "condition_column")
+                continue
+            # every segment's dictionary must live in the SAME wire-
+            # dtype buffer: phase 5 runs ONE gather over one buffer,
+            # and doff offsets from a different buffer would silently
+            # read the wrong dictionary (schema-evolved multi-file
+            # groups can mix int32/int64 dict pages per column)
+            pkeys = {str(p.dict_np.dtype) for p in col_plans
+                     if p is not None}
+            if len(pkeys) != 1:
+                kb.fallback("scan.filterDecode", "mixed_dict_dtypes")
+                continue
+            defer_cols.add(ci)
+        if not defer_cols:
+            kb.fallback("scan.filterDecode", "no_dict_columns")
 
     width_bytes: Dict[int, List[bytes]] = {}
     width_vals: Dict[int, int] = {}
@@ -238,7 +287,8 @@ def assemble(plans: List[List[Optional[ChunkPlan]]],
                 col_specs.append(_SegSpec(mode="null", nullable=True))
                 continue
             nullable = p.nullable and not _all_valid(p.def_runs)
-            s = _SegSpec(mode=p.mode, nullable=nullable)
+            s = _SegSpec(mode=p.mode, nullable=nullable,
+                         defer=(ci in defer_cols and p.mode == "dict"))
             if nullable:
                 s.def_stream = len(stream_quads)
                 stream_quads.append(_stream_quads(
@@ -371,22 +421,50 @@ def assemble(plans: List[List[Optional[ChunkPlan]]],
         arrays["dict_" + key] = _pad_np(
             buf, bucket_rows(buf.shape[0] + pad, 64))
 
+    # -- kernel-2 residency gate (needs the final dict buffer sizes) --
+    for ci in sorted(defer_cols):
+        s0 = next(s for s in specs[ci] if s.mode == "dict")
+        dbuf = arrays["dict_" + s0.plain_key]
+        ok, reason = kfd.supported(cap, dbuf.shape[0],
+                                   dbuf.dtype.itemsize)
+        if not ok:
+            kb.fallback("scan.filterDecode", reason)
+            for s in specs[ci]:
+                s.defer = False
+    defer_names = tuple(
+        names[ci] for ci in range(len(specs))
+        if any(s.defer for s in specs[ci]))
+    pushed = None
+    pushed_sig = None
+    if defer_names:
+        from spark_rapids_tpu.exec import kernel_cache as kc
+        pushed = (pushed_filter, tuple(scan_names), defer_names)
+        pushed_sig = (kc.expr_sig(pushed_filter), tuple(scan_names),
+                      defer_names)
+
     col_vbits = tuple(_column_vbits(out_dtypes[ci], plans[ci])
                       for ci in range(len(plans)))
-    key = ("pq_fused5", tuple(names),
+    # interpret mode is part of the executable's identity whenever the
+    # backend embeds pallas calls: flipping kernel.pallas.interpret
+    # in-process must not serve a stale interpreter-mode kernel
+    interp = kb.interpret() if backend == kb.PALLAS else None
+    key = ("pq_fused6", tuple(names),
            tuple(d.name for d in out_dtypes), K, vcap, cap,
            nslcap, rcap, tuple(stream_path), tuple(w_caps), col_vbits,
+           backend, interp, pushed_sig,
            tuple((a, arrays[a].shape, str(arrays[a].dtype))
                  for a in sorted(arrays)),
            tuple(tuple((s.mode, s.nullable, s.def_stream, s.val_stream,
                         s.plain_key, s.dcap, s.dlen, s.m_plain_off,
-                        s.m_dict_off, s.m_dict_size, s.m_dlen_off)
+                        s.m_dict_off, s.m_dict_size, s.m_dlen_off,
+                        s.defer)
                        for s in row) for row in specs))
     return _FusedPlan(key=key, specs=specs, out_dtypes=out_dtypes,
                       names=names, arrays=arrays, n_rows=list(n_rows),
                       cap=cap, vcap=vcap, stream_path=stream_path,
                       nslcap=nslcap, widths=tuple(w_caps),
-                      col_vbits=col_vbits)
+                      col_vbits=col_vbits, backend=backend,
+                      pushed=pushed)
 
 
 # ---------------------------------------------------------------------------
@@ -405,30 +483,12 @@ def _unpack_width(bytes_arr: jnp.ndarray, w: int, ncap: int) -> jnp.ndarray:
     words, so reshaping the words to [ncap/32, w] makes every value j
     in a group a STATIC (word, shift) slot — w vectorized shift/or ops
     over [ncap/32] lanes, ~10x less memory traffic than expanding to
-    one byte per bit."""
-    if w == 1:
-        bits = ((bytes_arr[:, None] >>
-                 jnp.arange(8, dtype=jnp.uint8)) & 1)      # [B, 8]
-        return bits.reshape(-1).astype(jnp.uint32)
-    if ncap % 32 == 0 and bytes_arr.shape[0] % 4 == 0:
-        words = (bytes_arr.reshape(-1, 4).astype(jnp.uint32) <<
-                 jnp.arange(0, 32, 8, dtype=jnp.uint32)[None, :]
-                 ).sum(axis=1, dtype=jnp.uint32)           # LE u32 words
-        W = words.reshape(ncap // 32, w)
-        mask = jnp.uint32((1 << w) - 1)
-        outs = []
-        for j in range(32):
-            a, s = (j * w) >> 5, (j * w) & 31
-            v = W[:, a] >> jnp.uint32(s)
-            if s + w > 32:
-                v = v | (W[:, a + 1] << jnp.uint32(32 - s))
-            outs.append(v & mask)
-        return jnp.stack(outs, axis=1).reshape(-1)
-    bits = ((bytes_arr[:, None] >>
-             jnp.arange(8, dtype=jnp.uint8)) & 1)          # [B, 8]
-    vals = bits.reshape(ncap, w).astype(jnp.uint32)
-    return jnp.sum(vals << jnp.arange(w, dtype=jnp.uint32)[None, :],
-                   axis=1)
+    one byte per bit.
+
+    (Implementation moved to kernels/decode.py so the Pallas backend
+    shares one definition; this alias is the XLA path.)"""
+    from spark_rapids_tpu.kernels.decode import _unpack_xla
+    return _unpack_xla(bytes_arr, w, ncap)
 
 
 def _expand_slice_stream(sruns_row: jnp.ndarray, dense_all: jnp.ndarray,
@@ -508,10 +568,11 @@ def _make_kernel(fp: _FusedPlan):
         for r, s in enumerate(col_specs):
             if s.mode == "null":
                 continue
-            sig = (s.mode, s.nullable, s.plain_key, s.dlen)
+            sig = (s.mode, s.nullable, s.plain_key, s.dlen, s.defer)
             groups.setdefault(sig, []).append((ci, r))
 
     def kernel(arrays: Dict[str, jnp.ndarray]):
+        from spark_rapids_tpu.kernels import decode as kdec
         nrows = arrays["nrows"]
         meta = arrays["meta"]
 
@@ -519,7 +580,8 @@ def _make_kernel(fp: _FusedPlan):
         dense_parts = [jnp.zeros((vcap,), jnp.uint32)]   # front pad
         for w, ncap in w_caps:
             dense_parts.append(
-                _unpack_width(arrays[f"bits_{w}"], w, ncap))
+                kdec.unpack_bits(arrays[f"bits_{w}"], w, ncap,
+                                 backend=fp.backend))
         dense_parts.append(jnp.zeros((vcap,), jnp.uint32))  # tail pad
         dense_all = jnp.concatenate(dense_parts)
 
@@ -544,7 +606,7 @@ def _make_kernel(fp: _FusedPlan):
         # -- phases 2-3: one vmapped subgraph per group ----------------
         seg_out: Dict[Tuple[int, int], Tuple] = {}
         for sig, members in groups.items():
-            mode, nullable, pkey, dlen = sig
+            mode, nullable, pkey, dlen, defer = sig
             specs_m = [specs[ci][r] for ci, r in members]
             n_m = nrows[jnp.asarray([r for _, r in members])]
             if nullable:
@@ -562,7 +624,23 @@ def _make_kernel(fp: _FusedPlan):
                     [s.m_dict_off for s in specs_m])]
                 dsize_m = meta[jnp.asarray(
                     [s.m_dict_size for s in specs_m])]
-                if mode == "dict":
+                if mode == "dict" and defer:
+                    # kernel 2: keep CODES (global dictionary index);
+                    # the gather runs predicated on the pushed mask in
+                    # phase 5 — filtered-out rows never decode
+                    def one_codes(idx, lv, n_r, doff, dsize):
+                        idx, valid = _def_apply(lv, idx, n_r, vcap)
+                        idx = jnp.clip(idx, 0,
+                                       jnp.maximum(dsize - 1, 0))
+                        return doff + idx, valid
+
+                    in_ax = (0, 0 if nullable else None, 0, 0, 0)
+                    codes_m, valid_m = jax.vmap(
+                        one_codes, in_axes=in_ax)(idx_m, lv_m, n_m,
+                                                  doff_m, dsize_m)
+                    for (ci, r), d, v in zip(members, codes_m, valid_m):
+                        seg_out[(ci, r)] = (d, v)
+                elif mode == "dict":
                     dbuf = arrays["dict_" + pkey]
 
                     def one_dict(idx, lv, n_r, doff, dsize):
@@ -656,16 +734,21 @@ def _make_kernel(fp: _FusedPlan):
                 out = jax.lax.dynamic_update_slice(out, parts[k], start)
             return out[:cap]
 
-        cols: List[DeviceColumn] = []
+        cols: List[Optional[DeviceColumn]] = []
+        deferred_info: Dict[int, Tuple] = {}   # ci -> (codes, valid)
         for ci, col_specs in enumerate(specs):
             odt = out_dtypes[ci]
             np_t = odt.to_np() if not odt.is_string else None
+            col_defer = any(s.defer for s in col_specs)
             col_L = max((s.dlen for s in col_specs), default=1) \
                 if odt.is_string else 0
             seg_data, seg_valid, seg_lens = [], [], []
             for r, s in enumerate(col_specs):
                 if s.mode == "null":
-                    if odt.is_string:
+                    if col_defer:
+                        seg_data.append(jnp.zeros((vcap,),
+                                                  dtype=jnp.int32))
+                    elif odt.is_string:
                         seg_data.append(jnp.zeros((vcap, col_L),
                                                   dtype=jnp.uint8))
                         seg_lens.append(jnp.zeros((vcap,),
@@ -676,7 +759,10 @@ def _make_kernel(fp: _FusedPlan):
                                                dtype=jnp.bool_))
                     continue
                 out = seg_out[(ci, r)]
-                if odt.is_string:
+                if col_defer:
+                    seg_data.append(out[0].astype(jnp.int32))
+                    seg_valid.append(out[1])
+                elif odt.is_string:
                     d = out[0]
                     if d.shape[1] < col_L:
                         d = jnp.pad(d, ((0, 0), (0, col_L - d.shape[1])))
@@ -691,7 +777,13 @@ def _make_kernel(fp: _FusedPlan):
             vb = fp.col_vbits[ci] if fp.col_vbits else None
             nn = all(not s.nullable and s.mode != "null"
                      for s in col_specs)
-            if odt.is_string:
+            if col_defer:
+                # kernel 2: hold global dictionary codes; decoded in
+                # phase 5 once the pushed filter's mask is known
+                deferred_info[ci] = (stitch(seg_data, np.int32(0)),
+                                     valid)
+                cols.append(None)
+            elif odt.is_string:
                 data = stitch(seg_data, np.uint8(0))
                 lens = stitch(seg_lens, np.int32(0))
                 cols.append(DeviceColumn(odt, data, valid, lens,
@@ -700,6 +792,38 @@ def _make_kernel(fp: _FusedPlan):
                 data = stitch(seg_data, np.zeros((), np_t)[()])
                 cols.append(DeviceColumn(odt, data, valid, vbits=vb,
                                          nonnull=nn))
+
+        # -- phase 5 (kernel 2): pushed-filter mask, then PREDICATED
+        # -- dictionary gathers for the deferred columns --------------
+        if deferred_info:
+            from spark_rapids_tpu.expr import eval_tpu
+            from spark_rapids_tpu.kernels import filter_decode as kfd
+            cond, scan_names_t, _dn = fp.pushed
+            by_name = {nm: c for nm, c in zip(fp.names, cols)
+                       if c is not None}
+            # placeholder for names the condition can't reference
+            # (deferred / partition / fallback columns — barred by the
+            # prepare-time eligibility gates)
+            ph = DeviceColumn(dt.INT32, jnp.zeros((cap,), jnp.int32),
+                              jnp.zeros((cap,), jnp.bool_))
+            eval_batch = DeviceBatch(
+                list(scan_names_t),
+                [by_name.get(nm, ph) for nm in scan_names_t], total)
+            cv = eval_tpu.evaluate(cond, eval_batch)
+            keep = cv.data.astype(jnp.bool_) & cv.validity & \
+                (jnp.arange(cap) < total)
+            for ci, (codes, valid) in deferred_info.items():
+                odt = out_dtypes[ci]
+                np_t = odt.to_np()
+                s0 = next(s for s in specs[ci] if s.defer)
+                dbuf = arrays["dict_" + s0.plain_key]
+                vals = kfd.decode_pallas(dbuf, codes, keep & valid)
+                nn = all(not s.nullable and s.mode != "null"
+                         for s in specs[ci])
+                cols[ci] = DeviceColumn(
+                    odt, vals.astype(np_t), valid,
+                    vbits=fp.col_vbits[ci] if fp.col_vbits else None,
+                    nonnull=nn)
         return tuple(cols), total
 
     return kernel
@@ -828,14 +952,25 @@ def prepare_fused(sources: Sequence[Tuple[Any, str, int]],
                   schema: Schema,
                   columns: Optional[List[str]] = None,
                   host_threads: int = 1,
-                  metrics=None) -> PreparedScan:
+                  metrics=None,
+                  backend: Optional[str] = None,
+                  pushed_filter=None,
+                  scan_names: Optional[List[str]] = None
+                  ) -> PreparedScan:
     """Host half of the fused decode: footer/page walks (through the
     scan-plan cache when enabled), fused-plan assembly, packed-page
     upload, and the host-Arrow fallback decode.  Safe to run on a
-    prefetch thread: it never reads device memory."""
+    prefetch thread: it never reads device memory.
+
+    ``backend`` picks the kernel backend (``kernel.backend``) for the
+    decode program; ``pushed_filter``/``scan_names`` arm the kernel-2
+    deferred dictionary-decode+filter (see ``assemble``) — an
+    optimization hint with per-batch eligibility checks here, never a
+    contract: any ineligibility simply decodes everything as before."""
     import contextlib
     from spark_rapids_tpu.columnar.batch import from_arrow as _fa
     from spark_rapids_tpu.exec.base import timed_extra
+    from spark_rapids_tpu.kernels import backend as kb
 
     def phase(key):
         return timed_extra(metrics, key) if metrics is not None \
@@ -845,6 +980,7 @@ def prepare_fused(sources: Sequence[Tuple[Any, str, int]],
     out_dtypes = [schema.field(c).dtype for c in wanted]
     n_rows = [pf.metadata.row_group(rg).num_rows
               for pf, _, rg in sources]
+    bk = kb.resolve(backend)
 
     with phase("scan.hostPrepTime"):
         plans, fallbacks, list_cols = _collect_plans(
@@ -858,8 +994,27 @@ def prepare_fused(sources: Sequence[Tuple[Any, str, int]],
         total = sum(n_rows)
         cap = bucket_rows(max(total, 1))
 
-        fp = assemble(dev_plans, dev_dtypes, dev_cols, n_rows) \
+        pushed = None
+        if pushed_filter is not None and bk == kb.PALLAS:
+            # every column the condition reads must be device-decoded
+            # in THIS batch (a fallback/list/partition operand would
+            # evaluate against a placeholder) — ineligible batches keep
+            # the ordinary decode, per-kernel-fallback style
+            from spark_rapids_tpu.expr import ir as _ir
+            ref_names = {scan_names[b.ordinal] for b in _ir.collect(
+                pushed_filter,
+                lambda e: isinstance(e, _ir.BoundReference))}
+            if ref_names <= set(dev_cols):
+                pushed = pushed_filter
+            else:
+                kb.fallback("scan.filterDecode", "condition_columns")
+
+        fp = assemble(dev_plans, dev_dtypes, dev_cols, n_rows,
+                      backend=bk, pushed_filter=pushed,
+                      scan_names=scan_names) \
             if dev_plans else None
+        if fp is not None and fp.pushed is not None:
+            kb.hit("scan.filterDecode")
 
     with phase("scan.uploadTime"):
         dev_arrays = {k: jnp.asarray(v) for k, v in fp.arrays.items()} \
@@ -920,7 +1075,8 @@ def finish_fused(prep: PreparedScan) -> Tuple[DeviceBatch, List[str]]:
     if prep.fp is not None:
         from spark_rapids_tpu.exec import kernel_cache as kc
         fp = prep.fp
-        kern = kc.get_kernel(fp.key, lambda: _make_kernel(fp))
+        kern = kc.get_kernel(fp.key, lambda: _make_kernel(fp),
+                             backend=fp.backend)
         out_cols, _ = kern(prep.dev_arrays)
         for name, col in zip(prep.dev_cols, out_cols):
             cols_by_name[name] = col

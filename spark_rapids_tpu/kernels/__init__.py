@@ -1,0 +1,11 @@
+"""Hand-written Pallas kernels behind the ``kernel.backend`` knob.
+
+See :mod:`spark_rapids_tpu.kernels.backend` for the selection contract
+(per-call-site choice, per-kernel fallback, hit/fallback counters) and
+docs/kernels.md for the kernel inventory and fallback matrix.
+"""
+
+from spark_rapids_tpu.kernels import backend  # noqa: F401
+from spark_rapids_tpu.kernels.backend import (PALLAS, XLA,  # noqa: F401
+                                              backend_override, choose,
+                                              default_backend, resolve)

@@ -1,0 +1,181 @@
+"""Kernel-backend selection: hand-written Pallas kernels vs composed XLA.
+
+The engine's hot decode/aggregate paths are gather-bandwidth-bound at
+the XLA level (PERF.md round-4b cost model: i64 gathers 22 ms/M values,
+64-bit scatters ~14x i32) and XLA-level reformulations are exhausted
+(ROADMAP open item 2).  This package holds purpose-built Pallas kernels
+for exactly those shapes — Eiger's purpose-built-analytics-primitives
+argument (arXiv:2607.04489) applied to the three measured walls:
+
+  * ``decode.unpack`` / ``decode.expand`` — dense phase-decomposed
+    RLE/bit-unpack for Parquet streams (kernels/decode.py)
+  * ``scan.filterDecode`` — fused dictionary-decode + filter that never
+    materializes decoded values for filtered-out rows
+    (kernels/filter_decode.py)
+  * ``agg.segreduce`` — single-pass segmented reduction for the
+    sorted-key grouped aggregate (kernels/segreduce.py)
+
+Selection contract (the ``sql.fusion.enabled`` pattern end to end):
+
+  * ``spark.rapids.tpu.kernel.backend`` picks ``xla`` (default, the
+    existing composed-array-op paths) or ``pallas``.
+  * The choice is PER CALL SITE with per-kernel fallback: a shape or
+    dtype a Pallas kernel doesn't cover silently takes the XLA path for
+    THAT kernel only — never the whole query (GPU-join-on-Hadoop,
+    arXiv:1904.11201: fallback cliffs dominate when the fast path isn't
+    universally applicable and degradation is coarse-grained).
+  * Every selection is observable: ``kernel.backend.pallas.hits`` and
+    ``kernel.backend.pallas.fallbacks`` (plus reason- and family-tagged
+    variants ``...fallbacks.<family>.<reason>``) in the metrics
+    registry, and per-dispatch attribution via the
+    ``kernel.dispatches.<family>.<backend>`` counters
+    (exec/kernel_cache.py).
+
+Counting semantics: hits/fallbacks are SELECTION events.  Host-side
+call sites (per-column stream expansion, scan prepare) select once per
+call, so those counters track per-batch work; selections made while
+TRACING a cached kernel (the aggregate's segmented reductions) count
+once per compile — the per-dispatch ground truth is always
+``kernel.dispatches.<family>.<backend>``.
+
+Interpret mode: Pallas kernels run under ``interpret=True`` whenever
+the active jax backend is not a real TPU (``kernel.pallas.interpret``
+= auto), so CPU CI (`JAX_PLATFORMS=cpu`) executes the REAL kernel
+bodies and the parity gates exercise actual kernel semantics, not a
+skip.
+"""
+
+from __future__ import annotations
+
+import threading
+from contextlib import contextmanager
+from typing import Optional
+
+XLA = "xla"
+PALLAS = "pallas"
+
+_lock = threading.Lock()
+_default_backend = XLA
+_interpret_mode = "auto"        # auto | true | false
+_pallas_available: Optional[bool] = None
+
+
+def configure(conf) -> None:
+    """Session-init hook: install the process default backend from
+    ``spark.rapids.tpu.kernel.backend`` (the scan-cache ``configure``
+    idiom — every new session re-asserts its own conf, so a prior
+    session's setting never leaks into an unconfigured one).  Plans
+    additionally carry a per-plan ``_kernel_backend`` stamp
+    (plan/overrides.py), which wins over this default wherever a plan
+    node is in scope."""
+    from spark_rapids_tpu import config as cfg
+    global _default_backend, _interpret_mode
+    backend = str(conf.get(cfg.KERNEL_BACKEND) or XLA).strip().lower()
+    if backend not in (XLA, PALLAS):
+        raise ValueError(
+            f"spark.rapids.tpu.kernel.backend must be 'xla' or "
+            f"'pallas', got {backend!r}")
+    mode = str(conf.get(cfg.KERNEL_PALLAS_INTERPRET)
+               or "auto").strip().lower()
+    with _lock:
+        _default_backend = backend
+        _interpret_mode = mode
+
+
+def default_backend() -> str:
+    with _lock:
+        return _default_backend
+
+
+def set_default_backend(backend: str) -> None:
+    """Test/bench hook (sessions should go through :func:`configure`)."""
+    global _default_backend
+    with _lock:
+        _default_backend = backend
+
+
+@contextmanager
+def backend_override(backend: str):
+    """Scoped default-backend override for benches and tests."""
+    prev = default_backend()
+    set_default_backend(backend)
+    try:
+        yield
+    finally:
+        set_default_backend(prev)
+
+
+def resolve(stamped: Optional[str] = None) -> str:
+    """The backend in effect at a call site: the plan-stamped value
+    when the caller has one (``_kernel_backend``), else the process
+    default."""
+    if stamped in (XLA, PALLAS):
+        return stamped
+    return default_backend()
+
+
+def pallas_available() -> bool:
+    """Import probe, memoized: environments without the Pallas
+    extension degrade to XLA everywhere (counted as fallbacks with
+    reason ``unavailable``)."""
+    global _pallas_available
+    if _pallas_available is None:
+        try:
+            from jax.experimental import pallas  # noqa: F401
+            from jax.experimental.pallas import tpu  # noqa: F401
+            _pallas_available = True
+        except Exception:
+            _pallas_available = False
+    return _pallas_available
+
+
+def interpret() -> bool:
+    """Run Pallas kernels in interpreter mode?  ``auto`` (default):
+    interpret unless the active jax backend is a real TPU — so tier-1
+    CPU runs execute the genuine kernel bodies.  The knob pins it for
+    debugging (``true``) or to force Mosaic compilation (``false``)."""
+    with _lock:
+        mode = _interpret_mode
+    if mode in ("true", "1", "yes", "on"):
+        return True
+    if mode in ("false", "0", "no", "off"):
+        return False
+    try:
+        import jax
+        return jax.default_backend() != "tpu"
+    except Exception:
+        return True
+
+
+def hit(family: str, n: int = 1) -> None:
+    """Record a Pallas selection (see the counting-semantics note in
+    the module docstring)."""
+    from spark_rapids_tpu.obs import registry as obsreg
+    obsreg.get_registry().inc_many(
+        ("kernel.backend.pallas.hits", n),
+        (f"kernel.backend.pallas.hits.{family}", n))
+
+
+def fallback(family: str, reason: str, n: int = 1) -> None:
+    """Record a pallas->xla per-kernel fallback with its reason tag."""
+    from spark_rapids_tpu.obs import registry as obsreg
+    obsreg.get_registry().inc_many(
+        ("kernel.backend.pallas.fallbacks", n),
+        (f"kernel.backend.pallas.fallbacks.{family}.{reason}", n))
+
+
+def choose(family: str, backend: str, supported: bool,
+           reason: str = "unsupported") -> str:
+    """Resolve one call site's backend: ``pallas`` only when requested
+    AND available AND the kernel covers this shape/dtype; anything else
+    is an observable per-kernel fallback to ``xla``."""
+    if backend != PALLAS:
+        return XLA
+    if not pallas_available():
+        fallback(family, "unavailable")
+        return XLA
+    if not supported:
+        fallback(family, reason)
+        return XLA
+    hit(family)
+    return PALLAS

@@ -1,0 +1,349 @@
+"""Kernel 1: dense phase-decomposed RLE/bit-unpack (Pallas).
+
+The per-column Parquet decode path (io/device_parquet.py,
+``expand_runs_matrix``) expands a hybrid RLE/bit-packed stream with
+per-ELEMENT random work: a run-id lookup, four 4-byte window gathers
+and ~5 run-metadata takes — ~9 gathers per element on a chip where
+gathers run ~90M/s while dense vector ops stream at HBM bandwidth
+(PERF.md round-4b cost model; "a dense phase-decomposed unpack is
+future work").  This module is that future work:
+
+  phase 0  ``unpack_bits`` — the whole packed byte buffer unpacks as
+           ONE dense w-wide bitstring: bytes -> little-endian u32
+           words -> per-value static (word, shift) slots.  A Pallas
+           kernel over value blocks; ZERO gathers.
+  phase 1  run metadata broadcasts to elements as two step functions
+           (A = dense-index offset, C = RLE value*2+flag) via
+           delta-scatter + cumsum — vector ops, zero gathers (the
+           io/parquet_fused.py general-path formulation).
+  phase 2  ``_expand`` — a Pallas kernel computes ``dense[A + i]`` per
+           element with the step functions resident per block: ONE
+           gather per element, into a dense value array.
+
+Net: ~9 gathers/element -> 1 (``GATHERS_PER_ELEMENT`` below, asserted
+by tests/test_kernels.py against the traced jaxpr of the XLA path).
+The Pallas path also covers dictionary bit widths up to 32 — the XLA
+window-gather path is capped at ``_MAX_W`` = 24 bits (4-byte window =
+shift(<=7) + w), so widths 25-32 previously fell all the way back to
+host Arrow decode; under ``kernel.backend=pallas`` they stay on
+device (the per-kernel-fallback cliff the motivation cites).
+
+Fallback matrix (reasons land in
+``kernel.backend.pallas.fallbacks.decode.*``): mixed bit widths within
+one stream, values too wide for the i32 step function, a dense buffer
+past the residency gate, or shapes off the 32-value alignment grid.
+Everything unsupported takes the existing XLA (or host) path for that
+stream only.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Tuple
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from spark_rapids_tpu.kernels import backend as kb
+
+# by-construction per-element gather counts of the two stream-expansion
+# formulations (XLA's count is additionally measured from its traced
+# jaxpr by tests/test_kernels.py and bench.py's kernels probe)
+GATHERS_PER_ELEMENT = {"xla": 9, "pallas": 1}
+
+_UNPACK_BLOCK = 8192      # values per grid step (phase 0)
+_EXPAND_BLOCK = 8192      # elements per grid step (phase 2)
+# dense-value residency gate for the expand kernel (bytes); streams
+# past it fall back — on-hardware tiling of the dense buffer through
+# the HBM->VMEM double-buffer pattern is the first follow-up there
+_DENSE_MAX_BYTES = 64 << 20
+
+
+# ---------------------------------------------------------------------------
+# phase 0: dense bit-unpack
+# ---------------------------------------------------------------------------
+
+def _unpack_xla(bytes_arr: jnp.ndarray, w: int, ncap: int) -> jnp.ndarray:
+    """Reference XLA unpack — the exact ``io/parquet_fused``
+    formulation (moved here so both backends share one definition and
+    the fused decode routes through the backend switch)."""
+    if w == 1:
+        bits = ((bytes_arr[:, None] >>
+                 jnp.arange(8, dtype=jnp.uint8)) & 1)      # [B, 8]
+        return bits.reshape(-1).astype(jnp.uint32)
+    if ncap % 32 == 0 and bytes_arr.shape[0] % 4 == 0:
+        words = (bytes_arr.reshape(-1, 4).astype(jnp.uint32) <<
+                 jnp.arange(0, 32, 8, dtype=jnp.uint32)[None, :]
+                 ).sum(axis=1, dtype=jnp.uint32)           # LE u32 words
+        W = words.reshape(ncap // 32, w)
+        mask = jnp.uint32((1 << w) - 1)
+        outs = []
+        for j in range(32):
+            a, s = (j * w) >> 5, (j * w) & 31
+            v = W[:, a] >> jnp.uint32(s)
+            if s + w > 32:
+                v = v | (W[:, a + 1] << jnp.uint32(32 - s))
+            outs.append(v & mask)
+        return jnp.stack(outs, axis=1).reshape(-1)
+    bits = ((bytes_arr[:, None] >>
+             jnp.arange(8, dtype=jnp.uint8)) & 1)          # [B, 8]
+    vals = bits.reshape(ncap, w).astype(jnp.uint32)
+    return jnp.sum(vals << jnp.arange(w, dtype=jnp.uint32)[None, :],
+                   axis=1)
+
+
+def _unpack_body(w: int, B: int):
+    """Pallas kernel body for one [B]-value block: bytes -> LE u32
+    words -> static (word, shift) slots — bit-identical integer math to
+    ``_unpack_xla``'s word path, zero gathers."""
+    def kernel(b_ref, o_ref):
+        by = b_ref[:]
+        # byte->LE-word shifts built with an in-kernel iota: a closure
+        # constant array would be a captured value pallas_call rejects
+        sh = jax.lax.broadcasted_iota(jnp.uint32, (1, 4), 1) * \
+            jnp.uint32(8)
+        words = (by.reshape(-1, 4).astype(jnp.uint32) << sh
+                 ).sum(axis=1, dtype=jnp.uint32)
+        W = words.reshape(B // 32, w)
+        mask = jnp.uint32((1 << w) - 1)
+        outs = []
+        for j in range(32):
+            a, s = (j * w) >> 5, (j * w) & 31
+            v = W[:, a] >> jnp.uint32(s)
+            if s + w > 32:
+                v = v | (W[:, a + 1] << jnp.uint32(32 - s))
+            outs.append(v & mask)
+        o_ref[:] = jnp.stack(outs, axis=1).reshape(-1)
+    return kernel
+
+
+def _unpack_pallas(bytes_arr: jnp.ndarray, w: int,
+                   ncap: int) -> jnp.ndarray:
+    from jax.experimental import pallas as pl
+    B = min(ncap, _UNPACK_BLOCK)
+    bpb = B * w // 8                  # bytes per block
+    return pl.pallas_call(
+        _unpack_body(w, B),
+        grid=(ncap // B,),
+        in_specs=[pl.BlockSpec((bpb,), lambda i: (i,))],
+        out_specs=pl.BlockSpec((B,), lambda i: (i,)),
+        out_shape=jax.ShapeDtypeStruct((ncap,), jnp.uint32),
+        interpret=kb.interpret(),
+    )(bytes_arr)
+
+
+def _unpack_supported(w: int, ncap: int, nbytes: int) -> bool:
+    return (1 <= w <= 32 and ncap % 32 == 0 and
+            ncap % min(ncap, _UNPACK_BLOCK) == 0 and
+            nbytes == ncap * w // 8 and nbytes % 4 == 0)
+
+
+def unpack_bits(bytes_arr: jnp.ndarray, w: int, ncap: int,
+                backend: Optional[str] = None) -> jnp.ndarray:
+    """Dense phase-0 unpack of one width's packed byte buffer to
+    [ncap] uint32 — the backend switch for every caller (the fused
+    whole-batch decode's per-width phase 0 and this module's phase 0).
+    Integer-exact on both backends, so results are bit-identical by
+    construction."""
+    bk = kb.choose("decode.unpack", kb.resolve(backend),
+                   _unpack_supported(w, ncap, bytes_arr.shape[0]),
+                   reason="shape")
+    if bk == kb.PALLAS:
+        return _unpack_pallas(bytes_arr, w, ncap)
+    return _unpack_xla(bytes_arr, w, ncap)
+
+
+# ---------------------------------------------------------------------------
+# phase 2: run expansion (one gather/element)
+# ---------------------------------------------------------------------------
+
+def _expand_body(B: int):
+    from jax.experimental import pallas as pl
+
+    def kernel(d_ref, a_ref, c_ref, o_ref):
+        base = pl.program_id(0) * B
+        i = jax.lax.broadcasted_iota(jnp.int32, (B, 1), 0)[:, 0] + base
+        a = a_ref[:]
+        c = c_ref[:]
+        d = d_ref[:]
+        idx = jnp.clip(a + i, 0, d.shape[0] - 1)
+        vals = jnp.take(d, idx)     # the ONE per-element gather,
+        #                             dense-value-resident per block
+        o_ref[:] = jnp.where((c & 1) != 0, (c >> 1).astype(jnp.uint32),
+                             vals)
+    return kernel
+
+
+def _expand_pallas(dense: jnp.ndarray, a: jnp.ndarray, c: jnp.ndarray,
+                   cap: int) -> jnp.ndarray:
+    from jax.experimental import pallas as pl
+    B = min(cap, _EXPAND_BLOCK)
+    dlen = dense.shape[0]
+    return pl.pallas_call(
+        _expand_body(B),
+        grid=(cap // B,),
+        in_specs=[pl.BlockSpec((dlen,), lambda i: (0,)),
+                  pl.BlockSpec((B,), lambda i: (i,)),
+                  pl.BlockSpec((B,), lambda i: (i,))],
+        out_specs=pl.BlockSpec((B,), lambda i: (i,)),
+        out_shape=jax.ShapeDtypeStruct((cap,), jnp.uint32),
+        interpret=kb.interpret(),
+    )(dense, a, c)
+
+
+# ---------------------------------------------------------------------------
+# host prep + public stream expansion
+# ---------------------------------------------------------------------------
+
+def stream_width(runs) -> Tuple[bool, int, str]:
+    """(supported, width, reason): a stream is Pallas-expandable when
+    its bit-packed runs share one NONZERO width <= 32.
+
+    Width-0 bit-packed runs (a page written against a 1-entry
+    dictionary) occupy zero packed bytes and decode to constant 0, so
+    they don't constrain the dense width — ``_dense_meta`` rewrites
+    them as RLE-0 runs.  Treating the accumulated 0 as "no width yet"
+    while ALSO letting a 0-width run read ``bit_bases[i]//w`` would
+    alias the NEXT run's packed values (a confirmed wrong-results
+    repro), hence the explicit rewrite."""
+    w = 0
+    for i in range(len(runs.counts)):
+        if runs.is_rle[i]:
+            continue
+        wi = int(runs.widths[i])
+        if wi == 0:
+            continue        # zero packed bytes; rewritten to RLE-0
+        if w and wi != w:
+            return False, 0, "mixed_widths"
+        w = wi
+        if wi > 32:
+            return False, 0, "width"
+    return True, w, ""
+
+
+def _dense_meta(runs, w: int, rcap: int) -> np.ndarray:
+    """Per-run (start, dA, dC) deltas — the step-function coefficients
+    phase 1 scatters (O(runs) host work, like ``_upload_runs``).  ``A``
+    carries through RLE runs so deltas telescope (the
+    ``io/parquet_fused._stream_quads`` trick).  The matrix widens to
+    int64 when a wide RLE payload (w approaching 32) overflows the i32
+    step function — the kernel handles either dtype."""
+    n = len(runs.counts)
+    rows = []
+    pos = 0
+    prev_a = prev_c = 0
+    lo = hi = 0
+    for i in range(n):
+        start = pos
+        pos += int(runs.counts[i])
+        if runs.is_rle[i]:
+            a = prev_a
+            c = (int(runs.values[i]) << 1) | 1
+        elif int(runs.widths[i]) == 0:
+            # width-0 bit-pack: zero packed bytes, every value is 0 —
+            # an RLE-0 run (its bit_base//w would alias the NEXT run's
+            # values; see stream_width)
+            a = prev_a
+            c = 1
+        else:
+            valoff = int(runs.bit_bases[i]) // w if w else 0
+            a = valoff - start
+            c = 0
+        rows.append((start, a - prev_a, c - prev_c))
+        lo = min(lo, rows[-1][1], rows[-1][2])
+        hi = max(hi, rows[-1][1], rows[-1][2])
+        prev_a, prev_c = a, c
+    np_t = np.int32 if -(1 << 31) <= lo and hi < (1 << 31) else np.int64
+    mat = np.zeros((rcap, 3), dtype=np_t)
+    mat[n:, 0] = np_t(1 << 30)          # padding rows: clipped + dropped
+    for i, r in enumerate(rows):
+        mat[i] = r
+    return mat
+
+
+def _expand_impl(w: int, ncap: int, cap: int):
+    """Device half of the Pallas stream expansion (jitted once per
+    (w, ncap, cap, interpret) via the kernel cache)."""
+    def run(mat: jnp.ndarray, packed: jnp.ndarray) -> jnp.ndarray:
+        if w:
+            dense = _unpack_pallas(packed, w, ncap)
+        else:
+            # 0-bit streams (single-entry dictionary): every bit-packed
+            # value is 0 by definition; no dense phase at all
+            dense = jnp.zeros((32,), jnp.uint32)
+        # delta-scatter + cumsum step functions (zero gathers); the
+        # meta dtype widens to i64 only for wide RLE payloads, and the
+        # cumsum sits at jit TOP LEVEL — never inside control flow
+        # (the scoped-VMEM pair-lowering landmine, exec/scans.py)
+        starts = jnp.minimum(mat[:, 0], cap)
+        a = jnp.cumsum(jnp.zeros((cap,), mat.dtype).at[starts].add(
+            mat[:, 1], mode="drop"))
+        c = jnp.cumsum(jnp.zeros((cap,), mat.dtype).at[starts].add(
+            mat[:, 2], mode="drop"))
+        return _expand_pallas(dense, a, c, cap)
+    return run
+
+
+def expand_stream(runs, packed: bytes, cap: int,
+                  backend: Optional[str] = None) -> jnp.ndarray:
+    """Expand one hybrid RLE/bit-packed stream to [cap] uint32 on the
+    selected backend (the per-column decode path's backend switch —
+    io/device_parquet.decode_plan).
+
+    Pallas: dense phase decomposition above, ONE gather/element, two
+    uploads (run matrix + packed bytes — transfer parity with the XLA
+    path).  XLA: the existing ``expand_runs_matrix`` window-gather
+    formulation (~9 gathers/element), which additionally REQUIRES
+    w <= ``_MAX_W`` (24) — wider streams raise ``UnsupportedChunk`` so
+    the column takes the host-Arrow fallback, exactly as before this
+    module existed."""
+    from spark_rapids_tpu.columnar.batch import bucket_rows
+    from spark_rapids_tpu.exec import kernel_cache as kc
+    from spark_rapids_tpu.io import device_parquet as dp
+
+    def xla_path():
+        wmax = max((int(x) for x, r in zip(runs.widths, runs.is_rle)
+                    if not r), default=0)
+        if wmax > dp._MAX_W:
+            # the XLA 4-byte-window formulation can't reach past 24
+            # bits; raising keeps the pre-pallas per-column host
+            # fallback behavior
+            raise dp.UnsupportedChunk(f"dict bit width {wmax}")
+        dev = dp._upload_runs(runs, packed)
+        return dp._expand_runs_packed(dev["runs_mat"], dev["packed"],
+                                      cap=cap)
+
+    if kb.resolve(backend) != kb.PALLAS:
+        # default path exits before any eligibility work: the support
+        # walk below is O(runs) host time that only the pallas
+        # decision consumes
+        return xla_path()
+
+    ok, w, reason = stream_width(runs)
+    nvals = sum(int(c) for c, r in zip(runs.counts, runs.is_rle)
+                if not r)
+    ncap = bucket_rows(max(nvals, 1), 32)
+    if ok and w:
+        ok = _unpack_supported(w, ncap, ncap * w // 8) and \
+            ncap * 4 <= _DENSE_MAX_BYTES
+        reason = reason or ("dense_too_large"
+                            if ncap * 4 > _DENSE_MAX_BYTES else "shape")
+    if ok:
+        ok = cap % min(cap, _EXPAND_BLOCK) == 0
+        reason = reason or "shape"
+    bk = kb.choose("decode.expand", kb.PALLAS, ok,
+                   reason=reason or "unsupported")
+    if bk != kb.PALLAS:
+        return xla_path()
+
+    rcap = bucket_rows(max(len(runs.counts), 1), 8)
+    mat = _dense_meta(runs, w, rcap)
+    pbytes = np.frombuffer(bytes(packed), dtype=np.uint8)
+    packed_dev = jnp.asarray(dp._pad_np(pbytes, max(ncap * w // 8, 4)))
+    kern = kc.get_kernel(
+        ("decode_expand", kb.PALLAS, w, rcap, ncap, cap,
+         str(mat.dtype), kb.interpret()),
+        lambda: _expand_impl(w, ncap, cap),
+        backend=kb.PALLAS)
+    return kern(jnp.asarray(mat), packed_dev)

@@ -1,0 +1,96 @@
+"""Kernel 2: fused dictionary-decode + filter (Pallas).
+
+A filtering consumer directly above a parquet scan (q6 shape:
+scan -> [fused filter] -> aggregate) pays the dictionary gather for
+EVERY row at decode time and then drops most of them — for the bench's
+25%-selective filter, 3 of every 4 dictionary lookups are wasted
+gather bandwidth on a chip where gathers are the measured wall
+(PERF.md).  When the planner pushes the consumer's combined condition
+into the scan (plan/overrides._push_scan_filters), the fused decode
+keeps dictionary columns as CODES through definition-level handling
+and row-group stitching, evaluates the condition on the (fully
+decoded, never-deferred) operand columns, and only then runs this
+kernel: a PREDICATED dictionary gather that skips whole blocks in
+which every row failed the filter — filtered-out rows never
+materialize decoded values (their slots hold zeros; the consumer
+re-applies the same mask, so downstream never observes them).
+
+The block-skip is the Pallas-only part: ``@pl.when(any(keep))`` elides
+the gather for all-dropped blocks, which no composed XLA formulation
+can express (XLA's ``where`` still evaluates both arms).  Selection
+and accounting happen host-side at scan-prepare time
+(io/parquet_fused.py): per-batch ``kernel.backend.pallas.hits`` /
+``.fallbacks.scan.filterDecode.*`` counters, per-kernel fallback to
+the ordinary decode-everything path.
+"""
+
+from __future__ import annotations
+
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+
+from spark_rapids_tpu.kernels import backend as kb
+
+_BLOCK = 2048
+# dictionary-residency gate (bytes) — see the decode-kernel note about
+# HBM->VMEM tiling as the on-hardware follow-up
+_DICT_MAX_BYTES = 16 << 20
+
+
+def supported(cap: int, dict_len: int, itemsize: int
+              ) -> Tuple[bool, str]:
+    if dict_len * itemsize > _DICT_MAX_BYTES:
+        return False, "dict_too_large"
+    if not (cap <= _BLOCK or cap % _BLOCK == 0):
+        return False, "shape"
+    return True, ""
+
+
+def decode_xla(dbuf: jnp.ndarray, codes: jnp.ndarray,
+               keep: jnp.ndarray) -> jnp.ndarray:
+    """Reference path (also the parity oracle): unpredicated gather +
+    select."""
+    idx = jnp.clip(codes, 0, dbuf.shape[0] - 1)
+    vals = jnp.take(dbuf, idx)
+    return jnp.where(keep, vals, jnp.zeros((), dbuf.dtype))
+
+
+def decode_pallas(dbuf: jnp.ndarray, codes: jnp.ndarray,
+                  keep: jnp.ndarray) -> jnp.ndarray:
+    """Predicated dictionary gather: one [cap]-element pass, gathers
+    only in blocks with at least one surviving row."""
+    from jax.experimental import pallas as pl
+    import numpy as np
+    cap = codes.shape[0]
+    B = min(cap, _BLOCK)
+    dlen = dbuf.shape[0]
+    # numpy scalar, not a traced 0-d array: a traced closure constant
+    # would be a captured value pallas_call rejects
+    zero = np.zeros((), np.dtype(dbuf.dtype))[()]
+
+    def kernel(d_ref, c_ref, k_ref, o_ref):
+        k = k_ref[:]
+        any_kept = jnp.any(k)
+
+        @pl.when(any_kept)
+        def _():
+            idx = jnp.clip(c_ref[:], 0, dlen - 1)
+            vals = jnp.take(d_ref[:], idx)
+            o_ref[:] = jnp.where(k, vals, zero)
+
+        @pl.when(jnp.logical_not(any_kept))
+        def _():
+            o_ref[:] = jnp.full((B,), zero)
+
+    return pl.pallas_call(
+        kernel,
+        grid=(cap // B,),
+        in_specs=[pl.BlockSpec((dlen,), lambda i: (0,)),
+                  pl.BlockSpec((B,), lambda i: (i,)),
+                  pl.BlockSpec((B,), lambda i: (i,))],
+        out_specs=pl.BlockSpec((B,), lambda i: (i,)),
+        out_shape=jax.ShapeDtypeStruct((cap,), dbuf.dtype),
+        interpret=kb.interpret(),
+    )(dbuf, codes, keep)

@@ -1,0 +1,193 @@
+"""Kernel 3: single-pass segmented reduction (Pallas).
+
+The sorted-key grouped aggregate (exec/tpu_aggregate.py) computes every
+reduction as a composed chain over [cap]-sized intermediates: gather
+values into sorted order (``take_sorted`` — one full materialized
+copy), run a cumsum or blocked segmented scan over that copy (a second
+full traversal writing a third array), then gather group ends.  On the
+gather-bound chip that chain IS the measured ~82 ms q6 aggregate wall
+(PERF.md round-5 stage differencing).
+
+This kernel fuses the first two stages into ONE sequential pass:
+per block, the sorted-order gather feeds the in-block segmented
+``associative_scan`` directly (values never round-trip through HBM as
+a sorted copy), and a (flag, value) carry in SMEM scratch threads the
+running segment prefix across blocks.  The block size and combine
+structure mirror ``exec/scans.seg_scan`` EXACTLY (one
+``associative_scan`` per 2^15-element block, elementwise carry
+combine; a single full-array scan below that size or for narrow
+dtypes) so float results are bit-identical to the XLA path — float
+addition is the one order-sensitive op, and an identical reduction
+tree is the parity contract CI enforces.
+
+Per-kernel fallback (``kernel.backend.pallas.fallbacks.agg.segreduce.*``):
+2-D payloads (string byte matrices), unknown ops, and capacities off
+the block grid take the existing XLA formulation for that reduction
+only.  Selection happens while TRACING the cached aggregate kernel, so
+hits/fallbacks count once per compile; per-dispatch attribution is the
+``kernel.dispatches.agg_*.{pallas|xla}`` counters.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Tuple
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from spark_rapids_tpu.kernels import backend as kb
+
+_BLOCK = 1 << 15          # MUST match exec/scans._BLOCK (float parity)
+# source-array residency gate (bytes): the gather path block-loads the
+# full [cap] value array (and the sorted path its block) — past this,
+# fall back rather than hand Mosaic an over-VMEM allocation with no
+# recovery (the same pending-tiling gate as decode/_DENSE_MAX_BYTES)
+_SRC_MAX_BYTES = 64 << 20
+
+_OPS = {"add": jnp.add, "min": jnp.minimum, "max": jnp.maximum}
+
+
+def op_name(op) -> Optional[str]:
+    if op is jnp.add:
+        return "add"
+    if op is jnp.minimum:
+        return "min"
+    if op is jnp.maximum:
+        return "max"
+    return None
+
+
+def supported(cap: int, dtype, op: Optional[str], ndim: int = 1
+              ) -> Tuple[bool, str]:
+    if op is None:
+        return False, "op"
+    if ndim != 1:
+        return False, "ndim"
+    if np.dtype(dtype).kind not in "iufb":
+        return False, "dtype"
+    if not (cap <= _BLOCK or cap % _BLOCK == 0):
+        return False, "shape"
+    if cap * np.dtype(dtype).itemsize > _SRC_MAX_BYTES:
+        return False, "src_too_large"
+    return True, ""
+
+
+def _combine(op):
+    def combine(a, b):
+        fa, va = a
+        fb, vb = b
+        return fa | fb, jnp.where(fb, vb, op(va, vb))
+    return combine
+
+
+def _seg_kernel(op, B: int, blocked: bool, gather: bool, scan_np):
+    """Kernel body: [optional sorted-order gather ->] in-block
+    segmented scan [-> carry across blocks].  Blocked kernels take the
+    op identity as a (1,)-shaped INPUT (last in_ref): it may be a
+    traced value (e.g. the string-min word sentinel built under jit),
+    which a closure constant could not carry."""
+    from jax.experimental import pallas as pl
+    combine = _combine(op)
+
+    def kernel(*refs):
+        if gather:
+            x_ref, ord_ref, f_ref = refs[:3]
+            rest = refs[3:]
+            v = jnp.take(x_ref[:], ord_ref[:])
+        else:
+            v_ref, f_ref = refs[:2]
+            rest = refs[2:]
+            v = v_ref[:]
+        if scan_np is not None:
+            v = v.astype(scan_np)
+        f = f_ref[:]
+        if not blocked:
+            o_ref = rest[0]
+            _pf, s = jax.lax.associative_scan(combine, (f, v))
+            o_ref[:] = s
+            return
+        ident_ref, o_ref, cf_ref, cv_ref = rest
+
+        @pl.when(pl.program_id(0) == 0)
+        def _():
+            cf_ref[0] = False
+            cv_ref[0] = ident_ref[0]
+        pf, pv = jax.lax.associative_scan(combine, (f, v))
+        cf = jnp.broadcast_to(cf_ref[0], pf.shape)
+        cv = jnp.broadcast_to(cv_ref[0], pv.shape)
+        of, ov = combine((cf, cv), (pf, pv))
+        o_ref[:] = ov
+        cf_ref[0] = of[-1]
+        cv_ref[0] = ov[-1]
+    return kernel
+
+
+def _run(new: jnp.ndarray, op_key: str, identity, out_np,
+         x_sorted: Optional[jnp.ndarray] = None,
+         x_full: Optional[jnp.ndarray] = None,
+         order: Optional[jnp.ndarray] = None) -> jnp.ndarray:
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+    op = _OPS[op_key]
+    gather = x_full is not None
+    src = x_full if gather else x_sorted
+    cap = new.shape[0]
+    scan_np = np.dtype(out_np) if out_np is not None and \
+        np.dtype(out_np) != src.dtype else None
+    out_dt = np.dtype(out_np) if out_np is not None else src.dtype
+    # mirror exec/scans.seg_scan: one full-array scan for narrow dtypes
+    # or small caps, 2^15 blocks + carry otherwise (float bit-parity)
+    blocked = out_dt.itemsize >= 8 and cap > _BLOCK
+    B = _BLOCK if blocked else cap
+    kernel = _seg_kernel(op, B, blocked, gather, scan_np)
+
+    if gather:
+        n_src = src.shape[0]
+        in_specs = [pl.BlockSpec((n_src,), lambda i: (0,)),
+                    pl.BlockSpec((B,), lambda i: (i,)),
+                    pl.BlockSpec((B,), lambda i: (i,))]
+        args = [src, order, new]
+    else:
+        in_specs = [pl.BlockSpec((B,), lambda i: (i,)),
+                    pl.BlockSpec((B,), lambda i: (i,))]
+        args = [src, new]
+    scratch = []
+    if blocked:
+        in_specs.append(pl.BlockSpec((1,), lambda i: (0,)))
+        args.append(jnp.full((1,), identity, dtype=out_dt))
+        scratch = [pltpu.SMEM((1,), jnp.bool_),
+                   pltpu.SMEM((1,), out_dt)]
+    return pl.pallas_call(
+        kernel,
+        grid=(cap // B,),
+        in_specs=in_specs,
+        out_specs=pl.BlockSpec((B,), lambda i: (i,)),
+        out_shape=jax.ShapeDtypeStruct((cap,), out_dt),
+        scratch_shapes=scratch,
+        interpret=kb.interpret(),
+    )(*args)
+
+
+def seg_scan_sorted(new: jnp.ndarray, x_sorted: jnp.ndarray,
+                    op_key: str, identity) -> jnp.ndarray:
+    """Inclusive segmented scan over already-sorted values — the
+    Pallas counterpart of ``exec/scans.seg_scan`` (identical combine
+    structure, fused into one pass)."""
+    return _run(new, op_key, identity, None, x_sorted=x_sorted)
+
+
+def gather_seg_scan(x_masked: jnp.ndarray, order: jnp.ndarray,
+                    new: jnp.ndarray, op_key: str, identity,
+                    scan_np=None) -> jnp.ndarray:
+    """Single-pass sorted-order gather + segmented scan: ``x_masked``
+    stays in ORIGINAL row space (the caller pre-masks with the op's
+    identity there, exactly like the XLA path) and is gathered through
+    ``order`` block by block, feeding the in-block scan directly — the
+    sorted copy and the standalone scan array never materialize.
+    ``scan_np`` widens AFTER the gather (narrow gathers are 3x cheaper
+    than emulated-i64 ones; the cast ordering matches
+    ``_SortedCtx.seg_sum``)."""
+    return _run(new, op_key, identity, scan_np, x_full=x_masked,
+                order=order)
