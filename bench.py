@@ -643,7 +643,7 @@ def main() -> None:
     profile_out = None
     concurrent_n = None    # None = flag absent; 0 = explicitly off
     serve_n = 0            # --serve=N remote clients; 0 = off
-    trend_out = "BENCH_pr9.json"   # --trend-out= overrides
+    trend_out = "BENCH_trend.json"   # --trend-out= overrides
     for a in sys.argv[1:]:
         if a.startswith("--profile-out="):
             profile_out = a.split("=", 1)[1]
@@ -748,19 +748,58 @@ def main() -> None:
                       out_name=trend_out)
 
 
+def _git_commit() -> str:
+    """Short commit hash stamped into trend records (None when the
+    bench runs outside a git checkout)."""
+    try:
+        out = subprocess.run(
+            ["git", "rev-parse", "--short", "HEAD"],
+            capture_output=True, text=True, timeout=10,
+            cwd=os.path.dirname(os.path.abspath(__file__)))
+        return out.stdout.strip() or None
+    except Exception:
+        return None
+
+
+def _compile_totals() -> dict:
+    """Compile-observatory totals for the trend record (obs/compile.py
+    + the cache-tier counters), so the compile bill rides the same
+    rolling series the throughput numbers do."""
+    try:
+        from spark_rapids_tpu.obs import compile as obscompile
+        from spark_rapids_tpu.obs import registry as obsreg
+        c = obsreg.get_registry().snapshot()["counters"]
+        t = obscompile.totals()
+        return {
+            "programs_compiled": int(c.get("kernel.cache.compiles", 0)),
+            "persistent_reloads":
+                int(c.get("kernel.cache.persistentHits", 0)),
+            "compile_wall_ms": t.get("compile_wall_ms"),
+            "families": t.get("families"),
+        }
+    except Exception:
+        return {}
+
+
 def _write_trend_file(result: dict, n: int, files: int,
                       smoke: bool,
-                      out_name: str = "BENCH_pr9.json") -> str:
-    """Machine-readable trend record at the repo root (name set by
-    ``--trend-out=``, default BENCH_pr9.json): suite timings, dispatch
-    counts, per-backend kernel timings, and queue-wait percentiles in
-    one stable schema, so the perf trajectory is greppable across PRs
-    instead of living only in prose."""
+                      out_name: str = "BENCH_trend.json") -> str:
+    """Machine-readable trend series at the repo root (name set by
+    ``--trend-out=``, default BENCH_trend.json): ONE rolling file,
+    schema spark-rapids-tpu-bench-trend/3 — each bench run APPENDS a
+    record (suite timings, dispatch counts, per-backend kernel
+    timings, queue-wait percentiles, compile-observatory totals)
+    stamped with the current commit (and a PR label when SRT_BENCH_PR
+    is set), so the perf trajectory across PRs is machine-readable
+    from a single series instead of per-PR BENCH_pr*.json snapshots
+    (the pr6/pr9 records were migrated into the series when the
+    rolling file replaced them)."""
     probe = result.get("dispatch_probe") or {}
     conc = result.get("concurrent") or {}
     kern = result.get("kernels") or {}
-    trend = {
-        "schema": "spark-rapids-tpu-bench-trend/2",
+    record = {
+        "pr": os.environ.get("SRT_BENCH_PR"),
+        "commit": _git_commit(),
         "generated_unix": time.time(),
         "config": {"rows": n, "files": files, "smoke": smoke},
         "suite_timings": {
@@ -798,13 +837,39 @@ def _write_trend_file(result: dict, n: int, files: int,
             "rows_match": kern.get("rows_match"),
             "error": kern.get("error"),
         },
+        "compile": _compile_totals(),
         "rows_match": result.get("rows_match"),
     }
     path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
                         out_name)
-    with open(path, "w") as f:
-        json.dump(trend, f, indent=2)
+    series = {"schema": "spark-rapids-tpu-bench-trend/3", "runs": []}
+    if os.path.exists(path):
+        try:
+            with open(path) as f:
+                loaded = json.load(f)
+            if isinstance(loaded, dict) and \
+                    isinstance(loaded.get("runs"), list):
+                series["runs"] = loaded["runs"]
+            elif isinstance(loaded, dict) and "suite_timings" in loaded:
+                # a stray trend/1 or trend/2 single-record file under
+                # this name: fold it in as the series' first run rather
+                # than destroying the measurement
+                series["runs"] = [loaded]
+        except Exception:
+            # unreadable (e.g. a previous run was killed mid-write):
+            # preserve the evidence instead of clobbering history
+            try:
+                os.replace(path, path + ".corrupt")
+            except OSError:
+                pass
+    series["runs"].append(record)
+    # temp-file + rename: a run killed mid-dump must never truncate
+    # the rolling series it exists to preserve
+    tmp = path + ".tmp"
+    with open(tmp, "w") as f:
+        json.dump(series, f, indent=2)
         f.write("\n")
+    os.replace(tmp, path)
     return path
 
 

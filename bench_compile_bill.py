@@ -7,8 +7,17 @@ that wall is dominated by trace + remote XLA compile.  Prints one JSON
 line: total queries, wall, compile events, total compile seconds, and
 the top-10 most expensive kernels.
 
-Run: ``python bench_compile_bill.py [--sf 0.002]`` (set JAX_PLATFORMS
-and the device as usual; the driver's bench chip is the target).
+``--churn-report`` additionally reads the compile observatory's ledger
+(obs/compile.py) after the suite and emits the shape-churn analysis:
+a ranked collapse-candidate table (family, distinct signatures,
+estimated programs after width-bucketing) plus per-query compile
+attribution whose total is asserted to match the ``/metrics``
+``kernel.cache.compiles`` counter exactly — the instrument ROADMAP
+item 2's shape-erased ABI refactor is driven by.
+
+Run: ``python bench_compile_bill.py [--sf 0.002] [--churn-report]``
+(set JAX_PLATFORMS and the device as usual; the driver's bench chip is
+the target).
 """
 
 import json
@@ -19,6 +28,22 @@ import time
 os.environ.setdefault("SRT_COMPILE_LOG", "1")
 
 
+def _churn_table(rows) -> str:
+    """Human-readable ranked collapse-candidate table (stderr; the
+    machine-readable rows ride the JSON line on stdout)."""
+    hdr = (f"{'family':<20} {'programs':>8} {'distinct':>8} "
+           f"{'bucketed':>8} {'savings':>8} {'wall_ms':>10}")
+    lines = [hdr, "-" * len(hdr)]
+    for r in rows:
+        lines.append(
+            f"{r['family']:<20} {r['programs']:>8} "
+            f"{r['distinct_signatures']:>8} "
+            f"{r['est_programs_width_bucketed']:>8} "
+            f"{r['est_collapse_savings']:>8} "
+            f"{r['compile_wall_ms']:>10.1f}")
+    return "\n".join(lines)
+
+
 def main() -> None:
     sf = 0.002
     if "--sf" in sys.argv:
@@ -26,6 +51,10 @@ def main() -> None:
     backend = "xla"
     if "--backend" in sys.argv:   # kernel.backend for the whole suite
         backend = sys.argv[sys.argv.index("--backend") + 1]
+    churn = "--churn-report" in sys.argv
+    limit = 0    # --limit N: first N queries only (smoke verification)
+    if "--limit" in sys.argv:
+        limit = int(sys.argv[sys.argv.index("--limit") + 1])
 
     from spark_rapids_tpu import TpuSparkSession
     from spark_rapids_tpu.bench import tpcds
@@ -37,7 +66,14 @@ def main() -> None:
          "spark.rapids.tpu.kernel.backend": backend})
     tables = tpcds.setup(s, data)
 
+    from spark_rapids_tpu.obs import compile as obscompile
     from spark_rapids_tpu.obs import registry as obsreg
+
+    # compiles before the suite loop (session warm-up, setup) are not
+    # attributable to any suite query; the attribution cross-check
+    # below is over the loop window
+    compiles_before = obsreg.get_registry().counter(
+        "kernel.cache.compiles")
 
     t0 = time.perf_counter()
     errors = {}
@@ -45,7 +81,10 @@ def main() -> None:
     # obs registry (snapshot deltas), so the whole-stage fusion layer's
     # dispatch reduction shows up per query next to the compile bill
     per_query = {}
-    for name in sorted(tpcds.QUERIES, key=lambda q: int(q[1:])):
+    names = sorted(tpcds.QUERIES, key=lambda q: int(q[1:]))
+    if limit:
+        names = names[:limit]
+    for name in names:
         view = obsreg.get_registry().view()
         try:
             tpcds.QUERIES[name](tables).collect()
@@ -55,6 +94,15 @@ def main() -> None:
         per_query[name] = {
             "dispatches": int(d.get("kernel.dispatches", 0)),
             "kernels_compiled": int(d.get("kernel.cache.misses", 0)),
+            # program granularity (the compile observatory's cache-tier
+            # split): fresh XLA compiles + persistent-cache reloads +
+            # the compile wall this query paid
+            "compiled_programs":
+                int(d.get("kernel.cache.compiles", 0)),
+            "persistent_reloads":
+                int(d.get("kernel.cache.persistentHits", 0)),
+            "compile_ms":
+                round(d.get("kernel.compile.wallNs", 0) / 1e6, 1),
             "fused_stages": int(d.get("fusion.stages", 0)),
             "dispatches_saved":
                 int(d.get("fusion.dispatchesSaved", 0)),
@@ -69,10 +117,10 @@ def main() -> None:
         by_kernel[key] = by_kernel.get(key, 0.0) + dt
     top = sorted(by_kernel.items(), key=lambda kv: -kv[1])[:10]
 
-    print(json.dumps({
+    result = {
         "metric": "TPC-DS 99-query compile bill "
                   f"(sf={sf}, one fresh process)",
-        "queries": len(tpcds.QUERIES),
+        "queries": len(names),
         "errors": errors,
         "suite_wall_s": round(wall, 1),
         "compile_events": len(log),
@@ -97,7 +145,54 @@ def main() -> None:
         "per_query": per_query,
         "top10": [{"kernel": k[:100], "s": round(v, 1)}
                   for k, v in top],
-    }), flush=True)
+    }
+
+    if churn:
+        snap = obscompile.snapshot(max_events=0)
+        rows = snap["churn"]
+        attr_total = sum(q["compiled_programs"]
+                         for q in per_query.values())
+        counter_total = int(reg_totals.get("kernel.cache.compiles", 0))
+        window_total = counter_total - int(compiles_before)
+        # the LEDGER's token-based per-query attribution must account
+        # for every fresh compile the process made: the registry
+        # deltas above are window accounting and would sum to the
+        # counter even with attribution broken, but the ledger only
+        # counts what a CancelToken actually claimed.  The identity
+        # closes over the ledger's own unattributed/evicted tallies
+        # (compiles outside any query, records evicted past the table
+        # bound) — an attribution gap beyond those means compiles
+        # escaped the observatory (the acceptance contract)
+        ledger_attr = sum(q["kernels_compiled"]
+                          for q in snap["per_query"].values())
+        closure = (snap["totals"]["unattributed_fresh"] +
+                   snap["totals"]["evicted_compiled"])
+        assert ledger_attr + closure == counter_total, (
+            f"ledger per-query compile attribution ({ledger_attr} "
+            f"+ {closure} unattributed/evicted) != "
+            f"kernel.cache.compiles counter ({counter_total}) — "
+            f"compiles are escaping query attribution")
+        assert attr_total == window_total, (
+            f"per-query registry deltas ({attr_total}) != "
+            f"kernel.cache.compiles over the suite window "
+            f"({window_total} = {counter_total} - {compiles_before})")
+        result["churn_report"] = rows
+        result["churn_attribution"] = {
+            "per_query_compiled_total": attr_total,
+            "ledger_attributed_total": ledger_attr,
+            "ledger_closure_unattributed_or_evicted": closure,
+            "kernel_cache_compiles_counter": counter_total,
+            "pre_suite_compiles": int(compiles_before),
+            "ledger_totals": snap["totals"],
+        }
+        print("== shape-churn collapse candidates "
+              "(ranked by distinct signatures) ==", file=sys.stderr)
+        print(_churn_table(rows), file=sys.stderr)
+        print(f"attribution: per-query compiled total {attr_total} == "
+              f"kernel.cache.compiles window {window_total}",
+              file=sys.stderr)
+
+    print(json.dumps(result), flush=True)
 
 
 if __name__ == "__main__":

@@ -205,6 +205,9 @@ while not all(f.done() for f in futs):
     rows = json.loads(scrape("/queries"))["queries"]
     assert all(r["state"] in ("queued", "running", "success")
                for r in rows), rows
+    # the compile observatory serves mid-batch too (obs/compile.py)
+    comp_live = json.loads(scrape("/compiles"))
+    assert comp_live["enabled"] and "churn" in comp_live, comp_live
     time.sleep(0.05)
 tables = [f.result(timeout=120) for f in futs]
 for i, (a, b) in enumerate(zip(serial, tables)):
@@ -233,10 +236,50 @@ assert not [r for r in rows if r["state"] in ("queued", "running")]
 qid = done[-1]["query_id"]
 prof = json.loads(scrape(f"/profiles/{qid}"))
 assert prof["query_id"] == qid and prof["status"] == "success"
+
+# compile-observatory contract (obs/compile.py): every compiled
+# program in the ledger must carry the triggering query's id AND its
+# canonical plan digest — a compile that escapes attribution would
+# make the compile bill un-billable
+comp = json.loads(scrape("/compiles?n=4096"))
+evs = comp["events"]
+assert evs, "no compile events despite 16 cold-ish queries"
+unattributed = [e for e in evs
+                if not e.get("query_id") or not e.get("plan_digest")]
+assert not unattributed, f"unattributed compiles: {unattributed[:3]}"
+assert comp["totals"]["events"] >= len(evs) > 0
+assert comp["churn"], "empty churn report despite compile events"
+
+# repeated-query probe: a NEW plan shape compiles programs on its
+# first run and must report ZERO fresh compiles on its second (the
+# in-memory kernel-cache tier, kernel.cache.memHits)
+from spark_rapids_tpu.obs import registry as obsreg
+probe = (base(2500).with_column("w", col("x") * col("x") + 3.0)
+         .group_by("k").agg(F.min("w").alias("mn"),
+                            F.avg("w").alias("aw")).sort("k"))
+v1 = obsreg.get_registry().view()
+first = probe.collect()
+d1 = v1.delta()["counters"]
+assert d1.get("kernel.cache.compiles", 0) > 0, (
+    f"probe's first run compiled nothing — the repeat check would be "
+    f"vacuous: {d1}")
+v2 = obsreg.get_registry().view()
+second = probe.collect()
+d2 = v2.delta()["counters"]
+assert first.equals(second)
+assert d2.get("kernel.cache.compiles", 0) == 0, (
+    f"repeated query re-compiled fresh programs: {d2}")
+assert d2.get("kernel.cache.persistentHits", 0) == 0, d2
+assert d2.get("kernel.cache.memHits", 0) > 0, d2
+row = max(json.loads(scrape("/queries"))["queries"],
+          key=lambda r: r["query_id"])   # the probe's second run
+assert row["kernels_compiled"] is None and row["compile_ms"] is None, row
+
 s.obs_server.shutdown()
 print(f"concurrency smoke OK: 8/8 bit-identical, "
       f"max queue wait {max(waits) / 1e6:.1f}ms, "
-      f"peak running seen {seen_running}, endpoint validated")
+      f"peak running seen {seen_running}, endpoint validated, "
+      f"{len(evs)} compiles attributed, repeat probe 0 fresh compiles")
 EOF
 
 echo "== serving smoke (3 remote clients, prepared + ad-hoc + result-cache hit, live /metrics scrape) =="

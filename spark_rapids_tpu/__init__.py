@@ -50,6 +50,22 @@ def _enable_compile_cache() -> None:
                            0.0)
         _jax.config.update("jax_persistent_cache_min_entry_size_bytes",
                            0)
+        # jax initializes the persistent cache AT MOST ONCE, on the
+        # first compile of the process (compilation_cache
+        # ._initialize_cache's _cache_initialized latch): any jit call
+        # before this session configured the dir pins the cache OFF
+        # for the whole process — the dir update above is silently
+        # ignored, warm runs re-pay full compiles, and the compile
+        # observatory reports 'fresh' where the operator expects
+        # 'persistent'.  Un-latch an initialized-but-empty decision so
+        # the just-configured dir takes effect (a live cache object is
+        # left alone).
+        from jax._src import compilation_cache as _jcc
+        if (getattr(_jcc, "_cache_initialized", False) and
+                getattr(_jcc, "_cache", None) is None) or \
+                (getattr(_jcc, "_cache_checked", False) and
+                 not getattr(_jcc, "_cache_used", True)):
+            _jcc.reset_cache()
     except Exception:  # cache is an optimization, never a hard failure
         pass
 

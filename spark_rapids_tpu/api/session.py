@@ -59,6 +59,14 @@ class TpuSparkSession:
         obs_trace.configure(
             bool(self.conf.get(cfg.OBS_TRACE_ENABLED)),
             int(self.conf.get(cfg.OBS_TRACE_BUFFER_SPANS)))
+        from spark_rapids_tpu.obs import compile as obs_compile
+        obs_compile.configure(
+            bool(self.conf.get(cfg.OBS_COMPILE_ENABLED)),
+            ring_events=int(self.conf.get(cfg.OBS_COMPILE_RING_EVENTS)),
+            storm_threshold=int(self.conf.get(
+                cfg.OBS_COMPILE_STORM_THRESHOLD)),
+            corpus_path=str(self.conf.get(
+                cfg.OBS_COMPILE_CORPUS_PATH) or ""))
         with TpuSparkSession._lock:
             TpuSparkSession._active = self
         self._plan_listeners: List = []
@@ -395,12 +403,19 @@ class TpuSparkSession:
             # record is a field subset of it plus the log-only extras,
             # so the two JSON surfaces cannot drift apart
             d = prof.to_dict()
+            # exact token-based attribution (obs/compile.row_fields —
+            # the same derivation the /queries rows use, so the two
+            # surfaces cannot drift), NOT the profile's registry-window
+            # delta: a concurrent neighbour's compiles would bleed into
+            # the window and misidentify this query as compile-bound
+            from spark_rapids_tpu.obs import compile as obs_compile
             record = {"ts_unix": _time.time(),
                       "threshold_ms": threshold_ms,
                       "session_id": prof.metrics.get("sched", {}).get(
                           "sched.sessionId"),
                       "queue_wait_s": prof.metrics.get("sched", {}).get(
                           "sched.queueWaitNs", 0) / 1e9}
+            record.update(obs_compile.row_fields(prof.query_id))
             for key in ("query_id", "plan_digest", "status", "error",
                         "wall_s", "result_rows", "phases",
                         "wall_breakdown"):

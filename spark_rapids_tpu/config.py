@@ -713,6 +713,44 @@ SERVE_STREAM_CHUNK_ROWS = conf(
     "overhead against backpressure granularity (a slow consumer bounds "
     "the server's read-ahead to its credit window times this).", int)
 
+OBS_COMPILE_ENABLED = conf(
+    "spark.rapids.tpu.obs.compile.enabled", True,
+    "Record a CompileEvent for every first (kernel, arg-shape) call "
+    "through the process kernel cache — the compile observatory "
+    "(obs/compile.py): kernel family, canonical shape/dtype signature, "
+    "backend, compile wall, cache tier (in-memory hit / persistent-"
+    "XLA-cache reload / fresh compile), and the triggering query id + "
+    "plan digest. Events land in a bounded ring with process-lifetime "
+    "per-family aggregates, surface as kernel.compile spans in the "
+    "Chrome trace, a 'compile' QueryProfile section, kernel.compile.* "
+    "registry counters, and the /compiles endpoint route. Disabled, "
+    "the kernel dispatch path pays one bool check.", bool)
+
+OBS_COMPILE_RING_EVENTS = conf(
+    "spark.rapids.tpu.obs.compile.ringEvents", 4096,
+    "Capacity of the compile observatory's event ring; the oldest "
+    "events drop past it (process-lifetime aggregates — per-family "
+    "program/signature counts, compile wall — are unaffected).", int)
+
+OBS_COMPILE_STORM_THRESHOLD = conf(
+    "spark.rapids.tpu.obs.compile.stormThreshold", 64,
+    "Programs one query may compile before the observatory flags a "
+    "'compile storm': a flight-recorder compile.storm event (once per "
+    "query) plus the kernel.compile.storms counter. The TPC-DS-99 "
+    "suite averages ~27 programs/query cold (PERF.md compile bill), "
+    "so a query past this threshold is hitting pathological shape "
+    "churn.", int)
+
+OBS_COMPILE_CORPUS_PATH = conf(
+    "spark.rapids.tpu.obs.compile.corpusPath", "",
+    "Append-mode JSONL file for the precompile corpus: on the first "
+    "completion of each distinct plan digest that compiled at least "
+    "one program, one record {plan_digest, query_id, programs: "
+    "[{family, key, signature, backend}]} is appended — exactly the "
+    "replay artifact an AOT precompile service needs to warm the "
+    "persistent XLA cache off the serving path (ROADMAP item 2). "
+    "Empty (default) disables corpus emission.")
+
 OBS_PROFILE_ENABLED = conf(
     "spark.rapids.tpu.obs.profile.enabled", True,
     "Assemble a QueryProfile after every action (annotated plan tree, "

@@ -114,12 +114,15 @@ _COMPILE_LOG: list = []
 
 
 def _shape_sig(args, kwargs):
+    # the treedef rides the signature as the OBJECT (hashable, eq by
+    # structure) — repr'ing it per dispatch would dominate the
+    # always-on compile observatory's per-call cost
     def leaf_sig(x):
         shp = getattr(x, "shape", None)
         dty = getattr(x, "dtype", None)
         return (tuple(shp), str(dty)) if shp is not None else repr(x)[:32]
     leaves, treedef = jax.tree_util.tree_flatten((args, kwargs))
-    return (str(treedef), tuple(leaf_sig(x) for x in leaves))
+    return (treedef, tuple(leaf_sig(x) for x in leaves))
 
 
 def _instrument(key, fn):
@@ -147,6 +150,64 @@ def _instrument(key, fn):
 def dump_compile_log() -> list:
     with _LOCK:
         return list(_COMPILE_LOG)
+
+
+def _observe_compiles(key: Any, fn: Callable,
+                      backend: str = None) -> Callable:
+    """Compile-observatory wrapper (obs/compile.py): the first call of
+    each (key, arg-shape) program is where jax.jit traces + compiles
+    (or reloads from the persistent XLA cache), so that call is timed
+    and recorded as a CompileEvent with its cache tier, backend, and
+    the triggering query's id + plan digest.  Wraps the jitted callable
+    DIRECTLY (inside the OOM/dispatch-counter wrappers) so the measured
+    wall is the compile, not the counters; an OOM-retry replay of the
+    same shape is by definition not a first call and never re-records.
+
+    Installed only when the observatory is enabled at BUILD time
+    (get_kernel): a disabled process pays nothing at all.  Once
+    installed, the wrapper tracks first calls even through a
+    mid-process disable (``record_compile`` itself no-ops then) — so a
+    later re-enable cannot misreport an already-compiled shape's next
+    dispatch as a microsecond 'fresh compile'.  Kernels BUILT while
+    disabled stay unobserved for their lifetime."""
+    from spark_rapids_tpu.obs import compile as obscompile
+    fam = _family(key)
+    bk = backend or ("pallas" if "pallas" in str(key) else "xla")
+    seen = set()
+    lock = threading.Lock()
+
+    def wrapped(*args, **kwargs):
+        sig = _shape_sig(args, kwargs)
+        with lock:
+            first = sig not in seen
+            if first:
+                seen.add(sig)
+        if not first:
+            return fn(*args, **kwargs)
+        probe = obscompile.probe_begin()
+        t0 = _time.perf_counter_ns()
+        try:
+            return fn(*args, **kwargs)
+        finally:
+            # record in finally: a first call that compiles and THEN
+            # raises (HBM OOM mid-execution) still paid the compile —
+            # the OOM-retry replay is warm and would never re-record,
+            # so skipping here would lose the event entirely
+            dur = _time.perf_counter_ns() - t0
+            obscompile.record_compile(
+                key=key, family=fam, backend=bk, leaves=sig[1],
+                t0_ns=t0, dur_ns=dur,
+                tier=obscompile.classify_tier(probe))
+            if COMPILE_LOG_ENABLED:
+                # the legacy SRT_COMPILE_LOG ledger shares this
+                # wrapper's first-call detection (one _shape_sig per
+                # dispatch, not two); _instrument only installs for
+                # kernels built while the observatory is disabled
+                with _LOCK:
+                    _COMPILE_LOG.append((repr(key)[:160],
+                                         repr(sig[1])[:120],
+                                         dur / 1e9))
+    return wrapped
 
 
 def _with_oom_recovery(fn):
@@ -215,7 +276,17 @@ def get_kernel(key: Any, builder: Callable[[], Callable],
     ``backend`` tags this kernel's per-dispatch family counter with the
     kernel backend ('pallas'/'xla') at backend-aware call sites; the
     backend must already be folded into ``key`` by the caller (two
-    backends are two executables)."""
+    backends are two executables).
+
+    Cache-tier counters (the compile-observatory split): an in-memory
+    hit here bumps ``kernel.cache.memHits`` (``kernel.cache.hits`` is
+    its documented legacy alias, key granularity); a miss invokes the
+    builder (``kernel.cache.misses``, distinct KEYS built), after which
+    each first (key, shape) call classifies as ``kernel.cache.compiles``
+    (fresh XLA compile) or ``kernel.cache.persistentHits`` (persistent-
+    cache reload) via obs/compile.py — note the granularity: one key
+    can lazily compile several shape-bucket programs, so misses is not
+    the sum of the two program-tier counters."""
     from spark_rapids_tpu.obs import registry as _obsreg
     fam = _family(key)
     with _LOCK:
@@ -224,15 +295,22 @@ def get_kernel(key: Any, builder: Callable[[], Callable],
             _CACHE.move_to_end(key)
             _obsreg.get_registry().inc_many(
                 ("kernel.cache.hits", 1),
-                (f"kernel.cache.hits.{fam}", 1))
+                (f"kernel.cache.hits.{fam}", 1),
+                ("kernel.cache.memHits", 1))
             return fn
     _obsreg.get_registry().inc_many(
         ("kernel.cache.misses", 1), (f"kernel.cache.misses.{fam}", 1))
     fn = jax.jit(builder(), **jit_kwargs)
+    from spark_rapids_tpu.obs import compile as _obscompile
+    observed = _obscompile.is_enabled()
+    if observed:
+        fn = _observe_compiles(key, fn, backend)
     if oom_retry:
         fn = _with_oom_recovery(fn)
     fn = _count_dispatches(key, fn, backend)
-    if COMPILE_LOG_ENABLED:
+    if COMPILE_LOG_ENABLED and not observed:
+        # legacy SRT_COMPILE_LOG path for observatory-disabled builds;
+        # observed kernels feed _COMPILE_LOG from _observe_compiles
         fn = _instrument(key, fn)
     with _LOCK:
         cur = _CACHE.setdefault(key, fn)

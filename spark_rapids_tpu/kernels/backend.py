@@ -164,6 +164,18 @@ def fallback(family: str, reason: str, n: int = 1) -> None:
         (f"kernel.backend.pallas.fallbacks.{family}.{reason}", n))
 
 
+def selection_snapshot() -> dict:
+    """The ``kernel.backend.*`` selection counters carved from the
+    registry as plain ints — the ``/compiles`` endpoint's selection
+    block, so compile-bill readers see WHICH backend's programs they
+    are looking at (a pallas-requested family that silently fell back
+    everywhere compiles XLA programs) next to the churn report."""
+    from spark_rapids_tpu.obs import registry as obsreg
+    counters = obsreg.get_registry().snapshot()["counters"]
+    return {k: int(v) for k, v in sorted(counters.items())
+            if k.startswith("kernel.backend.")}
+
+
 def choose(family: str, backend: str, supported: bool,
            reason: str = "unsupported") -> str:
     """Resolve one call site's backend: ``pallas`` only when requested

@@ -27,8 +27,14 @@ engine layer may import ``obs`` freely):
     on query failure/timeout/cancellation (opt-in via
     ``obs.recorder.dir``).
   * :mod:`spark_rapids_tpu.obs.server` — live telemetry endpoint:
-    Prometheus ``/metrics``, ``/queries``, ``/profiles/<qid>`` from a
-    background daemon thread (opt-in via ``obs.http.enabled``).
+    Prometheus ``/metrics``, ``/queries``, ``/profiles/<qid>``,
+    ``/compiles`` from a background daemon thread (opt-in via
+    ``obs.http.enabled``).
+  * :mod:`spark_rapids_tpu.obs.compile` — compile observatory:
+    per-compile attribution ledger (family, shape signature, cache
+    tier, triggering query), shape-churn analytics with
+    width-bucketing collapse estimates, compile-storm detection, and
+    the precompile corpus (default-on via ``obs.compile.enabled``).
 
 (``server`` holds a reference to the session it serves but imports no
 engine module; the package stays an import leaf.)

@@ -30,7 +30,20 @@ from spark_rapids_tpu.obs import trace as obstrace
 # acceptance contract is "includes scan, shuffle, semaphore, and spill
 # sections" whether or not the query touched them
 SECTIONS = ("scan", "shuffle", "semaphore", "spill", "pyworker",
-            "fusion", "sched", "kernel")
+            "fusion", "sched", "kernel", "compile")
+
+# compile-observatory metrics routed into the "compile" section even
+# though their names carry the kernel. prefix: the per-query compile
+# story (programs compiled, cache tiers, compile wall) reads as one
+# section instead of drowning in the dispatch counters
+_COMPILE_SECTION = ("kernel.cache.compiles", "kernel.cache.memHits",
+                    "kernel.cache.persistentHits")
+
+
+def _section_of(name: str) -> str:
+    if name.startswith("kernel.compile.") or name in _COMPILE_SECTION:
+        return "compile"
+    return name.split(".", 1)[0]
 
 
 @dataclass
@@ -162,19 +175,37 @@ def _sectioned(delta: Dict[str, Any]) -> Dict[str, Dict[str, Any]]:
     out: Dict[str, Dict[str, Any]] = {s: {} for s in SECTIONS}
     for kind in ("counters", "gauges"):
         for name, v in delta.get(kind, {}).items():
-            section = name.split(".", 1)[0]
-            d = out.setdefault(section, {})
+            d = out.setdefault(_section_of(name), {})
             d[name] = v
             if isinstance(v, (int, float)) and name.endswith("Ns"):
                 d[name + "_s"] = v / 1e9
     for name, h in delta.get("histograms", {}).items():
-        out.setdefault(name.split(".", 1)[0], {})[name] = h
+        out.setdefault(_section_of(name), {})[name] = h
     return out
+
+
+def _compile_attr_s(query_id: Optional[int],
+                    sections: Dict[str, Dict[str, Any]]) -> float:
+    """Compile wall this query triggered, in seconds: the compile
+    observatory's exact token-based attribution (the same source the
+    /queries rows and slow-query log use), falling back to the
+    registry-window delta only when the ledger never saw the query —
+    a window delta alone would bleed a concurrent neighbour's compiles
+    into this breakdown."""
+    if query_id is not None:
+        with contextlib.suppress(Exception):
+            from spark_rapids_tpu.obs import compile as obscompile
+            stats = obscompile.query_stats(query_id)
+            if stats is not None:
+                return stats["compile_ms"] / 1e3
+    return sections.get("compile", {}).get(
+        "kernel.compile.wallNs", 0) / 1e9
 
 
 def _breakdown(plan: Optional[ExecNodeProfile],
                sections: Dict[str, Dict[str, Any]],
-               wall_ns: int) -> Dict[str, float]:
+               wall_ns: int,
+               query_id: Optional[int] = None) -> Dict[str, float]:
     """Wall-clock breakdown in seconds: host prep vs upload vs dispatch
     vs shuffle vs semaphore wait, plus spill traffic in bytes."""
     host_prep = upload = dispatch = shuffle = fused = 0.0
@@ -203,6 +234,10 @@ def _breakdown(plan: Optional[ExecNodeProfile],
         "upload_s": upload,
         "dispatch_s": dispatch,
         "fused_stage_s": fused,
+        # compile wall this query triggered (obs/compile.py; first
+        # (kernel, shape) calls — an attribution inside dispatch_s and
+        # the exec node times, not a disjoint phase)
+        "compile_s": _compile_attr_s(query_id, sections),
         "shuffle_s": shuffle,
         "semaphore_wait_s": sem.get("semaphore.waitNs", 0) / 1e9,
         "spill_device_to_host_bytes":
@@ -305,7 +340,8 @@ class QueryRun:
             phases=dict(self.phases),
             plan=plan_prof,
             metrics=sections,
-            wall_breakdown=_breakdown(plan_prof, sections, wall_ns),
+            wall_breakdown=_breakdown(plan_prof, sections, wall_ns,
+                                      query_id=self.query_id),
             explain_lines=explain_lines,
             spans=obstrace.span_dicts(raw_spans),
             _raw_spans=raw_spans)

@@ -18,6 +18,15 @@ run.  ``ObsHttpServer`` serves, from a background daemon thread:
                             .query_table).
   ``GET /profiles/<qid>``   QueryProfile JSON from the session's
                             profile ring; 404 once evicted or unknown.
+  ``GET /compiles``         compile-observatory ledger (obs/compile
+                            .py): totals, the newest CompileEvents
+                            (family, signature, tier, wall, query id +
+                            plan digest), per-query attribution, the
+                            shape-churn report ranked by signature
+                            cardinality with width-bucketing collapse
+                            estimates, and the kernel-backend
+                            selection counters.  ``?n=`` bounds the
+                            event count (default 256).
   ``GET /healthz``          liveness probe.
 
 Off by default (``obs.http.enabled=false``): nothing binds a socket
@@ -176,6 +185,17 @@ class ObsHttpServer:
             default=str)
 
     @staticmethod
+    def _compiles_json(max_events: int = 256) -> str:
+        # function-level imports (the serve.result_cache idiom in
+        # _metrics_text): the handler reaches sideways only when the
+        # route is actually hit, so the module stays load-order safe
+        from spark_rapids_tpu.kernels import backend as kernel_backend
+        from spark_rapids_tpu.obs import compile as obscompile
+        payload = obscompile.snapshot(max_events=max_events)
+        payload["selection"] = kernel_backend.selection_snapshot()
+        return json.dumps(payload, default=str)
+
+    @staticmethod
     def _profile_json(session, qid: int) -> Optional[str]:
         prof = session.query_profile(qid)
         if prof is None:
@@ -206,7 +226,8 @@ class ObsHttpServer:
                         self._send(503, json.dumps(
                             {"error": "session gone; server stopping"}))
                         return
-                    path = self.path.split("?", 1)[0].rstrip("/") or "/"
+                    raw_path, _, query = self.path.partition("?")
+                    path = raw_path.rstrip("/") or "/"
                     if path == "/metrics":
                         # version 0.0.4 — the text exposition content
                         # type Prometheus scrapers negotiate
@@ -214,6 +235,13 @@ class ObsHttpServer:
                                    "text/plain; version=0.0.4")
                     elif path == "/queries":
                         self._send(200, server._queries_json(session))
+                    elif path == "/compiles":
+                        n = 256
+                        for part in query.split("&"):
+                            if part.startswith("n=") and \
+                                    part[2:].isdigit():
+                                n = int(part[2:])
+                        self._send(200, server._compiles_json(n))
                     elif path.startswith("/profiles/"):
                         tail = path.rsplit("/", 1)[1]
                         body = (server._profile_json(session, int(tail))
@@ -228,7 +256,7 @@ class ObsHttpServer:
                         self._send(200, json.dumps(
                             {"ok": True,
                              "routes": ["/metrics", "/queries",
-                                        "/profiles/<qid>",
+                                        "/profiles/<qid>", "/compiles",
                                         "/healthz"]}))
                     else:
                         self._send(404, json.dumps(

@@ -37,6 +37,7 @@ from typing import Any, Dict, Optional
 
 from collections import deque
 
+from spark_rapids_tpu.obs import compile as obscompile
 from spark_rapids_tpu.obs import recorder as obsrec
 from spark_rapids_tpu.obs import registry as obsreg
 from spark_rapids_tpu.sched import cancel as _cancel
@@ -241,6 +242,10 @@ class QueryService:
             "client_addr": meta.get("client_addr"),
             "plan_digest": meta.get("plan_digest"),
         }
+        # compile attribution (obs/compile.py): null when zero, so
+        # compile-bound outliers stand out in the table; the same
+        # shared derivation feeds the slow-query JSONL
+        row.update(obscompile.row_fields(fut.query_id))
         fin = info.get("finished_unix")
         if fin is not None:
             row["finished_unix"] = fin
@@ -280,6 +285,11 @@ class QueryService:
             from spark_rapids_tpu.plan.digest import safe_plan_digest
             meta["plan_digest"] = safe_plan_digest(plan)
         digest = meta["plan_digest"]
+        # compile observatory: bind qid -> digest so CompileEvents
+        # fired on any thread carrying this query's token are stamped
+        # with both (obs/compile.py; compiles inside a NESTED query
+        # attribute to the parent, whose token those threads carry)
+        obscompile.register_query(qid, digest)
         # nested collect inside a running query: execute inline under
         # the parent's slot/token (re-admission would self-deadlock)
         if getattr(self._tls, "in_query", False):
@@ -299,9 +309,11 @@ class QueryService:
             except BaseException as e:
                 fut._finish(QueryState.FAILED, error=e,
                             profile=self._session.query_profile(qid))
+                obscompile.finish_query(qid)
                 self._untrack(fut)
                 raise
             fut._finish(QueryState.SUCCESS, result=table, profile=prof)
+            obscompile.finish_query(qid)
             self._untrack(fut)
             return fut
         reg.inc("sched.submitted")
@@ -402,6 +414,10 @@ class QueryService:
             reg.inc("sched.completed")
             if tracker is not None:
                 self._observe(plan, tracker.delta())
+            # corpus emission BEFORE the future resolves: a caller that
+            # observes result() may immediately read the corpus file,
+            # and this thread's finally block runs after the wake-up
+            obscompile.finish_query(fut.query_id)
             fut._finish(QueryState.SUCCESS, result=table, profile=prof)
         finally:
             if tracker is not None:
@@ -409,6 +425,11 @@ class QueryService:
             if timer is not None:
                 timer.cancel()
             self._tls.in_query = False
+            # backstop for the failure/cancel exits (idempotent: the
+            # corpus dedups on digest), and attribution freeze BEFORE
+            # the table row is frozen by _untrack (which reads the
+            # per-query stats)
+            obscompile.finish_query(fut.query_id)
             self._untrack(fut)
             obsrec.record_event("sched.finished", query=fut.query_id,
                                 state=fut.state.value)
