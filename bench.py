@@ -791,9 +791,10 @@ def _write_trend_file(result: dict, n: int, files: int,
     timings, queue-wait percentiles, compile-observatory totals)
     stamped with the current commit (and a PR label when SRT_BENCH_PR
     is set), so the perf trajectory across PRs is machine-readable
-    from a single series instead of per-PR BENCH_pr*.json snapshots
-    (the pr6/pr9 records were migrated into the series when the
-    rolling file replaced them)."""
+    from a single rolling series — `BENCH_trend.json` is the one
+    canonical trend file (earlier per-PR snapshot files were folded
+    into it and deleted); `bench_compile_bill.py --abi-report`
+    appends `kind: "compile_bill"` records to the same series."""
     probe = result.get("dispatch_probe") or {}
     conc = result.get("concurrent") or {}
     kern = result.get("kernels") or {}
@@ -840,6 +841,16 @@ def _write_trend_file(result: dict, n: int, files: int,
         "compile": _compile_totals(),
         "rows_match": result.get("rows_match"),
     }
+    return append_trend_record(record, out_name)
+
+
+def append_trend_record(record: dict,
+                        out_name: str = "BENCH_trend.json") -> str:
+    """Append one record to the rolling trend series — the ONE writer
+    of the 'spark-rapids-tpu-bench-trend/3' file (bench runs append
+    their run records here; bench_compile_bill.py --abi-report appends
+    ``kind: "compile_bill"`` records through the same code path, so
+    schema/locking/corrupt-handling changes happen in one place)."""
     path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
                         out_name)
     series = {"schema": "spark-rapids-tpu-bench-trend/3", "runs": []}

@@ -15,9 +15,16 @@ attribution whose total is asserted to match the ``/metrics``
 ``kernel.cache.compiles`` counter exactly — the instrument ROADMAP
 item 2's shape-erased ABI refactor is driven by.
 
-Run: ``python bench_compile_bill.py [--sf 0.002] [--churn-report]``
-(set JAX_PLATFORMS and the device as usual; the driver's bench chip is
-the target).
+``--abi-report`` (implies the churn ledger read) compares the ACTUAL
+distinct-program count the suite compiled against the churn report's
+width-bucketed projection — the collapse the shape-erased ABI
+(exec/kernel_abi.py) promised vs what it delivered — and APPENDS one
+compile-bill record (program count, fresh/warm compile seconds) to the
+rolling ``BENCH_trend.json`` series so the collapse is tracked per run.
+
+Run: ``python bench_compile_bill.py [--sf 0.002] [--churn-report]
+[--abi-report]`` (set JAX_PLATFORMS and the device as usual; the
+driver's bench chip is the target).
 """
 
 import json
@@ -51,7 +58,8 @@ def main() -> None:
     backend = "xla"
     if "--backend" in sys.argv:   # kernel.backend for the whole suite
         backend = sys.argv[sys.argv.index("--backend") + 1]
-    churn = "--churn-report" in sys.argv
+    abi_report = "--abi-report" in sys.argv
+    churn = "--churn-report" in sys.argv or abi_report
     limit = 0    # --limit N: first N queries only (smoke verification)
     if "--limit" in sys.argv:
         limit = int(sys.argv[sys.argv.index("--limit") + 1])
@@ -192,7 +200,80 @@ def main() -> None:
               f"kernel.cache.compiles window {window_total}",
               file=sys.stderr)
 
+    if churn and abi_report:
+        from spark_rapids_tpu.exec import kernel_abi
+        totals = snap["totals"]
+        actual = totals["distinct_programs"]
+        projected = totals["width_bucketed_projection"]
+        result["abi_report"] = {
+            "abi_enabled": kernel_abi.is_enabled(),
+            "distinct_programs": actual,
+            "width_bucketed_projection": projected,
+            # >1: residual churn the projection says remains erasable;
+            # ~1: the ABI delivered the projected collapse
+            "actual_vs_projection_ratio":
+                round(actual / max(projected, 1), 3),
+            "compile_fresh_s":
+                round(totals["compile_wall_fresh_ms"] / 1e3, 2),
+            "warm_compile_s":
+                round(totals["compile_wall_persistent_ms"] / 1e3, 2),
+            "families": [
+                {"family": r["family"],
+                 "distinct": r["distinct_signatures"],
+                 "projected": r["est_programs_width_bucketed"]}
+                for r in rows],
+        }
+        result["trend_path"] = _append_compile_trend(result)
+        print(f"abi report: {actual} distinct programs vs "
+              f"{projected} projected "
+              f"(x{result['abi_report']['actual_vs_projection_ratio']}),"
+              f" fresh {result['abi_report']['compile_fresh_s']}s / "
+              f"warm {result['abi_report']['warm_compile_s']}s",
+              file=sys.stderr)
+
     print(json.dumps(result), flush=True)
+
+
+def _git_commit() -> str:
+    import subprocess
+    try:
+        return subprocess.run(
+            ["git", "rev-parse", "--short", "HEAD"],
+            capture_output=True, text=True,
+            cwd=os.path.dirname(os.path.abspath(__file__))
+        ).stdout.strip() or None
+    except Exception:
+        return None
+
+
+def _append_compile_trend(result: dict,
+                          out_name: str = "BENCH_trend.json") -> str:
+    """Append one compile-bill record to the rolling trend series via
+    bench.py's ONE series writer (append_trend_record: runs list,
+    temp-file + os.replace, corrupt-file preservation).  Records are
+    tagged ``kind: "compile_bill"`` so trend readers can split them
+    from the bench runs."""
+    import time as _t
+    from bench import append_trend_record
+    abi = result.get("abi_report") or {}
+    record = {
+        "kind": "compile_bill",
+        "pr": os.environ.get("SRT_BENCH_PR"),
+        "commit": _git_commit(),
+        "generated_unix": _t.time(),
+        "queries": result["queries"],
+        "suite_wall_s": result["suite_wall_s"],
+        "kernel_backend": result["kernel_backend"],
+        "abi_enabled": abi.get("abi_enabled"),
+        # the collapse, tracked per run
+        "distinct_programs": abi.get("distinct_programs"),
+        "width_bucketed_projection":
+            abi.get("width_bucketed_projection"),
+        "compile_fresh_s": abi.get("compile_fresh_s"),
+        "warm_compile_s": abi.get("warm_compile_s"),
+        "compile_total_s": result["compile_total_s"],
+    }
+    return append_trend_record(record, out_name)
 
 
 if __name__ == "__main__":

@@ -399,6 +399,169 @@ print(f"serving smoke OK: 3 clients bit-identical, "
       f"chunks streamed")
 EOF
 
+echo "== shape-erased ABI collapse gate (>=4x fewer programs, bit-identical) =="
+timeout 560 python - <<'EOF'
+# the serving-shaped probe: ONE query family over 2 schemas x 2 value
+# ranges x 2 batch sizes (the variance multi-tenant serving traffic
+# actually shows) runs in two fresh subprocesses — kernel.abi.enabled
+# off (the pre-ABI oracle) and on — and the erased ABI must compile
+# >= 4x fewer distinct programs for bit-identical results
+# (ISSUE 12 / ROADMAP item 2 acceptance).
+import json, os, subprocess, sys, tempfile
+
+PROBE = r'''
+import json, os, sys
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+abi = sys.argv[1] == "on"
+from spark_rapids_tpu import TpuSparkSession, col, functions as F
+from spark_rapids_tpu.obs import registry as obsreg
+s = TpuSparkSession({
+    "spark.rapids.tpu.sql.variableFloatAgg.enabled": True,
+    "spark.rapids.tpu.kernel.abi.enabled": abi})
+def q(df, k, x):
+    return (df.with_column("y", col(x) * 2.0 + 1.0)
+              .filter(col("y") > 20.0)
+              .with_column("z", col("y") - col(k))
+              .group_by(k).agg(F.count("*").alias("n"),
+                               F.sum("z").alias("sz"))
+              .sort(k))
+view = obsreg.get_registry().view()
+results = []
+for names in (("k", "x"), ("a", "b")):       # schema drift
+    for scale in (1, 900):                   # value-range drift
+        for n in (2200, 4200):               # batch-size drift
+            df = s.create_dataframe(
+                {names[0]: [(i % 7) * scale for i in range(n)],
+                 names[1]: [float(i % 100) for i in range(n)]},
+                num_partitions=2)
+            results.append(list(q(df, *names).collect()
+                                .to_pydict().values()))
+d = view.delta()["counters"]
+print(json.dumps({"programs": int(d.get("kernel.cache.compiles", 0)),
+                  "results": results}))
+'''
+def run(mode):
+    with tempfile.NamedTemporaryFile("w", suffix=".py",
+                                     delete=False) as f:
+        f.write(PROBE)
+    env = dict(os.environ)
+    env["PYTHONPATH"] = os.getcwd()     # probe runs from a temp file
+    out = subprocess.run([sys.executable, f.name, mode],
+                         capture_output=True, text=True, env=env,
+                         cwd=os.getcwd())
+    assert out.returncode == 0, out.stderr[-2000:]
+    return json.loads(out.stdout.strip().splitlines()[-1])
+
+off, on = run("off"), run("on")
+assert on["results"] == off["results"], (
+    "erased-ABI results diverge from the pre-ABI oracle")
+ratio = off["programs"] / max(on["programs"], 1)
+assert ratio >= 4.0, (
+    f"ABI collapse below the 4x gate: {off['programs']} -> "
+    f"{on['programs']} programs ({ratio:.2f}x)")
+print(f"ABI collapse OK: {off['programs']} -> {on['programs']} "
+      f"distinct programs ({ratio:.2f}x), 8/8 bit-identical")
+EOF
+
+echo "== corpus-replay warm-start gate (restart-sim: zero fresh compiles on /compiles) =="
+timeout 560 python - <<'EOF'
+# ROADMAP item 2's replica-restart contract: process A runs a probe
+# suite with a persistent XLA cache dir + the precompile corpus;
+# process B (fresh, same cache dir) replays the corpus through the AOT
+# precompile service BEFORE serving, then re-runs the probe and must
+# report ZERO fresh compiles on /compiles — persistent reloads only,
+# every one of them paid off the serving path by the replay thread.
+# Donation is disabled for the probe: donating kernels are barred from
+# the persistent cache by design (jax 0.4.37 reload mis-applies the
+# aliasing table) and would legitimately compile fresh.
+import json, os, subprocess, sys, tempfile
+
+work = tempfile.mkdtemp(prefix="warm_gate_")
+env = dict(os.environ)
+env.update({"JAX_PLATFORMS": "cpu",
+            "PYTHONPATH": os.getcwd(),   # probes run from temp files
+            "SPARK_RAPIDS_TPU_CPU_COMPILE_CACHE": "1",
+            "SPARK_RAPIDS_TPU_COMPILE_CACHE":
+                os.path.join(work, "xla")})
+corpus = os.path.join(work, "corpus.jsonl")
+
+COMMON = r'''
+import json, os, sys, urllib.request
+corpus = sys.argv[1]
+from spark_rapids_tpu import TpuSparkSession, col, functions as F
+def probe(s):
+    out = []
+    for n in (1800, 3000):
+        df = s.create_dataframe(
+            {"k": [i % 6 for i in range(n)],
+             "x": [float(i % 120) for i in range(n)]},
+            num_partitions=2)
+        out.append(list((df.with_column("y", col("x") * 1.5 + 2.0)
+                         .filter(col("y") > 30.0)
+                         .group_by("k").agg(F.count("*").alias("c"),
+                                            F.sum("y").alias("sy"))
+                         .sort("k")).collect().to_pydict().values()))
+    return out
+'''
+
+A = COMMON + r'''
+s = TpuSparkSession({
+    "spark.rapids.tpu.sql.variableFloatAgg.enabled": True,
+    "spark.rapids.tpu.sql.fusion.donateInputs": False,
+    "spark.rapids.tpu.obs.compile.corpusPath": corpus})
+res = probe(s)
+recs = [json.loads(l) for l in open(corpus)]
+progs = [p for r in recs for p in r.get("programs", [])]
+assert progs, "probe wrote no corpus programs"
+assert any(p.get("replay") for p in progs), "no replay payloads"
+print(json.dumps({"results": res, "programs": len(progs)}))
+'''
+
+B = COMMON + r'''
+s = TpuSparkSession({
+    "spark.rapids.tpu.sql.variableFloatAgg.enabled": True,
+    "spark.rapids.tpu.sql.fusion.donateInputs": False,
+    "spark.rapids.tpu.obs.http.enabled": True,
+    "spark.rapids.tpu.sched.precompile.enabled": True,
+    "spark.rapids.tpu.sched.precompile.corpusPath": corpus,
+    "spark.rapids.tpu.sched.precompile.idleWaitMs": 0})
+svc = s.precompile_service
+assert svc is not None and svc.wait(timeout=300), "replay did not finish"
+stats = svc.stats()
+assert stats["warmed"] > 0 and stats["failed"] == 0, stats
+res = probe(s)                     # the restarted replica's first queries
+with urllib.request.urlopen(
+        f"http://127.0.0.1:{s.obs_server.port}/compiles?n=0",
+        timeout=10) as r:
+    comp = json.loads(r.read().decode())
+fresh = {q: rec for q, rec in comp["per_query"].items()
+         if rec["kernels_compiled"]}
+assert not fresh, f"probe queries compiled FRESH after replay: {fresh}"
+reloads = sum(rec["persistent_reloads"]
+              for rec in comp["per_query"].values())
+assert reloads > 0, comp["per_query"]
+s.obs_server.shutdown()
+print(json.dumps({"results": res, "warmed": stats["warmed"],
+                  "reloads": reloads}))
+'''
+
+def run(code):
+    with tempfile.NamedTemporaryFile("w", suffix=".py",
+                                     delete=False) as f:
+        f.write(code)
+    out = subprocess.run([sys.executable, f.name, corpus],
+                         capture_output=True, text=True, env=env,
+                         cwd=os.getcwd())
+    assert out.returncode == 0, (out.stderr[-2000:] or out.stdout[-2000:])
+    return json.loads(out.stdout.strip().splitlines()[-1])
+
+a, b = run(A), run(B)
+assert a["results"] == b["results"], "restart-sim results diverge"
+print(f"warm-start gate OK: {a['programs']} corpus programs, "
+      f"{b['warmed']} warmed by replay, {b['reloads']} persistent "
+      f"reloads, 0 fresh compiles on the probe re-run")
+EOF
+
 echo "== smoke bench (tracing enabled) =="
 python bench.py --smoke --profile-out=/tmp/bench_profile.json
 

@@ -31,6 +31,8 @@ from spark_rapids_tpu.plan.planner import plan_cpu
 class TpuSparkSession:
     _active: Optional["TpuSparkSession"] = None
     _lock = threading.Lock()
+    # shared across sessions — see the note at self._query_ids
+    _QUERY_IDS = itertools.count(1)
 
     def __init__(self, conf: Optional[Dict[str, Any]] = None):
         self.conf = RapidsTpuConf(conf)
@@ -51,6 +53,8 @@ class TpuSparkSession:
             self.conf.get(cfg.SCAN_METADATA_CACHE_MAX_BYTES))
         from spark_rapids_tpu.kernels import backend as kernel_backend
         kernel_backend.configure(self.conf)
+        from spark_rapids_tpu.exec import kernel_abi
+        kernel_abi.configure(self.conf)
         from spark_rapids_tpu.pyworker import pool as pyworker_pool
         pyworker_pool.configure(self.conf)
         from spark_rapids_tpu.shuffle import faults
@@ -66,13 +70,21 @@ class TpuSparkSession:
             storm_threshold=int(self.conf.get(
                 cfg.OBS_COMPILE_STORM_THRESHOLD)),
             corpus_path=str(self.conf.get(
-                cfg.OBS_COMPILE_CORPUS_PATH) or ""))
+                cfg.OBS_COMPILE_CORPUS_PATH) or ""),
+            corpus_replay=bool(self.conf.get(
+                cfg.OBS_COMPILE_CORPUS_REPLAY)))
         with TpuSparkSession._lock:
             TpuSparkSession._active = self
         self._plan_listeners: List = []
         self._query_listeners: List = []
         self._views: Dict[str, lp.LogicalPlan] = {}
-        self._query_ids = itertools.count(1)
+        # PROCESS-global query ids (class attribute): the compile
+        # observatory, profile ring and /queries table key on qid, and
+        # per-session counters made two sessions' query 1 collide in
+        # the observatory's per-query attribution — session 2's corpus
+        # record inherited session 1's programs (found by
+        # tests/test_precompile.py's corpusReplay-knob test)
+        self._query_ids = TpuSparkSession._QUERY_IDS
         # per-query profiles: bounded ring keyed by query id, plus the
         # most recently COMPLETED one — concurrent collects no longer
         # race a single last-profile slot
@@ -124,6 +136,22 @@ class TpuSparkSession:
         if self.conf.get(cfg.SERVE_ENABLED):
             from spark_rapids_tpu.serve.server import ServeServer
             self._serve_server = ServeServer(self)
+        # -- AOT precompile service (sched/precompile.py): off by
+        # default — replays a previous process's compile corpus through
+        # lower+compile at low priority so a replica restart warms the
+        # persistent XLA cache off the serving path
+        self._precompile_service = None
+        if self.conf.get(cfg.SCHED_PRECOMPILE_ENABLED):
+            from spark_rapids_tpu.sched.precompile import \
+                PrecompileService
+            corpus = (str(self.conf.get(
+                cfg.SCHED_PRECOMPILE_CORPUS_PATH) or "") or
+                str(self.conf.get(cfg.OBS_COMPILE_CORPUS_PATH) or ""))
+            self._precompile_service = PrecompileService(
+                self, corpus,
+                idle_wait_ms=int(self.conf.get(
+                    cfg.SCHED_PRECOMPILE_IDLE_WAIT_MS)))
+            self._precompile_service.start()
 
     # -- builder-compatible construction -----------------------------------
     class Builder:
@@ -518,6 +546,16 @@ class TpuSparkSession:
         ``serve_server.port`` is the bound port (ephemeral under
         ``serve.port=0``)."""
         return self._serve_server
+
+    @property
+    def precompile_service(self):
+        """The background AOT precompile service
+        (sched/precompile.PrecompileService) when this session was
+        created with ``sched.precompile.enabled=true``; None otherwise.
+        ``precompile_service.wait()`` blocks until the initial corpus
+        replay finishes; ``.stats()`` reports plans/programs/warmed/
+        skipped/failed."""
+        return self._precompile_service
 
     def last_query_profile(self):
         """The QueryProfile of the most recently COMPLETED action (None

@@ -55,21 +55,20 @@ def compact_arrays(keep: "jnp.ndarray", dest: "jnp.ndarray", data,
 
 
 def bucket_rows(n: int, min_bucket: int = 16) -> int:
-    """Next power-of-two capacity >= n (>= min_bucket)."""
-    cap = max(int(min_bucket), 1)
-    n = max(int(n), 1)
-    while cap < n:
-        cap <<= 1
-    return cap
+    """Smallest capacity tier >= n (>= min_bucket).
+
+    Delegates to the shape-erased ABI's capacity ladder
+    (exec/kernel_abi.py): every 2^tierStride-th power-of-two rung under
+    the default ABI, the legacy every-pow2 ladder when the ABI is
+    disabled.  Batches BORN at tier capacities make the dispatch-time
+    pad of kernel_abi.erase a no-op on the hot path."""
+    from spark_rapids_tpu.exec import kernel_abi
+    return kernel_abi.tier_rows(n, min_bucket)
 
 
 def _bucket_strlen(n: int) -> int:
-    if n <= 0:
-        return 1
-    cap = 1
-    while cap < n:
-        cap <<= 1
-    return cap
+    from spark_rapids_tpu.exec import kernel_abi
+    return kernel_abi.tier_strlen(n)
 
 
 @jax.tree_util.register_pytree_node_class
@@ -398,9 +397,13 @@ def _upload_hints(dtype: dt.DType, data: np.ndarray,
             not np.issubdtype(np.asarray(data).dtype, np.integer)):
         return None, nn
     vals = data[:n][live_valid] if not nn else data[:n]
+    from spark_rapids_tpu.exec import kernel_abi
     if vals.size == 0:
-        return _VBIT_BUCKETS[0], nn
-    return bits_for_range(int(vals.min()), int(vals.max())), nn
+        return kernel_abi.bucket_vbits(_VBIT_BUCKETS[0]), nn
+    # the ABI re-buckets upload-derived hints to its coarse table so
+    # data-dependent value ranges stop minting per-range programs
+    return kernel_abi.bucket_vbits(
+        bits_for_range(int(vals.min()), int(vals.max()))), nn
 
 
 def _pack_wire_key(d: jnp.ndarray) -> str:
@@ -435,12 +438,20 @@ def _pack_batch_impl(batch: DeviceBatch):
 
 
 def _dispatch_pack(batch: DeviceBatch) -> jnp.ndarray:
-    """Dispatch (async) the pack kernel for one batch; no host read."""
-    from spark_rapids_tpu.exec import kernel_cache as kc
-    key = ("pack_batch", batch.schema_key(),
-           tuple(c.elem_validity is not None for c in batch.columns))
+    """Dispatch (async) the pack kernel for one batch; no host read.
+
+    Pack is a pure column-container kernel (names never reach the
+    emitted HLO), so it keys on the ABI's positional layout and runs
+    over the name/hint-erased batch — any two batches with one
+    physical layout share one program.  pad=False: the host download
+    epilogue reads the ORIGINAL buffer shapes back out of the packed
+    buffer, so dispatch-time capacity padding must not apply here."""
+    from spark_rapids_tpu.exec import kernel_abi, kernel_cache as kc
+    key = ("pack_batch", kernel_abi.erased_key(batch))
     fn = kc.get_kernel(key, lambda: _pack_batch_impl)
-    return fn(batch)
+    # strip_hints: pack never reads vbits/nonnull, so even bucketed
+    # hints on the treedef would re-trace an identical program
+    return fn(kernel_abi.erase(batch, pad=False, strip_hints=True))
 
 
 def _download_batch(batch: DeviceBatch, packed: Optional[jnp.ndarray]
@@ -531,16 +542,24 @@ def _dl_tier(n: int, capacity: int):
 
 def _compact_kernels(b: DeviceBatch):
     """(tier -> (slice kernel, pack kernel)) for one batch, loading every
-    candidate executable now (pre-download)."""
-    from spark_rapids_tpu.exec import kernel_cache as kc
-    evs = tuple(c.elem_validity is not None for c in b.columns)
+    candidate executable now (pre-download).  Keys and dispatch are
+    schema-erased like pack (the slice gathers by position only); the
+    caller restamps real names on the compacted batch."""
+    from spark_rapids_tpu.exec import kernel_abi, kernel_cache as kc
     out = {}
     for t in _DL_TIERS:
         if b.capacity > 4 * t:
-            key = ("dl_compact", b.schema_key(), t, evs)
+            key = ("dl_compact", kernel_abi.erased_key(b), t)
             out[t] = kc.get_kernel(key, lambda: _slice_head,
                                    static_argnames=("cap",))
     return out
+
+
+def _run_compact(b: DeviceBatch, fn, t: int) -> DeviceBatch:
+    """One erased dl_compact dispatch + host-side name restamp."""
+    from spark_rapids_tpu.exec import kernel_abi
+    nb = fn(kernel_abi.erase(b, pad=False), cap=t)
+    return DeviceBatch(b.names, nb.columns, nb.num_rows)
 
 
 def _compact_for_download(batches: Sequence[DeviceBatch]):
@@ -564,11 +583,12 @@ def _compact_for_download(batches: Sequence[DeviceBatch]):
             # schema ONCE per (schema, tier) per process — mid-query
             # to_arrow callers (shuffle slices) must not re-pay the
             # discarded warm-up compute on every call
+            from spark_rapids_tpu.exec import kernel_abi
             for t, fn in candidates[id(b)].items():
-                wkey = (b.schema_key(), t)
+                wkey = (kernel_abi.erased_key(b), t)
                 if wkey not in _WARMED_TIERS:
                     _WARMED_TIERS.add(wkey)
-                    _dispatch_pack(fn(b, cap=t))
+                    _dispatch_pack(_run_compact(b, fn, t))
         # full-capacity pack, reused if this batch stays uncompacted
         full_packed.append(_dispatch_pack(b))
     if traced:
@@ -590,7 +610,7 @@ def _compact_for_download(batches: Sequence[DeviceBatch]):
         tier = _dl_tier(n, b.capacity)
         if tier is not None and id(b) in candidates and \
                 tier in candidates[id(b)]:
-            nb = candidates[id(b)][tier](b, cap=tier)
+            nb = _run_compact(b, candidates[id(b)][tier], tier)
             nb.num_rows = n
             out.append(nb)
             out_packed.append(_dispatch_pack(nb))
@@ -763,15 +783,17 @@ def _concat_batches_nosync(batches: Sequence[DeviceBatch],
         target = sorted(devs, key=lambda d: d.id)[0]
         batches = [jax.device_put(b, target) for b in batches]
 
-    from spark_rapids_tpu.exec import kernel_cache as kc
+    from spark_rapids_tpu.exec import kernel_abi, kernel_cache as kc
     cap = bucket_rows(sum(b.capacity for b in batches), min_bucket)
     key = ("concat_nosync", cap,
-           tuple(b.schema_key() for b in batches),
-           tuple(tuple(c.elem_validity is not None for c in b.columns)
-                 for b in batches))
+           tuple(kernel_abi.erased_key(b) for b in batches))
     fn = kc.get_kernel(key, lambda: _concat_nosync_impl,
                        static_argnames=("cap",))
-    return fn(tuple(batches), cap=cap)
+    # schema-erased dispatch (concat is positional); restamp the real
+    # names host-side — callers read the output's names
+    out = fn(tuple(kernel_abi.erase(b, pad=False) for b in batches),
+             cap=cap)
+    return DeviceBatch(batches[0].names, out.columns, out.num_rows)
 
 
 def _concat_nosync_impl(batches, cap: int) -> DeviceBatch:

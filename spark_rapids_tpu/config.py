@@ -449,6 +449,43 @@ KERNEL_PALLAS_INTERPRET = conf(
     "'true' (always interpret, for debugging), 'false' (always compile "
     "via Mosaic).")
 
+KERNEL_ABI_ENABLED = conf(
+    "spark.rapids.tpu.kernel.abi.enabled", True,
+    "Shape-erased kernel ABI (exec/kernel_abi.py): batches are renamed "
+    "to canonical positional column names, value-range hints re-bucket "
+    "to the coarse ABI table, and row-capacity / var-len-width ladders "
+    "quantize to capacity tiers (with host-side pad at dispatch for "
+    "batches not born at a tier) before every kernel dispatch, so "
+    "queries that differ only in schema names, value ranges, or "
+    "near-miss batch sizes share one compiled program. Every erased "
+    "shape is a subset of the legacy power-of-two ladder, so disabling "
+    "this only multiplies compiles — it never changes results (the "
+    "bench_compile_bill --abi-report gate diffs the two).", bool)
+
+KERNEL_ABI_TIER_STRIDE = conf(
+    "spark.rapids.tpu.kernel.abi.tierStride", 2,
+    "Row-capacity tier ladder stride: capacities quantize to every "
+    "2^stride-th power-of-two rung (stride 1 = the legacy every-pow2 "
+    "ladder; the default 2 gives tiers 16, 64, 256, 1024, ... — at "
+    "most 4x padding for at most half the distinct capacity programs "
+    "per family).", int)
+
+KERNEL_ABI_WIDTH_STRIDE = conf(
+    "spark.rapids.tpu.kernel.abi.widthStride", 2,
+    "String/list max-width tier ladder stride (same scheme as "
+    "tierStride; default tiers 1, 4, 16, 64, ...). Wide-string padding "
+    "costs capacity x width bytes, so raise with care on string-heavy "
+    "workloads.", int)
+
+KERNEL_ABI_BUCKET_HINTS = conf(
+    "spark.rapids.tpu.kernel.abi.bucketHints", True,
+    "Re-bucket DeviceColumn.vbits value-range hints to the coarse ABI "
+    "table {16, 32, 56} at the dispatch boundary (and at scan/upload "
+    "hint derivation). The narrow fast paths only branch on coarse "
+    "thresholds (<=16 single-digit sorts, <=32 i32 gathers, <64 packed "
+    "radix fields), so the precise buckets buy program churn, not "
+    "speed. A weaker vbits bound is always sound.", bool)
+
 AGG_FUSED_FILTER = conf(
     "spark.rapids.tpu.sql.agg.fusedFilter.enabled", True,
     "Fuse a Filter directly under a hash aggregate into the "
@@ -486,12 +523,14 @@ FUSION_DONATE = conf(
     "known not to retain them, letting XLA reuse the input HBM for the "
     "output and cutting peak memory for deep chains.  Donated "
     "dispatches skip the HBM-OOM retry path (the retry would replay "
-    "consumed buffers).  Automatically stands down while the "
-    "persistent XLA compilation cache is active: cache-RELOADED "
-    "executables mis-apply the donation aliasing table on this jax "
-    "(exec/fused_stage._persistent_cache_active has the minimal "
-    "repro), so donation only arms for fresh-compiled kernels "
-    "(e.g. under SPARK_RAPIDS_TPU_NO_COMPILE_CACHE=1).", bool)
+    "consumed buffers).  Donating kernels compile OUTSIDE the "
+    "persistent XLA compilation cache (never written, never reloaded "
+    "— cache-RELOADED executables mis-apply the donation aliasing "
+    "table on this jax; tests/test_fusion."
+    "test_donation_persistent_cache_repro pins the minimal repro), so "
+    "donation stays armed alongside warm compiles for every other "
+    "program; each donating program pays one fresh compile per "
+    "process (kernel.cache.noPersistCompiles counts them).", bool)
 
 AGG_EXCHANGE = conf(
     "spark.rapids.tpu.sql.agg.exchange.enabled", False,
@@ -750,6 +789,41 @@ OBS_COMPILE_CORPUS_PATH = conf(
     "replay artifact an AOT precompile service needs to warm the "
     "persistent XLA cache off the serving path (ROADMAP item 2). "
     "Empty (default) disables corpus emission.")
+
+OBS_COMPILE_CORPUS_REPLAY = conf(
+    "spark.rapids.tpu.obs.compile.corpusReplay", True,
+    "Attach a replay payload (pickled traceable + abstract argument "
+    "shapes, base64) to each corpus program record so the AOT "
+    "precompile service (sched/precompile.py) can re-lower and "
+    "re-compile the exact program in a fresh process without data or "
+    "plans. Costs one pickle per first (kernel, shape) call while a "
+    "corpusPath is configured; programs whose traceable cannot pickle "
+    "are recorded without a payload and counted as skipped at replay. "
+    "Donation-built kernels never carry a payload — they are barred "
+    "from the persistent cache (see sql.fusion.donateInputs).", bool)
+
+SCHED_PRECOMPILE_ENABLED = conf(
+    "spark.rapids.tpu.sched.precompile.enabled", False,
+    "Start the background AOT precompile service at session init "
+    "(sched/precompile.py): replays the precompile corpus "
+    "(sched.precompile.corpusPath, falling back to "
+    "obs.compile.corpusPath) through jax lower+compile at low priority "
+    "— pausing whenever the scheduler has live queries — so a replica "
+    "restart warms the persistent XLA cache off the serving path and "
+    "serves warm from query one.", bool)
+
+SCHED_PRECOMPILE_CORPUS_PATH = conf(
+    "spark.rapids.tpu.sched.precompile.corpusPath", "",
+    "Corpus JSONL the precompile service replays (a file written by a "
+    "previous process via obs.compile.corpusPath). Empty: falls back "
+    "to this session's obs.compile.corpusPath.")
+
+SCHED_PRECOMPILE_IDLE_WAIT_MS = conf(
+    "spark.rapids.tpu.sched.precompile.idleWaitMs", 25,
+    "How long the precompile service sleeps between corpus programs, "
+    "and while waiting for the scheduler to drain live queries before "
+    "compiling the next one — the low-priority contract keeping "
+    "replay off the serving path.", int)
 
 OBS_PROFILE_ENABLED = conf(
     "spark.rapids.tpu.obs.profile.enabled", True,

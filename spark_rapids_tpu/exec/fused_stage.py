@@ -50,7 +50,6 @@ holding two copies of every intermediate.
 
 from __future__ import annotations
 
-import threading
 import warnings
 from typing import List, Optional, Sequence
 
@@ -87,52 +86,26 @@ _DONATE_SAFE_PRODUCERS = frozenset({
 })
 
 
-_disarm_noted = False
-_disarm_lock = threading.Lock()
-
-
-def _note_donation_disarmed() -> None:
-    """One-time operator-visible record that donation auto-disarmed:
-    a warning log plus the ``fusion.donationDisarmed`` registry counter
-    (scrapeable from /metrics) plus a flight-recorder event — the
-    silent stand-down left operators unable to see why donation was
-    off in steady state."""
-    global _disarm_noted
-    with _disarm_lock:
-        if _disarm_noted:
-            return
-        _disarm_noted = True
-    import logging
-    from spark_rapids_tpu.obs import recorder as obsrec
-    from spark_rapids_tpu.obs import registry as obsreg
-    reason = ("persistent XLA compile cache is active and "
-              "cache-reloaded donating executables mis-apply the "
-              "aliasing table on jax 0.4.37 "
-              "(exec/fused_stage._persistent_cache_active)")
-    logging.getLogger("spark_rapids_tpu.fusion").warning(
-        "input-buffer donation auto-disarmed: %s; re-arm with "
-        "SPARK_RAPIDS_TPU_NO_COMPILE_CACHE=1 or disable explicitly "
-        "via spark.rapids.tpu.sql.fusion.donateInputs=false", reason)
-    obsreg.get_registry().inc("fusion.donationDisarmed")
-    obsrec.record_event("fusion.donationDisarmed", reason=reason)
-
-
 def _persistent_cache_active() -> bool:
-    """Donation is UNSOUND combined with the persistent XLA compilation
-    cache on this jax (0.4.37): an executable RELOADED from the cache
-    mis-applies the donate_argnums aliasing table — identity-shaped
-    outputs read the WRONG donated input buffer (minimal repro: jit
-    ``lambda ai, af, p: (ai + 0, af * 1.0, ...)`` with
-    ``donate_argnums=(0,)``; run 2 of 2 processes returns ``af``'s bits
-    inside the ``ai + 0`` output).  Fresh compiles are always correct,
-    so donation simply stands down while a cache dir is configured and
-    re-arms when it is not (checked live: the kernel-cache key carries
-    the donate flag, so flipping is compile-consistent)."""
+    """Is a persistent XLA compilation cache dir configured?  Donation
+    used to AUTO-DISARM while one was (an executable RELOADED from the
+    cache mis-applies the donate_argnums aliasing table on jax 0.4.37 —
+    identity-shaped outputs read the WRONG donated input buffer;
+    minimal repro: jit ``lambda ai, af, p: (ai + 0, af * 1.0, ...)``
+    with ``donate_argnums=(0,)``; run 2 of 2 processes returns ``af``'s
+    bits inside the ``ai + 0`` output — pinned by
+    tests/test_fusion.test_donation_persistent_cache_repro).  Donation
+    now stays armed: donating kernels compile inside
+    ``kernel_cache._no_persistent_cache`` — never written to nor
+    reloaded from the cache — so steady state gets donation AND warm
+    compiles for every other program.  This predicate remains as the
+    guard's (and the regression tests') one definition of "a cache dir
+    is configured"."""
     try:
         import jax
         return bool(jax.config.jax_compilation_cache_dir)
     except Exception:
-        return True  # unknown state: never risk aliasing corruption
+        return True  # unknown state: assume a cache could be active
 
 
 def donate_ok(child: PhysicalPlan, enabled: bool) -> bool:
@@ -161,11 +134,6 @@ def donate_ok(child: PhysicalPlan, enabled: bool) -> bool:
     outputs compute the same value (checked empirically on this jax:
     jit(lambda x: (x*2, x*2)) returns distinct buffer pointers)."""
     if not enabled:
-        return False
-    if _persistent_cache_active():
-        # donation was WANTED here (plan-stamped on) but stood down:
-        # make the stand-down visible once, not silent forever
-        _note_donation_disarmed()
         return False
     while isinstance(child, TpuFusedStageExec) and child.is_passthrough:
         ords = [e.ordinal for e in child.out_exprs]
@@ -214,7 +182,10 @@ def build_kernel(exec_obj, key, impl_factory, donate: bool):
     executions of the same instance: a stale donating kernel fed an
     un-detached batch would invalidate buffers the caller still treats
     as live.  Donating kernels skip the HBM-OOM retry wrapper (the
-    retry would replay already-consumed buffers)."""
+    retry would replay already-consumed buffers) and compile OUTSIDE
+    the persistent XLA cache (``persistent_cache=False`` — reloaded
+    donating executables mis-apply the aliasing table on jax 0.4.37;
+    see kernel_cache._no_persistent_cache)."""
     if exec_obj._kernel is None or \
             getattr(exec_obj, "_kernel_donate", None) is not donate:
         from spark_rapids_tpu.exec import kernel_cache as kc
@@ -222,6 +193,7 @@ def build_kernel(exec_obj, key, impl_factory, donate: bool):
             _install_donation_warn_filter()
         exec_obj._kernel = kc.get_kernel(
             key + (donate,), impl_factory, oom_retry=not donate,
+            persistent_cache=not donate,
             **({"donate_argnums": (0,)} if donate else {}))
         exec_obj._kernel_donate = donate
     return exec_obj._kernel
@@ -230,12 +202,19 @@ def build_kernel(exec_obj, key, impl_factory, donate: bool):
 def dispatch(exec_obj, label: str, donate: bool, reg,
              b: DeviceBatch, pid: int, offset: int):
     """One per-batch kernel launch with the donation calling convention
-    (detached row count as a separate non-donated arg) and donation
-    bookkeeping."""
+    (detached row count as a separate non-donated arg), the
+    shape-erased ABI (kernel_abi.erase: canonical positional names,
+    bucketed hints, capacity/width padded to tier — the caller restamps
+    its real schema names after), and donation bookkeeping.  The erased
+    view shares the input's buffers unless padding engaged, so donation
+    still releases the producer's HBM."""
+    from spark_rapids_tpu.exec import kernel_abi
+    eb = kernel_abi.erase(b)
+    nr = b.num_rows
     with timed(exec_obj.metrics, label):
         out = exec_obj._kernel(
-            rows_detached(b) if donate else b,
-            rows_arg(b.num_rows), jnp.int32(pid), jnp.int64(offset))
+            rows_detached(eb) if donate else eb,
+            rows_arg(nr), jnp.int32(pid), jnp.int64(offset))
     if donate:
         exec_obj.metrics.add_extra("fusion.donatedBatches", 1)
         reg.inc("fusion.donatedDispatches")

@@ -125,17 +125,44 @@ def _shape_sig(args, kwargs):
     return (treedef, tuple(leaf_sig(x) for x in leaves))
 
 
+class _ShapeSeen:
+    """Per-wrapper first-call-per-shape detector (jax.jit retraces per
+    ``_shape_sig`` bucket) — the ONE implementation shared by all
+    kernel-call wrappers so their notion of "first call" cannot drift.
+    Two protocols, chosen by what the wrapper's semantics require:
+    ``claim`` marks-and-returns-first atomically (recording wrappers —
+    fire at most once per shape even under races); ``peek``/``mark``
+    split the check from the commit for guards whose SAFETY depends on
+    a shape not counting as warm until it was actually handled
+    (_no_persistent_cache)."""
+
+    def __init__(self):
+        self._seen = set()
+        self._lock = threading.Lock()
+
+    def claim(self, sig) -> bool:
+        """True exactly once per sig (atomic check-and-mark)."""
+        with self._lock:
+            if sig in self._seen:
+                return False
+            self._seen.add(sig)
+            return True
+
+    def peek(self, sig) -> bool:
+        with self._lock:
+            return sig in self._seen
+
+    def mark(self, sig) -> None:
+        with self._lock:
+            self._seen.add(sig)
+
+
 def _instrument(key, fn):
-    seen = set()
-    lock = threading.Lock()
+    seen = _ShapeSeen()
 
     def wrapped(*args, **kwargs):
         sig = _shape_sig(args, kwargs)
-        with lock:
-            first = sig not in seen
-            if first:
-                seen.add(sig)
-        if not first:
+        if not seen.claim(sig):
             return fn(*args, **kwargs)
         t0 = _time.perf_counter()
         out = fn(*args, **kwargs)
@@ -152,8 +179,52 @@ def dump_compile_log() -> list:
         return list(_COMPILE_LOG)
 
 
-def _observe_compiles(key: Any, fn: Callable,
-                      backend: str = None) -> Callable:
+def _replay_payload(inner: Callable, jit_kwargs: dict,
+                    args, kwargs) -> "str | None":
+    """Pickle (traceable, jit kwargs, abstract argument shapes) into a
+    base64 replay payload for the precompile corpus — everything the
+    AOT precompile service (sched/precompile.py) needs to re-lower and
+    re-compile this exact program in a fresh process, with no data, no
+    plan, no session.  Arguments map to ``jax.ShapeDtypeStruct`` leaves
+    (static kwargs — ints routed through ``static_argnames`` — stay
+    concrete).  Traceables are usually picklable (module functions, or
+    ``functools.partial`` over a class method + an expression-holding
+    shim — the same things the executor protocol already ships); ones
+    that are not return None and the program is recorded without a
+    payload (counted as skipped at replay time)."""
+    import base64
+    import pickle
+    import zlib
+
+    def to_sds(x):
+        shp = getattr(x, "shape", None)
+        dty = getattr(x, "dtype", None)
+        if shp is None or dty is None:
+            return x
+        return jax.ShapeDtypeStruct(tuple(shp), dty)
+    try:
+        sds = jax.tree_util.tree_map(to_sds, (args, kwargs))
+        raw = pickle.dumps({"fn": inner, "jit": jit_kwargs,
+                            "args": sds[0], "kwargs": sds[1]},
+                           protocol=pickle.HIGHEST_PROTOCOL)
+        if len(raw) > (2 << 20):
+            return None          # pathological payload: skip, don't bloat
+        return base64.b64encode(zlib.compress(raw, 6)).decode("ascii")
+    except Exception:
+        return None
+
+
+def load_replay_payload(payload: str):
+    """Inverse of :func:`_replay_payload` (the precompile service's
+    decode half; lives here so the pickle format has one owner)."""
+    import base64
+    import pickle
+    import zlib
+    return pickle.loads(zlib.decompress(base64.b64decode(payload)))
+
+
+def _observe_compiles(key: Any, fn: Callable, backend: str = None,
+                      replay_src=None) -> Callable:
     """Compile-observatory wrapper (obs/compile.py): the first call of
     each (key, arg-shape) program is where jax.jit traces + compiles
     (or reloads from the persistent XLA cache), so that call is timed
@@ -173,16 +244,11 @@ def _observe_compiles(key: Any, fn: Callable,
     from spark_rapids_tpu.obs import compile as obscompile
     fam = _family(key)
     bk = backend or ("pallas" if "pallas" in str(key) else "xla")
-    seen = set()
-    lock = threading.Lock()
+    seen = _ShapeSeen()
 
     def wrapped(*args, **kwargs):
         sig = _shape_sig(args, kwargs)
-        with lock:
-            first = sig not in seen
-            if first:
-                seen.add(sig)
-        if not first:
+        if not seen.claim(sig):
             return fn(*args, **kwargs)
         probe = obscompile.probe_begin()
         t0 = _time.perf_counter_ns()
@@ -194,10 +260,16 @@ def _observe_compiles(key: Any, fn: Callable,
             # the OOM-retry replay is warm and would never re-record,
             # so skipping here would lose the event entirely
             dur = _time.perf_counter_ns() - t0
+            replay = None
+            if replay_src is not None and obscompile.corpus_path() \
+                    and obscompile.corpus_replay_enabled():
+                replay = _replay_payload(replay_src[0], replay_src[1],
+                                         args, kwargs)
             obscompile.record_compile(
                 key=key, family=fam, backend=bk, leaves=sig[1],
                 t0_ns=t0, dur_ns=dur,
-                tier=obscompile.classify_tier(probe))
+                tier=obscompile.classify_tier(probe),
+                replay=replay)
             if COMPILE_LOG_ENABLED:
                 # the legacy SRT_COMPILE_LOG ledger shares this
                 # wrapper's first-call detection (one _shape_sig per
@@ -208,6 +280,81 @@ def _observe_compiles(key: Any, fn: Callable,
                                          repr(sig[1])[:120],
                                          dur / 1e9))
     return wrapped
+
+
+# serializes persistent-cache flips across threads: the flip window is
+# process-global jax config, so donating compiles take turns
+_PC_FLIP_LOCK = threading.Lock()
+_no_persist_noted = False
+
+
+def _no_persistent_cache(fn):
+    """Compile wrapper for kernels BARRED from the persistent XLA
+    compilation cache — donating kernels, on jax 0.4.37: an executable
+    RELOADED from the persistent cache mis-applies the donate_argnums
+    aliasing table (same-shaped outputs read the WRONG donated input
+    buffer; minimal repro pinned by
+    tests/test_fusion.test_donation_persistent_cache_repro).  Fresh
+    compiles are always correct, so the durable workaround is to keep
+    such programs out of the cache entirely — never written, never
+    reloadable — by compiling their first (shape) call inside a window
+    where the cache dir is unset and the latched cache object is reset
+    (jax consults the dir at cache-init, not per compile; flipping the
+    enable flag alone does not stop writes — probed on 0.4.37).
+
+    The window is serialized by a process lock; a concurrent compile of
+    a NON-donating kernel on another thread during the window loses
+    persistence for that one program (correctness unaffected — it
+    simply compiles fresh again next process).  Steady state therefore
+    gets donation AND warm compiles: every non-donating program warms
+    from the persistent cache, donating programs pay one fresh compile
+    per process, bounded by the (small) donating-kernel inventory.
+
+    A shape is marked warm only AFTER its guarded call returns: a
+    pre-marked shape would let (a) a concurrent first dispatch of the
+    same shape, or (b) the retry after a guarded call that raised
+    (HBM OOM), take the unguarded fast path while the program is still
+    uncompiled — compiling it with the cache armed and WRITING the
+    donating executable into the cache this guard exists to keep it
+    out of.  Concurrent first callers instead serialize on the flip
+    lock; by the time the loser's call runs, jax's in-memory cache is
+    warm and no compile (hence no write) happens."""
+    seen = _ShapeSeen()
+
+    def run(*args, **kwargs):
+        sig = _shape_sig(args, kwargs)
+        if seen.peek(sig):
+            return fn(*args, **kwargs)
+        global _no_persist_noted
+        if not _no_persist_noted:
+            _no_persist_noted = True
+            import logging
+            logging.getLogger("spark_rapids_tpu.fusion").info(
+                "donating kernels compile outside the persistent XLA "
+                "cache (jax 0.4.37 reload mis-applies donate_argnums "
+                "aliasing — see exec/kernel_cache._no_persistent_cache)")
+        from spark_rapids_tpu.obs import registry as _obsreg
+        from jax._src import compilation_cache as _cc
+        with _PC_FLIP_LOCK:
+            prev = None
+            try:
+                prev = jax.config.jax_compilation_cache_dir
+            except Exception:
+                pass
+            if prev:
+                jax.config.update("jax_compilation_cache_dir", None)
+                _cc.reset_cache()
+            try:
+                out = fn(*args, **kwargs)
+            finally:
+                if prev:
+                    jax.config.update("jax_compilation_cache_dir", prev)
+                    _cc.reset_cache()
+                _obsreg.get_registry().inc(
+                    "kernel.cache.noPersistCompiles")
+        seen.mark(sig)
+        return out
+    return run
 
 
 def _with_oom_recovery(fn):
@@ -263,6 +410,7 @@ def _count_dispatches(key: Any, fn: Callable,
 
 def get_kernel(key: Any, builder: Callable[[], Callable],
                oom_retry: bool = True, backend: str = None,
+               persistent_cache: bool = True,
                **jit_kwargs) -> Callable:
     """Return the cached jitted kernel for ``key``, building+jitting via
     ``builder`` on first use (LRU-bounded).
@@ -286,7 +434,15 @@ def get_kernel(key: Any, builder: Callable[[], Callable],
     (fresh XLA compile) or ``kernel.cache.persistentHits`` (persistent-
     cache reload) via obs/compile.py — note the granularity: one key
     can lazily compile several shape-bucket programs, so misses is not
-    the sum of the two program-tier counters."""
+    the sum of the two program-tier counters.
+
+    ``persistent_cache=False`` bars this kernel's programs from the
+    persistent XLA compilation cache (see ``_no_persistent_cache``) —
+    required for donating kernels on jax 0.4.37, where reloaded
+    executables mis-apply the donation aliasing table.  Such programs
+    also record no precompile replay payload: an AOT replay would
+    re-write them into the cache the guard exists to keep them out
+    of."""
     from spark_rapids_tpu.obs import registry as _obsreg
     fam = _family(key)
     with _LOCK:
@@ -300,11 +456,17 @@ def get_kernel(key: Any, builder: Callable[[], Callable],
             return fn
     _obsreg.get_registry().inc_many(
         ("kernel.cache.misses", 1), (f"kernel.cache.misses.{fam}", 1))
-    fn = jax.jit(builder(), **jit_kwargs)
+    inner = builder()
+    fn = jax.jit(inner, **jit_kwargs)
+    if not persistent_cache:
+        fn = _no_persistent_cache(fn)
     from spark_rapids_tpu.obs import compile as _obscompile
     observed = _obscompile.is_enabled()
     if observed:
-        fn = _observe_compiles(key, fn, backend)
+        fn = _observe_compiles(
+            key, fn, backend,
+            replay_src=(inner, jit_kwargs) if persistent_cache
+            else None)
     if oom_retry:
         fn = _with_oom_recovery(fn)
     fn = _count_dispatches(key, fn, backend)

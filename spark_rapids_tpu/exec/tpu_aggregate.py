@@ -902,9 +902,13 @@ class TpuHashAggregateExec(TpuExec):
             # interpret mode rides the key for pallas-built kernels so
             # flipping kernel.pallas.interpret can't serve stale
             # interpreter-mode executables from the process cache
+            # update/merge kernels never read the output schema names
+            # (they emit static __k*/__a* buffer names); only agg_final
+            # bakes the real names in — so names ride ONLY its key, and
+            # the same aggregation under different output aliases
+            # shares the expensive update/merge sorts (shape-erased ABI)
             sig = (kc.exprs_sig(self.groupings),
-                   kc.exprs_sig(self.aggregates),
-                   tuple(self._schema.names), bk,
+                   kc.exprs_sig(self.aggregates), bk,
                    kb.interpret() if bk == kb.PALLAS else None)
             # only the UPDATE kernel evaluates the fused condition;
             # merge/final kernels are identical across filters and must
@@ -925,10 +929,11 @@ class TpuHashAggregateExec(TpuExec):
                 lambda: functools.partial(cls._merge_impl, shim),
                 backend=bk)
             self._final_kernel = kc.get_kernel(
-                ("agg_final", sig),
+                ("agg_final", sig, tuple(self._schema.names)),
                 lambda: functools.partial(cls._final_impl, shim))
 
         def run(its):
+            from spark_rapids_tpu.exec import kernel_abi
             from spark_rapids_tpu.mem.spill import register_or_hold
             from spark_rapids_tpu.obs import registry as obsreg
             reg = obsreg.get_registry()
@@ -946,8 +951,14 @@ class TpuHashAggregateExec(TpuExec):
                         if isinstance(nr, (int, np.integer)) \
                                 and nr == 0 and self.groupings:
                             continue
+                        # shape-erased ABI: the update kernel reads
+                        # columns by ordinal only (groupings/aggregates
+                        # are BoundReference trees) and emits its own
+                        # static __k*/__a* buffer names, so the input
+                        # erases with no restamp needed
                         with timed(self.metrics, "agg.update"):
-                            partial = self._update_kernel(b)
+                            partial = self._update_kernel(
+                                kernel_abi.erase(b))
                         if self.fused_prologue_saved:
                             reg.inc("fusion.dispatchesSaved",
                                     self.fused_prologue_saved)

@@ -244,6 +244,7 @@ class TpuFilterExec(TpuExec):
         needs_ctx = bool(ir.collect(
             self.condition, lambda n: isinstance(
                 n, (ir.SparkPartitionID, ir.MonotonicallyIncreasingID))))
+        names = self.schema.names
 
         def run(pid, it):
             reg = obsreg.get_registry()
@@ -256,6 +257,9 @@ class TpuFilterExec(TpuExec):
                     nr = int(b.num_rows)
                 out = fs.dispatch(self, "filter.eval", donate, reg,
                                   b, pid, offset)
+                # the kernel's compact keeps the (ABI-erased) input
+                # names; restamp the real schema host-side
+                out = DeviceBatch(names, out.columns, out.num_rows)
                 if needs_ctx:
                     offset += nr
                 yield out
@@ -421,20 +425,29 @@ class TpuExpandExec(TpuExec):
     def execute(self):
         if self._kernels is None:
             from spark_rapids_tpu.exec import kernel_cache as kc
+            from spark_rapids_tpu.exec.fused_stage import canonical_names
 
             def mk(proj):
+                n_out = len(proj)
+
                 def impl(batch):
                     cols = [eval_tpu.evaluate(e, batch).to_column()
                             for e in proj]
-                    return DeviceBatch(self._schema.names, cols,
+                    # positional output names (the erased-ABI/PR-4
+                    # scheme); run() restamps the real schema
+                    return DeviceBatch(canonical_names(n_out), cols,
                                        batch.num_rows)
                 return kc.get_kernel(
-                    ("expand", kc.exprs_sig(proj),
-                     tuple(self._schema.names)), lambda: impl)
+                    ("expand", kc.exprs_sig(proj)), lambda: impl)
             self._kernels = [mk(p) for p in self.projections]
 
+        names = self._schema.names
+
         def run(it):
+            from spark_rapids_tpu.exec import kernel_abi
             for b in it:
+                eb = kernel_abi.erase(b)
                 for k in self._kernels:
-                    yield k(b)
+                    out = k(eb)
+                    yield DeviceBatch(names, out.columns, out.num_rows)
         return [run(it) for it in self.children[0].execute()]

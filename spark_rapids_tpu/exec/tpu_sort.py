@@ -104,12 +104,20 @@ class TpuSortExec(TpuExec):
                 for h in handles:
                     h.close()
             with timed(self.metrics, "sort.exec"):
-                digits = keys_kernel(whole)
+                # shape-erased ABI: ONE erased view feeds both the
+                # key-encode and the apply gather (order indices are
+                # positions in the erased capacity), names restamped
+                # host-side after
+                from spark_rapids_tpu.exec import kernel_abi
+                ew = kernel_abi.erase(whole)
+                digits = keys_kernel(ew)
                 order = sortkeys.shared_digit_sort(digits)
                 apply_kernel = kc.get_kernel(
-                    ("sort_apply", whole.schema_key()),
+                    ("sort_apply", kernel_abi.erased_key(ew)),
                     lambda: type(self)._apply_impl)
-                out = apply_kernel(whole, order)
+                out = apply_kernel(ew, order)
+                out = DeviceBatch(whole.names, out.columns,
+                                  out.num_rows)
             self.metrics.add_rows(out.num_rows)
             yield out
         if self.partitionwise:

@@ -137,7 +137,10 @@ def _column_vbits(out_dtype: dt.DType,
     """Host-known value range of one fused column: dictionary pages
     hold every referenceable value, PLAIN buffers hold every stored
     value — min/max over them bounds all VALID decoded values (null
-    slots store nothing in either encoding)."""
+    slots store nothing in either encoding).  The result is re-bucketed
+    to the shape-erased ABI's coarse hint table (kernel_abi) before it
+    reaches the pq_fused6 kernel key and the decoded columns — precise
+    per-file ranges were minting one scan program per value range."""
     if out_dtype.is_string or out_dtype.is_floating or out_dtype.is_bool:
         return None
     if not np.issubdtype(np.dtype(out_dtype.to_np()), np.integer):
@@ -159,9 +162,10 @@ def _column_vbits(out_dtype: dt.DType,
             lo = min(lo, int(buf.min())) if seen else int(buf.min())
             hi = max(hi, int(buf.max())) if seen else int(buf.max())
             seen = True
+    from spark_rapids_tpu.exec import kernel_abi
     if not seen:
-        return _VBIT_BUCKETS[0]
-    return bits_for_range(lo, hi)
+        return kernel_abi.bucket_vbits(_VBIT_BUCKETS[0])
+    return kernel_abi.bucket_vbits(bits_for_range(lo, hi))
 
 
 def _all_valid(runs: RunTable) -> bool:

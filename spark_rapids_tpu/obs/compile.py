@@ -86,6 +86,7 @@ TIER_PERSISTENT = "persistent"
 _enabled = True                       # obs.compile.enabled default
 _storm_threshold = DEFAULT_STORM_THRESHOLD
 _corpus_path = ""
+_corpus_replay = True                 # obs.compile.corpusReplay default
 
 _LOCK = threading.Lock()
 _ring: deque = deque(maxlen=DEFAULT_RING_EVENTS)
@@ -110,11 +111,13 @@ _corpus_lock = threading.Lock()
 def configure(enabled: bool,
               ring_events: int = DEFAULT_RING_EVENTS,
               storm_threshold: int = DEFAULT_STORM_THRESHOLD,
-              corpus_path: str = "") -> None:
+              corpus_path: str = "",
+              corpus_replay: bool = True) -> None:
     """Session-init hook (``obs.compile.*`` knobs; last session wins).
     Resizing the ring preserves its newest events; process-lifetime
     aggregates are never reset by reconfiguration."""
-    global _enabled, _storm_threshold, _corpus_path, _ring
+    global _enabled, _storm_threshold, _corpus_path, _ring, \
+        _corpus_replay
     with _LOCK:
         ring_events = max(16, int(ring_events))
         if ring_events != (_ring.maxlen or 0):
@@ -122,6 +125,11 @@ def configure(enabled: bool,
         _enabled = bool(enabled)
         _storm_threshold = max(1, int(storm_threshold))
         _corpus_path = str(corpus_path or "")
+        _corpus_replay = bool(corpus_replay)
+
+
+def corpus_replay_enabled() -> bool:
+    return _corpus_replay
 
 
 def is_enabled() -> bool:
@@ -387,9 +395,13 @@ def _bucket_key(key: Any) -> Any:
 
 def record_compile(key: Any, family: str, backend: str,
                    leaves: Sequence[Any], t0_ns: int, dur_ns: int,
-                   tier: str) -> None:
+                   tier: str, replay: Optional[str] = None) -> None:
     """Record one CompileEvent (called by the kernel-cache observe
-    wrapper on the first call of each (key, shape) program)."""
+    wrapper on the first call of each (key, shape) program).
+    ``replay`` is the optional AOT replay payload (base64, built by
+    kernel_cache._replay_payload) that rides the program's corpus
+    record only — never the ring or the /compiles events (payloads are
+    KBs each)."""
     if not _enabled:
         return
     global _seq
@@ -416,12 +428,20 @@ def record_compile(key: Any, family: str, backend: str,
         if fam is None:
             fam = _families[family] = {
                 "programs": 0, "fresh": 0, "persistent": 0,
-                "wall_ns": 0, "sigs": set(), "bucketed": set(),
-                "sig_overflow": False}
+                "wall_ns": 0, "wall_fresh_ns": 0,
+                "wall_persistent_ns": 0, "sigs": set(),
+                "bucketed": set(), "sig_overflow": False}
         fam["programs"] += 1
-        fam[tier if tier in (TIER_FRESH, TIER_PERSISTENT)
-            else TIER_FRESH] += 1
+        eff_tier = tier if tier in (TIER_FRESH, TIER_PERSISTENT) \
+            else TIER_FRESH
+        fam[eff_tier] += 1
         fam["wall_ns"] += int(dur_ns)
+        # per-tier wall split: the persistent share is the "warm
+        # compile" bill a replica restart pays (reload, not re-compile)
+        # — the number the precompile service exists to move off the
+        # serving path (tracked per run in BENCH_trend.json)
+        fam["wall_fresh_ns" if eff_tier == TIER_FRESH
+            else "wall_persistent_ns"] += int(dur_ns)
         if len(fam["sigs"]) < _MAX_SIGS_PER_FAMILY:
             fam["sigs"].add((key_repr, sig))
             fam["bucketed"].add((bkey, bleaves))
@@ -438,9 +458,11 @@ def record_compile(key: Any, family: str, backend: str,
                 q["compiled"] += 1
             q["wall_ns"] += int(dur_ns)
             if len(q["programs"]) < _MAX_PROGRAMS_PER_QUERY:
-                q["programs"].append(
-                    {"family": family, "key": key_repr,
-                     "signature": sig, "backend": backend})
+                prog = {"family": family, "key": key_repr,
+                        "signature": sig, "backend": backend}
+                if replay is not None:
+                    prog["replay"] = replay
+                q["programs"].append(prog)
             total = q["compiled"] + q["persistent"]
             if total > _storm_threshold and not q["storm"]:
                 q["storm"] = True
@@ -496,9 +518,19 @@ def _totals_locked() -> Dict[str, Any]:
     fresh = sum(a["fresh"] for a in _families.values())
     persistent = sum(a["persistent"] for a in _families.values())
     wall_ns = sum(a["wall_ns"] for a in _families.values())
+    distinct = sum(len(a["sigs"]) for a in _families.values())
+    bucketed = sum(len(a["bucketed"]) for a in _families.values())
     return {"events": fresh + persistent, "fresh": fresh,
             "persistent": persistent,
             "compile_wall_ms": round(wall_ns / 1e6, 3),
+            "compile_wall_fresh_ms": round(sum(
+                a["wall_fresh_ns"] for a in _families.values()) / 1e6,
+                3),
+            "compile_wall_persistent_ms": round(sum(
+                a["wall_persistent_ns"]
+                for a in _families.values()) / 1e6, 3),
+            "distinct_programs": distinct,
+            "width_bucketed_projection": bucketed,
             "families": len(_families),
             "queries_tracked": len(_queries),
             # closure terms for the attribution identity (see the

@@ -452,19 +452,105 @@ def test_aliased_projections_share_one_kernel():
     assert out.column_names == ["y"]
 
 
-def test_donation_disarmed_while_persistent_cache_active():
+def test_donation_armed_while_persistent_cache_active():
     # the test suite runs WITH the persistent compile cache (conftest);
-    # donation must stand down (cache-reloaded donating executables
-    # mis-apply the aliasing table — see fused_stage docstring)
+    # donation used to AUTO-DISARM under it (cache-reloaded donating
+    # executables mis-apply the aliasing table on jax 0.4.37) — the
+    # durable workaround compiles donating kernels OUTSIDE the
+    # persistent cache (kernel_cache._no_persistent_cache), so donation
+    # stays armed AND every other program keeps warm compiles
     import jax
+    from spark_rapids_tpu.exec import fused_stage as fs
     if not jax.config.jax_compilation_cache_dir:
         pytest.skip("persistent compile cache not active")
+    assert fs._persistent_cache_active()
     s = _session(True)
     view = obsreg.get_registry().view()
-    (_data(s).with_column("d", col("a") + col("b"))
-             .filter(col("d") > 15.0).select("d")).collect()
+    out = (_data(s).with_column("d", col("a") + col("b"))
+           .filter(col("d") > 15.0).select("d")).collect()
     d = view.delta()["counters"]
-    assert d.get("fusion.donatedDispatches", 0) == 0
+    assert d.get("fusion.donatedDispatches", 0) > 0
+    assert out.num_rows > 0
+
+
+def test_donating_programs_stay_out_of_persistent_cache(tmp_path):
+    # the guard itself: a kernel built with persistent_cache=False must
+    # neither write to nor read from the persistent XLA cache, and the
+    # cache must re-arm for the next ordinary compile
+    import os
+    import jax
+    import jax.numpy as jnp
+    from spark_rapids_tpu.exec import kernel_cache as kc
+    _session(True)   # ensures the persistent-cache flags are configured
+    import numpy as np
+    x = jnp.arange(32)   # materialized BEFORE the test's cache dir arms
+    x.block_until_ready()
+    prev = jax.config.jax_compilation_cache_dir
+    cache = str(tmp_path / "xla")
+    os.makedirs(cache, exist_ok=True)
+    jax.config.update("jax_compilation_cache_dir", cache)
+    try:
+        base = obsreg.get_registry().counter(
+            "kernel.cache.noPersistCompiles")
+        guarded = kc.get_kernel(
+            ("test_nopersist", 1), lambda: (lambda x: x * 3 + 1),
+            persistent_cache=False)
+        got = np.asarray(guarded(x))    # numpy oracle: no stray jits
+        assert got.tolist() == (np.arange(32) * 3 + 1).tolist()
+        assert os.listdir(cache) == [], (
+            "guarded compile leaked into the persistent cache")
+        assert obsreg.get_registry().counter(
+            "kernel.cache.noPersistCompiles") == base + 1
+        # warm replay of the same shape: no second flip
+        guarded(jnp.arange(32))
+        assert obsreg.get_registry().counter(
+            "kernel.cache.noPersistCompiles") == base + 1
+        # the cache re-armed: an ordinary compile persists again
+        plain = kc.get_kernel(
+            ("test_nopersist", 2), lambda: (lambda x: x * 5 + 2))
+        plain(jnp.arange(32))
+        assert os.listdir(cache), "cache did not re-arm after the guard"
+    finally:
+        jax.config.update("jax_compilation_cache_dir", prev)
+        from jax._src import compilation_cache as cc
+        cc.reset_cache()
+
+
+def test_donation_persistent_cache_repro():
+    # the minimal repro behind the guard, pinned as a regression test:
+    # compile a donating identity-shaped kernel, write it to a
+    # persistent cache, drop jax's in-memory caches so the re-jit
+    # RELOADS the executable from disk, and assert the reloaded
+    # executable applies the donation aliasing table correctly.  On the
+    # tunneled TPU runtime of jax 0.4.37 the reload returns af's bits
+    # inside the ai+0 output (the engine therefore never persists
+    # donating programs — see kernel_cache._no_persistent_cache); on
+    # platforms where jax is correct this documents the contract.
+    import tempfile
+    import jax
+    import jax.numpy as jnp
+    prev = jax.config.jax_compilation_cache_dir
+    cache = tempfile.mkdtemp(prefix="donate_repro_")
+    jax.config.update("jax_compilation_cache_dir", cache)
+    try:
+        def k(ai, af, p):
+            return ai + 0, af * 1.0, p + ai.astype(p.dtype)
+        ai = jnp.arange(16, dtype=jnp.int32)
+        af = jnp.linspace(0.0, 1.0, 16, dtype=jnp.float32)
+        p = jnp.ones(16, dtype=jnp.float32)
+        expect = [x.tolist()
+                  for x in jax.jit(k, donate_argnums=(0,))(ai, af, p)]
+        jax.clear_caches()      # force the re-jit to reload from disk
+        got = [x.tolist() for x in jax.jit(k, donate_argnums=(0,))(
+            jnp.arange(16, dtype=jnp.int32), af, p)]
+        assert got == expect, (
+            "persistent-cache reload mis-applied donate_argnums "
+            "aliasing — the _no_persistent_cache guard is mandatory "
+            f"on this platform: {got[0][:4]} vs {expect[0][:4]}")
+    finally:
+        jax.config.update("jax_compilation_cache_dir", prev)
+        from jax._src import compilation_cache as cc
+        cc.reset_cache()
 
 
 def test_donation_knob_parity_and_counter():
@@ -476,11 +562,12 @@ def test_donation_knob_parity_and_counter():
     import jax
     cache_dir = jax.config.jax_compilation_cache_dir
     try:
-        # donation only arms with the persistent compile cache off;
-        # session init re-enables the cache (conftest opted in), so
-        # null the dir AFTER each session exists.  The donate flag
-        # itself is PLAN-stamped per session (not process-global), so
-        # the two sessions cannot interfere
+        # donation arms regardless of persistent-cache state now (the
+        # no-persist guard replaced the auto-disarm); the dir is still
+        # nulled here so this test exercises the plain donation path
+        # independent of the guard.  The donate flag itself is
+        # PLAN-stamped per session (not process-global), so the two
+        # sessions cannot interfere
         s_on = _session(True)
         s_off = _session(
             True, **{"spark.rapids.tpu.sql.fusion.donateInputs": False})

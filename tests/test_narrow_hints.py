@@ -48,10 +48,32 @@ def test_vbits_from_parquet_dict_and_plain(tmp_path):
     batch, fallbacks = _decode_fused(p)
     assert not fallbacks
     cols = {n: c for n, c in zip(batch.names, batch.columns)}
+    # hints are re-bucketed to the shape-erased ABI table {16, 32, 56}
+    # (kernel_abi.bucket_vbits) before the scan kernel key and outputs
+    # — precise per-file ranges were minting one program per range.
+    # d64's precise bucket is 16 (already a tier); p32's precise 8
+    # coarsens to 16.  Both remain sound upper bounds.
     assert cols["d64"].vbits == 16
     assert cols["d64"].nonnull
-    assert cols["p32"].vbits == 8
+    assert cols["p32"].vbits == 16
     assert cols["f"].vbits is None
+
+
+def test_vbits_abi_disabled_keeps_precise_buckets(tmp_path):
+    # the legacy precise hint derivation survives behind
+    # kernel.abi.bucketHints for A/B measurement
+    from spark_rapids_tpu.exec import kernel_abi
+    t = pa.table({"p32": pa.array(
+        np.arange(-100, 100, dtype=np.int32).repeat(20))})
+    p = str(tmp_path / "t.parquet")
+    papq.write_table(t, p)
+    prev = kernel_abi._bucket_hints
+    kernel_abi._bucket_hints = False
+    try:
+        batch, _ = _decode_fused(p)
+    finally:
+        kernel_abi._bucket_hints = prev
+    assert batch.columns[0].vbits == 8
 
 
 def test_vbits_buckets():

@@ -411,26 +411,35 @@ def test_prefetch_stall_span_names_source():
     assert all("src" in s[7] for s in prefetches)
 
 
-def test_donation_disarm_warning_and_counter(caplog):
-    """With the persistent compile cache active (the tests' own
-    conftest arms it), a plan-stamped donate_ok(True) stands down
-    VISIBLY: one warning log + the fusion.donationDisarmed counter,
-    exactly once per process."""
+def test_donation_no_persist_guard_visibility(caplog):
+    """Donation no longer auto-disarms under the persistent compile
+    cache: donate_ok is cache-state-independent, and the guard that
+    replaced the stand-down (donating kernels compile OUTSIDE the
+    persistent cache) is operator-visible via one INFO log plus the
+    kernel.cache.noPersistCompiles counter per guarded compile."""
     import logging
-    from spark_rapids_tpu.exec import fused_stage
+    import jax.numpy as jnp
+    from spark_rapids_tpu.exec import fused_stage, kernel_cache as kc
     from spark_rapids_tpu.exec.base import PhysicalPlan
     if not fused_stage._persistent_cache_active():
         pytest.skip("no persistent compile cache in this environment")
-    fused_stage._disarm_noted = False        # re-arm the one-shot
+
+    class HostToDeviceExec(PhysicalPlan):   # allowlisted producer name
+        pass
+
+    # cache active, producer safe, plan-stamped on -> donation ARMS
+    assert fused_stage.donate_ok(HostToDeviceExec(), True) is True
+    # and a knob-off plan never donates regardless of cache state
+    assert fused_stage.donate_ok(HostToDeviceExec(), False) is False
+
     reg = obsreg.get_registry()
-    base = reg.counter("fusion.donationDisarmed")
-    with caplog.at_level(logging.WARNING, "spark_rapids_tpu.fusion"):
-        assert fused_stage.donate_ok(PhysicalPlan(), True) is False
-    assert reg.counter("fusion.donationDisarmed") == base + 1
-    assert any("donation auto-disarmed" in r.message
+    base = reg.counter("kernel.cache.noPersistCompiles")
+    kc._no_persist_noted = False             # re-arm the one-shot log
+    with caplog.at_level(logging.INFO, "spark_rapids_tpu.fusion"):
+        guarded = kc.get_kernel(
+            ("test_obs_nopersist", 1), lambda: (lambda x: x + 7),
+            persistent_cache=False)
+        guarded(jnp.arange(8))
+    assert reg.counter("kernel.cache.noPersistCompiles") == base + 1
+    assert any("outside the persistent XLA cache" in r.message
                for r in caplog.records)
-    # one-time: a second disarm decision does not re-log or re-count
-    assert fused_stage.donate_ok(PhysicalPlan(), True) is False
-    assert reg.counter("fusion.donationDisarmed") == base + 1
-    # the flag never affects the enabled=False path
-    assert fused_stage.donate_ok(PhysicalPlan(), False) is False
