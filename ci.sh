@@ -562,6 +562,75 @@ print(f"warm-start gate OK: {a['programs']} corpus programs, "
       f"reloads, 0 fresh compiles on the probe re-run")
 EOF
 
+echo "== pipelined-shuffle gate (depth=2 vs 0 bit-identical, overlap>0, codec parity) =="
+timeout 560 python - <<'EOF'
+# the sequential barrier exchange (shuffle.pipeline.depth=0) is the
+# pipelined data plane's correctness oracle (the sql.fusion.enabled
+# pattern): one process-transport shuffle query runs sequential,
+# pipelined, and pipelined+lz4 — all three must be BIT-IDENTICAL, the
+# pipelined run must show real overlap (shuffle.pipeline.overlapNs>0:
+# background prefetch wall the consumer did not wait out), the
+# compressed run must actually shrink the wire leg, and a fault-free
+# run must not retry or stall (regression: the make_client dial race
+# clobbered the server's DATA routing and surfaced exactly here).
+import os
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+import numpy as np
+import pyarrow as pa
+from spark_rapids_tpu import TpuSparkSession, functions as F
+from spark_rapids_tpu.obs import registry as obsreg
+from spark_rapids_tpu.shuffle import faults
+
+rng = np.random.default_rng(17)
+n = 6000
+t = pa.table({
+    "k": pa.array(rng.integers(0, 13, n).astype(np.int64)),
+    "v": pa.array(rng.integers(0, 1000, n).astype(np.int64))})
+BASE = {
+    "spark.rapids.tpu.sql.variableFloatAgg.enabled": True,
+    "spark.rapids.tpu.shuffle.transport": "process",
+    "spark.rapids.tpu.shuffle.transport.processExecutors": 2,
+    "spark.rapids.tpu.sql.shuffle.partitions": 3,
+}
+
+def run(depth, codec):
+    faults.reset_fault_stats()
+    s = TpuSparkSession(dict(BASE, **{
+        "spark.rapids.tpu.shuffle.pipeline.depth": depth,
+        "spark.rapids.tpu.shuffle.compression.codec": codec}))
+    view = obsreg.get_registry().view()
+    out = (s.create_dataframe(t, num_partitions=3)
+           .group_by("k")
+           .agg(F.count("*").alias("c"), F.sum("v").alias("sv"))
+           .sort("k")).collect()
+    d = view.delta()["counters"]
+    stats = faults.get_fault_stats()
+    assert stats.get("retries") == 0 and stats.get("timeouts") == 0, (
+        f"fault-free run retried/stalled (depth={depth}, "
+        f"codec={codec}): {stats}")
+    return out, d
+
+seq, _ = run(0, "none")
+piped, d = run(2, "none")
+assert piped.equals(seq), "pipelined result diverges from sequential"
+overlap = d.get("shuffle.pipeline.overlapNs", 0)
+assert overlap > 0, f"no overlap observed on the pipelined run: {d}"
+lz4, dz = run(2, "lz4")
+assert lz4.equals(seq), "compressed result diverges"
+wire, raw = dz.get("shuffle.wire.wireBytes", 0), \
+    dz.get("shuffle.wire.rawBytes", 0)
+assert 0 < wire < raw, f"wire leg did not shrink: {wire} vs {raw}"
+from spark_rapids_tpu.shuffle import procpool
+procpool.reset_executor_pool()
+print(f"pipelined-shuffle gate OK: 3/3 bit-identical, "
+      f"overlap {overlap / 1e6:.1f}ms, wire {raw} -> {wire} bytes "
+      f"({raw / wire:.2f}x)")
+EOF
+
+echo "== pipelined fault smoke (drop / kill / fallback / cancel with the pipeline pinned on) =="
+timeout 560 python -m pytest tests/test_shuffle_pipeline.py -q \
+    -k "drop or kill or fallback or cancel"
+
 echo "== smoke bench (tracing enabled) =="
 python bench.py --smoke --profile-out=/tmp/bench_profile.json
 

@@ -377,8 +377,43 @@ PYWORKER_MAX_RESPAWNS = conf(
 
 SHUFFLE_COMPRESSION_CODEC = conf(
     "spark.rapids.tpu.shuffle.compression.codec", "none",
-    "Codec for serialized shuffle partitions: none, lz4 (pyarrow IPC "
-    "compression), zstd. (reference: TableCompressionCodec.scala:41)")
+    "Codec for shuffle data: none, lz4, zstd, zlib (zlib compresses "
+    "the wire leg only — Arrow IPC has no zlib buffer compression, so "
+    "block stores hold those blocks uncompressed). "
+    "Applies to serialized shuffle partitions (pyarrow IPC buffer "
+    "compression in the block stores) AND, on the TCP/DCN process "
+    "transport, to the per-frame DATA wire leg — the driver's clients "
+    "negotiate the codec in their HELLO handshake and executor servers "
+    "wrap every DATA payload back to them (flag + uncompressed-size + "
+    "body; incompressible or empty frames ride uncompressed inside the "
+    "wrapper). See docs/shuffle_wire_format.md. (reference: "
+    "TableCompressionCodec.scala:41)")
+
+SHUFFLE_PIPELINE_DEPTH = conf(
+    "spark.rapids.tpu.shuffle.pipeline.depth", 2,
+    "Bounded look-ahead of the pipelined process-transport exchange: "
+    "up to this many reduce partitions are fetched + decoded + "
+    "uploaded ahead of the consumer (the ScanPrefetcher shape), with "
+    "per-map completion notifications letting reducers fetch a map "
+    "task's output the moment that map id finishes instead of "
+    "barriering on the whole map stage. Prepared partitions register "
+    "with the spill catalog at shuffle-input priority, so memory "
+    "pressure spills them to host/disk instead of stalling admission. "
+    "0 disables the pipeline (the sequential map->fetch->decode "
+    "exchange, bit-identical results — the CI parity gate diffs the "
+    "two).", int)
+
+SHUFFLE_PIPELINE_TIMEOUT_MS = conf(
+    "spark.rapids.tpu.shuffle.pipeline.timeoutMs", 120000,
+    "No-progress bound on the pipelined exchange's wait for the next "
+    "map-task completion: if no new map id lands within this window "
+    "the read escalates through the standard recovery ladder "
+    "(map-stage re-run of dead executors, then the CPU fallback when "
+    "enabled). Raise for map stages whose single tasks legitimately "
+    "run longer, or set 0 to wait indefinitely (the sequential "
+    "barrier's semantics: a dead executor still surfaces promptly "
+    "through its submit thread; only a wedged-but-alive one blocks, "
+    "exactly as it blocks the depth=0 pipe read).", int)
 
 AUTO_BROADCAST_THRESHOLD = conf(
     "spark.rapids.tpu.sql.autoBroadcastJoinThreshold", 10 << 20,

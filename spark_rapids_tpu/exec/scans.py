@@ -29,6 +29,7 @@ from __future__ import annotations
 
 import threading
 import time
+from dataclasses import dataclass
 from typing import Callable, List, Optional, Sequence
 
 import jax
@@ -39,6 +40,35 @@ from spark_rapids_tpu.obs import trace as obstrace
 from spark_rapids_tpu.sched import cancel as _cancel
 
 _BLOCK = 1 << 15          # per-step scan length
+
+
+@dataclass(frozen=True)
+class PrefetchKeys:
+    """Span/registry names one ScanPrefetcher instance emits under.
+
+    The prefetcher started life scan-only; the shuffle pipeline reuses
+    it (the exchange's bounded look-ahead over reduce partitions) with
+    its own name set — ``shuffle.pipeline.prefetch``/``stall`` spans
+    and ``shuffle.pipeline.stalls``/``overlapNs`` counters — so traces
+    and /metrics keep the two pipelines distinguishable."""
+
+    span_prefetch: str = "scan.prefetch"
+    span_stall: str = "scan.prefetchStall"
+    prefetch_ns: str = "scan.prefetchNs"
+    stalls: str = "scan.prefetchStalls"
+    stall_ns: str = "scan.prefetchStallNs"
+    overlap_ns: str = "scan.prefetchOverlapNs"
+    cat: str = "scan"
+
+
+SHUFFLE_PIPELINE_KEYS = PrefetchKeys(
+    span_prefetch="shuffle.pipeline.prefetch",
+    span_stall="shuffle.pipeline.stall",
+    prefetch_ns="shuffle.pipeline.prefetchNs",
+    stalls="shuffle.pipeline.stalls",
+    stall_ns="shuffle.pipeline.stallNs",
+    overlap_ns="shuffle.pipeline.overlapNs",
+    cat="shuffle")
 
 
 class ScanPrefetcher:
@@ -71,9 +101,10 @@ class ScanPrefetcher:
 
     def __init__(self, thunks: Sequence[Callable[[], object]],
                  depth: int, metrics=None,
-                 stall_key: str = "scan.prefetchStalls",
                  cleanup: Optional[Callable[[object], None]] = None,
-                 labels: Optional[Sequence[str]] = None):
+                 labels: Optional[Sequence[str]] = None,
+                 keys: Optional[PrefetchKeys] = None,
+                 thread_name: str = "scan-prefetch"):
         import concurrent.futures as cf
         import weakref
         self._thunks: List[Callable[[], object]] = list(thunks)
@@ -83,9 +114,13 @@ class ScanPrefetcher:
         self._labels: List[str] = list(labels or ())
         self._depth = max(1, int(depth))
         self._metrics = metrics
-        self._stall_key = stall_key
+        self._keys = keys or PrefetchKeys()
         self._lock = threading.Lock()
         self._futures = {}
+        # per-thunk prefetch wall (ns), consumed by get()'s overlap
+        # accounting: background work that completed before (or ran
+        # beyond) the consumer's arrival is genuinely overlapped time
+        self._durs = {}
         self._next = 0
         self._consumed = 0
         self._parts_done = 0
@@ -98,7 +133,7 @@ class ScanPrefetcher:
         if self._thunks:
             self._pool = cf.ThreadPoolExecutor(
                 max_workers=self._depth,
-                thread_name_prefix="scan-prefetch")
+                thread_name_prefix=thread_name)
             # args must not reference self (that would pin it forever)
             self._finalizer = weakref.finalize(
                 self, ScanPrefetcher._close_impl, self._lock,
@@ -124,9 +159,12 @@ class ScanPrefetcher:
                 return self._thunks[i]()
         finally:
             dur = time.perf_counter_ns() - t0
-            obstrace.record("scan.prefetch", t0, dur, cat="scan",
+            with self._lock:
+                self._durs[i] = dur
+            obstrace.record(self._keys.span_prefetch, t0, dur,
+                            cat=self._keys.cat,
                             args=self._span_args(i))
-            obsreg.get_registry().observe("scan.prefetchNs", dur)
+            obsreg.get_registry().observe(self._keys.prefetch_ns, dur)
 
     def _fill_locked(self) -> None:
         while (self._next < len(self._thunks) and
@@ -160,26 +198,39 @@ class ScanPrefetcher:
         t0 = 0
         if stalled:
             # the consumer outran the prepared window: a stall, timed
-            # so the profile shows where the pipeline starved
+            # so the profile shows where the pipeline starved (same
+            # name in Metrics.extra and the registry: PrefetchKeys
+            # owns it once)
             if self._metrics is not None:
-                self._metrics.add_extra(self._stall_key, 1)
-            obsreg.get_registry().inc("scan.prefetchStalls")
+                self._metrics.add_extra(self._keys.stalls, 1)
+            obsreg.get_registry().inc(self._keys.stalls)
             t0 = time.perf_counter_ns()
         try:
             return fut.result()
         finally:
+            stall_ns = 0
             if stalled:
-                dur = time.perf_counter_ns() - t0
+                stall_ns = time.perf_counter_ns() - t0
                 # the stall span names its source (path#rg), so a trace
                 # shows WHICH batch the consumer starved on
-                obstrace.record("scan.prefetchStall", t0, dur,
-                                cat="scan", args=self._span_args(i))
-                obsreg.get_registry().inc("scan.prefetchStallNs", dur)
+                obstrace.record(self._keys.span_stall, t0, stall_ns,
+                                cat=self._keys.cat,
+                                args=self._span_args(i))
+                obsreg.get_registry().inc(self._keys.stall_ns, stall_ns)
             with self._lock:
                 self._consumed += 1
+                dur = self._durs.pop(i, 0)
                 self._fill_locked()
                 if self._consumed >= len(self._thunks):
                     self._pool.shutdown(wait=False)
+            # overlapped time = background prefetch wall the consumer
+            # did NOT wait out: a thunk that was ready at get() overlaps
+            # in full; a stalled get overlaps only the head start.  This
+            # is the pipeline's headline (overlapNs == 0 means the
+            # look-ahead bought nothing).
+            overlap = dur - stall_ns
+            if overlap > 0:
+                obsreg.get_registry().inc(self._keys.overlap_ns, overlap)
 
     @staticmethod
     def _close_impl(lock, futures, pool, cleanup) -> None:

@@ -397,12 +397,18 @@ class PlainBatchHandle:
         self.close()
 
 
-def register_or_hold(batch: DeviceBatch):
+def register_or_hold(batch: DeviceBatch,
+                     priority: Optional[int] = None):
     """Register `batch` in the global spill catalog when enabled, else
     wrap it in a PlainBatchHandle; either way the caller gets a
-    get()/close() handle."""
-    return get_catalog().register(batch) if is_enabled() \
-        else PlainBatchHandle(batch)
+    get()/close() handle.  ``priority`` overrides the catalog's
+    default spill priority (e.g. INPUT_FROM_SHUFFLE_PRIORITY for
+    prepared pipelined-shuffle partitions)."""
+    if not is_enabled():
+        return PlainBatchHandle(batch)
+    if priority is None:
+        return get_catalog().register(batch)
+    return get_catalog().register(batch, priority=priority)
 
 
 # ---------------------------------------------------------------------------
@@ -498,19 +504,77 @@ def hbm_oom_recover(e: BaseException) -> bool:
     return freed > 0
 
 
+# ---------------------------------------------------------------------------
+# Auxiliary pressure spillers (in-flight shuffle buffers, etc.)
+# ---------------------------------------------------------------------------
+
+_PRESSURE_SPILLERS: List = []   # weakref.ref to objects w/ pressure_spill
+_PRESSURE_LOCK = threading.Lock()
+
+
+def register_pressure_spiller(obj) -> None:
+    """Register an object exposing ``pressure_spill(bytes_needed) ->
+    bytes_freed`` with the admission-pressure hook.  Held by weakref:
+    a shuffle's received-buffer catalog (the main client) registers at
+    construction and simply drops out when the exchange releases it —
+    no unregister ceremony on the error paths."""
+    import weakref
+    with _PRESSURE_LOCK:
+        _PRESSURE_SPILLERS[:] = [r for r in _PRESSURE_SPILLERS
+                                 if r() is not None]
+        _PRESSURE_SPILLERS.append(weakref.ref(obj))
+
+
+def _aux_pressure_spill(bytes_needed: int) -> int:
+    freed = 0
+    with _PRESSURE_LOCK:
+        refs = list(_PRESSURE_SPILLERS)
+    for r in refs:
+        if freed >= bytes_needed:
+            break
+        obj = r()
+        if obj is None:
+            continue
+        try:
+            freed += int(obj.pressure_spill(bytes_needed - freed))
+        except Exception:
+            # a broken spiller must not fail admission — but it must
+            # be auditable: 0 aux bytes with errors ticking is
+            # "spiller broken", not "nothing pending"
+            obsreg.get_registry().inc("spill.pressureAuxErrors")
+    return freed
+
+
 def handle_memory_pressure(bytes_needed: int) -> int:
     """Admission-control memory-pressure hook: when the scheduler
     admits a query into the top of the memory budget, proactively
     spill lowest-priority registered device batches so real HBM backs
     the newly admitted estimate (the DeviceMemoryEventHandler role,
-    driven from admission instead of an alloc failure).  Returns bytes
-    freed; a no-op while spill is disabled."""
+    driven from admission instead of an alloc failure).  When the
+    device tier alone can't cover it, auxiliary spillers run —
+    in-flight received shuffle payloads move host->disk (pipelined
+    shuffle buffers respond to pressure instead of stalling
+    admission).  Returns bytes freed; a no-op while spill is
+    disabled."""
     if not is_enabled() or bytes_needed <= 0:
         return 0
-    freed = get_catalog().spill_to_fit(int(bytes_needed))
-    if freed:
-        obsreg.get_registry().inc("spill.pressureSpills")
-    return freed
+    device_freed = get_catalog().spill_to_fit(int(bytes_needed))
+    aux_freed = 0
+    if device_freed < bytes_needed:
+        aux_freed = _aux_pressure_spill(
+            int(bytes_needed) - device_freed)
+    # tier-split accounting: device bytes are reclaimed HBM backing;
+    # aux bytes are host RAM moved to disk (received shuffle payloads)
+    # — capacity tuning must not read the second as the first (the
+    # summed return feeds sched.pressureSpillBytes as total relief)
+    reg = obsreg.get_registry()
+    if device_freed:
+        reg.inc("spill.pressureDeviceBytes", device_freed)
+    if aux_freed:
+        reg.inc("spill.pressureAuxBytes", aux_freed)
+    if device_freed or aux_freed:
+        reg.inc("spill.pressureSpills")
+    return device_freed + aux_freed
 
 
 def get_catalog() -> BufferCatalog:

@@ -209,10 +209,23 @@ def _breakdown(plan: Optional[ExecNodeProfile],
     """Wall-clock breakdown in seconds: host prep vs upload vs dispatch
     vs shuffle vs semaphore wait, plus spill traffic in bytes."""
     host_prep = upload = dispatch = shuffle = fused = 0.0
+    shuf_map = shuf_transfer = shuf_decode = 0.0
     if plan is not None:
         for n in plan.walk():
             host_prep += n.extra.get("scan.hostPrepTime", 0) / 1e9
             upload += n.extra.get("scan.uploadTime", 0) / 1e9
+            # the shuffle wall SPLIT: map-stage compute vs DCN transfer
+            # vs reduce-side decode+upload (exchange extras, ns; the
+            # map leg is ONE fleet-wide wall in both launch modes —
+            # first submit to last submit out — never a per-thread
+            # sum).  The legs are walls of possibly-CONCURRENT phases
+            # — with the pipelined exchange their sum exceeds
+            # shuffle_s exactly when overlap is working
+            # (shuffle.pipeline.overlapNs is the headline for how
+            # much)
+            shuf_map += n.extra.get("exchange.mapStages", 0) / 1e9
+            shuf_transfer += n.extra.get("exchange.transfer", 0) / 1e9
+            shuf_decode += n.extra.get("exchange.upload", 0) / 1e9
             if "Exchange" in n.name or "Shuffle" in n.name:
                 shuffle += n.time_ns / 1e9
             elif n.is_tpu:
@@ -239,6 +252,9 @@ def _breakdown(plan: Optional[ExecNodeProfile],
         # the exec node times, not a disjoint phase)
         "compile_s": _compile_attr_s(query_id, sections),
         "shuffle_s": shuffle,
+        "shuffle_map_s": shuf_map,
+        "shuffle_transfer_s": shuf_transfer,
+        "shuffle_decode_s": shuf_decode,
         "semaphore_wait_s": sem.get("semaphore.waitNs", 0) / 1e9,
         "spill_device_to_host_bytes":
             spill.get("spill.deviceToHostBytes", 0),

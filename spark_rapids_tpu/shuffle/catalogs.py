@@ -15,6 +15,7 @@ HBM under the same priority-ordered spill policy
 from __future__ import annotations
 
 import itertools
+import os
 import threading
 from dataclasses import dataclass
 from typing import Dict, List, Optional, Tuple
@@ -177,24 +178,129 @@ class ShuffleBufferCatalog:
 class ReceivedBuffer:
     temp_id: int
     table_meta: wire.TableMeta
-    data: bytes
+    data: Optional[bytes]
+    disk_path: Optional[str] = None   # pressure-spilled payload
 
 
 class ShuffleReceivedBufferCatalog:
     """Reducer-side catalog of fetched buffers awaiting materialization
     (ShuffleReceivedBufferCatalog.scala:119; temp ids TempSpillBufferId
-    :49)."""
+    :49).
+
+    Pressure-aware: the catalog registers with the admission
+    controller's memory-pressure hook (mem/spill.py), so in-flight
+    received payloads — the pipelined exchange can hold several
+    partitions' worth — spill host->disk under pressure instead of
+    stalling admission; ``materialize`` reads a spilled payload back
+    transparently.  Every add/release is counted
+    (``shuffle.received.added``/``released``), making leak audits a
+    registry diff instead of an internals spelunk."""
 
     def __init__(self):
         self._ids = itertools.count(1)
         self._received: Dict[int, ReceivedBuffer] = {}
         self._lock = threading.Lock()
+        # serializes whole pressure_spill passes against each other
+        # (two concurrent spillers would write and then orphan each
+        # other's files); never held by add/materialize, so frame
+        # intake keeps flowing while a spill writes
+        self._spill_mutex = threading.Lock()
+        self._spill_dir: Optional[str] = None
+        self.pending_bytes = 0
+        from spark_rapids_tpu.mem import spill as _spill
+        _spill.register_pressure_spiller(self)
 
     def add(self, table_meta: wire.TableMeta, data: bytes) -> int:
         with self._lock:
             tid = next(self._ids)
             self._received[tid] = ReceivedBuffer(tid, table_meta, data)
-            return tid
+            self.pending_bytes += len(data)
+        from spark_rapids_tpu.obs import registry as obsreg
+        obsreg.get_registry().inc("shuffle.received.added")
+        return tid
+
+    def pressure_spill(self, bytes_needed: int) -> int:
+        """Move pending received payloads host->disk until
+        ``bytes_needed`` host bytes are freed (oldest first — the
+        consumer drains in partition order, so the oldest pending
+        buffers are the furthest from consumption).
+
+        Disk writes happen OUTSIDE the catalog lock: ``add`` runs on
+        TCP reader threads as DATA frames complete, and blocking frame
+        intake for a multi-buffer write exactly when the system is
+        under pressure would invert the point.  A buffer that was
+        materialized/freed while its file was being written just has
+        the file discarded (the swap under the lock re-checks the
+        payload identity)."""
+        with self._lock:
+            # pending_bytes is the aggregate this fast path rides:
+            # handle_memory_pressure walks EVERY registered catalog
+            # on a pressured admission, and most have nothing pending
+            if self.pending_bytes <= 0:
+                return 0
+        with self._spill_mutex:
+            return self._pressure_spill_locked(bytes_needed)
+
+    def _pressure_spill_locked(self, bytes_needed: int) -> int:
+        import shutil
+        import tempfile
+        import weakref
+        freed = 0
+        with self._lock:
+            if self._spill_dir is None:
+                self._spill_dir = tempfile.mkdtemp(
+                    prefix="rapids_tpu_shuffle_recv_")
+                # the directory (and any payload files stranded by an
+                # error path) goes with the catalog — spilled buffers
+                # hold files only between pressure and consumption
+                self._spill_dir_finalizer = weakref.finalize(
+                    self, shutil.rmtree, self._spill_dir,
+                    ignore_errors=True)
+            spill_dir = self._spill_dir
+            victims = [(rb.temp_id, rb.data)
+                       for rb in self._received.values()
+                       if rb.data]
+        for tid, data in victims:
+            if freed >= bytes_needed:
+                break
+            path = os.path.join(spill_dir, f"recv_{tid}.bin")
+            with open(path, "wb") as f:
+                f.write(data)
+            with self._lock:
+                rb = self._received.get(tid)
+                if rb is not None and rb.data is data:
+                    rb.data = None
+                    rb.disk_path = path
+                    self.pending_bytes -= len(data)
+                    freed += len(data)
+                    continue
+            # consumed (or freed) while we wrote: drop the orphan file
+            try:
+                os.unlink(path)
+            except OSError:
+                pass
+        if freed:
+            from spark_rapids_tpu.obs import registry as obsreg
+            obsreg.get_registry().inc_many(
+                ("spill.events", 1),
+                ("shuffle.received.spilledBytes", freed))
+        return freed
+
+    @staticmethod
+    def _payload(rb: ReceivedBuffer) -> bytes:
+        if rb.data is not None:
+            return rb.data
+        with open(rb.disk_path, "rb") as f:
+            return f.read()
+
+    @staticmethod
+    def _drop_disk(rb: ReceivedBuffer) -> None:
+        if rb.disk_path is not None:
+            try:
+                os.unlink(rb.disk_path)
+            except OSError:
+                pass
+            rb.disk_path = None
 
     def materialize(self, temp_id: int) -> pa.Table:
         """Decode the received payload into a host table and drop it.
@@ -202,6 +308,13 @@ class ShuffleReceivedBufferCatalog:
         as the reference does (MetaUtils.scala:145)."""
         with self._lock:
             rb = self._received.pop(temp_id)
+            if rb.data is not None:
+                self.pending_bytes -= len(rb.data)
+        data = self._payload(rb)
+        self._drop_disk(rb)
+        rb.data = data
+        from spark_rapids_tpu.obs import registry as obsreg
+        obsreg.get_registry().inc("shuffle.received.released")
         if rb.table_meta.is_degenerate:
             if not rb.table_meta.columns and rb.table_meta.num_rows:
                 # pyarrow cannot represent a zero-column table with rows;
@@ -223,7 +336,13 @@ class ShuffleReceivedBufferCatalog:
         iterator's error path releases undelivered fetches so an aborted
         read doesn't leak catalog entries."""
         with self._lock:
-            self._received.pop(temp_id, None)
+            rb = self._received.pop(temp_id, None)
+            if rb is not None and rb.data is not None:
+                self.pending_bytes -= len(rb.data)
+        if rb is not None:
+            self._drop_disk(rb)
+            from spark_rapids_tpu.obs import registry as obsreg
+            obsreg.get_registry().inc("shuffle.received.released")
 
     @property
     def pending(self) -> int:

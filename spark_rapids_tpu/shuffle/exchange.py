@@ -30,6 +30,7 @@ TPU-first departures from the reference:
 from __future__ import annotations
 
 import itertools
+import threading
 from dataclasses import dataclass
 from typing import Any, Dict, Iterator, List, Optional, Sequence, Tuple
 
@@ -45,7 +46,8 @@ from spark_rapids_tpu.columnar.batch import (DeviceBatch, DeviceColumn,
                                              bucket_rows, concat_batches,
                                              from_arrow, to_arrow)
 from spark_rapids_tpu.exec import sortkeys
-from spark_rapids_tpu.exec.base import PhysicalPlan, TpuExec, timed
+from spark_rapids_tpu.exec.base import (PhysicalPlan, TpuExec, timed,
+                                        timed_extra)
 from spark_rapids_tpu.exec.cpu import concat_tables, _empty_table
 from spark_rapids_tpu.expr import eval_cpu, eval_tpu, ir
 from spark_rapids_tpu.expr.eval_tpu import ColVal
@@ -266,6 +268,155 @@ class ShuffleBlockStore:
         return out
 
 
+class ShuffleMapTaskError(Exception):
+    """A shipped map stage failed deterministically: the executor is
+    healthy and replied ``ok=False`` (task exception, unknown op).
+    Deliberately NOT a RuntimeError/OSError: the pipelined submit
+    ladder retries (and hard-kills + respawns) only on those transport
+    shapes — killing a healthy shared executor over a task bug would
+    wipe concurrent exchanges' map output for a failure a re-run
+    cannot fix — and the read side propagates this raw instead of
+    degrading to the CPU block store, exactly as the sequential
+    (depth=0) barrier path surfaces the same failure."""
+
+
+class _MapOutputTracker:
+    """Per-map completion book for the pipelined exchange (the
+    MapOutputTracker role at map-task granularity).
+
+    Submit threads report each ``(executor_id, map_id)`` the moment the
+    executor's ``map_done`` event lands (the blocks are already in its
+    catalog); reducers iterate :meth:`events` and fetch each completed
+    map's output immediately instead of barriering on the whole map
+    stage.  The completed list is append-only and deduplicated, so a
+    map-stage RE-RUN after an executor death re-announces the same pairs
+    harmlessly — readers key their fetched state by the pair.
+    """
+
+    def __init__(self):
+        self._cond = threading.Condition(threading.Lock())
+        self._completed: List[Tuple[str, int]] = []
+        self._seen = set()
+        self._open_execs = 0
+        self._failed: Optional[BaseException] = None
+
+    def open_exec(self) -> None:
+        with self._cond:
+            self._open_execs += 1
+
+    def map_done(self, executor_id: str, map_id: int) -> None:
+        with self._cond:
+            key = (executor_id, map_id)
+            if key not in self._seen:
+                self._seen.add(key)
+                self._completed.append(key)
+            self._cond.notify_all()
+
+    def exec_done(self, executor_id: str, map_ids) -> None:
+        """Final (authoritative) map list for one executor's stage —
+        covers a stage whose events were lost or a non-streaming
+        re-submit."""
+        with self._cond:
+            for m in map_ids:
+                key = (executor_id, m)
+                if key not in self._seen:
+                    self._seen.add(key)
+                    self._completed.append(key)
+            self._open_execs -= 1
+            self._cond.notify_all()
+
+    def fail(self, exc: BaseException) -> None:
+        """A submit thread died (task failure / respawn crash-loop):
+        readers must surface it instead of waiting out the timeout."""
+        with self._cond:
+            if self._failed is None:
+                self._failed = exc
+            self._open_execs -= 1
+            self._cond.notify_all()
+
+    @property
+    def open_execs(self) -> int:
+        """Map stages still in flight (submit thread neither finished
+        nor failed) — the read-side recovery ladder checks this before
+        degrading: a fetch that raced a mid-stage death should spend
+        its retry budget on the submit thread's in-flight re-run, not
+        prematurely fall back."""
+        with self._cond:
+            return self._open_execs
+
+    def batches(self, timeout_s: float, token=None):
+        """Yield LISTS of ``(executor_id, map_id)`` completions in
+        announce order — everything newly available per step, blocking
+        only when nothing is — until every opened executor's stage
+        finished.  Batching lets a reader fetch all of one executor's
+        already-completed maps in ONE do_fetch round trip (the
+        per-peer fetch pattern of the sequential path), paying per-map
+        round trips only for maps that genuinely trickle in.
+        ``timeout_s`` bounds the NO-PROGRESS wait (a wedged-but-alive
+        executor surfaces as a shuffle timeout, which escalates
+        through the standard recovery ladder); ``None`` waits
+        indefinitely (``pipeline.timeoutMs=0`` — dead executors still
+        surface through :meth:`fail`).  A fired CancelToken raises at
+        the next wait tick (the wait is chunked so cancellation lands
+        promptly)."""
+        import time as _time
+        from spark_rapids_tpu.shuffle.iterator import \
+            RapidsShuffleTimeoutException
+        i = 0
+        while True:
+            with self._cond:
+                # wall-clock no-progress deadline, re-stamped only per
+                # DELIVERED batch (each yield step re-enters here): a
+                # condition wakeup that brought no new completion —
+                # e.g. a crash-looping executor's re-run re-announcing
+                # already-seen map ids — must not push the bound out,
+                # or a genuinely wedged sibling stage never escalates
+                t0 = _time.monotonic()
+                while (i >= len(self._completed) and
+                       self._open_execs > 0 and self._failed is None):
+                    if token is not None and token.is_cancelled:
+                        token.check()
+                    self._cond.wait(timeout=0.1)
+                    if i < len(self._completed):
+                        break   # real progress: deliver it
+                    if timeout_s is not None and \
+                            _time.monotonic() - t0 >= timeout_s:
+                        raise RapidsShuffleTimeoutException(
+                            "pipelined shuffle: no map completion "
+                            f"for {timeout_s}s "
+                            f"({self._open_execs} stages open)")
+                if i < len(self._completed):
+                    batch = self._completed[i:]
+                    i = len(self._completed)
+                else:
+                    if self._failed is not None:
+                        exc = self._failed
+                        if isinstance(exc, (RuntimeError, OSError)) \
+                                and not isinstance(
+                                    exc, _cancel.QueryCancelledError):
+                            # transport-side map-stage loss that
+                            # exhausted the submit retry ladder:
+                            # surface as fetch-failed so the read
+                            # side's ONE recovery ladder
+                            # (fetch_with_recovery) owns it — re-run
+                            # anything recoverable, else degrade to
+                            # the CPU block store when cpuFallback
+                            # allows, matching the depth=0 path's
+                            # behavior for a lost executor.  Task
+                            # failures (ShuffleMapTaskError) and
+                            # cancellation stay raw: both must fail
+                            # the query exactly like the sequential
+                            # barrier path, never fall back.
+                            from spark_rapids_tpu.shuffle.iterator \
+                                import RapidsShuffleFetchFailedException
+                            raise RapidsShuffleFetchFailedException(
+                                "pipelined shuffle: map stage lost: "
+                                f"{exc}") from exc
+                        raise exc
+                    return
+            yield batch
+
+
 # ---------------------------------------------------------------------------
 # Execs
 # ---------------------------------------------------------------------------
@@ -340,7 +491,6 @@ class CpuShuffleExchangeExec(PhysicalPlan):
         raise NotImplementedError(type(p).__name__)
 
     def execute(self):
-        import threading
         n_parts = self.partitioning.num_partitions
         state = {"slices": None}
         lock = threading.Lock()
@@ -555,14 +705,19 @@ class TpuShuffleExchangeExec(TpuExec):
     _MANAGER_EXECUTORS = 2
 
     def run_map_stage(self, shuffle_id: int, catalog, n_execs: int,
-                      exec_idx: int) -> List[int]:
+                      exec_idx: int, on_map_done=None) -> List[int]:
         """Map side of this exchange inside ONE executor process
         (RapidsCachingWriter.write analog,
         RapidsShuffleInternalManager.scala:90-155): executes this
         executor's share of input partitions (map task = input partition,
         ``p % n_execs == exec_idx``), partitions each batch on device,
         and registers the slices in the executor-local catalog.  Returns
-        the completed map ids."""
+        the completed map ids.
+
+        ``on_map_done(map_id)`` fires after EACH map task's slices are
+        fully registered (the pipelined exchange's per-map completion
+        notification: reducers may start fetching that map id the moment
+        it fires, while later maps are still running)."""
         n_parts = self.partitioning.num_partitions
         its = self.children[0].execute()
         if isinstance(self.partitioning, RangePartitioning):
@@ -595,6 +750,8 @@ class TpuShuffleExchangeExec(TpuExec):
                             self._slice(reordered, off, c))
                     off += c
             maps.append(map_id)
+            if on_map_done is not None:
+                on_map_done(map_id)
         return maps
 
     _process_sids = itertools.count(1)
@@ -607,7 +764,6 @@ class TpuShuffleExchangeExec(TpuExec):
         machines.  A dead executor surfaces as fetch-failed and its map
         stage is re-run on a respawned executor (the Spark stage-retry
         semantics, RapidsShuffleIterator.scala:188)."""
-        import threading
         from spark_rapids_tpu.shuffle import faults
         from spark_rapids_tpu.shuffle.catalogs import \
             ShuffleReceivedBufferCatalog
@@ -627,6 +783,16 @@ class TpuShuffleExchangeExec(TpuExec):
         backoff_ms = float(self.conf_obj.get(
             cfg.SHUFFLE_FETCH_RETRY_BACKOFF_MS))
         cpu_fallback = bool(self.conf_obj.get(cfg.SHUFFLE_CPU_FALLBACK))
+        pipeline_depth = max(0, int(self.conf_obj.get(
+            cfg.SHUFFLE_PIPELINE_DEPTH)))
+        _pipeline_timeout_ms = float(self.conf_obj.get(
+            cfg.SHUFFLE_PIPELINE_TIMEOUT_MS))
+        # 0 = wait indefinitely, the sequential barrier's semantics: a
+        # DEAD executor still surfaces promptly (its submit thread
+        # fails the tracker); only a wedged-but-alive one waits — the
+        # same hang depth=0 has always had on its pipe reads
+        pipeline_timeout_s = None if _pipeline_timeout_ms <= 0 \
+            else max(1.0, _pipeline_timeout_ms / 1000.0)
         tcp_conf_extra = {
             "connect_timeout_ms": self.conf_obj.get(
                 cfg.SHUFFLE_CONNECT_TIMEOUT_MS),
@@ -637,28 +803,35 @@ class TpuShuffleExchangeExec(TpuExec):
             # square the connect attempts to a dead peer
             "connect_max_retries": 1 if max_retries > 0 else 0,
             "connect_backoff_ms": backoff_ms,
+            # compressed wire leg: the driver's clients negotiate the
+            # per-frame DATA codec in their HELLO; executor servers
+            # honor whatever the client announced (tcp.wire_codec)
+            "data_codec": self.codec_name,
         }
         faults.install_plan_from_conf(self.conf_obj)
         stats = faults.get_fault_stats()
+        # per-exchange recovery-stats attribution: every thread doing
+        # work for THIS exchange (submit threads, readers, pipeline
+        # thunks, the TCP reader threads of connections they dial)
+        # increments this scope alongside the process counters, so the
+        # stamped per-query view is exact even with concurrent
+        # exchanges in one process (the old snapshot-delta bled)
+        scope = faults.StatsScope()
         state = {"done": False, "sid": None, "pool": None,
                  "transport": None, "received": None, "maps": {},
                  "clients": {}, "reads_left": n_parts, "epoch": 0,
-                 "fb_store": None, "stats_base": stats.snapshot()}
+                 "fb_store": None}
         lock = threading.Lock()
         fb_lock = threading.Lock()  # guards only the fallback store
 
         def stamp_fault_stats() -> None:
-            """Per-query ShuffleFaultStats view: delta of the process
-            counters since this exchange started, into Metrics.extra
-            (the explain/metrics surface).  Known limit: exchanges
-            executing concurrently in one process share the counters,
-            so their deltas can include each other's recovery work —
-            localization, not accounting."""
-            snap = stats.snapshot()
-            base = state["stats_base"]
+            """Per-query ShuffleFaultStats view, attributed exactly:
+            the counts in this exchange's StatsScope (incremented by
+            its own threads and connections), into Metrics.extra (the
+            explain/metrics surface)."""
+            snap = scope.snapshot()
             for k in faults.ShuffleFaultStats.FIELDS:
-                self.metrics.extra[f"shuffle.{k}"] = \
-                    snap.get(k, 0) - base.get(k, 0)
+                self.metrics.extra[f"shuffle.{k}"] = snap.get(k, 0)
             if state.get("recover_error"):
                 self.metrics.extra["shuffle.recover_error"] = \
                     state["recover_error"]
@@ -721,9 +894,12 @@ class TpuShuffleExchangeExec(TpuExec):
                 return winner
             return c
 
-        def submit(pool, exec_idx: int, sid: int):
+        def submit(pool, exec_idx: int, sid: int, on_map=None):
             """Ship this exchange's map stage for executor ``exec_idx``;
-            returns completed map ids (raises on task failure)."""
+            returns completed map ids (raises on task failure).  With
+            ``on_map`` set, the task streams per-map completion events
+            and ``on_map(map_id)`` fires for each BEFORE the final
+            reply — the pipelined map/fetch overlap signal."""
             import time as _time
             from spark_rapids_tpu.obs import trace as obstrace
             h = pool.handle(exec_idx)
@@ -738,14 +914,26 @@ class TpuShuffleExchangeExec(TpuExec):
                 # by half a pipe round trip — microseconds, vs the
                 # multi-ms spans it places
                 clock_offset = h.clock_sync()
-            reply = h.call({"op": "map_stage", "exchange": self,
-                            "shuffle_id": sid, "n_execs": n_execs,
-                            "exec_idx": exec_idx, "trace": trace_on})
+            task = {"op": "map_stage", "exchange": self,
+                    "shuffle_id": sid, "n_execs": n_execs,
+                    "exec_idx": exec_idx, "trace": trace_on,
+                    "stream": on_map is not None}
+            if on_map is None:
+                reply = h.call(task)
+            else:
+                reply = h.call_stream(
+                    task, lambda ev: on_map(int(ev["map_id"]))
+                    if ev.get("event") == "map_done" else None)
             t_recv = _time.perf_counter_ns()
             if not reply.get("ok"):
-                raise RuntimeError(
-                    f"map stage on {h.executor_id} failed: "
-                    f"{reply.get('error')}\n{reply.get('traceback', '')}")
+                msg = (f"map stage on {h.executor_id} failed: "
+                       f"{reply.get('error')}\n"
+                       f"{reply.get('traceback', '')}")
+                if reply.get("transport"):
+                    # pipe/process death: retryable (the pipelined
+                    # ladder kills + respawns + re-runs on this shape)
+                    raise RuntimeError(msg)
+                raise ShuffleMapTaskError(msg)
             # executor-side Metrics come home with the map results and
             # merge into THIS driver-side tree by plan node id — without
             # this, everything timed/counted inside the shipped fragment
@@ -777,13 +965,32 @@ class TpuShuffleExchangeExec(TpuExec):
                               f"pid={reply.get('pid', '?')}")
             return h, reply["maps"]
 
+        def install_exchange_state(pool, sid, peers) -> None:
+            """The ONE state-setup block both launch modes share (the
+            sequential barrier and the pipelined start_maps must not
+            drift): received catalog, transport with the complete
+            address book, and the process_executors stamp — fleet
+            size, identically in both modes regardless of how many
+            executors end up owning map output.  Caller holds
+            ``lock``."""
+            state["sid"] = sid
+            state["pool"] = pool
+            state["received"] = ShuffleReceivedBufferCatalog()
+            state["transport"] = TcpShuffleTransport(
+                f"driver-{sid}",
+                dict(tcp_conf_extra, peers=peers, seed=sid))
+            self.metrics.extra["process_executors"] = n_execs
+
         def materialize():
+            """Sequential (depth=0) map-side barrier: every map stage
+            completes before any reducer fetches."""
             with lock:
                 if state["done"]:
                     return
                 pool = get_executor_pool(n_execs, nested_transport)
                 sid = next(self._process_sids)
-                with timed(self.metrics, "exchange.mapStages"):
+                with timed(self.metrics), \
+                        timed_extra(self.metrics, "exchange.mapStages"):
                     # map stages run concurrently across the fleet; each
                     # handle's pipe is independent; the submit threads
                     # inherit this query's CancelToken explicitly
@@ -792,7 +999,8 @@ class TpuShuffleExchangeExec(TpuExec):
 
                     def run(e):
                         try:
-                            with _cancel.install(tok):
+                            with _cancel.install(tok), \
+                                    faults.attribute_to(scope):
                                 results[e] = submit(pool, e, sid)
                         except BaseException as ex:
                             results[e] = ex
@@ -817,14 +1025,124 @@ class TpuShuffleExchangeExec(TpuExec):
                     # sequentially per executor index
                     for e in range(n_execs):
                         check_map_stage_faults(pool, e)
-                state["sid"] = sid
-                state["pool"] = pool
-                state["received"] = ShuffleReceivedBufferCatalog()
-                state["transport"] = TcpShuffleTransport(
-                    f"driver-{sid}",
-                    dict(tcp_conf_extra, peers=peers, seed=sid))
-                self.metrics.extra["process_executors"] = \
-                    len(state["maps"]) or n_execs
+                install_exchange_state(pool, sid, peers)
+                state["done"] = True
+
+        tracker = _MapOutputTracker()
+
+        def start_maps():
+            """Pipelined map-side launch: spawn the fleet, install the
+            address book (executor ports are known at spawn), and ship
+            every map stage WITHOUT joining — per-map completions flow
+            into the tracker, and reducers begin fetching a map id the
+            moment it lands.  Submit threads are daemons: a wedged
+            executor must not pin interpreter exit (the tracker's
+            no-progress timeout escalates the read side through the
+            standard recovery ladder instead)."""
+            with lock:
+                if state["done"]:
+                    return
+                pool = get_executor_pool(n_execs, nested_transport)
+                sid = next(self._process_sids)
+                # spawn all handles up front: the address book must be
+                # complete before any reducer dials a peer
+                for e in range(n_execs):
+                    pool.handle(e)
+                peers = pool.peers()
+                install_exchange_state(pool, sid, peers)
+                tok = _cancel.current()
+                import time as _time
+                map_t0 = _time.perf_counter_ns()
+                map_done_lock = threading.Lock()
+                map_remaining = [n_execs]
+
+                def mark_submit_done():
+                    # ONE fleet-wide map-stage wall (first launch ->
+                    # last submit out), stamped by the last thread:
+                    # the sequential path times its barrier as one
+                    # wall, and the profile's shuffle_map_s must stay
+                    # comparable across modes — per-thread sums would
+                    # inflate it ~n_execs-fold for concurrent stages.
+                    # Called strictly BEFORE the tracker event that
+                    # can release the last reader, so a finished
+                    # query's profile always carries the stamp.
+                    with map_done_lock:
+                        map_remaining[0] -= 1
+                        last = map_remaining[0] == 0
+                    if last:
+                        self.metrics.add_extra(
+                            "exchange.mapStages",
+                            _time.perf_counter_ns() - map_t0)
+
+                def run(e):
+                    eid = f"exec-{e}"
+                    try:
+                        with _cancel.install(tok), \
+                                faults.attribute_to(scope):
+                            run_attempts(e, eid)
+                    except BaseException as ex:
+                        mark_submit_done()
+                        tracker.fail(ex)
+
+                def run_attempts(e: int, eid: str) -> None:
+                    # A submit can die MID-map-stage here (the
+                    # sequential path can't: its kills land after the
+                    # join barrier) — a chaos kill or crash takes the
+                    # pipe down while maps are still streaming.  Retry
+                    # bounded like the read ladder: pool.handle()
+                    # respawns the executor (same id, fresh catalog,
+                    # NEW port) and the re-run re-registers every map —
+                    # the tracker dedupes re-announced ids, and readers
+                    # whose fetches raced the death retry through their
+                    # own ladder once add_peer repoints the address
+                    # book.  EVERY retry starts by hard-killing the
+                    # executor: the re-run is idempotent only against
+                    # a FRESH catalog (register_batch appends, never
+                    # dedupes — re-running into a surviving catalog
+                    # would duplicate the failed attempt's partial
+                    # registrations and silently double rows), and the
+                    # forced respawn's NEW port means readers racing
+                    # the window fail loudly on the stale address
+                    # instead of silently fetching from a half-empty
+                    # catalog.  An aliveness check can't replace this:
+                    # Popen.poll() reads stale None while the killing
+                    # thread holds the waitpid lock.  Cancellation is
+                    # never retried.
+                    last: Optional[BaseException] = None
+                    for _attempt in range(n_execs + 2):
+                        try:
+                            h, mids = submit(
+                                pool, e, sid,
+                                on_map=lambda m: tracker.map_done(
+                                    eid, m))
+                        except _cancel.QueryCancelledError:
+                            raise
+                        except (RuntimeError, OSError) as ex:
+                            last = ex
+                            self.metrics.add_extra(
+                                "shuffle.mapStageReruns", 1)
+                            try:
+                                pool.kill(e)
+                            except Exception:
+                                pass   # already gone
+                            continue
+                        with lock:
+                            if mids:
+                                state["maps"][h.executor_id] = \
+                                    (e, list(mids))
+                            # respawn = same executor id, new port
+                            state["transport"].add_peer(
+                                h.executor_id, "127.0.0.1", h.port)
+                        check_map_stage_faults(pool, e)
+                        mark_submit_done()
+                        tracker.exec_done(h.executor_id, mids)
+                        return
+                    raise last
+                for e in range(n_execs):
+                    tracker.open_exec()
+                    threading.Thread(target=run, args=(e,),
+                                     daemon=True,
+                                     name=f"shuffle-map-{e}").start()
                 state["done"] = True
 
         def recover(seen_epoch: int) -> bool:
@@ -903,7 +1221,19 @@ class TpuShuffleExchangeExec(TpuExec):
                 state["reads_left"] -= 1
                 if state["reads_left"] != 0:
                     return
-                # last reader out: free the executor-resident map output
+                pf = state.get("prefetcher")
+            # last reader out.  Drain the pipeline FIRST, outside the
+            # exchange lock (running thunks acquire it): abandoned
+            # partition iterators release without ever consuming, and
+            # tearing the transport down under a still-fetching
+            # background thunk would drive it through the whole
+            # recovery ladder (retries, map-stage re-runs, CPU-fallback
+            # recompute) for a result nobody reads — close() cancels
+            # pending thunks and waits out + cleans up running ones.
+            if pf is not None:
+                pf.close()
+            with lock:
+                # free the executor-resident map output
                 # (ShuffleManager.unregisterShuffle analog — the pool is
                 # a long-lived fleet, so blocks must not accumulate)
                 if state["pool"] is not None:
@@ -913,31 +1243,19 @@ class TpuShuffleExchangeExec(TpuExec):
                 if state["transport"] is not None:
                     state["transport"].shutdown()
 
-        def reader(pidx: int) -> Iterator[DeviceBatch]:
-            materialize()
-            tables = None
+        def fetch_with_recovery(pidx: int, attempt) -> List[pa.Table]:
+            """The ONE read-side recovery ladder — both the sequential
+            reader and the pipelined read_partition run their fetch
+            attempts through it, so the depth=0 oracle path and the
+            pipelined path cannot diverge: retry ``attempt()`` up to
+            ``n_execs + 2`` times, re-running dead executors' map
+            stages between attempts, then degrade to the CPU block
+            store (or raise the typed exceptions)."""
             for _attempt in range(n_execs + 2):
                 with lock:
-                    sid = state["sid"]
-                    recv = state["received"]
-                    maps = dict(state["maps"])
                     epoch = state["epoch"]
-                # clients dialed outside the lock (client_for locks only
-                # around its cache accesses)
-                remotes = [
-                    RemoteSource(eid, client_for(eid), list(mids),
-                                 refresh=lambda e=eid: client_for(e))
-                    for eid, (_ei, mids) in sorted(maps.items())]
-                if not remotes:
-                    tables = []
-                    break
-                it = RapidsShuffleIterator(
-                    sid, pidx, None, remotes, recv, timeout_s=30.0,
-                    max_retries=max_retries,
-                    retry_backoff_ms=backoff_ms)
                 try:
-                    tables = [t for t in it if t.num_rows]
-                    break
+                    return attempt()
                 except (RapidsShuffleFetchFailedException,
                         RapidsShuffleTimeoutException):
                     try:
@@ -949,39 +1267,207 @@ class TpuShuffleExchangeExec(TpuExec):
                         recovered = False
                         state["recover_error"] = (
                             f"{type(rec_exc).__name__}: {rec_exc}")
+                    if not recovered and tracker.open_execs > 0:
+                        # nothing recover() can re-run, but a submit
+                        # thread is STILL mid-ladder on this stage (a
+                        # mid-stage death races the readers before
+                        # state["maps"] carries the executor): its
+                        # kill+respawn+re-run will re-announce the
+                        # maps and repoint the address book — keep
+                        # the bounded read retries pointed at that
+                        # instead of prematurely degrading
+                        continue
                     if not recovered:
-                        # nothing dead: a real protocol failure — degrade
-                        # to the CPU block store instead of failing the
-                        # query (fall-back-to-Spark-shuffle contract)
+                        # nothing dead: a real protocol failure —
+                        # degrade to the CPU block store instead of
+                        # failing the query (fall-back-to-Spark-shuffle
+                        # contract)
                         if cpu_fallback:
-                            tables = [t for t in fallback_tables(pidx)
-                                      if t.num_rows]
-                            break
+                            return [t for t in fallback_tables(pidx)
+                                    if t.num_rows]
                         stamp_fault_stats()
                         raise
-            else:
-                # map-stage retries exhausted (crash-looping executor):
-                # CPU fallback if allowed, else surface the failure — an
-                # empty yield would silently drop rows
-                if cpu_fallback:
-                    tables = [t for t in fallback_tables(pidx)
-                              if t.num_rows]
-                else:
-                    stamp_fault_stats()
-                    raise RapidsShuffleFetchFailedException(
-                        f"shuffle {state['sid']} reduce {pidx}: map "
-                        f"stage retries exhausted after {n_execs + 2} "
-                        "attempts")
+            # map-stage retries exhausted (crash-looping executor):
+            # CPU fallback if allowed, else surface the failure — an
+            # empty yield would silently drop rows
+            if cpu_fallback:
+                return [t for t in fallback_tables(pidx)
+                        if t.num_rows]
+            stamp_fault_stats()
+            raise RapidsShuffleFetchFailedException(
+                f"shuffle {state['sid']} reduce {pidx}: map stage "
+                f"retries exhausted after {n_execs + 2} attempts")
+
+        def reader(pidx: int) -> Iterator[DeviceBatch]:
+            materialize()
+
+            def attempt() -> List[pa.Table]:
+                with lock:
+                    sid = state["sid"]
+                    recv = state["received"]
+                    maps = dict(state["maps"])
+                # clients dialed outside the lock (client_for locks
+                # only around its cache accesses)
+                remotes = [
+                    RemoteSource(eid, client_for(eid), list(mids),
+                                 refresh=lambda e=eid: client_for(e))
+                    for eid, (_ei, mids) in sorted(maps.items())]
+                if not remotes:
+                    return []
+                it = RapidsShuffleIterator(
+                    sid, pidx, None, remotes, recv, timeout_s=30.0,
+                    max_retries=max_retries,
+                    retry_backoff_ms=backoff_ms)
+                with timed_extra(self.metrics, "exchange.transfer"):
+                    return [t for t in it if t.num_rows]
+
+            with faults.attribute_to(scope):
+                tables = fetch_with_recovery(pidx, attempt)
             stamp_fault_stats()
             if not tables:
                 return
             t = concat_tables(tables, self.schema)
-            with timed(self.metrics, "exchange.upload"):
+            with timed(self.metrics), \
+                    timed_extra(self.metrics, "exchange.upload"):
                 b = from_arrow(t, self.min_bucket)
             self.metrics.num_output_rows += t.num_rows
             self.metrics.add_batches()
             yield b
 
+        # ------------------------------------------------------------------
+        # Pipelined read side (shuffle.pipeline.depth > 0): one bounded
+        # look-ahead stage fetches + decodes + uploads reduce partition
+        # k+1 while partition k is being consumed (the ScanPrefetcher
+        # shape), and each partition's fetch starts per map id as the
+        # tracker announces it — map compute, DCN transfer, and reduce-
+        # side decode overlap instead of paying three sequential walls.
+        # ------------------------------------------------------------------
+
+        def fetch_maps(eid: str, mids: List[int],
+                       pidx: int) -> List[pa.Table]:
+            """Fetch a batch of completed map tasks' blocks for
+            ``pidx`` from one executor through the standard per-peer
+            iterator state machine (all of PR 1's retry/cancel/
+            leak-free paths apply; one metadata + transfer round trip
+            covers the whole batch)."""
+            with lock:
+                sid = state["sid"]
+                recv = state["received"]
+            it = RapidsShuffleIterator(
+                sid, pidx, None,
+                [RemoteSource(eid, client_for(eid), list(mids),
+                              refresh=lambda: client_for(eid))],
+                recv, timeout_s=30.0, max_retries=max_retries,
+                retry_backoff_ms=backoff_ms)
+            return [t for t in it if t.num_rows]
+
+        def read_partition(pidx: int):
+            """Pipeline thunk body for one reduce partition: stream map
+            completions, fetch each map's output as it lands, then
+            decode + upload once and register the prepared batch with
+            the spill catalog (pressure-aware: the admission
+            controller's handle_memory_pressure can push prepared
+            partitions to host/disk instead of stalling admission).
+            Returns (spillable-or-plain handle, row count), or (None, 0)
+            for an empty partition."""
+            start_maps()
+            token = _cancel.current()
+            # per-executor accumulation: fetched map ids (dedup across
+            # retry attempts — the tracker replays announcements) and
+            # their tables in map-execution order.  A map task's blocks
+            # register in catalog order and one executor's map_done
+            # events announce in execution order, so per-eid table
+            # order is deterministic regardless of how the completions
+            # were batched into fetches.
+            fetched: Dict[str, set] = {}
+            got: Dict[str, List[pa.Table]] = {}
+
+            def attempt() -> List[pa.Table]:
+                for batch in tracker.batches(pipeline_timeout_s,
+                                             token=token):
+                    by_eid: Dict[str, List[int]] = {}
+                    for eid, mid in batch:
+                        if mid not in fetched.setdefault(eid, set()):
+                            by_eid.setdefault(eid, []).append(mid)
+                    for eid in sorted(by_eid):
+                        mids = sorted(by_eid[eid])
+                        # only the fetch itself is transfer wall;
+                        # waiting on the tracker is map-side time
+                        with timed_extra(self.metrics,
+                                         "exchange.transfer"):
+                            ts = fetch_maps(eid, mids, pidx)
+                        # mark fetched only on success: a failed group
+                        # fetch delivers nothing (the iterator's error
+                        # path frees partials) and retries whole
+                        fetched[eid].update(mids)
+                        got.setdefault(eid, []).extend(ts)
+                # deterministic assembly — executors sorted, each
+                # executor's stream in map-execution order — matching
+                # the per-peer registration order the sequential path
+                # fetches in, so depth=0 and pipelined results agree
+                return [t for eid in sorted(got) for t in got[eid]]
+
+            with faults.attribute_to(scope):
+                tables = fetch_with_recovery(pidx, attempt)
+            if not tables:
+                return (None, 0)
+            t = concat_tables(tables, self.schema)
+            with timed_extra(self.metrics, "exchange.upload"):
+                b = from_arrow(t, self.min_bucket)
+            # in-flight prepared partitions register at shuffle-input
+            # priority: under memory pressure they spill device->host->
+            # disk through the standard tiers instead of pinning HBM
+            # while the consumer is still partitions away
+            from spark_rapids_tpu.mem import spill as _spill
+            handle = _spill.register_or_hold(
+                b, priority=_spill.INPUT_FROM_SHUFFLE_PRIORITY)
+            return (handle, t.num_rows)
+
+        def _cleanup_prepared(res) -> None:
+            handle = res[0] if isinstance(res, tuple) else None
+            if handle is not None:
+                handle.close()
+
+        def pipelined_readers():
+            from spark_rapids_tpu.exec.scans import (
+                SHUFFLE_PIPELINE_KEYS, ScanPrefetcher)
+            prefetcher = ScanPrefetcher(
+                [lambda p=p: read_partition(p) for p in range(n_parts)],
+                depth=pipeline_depth, metrics=self.metrics,
+                cleanup=_cleanup_prepared,
+                labels=[f"reduce{p}" for p in range(n_parts)],
+                keys=SHUFFLE_PIPELINE_KEYS,
+                thread_name="shuffle-pipeline")
+            with lock:
+                # release() drains this before transport teardown, so
+                # abandoned readers can't strand a mid-fetch thunk
+                state["prefetcher"] = prefetcher
+
+            def piped_reader(pidx: int) -> Iterator[DeviceBatch]:
+                try:
+                    handle, nrows = prefetcher.get(pidx)
+                finally:
+                    prefetcher.part_done()
+                stamp_fault_stats()
+                if handle is None:
+                    return
+                try:
+                    with timed(self.metrics):
+                        b = handle.get()  # unspills if pressure moved
+                finally:
+                    # close() even when the unspill raises (HBM OOM /
+                    # disk-tier IO error): the catalog entry and any
+                    # disk payload must not stay pinned until GC
+                    handle.close()
+                self.metrics.num_output_rows += nrows
+                self.metrics.add_batches()
+                yield b
+
+            return [_ReleasingIter(piped_reader(p), release)
+                    for p in range(n_parts)]
+
+        if pipeline_depth > 0:
+            return pipelined_readers()
         return [_ReleasingIter(reader(p), release)
                 for p in range(n_parts)]
 
@@ -997,7 +1483,6 @@ class TpuShuffleExchangeExec(TpuExec):
         kernels (join probe, per-partition aggregate) execute distributed
         across the mesh.
         """
-        import threading
         from spark_rapids_tpu.shuffle import ici
         n_parts = self.partitioning.num_partitions
         state = {"done": False, "dev": None, "n_dev": 1,
@@ -1069,7 +1554,6 @@ class TpuShuffleExchangeExec(TpuExec):
             return self._execute_ici()
         if self.transport == "process":
             return self._execute_process()
-        import threading
         n_parts = self.partitioning.num_partitions
         state = {"done": False, "store": None, "dev_slices": None,
                  "mgr": None, "sid": None, "reads_left": n_parts}

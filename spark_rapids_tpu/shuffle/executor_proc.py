@@ -14,7 +14,11 @@ process boundaries.
 Protocol (stdin/stdout, binary): frame := u32 len, len pickle bytes.
 First frame OUT is the hello ``{"port": p, "pid": n}``.  Frames IN:
 ``{"op": "map_stage", ...}`` -> runs the exchange's map side against the
-local catalog, replies ``{"ok": True, "maps": [...]}``;
+local catalog, replies ``{"ok": True, "maps": [...]}``; with
+``"stream": True`` the reply is preceded by one
+``{"event": "map_done", "map_id": m}`` frame per completed map task
+(the pipelined exchange's per-map completion notifications — readers
+consume them via ``ExecutorHandle.call_stream``);
 ``{"op": "ping"}`` -> ``{"ok": True}``; ``{"op": "stop"}`` -> exits.
 """
 
@@ -46,9 +50,17 @@ def read_frame(stream: BinaryIO) -> Optional[dict]:
     return pickle.loads(payload)
 
 
-def _run_map_stage(task: dict, catalog, nested_transport: str) -> dict:
+def _run_map_stage(task: dict, catalog, nested_transport: str,
+                   notify=None) -> dict:
     """Execute the shipped exchange's map side for this executor's share
-    of input partitions, registering slices in the local catalog."""
+    of input partitions, registering slices in the local catalog.
+
+    With ``task["stream"]`` set (the pipelined exchange), ``notify`` is
+    called with a ``{"event": "map_done", "map_id": m}`` frame as each
+    map task's output lands in the catalog — BEFORE the final reply —
+    so the driver's reducers can start fetching that map's blocks while
+    later maps are still running (per-map completion notifications, the
+    map/fetch overlap leg)."""
     exch = task["exchange"]
     # cross-process trace stitching: when the driver traces, this
     # executor records its own span window for the stage and ships it
@@ -79,9 +91,14 @@ def _run_map_stage(task: dict, catalog, nested_transport: str) -> dict:
             n.transport = nested_transport
             nested.append(nested_transport)
     exch.foreach(_localize)
+    on_map_done = None
+    if task.get("stream") and notify is not None:
+        def on_map_done(map_id: int) -> None:
+            notify({"event": "map_done", "map_id": map_id})
     maps = exch.run_map_stage(
         shuffle_id=task["shuffle_id"], catalog=catalog,
-        n_execs=task["n_execs"], exec_idx=task["exec_idx"])
+        n_execs=task["n_execs"], exec_idx=task["exec_idx"],
+        on_map_done=on_map_done)
     # per-node Metrics accumulated while running this fragment go home
     # with the reply (keyed by pre-order node id) — the driver merges
     # them into its own tree so executor-side work is not dropped from
@@ -132,8 +149,9 @@ def main() -> None:
             break
         try:
             if msg["op"] == "map_stage":
-                write_frame(out, _run_map_stage(msg, catalog,
-                                                nested_transport))
+                write_frame(out, _run_map_stage(
+                    msg, catalog, nested_transport,
+                    notify=lambda ev: write_frame(out, ev)))
             elif msg["op"] == "unregister":
                 catalog.unregister_shuffle(msg["shuffle_id"])
                 write_frame(out, {"ok": True})

@@ -56,6 +56,7 @@ batch — identically on every run.
 
 from __future__ import annotations
 
+import contextlib
 import enum
 import random
 import re
@@ -64,6 +65,66 @@ from dataclasses import dataclass, field
 from typing import Dict, List, Optional
 
 from spark_rapids_tpu.obs import registry as _obsreg
+
+
+# ---------------------------------------------------------------------------
+# Per-exchange stats attribution (the stamp_fault_stats accounting fix)
+# ---------------------------------------------------------------------------
+
+class StatsScope:
+    """One exchange's private view of the recovery counters.
+
+    The process-wide :class:`ShuffleFaultStats` block is shared by every
+    exchange in the process, so a snapshot delta taken by one exchange
+    used to bleed in whatever recovery work CONCURRENT exchanges did in
+    the same window.  A scope fixes the attribution: every ``incr`` also
+    lands in the scope installed on the incrementing thread (via
+    :func:`attribute_to`), and the exchange stamps ITS scope's counts —
+    exact per-query recovery work, not a window over shared counters.
+
+    Threads that outlive the installing frame (the TCP client's reader
+    thread) capture the scope at connection build time and install it
+    themselves — see ``TcpClientConnection``."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._counts: Dict[str, int] = {}
+
+    def incr(self, name: str, n: int = 1) -> None:
+        with self._lock:
+            self._counts[name] = self._counts.get(name, 0) + n
+
+    def get(self, name: str) -> int:
+        with self._lock:
+            return self._counts.get(name, 0)
+
+    def snapshot(self) -> Dict[str, int]:
+        with self._lock:
+            return dict(self._counts)
+
+
+_scope_tls = threading.local()
+
+
+def current_scope() -> Optional[StatsScope]:
+    """The StatsScope installed on this thread, or None."""
+    return getattr(_scope_tls, "scope", None)
+
+
+@contextlib.contextmanager
+def attribute_to(scope: Optional[StatsScope]):
+    """Install ``scope`` as this thread's stats-attribution target for
+    the duration (nestable; None is a no-op passthrough that keeps any
+    outer scope in place)."""
+    if scope is None:
+        yield None
+        return
+    prev = getattr(_scope_tls, "scope", None)
+    _scope_tls.scope = scope
+    try:
+        yield scope
+    finally:
+        _scope_tls.scope = prev
 
 
 class FaultAction(enum.Enum):
@@ -114,6 +175,12 @@ class ShuffleFaultStats:
         # counters appear in per-query profiles next to the scan/spill/
         # semaphore channels (obs/registry.py)
         _obsreg.get_registry().inc(f"shuffle.{name}", n)
+        # and into the incrementing thread's attribution scope, so a
+        # per-exchange stats view is exact even with concurrent
+        # exchanges sharing this process block (see StatsScope)
+        scope = current_scope()
+        if scope is not None:
+            scope.incr(name, n)
 
     def get(self, name: str) -> int:
         with self._lock:

@@ -31,6 +31,10 @@ CODEC_ZSTD = 3
 _CODEC_NAMES = {CODEC_UNCOMPRESSED: "none", CODEC_COPY: "copy",
                 CODEC_LZ4: "lz4", CODEC_ZSTD: "zstd"}
 _CODEC_IDS = {v: k for k, v in _CODEC_NAMES.items()}
+# conf value "zlib" compresses only the TCP wire leg; blocks
+# serialize uncompressed (Arrow IPC has no zlib buffer compression),
+# so their metadata carries the uncompressed id
+_CODEC_IDS["zlib"] = CODEC_UNCOMPRESSED
 
 
 def codec_name(codec_id: int) -> str:

@@ -35,17 +35,39 @@ class ExecutorHandle:
 
     def call(self, msg: dict) -> dict:
         """One request/response over the pipe (serialized per handle)."""
+        return self.call_stream(msg, None)
+
+    def call_stream(self, msg: dict, on_event) -> dict:
+        """Request/response that also surfaces interleaved EVENT frames
+        (dicts carrying an ``"event"`` key) to ``on_event`` before the
+        final reply — the pipelined map stage streams one ``map_done``
+        event per completed map task this way.  ``on_event=None``
+        silently discards events, which keeps plain :meth:`call` safe
+        against a streaming reply."""
         with self._lock:
             if not self.alive:
-                return {"ok": False,
+                # "transport": the pipe/process is gone, not the task —
+                # the submit side kills + respawns and re-runs on this
+                # flag; its absence means the executor itself replied
+                # ok=False (a deterministic task failure, not retried)
+                return {"ok": False, "transport": True,
                         "error": f"executor {self.executor_id} is dead"}
             try:
                 write_frame(self.proc.stdin, msg)
-                reply = read_frame(self.proc.stdout)
+                while True:
+                    reply = read_frame(self.proc.stdout)
+                    if reply is None or "event" not in reply:
+                        break
+                    if on_event is not None:
+                        try:
+                            on_event(reply)
+                        except Exception:
+                            pass   # a consumer bug must not desync the pipe
             except (BrokenPipeError, OSError) as e:
-                return {"ok": False, "error": f"pipe: {e}"}
+                return {"ok": False, "transport": True,
+                        "error": f"pipe: {e}"}
             if reply is None:
-                return {"ok": False,
+                return {"ok": False, "transport": True,
                         "error": f"executor {self.executor_id} closed the "
                                  "pipe mid-request"}
             return reply

@@ -50,6 +50,16 @@ class ZstdCompressionCodec(TableCompressionCodec):
         return "zstd"
 
 
+class ZlibCompressionCodec(TableCompressionCodec):
+    """Accepted everywhere the conf reaches so ``codec=zlib`` (the
+    TCP wire leg's stdlib-only option) never crashes the block-store
+    or CPU-fallback paths — but Arrow IPC has no zlib buffer
+    compression, so blocks serialize uncompressed here; only the
+    per-frame DATA wire leg (tcp.wire_codec) actually deflates."""
+
+    name = "zlib"
+
+
 _CODECS: Dict[str, TableCompressionCodec] = {}
 
 
@@ -70,6 +80,7 @@ register_codec(CopyCompressionCodec())
 _CODECS["none"] = _CODECS["copy"]  # conf alias
 register_codec(Lz4CompressionCodec())
 register_codec(ZstdCompressionCodec())
+register_codec(ZlibCompressionCodec())
 
 
 def serialize_table(table: pa.Table, codec: TableCompressionCodec) -> bytes:

@@ -36,6 +36,7 @@ import socket
 import struct
 import threading
 import time
+import zlib
 from typing import Callable, Dict, List, Optional, Tuple
 
 from spark_rapids_tpu.shuffle import faults
@@ -48,6 +49,164 @@ from spark_rapids_tpu.shuffle.transport import (ClientConnection,
 
 _HELLO, _REQ, _RESP, _DATA, _ERR = 0, 1, 2, 3, 4
 _HDR = struct.Struct("<BQI")
+
+# ---------------------------------------------------------------------------
+# Per-frame DATA compression (the compressed DCN leg)
+#
+# Negotiated in the HELLO handshake: the client appends "\0<codec>" to
+# its executor id (announcing what it RESOLVED, so a degraded end
+# negotiates "zlib", never a name it can't decode natively); a server
+# that accepts the suffix wraps EVERY DATA payload to that peer as
+# u8 flag (0 raw / 1 compressed / 2 stdlib-zlib-fallback compressed),
+# u32 uncompressed_size, body  — so incompressible or empty frames
+# ride flag-0 with no size inflation beyond the 5-byte header, and
+# the length-prefixed frame layout itself is unchanged.  Flag 2 marks
+# frames from a SENDER whose own resolution degraded (it lacks the
+# negotiated codec): the receiver decodes them with stdlib zlib
+# regardless of what it negotiated, so availability drift between the
+# two processes can never silently poison the stream.  The codec runs
+# on the wire payload (the already-serialized Arrow IPC block
+# windows), shrinking the transfer leg on compressible columnar data.
+# ---------------------------------------------------------------------------
+
+_WIRE_WRAP = struct.Struct("<BI")
+_WIRE_RAW, _WIRE_COMPRESSED, _WIRE_FALLBACK = 0, 1, 2
+
+
+class WireCodec:
+    """One per-frame compression codec (name + compress/decompress)."""
+
+    def __init__(self, name: str, compress: Callable[[bytes], bytes],
+                 decompress: Callable[[bytes, int], bytes],
+                 fallback: bool = False):
+        self.name = name
+        self.compress = compress
+        self.decompress = decompress
+        # stdlib zlib standing in for another name: announced as
+        # "zlib" when this end negotiates, and marked on the wire
+        # (``_WIRE_FALLBACK``) when this end compresses — the peer
+        # must never assume the negotiated NAME's bitstream from an
+        # end whose resolution degraded (split-brain poisoning)
+        self.fallback = fallback
+
+
+def negotiated_name(codec: "WireCodec") -> str:
+    """The codec name this end should announce in its HELLO: a
+    degraded resolution negotiates the implementation it will actually
+    run ("zlib"), not the name it failed to load."""
+    return "zlib" if codec.fallback else codec.name
+
+
+def _zlib_codec(name: str) -> WireCodec:
+    return WireCodec(name, lambda b: zlib.compress(b, 1),
+                     lambda b, n: zlib.decompress(b),
+                     fallback=(name != "zlib"))
+
+
+def _make_wire_codec(name: str) -> WireCodec:
+    """lz4/zstd ride pyarrow's codecs (already shipping in the image for
+    IPC buffer compression); an unavailable codec degrades to the
+    stdlib zlib implementation — both ends of a connection resolve the
+    NAME through this same table, so the negotiated stream stays
+    self-consistent."""
+    if name == "zlib":
+        return _zlib_codec(name)
+    try:
+        import pyarrow as pa
+        if pa.Codec.is_available(name):
+            codec = pa.Codec(name)
+            return WireCodec(
+                name,
+                lambda b: codec.compress(b, asbytes=True),
+                lambda b, n: codec.decompress(b, decompressed_size=n,
+                                              asbytes=True))
+    except Exception:
+        pass
+    return _zlib_codec(name)
+
+
+_WIRE_CODECS: Dict[str, Optional[WireCodec]] = {}
+_WIRE_CODEC_LOCK = threading.Lock()
+_WIRE_CODEC_NAMES = ("lz4", "zstd", "zlib")
+
+
+def wire_codec(name: Optional[str]) -> Optional[WireCodec]:
+    """Resolve a codec name to a WireCodec; None/none/copy disable.
+    Only the spec'd names (lz4|zstd|zlib) ever compress — an
+    unrecognized name keeps the leg UNCOMPRESSED per the wire format
+    doc, never a silent substitution.  A known-but-unavailable codec
+    degrades to the stdlib zlib implementation, and the degrade is
+    NEVER silent on the wire: a degraded client announces "zlib" in
+    its HELLO (negotiated_name), and a degraded server marks every
+    frame it compresses with the fallback wrap flag — so availability
+    drift between the two processes cannot poison the stream."""
+    name = (name or "none").lower()
+    if name not in _WIRE_CODEC_NAMES:
+        return None
+    with _WIRE_CODEC_LOCK:
+        if name not in _WIRE_CODECS:
+            _WIRE_CODECS[name] = _make_wire_codec(name)
+        return _WIRE_CODECS[name]
+
+
+def encode_data_payload(payload: bytes,
+                        codec: Optional[WireCodec]) -> bytes:
+    """Wrap one DATA payload for a peer that negotiated a codec; a
+    None codec returns the payload untouched (legacy unwrapped leg)."""
+    if codec is None:
+        return payload
+    if payload:
+        comp = codec.compress(payload)
+        if len(comp) < len(payload):
+            # a degraded sender marks its frames: the receiver may
+            # hold the NATIVE codec for the negotiated name, and the
+            # fallback's zlib bitstream would poison it
+            flag = _WIRE_FALLBACK if codec.fallback \
+                else _WIRE_COMPRESSED
+            return _WIRE_WRAP.pack(flag, len(payload)) + comp
+    # empty or incompressible: stored raw, still wrapped so the
+    # receiver's framing stays deterministic
+    return _WIRE_WRAP.pack(_WIRE_RAW, len(payload)) + payload
+
+
+def decode_data_payload(payload: bytes, codec: Optional[WireCodec],
+                        peer: Optional[str] = None) -> bytes:
+    """Inverse of :func:`encode_data_payload`; raises
+    ShuffleTransportError on a malformed/corrupted wrapper (surfacing
+    as a retryable fetch failure, never silent garbage)."""
+    if codec is None:
+        return payload
+    if len(payload) < _WIRE_WRAP.size:
+        raise ShuffleTransportError(
+            f"short compressed DATA wrapper ({len(payload)} bytes)",
+            peer)
+    flag, usize = _WIRE_WRAP.unpack_from(payload, 0)
+    body = payload[_WIRE_WRAP.size:]
+    if flag == _WIRE_RAW:
+        if len(body) != usize:
+            raise ShuffleTransportError(
+                f"raw DATA wrapper size mismatch ({len(body)} != "
+                f"{usize})", peer)
+        return body
+    if flag not in (_WIRE_COMPRESSED, _WIRE_FALLBACK):
+        raise ShuffleTransportError(
+            f"unknown DATA wrapper flag {flag}", peer)
+    try:
+        if flag == _WIRE_FALLBACK:
+            # the SENDER's resolution degraded to stdlib zlib:
+            # decode with zlib no matter what this end resolved
+            out = zlib.decompress(body)
+        else:
+            out = codec.decompress(body, usize)
+    except Exception as e:
+        raise ShuffleTransportError(
+            f"DATA frame decompression failed ({codec.name}): {e}",
+            peer) from e
+    if len(out) != usize:
+        raise ShuffleTransportError(
+            f"decompressed DATA size mismatch ({len(out)} != {usize})",
+            peer)
+    return out
 
 
 class ShuffleTransportError(OSError):
@@ -135,7 +294,8 @@ class TcpClientConnection(ClientConnection):
     def __init__(self, local_executor_id: str, host: str, port: int,
                  peer_executor_id: Optional[str] = None,
                  connect_timeout_s: float = 30.0,
-                 read_timeout_s: Optional[float] = None):
+                 read_timeout_s: Optional[float] = None,
+                 data_codec: Optional[str] = None):
         self.local_executor_id = local_executor_id
         self.peer_executor_id = peer_executor_id
         self.channel = _TagChannel()
@@ -148,12 +308,30 @@ class TcpClientConnection(ClientConnection):
         self._req_lock = threading.Lock()
         self._next_req = 0
         self._closed = False
-        _send_frame(self._sock, _HELLO, 0,
-                    local_executor_id.encode(), self._wlock,
+        # per-frame DATA codec negotiation: announce the codec in the
+        # HELLO suffix; the server wraps every DATA payload back to us
+        # (see module header).  None keeps the legacy unwrapped leg.
+        self._data_codec = wire_codec(data_codec)
+        hello = local_executor_id
+        if self._data_codec is not None:
+            # a degraded resolution announces "zlib" — negotiating a
+            # name this end cannot actually decode natively would
+            # split-brain the stream if the server CAN (its native
+            # frames would hit our stdlib fallback)
+            hello += "\0" + negotiated_name(self._data_codec)
+        # per-exchange stats attribution: the reader is a daemon thread
+        # that outlives the dialing frame, so it carries the dialer's
+        # scope explicitly (faults.StatsScope)
+        self._stats_scope = faults.current_scope()
+        _send_frame(self._sock, _HELLO, 0, hello.encode(), self._wlock,
                     peer=peer_executor_id)
-        self._reader = threading.Thread(target=self._read_loop,
+        self._reader = threading.Thread(target=self._read_loop_scoped,
                                         daemon=True)
         self._reader.start()
+
+    def _read_loop_scoped(self) -> None:
+        with faults.attribute_to(self._stats_scope):
+            self._read_loop()
 
     def _has_pending(self) -> bool:
         with self._req_lock:
@@ -222,6 +400,27 @@ class TcpClientConnection(ClientConnection):
                     tx.complete(TransactionStatus.ERROR,
                                 error=payload.decode(errors="replace"))
             elif kind == _DATA:
+                if self._data_codec is not None:
+                    from spark_rapids_tpu.obs import registry as obsreg
+                    wire_len = len(payload)
+                    try:
+                        # fault injection (above) ran on the WIRE bytes,
+                        # so a CORRUPT event lands here as a decode
+                        # failure — a retryable fetch fault, not garbage
+                        payload = decode_data_payload(
+                            payload, self._data_codec,
+                            peer=self.peer_executor_id)
+                    except ShuffleTransportError as e:
+                        self._fail_all(f"bad DATA frame: {e}")
+                        self.close()
+                        return
+                    obsreg.get_registry().inc_many(
+                        ("shuffle.wire.wireBytes", wire_len),
+                        ("shuffle.wire.rawBytes", len(payload)),
+                        ("shuffle.wire.frames", 1),
+                        ("shuffle.wire.compressedFrames",
+                         1 if wire_len < len(payload) +
+                         _WIRE_WRAP.size else 0))
                 # post as a "send" into the rendezvous; a dummy tx
                 # carries the completion the channel requires
                 stx = Transaction(tag)
@@ -322,7 +521,9 @@ class TcpServerConnection(ServerConnection):
     def __init__(self, executor_id: str, port: int = 0):
         self.executor_id = executor_id
         self.handler: Optional[Callable] = None
-        self._peers: Dict[str, Tuple[socket.socket, threading.Lock]] = {}
+        # peer id -> (socket, write lock, negotiated DATA codec)
+        self._peers: Dict[str, Tuple[socket.socket, threading.Lock,
+                                     Optional[WireCodec]]] = {}
         self._peer_lock = threading.Lock()
         self._accepted: List[socket.socket] = []
         self._lsock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
@@ -361,9 +562,18 @@ class TcpServerConnection(ServerConnection):
                     return
                 kind, tag, payload = frame
                 if kind == _HELLO:
-                    peer_id = payload.decode()
+                    # "executor_id" or "executor_id\0codec": a codec
+                    # suffix negotiates per-frame DATA compression —
+                    # every DATA payload to this peer is then wrapped.
+                    # If OUR resolution of the announced name degrades
+                    # to the zlib fallback, the frames we compress are
+                    # flag-marked so the (possibly native) client
+                    # still decodes them correctly.
+                    text = payload.decode()
+                    peer_id, _, codec_name = text.partition("\0")
+                    codec = wire_codec(codec_name or None)
                     with self._peer_lock:
-                        self._peers[peer_id] = (sock, wlock)
+                        self._peers[peer_id] = (sock, wlock, codec)
                 elif kind == _REQ and self.handler is not None:
                     try:
                         resp_kind, resp = _RESP, self.handler(
@@ -403,7 +613,10 @@ class TcpServerConnection(ServerConnection):
             tx.complete(TransactionStatus.ERROR,
                         error=f"no connection from {peer_executor_id}")
             return tx
-        sock, wlock = peer
+        sock, wlock, codec = peer
+        raw_len = len(data)
+        if codec is not None:
+            data = encode_data_payload(data, codec)
         plan = faults.get_fault_plan()
         ev = plan.check("tcp.server.data") if plan else None
         if ev is not None:
@@ -424,6 +637,14 @@ class TcpServerConnection(ServerConnection):
         try:
             _send_frame(sock, _DATA, tag, data, wlock,
                         peer=peer_executor_id)
+            if codec is not None:
+                # counted only AFTER the frame actually hit the wire:
+                # dropped / failed sends must not inflate the
+                # serving-side savings audit
+                from spark_rapids_tpu.obs import registry as obsreg
+                obsreg.get_registry().inc_many(
+                    ("shuffle.wire.sentWireBytes", len(data)),
+                    ("shuffle.wire.sentRawBytes", raw_len))
             tx.complete(TransactionStatus.SUCCESS)
         except OSError as e:
             tx.complete(TransactionStatus.ERROR, error=str(e))
@@ -458,6 +679,10 @@ class TcpShuffleTransport(ShuffleTransport):
       * ``connect_max_retries`` (default 2) / ``connect_backoff_ms``
         (default 50): bounded reconnect with exponential backoff +
         deterministic jitter (``seed``, default 0)
+      * ``data_codec`` (default "none"): per-frame DATA compression
+        codec this transport's clients negotiate in their HELLO
+        (lz4 | zstd | zlib); the serving side wraps every DATA payload
+        to a negotiating peer — see the module-header wrap layout
     """
 
     def __init__(self, executor_id: str, conf=None):
@@ -474,10 +699,19 @@ class TcpShuffleTransport(ShuffleTransport):
         self._connect_retries = int(get("connect_max_retries", 2) or 0)
         self._backoff_s = float(
             get("connect_backoff_ms", 50) or 50) / 1000.0
+        # per-frame DATA codec this transport's clients negotiate in
+        # their HELLO ("none" disables; see wire_codec)
+        self._data_codec = str(get("data_codec", "none") or "none")
         self._rng = random.Random(int(get("seed", 0) or 0))
         self._server: Optional[TcpServerConnection] = None
         self._clients: Dict[str, TcpClientConnection] = {}
         self._clients_lock = threading.Lock()
+        self._dial_locks: Dict[str, threading.Lock] = {}
+        # peer -> (monotonic stamp, error) of the most recent failed
+        # dial: waiters that were already queued behind the dial lock
+        # when it failed share the outcome instead of each paying the
+        # full connect ladder against the same dead address
+        self._dial_failures: Dict[str, Tuple[float, str]] = {}
 
     def add_peer(self, executor_id: str, host: str, port: int) -> None:
         self._peers[executor_id] = (host, port)
@@ -506,7 +740,8 @@ class TcpShuffleTransport(ShuffleTransport):
                     self.executor_id, host, port,
                     peer_executor_id=peer_executor_id,
                     connect_timeout_s=self._connect_timeout_s,
-                    read_timeout_s=self._read_timeout_s or None)
+                    read_timeout_s=self._read_timeout_s or None,
+                    data_codec=self._data_codec)
             except OSError as e:
                 last = e
         raise ShuffleTransportError(
@@ -515,33 +750,65 @@ class TcpShuffleTransport(ShuffleTransport):
             peer_executor_id)
 
     def make_client(self, peer_executor_id: str) -> ClientConnection:
+        # Dials to the SAME peer are serialized by a per-peer lock: two
+        # threads racing make_client would otherwise both connect, and
+        # closing the losing socket is NOT harmless — the server keys
+        # its DATA routing by the client's executor id, so the loser's
+        # HELLO clobbers the winner's peer entry and the loser's close
+        # then drops the entry entirely, leaving every subsequent DATA
+        # frame unroutable (a silent fetch stall until the read
+        # watchdog).  Exactly one live connection per (local, peer)
+        # pair may ever exist.  Dials to DIFFERENT peers still run
+        # concurrently — a dead peer's connect timeouts serialize only
+        # its own callers, never the fleet.
+        t_enter = time.monotonic()
         with self._clients_lock:
             cached = self._clients.get(peer_executor_id)
-            if cached is not None:
-                if not cached.closed:
-                    return cached
-                # dead connection (peer restarted / network drop):
-                # reconnect to the current address book entry
-                cached.close()
-                del self._clients[peer_executor_id]
-                faults.get_fault_stats().incr("reconnects")
-        if peer_executor_id not in self._peers:
-            raise KeyError(f"unknown peer {peer_executor_id}; "
-                           f"add_peer() or conf['peers'] required")
-        host, port = self._peers[peer_executor_id]
-        try:
-            # dialing (timeouts + backoff sleeps) happens unlocked
-            c = self._connect(peer_executor_id, host, port)
-        except OSError as e:
-            # do NOT cache: the next make_client retries the connect
-            return _DeadClientConnection(str(e))
-        with self._clients_lock:
-            cur = self._clients.get(peer_executor_id)
-            if cur is not None and not cur.closed:
-                c.close()  # concurrent dial won: don't leak the loser
-                return cur
-            self._clients[peer_executor_id] = c
-        return c
+            if cached is not None and not cached.closed:
+                return cached
+            dial_lock = self._dial_locks.setdefault(
+                peer_executor_id, threading.Lock())
+        with dial_lock:
+            with self._clients_lock:
+                cached = self._clients.get(peer_executor_id)
+                if cached is not None:
+                    if not cached.closed:
+                        return cached  # a queued dialer's work arrived
+                    # dead connection (peer restarted / network drop):
+                    # reconnect to the current address book entry
+                    cached.close()
+                    del self._clients[peer_executor_id]
+                    faults.get_fault_stats().incr("reconnects")
+                failed = self._dial_failures.get(peer_executor_id)
+                if failed is not None and failed[0] > t_enter:
+                    # a dial that ran WHILE we queued just failed:
+                    # share its outcome rather than stacking another
+                    # full connect ladder behind the same dead
+                    # address (k waiters would otherwise serialize
+                    # k timeouts).  Callers entering AFTER the
+                    # failure — e.g. retries following an add_peer
+                    # repoint — dial fresh.
+                    return _DeadClientConnection(failed[1])
+            if peer_executor_id not in self._peers:
+                raise KeyError(f"unknown peer {peer_executor_id}; "
+                               f"add_peer() or conf['peers'] required")
+            host, port = self._peers[peer_executor_id]
+            try:
+                # dialing (timeouts + backoff sleeps) happens outside
+                # the cache lock; the per-peer dial lock holds
+                c = self._connect(peer_executor_id, host, port)
+            except OSError as e:
+                # do NOT cache a dead connection: the next make_client
+                # retries the connect — but stamp the failure so
+                # already-queued waiters share it (above)
+                with self._clients_lock:
+                    self._dial_failures[peer_executor_id] = (
+                        time.monotonic(), str(e))
+                return _DeadClientConnection(str(e))
+            with self._clients_lock:
+                self._clients[peer_executor_id] = c
+                self._dial_failures.pop(peer_executor_id, None)
+            return c
 
     def server(self) -> TcpServerConnection:
         if self._server is None:

@@ -1,0 +1,677 @@
+"""Pipelined shuffle data plane: map/fetch overlap, transfer/decode
+overlap, compressed wire legs, pressure-aware buffering.
+
+The exchange's pipelined read side (``shuffle.pipeline.depth > 0``)
+must be indistinguishable from the sequential barrier exchange in
+RESULTS while overlapping the three walls in TIME — so every scenario
+here runs the pipelined path explicitly pinned on and asserts parity
+against either the sequential path or a fault-free run: the PR 1
+fault-acceptance ladder (DATA-frame drop mid-pipeline, executor kill
+while later maps are still running, CPU fallback), cancellation
+mid-pipeline (no leaked received-catalog buffers), per-frame wire
+compression round trips including the incompressible/empty edges, and
+the make_client dial race whose losing socket used to clobber the
+server's DATA routing.
+"""
+
+import threading
+import time
+
+import numpy as np
+import pyarrow as pa
+import pytest
+
+from spark_rapids_tpu import TpuSparkSession, functions as F
+from spark_rapids_tpu.obs import registry as obsreg
+from spark_rapids_tpu.shuffle import faults
+from spark_rapids_tpu.shuffle.tcp import (ShuffleTransportError,
+                                          TcpShuffleTransport,
+                                          decode_data_payload,
+                                          encode_data_payload,
+                                          wire_codec)
+from tests.parity import assert_tables_equal
+
+pytestmark = pytest.mark.faults
+
+
+@pytest.fixture(autouse=True)
+def _clean_state():
+    obsreg.reset_registry()
+    faults.set_fault_plan(None)
+    faults.reset_fault_stats()
+    yield
+    obsreg.reset_registry()
+    faults.set_fault_plan(None)
+    faults.reset_fault_stats()
+
+
+@pytest.fixture(scope="module", autouse=True)
+def _proc_pool_teardown():
+    yield
+    from spark_rapids_tpu.shuffle import procpool
+    procpool.reset_executor_pool()
+
+
+_BASE_CONF = {
+    "spark.rapids.tpu.shuffle.transport": "process",
+    "spark.rapids.tpu.shuffle.transport.processExecutors": 2,
+    "spark.rapids.tpu.sql.shuffle.partitions": 3,
+    "spark.rapids.tpu.shuffle.readTimeoutMs": 400,
+    "spark.rapids.tpu.shuffle.fetch.maxRetries": 2,
+    "spark.rapids.tpu.shuffle.fetch.retryBackoffMs": 20,
+    "spark.rapids.tpu.shuffle.connectTimeoutMs": 2000,
+}
+
+
+def _conf(depth=2, codec="none", **extra):
+    c = dict(_BASE_CONF)
+    c["spark.rapids.tpu.shuffle.pipeline.depth"] = depth
+    c["spark.rapids.tpu.shuffle.compression.codec"] = codec
+    c.update(extra)
+    return c
+
+
+def _data(n=3000, seed=31):
+    rng = np.random.default_rng(seed)
+    return pa.table({
+        "k": pa.array(rng.integers(0, 11, n).astype(np.int64)),
+        "v": pa.array(rng.integers(0, 1000, n).astype(np.int64)),
+    })
+
+
+def _agg(s, t):
+    return (s.create_dataframe(t, num_partitions=3)
+            .group_by("k")
+            .agg(F.count("*").alias("c"), F.sum("v").alias("sv"))
+            .sort("k"))
+
+
+# ---------------------------------------------------------------------------
+# wire codec units: wrap layout, incompressible/empty edges, corruption
+# ---------------------------------------------------------------------------
+
+@pytest.mark.parametrize("name", ["lz4", "zstd", "zlib"])
+def test_wire_codec_roundtrip(name):
+    codec = wire_codec(name)
+    assert codec is not None and codec.name == name
+    payload = b"columnar-run " * 4096
+    wrapped = encode_data_payload(payload, codec)
+    assert len(wrapped) < len(payload)         # compressible: shrinks
+    assert decode_data_payload(wrapped, codec) == payload
+
+
+@pytest.mark.parametrize("name", ["lz4", "zstd", "zlib"])
+def test_wire_codec_incompressible_stored_raw(name):
+    codec = wire_codec(name)
+    rng = np.random.default_rng(7)
+    payload = rng.integers(0, 256, 8192, dtype=np.uint8).tobytes()
+    wrapped = encode_data_payload(payload, codec)
+    # random bytes don't compress: stored raw, only the 5-byte wrapper
+    assert len(wrapped) == len(payload) + 5
+    assert wrapped[0] == 0                      # _WIRE_RAW flag
+    assert decode_data_payload(wrapped, codec) == payload
+
+
+def test_wire_codec_empty_frame():
+    codec = wire_codec("lz4")
+    wrapped = encode_data_payload(b"", codec)
+    assert len(wrapped) == 5                    # header-only wrapper
+    assert decode_data_payload(wrapped, codec) == b""
+
+
+def test_wire_codec_none_is_passthrough():
+    assert wire_codec(None) is None
+    assert wire_codec("none") is None
+    payload = b"untouched"
+    assert encode_data_payload(payload, None) is payload
+    assert decode_data_payload(payload, None) is payload
+
+
+def test_wire_codec_unknown_name_stays_uncompressed():
+    """An unrecognized codec name keeps the leg UNCOMPRESSED (the
+    wire-format spec), never a silent zlib substitution — a typo'd
+    conf must not change the wire format behind the user's back."""
+    assert wire_codec("lz-4") is None
+    assert wire_codec("snappy") is None
+    assert wire_codec("LZ4") is not None      # case-folded known name
+
+
+def test_wire_codec_corruption_raises_typed():
+    codec = wire_codec("lz4")
+    wrapped = bytearray(encode_data_payload(b"abc " * 1000, codec))
+    wrapped[10] ^= 0xFF
+    with pytest.raises(ShuffleTransportError):
+        decode_data_payload(bytes(wrapped), codec, peer="exec-X")
+    with pytest.raises(ShuffleTransportError):
+        decode_data_payload(b"\x07", codec)     # short wrapper
+    with pytest.raises(ShuffleTransportError):
+        decode_data_payload(b"\x09\x00\x00\x00\x00", codec)  # bad flag
+
+
+# ---------------------------------------------------------------------------
+# pipelined vs sequential parity, overlap, compressed wire savings
+# ---------------------------------------------------------------------------
+
+def test_pipelined_matches_sequential_bit_identical():
+    t = _data()
+    seq = _agg(TpuSparkSession(_conf(depth=0)), t).collect()
+    piped = _agg(TpuSparkSession(_conf(depth=2)), t).collect()
+    assert piped.equals(seq)                    # bit-identical
+    stats = faults.get_fault_stats()
+    assert stats.get("retries") == 0            # clean pipeline run
+    assert stats.get("timeouts") == 0
+
+
+def test_pipelined_overlap_observed():
+    t = _data(seed=32)
+    _agg(TpuSparkSession(_conf(depth=2)), t).collect()
+    reg = obsreg.get_registry()
+    assert reg.counter("shuffle.pipeline.overlapNs") > 0
+    # every received payload was consumed or freed — leak audit
+    assert reg.counter("shuffle.received.added") == \
+        reg.counter("shuffle.received.released")
+
+
+def test_compressed_wire_leg_parity_and_savings():
+    t = _data(seed=33)
+    plain = _agg(TpuSparkSession(_conf(depth=2, codec="none")), t) \
+        .collect()
+    obsreg.reset_registry()
+    lz4 = _agg(TpuSparkSession(_conf(depth=2, codec="lz4")), t).collect()
+    assert lz4.equals(plain)
+    reg = obsreg.get_registry()
+    # integer columns from a small domain compress: the wire leg shrank
+    assert 0 < reg.counter("shuffle.wire.wireBytes") < \
+        reg.counter("shuffle.wire.rawBytes")
+    assert reg.counter("shuffle.wire.frames") > 0
+    # a fault-free compressed run must not stall or retry (regression:
+    # the dial race's clobbered DATA routing surfaced as exactly this)
+    stats = faults.get_fault_stats()
+    assert stats.get("retries") == 0
+    assert stats.get("timeouts") == 0
+
+
+def test_profile_shuffle_wall_split():
+    s = TpuSparkSession(_conf(depth=2))
+    _agg(s, _data(seed=34)).collect()
+    prof = s.last_query_profile()
+    wb = prof.wall_breakdown
+    for key in ("shuffle_map_s", "shuffle_transfer_s",
+                "shuffle_decode_s"):
+        assert key in wb                        # always present
+    assert wb["shuffle_map_s"] > 0
+
+
+# ---------------------------------------------------------------------------
+# PR 1 fault-acceptance ladder on the pipelined path
+# ---------------------------------------------------------------------------
+
+def test_data_frame_drop_mid_pipeline_recovers():
+    t = _data(seed=35)
+    healthy = _agg(TpuSparkSession(_conf(depth=2)), t).collect()
+    faults.reset_fault_stats()
+    faults.set_fault_plan(faults.FaultPlan.parse(
+        "seed=41;tcp.client.data:drop@2"))
+    got = _agg(TpuSparkSession(_conf(depth=2)), t).collect()
+    assert_tables_equal(healthy, got, ignore_order=True)
+    stats = faults.get_fault_stats()
+    assert stats.get("injected_faults") == 1
+    assert stats.get("retries") >= 1
+
+
+def test_executor_kill_during_map_stage_pipelined():
+    """KILL executor 1 at the first map-stage consultation: in the
+    pipelined launch there is no join barrier, so the kill can land
+    while that executor's own maps are still streaming — the submit
+    thread's bounded re-run ladder (respawn, re-register, re-announce)
+    or the reader-side recover() must deliver identical results either
+    way."""
+    t = _data(seed=36)
+    healthy = _agg(TpuSparkSession(_conf(depth=2)), t).collect()
+    faults.reset_fault_stats()
+    faults.set_fault_plan(faults.FaultPlan.parse(
+        "seed=42;procpool.map_stage:kill@1:i1"))
+    got = _agg(TpuSparkSession(_conf(depth=2)), t).collect()
+    assert_tables_equal(healthy, got, ignore_order=True)
+    assert faults.get_fault_stats().get("injected_faults") == 1
+
+
+def test_cpu_fallback_pipelined_matches():
+    """Every DATA frame dropped: nothing is dead so recovery can't
+    help, and the PIPELINED exchange must degrade to the CPU block
+    store with correct results, exactly like the sequential path."""
+    t = _data(seed=37)
+    cpu = _agg(TpuSparkSession(
+        {"spark.rapids.tpu.sql.enabled": False}), t).collect()
+    faults.set_fault_plan(faults.FaultPlan.parse(
+        "seed=43;tcp.client.data:drop@1:x100000"))
+    s = TpuSparkSession(_conf(
+        depth=2,
+        **{"spark.rapids.tpu.shuffle.readTimeoutMs": 150,
+           "spark.rapids.tpu.shuffle.fetch.maxRetries": 1}))
+    got = _agg(s, t).collect()
+    assert_tables_equal(cpu, got, ignore_order=True)
+    assert faults.get_fault_stats().get("fallbacks") >= 1
+
+
+def test_cancel_mid_pipeline_leak_free():
+    """Service-level cancel while pipelined fetches crawl under a
+    DELAY plan: the prefetcher drains, no received-catalog buffers
+    leak, no admission slots leak, and the session stays usable."""
+    from spark_rapids_tpu.sched.cancel import QueryCancelledError
+    from spark_rapids_tpu.sched.service import QueryState
+
+    faults.set_fault_plan(faults.FaultPlan.parse(
+        "seed=44;tcp.server.data:delay@1:d300:x10000"))
+    s = TpuSparkSession(_conf(
+        depth=2,
+        **{"spark.rapids.tpu.shuffle.fetch.maxRetries": 50,
+           "spark.rapids.tpu.shuffle.fetch.retryBackoffMs": 100}))
+    fut = _agg(s, _data(n=4000, seed=38)).collect_async()
+    reg = obsreg.get_registry()
+    deadline = time.time() + 60
+    while (reg.counter("shuffle.fetchFrames") == 0 and
+           not fut.done() and time.time() < deadline):
+        time.sleep(0.05)
+    fut.cancel("mid-pipeline cancel")
+    with pytest.raises(QueryCancelledError):
+        fut.result(timeout=90)
+    assert fut.state is QueryState.CANCELLED
+    # unwind settles asynchronously (prefetcher threads + iterator
+    # error paths); then every added received buffer must be released
+    deadline = time.time() + 30
+    while (reg.counter("shuffle.received.added") !=
+           reg.counter("shuffle.received.released") and
+           time.time() < deadline):
+        time.sleep(0.05)
+    assert reg.counter("shuffle.received.added") == \
+        reg.counter("shuffle.received.released")
+    stats = s.scheduler.controller.stats()
+    assert stats["running"] == 0 and stats["queued"] == 0
+    # the engine still answers after the plan is lifted
+    faults.set_fault_plan(None)
+    again = _agg(s, _data(n=500, seed=39)).collect()
+    assert again.num_rows > 0
+
+
+# ---------------------------------------------------------------------------
+# dial race regression + scoped stats attribution
+# ---------------------------------------------------------------------------
+
+def test_make_client_dial_race_single_connection():
+    """Concurrent make_client to one peer must produce exactly ONE
+    connection: the losing socket of the old race closed AFTER its
+    HELLO clobbered the server's peer entry, leaving DATA frames
+    unroutable (a silent stall until the read watchdog)."""
+    from spark_rapids_tpu.shuffle.tcp import TcpServerConnection
+
+    server = TcpServerConnection("exec-race", port=0)
+    try:
+        tr = TcpShuffleTransport("driver-race", {
+            "peers": {"exec-race": ("127.0.0.1", server.port)},
+        })
+        results, errs = [], []
+        barrier = threading.Barrier(8)
+
+        def dial():
+            try:
+                barrier.wait()
+                results.append(tr.make_client("exec-race"))
+            except Exception as e:                # pragma: no cover
+                errs.append(e)
+
+        ts = [threading.Thread(target=dial) for _ in range(8)]
+        for th in ts:
+            th.start()
+        for th in ts:
+            th.join(10)
+        assert not errs
+        assert len(results) == 8
+        assert all(c is results[0] for c in results)  # one connection
+        # the server routes DATA to exactly one live peer socket
+        deadline = time.time() + 5
+        while len(server._peers) != 1 and time.time() < deadline:
+            time.sleep(0.02)
+        assert len(server._peers) == 1
+        got = []
+        results[0].receive(777, 5, got.append)
+        tx = server.send("driver-race", 777, b"hello", None)
+        tx.wait(5.0)
+        deadline = time.time() + 5
+        while not got and time.time() < deadline:
+            time.sleep(0.02)
+        assert got and got[0].status.name == "SUCCESS"
+        tr.shutdown()
+    finally:
+        server.close()
+
+
+def test_stats_scope_attribution_is_exact():
+    """Two exchanges' recovery work in one process lands in each
+    exchange's OWN scope: the old snapshot-delta bled concurrent
+    neighbours' counters into every stamp."""
+    stats = faults.get_fault_stats()
+    s1, s2 = faults.StatsScope(), faults.StatsScope()
+    start = threading.Barrier(2)
+
+    def work(scope, n):
+        with faults.attribute_to(scope):
+            start.wait()
+            for _ in range(n):
+                stats.incr("retries")
+
+    t1 = threading.Thread(target=work, args=(s1, 100))
+    t2 = threading.Thread(target=work, args=(s2, 250))
+    t1.start(), t2.start()
+    t1.join(), t2.join()
+    assert s1.get("retries") == 100              # exact, no bleed
+    assert s2.get("retries") == 250
+    assert stats.get("retries") == 350           # process block: both
+    # nesting: inner scope captures, outer restored after
+    with faults.attribute_to(s1):
+        with faults.attribute_to(s2):
+            stats.incr("timeouts")
+        assert faults.current_scope() is s1
+    assert s2.get("timeouts") == 1 and s1.get("timeouts") == 0
+    # None is a passthrough that keeps the outer scope installed
+    with faults.attribute_to(s1):
+        with faults.attribute_to(None):
+            assert faults.current_scope() is s1
+
+
+# ---------------------------------------------------------------------------
+# pressure-aware received-buffer spill
+# ---------------------------------------------------------------------------
+
+def test_received_catalog_pressure_spill_roundtrip(tmp_path):
+    import os
+    from spark_rapids_tpu.shuffle.catalogs import (
+        ShuffleReceivedBufferCatalog, build_table_meta)
+    from spark_rapids_tpu.shuffle.serializer import (get_codec,
+                                                     serialize_table)
+
+    recv = ShuffleReceivedBufferCatalog()
+    tables = [pa.table({"v": pa.array(np.arange(i, i + 500))})
+              for i in range(3)]
+    codec = get_codec("none")
+    tids = []
+    for i, t in enumerate(tables):
+        payload = serialize_table(t, codec)
+        tids.append(recv.add(
+            build_table_meta(i + 1, t.num_rows, t, len(payload)),
+            payload))
+    before = recv.pending_bytes
+    assert before > 0
+    freed = recv.pressure_spill(before)          # push everything out
+    assert freed == before and recv.pending_bytes == 0
+    spilled = [rb.disk_path for rb in recv._received.values()]
+    assert all(p is not None and os.path.exists(p) for p in spilled)
+    # materialize reads back transparently and cleans the disk payload
+    for tid, t in zip(tids, tables):
+        assert recv.materialize(tid).equals(t)
+    assert all(not os.path.exists(p) for p in spilled)
+    assert recv.pending == 0
+
+
+def test_memory_pressure_hook_reaches_received_buffers():
+    """The admission controller's handle_memory_pressure drains the
+    registered received-buffer catalogs when the device tier alone
+    can't cover the request."""
+    from spark_rapids_tpu.mem import spill
+    from spark_rapids_tpu.shuffle.catalogs import (
+        ShuffleReceivedBufferCatalog, build_table_meta)
+    from spark_rapids_tpu.shuffle.serializer import (get_codec,
+                                                     serialize_table)
+    spill.init_catalog(1 << 30, 1 << 30)
+    recv = ShuffleReceivedBufferCatalog()        # registers itself
+    t = pa.table({"v": pa.array(np.arange(4000))})
+    payload = serialize_table(t, get_codec("none"))
+    tid = recv.add(build_table_meta(1, t.num_rows, t, len(payload)),
+                   payload)
+    freed = spill.handle_memory_pressure(1 << 40)  # force aux spillers
+    assert freed >= len(payload)
+    assert recv.pending_bytes == 0
+    assert recv.materialize(tid).equals(t)       # still readable
+
+
+# ---------------------------------------------------------------------------
+# task-failure vs transport-death classification on the submit ladder
+# ---------------------------------------------------------------------------
+
+def test_executor_reply_classifies_task_vs_transport():
+    """An executor that REPLIES ok=False (deterministic task failure)
+    carries no "transport" flag — the pipelined submit ladder must not
+    hard-kill a healthy shared executor (wiping concurrent exchanges'
+    map output) over a failure a re-run cannot fix.  A dead pipe does
+    carry it, keeping the kill+respawn+re-run ladder for real deaths."""
+    from spark_rapids_tpu.shuffle import procpool
+    pool = procpool.get_executor_pool(1)
+    h = pool.handle(0)
+    reply = h.call({"op": "definitely-not-an-op"})
+    assert reply.get("ok") is False and not reply.get("transport")
+    pool.kill(0)
+    reply = h.call({"op": "ping"})
+    assert reply.get("ok") is False and reply.get("transport")
+
+
+def test_tracker_failure_surfaces_by_kind():
+    """tracker.batches routes submit-thread failures by kind: transport
+    exhaustion -> RapidsShuffleFetchFailedException (so the read-side
+    ladder recovers or degrades to the CPU store, like depth=0 does
+    for a lost executor); deterministic task failures and cancellation
+    propagate raw (both must fail the query exactly like the
+    sequential barrier path — never silently fall back)."""
+    from spark_rapids_tpu.sched.cancel import QueryCancelledError
+    from spark_rapids_tpu.shuffle.exchange import (_MapOutputTracker,
+                                                   ShuffleMapTaskError)
+    from spark_rapids_tpu.shuffle.iterator import \
+        RapidsShuffleFetchFailedException
+
+    def failed_tracker(exc):
+        tr = _MapOutputTracker()
+        tr.open_exec()
+        tr.fail(exc)
+        return tr
+
+    with pytest.raises(RapidsShuffleFetchFailedException):
+        list(failed_tracker(RuntimeError("pipe: gone")).batches(1.0))
+    with pytest.raises(ShuffleMapTaskError):
+        list(failed_tracker(
+            ShuffleMapTaskError("bad expr")).batches(1.0))
+    with pytest.raises(QueryCancelledError):
+        list(failed_tracker(QueryCancelledError()).batches(1.0))
+
+    # completions announced before the death still drain first
+    tr = failed_tracker(RuntimeError("pipe: gone"))
+    tr.map_done("exec-0", 0)
+    it = tr.batches(1.0)
+    assert next(it) == [("exec-0", 0)]
+    with pytest.raises(RapidsShuffleFetchFailedException):
+        next(it)
+
+
+def test_zlib_codec_accepted_beyond_the_wire_leg():
+    """codec=zlib is documented as accepted: the block-store /
+    CPU-fallback serializer path must resolve it (storing blocks
+    uncompressed — Arrow IPC has no zlib buffer compression) instead
+    of crashing with 'unknown codec'."""
+    from spark_rapids_tpu.shuffle.serializer import (
+        deserialize_table, get_codec, serialize_table)
+    t = _data(500)
+    assert deserialize_table(
+        serialize_table(t, get_codec("zlib"))).equals(t)
+    # e2e through the local-transport block store (the path that
+    # raised before zlib was registered)
+    s = TpuSparkSession({
+        "spark.rapids.tpu.sql.shuffle.partitions": 3,
+        "spark.rapids.tpu.shuffle.compression.codec": "zlib"})
+    ref = TpuSparkSession({
+        "spark.rapids.tpu.sql.shuffle.partitions": 3})
+    assert_tables_equal(_agg(s, t).collect(), _agg(ref, t).collect())
+
+
+def test_wire_codec_fallback_flag_and_negotiation():
+    """Availability drift between the two processes must never poison
+    the stream: a degraded end announces "zlib" when it negotiates,
+    and flag-marks the frames it compresses so a NATIVE peer decodes
+    them with stdlib zlib instead of the negotiated codec."""
+    from spark_rapids_tpu.shuffle.tcp import (
+        _zlib_codec, decode_data_payload, encode_data_payload,
+        negotiated_name, wire_codec)
+    native = wire_codec("lz4")
+    degraded = _zlib_codec("lz4")       # forced stdlib stand-in
+    assert degraded.fallback and negotiated_name(degraded) == "zlib"
+    assert negotiated_name(wire_codec("zlib")) == "zlib"
+    payload = b"abcdefgh" * 400
+    # degraded sender -> native receiver: the fallback flag routes
+    # the decode through zlib no matter what the receiver resolved
+    wrapped = encode_data_payload(payload, degraded)
+    assert wrapped[0] == 2                  # _WIRE_FALLBACK
+    assert decode_data_payload(wrapped, native) == payload
+    # native sender -> native receiver unchanged
+    wrapped = encode_data_payload(payload, native)
+    assert wrapped[0] == 1 and \
+        decode_data_payload(wrapped, native) == payload
+
+
+def test_pipeline_timeout_zero_waits_indefinitely():
+    """pipeline.timeoutMs=0 -> tracker.batches(None) has no
+    no-progress bound (the sequential barrier's semantics); slow map
+    tasks complete instead of spuriously escalating to recovery."""
+    from spark_rapids_tpu.shuffle.exchange import _MapOutputTracker
+    tr = _MapOutputTracker()
+    tr.open_exec()
+
+    def late():
+        time.sleep(0.4)
+        tr.map_done("exec-0", 0)
+        tr.exec_done("exec-0", [0])
+    threading.Thread(target=late, daemon=True).start()
+    assert list(tr.batches(None)) == [[("exec-0", 0)]]
+
+
+def test_pressure_spill_tier_split_counters():
+    """handle_memory_pressure reports device-tier HBM relief and
+    aux-spiller host->disk relief under separate counters — host RAM
+    moved to disk must not read as freed HBM in capacity tuning."""
+    from spark_rapids_tpu.mem import spill
+    from spark_rapids_tpu.shuffle.catalogs import (
+        ShuffleReceivedBufferCatalog, build_table_meta)
+    from spark_rapids_tpu.shuffle.serializer import (get_codec,
+                                                     serialize_table)
+    spill.init_catalog(1 << 30, 1 << 30)
+    recv = ShuffleReceivedBufferCatalog()
+    t = pa.table({"v": pa.array(np.arange(3000))})
+    payload = serialize_table(t, get_codec("none"))
+    recv.add(build_table_meta(1, t.num_rows, t, len(payload)), payload)
+    view = obsreg.get_registry().view()
+    freed = spill.handle_memory_pressure(1 << 40)
+    d = view.delta()["counters"]
+    assert freed >= len(payload)
+    assert d.get("spill.pressureAuxBytes", 0) >= len(payload)
+    # nothing device-resident was registered -> no HBM claimed
+    assert d.get("spill.pressureDeviceBytes", 0) == 0
+
+
+def test_zlib_codec_id_maps_to_uncompressed_block_meta():
+    """BufferMeta carries CODEC_UNCOMPRESSED for codec=zlib blocks
+    (they serialize uncompressed; only the wire leg deflates) — the
+    manager-transport catalog crashed with KeyError('zlib') before."""
+    from spark_rapids_tpu.shuffle import meta
+    assert meta.codec_id("zlib") == meta.CODEC_UNCOMPRESSED
+    t = _data(400)
+    s = TpuSparkSession({
+        "spark.rapids.tpu.sql.shuffle.partitions": 3,
+        "spark.rapids.tpu.shuffle.transport": "manager",
+        "spark.rapids.tpu.shuffle.compression.codec": "zlib"})
+    ref = TpuSparkSession({
+        "spark.rapids.tpu.sql.shuffle.partitions": 3})
+    assert_tables_equal(_agg(s, t).collect(), _agg(ref, t).collect())
+
+
+def test_tracker_open_execs_gates_premature_fallback():
+    """open_execs exposes in-flight submit ladders so the read-side
+    recovery loop retries against a mid-stage re-run instead of
+    degrading to the CPU store while the stage is still healing."""
+    from spark_rapids_tpu.shuffle.exchange import _MapOutputTracker
+    tr = _MapOutputTracker()
+    assert tr.open_execs == 0            # sequential path: no gating
+    tr.open_exec()
+    tr.open_exec()
+    assert tr.open_execs == 2
+    tr.exec_done("exec-0", [0])
+    assert tr.open_execs == 1
+    tr.fail(RuntimeError("pipe: gone"))
+    assert tr.open_execs == 0            # failed ladder releases too
+
+
+def test_dead_peer_dial_failure_shared_with_queued_waiters():
+    """k readers racing make_client to a dead peer must not serialize
+    k full connect ladders behind the per-peer dial lock: waiters
+    already queued when a dial fails share its outcome; callers
+    entering AFTER the failure (e.g. post-add_peer retries) dial
+    fresh."""
+    from spark_rapids_tpu.shuffle.tcp import (TcpShuffleTransport,
+                                              _DeadClientConnection)
+    tr = TcpShuffleTransport("driver-deadpeer", {
+        "peers": {"exec-dead": ("127.0.0.1", 1)},
+        "connect_timeout_ms": 200})
+    calls = []
+    real_connect = tr._connect
+
+    def slow_failing_connect(peer, host, port):
+        calls.append(peer)
+        time.sleep(0.3)          # all waiters queue behind this dial
+        raise OSError("connection refused")
+    tr._connect = slow_failing_connect
+    barrier = threading.Barrier(6)
+    results = []
+
+    def dial():
+        barrier.wait()
+        results.append(tr.make_client("exec-dead"))
+    ts = [threading.Thread(target=dial) for _ in range(6)]
+    for t in ts:
+        t.start()
+    for t in ts:
+        t.join()
+    assert len(results) == 6 and all(
+        isinstance(r, _DeadClientConnection) for r in results)
+    assert len(calls) == 1, f"waiters re-dialed: {len(calls)}"
+    # a LATER caller (entered after the failure) dials fresh
+    results.clear()
+    results.append(tr.make_client("exec-dead"))
+    assert len(calls) == 2
+    tr._connect = real_connect
+
+
+def test_tracker_timeout_not_reset_by_duplicate_announcements():
+    """Re-announced (already-seen) map ids wake the tracker without
+    delivering progress; they must not push the no-progress deadline
+    out, or a wedged sibling stage never escalates while a
+    crash-looping executor's re-runs keep re-announcing."""
+    from spark_rapids_tpu.shuffle.exchange import _MapOutputTracker
+    from spark_rapids_tpu.shuffle.iterator import \
+        RapidsShuffleTimeoutException
+    tr = _MapOutputTracker()
+    tr.open_exec()                       # the wedged stage
+    tr.map_done("exec-0", 0)             # one real completion
+    stop = threading.Event()
+
+    def spam_duplicates():
+        while not stop.is_set():
+            tr.map_done("exec-0", 0)     # dedup'd: wakeup, no progress
+            time.sleep(0.02)
+    spammer = threading.Thread(target=spam_duplicates, daemon=True)
+    spammer.start()
+    try:
+        it = tr.batches(0.6)
+        assert next(it) == [("exec-0", 0)]
+        t0 = time.monotonic()
+        with pytest.raises(RapidsShuffleTimeoutException):
+            next(it)
+        elapsed = time.monotonic() - t0
+        assert elapsed < 3.0, f"deadline deferred by wakeups: {elapsed}"
+    finally:
+        stop.set()
+        spammer.join()
