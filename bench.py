@@ -240,12 +240,23 @@ def _kernel_backend_probe(rows: int = 1 << 17) -> dict:
             best = dt_ if best is None else min(best, dt_)
         return best * 1e3
 
+    from spark_rapids_tpu.obs import registry as obsreg
+
     out: dict = {}
     decode_res = {}
+    decode_tiles: dict = {}
     for bk_name in ("xla", "pallas"):
         with kb.backend_override(bk_name):
+            # tile accounting around exactly ONE invocation: decode's
+            # record_tiles fires per host call, so including the warm
+            # + timing reps would report call-count multiplicity, not
+            # streamed volume (segreduce's fires once per jit trace
+            # and needs no such scoping)
+            one_view = obsreg.get_registry().view()
             decode_res[bk_name] = np.asarray(
                 kdec.expand_stream(runs, bytes(packed), cap))[:total]
+            if bk_name == "pallas":
+                decode_tiles = one_view.delta()["counters"]
             ms = timed_ms(lambda: kdec.expand_stream(
                 runs, bytes(packed), cap))
         out[f"decode_{bk_name}_ms"] = round(ms, 3)
@@ -289,16 +300,40 @@ def _kernel_backend_probe(rows: int = 1 << 17) -> dict:
     v = jnp.asarray(vals)
     mask = jnp.arange(cap) < n
     agg_res = {}
+    agg_tiles: dict = {}
     for bk_name in ("xla", "pallas"):
         def one(bk=bk_name):
             ctx = _group_ctx([kv], cap, n, backend=bk)
             return ctx.seg_sum(v, mask, out_np=np.float64) + \
                 ctx.seg_count(mask)
         agg_fn = jax.jit(one)
-        agg_res[bk_name] = np.asarray(agg_fn())[:64]
+        one_view = obsreg.get_registry().view()
+        agg_res[bk_name] = np.asarray(agg_fn())[:64]   # traces here
+        if bk_name == "pallas":
+            agg_tiles = one_view.delta()["counters"]
         out[f"agg_{bk_name}_ms"] = round(timed_ms(agg_fn), 3)
     assert np.array_equal(agg_res["xla"], agg_res["pallas"]), \
         "kernel.backend aggregate parity failed"
+    # HBM->VMEM streaming-tiler accounting (the counters that replaced
+    # the retired whole-buffer residency fallbacks): decode from ONE
+    # scoped invocation (per-call counting), segreduce from its
+    # per-compile counting inside agg_view's window — both are the
+    # per-probe streamed volume, not timing-rep multiplicity
+    out["tiles"] = {
+        "decode": int(decode_tiles.get(
+            "kernel.pallas.tiles.decode.expand", 0)),
+        "decode_bytes": int(decode_tiles.get(
+            "kernel.pallas.tileBytes.decode.expand", 0)),
+        "segreduce": int(agg_tiles.get(
+            "kernel.pallas.tiles.agg.segreduce", 0)),
+        "segreduce_bytes": int(agg_tiles.get(
+            "kernel.pallas.tileBytes.agg.segreduce", 0)),
+        "plan_hits": int(agg_tiles.get("kernel.tilePlan.hits", 0)) +
+            int(decode_tiles.get("kernel.tilePlan.hits", 0)),
+        "plan_misses": int(agg_tiles.get("kernel.tilePlan.misses", 0)) +
+            int(decode_tiles.get("kernel.tilePlan.misses", 0)),
+        "tile_bytes_conf": kb.tile_bytes(),
+    }
     out["rows"] = rows
     out["rows_match"] = True
     return out
@@ -918,13 +953,16 @@ def _write_trend_file(result: dict, n: int, files: int,
             "p95_ms": conc.get("queue_wait_p95_ms"),
         },
         # per-backend kernel.backend timings (decode / aggregate) +
-        # gathers-per-element accounting — the PR-9 headline
+        # gathers-per-element accounting (the PR-9 headline) and the
+        # PR-14 HBM->VMEM streaming-tiler volume (tile counts/bytes +
+        # tile-plan memo hits) across the probe window
         "kernels": {
             "decode_xla_ms": kern.get("decode_xla_ms"),
             "decode_pallas_ms": kern.get("decode_pallas_ms"),
             "agg_xla_ms": kern.get("agg_xla_ms"),
             "agg_pallas_ms": kern.get("agg_pallas_ms"),
             "gathers_per_element": kern.get("gathers_per_element"),
+            "tiles": kern.get("tiles"),
             "rows": kern.get("rows"),
             "rows_match": kern.get("rows_match"),
             "error": kern.get("error"),

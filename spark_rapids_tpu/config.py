@@ -464,17 +464,19 @@ SHUFFLE_PARTITIONS = conf(
     "analog).", int)
 
 KERNEL_BACKEND = conf(
-    "spark.rapids.tpu.kernel.backend", "xla",
+    "spark.rapids.tpu.kernel.backend", "pallas",
     "Kernel backend for the gather-bound decode/aggregate hot paths: "
-    "'xla' (the composed array-op formulations) or 'pallas' "
-    "(hand-written Pallas kernels: dense phase-decomposed RLE/"
-    "bit-unpack, fused dictionary-decode+filter, single-pass segmented "
-    "reduction — spark_rapids_tpu/kernels/). Selection is per call "
-    "site with automatic per-kernel fallback to the XLA path when a "
-    "shape/dtype isn't covered (never whole-query; counted in "
-    "kernel.backend.pallas.hits/.fallbacks with reason tags). The "
-    "sql.fusion.enabled pattern: the XLA path stays the correctness "
-    "oracle and CI diffs the two backends bit-for-bit.")
+    "'pallas' (default — hand-written Pallas kernels: dense phase-"
+    "decomposed RLE/bit-unpack, fused dictionary-decode+filter, "
+    "single-pass segmented reduction — spark_rapids_tpu/kernels/, "
+    "streaming arbitrarily large buffers through VMEM in double-"
+    "buffered tiles of kernel.pallas.tileBytes) or 'xla' (the composed "
+    "array-op formulations, demoted to correctness oracle — the "
+    "one-knob revert). Selection is per call site with automatic "
+    "per-kernel fallback to the XLA path when a shape/dtype isn't "
+    "covered (never whole-query; counted in "
+    "kernel.backend.pallas.hits/.fallbacks with reason tags), and CI "
+    "diffs the two backends bit-for-bit.")
 
 KERNEL_PALLAS_INTERPRET = conf(
     "spark.rapids.tpu.kernel.pallas.interpret", "auto",
@@ -483,6 +485,21 @@ KERNEL_PALLAS_INTERPRET = conf(
     "real kernel bodies and parity gates are genuine, not skips), "
     "'true' (always interpret, for debugging), 'false' (always compile "
     "via Mosaic).")
+
+KERNEL_PALLAS_TILE_BYTES = conf(
+    "spark.rapids.tpu.kernel.pallas.tileBytes", 4 << 20,
+    "Per-tile byte budget for the HBM->VMEM streaming tiler "
+    "(kernels/tiling.py): gather-source buffers (dense decoded values, "
+    "dictionaries, segmented-reduction sources) larger than one tile "
+    "stream through the Pallas kernels as a second grid dimension of "
+    "fixed-size tiles (double-buffered by the Pallas pipeline emitter) "
+    "instead of requiring whole-buffer VMEM residency — this replaced "
+    "the retired dense_too_large/dict_too_large/src_too_large fallback "
+    "gates. Tile counts/bytes are observable as "
+    "kernel.pallas.tiles[.family] / kernel.pallas.tileBytes[.family]; "
+    "tile plans memoize per (kernel, shape) in the kernel cache "
+    "(kernel.tilePlan.hits/misses). Must leave room for two resident "
+    "tiles plus the element blocks in ~16 MiB VMEM/core.", int)
 
 KERNEL_ABI_ENABLED = conf(
     "spark.rapids.tpu.kernel.abi.enabled", True,

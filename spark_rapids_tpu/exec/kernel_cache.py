@@ -481,8 +481,41 @@ def get_kernel(key: Any, builder: Callable[[], Callable],
     return cur
 
 
+# -- tile-plan memo (kernels/tiling.py) -------------------------------------
+# Grid shapes of the streaming Pallas tiler are pure functions of
+# (kernel family, buffer shapes, tileBytes, block caps) but computing
+# one walks the pow2 ladders and reads config — per-dispatch host cost
+# the hot path should not re-pay.  Plans memoize here, alongside the
+# kernels they shape, with their own hit/miss counters
+# (kernel.tilePlan.hits/misses).  Bounded like _CACHE; a plan is a tiny
+# frozen dataclass so the bound is about key hygiene, not memory.
+_TILE_PLANS: "OrderedDict[Any, Any]" = OrderedDict()
+
+
+def tile_plan(key: Any, builder: Callable[[], Any]) -> Any:
+    """Return the memoized tile plan for ``key``, computing it via
+    ``builder`` on first use.  ``key`` must capture everything the plan
+    depends on (family, shapes, block caps, tileBytes, interpret) —
+    kernels/tiling.py owns that contract."""
+    from spark_rapids_tpu.obs import registry as _obsreg
+    with _LOCK:
+        plan = _TILE_PLANS.get(key)
+        if plan is not None:
+            _TILE_PLANS.move_to_end(key)
+            _obsreg.get_registry().inc("kernel.tilePlan.hits")
+            return plan
+    _obsreg.get_registry().inc("kernel.tilePlan.misses")
+    plan = builder()
+    with _LOCK:
+        cur = _TILE_PLANS.setdefault(key, plan)
+        if len(_TILE_PLANS) > _MAX_ENTRIES:
+            _TILE_PLANS.popitem(last=False)
+    return cur
+
+
 def clear() -> None:
     _CACHE.clear()
+    _TILE_PLANS.clear()
     _ID_PINNED.clear()
 
 

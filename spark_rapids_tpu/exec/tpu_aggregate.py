@@ -78,6 +78,12 @@ class _SortedCtx:
     # kernel backend for the segment reductions ('xla' | 'pallas'):
     # per-REDUCTION selection with fallback — see kernels/segreduce.py
     backend: str = "xla"
+    # tile budget pinned by the enclosing kernel's cache key (None =
+    # the live kernel.pallas.tileBytes knob): the segreduce gather
+    # plans its source tiles from THIS value, so a concurrent session
+    # reconfiguring the knob between key computation and trace cannot
+    # cache a kernel whose geometry disagrees with its key
+    tile_bytes: "Optional[int]" = None
 
     # -- scatter-free segment reductions -------------------------------
     #
@@ -136,7 +142,8 @@ class _SortedCtx:
                            jnp.zeros((), out_np))
             if self._pallas_op(jnp.add, out_np):
                 s = kseg.gather_seg_scan(xm, self.order, self.new,
-                                         "add", 0)
+                                         "add", 0,
+                                         tile_bytes=self.tile_bytes)
                 return jnp.take(s, self.end_pos)
             return jnp.take(
                 scans.seg_scan(jnp.add, self.new,
@@ -148,14 +155,16 @@ class _SortedCtx:
                            ).astype(jnp.int32)
             if self._pallas_op(jnp.add, jnp.int32):
                 s = kseg.gather_seg_scan(xm, self.order, self.new,
-                                         "add", 0)
+                                         "add", 0,
+                                         tile_bytes=self.tile_bytes)
                 return jnp.take(s, self.end_pos).astype(out_np)
             c = jnp.cumsum(self.take_sorted(xm))
         else:
             xm = jnp.where(mask, x, jnp.zeros((), x.dtype))
             if self._pallas_op(jnp.add, out_np):
                 s = kseg.gather_seg_scan(xm, self.order, self.new,
-                                         "add", 0, scan_np=out_np)
+                                         "add", 0, scan_np=out_np,
+                                         tile_bytes=self.tile_bytes)
                 return jnp.take(s, self.end_pos)
             c = scans.cumsum(self.take_sorted(xm).astype(out_np))
         ce = jnp.take(c, self.end_pos)
@@ -174,7 +183,8 @@ class _SortedCtx:
         else:
             if self._pallas_op(jnp.add, jnp.int32):
                 s = kseg.gather_seg_scan(mask, self.order, self.new,
-                                         "add", 0, scan_np=jnp.int32)
+                                         "add", 0, scan_np=jnp.int32,
+                                         tile_bytes=self.tile_bytes)
                 return jnp.take(s, self.end_pos).astype(jnp.int64)
             xs = self.take_sorted(mask).astype(jnp.int32)
         c = jnp.cumsum(xs)
@@ -208,7 +218,7 @@ class _SortedCtx:
         xm = jnp.where(mask, x, jnp.asarray(fill, dtype=x.dtype))
         if self._pallas_op(op, x.dtype, xm.ndim):
             s = kseg.gather_seg_scan(xm, self.order, self.new, name,
-                                     fill)
+                                     fill, tile_bytes=self.tile_bytes)
             return jnp.take(s, self.end_pos)
         return jnp.take(
             scans.seg_scan(op, self.new, self.take_sorted(xm), fill),
@@ -493,15 +503,16 @@ def normalize_key(v: ColVal) -> ColVal:
 
 def sorted_group_ctx(key_vals: List[ColVal],
                      batch: DeviceBatch,
-                     backend: str = "xla") -> _SortedCtx:
+                     backend: str = "xla",
+                     tile_bytes=None) -> _SortedCtx:
     """Batch-shaped wrapper over _group_ctx (rows are prefix-dense:
     row i exists iff i < num_rows)."""
     return _group_ctx(key_vals, batch.capacity, batch.num_rows,
-                      backend=backend)
+                      backend=backend, tile_bytes=tile_bytes)
 
 
 def _group_ctx(key_vals: List[ColVal], cap: int, n_rows,
-               backend: str = "xla") -> _SortedCtx:
+               backend: str = "xla", tile_bytes=None) -> _SortedCtx:
     """Group rows by key: stable LSD radix sort over bit-packed key
     digits brings equal keys adjacent, boundaries mark group starts, and
     every downstream reduction is scan+gather (see _SortedCtx).
@@ -522,7 +533,8 @@ def _group_ctx(key_vals: List[ColVal], cap: int, n_rows,
             order=i32, new=(i32 == 0), gid_sorted=jnp.zeros_like(i32),
             start_pos=jnp.zeros((cap,), jnp.int32), end_pos=end,
             sorted_mask=row_mask, cap=cap, row_mask=row_mask,
-            n_groups=jnp.int32(1), backend=backend)
+            n_groups=jnp.int32(1), backend=backend,
+            tile_bytes=tile_bytes)
 
     fields = [(1, (~row_mask).astype(jnp.uint64))]  # padding sorts last
     total_bits = 1
@@ -581,7 +593,8 @@ def _group_ctx(key_vals: List[ColVal], cap: int, n_rows,
         vb = sortkeys.narrow_int_bits(v0)
         if vb is not None:
             key_inverse = (vb, eff_nullables[0], v0.dtype, v0.vbits)
-    return _SortedCtx(order=order, new=new, gid_sorted=gid_sorted,
+    return _SortedCtx(tile_bytes=tile_bytes,
+                      order=order, new=new, gid_sorted=gid_sorted,
                       start_pos=start_pos, end_pos=end_pos,
                       sorted_mask=sorted_mask, cap=cap,
                       row_mask=row_mask, n_groups=n_groups,
@@ -711,7 +724,8 @@ def update_aggregate(batch: DeviceBatch,
                      aggregates: Sequence[ir.AggregateExpression],
                      specs: Sequence[_AggSpec],
                      condition: Optional[ir.Expression] = None,
-                     backend: str = "xla") -> DeviceBatch:
+                     backend: str = "xla",
+                     tile_bytes=None) -> DeviceBatch:
     """Per-batch update phase: groupBy().aggregate(updateAggs) analog.
 
     ``condition`` is a fused pre-filter (Filter directly under the
@@ -729,7 +743,8 @@ def update_aggregate(batch: DeviceBatch,
         rung-sized gather total instead of a rung compact + a sorted
         gather."""
         from dataclasses import replace as _dc_replace
-        ctx = _group_ctx(kv, cap2, nr, backend=backend)
+        ctx = _group_ctx(kv, cap2, nr, backend=backend,
+                         tile_bytes=tile_bytes)
         cols = gather_group_keys(kv, ctx)
         names = [f"__k{i}" for i in range(len(cols))]
         vctx = ctx
@@ -798,14 +813,16 @@ def update_aggregate(batch: DeviceBatch,
 
 def merge_aggregate(batch: DeviceBatch, n_keys: int,
                     specs: Sequence[_AggSpec],
-                    backend: str = "xla") -> DeviceBatch:
+                    backend: str = "xla",
+                    tile_bytes=None) -> DeviceBatch:
     """Merge phase over concatenated partials: mergeAggs analog."""
     def run(b: DeviceBatch) -> DeviceBatch:
         key_cols = b.columns[:n_keys]
         key_vals = [ColVal(c.dtype, c.data, c.validity, c.lengths,
                             vbits=c.vbits, nonnull=c.nonnull)
                     for c in key_cols]
-        ctx = sorted_group_ctx(key_vals, b, backend=backend)
+        ctx = sorted_group_ctx(key_vals, b, backend=backend,
+                               tile_bytes=tile_bytes)
         cols = gather_group_keys(key_vals, ctx)
         names = list(b.names[:n_keys])
         bufs_per_spec = []
@@ -876,11 +893,15 @@ class TpuHashAggregateExec(TpuExec):
     def _update_impl(self, batch: DeviceBatch) -> DeviceBatch:
         return update_aggregate(batch, self.groupings, self.aggregates,
                                 self.specs, self.fused_condition,
-                                backend=getattr(self, "backend", "xla"))
+                                backend=getattr(self, "backend", "xla"),
+                                tile_bytes=getattr(self, "tile_bytes",
+                                                   None))
 
     def _merge_impl(self, batch: DeviceBatch) -> DeviceBatch:
         return merge_aggregate(batch, len(self.groupings), self.specs,
-                               backend=getattr(self, "backend", "xla"))
+                               backend=getattr(self, "backend", "xla"),
+                               tile_bytes=getattr(self, "tile_bytes",
+                                                  None))
 
     def _final_impl(self, batch: DeviceBatch) -> DeviceBatch:
         return finalize_aggregate(batch, len(self.groupings), self.specs,
@@ -907,9 +928,16 @@ class TpuHashAggregateExec(TpuExec):
             # bakes the real names in — so names ride ONLY its key, and
             # the same aggregation under different output aliases
             # shares the expensive update/merge sorts (shape-erased ABI)
+            # the tile budget rides the key too: it shapes the grids of
+            # the embedded segreduce kernels (kernels/tiling.py).  Read
+            # ONCE here and threaded through the shim to trace time, so
+            # a concurrent session reconfiguring the knob between key
+            # computation and first trace cannot cache a kernel whose
+            # tile geometry disagrees with its key.
+            tb = kb.tile_bytes() if bk == kb.PALLAS else None
             sig = (kc.exprs_sig(self.groupings),
                    kc.exprs_sig(self.aggregates), bk,
-                   kb.interpret() if bk == kb.PALLAS else None)
+                   kb.interpret() if bk == kb.PALLAS else None, tb)
             # only the UPDATE kernel evaluates the fused condition;
             # merge/final kernels are identical across filters and must
             # share one compile (aggregate sorts cost ~17-20 s each)
@@ -918,7 +946,8 @@ class TpuHashAggregateExec(TpuExec):
             shim = types.SimpleNamespace(
                 groupings=self.groupings, aggregates=self.aggregates,
                 specs=self.specs, _schema=self._schema,
-                fused_condition=self.fused_condition, backend=bk)
+                fused_condition=self.fused_condition, backend=bk,
+                tile_bytes=tb)
             cls = type(self)
             self._update_kernel = kc.get_kernel(
                 ("agg_update", usig),

@@ -68,6 +68,32 @@ def _gather(child: PhysicalPlan) -> Optional[DeviceBatch]:
             h.close()
 
 
+def _canon_side(batch: DeviceBatch, prefix: str) -> DeviceBatch:
+    """Shape-erased ABI at the join dispatch boundary (the PR 12 erase
+    extended into the join ``emit`` family): bucket value-range hints
+    to the coarse ABI table and pad stragglers to capacity tiers
+    (``kernel_abi.erase``), then rename positionally with the side's
+    static ``__l*``/``__r*`` prefix — the join kernels reference key
+    columns by those canonical names, and keeping the two sides'
+    prefixes distinct means the emitted build+stream column set never
+    carries duplicate names.  Joins that differ only in schema names
+    or precise value ranges share one program; the renamed-join-schema
+    rerun test pins zero new programs."""
+    from spark_rapids_tpu.exec import kernel_abi
+    names = [f"{prefix}{i}" for i in range(batch.num_cols)]
+    eb = kernel_abi.erase(batch)
+    return DeviceBatch(names, eb.columns, eb.num_rows)
+
+
+def _side_key(batch: DeviceBatch):
+    """Erased cache-key component for one (already canonical) side —
+    layout only under the ABI, the legacy named schema_key otherwise
+    (so flipping kernel.abi.enabled between sessions cannot serve a
+    kernel traced under the other ABI)."""
+    from spark_rapids_tpu.exec import kernel_abi
+    return kernel_abi.erased_key(batch)
+
+
 def _key_vals(batch: DeviceBatch, key_names: Sequence[str]) -> List[ColVal]:
     out = []
     for k in key_names:
@@ -584,7 +610,7 @@ class _HashJoinBase(TpuExec):
         kernel."""
         from spark_rapids_tpu.exec import kernel_cache as kc
         pkey = ("join_pack", tuple(bkeys), tuple(skeys),
-                build.schema_key(), stream.schema_key())
+                _side_key(build), _side_key(stream))
         if pkey not in self._kernels:
             self._kernels[pkey] = kc.get_kernel(
                 pkey, lambda: lambda b, s: _join_sort_key(
@@ -600,7 +626,7 @@ class _HashJoinBase(TpuExec):
         the unique or duplicated-build-key emit variant."""
         from spark_rapids_tpu.exec import kernel_cache as kc
         sig = (bits, emit_how, tuple(bkeys), tuple(skeys),
-               build.schema_key(), stream.schema_key())
+               _side_key(build), _side_key(stream))
         ckey = ("probe_count",) + sig
         if ckey not in self._kernels:
             self._kernels[ckey] = kc.get_kernel(
@@ -619,7 +645,7 @@ class _HashJoinBase(TpuExec):
                     isinstance(stream.num_rows, (int, np.integer)) and \
                     total == int(stream.num_rows):
                 emit_variant = "inner_inplace"   # FK join: all rows match
-            out_cap = stream.capacity if emit_variant != "inner" \
+            out_cap = bucket_rows(stream.capacity) if emit_variant != "inner" \
                 else bucket_rows(total)
             ekey = ("probe_emit_u", emit_variant, out_cap,
                     build_first) + sig
@@ -664,21 +690,22 @@ class _HashJoinBase(TpuExec):
                    build_side: str = "right"):
         """Join two single batches; yields 0 or 1 output batches."""
         how = self.how
-        # rename columns positionally to dodge duplicate-name lookups
-        lnames = [f"__l{i}" for i in range(left.num_cols)]
-        rnames = [f"__r{i}" for i in range(right.num_cols)]
-        lkeys = [lnames[left.names.index(k)] for k in self.left_keys]
-        rkeys = [rnames[right.names.index(k)] for k in self.right_keys]
-        left = DeviceBatch(lnames, left.columns, left.num_rows)
-        right = DeviceBatch(rnames, right.columns, right.num_rows)
+        # canonicalize both sides at the dispatch boundary: positional
+        # __l*/__r* names (dodges duplicate-name lookups AND erases the
+        # user schema from the kernel identity) + ABI hint bucketing /
+        # tier padding (_canon_side)
+        lkeys = [f"__l{left.names.index(k)}" for k in self.left_keys]
+        rkeys = [f"__r{right.names.index(k)}" for k in self.right_keys]
+        left = _canon_side(left, "__l")
+        right = _canon_side(right, "__r")
 
         if how in ("semi", "anti"):
             from spark_rapids_tpu.exec import kernel_cache as kc
             bits = _probe_code_bits(right, left, rkeys, lkeys)
             if bits is not None and bits <= _PROBE_MAX_BITS:
                 key = ("probe_semi", how, bits, tuple(lkeys),
-                       tuple(rkeys), left.schema_key(),
-                       right.schema_key())
+                       tuple(rkeys), _side_key(left),
+                       _side_key(right))
                 if key not in self._kernels:
                     self._kernels[key] = kc.get_kernel(
                         key, lambda: lambda b, s: _probe_semi_kernel(
@@ -687,7 +714,7 @@ class _HashJoinBase(TpuExec):
                     out = self._kernels[key](right, left)
             else:
                 key = ("semi", how, tuple(lkeys), tuple(rkeys),
-                       left.schema_key(), right.schema_key())
+                       _side_key(left), _side_key(right))
                 if key not in self._kernels:
                     self._kernels[key] = kc.get_kernel(
                         key, lambda: lambda b, s, o, g: _semi_kernel(
@@ -722,7 +749,7 @@ class _HashJoinBase(TpuExec):
                                         emit_how, build_first, bits)
             return
         ckey = ("count", emit_how, tuple(bkeys), tuple(skeys),
-                build.schema_key(), stream.schema_key())
+                _side_key(build), _side_key(stream))
         if ckey not in self._kernels:
             self._kernels[ckey] = kc.get_kernel(
                 ckey, lambda: lambda b, s, o, g: _count_kernel(
@@ -741,7 +768,7 @@ class _HashJoinBase(TpuExec):
                 f"2^31 limit; repartition the inputs")
         out_cap = bucket_rows(total)
         ekey = ("emit", emit_how, out_cap, tuple(bkeys), tuple(skeys),
-                build_first, build.schema_key(), stream.schema_key())
+                build_first, _side_key(build), _side_key(stream))
         if ekey not in self._kernels:
             self._kernels[ekey] = kc.get_kernel(
                 ekey, lambda: lambda b, s, o, g: _emit_kernel(
@@ -932,10 +959,15 @@ class _NestedLoopBase(TpuExec):
         if nl == 0 or nr == 0:
             return
         from spark_rapids_tpu.exec import kernel_cache as kc
+        # same dispatch-boundary canonicalization as the hash joins:
+        # the kernel builds its output with positional names (the
+        # condition reads by ordinal), the real schema restamps after
+        left = _canon_side(left, "__l")
+        right = _canon_side(right, "__r")
+        n_out = left.num_cols + right.num_cols
         out_cap = bucket_rows(nl * nr)
         key = ("cross", out_cap, kc.expr_sig(self.condition),
-               tuple(self._schema.names), left.schema_key(),
-               right.schema_key())
+               _side_key(left), _side_key(right))
         if key not in self._kernels:
             def impl(l, r):
                 total = l.num_rows * r.num_rows
@@ -947,7 +979,8 @@ class _NestedLoopBase(TpuExec):
                 valid = k < total
                 cols = [c.gather(li, valid) for c in l.columns] + \
                     [c.gather(ri, valid) for c in r.columns]
-                out = DeviceBatch(self._schema.names, cols, total)
+                out = DeviceBatch([f"_c{i}" for i in range(n_out)],
+                                  cols, total)
                 if self.condition is not None:
                     v = eval_tpu.evaluate(self.condition, out)
                     out = compact(out, v.data.astype(jnp.bool_) &
@@ -956,6 +989,7 @@ class _NestedLoopBase(TpuExec):
             self._kernels[key] = kc.get_kernel(key, lambda: impl)
         with timed(self.metrics, "join.nestedLoop"):
             out = self._kernels[key](left, right)
+        out = DeviceBatch(self._schema.names, out.columns, out.num_rows)
         self.metrics.add_rows(out.num_rows)
         self.metrics.add_batches()
         yield out

@@ -127,6 +127,12 @@ class _FusedPlan:
     # kernel backend for phase 0 (dense unpack) and the kernel-2
     # deferred dictionary gather; folded into ``key``
     backend: str = "xla"
+    # tile budget stamped at assemble time (pallas only; also in
+    # ``key``): _make_kernel's tiled gathers read THIS value, never
+    # the live process knob, so a concurrent session reconfiguring
+    # kernel.pallas.tileBytes between assemble and first trace cannot
+    # build a kernel that disagrees with the eligibility gate or key
+    tile_bytes: Optional[int] = None
     # kernel 2: (condition expr, scan output-name order, deferred
     # column names) when the pushed filter is active, else None
     pushed: Optional[Tuple] = None
@@ -243,21 +249,27 @@ def assemble(plans: List[List[Optional[ChunkPlan]]],
             pushed_filter, lambda e: isinstance(e, _ir.BoundReference))}
         for ci, col_plans in enumerate(plans):
             modes = {p.mode for p in col_plans if p is not None}
-            if modes != {"dict"}:
+            # int ('dict') and STRING ('dict_str') dictionary columns
+            # both defer; mixed-mode columns decode eagerly
+            if modes not in ({"dict"}, {"dict_str"}):
                 continue
             if names[ci] in ref_names:
                 kb.fallback("scan.filterDecode", "condition_column")
                 continue
-            # every segment's dictionary must live in the SAME wire-
-            # dtype buffer: phase 5 runs ONE gather over one buffer,
-            # and doff offsets from a different buffer would silently
-            # read the wrong dictionary (schema-evolved multi-file
-            # groups can mix int32/int64 dict pages per column)
-            pkeys = {str(p.dict_np.dtype) for p in col_plans
-                     if p is not None}
-            if len(pkeys) != 1:
-                kb.fallback("scan.filterDecode", "mixed_dict_dtypes")
-                continue
+            if modes == {"dict"}:
+                # every segment's dictionary must live in the SAME
+                # wire-dtype buffer: phase 5 runs ONE gather over one
+                # buffer, and doff offsets from a different buffer
+                # would silently read the wrong dictionary (schema-
+                # evolved multi-file groups can mix int32/int64 dict
+                # pages per column).  String dictionaries are immune:
+                # all of them share the one u8 matrix buffer and the
+                # per-segment stride is static in the stitched codes.
+                pkeys = {str(p.dict_np.dtype) for p in col_plans
+                         if p is not None}
+                if len(pkeys) != 1:
+                    kb.fallback("scan.filterDecode", "mixed_dict_dtypes")
+                    continue
             defer_cols.add(ci)
         if not defer_cols:
             kb.fallback("scan.filterDecode", "no_dict_columns")
@@ -292,7 +304,8 @@ def assemble(plans: List[List[Optional[ChunkPlan]]],
                 continue
             nullable = p.nullable and not _all_valid(p.def_runs)
             s = _SegSpec(mode=p.mode, nullable=nullable,
-                         defer=(ci in defer_cols and p.mode == "dict"))
+                         defer=(ci in defer_cols and
+                                p.mode in ("dict", "dict_str")))
             if nullable:
                 s.def_stream = len(stream_quads)
                 stream_quads.append(_stream_quads(
@@ -425,12 +438,24 @@ def assemble(plans: List[List[Optional[ChunkPlan]]],
         arrays["dict_" + key] = _pad_np(
             buf, bucket_rows(buf.shape[0] + pad, 64))
 
-    # -- kernel-2 residency gate (needs the final dict buffer sizes) --
+    # -- kernel-2 shape gate (the old 16 MiB dict_too_large residency
+    # -- gate is gone — oversized dictionaries stream tile-wise
+    # -- instead of falling back).  ``tileb`` below is the one
+    # -- tile-budget read this plan ever makes: gate, cache key, and
+    # -- trace-time kernels all share it.
+    tileb = kb.tile_bytes() if backend == kb.PALLAS else None
     for ci in sorted(defer_cols):
-        s0 = next(s for s in specs[ci] if s.mode == "dict")
-        dbuf = arrays["dict_" + s0.plain_key]
-        ok, reason = kfd.supported(cap, dbuf.shape[0],
-                                   dbuf.dtype.itemsize)
+        s0 = next(s for s in specs[ci] if s.mode in ("dict", "dict_str"))
+        if s0.mode == "dict":
+            ok, reason = kfd.supported(cap)
+        else:
+            col_L = max(s.dlen for s in specs[ci]
+                        if s.mode == "dict_str")
+            ok, reason = kfd.str_supported(cap, col_L,
+                                           tile_bytes=tileb)
+            if ok:
+                # the post-filter lengths recover via the 1-D gather
+                ok, reason = kfd.supported(cap)
         if not ok:
             kb.fallback("scan.filterDecode", reason)
             for s in specs[ci]:
@@ -450,12 +475,14 @@ def assemble(plans: List[List[Optional[ChunkPlan]]],
                       for ci in range(len(plans)))
     # interpret mode is part of the executable's identity whenever the
     # backend embeds pallas calls: flipping kernel.pallas.interpret
-    # in-process must not serve a stale interpreter-mode kernel
+    # in-process must not serve a stale interpreter-mode kernel — and
+    # so is the tile budget (``tileb``, read ONCE above), which shapes
+    # every embedded kernel's grid
     interp = kb.interpret() if backend == kb.PALLAS else None
     key = ("pq_fused6", tuple(names),
            tuple(d.name for d in out_dtypes), K, vcap, cap,
            nslcap, rcap, tuple(stream_path), tuple(w_caps), col_vbits,
-           backend, interp, pushed_sig,
+           backend, interp, tileb, pushed_sig,
            tuple((a, arrays[a].shape, str(arrays[a].dtype))
                  for a in sorted(arrays)),
            tuple(tuple((s.mode, s.nullable, s.def_stream, s.val_stream,
@@ -468,7 +495,7 @@ def assemble(plans: List[List[Optional[ChunkPlan]]],
                       cap=cap, vcap=vcap, stream_path=stream_path,
                       nslcap=nslcap, widths=tuple(w_caps),
                       col_vbits=col_vbits, backend=backend,
-                      pushed=pushed)
+                      tile_bytes=tileb, pushed=pushed)
 
 
 # ---------------------------------------------------------------------------
@@ -644,6 +671,35 @@ def _make_kernel(fp: _FusedPlan):
                                                   doff_m, dsize_m)
                     for (ci, r), d, v in zip(members, codes_m, valid_m):
                         seg_out[(ci, r)] = (d, v)
+                elif mode == "dict_str" and defer:
+                    # kernel 2, strings: stitch three int32 code
+                    # streams — byte base into the shared u8 matrix
+                    # buffer, index into the lengths buffer, and the
+                    # segment's static row stride — and gather bytes +
+                    # lengths tile-wise in phase 5 once the pushed
+                    # mask is known (kernels/filter_decode)
+                    L = int(pkey)
+                    loff_m = meta[jnp.asarray(
+                        [s.m_dlen_off for s in specs_m])]
+
+                    def one_str_codes(idx, lv, n_r, doff, dsize, loff):
+                        idx, valid = _def_apply(lv, idx, n_r, vcap)
+                        idx = jnp.clip(idx, 0,
+                                       jnp.maximum(dsize - 1, 0))
+                        bb = doff + idx * L
+                        li = loff + idx
+                        lw = jnp.where(valid, jnp.int32(L),
+                                       jnp.int32(0))
+                        return bb, li, lw, valid
+
+                    in_ax = (0, 0 if nullable else None, 0, 0, 0, 0)
+                    bb_m, li_m, lw_m, valid_m = jax.vmap(
+                        one_str_codes, in_axes=in_ax)(idx_m, lv_m, n_m,
+                                                      doff_m, dsize_m,
+                                                      loff_m)
+                    for (ci, r), b3, l3, w3, v in zip(
+                            members, bb_m, li_m, lw_m, valid_m):
+                        seg_out[(ci, r)] = (b3, l3, w3, v)
                 elif mode == "dict":
                     dbuf = arrays["dict_" + pkey]
 
@@ -739,19 +795,27 @@ def _make_kernel(fp: _FusedPlan):
             return out[:cap]
 
         cols: List[Optional[DeviceColumn]] = []
-        deferred_info: Dict[int, Tuple] = {}   # ci -> (codes, valid)
+        # ci -> ('int', codes, valid) | ('str', bb, li, lw, valid, L)
+        deferred_info: Dict[int, Tuple] = {}
         for ci, col_specs in enumerate(specs):
             odt = out_dtypes[ci]
             np_t = odt.to_np() if not odt.is_string else None
             col_defer = any(s.defer for s in col_specs)
+            str_defer = col_defer and odt.is_string
             col_L = max((s.dlen for s in col_specs), default=1) \
                 if odt.is_string else 0
             seg_data, seg_valid, seg_lens = [], [], []
+            seg_li, seg_lw = [], []   # string-defer code streams
             for r, s in enumerate(col_specs):
                 if s.mode == "null":
                     if col_defer:
                         seg_data.append(jnp.zeros((vcap,),
                                                   dtype=jnp.int32))
+                        if str_defer:
+                            seg_li.append(jnp.zeros((vcap,),
+                                                    dtype=jnp.int32))
+                            seg_lw.append(jnp.zeros((vcap,),
+                                                    dtype=jnp.int32))
                     elif odt.is_string:
                         seg_data.append(jnp.zeros((vcap, col_L),
                                                   dtype=jnp.uint8))
@@ -763,7 +827,12 @@ def _make_kernel(fp: _FusedPlan):
                                                dtype=jnp.bool_))
                     continue
                 out = seg_out[(ci, r)]
-                if col_defer:
+                if str_defer:
+                    seg_data.append(out[0].astype(jnp.int32))  # bytebase
+                    seg_li.append(out[1].astype(jnp.int32))
+                    seg_lw.append(out[2].astype(jnp.int32))
+                    seg_valid.append(out[3])
+                elif col_defer:
                     seg_data.append(out[0].astype(jnp.int32))
                     seg_valid.append(out[1])
                 elif odt.is_string:
@@ -781,10 +850,16 @@ def _make_kernel(fp: _FusedPlan):
             vb = fp.col_vbits[ci] if fp.col_vbits else None
             nn = all(not s.nullable and s.mode != "null"
                      for s in col_specs)
-            if col_defer:
+            if str_defer:
+                deferred_info[ci] = ("str", stitch(seg_data, np.int32(0)),
+                                     stitch(seg_li, np.int32(0)),
+                                     stitch(seg_lw, np.int32(0)),
+                                     valid, col_L)
+                cols.append(None)
+            elif col_defer:
                 # kernel 2: hold global dictionary codes; decoded in
                 # phase 5 once the pushed filter's mask is known
-                deferred_info[ci] = (stitch(seg_data, np.int32(0)),
+                deferred_info[ci] = ("int", stitch(seg_data, np.int32(0)),
                                      valid)
                 cols.append(None)
             elif odt.is_string:
@@ -816,14 +891,29 @@ def _make_kernel(fp: _FusedPlan):
             cv = eval_tpu.evaluate(cond, eval_batch)
             keep = cv.data.astype(jnp.bool_) & cv.validity & \
                 (jnp.arange(cap) < total)
-            for ci, (codes, valid) in deferred_info.items():
+            for ci, dinfo in deferred_info.items():
                 odt = out_dtypes[ci]
+                nn = all(not s.nullable and s.mode != "null"
+                         for s in specs[ci])
+                if dinfo[0] == "str":
+                    _k, bb, li, lw, valid, col_L = dinfo
+                    keepv = keep & valid
+                    mat = kfd.decode_str_pallas(
+                        arrays["dict_u8str"], bb, lw, keepv, col_L,
+                        tile_bytes=fp.tile_bytes)
+                    lens = kfd.decode_pallas(
+                        arrays["dict_strlens"], li, keepv,
+                        tile_bytes=fp.tile_bytes)
+                    cols[ci] = DeviceColumn(
+                        odt, mat, valid, lens.astype(jnp.int32),
+                        nonnull=nn)
+                    continue
+                _k, codes, valid = dinfo
                 np_t = odt.to_np()
                 s0 = next(s for s in specs[ci] if s.defer)
                 dbuf = arrays["dict_" + s0.plain_key]
-                vals = kfd.decode_pallas(dbuf, codes, keep & valid)
-                nn = all(not s.nullable and s.mode != "null"
-                         for s in specs[ci])
+                vals = kfd.decode_pallas(dbuf, codes, keep & valid,
+                                         tile_bytes=fp.tile_bytes)
                 cols[ci] = DeviceColumn(
                     odt, vals.astype(np_t), valid,
                     vbits=fp.col_vbits[ci] if fp.col_vbits else None,

@@ -55,9 +55,15 @@ XLA = "xla"
 PALLAS = "pallas"
 
 _lock = threading.Lock()
-_default_backend = XLA
+_default_backend = PALLAS
 _interpret_mode = "auto"        # auto | true | false
+_tile_bytes = 4 << 20           # kernel.pallas.tileBytes default
 _pallas_available: Optional[bool] = None
+# memoized resolution of interpret='auto' (the active-jax-backend
+# probe): jax.default_backend() is a per-dispatch cost the tile-plan /
+# kernel-selection hot path must not pay, and the platform cannot
+# change mid-process.  Pinned modes ('true'/'false') bypass the memo.
+_auto_interpret: Optional[bool] = None
 
 
 def configure(conf) -> None:
@@ -69,17 +75,24 @@ def configure(conf) -> None:
     (plan/overrides.py), which wins over this default wherever a plan
     node is in scope."""
     from spark_rapids_tpu import config as cfg
-    global _default_backend, _interpret_mode
-    backend = str(conf.get(cfg.KERNEL_BACKEND) or XLA).strip().lower()
+    global _default_backend, _interpret_mode, _tile_bytes
+    backend = str(conf.get(cfg.KERNEL_BACKEND) or PALLAS).strip().lower()
     if backend not in (XLA, PALLAS):
         raise ValueError(
             f"spark.rapids.tpu.kernel.backend must be 'xla' or "
             f"'pallas', got {backend!r}")
     mode = str(conf.get(cfg.KERNEL_PALLAS_INTERPRET)
                or "auto").strip().lower()
+    tb_raw = conf.get(cfg.KERNEL_PALLAS_TILE_BYTES)
+    tb = int(tb_raw) if tb_raw is not None else (4 << 20)
+    if tb < (64 << 10):
+        raise ValueError(
+            f"spark.rapids.tpu.kernel.pallas.tileBytes must be at "
+            f"least 64 KiB, got {tb}")
     with _lock:
         _default_backend = backend
         _interpret_mode = mode
+        _tile_bytes = tb
 
 
 def default_backend() -> str:
@@ -133,18 +146,51 @@ def interpret() -> bool:
     """Run Pallas kernels in interpreter mode?  ``auto`` (default):
     interpret unless the active jax backend is a real TPU — so tier-1
     CPU runs execute the genuine kernel bodies.  The knob pins it for
-    debugging (``true``) or to force Mosaic compilation (``false``)."""
+    debugging (``true``) or to force Mosaic compilation (``false``).
+
+    The ``auto`` probe (``jax.default_backend()``) is memoized: it used
+    to re-resolve on every dispatch/tile-plan lookup, but the active
+    platform cannot change mid-process — only the pinned modes bypass
+    the memo (they are a plain mode-string compare anyway)."""
+    global _auto_interpret
     with _lock:
         mode = _interpret_mode
     if mode in ("true", "1", "yes", "on"):
         return True
     if mode in ("false", "0", "no", "off"):
         return False
+    if _auto_interpret is None:
+        try:
+            import jax
+            _auto_interpret = jax.default_backend() != "tpu"
+        except Exception:
+            _auto_interpret = True
+    return _auto_interpret
+
+
+def tile_bytes() -> int:
+    """Per-tile byte budget of the HBM->VMEM streaming tiler
+    (``kernel.pallas.tileBytes``) — the knob kernels/tiling.py plans
+    grids against.  Part of every tiled kernel's cache key (via the
+    tile plan's block/tile shapes), so flipping it mid-process can
+    never serve a stale grid."""
+    with _lock:
+        return _tile_bytes
+
+
+@contextmanager
+def tile_bytes_override(n: int):
+    """Scoped tileBytes override for benches and tile-boundary tests
+    (forcing multi-tile grids on small buffers)."""
+    global _tile_bytes
+    with _lock:
+        prev = _tile_bytes
+        _tile_bytes = int(n)
     try:
-        import jax
-        return jax.default_backend() != "tpu"
-    except Exception:
-        return True
+        yield
+    finally:
+        with _lock:
+            _tile_bytes = prev
 
 
 def hit(family: str, n: int = 1) -> None:
@@ -162,6 +208,23 @@ def fallback(family: str, reason: str, n: int = 1) -> None:
     obsreg.get_registry().inc_many(
         ("kernel.backend.pallas.fallbacks", n),
         (f"kernel.backend.pallas.fallbacks.{family}.{reason}", n))
+
+
+def record_tiles(family: str, n_tiles: int, tile_nbytes: int) -> None:
+    """Count one tiled-kernel selection's streaming volume: how many
+    HBM->VMEM source tiles the grid walks and how many bytes they
+    cover.  These counters replaced the retired whole-buffer residency
+    fallbacks (``dense_too_large``/``dict_too_large``/``src_too_large``
+    reasons): a buffer past the old gates now shows up as a large tile
+    count instead of an XLA fallback.  Same counting semantics as
+    :func:`hit` — host call sites count per batch, trace-time call
+    sites once per compile."""
+    from spark_rapids_tpu.obs import registry as obsreg
+    obsreg.get_registry().inc_many(
+        ("kernel.pallas.tiles", n_tiles),
+        (f"kernel.pallas.tiles.{family}", n_tiles),
+        ("kernel.pallas.tileBytes", n_tiles * tile_nbytes),
+        (f"kernel.pallas.tileBytes.{family}", n_tiles * tile_nbytes))
 
 
 def selection_snapshot() -> dict:

@@ -28,12 +28,21 @@ shift(<=7) + w), so widths 25-32 previously fell all the way back to
 host Arrow decode; under ``kernel.backend=pallas`` they stay on
 device (the per-kernel-fallback cliff the motivation cites).
 
+Arbitrarily large dense-value buffers STREAM through the expand kernel
+(kernels/tiling.py): the grid gains a second dimension over fixed-size
+dense tiles (``kernel.pallas.tileBytes``), the output block stays
+VMEM-resident across the tile sweep, and each tile's gather runs only
+under ``pl.when`` when some element of the block actually indexes into
+it — the dense index of a hybrid stream is monotone non-decreasing, so
+almost every (block, tile) cell skips.  This replaced the PR 9 64 MiB
+``dense_too_large`` residency fallback; tile volume is observable as
+``kernel.pallas.tiles.decode.expand``.
+
 Fallback matrix (reasons land in
 ``kernel.backend.pallas.fallbacks.decode.*``): mixed bit widths within
-one stream, values too wide for the i32 step function, a dense buffer
-past the residency gate, or shapes off the 32-value alignment grid.
-Everything unsupported takes the existing XLA (or host) path for that
-stream only.
+one stream, values too wide for the i32 step function, or shapes off
+the 32-value alignment grid.  Everything unsupported takes the
+existing XLA (or host) path for that stream only.
 """
 
 from __future__ import annotations
@@ -46,18 +55,15 @@ import jax
 import jax.numpy as jnp
 
 from spark_rapids_tpu.kernels import backend as kb
+from spark_rapids_tpu.kernels import tiling
 
 # by-construction per-element gather counts of the two stream-expansion
 # formulations (XLA's count is additionally measured from its traced
 # jaxpr by tests/test_kernels.py and bench.py's kernels probe)
 GATHERS_PER_ELEMENT = {"xla": 9, "pallas": 1}
 
-_UNPACK_BLOCK = 8192      # values per grid step (phase 0)
-_EXPAND_BLOCK = 8192      # elements per grid step (phase 2)
-# dense-value residency gate for the expand kernel (bytes); streams
-# past it fall back — on-hardware tiling of the dense buffer through
-# the HBM->VMEM double-buffer pattern is the first follow-up there
-_DENSE_MAX_BYTES = 64 << 20
+_UNPACK_BLOCK = 8192      # base values per grid step (phase 0)
+_EXPAND_BLOCK = 8192      # base elements per grid step (phase 2)
 
 
 # ---------------------------------------------------------------------------
@@ -118,10 +124,17 @@ def _unpack_body(w: int, B: int):
     return kernel
 
 
+def _unpack_block(ncap: int) -> int:
+    """Adaptive phase-0 block: pow2, grows with ncap (bounded grid —
+    a 16M-value buffer is a 128-cell grid, not 2048) while staying on
+    the 32-value alignment the (word, shift) slot table needs."""
+    return tiling.plan("decode.unpack", ncap, 1, 1, _UNPACK_BLOCK).block
+
+
 def _unpack_pallas(bytes_arr: jnp.ndarray, w: int,
                    ncap: int) -> jnp.ndarray:
     from jax.experimental import pallas as pl
-    B = min(ncap, _UNPACK_BLOCK)
+    B = min(ncap, _unpack_block(ncap))
     bpb = B * w // 8                  # bytes per block
     return pl.pallas_call(
         _unpack_body(w, B),
@@ -135,7 +148,7 @@ def _unpack_pallas(bytes_arr: jnp.ndarray, w: int,
 
 def _unpack_supported(w: int, ncap: int, nbytes: int) -> bool:
     return (1 <= w <= 32 and ncap % 32 == 0 and
-            ncap % min(ncap, _UNPACK_BLOCK) == 0 and
+            ncap % min(ncap, _unpack_block(ncap)) == 0 and
             nbytes == ncap * w // 8 and nbytes % 4 == 0)
 
 
@@ -155,38 +168,70 @@ def unpack_bits(bytes_arr: jnp.ndarray, w: int, ncap: int,
 
 
 # ---------------------------------------------------------------------------
-# phase 2: run expansion (one gather/element)
+# phase 2: run expansion (one gather/element, dense tiles streamed)
 # ---------------------------------------------------------------------------
 
-def _expand_body(B: int):
+def _expand_body(B: int, T: int, dlen: int):
+    """2D-grid kernel body: element block i against dense tile j.
+
+    The output block is VMEM-resident across the whole tile sweep
+    (its index map ignores j): j == 0 writes the RLE lanes and zeros,
+    each tile then overwrites exactly the bit-packed lanes whose
+    (clipped) dense index falls inside it — the index is unique per
+    lane, so accumulation is a plain masked select, and the gather is
+    ``pl.when``-elided for tiles no lane of this block references
+    (monotone dense indices make that the overwhelming case)."""
     from jax.experimental import pallas as pl
 
     def kernel(d_ref, a_ref, c_ref, o_ref):
         base = pl.program_id(0) * B
+        j = pl.program_id(1)
         i = jax.lax.broadcasted_iota(jnp.int32, (B, 1), 0)[:, 0] + base
         a = a_ref[:]
         c = c_ref[:]
-        d = d_ref[:]
-        idx = jnp.clip(a + i, 0, d.shape[0] - 1)
-        vals = jnp.take(d, idx)     # the ONE per-element gather,
-        #                             dense-value-resident per block
-        o_ref[:] = jnp.where((c & 1) != 0, (c >> 1).astype(jnp.uint32),
-                             vals)
+        rle = (c & 1) != 0
+        # the clip mirrors the untiled formulation exactly: padding
+        # lanes ride the last run's step function past dlen and land
+        # (clipped) in the final tile, same value as before tiling
+        idx = jnp.clip(a + i, 0, dlen - 1)
+
+        @pl.when(j == 0)
+        def _():
+            o_ref[:] = jnp.where(rle, (c >> 1).astype(jnp.uint32),
+                                 jnp.uint32(0))
+
+        lo = j * T
+        in_tile = jnp.logical_not(rle) & (idx >= lo) & (idx < lo + T)
+
+        @pl.when(jnp.any(in_tile))
+        def _():
+            local = jnp.clip(idx - lo, 0, T - 1).astype(jnp.int32)
+            vals = jnp.take(d_ref[:], local)   # the ONE per-element
+            #                                    gather, tile-resident
+            o_ref[:] = jnp.where(in_tile, vals, o_ref[:])
     return kernel
 
 
 def _expand_pallas(dense: jnp.ndarray, a: jnp.ndarray, c: jnp.ndarray,
-                   cap: int) -> jnp.ndarray:
+                   cap: int,
+                   p: "tiling.TilePlan | None" = None) -> jnp.ndarray:
     from jax.experimental import pallas as pl
-    B = min(cap, _EXPAND_BLOCK)
     dlen = dense.shape[0]
+    if p is None:
+        p = tiling.plan("decode.expand", cap, dlen, 4, _EXPAND_BLOCK)
+    B, T = p.block, p.tile
+    if p.src_pad != dlen:
+        # ragged final tile: pad the dense buffer to the tile grid (a
+        # dense device-side pad); pad lanes are reachable only through
+        # the clip, which in_tile already restricts to < dlen
+        dense = jnp.pad(dense, (0, p.src_pad - dlen))
     return pl.pallas_call(
-        _expand_body(B),
-        grid=(cap // B,),
-        in_specs=[pl.BlockSpec((dlen,), lambda i: (0,)),
-                  pl.BlockSpec((B,), lambda i: (i,)),
-                  pl.BlockSpec((B,), lambda i: (i,))],
-        out_specs=pl.BlockSpec((B,), lambda i: (i,)),
+        _expand_body(B, T, dlen),
+        grid=(cap // B, p.n_tiles),
+        in_specs=[pl.BlockSpec((T,), lambda i, j: (j,)),
+                  pl.BlockSpec((B,), lambda i, j: (i,)),
+                  pl.BlockSpec((B,), lambda i, j: (i,))],
+        out_specs=pl.BlockSpec((B,), lambda i, j: (i,)),
         out_shape=jax.ShapeDtypeStruct((cap,), jnp.uint32),
         interpret=kb.interpret(),
     )(dense, a, c)
@@ -262,9 +307,12 @@ def _dense_meta(runs, w: int, rcap: int) -> np.ndarray:
     return mat
 
 
-def _expand_impl(w: int, ncap: int, cap: int):
+def _expand_impl(w: int, ncap: int, cap: int, plan=None):
     """Device half of the Pallas stream expansion (jitted once per
-    (w, ncap, cap, interpret) via the kernel cache)."""
+    (w, ncap, cap, interpret, block, tile) via the kernel cache).
+    ``plan`` is the tile plan the CALLER keyed the kernel on — trace
+    time must use exactly that geometry, not a fresh read of the
+    process tileBytes knob."""
     def run(mat: jnp.ndarray, packed: jnp.ndarray) -> jnp.ndarray:
         if w:
             dense = _unpack_pallas(packed, w, ncap)
@@ -281,7 +329,7 @@ def _expand_impl(w: int, ncap: int, cap: int):
             mat[:, 1], mode="drop"))
         c = jnp.cumsum(jnp.zeros((cap,), mat.dtype).at[starts].add(
             mat[:, 2], mode="drop"))
-        return _expand_pallas(dense, a, c, cap)
+        return _expand_pallas(dense, a, c, cap, p=plan)
     return run
 
 
@@ -325,17 +373,22 @@ def expand_stream(runs, packed: bytes, cap: int,
                 if not r)
     ncap = bucket_rows(max(nvals, 1), 32)
     if ok and w:
-        ok = _unpack_supported(w, ncap, ncap * w // 8) and \
-            ncap * 4 <= _DENSE_MAX_BYTES
-        reason = reason or ("dense_too_large"
-                            if ncap * 4 > _DENSE_MAX_BYTES else "shape")
+        ok = _unpack_supported(w, ncap, ncap * w // 8)
+        reason = reason or "shape"
+    # tile plan for the dense gather source (the streaming replacement
+    # for the retired 64 MiB dense_too_large residency gate); its
+    # block/tile shapes join the kernel key — derived from tier-
+    # bucketed caps + the process tileBytes, so keys stay coarse
+    p = tiling.plan("decode.expand", cap, max(ncap, 32) if w else 32,
+                    4, _EXPAND_BLOCK)
     if ok:
-        ok = cap % min(cap, _EXPAND_BLOCK) == 0
+        ok = cap % p.block == 0
         reason = reason or "shape"
     bk = kb.choose("decode.expand", kb.PALLAS, ok,
                    reason=reason or "unsupported")
     if bk != kb.PALLAS:
         return xla_path()
+    kb.record_tiles("decode.expand", p.n_tiles, p.tile_nbytes)
 
     rcap = bucket_rows(max(len(runs.counts), 1), 8)
     mat = _dense_meta(runs, w, rcap)
@@ -343,7 +396,7 @@ def expand_stream(runs, packed: bytes, cap: int,
     packed_dev = jnp.asarray(dp._pad_np(pbytes, max(ncap * w // 8, 4)))
     kern = kc.get_kernel(
         ("decode_expand", kb.PALLAS, w, rcap, ncap, cap,
-         str(mat.dtype), kb.interpret()),
-        lambda: _expand_impl(w, ncap, cap),
+         str(mat.dtype), kb.interpret(), p.block, p.tile),
+        lambda: _expand_impl(w, ncap, cap, plan=p),
         backend=kb.PALLAS)
     return kern(jnp.asarray(mat), packed_dev)

@@ -22,30 +22,95 @@ and accounting happen host-side at scan-prepare time
 (io/parquet_fused.py): per-batch ``kernel.backend.pallas.hits`` /
 ``.fallbacks.scan.filterDecode.*`` counters, per-kernel fallback to
 the ordinary decode-everything path.
+
+Arbitrarily large dictionaries STREAM through the kernels
+(kernels/tiling.py): a second grid dimension walks the dictionary in
+``kernel.pallas.tileBytes`` tiles, the output block stays VMEM-
+resident across the sweep, and the per-tile gather is doubly
+predicated — skipped when every row of the block failed the filter
+AND when no surviving row's code lands in this tile.  This replaced
+the PR 9 16 MiB ``dict_too_large`` residency fallback
+(``kernel.pallas.tiles.scan.filterDecode`` counts streamed volume).
+
+STRING dictionaries defer the same way (the widest decode cost in the
+compile-bill top-10 is string-keyed): the fused decode stitches three
+int32 code arrays per deferred string column — per-row byte base into
+the shared u8 dictionary matrix buffer, per-row index into the
+dictionary-lengths buffer, and the segment's static row stride — and
+post-filter :func:`decode_str_pallas` gathers the byte matrix tile-
+wise (each (row, char) cell predicated into its tile) while
+:func:`decode_pallas` over the int32 lengths buffer recovers per-row
+lengths.  Layouts the string tiler can't express (a row stride too
+wide for even the minimum element block's 2-D VMEM footprint) fall
+back per batch with reason ``string_layout``.
 """
 
 from __future__ import annotations
 
 from typing import Tuple
 
+import numpy as np
+
 import jax
 import jax.numpy as jnp
 
 from spark_rapids_tpu.kernels import backend as kb
+from spark_rapids_tpu.kernels import tiling
 
 _BLOCK = 2048
-# dictionary-residency gate (bytes) — see the decode-kernel note about
-# HBM->VMEM tiling as the on-hardware follow-up
-_DICT_MAX_BYTES = 16 << 20
+# minimum element block of the 2-D string gather: below this the grid
+# degenerates (and TPU sublane tiling would pad anyway) — a row stride
+# that cannot fit _STR_MIN_BLOCK rows in a tile budget is the one
+# layout the string tiler refuses (reason ``string_layout``)
+_STR_MIN_BLOCK = 8
 
 
-def supported(cap: int, dict_len: int, itemsize: int
-              ) -> Tuple[bool, str]:
-    if dict_len * itemsize > _DICT_MAX_BYTES:
-        return False, "dict_too_large"
-    if not (cap <= _BLOCK or cap % _BLOCK == 0):
+def supported(cap: int) -> Tuple[bool, str]:
+    # no dictionary-size gate: dictionaries past one tileBytes tile
+    # stream through the 2D grid (the retired dict_too_large reason);
+    # only the element-block grid must divide.  The block is a pure
+    # function of cap (never of tileBytes), so this gate cannot drift
+    # from trace-time geometry.
+    B = _block(cap)
+    if not (cap <= B or cap % B == 0):
         return False, "shape"
     return True, ""
+
+
+def str_supported(cap: int, width: int,
+                  tile_bytes: "int | None" = None) -> Tuple[bool, str]:
+    """Per-batch eligibility of the deferred STRING decode: the 2-D
+    output block (rows x width) must fit the tile budget at the
+    minimum element block, and the row grid must divide.  Callers that
+    gate at plan-assemble time must pass the SAME ``tile_bytes`` they
+    later hand :func:`decode_str_pallas` (the fused plan stamps it)."""
+    B = _str_block(cap, width, tile_bytes)
+    if B < _STR_MIN_BLOCK:
+        return False, "string_layout"
+    if not (cap <= B or cap % B == 0):
+        return False, "shape"
+    return True, ""
+
+
+def _block(cap: int) -> int:
+    """Adaptive element block (pow2, bounded grid) for the 1-D gather."""
+    return min(cap, tiling.plan("scan.filterDecode", cap, 1, 1,
+                                _BLOCK).block)
+
+
+def _str_block(cap: int, width: int,
+               tile_bytes: "int | None" = None) -> int:
+    """Element block of the 2-D string gather: bounded so the (B, width)
+    u8 output block plus its i32 index/mask planes stay within the tile
+    budget (~5 bytes per (row, char) cell)."""
+    tb = int(tile_bytes) if tile_bytes is not None else kb.tile_bytes()
+    budget = max(tb // max(width * 5, 1), 1)
+    b = _STR_MIN_BLOCK
+    while b * 2 <= min(budget, _BLOCK):
+        b *= 2
+    if b > budget:
+        return 0
+    return min(cap, b)
 
 
 def decode_xla(dbuf: jnp.ndarray, codes: jnp.ndarray,
@@ -58,39 +123,119 @@ def decode_xla(dbuf: jnp.ndarray, codes: jnp.ndarray,
 
 
 def decode_pallas(dbuf: jnp.ndarray, codes: jnp.ndarray,
-                  keep: jnp.ndarray) -> jnp.ndarray:
-    """Predicated dictionary gather: one [cap]-element pass, gathers
-    only in blocks with at least one surviving row."""
+                  keep: jnp.ndarray,
+                  tile_bytes: "int | None" = None) -> jnp.ndarray:
+    """Predicated dictionary gather, dictionary streamed tile-wise:
+    one [cap]-element pass that gathers only in (block, tile) cells
+    where at least one surviving row's code lands in the tile."""
     from jax.experimental import pallas as pl
-    import numpy as np
     cap = codes.shape[0]
-    B = min(cap, _BLOCK)
     dlen = dbuf.shape[0]
+    B = _block(cap)
+    p = tiling.plan("scan.filterDecode", cap, dlen,
+                    np.dtype(dbuf.dtype).itemsize, B, block_max=B,
+                    tile_bytes=tile_bytes)
+    T, n_tiles = p.tile, p.n_tiles
+    # runs at trace time of the enclosing fused-decode kernel: tile
+    # volume counts once per compile (the kb.hit counting semantics)
+    kb.record_tiles("scan.filterDecode", n_tiles, p.tile_nbytes)
+    if p.src_pad != dlen:
+        dbuf = jnp.pad(dbuf, (0, p.src_pad - dlen))
     # numpy scalar, not a traced 0-d array: a traced closure constant
     # would be a captured value pallas_call rejects
     zero = np.zeros((), np.dtype(dbuf.dtype))[()]
 
     def kernel(d_ref, c_ref, k_ref, o_ref):
+        j = pl.program_id(1)
         k = k_ref[:]
-        any_kept = jnp.any(k)
+        c = jnp.clip(c_ref[:], 0, dlen - 1)   # decode_xla's exact clip
 
-        @pl.when(any_kept)
-        def _():
-            idx = jnp.clip(c_ref[:], 0, dlen - 1)
-            vals = jnp.take(d_ref[:], idx)
-            o_ref[:] = jnp.where(k, vals, zero)
-
-        @pl.when(jnp.logical_not(any_kept))
+        @pl.when(j == 0)
         def _():
             o_ref[:] = jnp.full((B,), zero)
 
+        lo = j * T
+        in_tile = k & (c >= lo) & (c < lo + T)
+
+        @pl.when(jnp.any(in_tile))
+        def _():
+            local = jnp.clip(c - lo, 0, T - 1).astype(jnp.int32)
+            vals = jnp.take(d_ref[:], local)
+            o_ref[:] = jnp.where(in_tile, vals, o_ref[:])
+
     return pl.pallas_call(
         kernel,
-        grid=(cap // B,),
-        in_specs=[pl.BlockSpec((dlen,), lambda i: (0,)),
-                  pl.BlockSpec((B,), lambda i: (i,)),
-                  pl.BlockSpec((B,), lambda i: (i,))],
-        out_specs=pl.BlockSpec((B,), lambda i: (i,)),
+        grid=(cap // B, n_tiles),
+        in_specs=[pl.BlockSpec((T,), lambda i, j: (j,)),
+                  pl.BlockSpec((B,), lambda i, j: (i,)),
+                  pl.BlockSpec((B,), lambda i, j: (i,))],
+        out_specs=pl.BlockSpec((B,), lambda i, j: (i,)),
         out_shape=jax.ShapeDtypeStruct((cap,), dbuf.dtype),
         interpret=kb.interpret(),
     )(dbuf, codes, keep)
+
+
+def decode_str_pallas(dbuf: jnp.ndarray, byte_base: jnp.ndarray,
+                      lw: jnp.ndarray, keep: jnp.ndarray,
+                      width: int,
+                      tile_bytes: "int | None" = None) -> jnp.ndarray:
+    """Predicated STRING-dictionary byte gather, the u8 dictionary
+    matrix buffer streamed tile-wise: surviving row r reads bytes
+    ``dbuf[byte_base[r] : byte_base[r] + lw[r]]`` into out[r, :lw[r]]
+    (``lw`` is the segment's static row stride, 0 past it and on
+    dropped/invalid rows).  Each (row, char) cell is predicated into
+    the tile holding its byte, so a row's bytes may span tiles freely
+    and all-dropped blocks never gather at all."""
+    from jax.experimental import pallas as pl
+    cap = byte_base.shape[0]
+    dlen = dbuf.shape[0]
+    B = _str_block(cap, width, tile_bytes)
+    assert B >= _STR_MIN_BLOCK, "caller must gate via str_supported"
+    p = tiling.plan("scan.filterDecode.str", cap, dlen, 1, B,
+                    block_max=B, tile_bytes=tile_bytes)
+    T, n_tiles = p.tile, p.n_tiles
+    kb.record_tiles("scan.filterDecode.str", n_tiles, p.tile_nbytes)
+    if p.src_pad != dlen:
+        dbuf = jnp.pad(dbuf, (0, p.src_pad - dlen))
+
+    def kernel(d_ref, bb_ref, lw_ref, k_ref, o_ref):
+        j = pl.program_id(1)
+        col = jax.lax.broadcasted_iota(jnp.int32, (B, width), 1)
+        bb = bb_ref[:]
+        live = k_ref[:][:, None] & (col < lw_ref[:][:, None])
+        bidx = jnp.clip(bb[:, None] + col, 0, dlen - 1)
+
+        @pl.when(j == 0)
+        def _():
+            o_ref[:] = jnp.zeros((B, width), jnp.uint8)
+
+        lo = j * T
+        in_tile = live & (bidx >= lo) & (bidx < lo + T)
+
+        @pl.when(jnp.any(in_tile))
+        def _():
+            local = jnp.clip(bidx - lo, 0, T - 1)
+            vals = jnp.take(d_ref[:], local)
+            o_ref[:] = jnp.where(in_tile, vals, o_ref[:])
+
+    return pl.pallas_call(
+        kernel,
+        grid=(cap // B, n_tiles),
+        in_specs=[pl.BlockSpec((T,), lambda i, j: (j,)),
+                  pl.BlockSpec((B,), lambda i, j: (i,)),
+                  pl.BlockSpec((B,), lambda i, j: (i,)),
+                  pl.BlockSpec((B,), lambda i, j: (i,))],
+        out_specs=pl.BlockSpec((B, width), lambda i, j: (i, 0)),
+        out_shape=jax.ShapeDtypeStruct((cap, width), jnp.uint8),
+        interpret=kb.interpret(),
+    )(dbuf, byte_base, lw, keep)
+
+
+def str_decode_xla(dbuf: jnp.ndarray, byte_base: jnp.ndarray,
+                   lw: jnp.ndarray, keep: jnp.ndarray,
+                   width: int) -> jnp.ndarray:
+    """XLA oracle of :func:`decode_str_pallas` (tests/CI parity)."""
+    col = jnp.arange(width, dtype=jnp.int32)[None, :]
+    bidx = jnp.clip(byte_base[:, None] + col, 0, dbuf.shape[0] - 1)
+    live = keep[:, None] & (col < lw[:, None])
+    return jnp.where(live, jnp.take(dbuf, bidx), jnp.uint8(0))

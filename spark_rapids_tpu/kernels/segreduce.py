@@ -38,13 +38,9 @@ import jax
 import jax.numpy as jnp
 
 from spark_rapids_tpu.kernels import backend as kb
+from spark_rapids_tpu.kernels import tiling
 
 _BLOCK = 1 << 15          # MUST match exec/scans._BLOCK (float parity)
-# source-array residency gate (bytes): the gather path block-loads the
-# full [cap] value array (and the sorted path its block) — past this,
-# fall back rather than hand Mosaic an over-VMEM allocation with no
-# recovery (the same pending-tiling gate as decode/_DENSE_MAX_BYTES)
-_SRC_MAX_BYTES = 64 << 20
 
 _OPS = {"add": jnp.add, "min": jnp.minimum, "max": jnp.maximum}
 
@@ -59,18 +55,36 @@ def op_name(op) -> Optional[str]:
     return None
 
 
+# element-block ceiling of the NON-blocked scan path (bytes): narrow
+# out dtypes (< 8-byte — int32 counts/narrow sums, f32) run ONE
+# full-array associative_scan, exactly mirroring exec/scans.seg_scan,
+# so their element block is cap-sized and cannot tile without changing
+# the scan tree (float parity).  Streaming removed the SOURCE gate
+# (src_too_large, retired — sources of any size tile through VMEM);
+# this bound only keeps the un-tileable cap-sized blocks of the narrow
+# path within the old envelope, with its own reason tag.
+_NARROW_BLOCK_MAX_BYTES = 64 << 20
+
+
 def supported(cap: int, dtype, op: Optional[str], ndim: int = 1
               ) -> Tuple[bool, str]:
     if op is None:
         return False, "op"
     if ndim != 1:
         return False, "ndim"
-    if np.dtype(dtype).kind not in "iufb":
+    dt_ = np.dtype(dtype)
+    if dt_.kind not in "iufb":
         return False, "dtype"
     if not (cap <= _BLOCK or cap % _BLOCK == 0):
         return False, "shape"
-    if cap * np.dtype(dtype).itemsize > _SRC_MAX_BYTES:
-        return False, "src_too_large"
+    # no SOURCE size gate: the gather path streams the source array
+    # through VMEM in kernel.pallas.tileBytes tiles (the retired
+    # src_too_large residency fallback; kernel.pallas.tiles.* counts
+    # the streamed volume).  Narrow dtypes scan un-blocked (cap-sized
+    # element blocks — see _NARROW_BLOCK_MAX_BYTES).
+    if dt_.itemsize < 8 and cap > _BLOCK and \
+            cap * dt_.itemsize > _NARROW_BLOCK_MAX_BYTES:
+        return False, "wide_block"
     return True, ""
 
 
@@ -82,24 +96,19 @@ def _combine(op):
     return combine
 
 
-def _seg_kernel(op, B: int, blocked: bool, gather: bool, scan_np):
-    """Kernel body: [optional sorted-order gather ->] in-block
-    segmented scan [-> carry across blocks].  Blocked kernels take the
-    op identity as a (1,)-shaped INPUT (last in_ref): it may be a
-    traced value (e.g. the string-min word sentinel built under jit),
-    which a closure constant could not carry."""
+def _seg_kernel(op, B: int, blocked: bool, scan_np):
+    """Sorted-path kernel body (1D grid): in-block segmented scan
+    [-> carry across blocks].  Blocked kernels take the op identity as
+    a (1,)-shaped INPUT (last in_ref): it may be a traced value (e.g.
+    the string-min word sentinel built under jit), which a closure
+    constant could not carry."""
     from jax.experimental import pallas as pl
     combine = _combine(op)
 
     def kernel(*refs):
-        if gather:
-            x_ref, ord_ref, f_ref = refs[:3]
-            rest = refs[3:]
-            v = jnp.take(x_ref[:], ord_ref[:])
-        else:
-            v_ref, f_ref = refs[:2]
-            rest = refs[2:]
-            v = v_ref[:]
+        v_ref, f_ref = refs[:2]
+        rest = refs[2:]
+        v = v_ref[:]
         if scan_np is not None:
             v = v.astype(scan_np)
         f = f_ref[:]
@@ -124,10 +133,76 @@ def _seg_kernel(op, B: int, blocked: bool, gather: bool, scan_np):
     return kernel
 
 
+def _seg_gather_kernel(op, B: int, T: int, n_tiles: int, blocked: bool,
+                       scan_np):
+    """Gather-path kernel body (2D grid over element blocks x source
+    tiles): the sorted-order gather accumulates into a VMEM scratch
+    across the tile sweep — each lane's source index (a permutation
+    entry) lands in exactly one tile, and ``pl.when`` skips tiles no
+    lane of this block references — then the LAST tile runs the exact
+    in-block segmented scan + (flag, value) SMEM carry of the sorted
+    path.  The scan structure (one associative_scan per ``B`` block,
+    elementwise carry combine, identical combine order) is untouched by
+    the tiling — only WHERE the gathered operand block comes from
+    changed — so float results stay bit-identical to exec/scans.seg_scan
+    across tile boundaries, including segments spanning many tiles."""
+    from jax.experimental import pallas as pl
+    combine = _combine(op)
+
+    def kernel(*refs):
+        x_ref, ord_ref, f_ref = refs[:3]
+        rest = refs[3:]
+        if blocked:
+            ident_ref, o_ref, vacc_ref, cf_ref, cv_ref = rest
+        else:
+            o_ref, vacc_ref = rest[0], rest[1]
+        # program ids hoisted: interpret-mode lowering cannot rewrite
+        # the primitive inside a pl.when sub-jaxpr
+        i = pl.program_id(0)
+        j = pl.program_id(1)
+        o = ord_ref[:]
+        lo = j * T
+        in_tile = (o >= lo) & (o < lo + T)
+
+        @pl.when(jnp.any(in_tile))
+        def _():
+            local = jnp.clip(o - lo, 0, T - 1).astype(jnp.int32)
+            vals = jnp.take(x_ref[:], local)
+            if n_tiles == 1:
+                vacc_ref[:] = vals
+            else:
+                vacc_ref[:] = jnp.where(in_tile, vals, vacc_ref[:])
+
+        @pl.when(j == n_tiles - 1)
+        def _():
+            v = vacc_ref[:]
+            if scan_np is not None:
+                v = v.astype(scan_np)
+            f = f_ref[:]
+            if not blocked:
+                _pf, s = jax.lax.associative_scan(combine, (f, v))
+                o_ref[:] = s
+                return
+
+            @pl.when(i == 0)
+            def _():
+                cf_ref[0] = False
+                cv_ref[0] = ident_ref[0]
+            pf, pv = jax.lax.associative_scan(combine, (f, v))
+            cf = jnp.broadcast_to(cf_ref[0], pf.shape)
+            cv = jnp.broadcast_to(cv_ref[0], pv.shape)
+            of, ov = combine((cf, cv), (pf, pv))
+            o_ref[:] = ov
+            cf_ref[0] = of[-1]
+            cv_ref[0] = ov[-1]
+    return kernel
+
+
 def _run(new: jnp.ndarray, op_key: str, identity, out_np,
          x_sorted: Optional[jnp.ndarray] = None,
          x_full: Optional[jnp.ndarray] = None,
-         order: Optional[jnp.ndarray] = None) -> jnp.ndarray:
+         order: Optional[jnp.ndarray] = None,
+         tile_bytes: Optional[int] = None) -> jnp.ndarray:
     from jax.experimental import pallas as pl
     from jax.experimental.pallas import tpu as pltpu
     op = _OPS[op_key]
@@ -141,29 +216,59 @@ def _run(new: jnp.ndarray, op_key: str, identity, out_np,
     # or small caps, 2^15 blocks + carry otherwise (float bit-parity)
     blocked = out_dt.itemsize >= 8 and cap > _BLOCK
     B = _BLOCK if blocked else cap
-    kernel = _seg_kernel(op, B, blocked, gather, scan_np)
 
-    if gather:
-        n_src = src.shape[0]
-        in_specs = [pl.BlockSpec((n_src,), lambda i: (0,)),
-                    pl.BlockSpec((B,), lambda i: (i,)),
-                    pl.BlockSpec((B,), lambda i: (i,))]
-        args = [src, order, new]
-    else:
+    if not gather:
+        # sorted path: the operand is already element-blocked; no large
+        # resident source, 1D grid as before
+        kernel = _seg_kernel(op, B, blocked, scan_np)
         in_specs = [pl.BlockSpec((B,), lambda i: (i,)),
                     pl.BlockSpec((B,), lambda i: (i,))]
         args = [src, new]
-    scratch = []
+        scratch = []
+        if blocked:
+            in_specs.append(pl.BlockSpec((1,), lambda i: (0,)))
+            args.append(jnp.full((1,), identity, dtype=out_dt))
+            scratch = [pltpu.SMEM((1,), jnp.bool_),
+                       pltpu.SMEM((1,), out_dt)]
+        return pl.pallas_call(
+            kernel,
+            grid=(cap // B,),
+            in_specs=in_specs,
+            out_specs=pl.BlockSpec((B,), lambda i: (i,)),
+            out_shape=jax.ShapeDtypeStruct((cap,), out_dt),
+            scratch_shapes=scratch,
+            interpret=kb.interpret(),
+        )(*args)
+
+    # gather path: stream the [n_src] value array through VMEM in
+    # tiles (2D grid; the element block stays pinned at the sorted
+    # path's B for the shared scan structure — float bit-parity)
+    n_src = src.shape[0]
+    isz = np.dtype(src.dtype).itemsize
+    p = tiling.plan("agg.segreduce", cap, n_src, isz, B, block_max=B,
+                    tile_bytes=tile_bytes)
+    T, n_tiles = p.tile, p.n_tiles
+    # selection happens at trace time of the enclosing cached aggregate
+    # kernel, so tile volume counts once per compile (like kb.hit)
+    kb.record_tiles("agg.segreduce", n_tiles, p.tile_nbytes)
+    if p.src_pad != n_src:
+        src = jnp.pad(src, (0, p.src_pad - n_src))
+    kernel = _seg_gather_kernel(op, B, T, n_tiles, blocked, scan_np)
+    in_specs = [pl.BlockSpec((T,), lambda i, j: (j,)),
+                pl.BlockSpec((B,), lambda i, j: (i,)),
+                pl.BlockSpec((B,), lambda i, j: (i,))]
+    args = [src, order, new]
+    scratch = [pltpu.VMEM((B,), src.dtype)]   # gather accumulator
     if blocked:
-        in_specs.append(pl.BlockSpec((1,), lambda i: (0,)))
+        in_specs.append(pl.BlockSpec((1,), lambda i, j: (0,)))
         args.append(jnp.full((1,), identity, dtype=out_dt))
-        scratch = [pltpu.SMEM((1,), jnp.bool_),
-                   pltpu.SMEM((1,), out_dt)]
+        scratch = scratch + [pltpu.SMEM((1,), jnp.bool_),
+                             pltpu.SMEM((1,), out_dt)]
     return pl.pallas_call(
         kernel,
-        grid=(cap // B,),
+        grid=(cap // B, n_tiles),
         in_specs=in_specs,
-        out_specs=pl.BlockSpec((B,), lambda i: (i,)),
+        out_specs=pl.BlockSpec((B,), lambda i, j: (i,)),
         out_shape=jax.ShapeDtypeStruct((cap,), out_dt),
         scratch_shapes=scratch,
         interpret=kb.interpret(),
@@ -180,7 +285,8 @@ def seg_scan_sorted(new: jnp.ndarray, x_sorted: jnp.ndarray,
 
 def gather_seg_scan(x_masked: jnp.ndarray, order: jnp.ndarray,
                     new: jnp.ndarray, op_key: str, identity,
-                    scan_np=None) -> jnp.ndarray:
+                    scan_np=None,
+                    tile_bytes: Optional[int] = None) -> jnp.ndarray:
     """Single-pass sorted-order gather + segmented scan: ``x_masked``
     stays in ORIGINAL row space (the caller pre-masks with the op's
     identity there, exactly like the XLA path) and is gathered through
@@ -188,6 +294,8 @@ def gather_seg_scan(x_masked: jnp.ndarray, order: jnp.ndarray,
     sorted copy and the standalone scan array never materialize.
     ``scan_np`` widens AFTER the gather (narrow gathers are 3x cheaper
     than emulated-i64 ones; the cast ordering matches
-    ``_SortedCtx.seg_sum``)."""
+    ``_SortedCtx.seg_sum``).
+    ``tile_bytes`` pins the source-tile budget the enclosing cached
+    kernel keyed on (None = the live knob)."""
     return _run(new, op_key, identity, scan_np, x_full=x_masked,
-                order=order)
+                order=order, tile_bytes=tile_bytes)

@@ -1,0 +1,125 @@
+"""HBM->VMEM streaming tile planner for the Pallas kernel tier.
+
+PR 9's kernels gated whole-buffer VMEM residency (64 MiB dense values,
+16 MiB dictionaries, 64 MiB reduction sources) and fell back to XLA
+past the gates — exactly the large, memory-bound batches where the
+kernels matter most.  This module plans the replacement: every
+gather-source buffer (dense decoded values, dictionaries, segmented-
+reduction sources) streams through the kernels as a SECOND grid
+dimension of fixed-size tiles.  The Pallas pipeline emitter double-
+buffers grid-mapped BlockSpec inputs automatically (fetch tile j+1
+while tile j computes — the standard HBM->VMEM overlap pattern), so a
+2D grid over (element blocks x source tiles) with the source tile
+keyed on the inner grid index IS the double-buffered streaming loop.
+
+Plan shape, shared by all three kernel families:
+
+  grid = (n_blocks, n_tiles)           # j (tiles) iterates fastest
+  source:  BlockSpec((tile,),  lambda i, j: (j,))
+  indices: BlockSpec((block,), lambda i, j: (i,))
+  output:  BlockSpec((block,), lambda i, j: (i,))   # revisited over j
+
+The output block's index map ignores ``j``, so the block stays VMEM-
+resident across the whole tile sweep and is written back once —
+kernels initialize it at ``j == 0`` and accumulate per-tile gathers
+under ``pl.when(jnp.any(in_tile))``, which skips the gather (and on
+hardware the tile's compute, the DMA still pipelines) for tiles no
+element of the block references.  Ragged final tiles are handled by
+padding the source to ``n_tiles * tile`` (a dense device-side pad) and
+masking in-kernel — a clipped index can land in the pad region only on
+lanes the ``in_tile`` predicate already excludes.
+
+Element-block sizes grow with capacity (pow2, bounded by _BLOCK_MAX)
+so huge caps don't degenerate into tens of thousands of grid cells —
+bounded VMEM per block, bounded grid, and a pure function of the
+capacity so it adds no program churn beyond what the capacity tier
+already keys.  The one exception is segreduce's blocked float path,
+which pins block = 2^15 for bit-parity with exec/scans.seg_scan and
+passes it here explicitly.
+
+Plans are memoized in the kernel cache (``kernel_cache.tile_plan``,
+``kernel.tilePlan.hits/misses``): a plan is a pure function of the
+key below, and the hot dispatch path re-reads it instead of re-walking
+the ladders and the config lock.  Block and tile shapes join every
+tiled kernel's cache key — they are derived from tier-bucketed buffer
+lengths plus the process-wide ``kernel.pallas.tileBytes``, so the keys
+stay as coarse as the PR 12 ABI tiers made the shapes themselves.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+from spark_rapids_tpu.kernels import backend as kb
+
+# element-block ceiling: 2^17 u32 lanes = 512 KiB VMEM — small next to
+# a default 4 MiB source tile, large enough that a 16M-row cap is a
+# 128-cell grid dimension, not 2048
+_BLOCK_MAX = 1 << 17
+# grid-dimension target: grow the element block (pow2) until the block
+# count drops to about this many cells
+_BLOCKS_TARGET = 128
+
+
+def _pow2_ceil(n: int) -> int:
+    return 1 << max(int(n) - 1, 0).bit_length()
+
+
+@dataclass(frozen=True)
+class TilePlan:
+    """One tiled kernel's static grid geometry."""
+    block: int          # elements per element-block (grid dim 0)
+    n_blocks: int
+    tile: int           # source elements per HBM->VMEM tile (grid dim 1)
+    n_tiles: int
+    src_pad: int        # padded source length (= tile * n_tiles)
+    tile_nbytes: int    # tile * itemsize
+
+    @property
+    def grid(self):
+        return (self.n_blocks, self.n_tiles)
+
+
+def _build(cap: int, block: int, block_max: int, src_len: int,
+           itemsize: int, tile_bytes: int) -> TilePlan:
+    # element block: the caller's base block, grown (pow2) toward the
+    # grid target, capped by block_max and the capacity itself; a
+    # non-pow2 cap keeps the base block (the caller's shape gate
+    # requires cap % block == 0 either way)
+    b = min(_pow2_ceil(cap), block_max,
+            max(block, _pow2_ceil(max(cap // _BLOCKS_TARGET, 1))))
+    if cap % b:
+        b = min(cap, block)
+    n_blocks = max(-(-cap // b), 1)
+    # source tile: largest pow2 element count under the byte budget; a
+    # source that fits one tile whole degenerates to the PR 9
+    # single-resident shape (n_tiles == 1)
+    t_budget = max(tile_bytes // max(itemsize, 1), 8)
+    t = max(min(_pow2_ceil(max(src_len, 1)),
+                1 << (t_budget.bit_length() - 1)), 8)
+    n_tiles = max(-(-max(src_len, 1) // t), 1)
+    return TilePlan(block=b, n_blocks=n_blocks, tile=t, n_tiles=n_tiles,
+                    src_pad=t * n_tiles, tile_nbytes=t * itemsize)
+
+
+def plan(family: str, cap: int, src_len: int, itemsize: int,
+         block: int, block_max: int = _BLOCK_MAX,
+         tile_bytes: "int | None" = None) -> TilePlan:
+    """Memoized tile plan for one (family, shape) call site.
+
+    ``cap``: element capacity (grid dim 0 extent * block).  ``src_len``
+    / ``itemsize``: the gather-source buffer being streamed.  ``block``:
+    the family's base element-block; pass ``block_max=block`` to pin it
+    (segreduce's float-parity 2^15 blocks).  ``tile_bytes`` pins the
+    budget for call sites whose eligibility gate already read it (the
+    fused-scan plan stamps its assemble-time value so a concurrent
+    session reconfiguring the knob between assemble and first trace
+    cannot produce a kernel that disagrees with its gate or its cache
+    key); None reads the process knob."""
+    from spark_rapids_tpu.exec import kernel_cache as kc
+    tb = int(tile_bytes) if tile_bytes is not None else kb.tile_bytes()
+    key = ("tile_plan", family, int(cap), int(src_len), int(itemsize),
+           int(block), int(block_max), tb)
+    return kc.tile_plan(
+        key, lambda: _build(int(cap), int(block), int(block_max),
+                            int(src_len), int(itemsize), tb))

@@ -709,7 +709,7 @@ class TpuOverrides:
         # scan execs read their OWN plan's backend (kernels.resolve),
         # so concurrent sessions with different kernel.backend settings
         # stay independent (the donation-stamp lesson, PR 4 review r3)
-        kbackend = str(conf.get(cfg.KERNEL_BACKEND) or "xla")
+        kbackend = str(conf.get(cfg.KERNEL_BACKEND) or "pallas")
 
         def _stamp(n):
             n._donate_enabled = donate

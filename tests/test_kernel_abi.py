@@ -218,6 +218,42 @@ def test_renamed_schema_compiles_zero_new_programs():
     assert first.column(1).to_pylist() == second.column(1).to_pylist()
 
 
+def test_renamed_join_schema_compiles_zero_new_programs():
+    """The erased ABI extended into the join ``emit`` family (PR 14):
+    the same join over renamed same-layout schemas shares EVERY
+    program — the join kernels key on canonical __l*/__r* positional
+    names + erased layout keys, capacities route through bucket_rows,
+    and dispatch-boundary hints bucket via kernel_abi.erase."""
+    s = _session()
+
+    def data(kn, vn, n, seed):
+        return s.create_dataframe(
+            {kn: [(i * 7 + seed) % 13 for i in range(n)],
+             vn: [float(i % 50) for i in range(n)]})
+
+    def q(left, right, kl):
+        return left.join(right, on=kl).sort(kl).collect()
+
+    first = q(data("k", "lv", 300, 0),
+              data("k", "rv", 200, 3).select(
+                  col("k"), col("rv")), "k")
+    view = obsreg.get_registry().view()
+    second = q(data("a", "x1", 300, 0),
+               data("a", "y1", 200, 3).select(
+                   col("a"), col("y1")), "a")
+    d = view.delta()["counters"]
+    fresh = {k: int(v) for k, v in d.items()
+             if k.startswith("kernel.cache.misses.") and v}
+    # agg_final bakes real names by design; nothing in the join
+    # families (emit/count/probe_*/semi/join_pack/cross) may re-mint
+    assert not {k for k in fresh if "emit" in k or "count" in k or
+                "probe" in k or "semi" in k or "join" in k or
+                "cross" in k}, fresh
+    assert set(fresh) <= {"kernel.cache.misses.agg_final"}, fresh
+    assert first.column(1).to_pylist() == second.column(1).to_pylist()
+    assert first.column(2).to_pylist() == second.column(2).to_pylist()
+
+
 def test_value_range_drift_compiles_zero_new_programs():
     """Value ranges inside one ABI hint bucket share programs: the
     precise vbits (8 vs 16 here) both bucket to 16."""

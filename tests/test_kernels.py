@@ -40,10 +40,11 @@ from tests.parity import assert_tables_equal
 @pytest.fixture(autouse=True)
 def _reset_backend_default():
     """Tests here flip the process default backend (via sessions and
-    overrides); restore 'xla' so later test MODULES that call decode
-    helpers without creating a session aren't silently rerouted."""
+    overrides); restore the process default ('pallas' since the PR 14
+    flip) so later test MODULES that call decode helpers without
+    creating a session aren't silently rerouted."""
     yield
-    kb.set_default_backend("xla")
+    kb.set_default_backend(kb.PALLAS)
 
 
 # ---------------------------------------------------------------------------
@@ -521,14 +522,17 @@ def test_decode_null_validity_interaction(tmp_path):
 
 def test_backend_knob_configures_process_default():
     from spark_rapids_tpu import TpuSparkSession
-    TpuSparkSession({"spark.rapids.tpu.kernel.backend": "pallas"})
-    assert kb.default_backend() == "pallas"
-    # a session WITHOUT the knob re-asserts the default (the
-    # scan_cache.configure idiom: no leakage into later sessions)
-    TpuSparkSession({})
+    TpuSparkSession({"spark.rapids.tpu.kernel.backend": "xla"})
     assert kb.default_backend() == "xla"
+    # a session WITHOUT the knob re-asserts the default — PALLAS since
+    # the PR 14 flip (the scan_cache.configure idiom: no leakage into
+    # later sessions)
+    TpuSparkSession({})
+    assert kb.default_backend() == "pallas"
     with pytest.raises(ValueError):
         TpuSparkSession({"spark.rapids.tpu.kernel.backend": "vulkan"})
+    with pytest.raises(ValueError):
+        TpuSparkSession({"spark.rapids.tpu.kernel.pallas.tileBytes": 1})
 
 
 def test_plan_stamp_wins_over_process_default(tmp_path):
