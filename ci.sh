@@ -497,6 +497,96 @@ print(f"serving smoke OK: 3 clients bit-identical, "
       f"chunks streamed")
 EOF
 
+echo "== incremental-maintenance gate (append probe: delta bit-identical, zero old-file walks, refresher observed) =="
+timeout 300 python - <<'EOF'
+# ISSUE 15 acceptance: after an append to a cached aggregate query's
+# watched sources, the refresh recomputes ONLY the delta row groups —
+# the page-walk counter (scan metadata cache disabled, so every
+# scanned chunk walks) must show exactly the delta file's chunks and
+# zero reads of unchanged files — with results bit-identical to the
+# full recompute, and the background refresher must be OBSERVED
+# keeping the entry warm off the serving path.
+import json, os, tempfile, time
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+import pyarrow as pa, pyarrow.parquet as papq
+from spark_rapids_tpu import TpuSparkSession, functions as F
+from spark_rapids_tpu.io import parquet_meta as pqm
+from spark_rapids_tpu.obs import registry as obsreg
+from spark_rapids_tpu.serve.client import ServeClient
+
+root = tempfile.mkdtemp(prefix="inc_gate_")
+def write(i, n0, n):
+    papq.write_table(pa.table({
+        "k": pa.array([j % 9 for j in range(n0, n0 + n)],
+                      type=pa.int64()),
+        "x": pa.array([(j * 7) % 250 for j in range(n0, n0 + n)],
+                      type=pa.int64())}),
+        os.path.join(root, f"part-{i:03d}.parquet"))
+for i in range(4):
+    write(i, i * 3000, 3000)
+
+s = TpuSparkSession({
+    "spark.rapids.tpu.sql.variableFloatAgg.enabled": True,
+    "spark.rapids.tpu.serve.enabled": True,
+    # every scanned chunk page-walks, so the counter is the proof
+    "spark.rapids.tpu.sql.scan.metadataCache.enabled": False,
+    "spark.rapids.tpu.serve.incremental.refreshMs": 100})
+s.register_view("t", s.read.parquet(root))
+Q = ("select k, count(*) as c, sum(x) as sx, min(x) as mn, "
+     "max(x) as mx from t group by k")
+def oracle():
+    return (s.read.parquet(root).group_by("k")
+            .agg(F.count("*").alias("c"), F.sum("x").alias("sx"),
+                 F.min("x").alias("mn"), F.max("x").alias("mx"))
+            .collect().sort_by("k"))
+
+reg = obsreg.get_registry()
+with ServeClient("127.0.0.1", s.serve_server.port) as c:
+    first = c.sql(Q)
+    assert first.sort_by("k").equals(oracle()), "capture run diverges"
+
+    # ~2% append -> the next lookup must delta-refresh, reading ONLY
+    # the appended file's row groups
+    write(4, 12000, 250)
+    w0 = pqm.walk_count()
+    v = reg.view()
+    got = c.sql(Q)
+    walked = pqm.walk_count() - w0
+    d = v.delta()["counters"]
+    assert d.get("serve.incremental.hits") == 1, d
+    assert d.get("serve.incremental.deltaFiles") == 1, d
+    assert d.get("serve.incremental.deltaBatches", 0) >= 1, d
+    # the delta file has 2 leaf columns x 1 row group = 2 chunk walks;
+    # any old-file row-group read would add to the counter
+    assert walked == 2, f"delta refresh walked {walked} chunks (want 2)"
+    assert got.sort_by("k").equals(oracle()), (
+        "incremental result diverges from full recompute")
+
+    # background refresher: append while idle, observe a refresh run,
+    # then the client lookup must hit warm with ZERO dispatches
+    write(5, 12250, 250)
+    deadline = time.time() + 60
+    while time.time() < deadline:
+        if reg.snapshot()["counters"].get(
+                "serve.incremental.refreshRuns", 0) >= 1:
+            break
+        time.sleep(0.05)
+    runs = reg.snapshot()["counters"].get(
+        "serve.incremental.refreshRuns", 0)
+    assert runs >= 1, "no refresher run observed within 60s"
+    v2 = reg.view()
+    warm = c.sql(Q)
+    d2 = v2.delta()["counters"]
+    assert d2.get("serve.resultCacheHits") == 1, d2
+    assert d2.get("kernel.dispatches", 0) == 0, (
+        f"post-refresh lookup dispatched kernels: {d2}")
+    assert warm.sort_by("k").equals(oracle()), "refreshed entry diverges"
+s.serve_server.shutdown()
+print(f"incremental gate OK: delta walked 2/2 delta chunks "
+      f"(0 old-file reads), bit-identical, {runs} refresher run(s), "
+      f"warm hit with 0 dispatches")
+EOF
+
 echo "== shape-erased ABI collapse gate (>=4x fewer programs, bit-identical) =="
 timeout 560 python - <<'EOF'
 # the serving-shaped probe: ONE query family over 2 schemas x 2 value

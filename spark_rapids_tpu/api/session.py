@@ -11,6 +11,7 @@ from __future__ import annotations
 import collections
 import contextlib
 import itertools
+import os
 import threading
 from typing import Any, Dict, List, Optional, Sequence, Union
 
@@ -613,6 +614,12 @@ class DataFrameReader:
         opts = dict(self._options)
         opts["part_values"] = part_values
         opts["part_fields"] = pfields
+        # the pre-expansion roots: the serving tier's incremental
+        # maintenance re-expands them at lookup time so files appended
+        # to a watched directory appear in the stamp set instead of
+        # being invisible to this frozen file list
+        # (exec/incremental.current_files)
+        opts["source_roots"] = [os.path.abspath(p) for p in paths]
         return DataFrame(
             lp.FileScan(fmt, files, schema, opts), self.session)
 

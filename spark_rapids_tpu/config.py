@@ -797,6 +797,42 @@ SERVE_RESULT_CACHE_MAX_BYTES = conf(
     "results evict past it. A single result larger than the whole "
     "budget is never cached.", int)
 
+SERVE_INCREMENTAL_ENABLED = conf(
+    "spark.rapids.tpu.serve.incremental.enabled", True,
+    "Incremental maintenance of the serving result cache "
+    "(exec/incremental.py): for deterministic cacheable plans whose "
+    "root chain is a TPU hash aggregate over stampable parquet "
+    "sources, the pre-final MERGED aggregate partial state is retained "
+    "alongside the result (both under serve.resultCache.maxBytes). "
+    "When a later lookup finds the sources drifted by pure APPEND "
+    "(every old file's (path, mtime_ns, size) stamp unchanged, new "
+    "files added), the SAME plan re-runs its update phase over only "
+    "the delta files, merges with the retained partials, and "
+    "finalizes — recompute cost proportional to the delta, not the "
+    "dataset. Any other drift (rewrite / shrink / delete / mtime-only "
+    "touch) falls back to the full recompute, which stays the "
+    "bit-identical correctness oracle (flip this off to revert to "
+    "all-or-nothing caching in one knob, the sql.fusion.enabled "
+    "pattern).", bool)
+
+SERVE_INCREMENTAL_REFRESH_MS = conf(
+    "spark.rapids.tpu.serve.incremental.refreshMs", 0,
+    "Poll interval for the background incremental refresher: every "
+    "refreshMs it re-stamps the sources of retained cache entries and "
+    "delta-refreshes any that drifted by pure append, at low priority "
+    "and only while the scheduler has no live queries (the "
+    "sched.precompile idle-wait contract) — so interactive hits stay "
+    "warm instead of paying the delta on first touch. 0 (default) "
+    "disables the thread; lookups still delta-refresh on demand.", int)
+
+SERVE_INCREMENTAL_MAX_TRACKED = conf(
+    "spark.rapids.tpu.serve.incremental.maxTracked", 64,
+    "How many distinct (plan digest, output names) entries the "
+    "incremental maintainer tracks for delta refresh (LRU past it). "
+    "Each tracked entry pins its logical plan template; the retained "
+    "partial-state tables themselves live in the result cache under "
+    "serve.resultCache.maxBytes.", int)
+
 SERVE_STREAM_CHUNK_ROWS = conf(
     "spark.rapids.tpu.serve.stream.chunkRows", 65536,
     "Rows per streamed Arrow result chunk. Each chunk costs one CHUNK "

@@ -1,0 +1,552 @@
+"""Incremental query maintenance: delta scans + retained aggregate
+partials.
+
+PR 7's serving result cache is all-or-nothing: entries key on
+``io/scan_cache.source_stamps``, so appending ONE file to a watched
+dataset invalidates the whole entry and the next hit re-pays the full
+scan + aggregate.  This module turns that cache into a *delta-
+maintained* one for the plan shape dashboards actually repeat — a
+deterministic aggregate over stampable parquet sources:
+
+  * alongside each cacheable aggregate result, the **pre-final merged
+    partial state** is retained (the ``_AggSpec`` update/merge/finalize
+    triple already makes aggregate state mergeable —
+    exec/tpu_aggregate.py) in the same byte-budget LRU the results live
+    in (``serve.resultCache.maxBytes``), keyed by plan digest + the
+    per-file stamp set;
+  * on a lookup whose stamp set drifted by **pure append** (every old
+    file's (path, mtime_ns, size) stamp unchanged, new files added —
+    ``io/scan_cache.classify_stamp_delta``), the SAME plan re-runs its
+    update phase over only the delta files (a ``file_subset``
+    restriction threaded through the scan node), ``merge_aggregate``
+    folds the retained partials in, and finalize produces the full
+    result — recompute cost proportional to the delta, not the
+    dataset;
+  * any other drift (rewrite, shrink, delete, mtime-only touch, or a
+    file moving mid-refresh) falls back to the full recompute, which
+    stays the bit-identical correctness oracle
+    (``serve.incremental.enabled`` is the one-knob revert, the
+    ``sql.fusion.enabled`` pattern);
+  * a low-priority background refresher (``serve.incremental.
+    refreshMs``, the sched/precompile idle-wait idiom) polls stamps
+    and delta-refreshes retained entries off the serving path, so
+    interactive hits stay warm instead of paying the delta on first
+    touch.
+
+Watched datasets: ``read.parquet(dir)`` expands the directory eagerly,
+so the scan records its original ``source_roots`` and the maintenance
+path re-expands them at lookup time — a file appended to the directory
+appears as a new path in the stamp set (and invalidates/delta-refreshes
+the entry) instead of being silently invisible to the frozen file list.
+
+Eligibility (reported explain-style by :func:`explain`): the root
+chain (Sort/Limit/Project allowed on top) must end at ONE Aggregate
+whose functions are all decomposable (count/sum/min/max/avg, no
+DISTINCT — First/Last are arrival-order-dependent), over a
+Filter/Project chain on ONE parquet FileScan — no joins, no nested
+aggregates, no nondeterministic expressions, no distributed two-stage
+aggregate (per-partition partials have no single retained state).
+
+Registry counters (→ /metrics): ``serve.incremental.hits`` /
+``deltaFiles`` / ``deltaBatches`` / ``fullFallbacks[.reason]`` /
+``refreshRuns`` / ``ineligible.<reason>``; the per-query profile gains
+an always-present ``incremental`` section.
+"""
+
+from __future__ import annotations
+
+import copy
+import os
+import threading
+from collections import OrderedDict
+from typing import Any, Dict, List, Optional, Tuple
+
+from spark_rapids_tpu import config as cfg
+from spark_rapids_tpu.expr import ir
+from spark_rapids_tpu.obs import registry as _obsreg
+from spark_rapids_tpu.plan import logical as lp
+
+# partial-state entries ride the serving result cache (byte accounting
+# against serve.resultCache.maxBytes comes for free) under a namespaced
+# digest; the marker names keep them from ever colliding with a real
+# result's (digest, output-names) pair
+PARTIAL_SUFFIX = "#partial"
+PARTIAL_NAMES = ("__incremental_partial__",)
+
+_DECOMPOSABLE = (ir.Count, ir.Sum, ir.Min, ir.Max, ir.Average)
+
+# root-chain nodes allowed ABOVE the maintained aggregate: they are
+# deterministic row-wise/order transforms of the finalized output, so
+# re-running them over a delta-merged aggregate is exactly re-running
+# them over the full recompute's aggregate
+_ABOVE_AGG = (lp.Sort, lp.Limit, lp.Project)
+_BELOW_AGG = (lp.Filter, lp.Project)
+
+
+# ---------------------------------------------------------------------------
+# Eligibility
+# ---------------------------------------------------------------------------
+
+def _unalias(e: ir.Expression) -> ir.Expression:
+    return e.children[0] if isinstance(e, ir.Alias) else e
+
+
+def _root_aggregate(plan: lp.LogicalPlan) -> Optional[lp.Aggregate]:
+    node = plan
+    while isinstance(node, _ABOVE_AGG):
+        node = node.children[0]
+    return node if isinstance(node, lp.Aggregate) else None
+
+
+def _scan_below(agg: lp.Aggregate):
+    node = agg.children[0]
+    while isinstance(node, _BELOW_AGG):
+        node = node.children[0]
+    return node
+
+
+def eligibility(plan: lp.LogicalPlan,
+                conf=None) -> Tuple[bool, str]:
+    """(eligible, reason) for delta maintenance of ``plan`` (module
+    docstring).  ``reason`` is ``"eligible"`` on success, else the
+    explain-style slug also used for the
+    ``serve.incremental.ineligible.<reason>`` counter."""
+    agg = _root_aggregate(plan)
+    if agg is None:
+        return False, "non_agg_root"
+    for a in agg.aggregates:
+        fn = _unalias(a)
+        if not isinstance(fn, _DECOMPOSABLE) or \
+                getattr(fn, "distinct", False):
+            return False, "non_decomposable_function"
+    below = _scan_below(agg)
+    if isinstance(below, lp.Join):
+        return False, "join"
+    if isinstance(below, lp.Aggregate):
+        # nested aggregate (incl. the DISTINCT double-agg rewrite):
+        # the inner dedup state is not mergeable across delta runs
+        return False, "non_decomposable_function"
+    if not isinstance(below, lp.FileScan):
+        return False, "non_scan_subtree"
+    if below.fmt != "parquet":
+        return False, "non_parquet_source"
+    from spark_rapids_tpu.plan import digest as pdig
+    for node in pdig.walk(plan):
+        for e in pdig.iter_node_exprs(node):
+            if ir.collect(e, lambda x: type(x).__name__
+                          in pdig._NONDETERMINISTIC_EXPRS):
+                return False, "nondeterminism"
+    if conf is not None and agg.groupings:
+        # the planner's two-stage shape merges partials PER PARTITION
+        # behind a hash exchange — there is no single merged partial
+        # to retain (planner.plan_cpu two_stage condition, mirrored)
+        if conf.get(cfg.AGG_EXCHANGE) or \
+                str(conf.get(cfg.SHUFFLE_TRANSPORT)) in (
+                    "ici", "ici_ring", "process"):
+            return False, "distributed_agg"
+    return True, "eligible"
+
+
+def explain(plan: lp.LogicalPlan, conf=None) -> List[str]:
+    """Explain-style eligibility report (DataFrame.explain idiom)."""
+    ok, reason = eligibility(plan, conf)
+    if ok:
+        agg = _root_aggregate(plan)
+        scan = _scan_below(agg)
+        return [
+            "incremental maintenance: ELIGIBLE",
+            f"  aggregate: {len(agg.groupings)} grouping(s), "
+            f"{len(agg.aggregates)} decomposable function(s)",
+            f"  sources: {len(scan.paths)} parquet file(s)"
+            + (" (watched roots)" if scan.options.get("source_roots")
+               else ""),
+        ]
+    return [f"incremental maintenance: INELIGIBLE ({reason})"]
+
+
+# ---------------------------------------------------------------------------
+# Watched-dataset expansion + stamps
+# ---------------------------------------------------------------------------
+
+def current_files(scan: lp.FileScan) -> Tuple[List[str], List[dict]]:
+    """(files, part_values) the scan resolves to RIGHT NOW: the
+    recorded ``source_roots`` re-expanded when present (so appended
+    files appear), else the frozen snapshot taken at read() time."""
+    roots = scan.options.get("source_roots")
+    if not roots:
+        return (list(scan.paths),
+                list(scan.options.get("part_values") or []))
+    from spark_rapids_tpu.io.readers import expand_paths
+    return expand_paths(scan.fmt, list(roots))
+
+
+def current_stamps(plan: lp.LogicalPlan):
+    """Current source stamps for a plan — ``scan_cache.source_stamps``
+    over the *live* expansion of every FileScan (None when any source
+    can't be stamped, matching the source_stamps contract)."""
+    from spark_rapids_tpu.io import scan_cache as sc
+    from spark_rapids_tpu.plan import digest as pdig
+    paths: List[str] = []
+    for node in pdig.walk(plan):
+        if isinstance(node, lp.FileScan):
+            files, _ = current_files(node)
+            paths.extend(files)
+    return sc.source_stamps(sorted(set(paths)))
+
+
+def files_from_stamps(scan: lp.FileScan, stamps
+                      ) -> Tuple[List[str], List[dict]]:
+    """(files, part_values) for the maintained scan, derived from an
+    already-computed stamp set instead of a second directory
+    expansion — the serving path stamps the sources once per lookup
+    and reuses that sweep here (eligible plans have exactly ONE
+    FileScan, so the stamp set's paths ARE this scan's live file
+    list).  Partition values re-derive through the same
+    ``readers.dir_part_values`` parser ``expand_paths`` uses."""
+    import os as _os
+    from spark_rapids_tpu.io.readers import dir_part_values
+    roots = [_os.path.abspath(r)
+             for r in (scan.options.get("source_roots") or [])]
+    if not roots:
+        return (list(scan.paths),
+                list(scan.options.get("part_values") or []))
+    files = [s[1] for s in stamps]
+    pvs = []
+    for f in files:
+        pv: dict = {}
+        for r in roots:
+            if _os.path.isdir(r) and \
+                    _os.path.abspath(f).startswith(r + _os.sep):
+                pv = dir_part_values(r, f)
+                break
+        pvs.append(pv)
+    return files, pvs
+
+
+# ---------------------------------------------------------------------------
+# Plan cloning + stamping
+# ---------------------------------------------------------------------------
+
+class PartialSink:
+    """Capture slot the aggregate exec fills with the merged partial
+    state (as a host Arrow table of the static __k*/__a* buffer
+    columns) just before finalize — exec/tpu_aggregate.py honors it
+    through the ``_incremental`` plan stamp."""
+
+    __slots__ = ("table", "update_batches")
+
+    def __init__(self):
+        self.table = None
+        self.update_batches = 0
+
+
+def _refreshed_scan(scan: lp.FileScan, files: List[str],
+                    part_values: List[dict],
+                    file_subset=None) -> lp.FileScan:
+    """Shallow clone of ``scan`` re-pinned to the live file list, with
+    an optional ``file_subset`` restriction (delta scans).  The subset
+    rides ``options`` so it participates in the plan digest and both
+    scan execs (device + CPU fallback) honor it."""
+    new = copy.copy(scan)
+    new.paths = list(files)
+    opts = dict(scan.options)
+    opts["part_values"] = list(part_values)
+    if file_subset is not None:
+        opts["file_subset"] = tuple(sorted(
+            os.path.abspath(p) for p in file_subset))
+    else:
+        opts.pop("file_subset", None)
+    new.options = opts
+    return new
+
+
+def clone_stamped(plan: lp.LogicalPlan, files: List[str],
+                  part_values: List[dict],
+                  sink: Optional[PartialSink] = None,
+                  retained=None, delta_files=None,
+                  is_delta: bool = False) -> lp.LogicalPlan:
+    """Clone the (linear, eligibility-checked) plan chain with the scan
+    re-pinned/restricted and the aggregate stamped for partial capture
+    and retained-state merge.  The original plan is never mutated —
+    stamps ride private attrs the plan digest skips, except the file
+    subset which rides scan options (it changes result content, so it
+    must change the digest)."""
+
+    def rec(node: lp.LogicalPlan) -> lp.LogicalPlan:
+        if isinstance(node, lp.FileScan):
+            return _refreshed_scan(node, files, part_values,
+                                   file_subset=delta_files)
+        c = copy.copy(node)
+        c.children = tuple(rec(ch) for ch in node.children)
+        if isinstance(node, lp.Aggregate):
+            c._incremental = {"sink": sink, "retained": retained,
+                              "delta": bool(is_delta)}
+        return c
+
+    return rec(plan)
+
+
+def repin_plan(plan: lp.LogicalPlan) -> lp.LogicalPlan:
+    """Clone with every watched FileScan re-expanded to the live file
+    list (no aggregate stamps): the full-recompute path over the
+    CURRENT dataset snapshot, so a result cached under live stamps was
+    really computed over the files those stamps describe."""
+
+    def rec(node: lp.LogicalPlan) -> lp.LogicalPlan:
+        if isinstance(node, lp.FileScan):
+            files, pvs = current_files(node)
+            if list(files) == list(node.paths) and \
+                    "file_subset" not in node.options:
+                return node
+            return _refreshed_scan(node, files, pvs)
+        if not node.children:
+            return node
+        kids = tuple(rec(ch) for ch in node.children)
+        if all(k is o for k, o in zip(kids, node.children)):
+            return node
+        c = copy.copy(node)
+        c.children = kids
+        return c
+
+    return rec(plan)
+
+
+# ---------------------------------------------------------------------------
+# The maintainer
+# ---------------------------------------------------------------------------
+
+class _RunCtx:
+    """What one maintained run needs at completion time."""
+
+    __slots__ = ("mode", "cache_key", "names", "stamps",
+                 "retained_stamps", "sink", "plan", "delta_paths")
+
+    def __init__(self, mode: str, cache_key: str, names, stamps,
+                 retained_stamps, sink: Optional[PartialSink],
+                 plan: lp.LogicalPlan, delta_paths=()):
+        self.mode = mode                  # "capture" | "delta"
+        self.cache_key = cache_key
+        self.names = tuple(names)
+        self.stamps = stamps              # expected post-run stamp set
+        self.retained_stamps = retained_stamps
+        self.sink = sink
+        self.plan = plan                  # ORIGINAL logical plan
+        self.delta_paths = tuple(delta_paths)
+
+
+class IncrementalMaintainer:
+    """Serving-tier incremental maintenance (module docstring).
+
+    One per ServeServer.  ``prepare`` is called on every result-cache
+    miss of a cacheable plan and decides full-capture vs delta;
+    ``finish`` commits results + partials under verified stamps and
+    owns the mid-stream-drift fallback.  ``refresh_once``/the
+    background thread keep tracked entries warm off the serving path.
+    """
+
+    def __init__(self, session):
+        self._session = session
+        conf = session.conf
+        self.enabled = bool(conf.get(cfg.SERVE_INCREMENTAL_ENABLED))
+        self.refresh_ms = int(conf.get(cfg.SERVE_INCREMENTAL_REFRESH_MS))
+        self.max_tracked = max(
+            1, int(conf.get(cfg.SERVE_INCREMENTAL_MAX_TRACKED)))
+        # (cache_key, names) -> {"plan": original logical plan}
+        self._tracked: "OrderedDict[Tuple, Dict[str, Any]]" = \
+            OrderedDict()
+        self._lock = threading.Lock()
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        if self.enabled and self.refresh_ms > 0:
+            self._thread = threading.Thread(
+                target=self._refresh_loop, name="serve-incremental",
+                daemon=True)
+            self._thread.start()
+
+    # -- lifecycle ----------------------------------------------------------
+    def shutdown(self) -> None:
+        self._stop.set()
+
+    def tracked_keys(self) -> List[Tuple]:
+        with self._lock:
+            return list(self._tracked)
+
+    # -- serving-path hooks -------------------------------------------------
+    def prepare(self, plan: lp.LogicalPlan, cache_key: str, names,
+                stamps, is_refresh: bool = False):
+        """On a result-cache miss for a cacheable plan: returns
+        ``(plan_to_submit, ctx)``.  ``ctx`` None means plain full run
+        (ineligible or maintenance off) — the caller keeps its legacy
+        insert path; otherwise the caller MUST route the completed
+        table through :meth:`finish` with this ctx and skip its own
+        insert."""
+        from spark_rapids_tpu.io import scan_cache as sc
+        from spark_rapids_tpu.serve import result_cache
+        reg = _obsreg.get_registry()
+        if not self.enabled or stamps is None:
+            return repin_plan(plan), None
+        ok, reason = eligibility(plan, self._session.conf)
+        if not ok:
+            reg.inc(f"serve.incremental.ineligible.{reason}")
+            return repin_plan(plan), None
+        agg = _root_aggregate(plan)
+        scan = _scan_below(agg)
+        # reuse the caller's stamp sweep as the live file list rather
+        # than paying a second directory expansion on the serving path
+        files, pvs = files_from_stamps(scan, stamps)
+        retained = result_cache.lookup_latest(
+            cache_key + PARTIAL_SUFFIX, PARTIAL_NAMES)
+        if retained is not None:
+            old_stamps, ptable = retained
+            delta = sc.classify_stamp_delta(old_stamps, stamps)
+            if delta.kind == "append":
+                sink = PartialSink()
+                dplan = clone_stamped(
+                    plan, files, pvs, sink=sink, retained=ptable,
+                    delta_files=delta.appended, is_delta=True)
+                if not is_refresh:
+                    reg.inc("serve.incremental.hits")
+                reg.inc("serve.incremental.deltaFiles",
+                        len(delta.appended))
+                return dplan, _RunCtx(
+                    "delta", cache_key, names, stamps, old_stamps,
+                    sink, plan, delta.appended)
+            if delta.kind != "unchanged":
+                reg.inc("serve.incremental.fullFallbacks")
+                reg.inc(f"serve.incremental.fullFallbacks.{delta.kind}")
+        # first sight of this (digest, names) under these stamps — or a
+        # non-append drift: full run, capturing partials for next time
+        sink = PartialSink()
+        cplan = clone_stamped(plan, files, pvs, sink=sink)
+        return cplan, _RunCtx("capture", cache_key, names, stamps,
+                              None, sink, plan)
+
+    def finish(self, ctx: _RunCtx, table):
+        """Commit one maintained run.  Returns the table to stream —
+        usually ``table`` itself; a delta run whose OLD files moved
+        mid-refresh is torn (its retained partials were stale) and is
+        replaced by a synchronous full recompute."""
+        from spark_rapids_tpu.io import scan_cache as sc
+        reg = _obsreg.get_registry()
+        post = current_stamps(ctx.plan)
+        if ctx.mode == "delta":
+            if ctx.sink is None or ctx.sink.table is None:
+                # the aggregate that ran never filled the sink — the
+                # _incremental stamp was NOT honored (the plan landed
+                # on CpuHashAggregateExec, a per_partition shape, or a
+                # future planner path that drops the stamp) while the
+                # scan's file_subset restriction WAS: the computed
+                # table covers only the delta files.  Eligibility is a
+                # prediction; this is the ground truth of what
+                # executed — never stream it, recompute fully.
+                reg.inc("serve.incremental.fullFallbacks")
+                reg.inc("serve.incremental.fullFallbacks.unhonored")
+                return self._recompute_full(ctx)
+            if post != ctx.stamps:
+                d2 = sc.classify_stamp_delta(ctx.retained_stamps,
+                                             post or ())
+                reg.inc("serve.incremental.fullFallbacks")
+                if post is not None and d2.kind in ("append",
+                                                    "unchanged"):
+                    # delta arrived mid-refresh on top of pure appends:
+                    # the computed result is a coherent snapshot (each
+                    # file was read through one consistent footer), it
+                    # just can't be frozen under any stamp we observed
+                    reg.inc("serve.incremental."
+                            "fullFallbacks.midStreamAppend")
+                    return table
+                # an OLD file was rewritten/deleted mid-refresh: the
+                # retained partials this run merged were stale — the
+                # result may correspond to NO dataset snapshot.  Never
+                # stream it; recompute fully.
+                reg.inc("serve.incremental."
+                        "fullFallbacks.midStreamDrift")
+                return self._recompute_full(ctx)
+            self._commit(ctx, table)
+            return table
+        # capture: freeze result + partial only under held stamps (the
+        # serve pre/post-stamp pin, extended to the partial state)
+        if post == ctx.stamps:
+            self._commit(ctx, table)
+        return table
+
+    # -- internals ----------------------------------------------------------
+    def _commit(self, ctx: _RunCtx, table) -> None:
+        from spark_rapids_tpu.serve import result_cache
+        reg = _obsreg.get_registry()
+        result_cache.insert(ctx.cache_key, ctx.names, ctx.stamps, table)
+        if ctx.sink is not None and ctx.sink.table is not None:
+            if result_cache.insert(ctx.cache_key + PARTIAL_SUFFIX,
+                                   PARTIAL_NAMES, ctx.stamps,
+                                   ctx.sink.table):
+                reg.inc("serve.incremental.partialsRetained")
+        with self._lock:
+            key = (ctx.cache_key, ctx.names)
+            self._tracked[key] = {"plan": ctx.plan}
+            self._tracked.move_to_end(key)
+            while len(self._tracked) > self.max_tracked:
+                self._tracked.popitem(last=False)
+
+    def _recompute_full(self, ctx: _RunCtx):
+        fut = self._session._query_service.submit(repin_plan(ctx.plan))
+        return fut.result()
+
+    # -- background refresher ----------------------------------------------
+    def _busy(self) -> bool:
+        """Live (queued or running) queries — the signal the refresher
+        yields to (the sched/precompile low-priority contract)."""
+        try:
+            return self._session._query_service.has_live_queries()
+        except Exception:
+            return False
+
+    def _yield_to_serving(self) -> None:
+        import time
+        while not self._stop.is_set() and self._busy():
+            time.sleep(max(self.refresh_ms, 5) / 1e3)
+
+    def _refresh_loop(self) -> None:
+        period = max(self.refresh_ms, 1) / 1e3
+        while not self._stop.wait(period):
+            try:
+                self.refresh_once()
+            except Exception:
+                pass
+
+    def refresh_once(self) -> int:
+        """One refresher sweep: delta-refresh every tracked entry whose
+        sources drifted by pure append.  Returns how many entries were
+        refreshed.  Public so tests and the CI gate can drive a sweep
+        deterministically."""
+        from spark_rapids_tpu.serve import result_cache
+        reg = _obsreg.get_registry()
+        with self._lock:
+            items = list(self._tracked.items())
+        ran = 0
+        for (cache_key, names), ent in items:
+            if self._stop.is_set():
+                break
+            self._yield_to_serving()
+            plan = ent["plan"]
+            stamps = current_stamps(plan)
+            if stamps is None:
+                continue
+            latest = result_cache.lookup_latest(cache_key, names)
+            if latest is not None and latest[0] == stamps:
+                continue                  # still warm
+            sub, ctx = self.prepare(plan, cache_key, names, stamps,
+                                    is_refresh=True)
+            if ctx is None or ctx.mode != "delta":
+                # non-append drift (or evicted partial): the next
+                # client query pays the full recompute; the refresher
+                # never burns a full dataset pass in the background
+                continue
+            try:
+                fut = self._session._query_service.submit(
+                    sub, priority=-1)
+                self.finish(ctx, fut.result())
+                reg.inc("serve.incremental.refreshRuns")
+                ran += 1
+            except Exception:
+                pass
+        return ran

@@ -961,6 +961,17 @@ class TpuHashAggregateExec(TpuExec):
                 ("agg_final", sig, tuple(self._schema.names)),
                 lambda: functools.partial(cls._final_impl, shim))
 
+        # incremental-maintenance stamp (exec/incremental.py, threaded
+        # through the planner): "retained" is a host table of merged
+        # partial state from a previous run to fold into THIS run's
+        # merge, "sink" captures this run's merged partials (pre-
+        # finalize) for the next delta.  Never honored per_partition:
+        # each partition merges independently there, so seeding every
+        # partition with the retained state would multiply it in.
+        inc = getattr(self, "_incremental", None)
+        if inc is not None and self.per_partition:
+            inc = None
+
         def run(its):
             from spark_rapids_tpu.exec import kernel_abi
             from spark_rapids_tpu.mem.spill import register_or_hold
@@ -970,6 +981,16 @@ class TpuHashAggregateExec(TpuExec):
             # (reference: aggregate.scala buffers partial results;
             # SpillableColumnarBatch keeps them evictable)
             partials: List = []
+            n_updates = 0
+            if inc is not None and inc.get("retained") is not None:
+                # the retained state merges FIRST, preserving the
+                # old-batches-then-new-batches partial order a full
+                # recompute would have produced
+                from spark_rapids_tpu.columnar.batch import from_arrow
+                retained_b = from_arrow(inc["retained"])
+                reg.inc("incremental.retainedRows",
+                        int(retained_b.num_rows))
+                partials.append(register_or_hold(retained_b))
             try:
                 for it in its:
                     for b in it:
@@ -991,12 +1012,25 @@ class TpuHashAggregateExec(TpuExec):
                         if self.fused_prologue_saved:
                             reg.inc("fusion.dispatchesSaved",
                                     self.fused_prologue_saved)
+                        n_updates += 1
+                        if inc is not None and inc.get("delta"):
+                            # a delta-restricted scan's update batches
+                            # ARE the delta cost — the serve-tier
+                            # counter and the per-query profile section
+                            # both read this
+                            reg.inc("incremental.deltaBatches")
+                            reg.inc("serve.incremental.deltaBatches")
                         partials.append(register_or_hold(partial))
                 if not partials:
                     if self.groupings:
                         return  # grouped agg over empty input -> no rows
                     # global agg over empty -> one row (count=0, sum=null)
                     empty = _make_empty_buffer_batch(self)
+                    if inc is not None and inc.get("sink") is not None:
+                        from spark_rapids_tpu.columnar.batch import \
+                            to_arrow
+                        inc["sink"].table = to_arrow(empty)
+                        inc["sink"].update_batches = n_updates
                     yield self._final_kernel(empty)
                     return
                 if len(partials) == 1:
@@ -1005,6 +1039,17 @@ class TpuHashAggregateExec(TpuExec):
                     whole = concat_batches([p.get() for p in partials])
                     with timed(self.metrics, "agg.merge"):
                         merged = self._merge_kernel(whole)
+                if inc is not None and inc.get("sink") is not None:
+                    # freeze the pre-finalize merged state host-side:
+                    # the next append-only drift merges forward from
+                    # this instead of rescanning the whole dataset.
+                    # The host conversion syncs once at the END of the
+                    # pipeline (finalize is the only dispatch left).
+                    from spark_rapids_tpu.columnar.batch import to_arrow
+                    with timed(self.metrics, "agg.partialCapture"):
+                        inc["sink"].table = to_arrow(merged)
+                        inc["sink"].update_batches = n_updates
+                    reg.inc("incremental.partialsCaptured")
                 out = self._final_kernel(merged)
                 self.metrics.add_rows(out.num_rows)
                 yield out

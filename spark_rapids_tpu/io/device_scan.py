@@ -161,8 +161,8 @@ class TpuParquetScanExec(TpuExec):
         if (self.fmt == "parquet" and self.allow_fused and
                 self.conf.get(cfg.PARQUET_FUSED_DECODE)):
             return self._execute_fused()
-        return [self._file_part(i)
-                for i in range(len(self.scan.paths))]
+        from spark_rapids_tpu.io.readers import scan_file_indices
+        return [self._file_part(i) for i in scan_file_indices(self.scan)]
 
     # -- fused coalescing reader (one XLA program per batch) ---------------
     def _fused_groups(self):
@@ -175,12 +175,17 @@ class TpuParquetScanExec(TpuExec):
         again inside each group's iterator — a scan over thousands of
         files must not hold thousands of descriptors for the query."""
         from spark_rapids_tpu.io import scan_cache as sc
+        from spark_rapids_tpu.io.readers import scan_file_indices
         max_rows = int(self.conf.get(cfg.MAX_READER_BATCH_SIZE_ROWS))
         max_bytes = int(self.conf.get(cfg.MAX_READER_BATCH_SIZE_BYTES))
         pv_list = self.scan.options.get("part_values") or []
         groups = []
         cur, cur_rows, cur_bytes, cur_pv = [], 0, 0, None
-        for fi, path in enumerate(self.scan.paths):
+        # a file_subset restriction (incremental delta scans) excludes
+        # files HERE, before any footer opens: a restricted scan never
+        # stats, walks, or uploads a byte of an excluded file
+        for fi in scan_file_indices(self.scan):
+            path = self.scan.paths[fi]
             pf = sc.open_source(path, metrics=self.metrics)
             pv = pv_list[fi] if fi < len(pv_list) else {}
             pv_key = tuple(sorted(pv.items()))
@@ -393,7 +398,9 @@ class TpuCsvScanExec(TpuExec):
             set_input_file("")
 
     def execute(self):
-        return [self._file_part(p) for p in self.scan.paths]
+        from spark_rapids_tpu.io.readers import scan_file_indices
+        return [self._file_part(self.scan.paths[i])
+                for i in scan_file_indices(self.scan)]
 
     def simple_string(self) -> str:
         return (f"{type(self).__name__}"

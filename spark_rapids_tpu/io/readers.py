@@ -57,20 +57,41 @@ def expand_paths(fmt: str, paths: List[str]):
                     f.endswith(exts) or "part-" in os.path.basename(f))
                 and not os.path.basename(f).startswith(("_", ".")))
             for f in hits:
-                rel = os.path.relpath(os.path.dirname(f), p)
-                pv = {}
-                if rel != ".":
-                    for seg in rel.split(os.sep):
-                        if "=" in seg:
-                            k, v = seg.split("=", 1)
-                            pv[k] = None if \
-                                v == "__HIVE_DEFAULT_PARTITION__" else v
                 files.append(f)
-                part_values.append(pv)
+                part_values.append(dir_part_values(p, f))
         else:
             files.append(p)
             part_values.append({})
     return files, part_values
+
+
+def dir_part_values(root: str, f: str) -> dict:
+    """Hive partition values encoded in ``f``'s path below ``root`` —
+    the ONE parser for `key=value` path segments, shared by
+    ``expand_paths`` and the incremental maintainer's stamp-derived
+    file lists (exec/incremental.py) so the two can't drift."""
+    rel = os.path.relpath(os.path.dirname(f), root)
+    pv: dict = {}
+    if rel != ".":
+        for seg in rel.split(os.sep):
+            if "=" in seg:
+                k, v = seg.split("=", 1)
+                pv[k] = None if v == "__HIVE_DEFAULT_PARTITION__" else v
+    return pv
+
+
+def scan_file_indices(scan) -> List[int]:
+    """File indices a scan should actually read: all of them, unless a
+    ``file_subset`` restriction is stamped in the scan options (the
+    incremental delta path, exec/incremental.py).  Index-based so
+    ``part_values``/``part_fields`` alignment survives the
+    restriction."""
+    subset = scan.options.get("file_subset")
+    if subset is None:
+        return list(range(len(scan.paths)))
+    keep = {os.path.abspath(p) for p in subset}
+    return [i for i, p in enumerate(scan.paths)
+            if os.path.abspath(p) in keep]
 
 
 def _partition_fields(part_values: List[dict]):
@@ -261,7 +282,7 @@ class CpuFileScanExec(PhysicalPlan):
                 break
 
     def execute(self) -> List[Iterator[pa.Table]]:
-        indices = list(range(len(self.scan.paths)))
+        indices = scan_file_indices(self.scan)
         if self.reader_type == "MULTITHREADED":
             nthreads = self.conf.get(
                 cfg.PARQUET_MULTITHREAD_READ_NUM_THREADS)

@@ -212,6 +212,63 @@ def source_stamps(paths) -> Optional[Tuple[Tuple, ...]]:
     return tuple(sorted(out))
 
 
+class StampDelta:
+    """Classification of an (old, new) source-stamp-set pair — the
+    incremental result-maintenance admissibility verdict
+    (exec/incremental.py).  ``kind`` is one of:
+
+      * ``unchanged`` — identical stamp sets;
+      * ``append``    — every old file's (path, mtime_ns, size) stamp
+        holds verbatim and >= 1 new path appeared: the ONLY drift shape
+        whose delta can be recomputed from the new files alone;
+      * ``rewrite``   — some old path's stamp moved (size grew, shrank,
+        or an mtime-only touch: content equality is unknowable from the
+        stamp, so a touch classifies conservatively as a rewrite);
+      * ``shrink``    — some old path vanished from the new set (file
+        deleted or renamed away);
+      * ``mixed``     — both rewrites/shrinks AND appends at once.
+
+    Per-file attribution rides along so fallback counters and the
+    /resultcache inspection can say WHICH file broke incrementality."""
+
+    __slots__ = ("kind", "appended", "rewritten", "deleted")
+
+    def __init__(self, kind: str, appended, rewritten, deleted):
+        self.kind = kind
+        self.appended = tuple(appended)
+        self.rewritten = tuple(rewritten)
+        self.deleted = tuple(deleted)
+
+    def __repr__(self) -> str:
+        return (f"StampDelta({self.kind}, +{len(self.appended)} "
+                f"~{len(self.rewritten)} -{len(self.deleted)})")
+
+
+def classify_stamp_delta(old_stamps, new_stamps) -> StampDelta:
+    """Classify drift between two ``source_stamps`` tuples (see
+    :class:`StampDelta`).  Both arguments are iterables of
+    ("file", abspath, mtime_ns, size) stamps; paths, not live files,
+    are compared — a deleted file shows up as a missing path here, it
+    never re-raises the ``os.stat`` failure (the caller obtained the
+    new stamps through :func:`source_stamps`, whose contract is None on
+    any unstatable path)."""
+    old_by_path = {s[1]: s for s in old_stamps}
+    new_by_path = {s[1]: s for s in new_stamps}
+    appended = sorted(p for p in new_by_path if p not in old_by_path)
+    deleted = sorted(p for p in old_by_path if p not in new_by_path)
+    rewritten = sorted(p for p, s in old_by_path.items()
+                       if p in new_by_path and new_by_path[p] != s)
+    if not appended and not deleted and not rewritten:
+        return StampDelta("unchanged", (), (), ())
+    if appended and not deleted and not rewritten:
+        return StampDelta("append", appended, (), ())
+    if appended:
+        return StampDelta("mixed", appended, rewritten, deleted)
+    if deleted:
+        return StampDelta("shrink", (), rewritten, deleted)
+    return StampDelta("rewrite", (), rewritten, ())
+
+
 def handle_key(pf, src) -> Optional[Tuple]:
     """Plan-cache key for chunks walked through the open handle ``pf``:
     the stamp captured when the footer was parsed (FooterInfo), NOT a

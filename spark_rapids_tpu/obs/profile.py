@@ -30,7 +30,7 @@ from spark_rapids_tpu.obs import trace as obstrace
 # acceptance contract is "includes scan, shuffle, semaphore, and spill
 # sections" whether or not the query touched them
 SECTIONS = ("scan", "shuffle", "semaphore", "spill", "pyworker",
-            "fusion", "sched", "kernel", "compile")
+            "fusion", "sched", "kernel", "compile", "incremental")
 
 # compile-observatory metrics routed into the "compile" section even
 # though their names carry the kernel. prefix: the per-query compile

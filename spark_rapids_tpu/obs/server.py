@@ -27,6 +27,13 @@ run.  ``ObsHttpServer`` serves, from a background daemon thread:
                             estimates, and the kernel-backend
                             selection counters.  ``?n=`` bounds the
                             event count (default 256).
+  ``GET /resultcache``      JSON: per-entry inspection of the serving
+                            result cache (serve/result_cache.py) —
+                            digest prefix, output names, bytes, age,
+                            source count, and the entry's CURRENT
+                            stamp drift (rewritten/deleted file
+                            counts), so operators can see what the
+                            incremental refresher keeps warm.
   ``GET /healthz``          liveness probe.
 
 Off by default (``obs.http.enabled=false``): nothing binds a socket
@@ -174,9 +181,43 @@ class ObsHttpServer:
                 rc = result_cache.stats()
                 reg.set_gauge("serve.resultCacheBytes", rc["bytes"])
                 reg.set_gauge("serve.resultCacheEntries", rc["entries"])
+                reg.set_gauge("serve.resultCache.oldestEntryAgeSec",
+                              result_cache.oldest_entry_age_s())
         except Exception:
             pass
         return render_prometheus(reg.snapshot())
+
+    @staticmethod
+    def _resultcache_json() -> str:
+        """Per-entry inspection (the /queries idiom applied to the
+        result cache): age, bytes, stamped sources, and the current
+        stamp DRIFT per entry — how many of its files changed/vanished
+        and how many new files appeared since it was frozen — so an
+        operator can see exactly what the incremental refresher is
+        keeping warm and what will fall back to a full recompute."""
+        from spark_rapids_tpu.io import scan_cache as sc
+        from spark_rapids_tpu.serve import result_cache
+        rows = result_cache.entries_info()
+        for row in rows:
+            old = [tuple(s) for s in row.pop("stamps")]
+            paths = [s[1] for s in old]
+            cur = sc.source_stamps(paths)
+            if cur is None:
+                # at least one file is gone: stamp each survivor
+                cur = tuple(k for k in (sc.file_key(p) for p in paths)
+                            if k is not None)
+            delta = sc.classify_stamp_delta(old, cur)
+            row["sources"] = len(paths)
+            row["stamp_drift"] = {
+                "kind": delta.kind,
+                "appended": len(delta.appended),
+                "rewritten": len(delta.rewritten),
+                "deleted": len(delta.deleted),
+                "drifted_files": len(delta.rewritten)
+                + len(delta.deleted) + len(delta.appended),
+            }
+        return json.dumps({"entries": rows,
+                           "stats": result_cache.stats()})
 
     @staticmethod
     def _queries_json(session) -> str:
@@ -242,6 +283,8 @@ class ObsHttpServer:
                                     part[2:].isdigit():
                                 n = int(part[2:])
                         self._send(200, server._compiles_json(n))
+                    elif path == "/resultcache":
+                        self._send(200, server._resultcache_json())
                     elif path.startswith("/profiles/"):
                         tail = path.rsplit("/", 1)[1]
                         body = (server._profile_json(session, int(tail))
@@ -257,7 +300,7 @@ class ObsHttpServer:
                             {"ok": True,
                              "routes": ["/metrics", "/queries",
                                         "/profiles/<qid>", "/compiles",
-                                        "/healthz"]}))
+                                        "/resultcache", "/healthz"]}))
                     else:
                         self._send(404, json.dumps(
                             {"error": f"unknown route {path!r}"}))

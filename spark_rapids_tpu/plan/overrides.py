@@ -253,13 +253,22 @@ register_exec_rule(cpux.CpuSortExec, ExecRule(
                                             n.partitionwise),
     extra_tag=_sort_unsupported_types))
 
+def _convert_hash_agg(n, ch, conf):
+    out = TpuHashAggregateExec(ch[0], n.groupings, n.aggregates,
+                               n.schema, per_partition=n.per_partition)
+    # incremental-maintenance stamp threaded from the logical plan
+    # (exec/incremental.py via planner.plan_cpu)
+    inc = getattr(n, "_incremental", None)
+    if inc is not None:
+        out._incremental = inc
+    return out
+
+
 register_exec_rule(cpux.CpuHashAggregateExec, ExecRule(
     "HashAggregateExec",
     "TPU hash aggregate (sort-based segmented reduction)",
     lambda n: list(n.groupings) + list(n.aggregates),
-    convert=lambda n, ch, conf: TpuHashAggregateExec(
-        ch[0], n.groupings, n.aggregates, n.schema,
-        per_partition=n.per_partition),
+    convert=_convert_hash_agg,
     extra_tag=lambda n, conf: _nested_key_reasons(n.groupings, "grouping")))
 
 register_exec_rule(cpux.CpuExpandExec, ExecRule(

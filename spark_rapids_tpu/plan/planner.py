@@ -83,8 +83,16 @@ def plan_cpu(node: lp.LogicalPlan, conf: RapidsTpuConf) -> PhysicalPlan:
             return cpux.CpuHashAggregateExec(child, groupings, aggs,
                                              node.schema,
                                              per_partition=True)
-        return cpux.CpuHashAggregateExec(child, groupings, aggs,
-                                         node.schema)
+        agg_exec = cpux.CpuHashAggregateExec(child, groupings, aggs,
+                                             node.schema)
+        # incremental-maintenance stamp (exec/incremental.py): ride the
+        # logical node's partial-capture/retained-state hooks through
+        # to the physical aggregate; a private attr so the plan digest
+        # and expression enumeration never see it
+        inc = getattr(node, "_incremental", None)
+        if inc is not None:
+            agg_exec._incremental = inc
+        return agg_exec
     if isinstance(node, lp.Limit):
         child = plan_cpu(node.children[0], conf)
         return cpux.CpuLimitExec(child, node.n)

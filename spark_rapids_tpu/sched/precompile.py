@@ -99,9 +99,7 @@ class PrecompileService:
         """Live (queued or running) queries in this session's
         scheduler — the signal replay yields to."""
         try:
-            svc = self._session._query_service
-            with svc._track_lock:
-                return bool(svc._active)
+            return self._session._query_service.has_live_queries()
         except Exception:
             return False
 

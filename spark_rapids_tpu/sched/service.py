@@ -205,6 +205,13 @@ class QueryService:
     def _observe(self, plan, hwm_bytes: int) -> None:
         self.book.record(plan_shape_key(plan), hwm_bytes)
 
+    def has_live_queries(self) -> bool:
+        """True while any query is queued or running — the signal the
+        low-priority background services (sched/precompile replay, the
+        serve incremental refresher) yield to."""
+        with self._track_lock:
+            return bool(self._active)
+
     # -- live query table (the /queries telemetry surface) -------------------
     def _track(self, fut: QueryFuture, req: AdmissionRequest,
                meta: Optional[Dict[str, Any]] = None) -> None:

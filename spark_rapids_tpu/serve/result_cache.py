@@ -26,8 +26,9 @@ Counters (registry → /metrics): ``serve.resultCacheHits`` /
 from __future__ import annotations
 
 import threading
+import time
 from collections import OrderedDict
-from typing import Dict, Optional, Tuple
+from typing import Any, Dict, List, Optional, Tuple
 
 import pyarrow as pa
 
@@ -37,8 +38,8 @@ _LOCK = threading.Lock()
 _ENABLED = True
 _MAX_BYTES = 256 << 20
 
-# key -> (table, nbytes); LRU order (oldest first)
-_ENTRIES: "OrderedDict[Tuple, Tuple[pa.Table, int]]" = OrderedDict()
+# key -> (table, nbytes, inserted_unix); LRU order (oldest first)
+_ENTRIES: "OrderedDict[Tuple, Tuple[pa.Table, int, float]]" = OrderedDict()
 # (digest, names) -> last stamps inserted, so a fresh-stamp insert
 # purges the stale-stamp entry immediately instead of waiting out LRU
 _STAMP_OF: Dict[Tuple, Tuple] = {}
@@ -79,6 +80,39 @@ def stats() -> Dict[str, int]:
         return {"entries": len(_ENTRIES), "bytes": _TOTAL_BYTES}
 
 
+def oldest_entry_age_s(now: Optional[float] = None) -> float:
+    """Age in seconds of the oldest entry still resident (0.0 when the
+    cache is empty) — the ``serve.resultCache.oldestEntryAgeSec`` gauge
+    the /metrics scrape refreshes so operators can see how long the
+    refresher has kept results warm."""
+    now = time.time() if now is None else now
+    with _LOCK:
+        if not _ENTRIES:
+            return 0.0
+        oldest = min(ts for (_, _, ts) in _ENTRIES.values())
+    return max(0.0, now - oldest)
+
+
+def entries_info() -> List[Dict[str, Any]]:
+    """Per-entry inspection rows (digest prefix, names, bytes, age,
+    stamped source paths) for the ``/resultcache`` endpoint route; the
+    route joins each row against the files' CURRENT stamps to report
+    per-entry stamp drift."""
+    now = time.time()
+    with _LOCK:
+        snap = [(key, nb, ts) for key, (_, nb, ts) in _ENTRIES.items()]
+    out = []
+    for (digest, names, stamps), nb, ts in snap:
+        out.append({
+            "digest": str(digest)[:48],
+            "names": list(names),
+            "nbytes": int(nb),
+            "age_s": round(max(0.0, now - ts), 3),
+            "stamps": [list(s) for s in stamps],
+        })
+    return out
+
+
 def entry_key(digest: str, names, stamps) -> Tuple:
     return (digest, tuple(names), tuple(stamps))
 
@@ -94,7 +128,7 @@ def _evict_locked() -> None:
     global _TOTAL_BYTES
     reg = _obsreg.get_registry()
     while _TOTAL_BYTES > _MAX_BYTES and _ENTRIES:
-        key, (_, nb) = _ENTRIES.popitem(last=False)
+        key, (_, nb, _ts) = _ENTRIES.popitem(last=False)
         _TOTAL_BYTES -= nb
         if _STAMP_OF.get(key[:2]) == key[2]:
             del _STAMP_OF[key[:2]]
@@ -121,6 +155,27 @@ def lookup(digest: str, names, stamps) -> Optional[pa.Table]:
     return hit[0]
 
 
+def lookup_latest(digest: str, names
+                  ) -> Optional[Tuple[Tuple, pa.Table]]:
+    """The most recently inserted (stamps, table) for (digest, names)
+    regardless of whether those stamps still hold — the incremental
+    maintainer's retained-state lookup: a stale-stamp partial is
+    exactly what a delta refresh merges forward.  Counts neither a hit
+    nor a miss (the caller already counted its primary lookup).
+    Returns None when no entry for the pair is resident."""
+    if not _ENABLED:
+        return None
+    with _LOCK:
+        stamps = _STAMP_OF.get((digest, tuple(names)))
+        if stamps is None:
+            return None
+        hit = _ENTRIES.get(entry_key(digest, names, stamps))
+        if hit is None:
+            return None
+        _ENTRIES.move_to_end(entry_key(digest, names, stamps))
+        return stamps, hit[0]
+
+
 def insert(digest: str, names, stamps, table: pa.Table) -> bool:
     """Insert one materialized result; returns False when the cache is
     off, the entry alone exceeds the whole budget, or ``stamps`` is
@@ -145,7 +200,7 @@ def insert(digest: str, names, stamps, table: pa.Table) -> bool:
             _ENTRIES.move_to_end(key)
             _STAMP_OF[key[:2]] = key[2]
             return True
-        _ENTRIES[key] = (table, nb)
+        _ENTRIES[key] = (table, nb, time.time())
         _STAMP_OF[key[:2]] = key[2]
         _TOTAL_BYTES += nb
         _evict_locked()

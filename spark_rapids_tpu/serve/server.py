@@ -203,6 +203,12 @@ class ServeServer:
         result_cache.configure(
             bool(conf.get(cfg.SERVE_RESULT_CACHE_ENABLED)),
             int(conf.get(cfg.SERVE_RESULT_CACHE_MAX_BYTES)))
+        # incremental result maintenance (exec/incremental.py): delta
+        # scans + retained aggregate partials over the result cache,
+        # plus the background stamp-polling refresher
+        from spark_rapids_tpu.exec.incremental import \
+            IncrementalMaintainer
+        self.maintainer = IncrementalMaintainer(session)
         self._sessions: Dict[str, ServeSession] = {}
         self._lock = threading.Lock()
         self._session_seq = itertools.count(1)
@@ -238,6 +244,7 @@ class ServeServer:
 
     def shutdown(self) -> None:
         self._static_shutdown(self._lsock, self._stop)
+        self.maintainer.shutdown()
         with self._lock:
             sessions = list(self._sessions.values())
             self._sessions.clear()
@@ -495,8 +502,9 @@ class ServeServer:
         try:
             digest = cache_key = names = stamps = None
             cacheable = False
+            submit_plan, inc_ctx = plan, None
             try:
-                from spark_rapids_tpu.io.scan_cache import source_stamps
+                from spark_rapids_tpu.exec import incremental
                 from spark_rapids_tpu.plan.digest import plan_fingerprint
                 fp = plan_fingerprint(plan)
                 digest = fp.digest
@@ -506,7 +514,11 @@ class ServeServer:
                 cache_key = f"{self._semantics_stamp}:{fp.digest}"
                 names = tuple(plan.schema.names)
                 if fp.cacheable and result_cache.enabled():
-                    stamps = source_stamps(fp.sources)
+                    # stamps come from the LIVE expansion of the scan's
+                    # source roots (not the frozen read()-time file
+                    # list) so a file appended to a watched dataset
+                    # invalidates — and delta-refreshes — the entry
+                    stamps = incremental.current_stamps(plan)
                     cacheable = stamps is not None
             except Exception:
                 cacheable = False
@@ -520,13 +532,18 @@ class ServeServer:
                         args=(conn, sess, infl, hit),
                         name=f"serve-stream-{tag}", daemon=True).start()
                     return
+                # incremental maintenance decides full-capture vs delta
+                # (and re-pins watched scans to the live file set so
+                # the executed plan reads what the stamps describe)
+                submit_plan, inc_ctx = self.maintainer.prepare(
+                    plan, cache_key, names, stamps)
             eng = self._engine()
             meta = {"session_id": sess.session_id,
                     "client_addr": sess.client_addr}
             if digest is not None:
                 meta["plan_digest"] = digest  # already computed here
             fut = eng.scheduler.submit(
-                plan, priority=sess.priority,
+                submit_plan, priority=sess.priority,
                 timeout_ms=sess.timeout_ms,
                 estimate_bytes=sess.estimate_bytes,
                 meta=meta)
@@ -535,7 +552,7 @@ class ServeServer:
             threading.Thread(
                 target=self._stream_result,
                 args=(conn, sess, infl, cache_key, names, stamps,
-                      cacheable),
+                      cacheable, plan, inc_ctx),
                 name=f"serve-stream-{tag}", daemon=True).start()
         except BaseException:
             sess.end_query()
@@ -568,7 +585,7 @@ class ServeServer:
 
     def _stream_result(self, conn: _Conn, sess: ServeSession,
                        infl: _Inflight, cache_key, names, stamps,
-                       cacheable: bool) -> None:
+                       cacheable: bool, plan=None, inc_ctx=None) -> None:
         fut = infl.future
         release = self._releaser(conn, sess, infl)
         try:
@@ -583,16 +600,34 @@ class ServeServer:
                     self._send_err(conn, infl.tag, type(e).__name__,
                                    str(e))
                 return
-            if cacheable:
+            if inc_ctx is not None:
+                # the maintainer owns caching for maintained runs
+                # (result + partial state under verified stamps) and
+                # replaces a torn delta result with a full recompute
+                try:
+                    table = self.maintainer.finish(inc_ctx, table)
+                except BaseException as e:
+                    if inc_ctx.mode == "delta":
+                        # a delta result whose stamp verification (or
+                        # torn-result recompute) failed must never be
+                        # streamed as if it were the full answer
+                        if conn.alive:
+                            self._send_err(conn, infl.tag,
+                                           type(e).__name__, str(e))
+                        return
+                    # capture-mode maintenance is bookkeeping only: the
+                    # computed table itself is the plain full result
+            elif cacheable:
                 # only freeze the result when the sources still carry
                 # the pre-execution stamps: a file rewritten mid-query
                 # must not cache a half-old result under either stamp
-                from spark_rapids_tpu.io.scan_cache import source_stamps
+                from spark_rapids_tpu.exec import incremental
                 try:
-                    post = source_stamps([s[1] for s in stamps])
+                    post = incremental.current_stamps(plan) \
+                        if plan is not None else None
                 except Exception:
                     post = None
-                if post == stamps:
+                if post is not None and post == stamps:
                     result_cache.insert(cache_key, names, stamps,
                                         table)
             self._stream_table(conn, infl, table, cache_hit=False,

@@ -8,13 +8,17 @@ CatalystExpressionBuilder.scala:45-242) so the result can be accelerated by
 the planner like any other expression; any untranslatable opcode keeps the
 original UDF on CPU.
 
-Here the input is CPython 3.12 bytecode via :mod:`dis` and the output is
-:mod:`spark_rapids_tpu.expr.ir`.  The symbolic executor interprets the
-instruction stream over a stack of IR expressions; at a conditional jump it
-recursively evaluates both successors and merges them with ``ir.If`` (the
-reference does the same merge through CatalystExpressionBuilder's condition
-propagation, State.scala:78).  Loops (backward jumps) and unknown opcodes
-raise :class:`UdfCompileError`, which callers turn into a row-wise CPU
+Here the input is CPython bytecode via :mod:`dis` (the 3.10 through 3.12
+opcode families: 3.10's ``BINARY_ADD``/``CALL_FUNCTION``/``LOAD_METHOD``
+fixed-opcode forms and 3.11+'s parameterized ``BINARY_OP``/``CALL`` forms
+are both interpreted, so the same UDF compiles on every interpreter the
+engine supports) and the output is :mod:`spark_rapids_tpu.expr.ir`.  The
+symbolic executor interprets the instruction stream over a stack of IR
+expressions; at a conditional jump it recursively evaluates both
+successors and merges them with ``ir.If`` (the reference does the same
+merge through CatalystExpressionBuilder's condition propagation,
+State.scala:78).  Loops (backward jumps) and unknown opcodes raise
+:class:`UdfCompileError`, which callers turn into a row-wise CPU
 ``ir.PythonUDF`` fallback — matching the reference's fallback behavior.
 
 Known, documented semantic divergence (shared with the reference, whose
@@ -30,6 +34,7 @@ from __future__ import annotations
 import builtins
 import dis
 import math
+import sys
 from typing import Any, Dict, List, Optional, Sequence
 
 from spark_rapids_tpu.expr import ir
@@ -213,6 +218,26 @@ _BINARY_OPS = {
     11: ir.Divide,      # /
 }
 
+# CPython <= 3.10 spells each arithmetic op as its own opcode instead of
+# BINARY_OP's oparg; the INPLACE_* variants share semantics exactly as
+# the oparg-13 aliasing does on 3.11+
+_NAMED_BINARY_OPS = {}
+for _name, _builder in (("ADD", ir.Add), ("SUBTRACT", ir.Subtract),
+                        ("MULTIPLY", ir.Multiply),
+                        ("TRUE_DIVIDE", ir.Divide),
+                        ("FLOOR_DIVIDE", _floordiv),
+                        ("MODULO", ir.Pmod), ("POWER", ir.Pow)):
+    _NAMED_BINARY_OPS[f"BINARY_{_name}"] = _builder
+    _NAMED_BINARY_OPS[f"INPLACE_{_name}"] = _builder
+
+# LOAD_GLOBAL's oparg low bit became a push-NULL flag in 3.11;
+# LOAD_ATTR's low bit became a method-load flag only in 3.12 (3.11
+# still uses LOAD_METHOD).  On older interpreters the arg is a plain
+# name index and reading the bit would misinterpret every odd-indexed
+# name — so each opcode gates on the version that introduced ITS flag.
+_GLOBAL_NULL_FLAG = sys.version_info >= (3, 11)
+_ATTR_METHOD_FLAG = sys.version_info >= (3, 12)
+
 _COMPARE_OPS = {
     "<": ir.LessThan, "<=": ir.LessThanOrEqual, "==": ir.EqualTo,
     ">": ir.GreaterThan, ">=": ir.GreaterThanOrEqual,
@@ -288,9 +313,12 @@ class _Compiler:
             elif op == "RETURN_VALUE":
                 return _as_expr(stack.pop())
             elif op == "LOAD_GLOBAL":
-                if instr.arg & 1:
+                if _GLOBAL_NULL_FLAG and instr.arg & 1:
                     stack.append(_NULL)
                 stack.append(self.resolve_global(instr.argval))
+                idx += 1
+            elif op == "PUSH_NULL":            # 3.11+
+                stack.append(_NULL)
                 idx += 1
             elif op == "LOAD_ATTR":
                 obj = stack.pop()
@@ -299,17 +327,35 @@ class _Compiler:
                         attr = getattr(obj.value, instr.argval)
                     except AttributeError as e:
                         raise UdfCompileError(str(e))
-                    if instr.arg & 1:
+                    if _ATTR_METHOD_FLAG and instr.arg & 1:
                         stack.append(_NULL)
                     stack.append(_Raw(attr))
-                elif isinstance(obj, ir.Expression) and instr.arg & 1:
+                elif isinstance(obj, ir.Expression) and \
+                        _ATTR_METHOD_FLAG and instr.arg & 1:
                     stack.append(_Method(instr.argval))
                     stack.append(obj)
                 else:
                     raise UdfCompileError(
                         f"unsupported attribute load .{instr.argval}")
                 idx += 1
-            elif op == "CALL":
+            elif op == "LOAD_METHOD":          # <= 3.11
+                obj = stack.pop()
+                if isinstance(obj, ir.Expression):
+                    # the (method, self) pair CALL/CALL_METHOD pops
+                    stack.append(_Method(instr.argval))
+                    stack.append(obj)
+                elif isinstance(obj, _Raw):
+                    try:
+                        attr = getattr(obj.value, instr.argval)
+                    except AttributeError as e:
+                        raise UdfCompileError(str(e))
+                    stack.append(_NULL)
+                    stack.append(_Raw(attr))
+                else:
+                    raise UdfCompileError(
+                        f"unsupported method load .{instr.argval}")
+                idx += 1
+            elif op in ("CALL", "CALL_METHOD"):
                 argc = instr.arg or 0
                 args = stack[len(stack) - argc:]
                 del stack[len(stack) - argc:]
@@ -320,6 +366,18 @@ class _Compiler:
                 else:
                     result = _translate_call(a, b, args)
                 stack.append(result)
+                idx += 1
+            elif op == "CALL_FUNCTION":        # <= 3.10: no NULL slot
+                argc = instr.arg or 0
+                args = stack[len(stack) - argc:]
+                del stack[len(stack) - argc:]
+                stack.append(_translate_call(stack.pop(), None, args))
+                idx += 1
+            elif op in _NAMED_BINARY_OPS:      # <= 3.10
+                r = stack.pop()
+                le = stack.pop()
+                stack.append(_NAMED_BINARY_OPS[op](_as_expr(le),
+                                                   _as_expr(r)))
                 idx += 1
             elif op == "BINARY_OP":
                 r = stack.pop()
@@ -366,19 +424,31 @@ class _Compiler:
             elif op == "COPY":
                 stack.append(stack[-(instr.arg or 1)])
                 idx += 1
+            elif op == "DUP_TOP":              # <= 3.10
+                stack.append(stack[-1])
+                idx += 1
             elif op == "SWAP":
                 n = instr.arg or 2
                 stack[-1], stack[-n] = stack[-n], stack[-1]
                 idx += 1
+            elif op == "ROT_TWO":              # <= 3.10
+                stack[-1], stack[-2] = stack[-2], stack[-1]
+                idx += 1
+            elif op == "ROT_THREE":            # <= 3.10
+                stack[-1], stack[-2], stack[-3] = \
+                    stack[-2], stack[-3], stack[-1]
+                idx += 1
             elif op == "POP_TOP":
                 stack.pop()
                 idx += 1
-            elif op == "JUMP_FORWARD":
-                idx = self.by_offset[instr.argval]
+            elif op in ("JUMP_FORWARD", "JUMP_ABSOLUTE"):
+                idx = self._jump_target(instr)
             elif op == "JUMP_BACKWARD":
                 raise UdfCompileError("loops are not supported")
-            elif op in ("POP_JUMP_IF_FALSE", "POP_JUMP_IF_TRUE",
-                        "POP_JUMP_IF_NONE", "POP_JUMP_IF_NOT_NONE"):
+            elif op.startswith("POP_JUMP") and \
+                    ("_IF_" in op or op.startswith("POP_JUMP_IF")):
+                # POP_JUMP_IF_* (3.10/3.12) and the 3.11-only
+                # POP_JUMP_{FORWARD,BACKWARD}_IF_* spellings
                 cond = stack.pop()
                 if op.endswith("NONE"):
                     pred: ir.Expression = ir.IsNull(_as_expr(cond))
@@ -386,14 +456,38 @@ class _Compiler:
                 else:
                     pred = _as_bool(cond)
                     jump_when = op.endswith("TRUE")
-                target = self.by_offset[instr.argval]
+                target = self._jump_target(instr)
                 taken = self.run(target, stack, locals_, depth + 1)
                 fallthrough = self.run(idx + 1, stack, locals_, depth + 1)
                 if jump_when:
                     return ir.If(pred, taken, fallthrough)
                 return ir.If(pred, fallthrough, taken)
+            elif op in ("JUMP_IF_FALSE_OR_POP", "JUMP_IF_TRUE_OR_POP"):
+                # <= 3.11 `and`/`or` chains: the jump KEEPS the
+                # condition as the expression value, the fallthrough
+                # pops it and keeps evaluating
+                pred = _as_bool(stack[-1])
+                target = self._jump_target(instr)
+                taken = self.run(target, stack, locals_, depth + 1)
+                fallthrough = self.run(idx + 1, stack[:-1], locals_,
+                                       depth + 1)
+                if op == "JUMP_IF_TRUE_OR_POP":
+                    return ir.If(pred, taken, fallthrough)
+                return ir.If(pred, fallthrough, taken)
             else:
                 raise UdfCompileError(f"unsupported opcode {op}")
+
+    def _jump_target(self, instr) -> int:
+        """Instruction index of a jump's target; backward targets are
+        loops, which the compiler refuses (matching JUMP_BACKWARD on
+        3.12 — 3.10 spells loop back-edges as JUMP_ABSOLUTE)."""
+        target = self.by_offset.get(instr.argval)
+        if target is None:
+            raise UdfCompileError(
+                f"jump to unknown offset {instr.argval}")
+        if instr.argval <= instr.offset:
+            raise UdfCompileError("loops are not supported")
+        return target
 
 
 def _is_none(v: Any) -> bool:

@@ -1,0 +1,518 @@
+"""Incremental query maintenance (exec/incremental.py): delta scans +
+retained aggregate partials over the serving result cache.
+
+The full recompute is the bit-identical correctness oracle for every
+append path, and every non-append drift edge (rewrite, deletion,
+mtime-only touch, delta arriving mid-refresh) must land in
+``serve.incremental.fullFallbacks.<reason>`` — never in a wrong
+result."""
+
+import json
+import os
+import urllib.request
+
+import pyarrow as pa
+import pyarrow.parquet as papq
+import pytest
+
+from spark_rapids_tpu import TpuSparkSession, col, functions as F
+from spark_rapids_tpu.exec import incremental as inc
+from spark_rapids_tpu.io import scan_cache as sc
+from spark_rapids_tpu.obs import registry as obsreg
+from spark_rapids_tpu.serve import result_cache
+from spark_rapids_tpu.serve.client import ServeClient
+
+
+@pytest.fixture(autouse=True)
+def _fresh_state():
+    obsreg.reset_registry()
+    result_cache.clear()
+    yield
+    obsreg.reset_registry()
+    result_cache.clear()
+
+
+def _write(root, i, n0, n):
+    papq.write_table(pa.table({
+        "k": pa.array([j % 5 for j in range(n0, n0 + n)],
+                      type=pa.int64()),
+        "x": pa.array([(j * 3) % 100 for j in range(n0, n0 + n)],
+                      type=pa.int64())}),
+        os.path.join(root, f"part-{i:03d}.parquet"))
+
+
+def _session(extra=None):
+    conf = {
+        "spark.rapids.tpu.sql.variableFloatAgg.enabled": True,
+        "spark.rapids.tpu.serve.enabled": True,
+    }
+    conf.update(extra or {})
+    return TpuSparkSession(conf)
+
+
+_Q = "select k, count(*) as c, sum(x) as sx from t group by k"
+
+
+def _oracle(s, root):
+    return (s.read.parquet(root).group_by("k")
+            .agg(F.count("*").alias("c"), F.sum("x").alias("sx"))
+            .collect().sort_by("k"))
+
+
+def _counters(view):
+    return view.delta()["counters"]
+
+
+# ---------------------------------------------------------------------------
+# stamp-delta classification units
+# ---------------------------------------------------------------------------
+
+def _stamp(path, mtime=1, size=10):
+    return ("file", path, mtime, size)
+
+
+def test_classify_unchanged_and_append():
+    old = (_stamp("/a"), _stamp("/b"))
+    assert sc.classify_stamp_delta(old, old).kind == "unchanged"
+    new = old + (_stamp("/c"),)
+    d = sc.classify_stamp_delta(old, new)
+    assert d.kind == "append"
+    assert d.appended == ("/c",)
+    assert d.rewritten == () and d.deleted == ()
+
+
+def test_classify_rewrite_variants():
+    old = (_stamp("/a", mtime=1, size=10),)
+    # size change
+    assert sc.classify_stamp_delta(
+        old, (_stamp("/a", mtime=2, size=20),)).kind == "rewrite"
+    # mtime-only touch with the same size is conservatively a rewrite:
+    # content equality is unknowable from the stamp
+    d = sc.classify_stamp_delta(old, (_stamp("/a", mtime=2, size=10),))
+    assert d.kind == "rewrite" and d.rewritten == ("/a",)
+
+
+def test_classify_shrink_and_mixed():
+    old = (_stamp("/a"), _stamp("/b"))
+    d = sc.classify_stamp_delta(old, (_stamp("/a"),))
+    assert d.kind == "shrink" and d.deleted == ("/b",)
+    d = sc.classify_stamp_delta(
+        old, (_stamp("/a"), _stamp("/b", mtime=9), _stamp("/c")))
+    assert d.kind == "mixed"
+    assert d.appended == ("/c",) and d.rewritten == ("/b",)
+
+
+def test_classify_deleted_files_never_stat(tmp_path):
+    # classification is pure stamp arithmetic: paths that no longer
+    # exist on disk must not raise through os.stat
+    gone = str(tmp_path / "vanished.parquet")
+    d = sc.classify_stamp_delta((_stamp(gone),), ())
+    assert d.kind == "shrink" and d.deleted == (gone,)
+
+
+# ---------------------------------------------------------------------------
+# eligibility (explain-style reasons)
+# ---------------------------------------------------------------------------
+
+def _scan_df(s, tmp_path):
+    root = str(tmp_path)
+    _write(root, 0, 0, 200)
+    return s.read.parquet(root)
+
+
+def test_eligibility_reasons(tmp_path):
+    s = TpuSparkSession(
+        {"spark.rapids.tpu.sql.variableFloatAgg.enabled": True})
+    df = _scan_df(s, tmp_path)
+    agg = df.group_by("k").agg(F.sum("x").alias("sx"))
+    assert inc.eligibility(agg.plan, s.conf) == (True, "eligible")
+    # sort/projection above the aggregate stay eligible (deterministic
+    # transforms of the finalized output)
+    assert inc.eligibility(agg.sort("k").plan, s.conf)[0]
+    # non-agg root
+    ok, reason = inc.eligibility(df.filter(col("x") > 3).plan, s.conf)
+    assert (ok, reason) == (False, "non_agg_root")
+    # join below
+    j = df.join(df, on="k").group_by("k").agg(F.count("*").alias("c"))
+    assert inc.eligibility(j.plan, s.conf) == (False, "join")
+    # nondeterminism
+    nd = (df.with_column("r", F.rand()).group_by("k")
+          .agg(F.sum("r").alias("sr")))
+    assert inc.eligibility(nd.plan, s.conf) == (False, "nondeterminism")
+    # DISTINCT lowers to a nested (double) aggregate
+    dd = df.group_by("k").agg(F.sum_distinct(col("x")).alias("sd"))
+    assert inc.eligibility(dd.plan, s.conf) == (
+        False, "non_decomposable_function")
+    # first/last are arrival-order dependent
+    fl = df.group_by("k").agg(F.first("x").alias("f"))
+    assert inc.eligibility(fl.plan, s.conf) == (
+        False, "non_decomposable_function")
+    # in-memory source: no stamps to maintain
+    mem = s.create_dataframe({"k": [1, 2], "x": [3, 4]})
+    m = mem.group_by("k").agg(F.sum("x").alias("sx"))
+    assert inc.eligibility(m.plan, s.conf) == (False,
+                                               "non_scan_subtree")
+    lines = inc.explain(agg.plan, s.conf)
+    assert lines[0].endswith("ELIGIBLE")
+    assert "INELIGIBLE (join)" in inc.explain(j.plan, s.conf)[0]
+
+
+def test_eligibility_distributed_agg(tmp_path):
+    s = TpuSparkSession({
+        "spark.rapids.tpu.sql.variableFloatAgg.enabled": True,
+        "spark.rapids.tpu.sql.agg.exchange.enabled": True})
+    df = _scan_df(s, tmp_path)
+    agg = df.group_by("k").agg(F.sum("x").alias("sx"))
+    assert inc.eligibility(agg.plan, s.conf) == (False,
+                                                 "distributed_agg")
+
+
+# ---------------------------------------------------------------------------
+# serve-path end to end
+# ---------------------------------------------------------------------------
+
+def test_append_delta_bit_identical(tmp_path):
+    root = str(tmp_path)
+    _write(root, 0, 0, 2000)
+    _write(root, 1, 2000, 2000)
+    s = _session()
+    s.register_view("t", s.read.parquet(root))
+    reg = obsreg.get_registry()
+    with ServeClient("127.0.0.1", s.serve_server.port) as c:
+        first = c.sql(_Q)
+        assert first.sort_by("k").equals(_oracle(s, root))
+        assert c.sql(_Q).equals(first)                # plain hit
+        _write(root, 2, 4000, 300)                    # ~7% append
+        v = reg.view()
+        got = c.sql(_Q)
+        d = _counters(v)
+        assert d.get("serve.incremental.hits") == 1, d
+        assert d.get("serve.incremental.deltaFiles") == 1, d
+        assert d.get("serve.incremental.deltaBatches", 0) >= 1, d
+        assert got.sort_by("k").equals(_oracle(s, root))
+        # the refreshed entry serves the next lookup with ZERO
+        # dispatches under the new stamps
+        v2 = reg.view()
+        again = c.sql(_Q)
+        d2 = _counters(v2)
+        assert d2.get("serve.resultCacheHits") == 1, d2
+        assert d2.get("kernel.dispatches", 0) == 0, d2
+        assert again.equals(got)
+    s.serve_server.shutdown()
+
+
+def test_delta_scan_reads_zero_old_chunks(tmp_path):
+    """The walk-counter proof: with the scan-plan cache OFF every
+    scanned chunk walks page headers, so a delta refresh that read any
+    old-file row group would show in the counter."""
+    from spark_rapids_tpu.io import parquet_meta as pqm
+    root = str(tmp_path)
+    _write(root, 0, 0, 2000)
+    _write(root, 1, 2000, 2000)
+    s = _session({"spark.rapids.tpu.sql.scan.metadataCache.enabled":
+                  False})
+    s.register_view("t", s.read.parquet(root))
+    with ServeClient("127.0.0.1", s.serve_server.port) as c:
+        c.sql(_Q)                                     # capture run
+        _write(root, 2, 4000, 300)
+        w0 = pqm.walk_count()
+        got = c.sql(_Q)                               # delta run
+        walked = pqm.walk_count() - w0
+        # the delta file has 2 leaf columns in 1 row group: exactly 2
+        # chunk walks; ANY old-file read would add to this
+        assert walked == 2, walked
+        assert got.sort_by("k").equals(_oracle(s, root))
+    s.serve_server.shutdown()
+
+
+def test_global_aggregate_delta(tmp_path):
+    root = str(tmp_path)
+    _write(root, 0, 0, 1500)
+    s = _session()
+    s.register_view("t", s.read.parquet(root))
+    q = ("select count(*) as c, sum(x) as sx, min(x) as mn, "
+         "max(x) as mx, avg(x) as ax from t")
+    reg = obsreg.get_registry()
+    with ServeClient("127.0.0.1", s.serve_server.port) as c:
+        c.sql(q)
+        _write(root, 1, 1500, 400)
+        v = reg.view()
+        got = c.sql(q)
+        assert _counters(v).get("serve.incremental.hits") == 1
+    oracle = (s.read.parquet(root)
+              .agg(F.count("*").alias("c"), F.sum("x").alias("sx"),
+                   F.min("x").alias("mn"), F.max("x").alias("mx"),
+                   F.avg("x").alias("ax")).collect())
+    assert got.equals(oracle)
+    s.serve_server.shutdown()
+
+
+def test_incremental_disabled_one_knob(tmp_path):
+    root = str(tmp_path)
+    _write(root, 0, 0, 1200)
+    s = _session({"spark.rapids.tpu.serve.incremental.enabled": False})
+    s.register_view("t", s.read.parquet(root))
+    reg = obsreg.get_registry()
+    with ServeClient("127.0.0.1", s.serve_server.port) as c:
+        c.sql(_Q)
+        _write(root, 1, 1200, 300)
+        v = reg.view()
+        got = c.sql(_Q)
+        d = _counters(v)
+        assert d.get("serve.incremental.hits", 0) == 0, d
+        assert d.get("serve.incremental.deltaBatches", 0) == 0, d
+        assert got.sort_by("k").equals(_oracle(s, root))
+    s.serve_server.shutdown()
+
+
+# ---------------------------------------------------------------------------
+# append-detection edges: every one lands in fullFallbacks.<reason>
+# ---------------------------------------------------------------------------
+
+def _edge_session(tmp_path):
+    root = str(tmp_path)
+    _write(root, 0, 0, 1500)
+    _write(root, 1, 1500, 1500)
+    s = _session()
+    s.register_view("t", s.read.parquet(root))
+    return s, root
+
+
+def test_edge_inplace_rewrite(tmp_path):
+    s, root = _edge_session(tmp_path)
+    reg = obsreg.get_registry()
+    with ServeClient("127.0.0.1", s.serve_server.port) as c:
+        c.sql(_Q)
+        _write(root, 0, 7000, 900)                    # rewrite old file
+        v = reg.view()
+        got = c.sql(_Q)
+        d = _counters(v)
+        assert d.get("serve.incremental.fullFallbacks.rewrite") == 1, d
+        assert d.get("serve.incremental.hits", 0) == 0, d
+        assert got.sort_by("k").equals(_oracle(s, root))
+    s.serve_server.shutdown()
+
+
+def test_edge_file_deletion(tmp_path):
+    s, root = _edge_session(tmp_path)
+    reg = obsreg.get_registry()
+    with ServeClient("127.0.0.1", s.serve_server.port) as c:
+        c.sql(_Q)
+        os.remove(os.path.join(root, "part-001.parquet"))
+        v = reg.view()
+        got = c.sql(_Q)
+        d = _counters(v)
+        assert d.get("serve.incremental.fullFallbacks.shrink") == 1, d
+        assert got.sort_by("k").equals(_oracle(s, root))
+    s.serve_server.shutdown()
+
+
+def test_edge_mtime_touch_same_size(tmp_path):
+    s, root = _edge_session(tmp_path)
+    reg = obsreg.get_registry()
+    with ServeClient("127.0.0.1", s.serve_server.port) as c:
+        base = c.sql(_Q)
+        p = os.path.join(root, "part-000.parquet")
+        st = os.stat(p)
+        os.utime(p, ns=(st.st_atime_ns, st.st_mtime_ns + 1_000_000))
+        v = reg.view()
+        got = c.sql(_Q)
+        d = _counters(v)
+        assert d.get("serve.incremental.fullFallbacks.rewrite") == 1, d
+        assert got.equals(base)                       # content unchanged
+    s.serve_server.shutdown()
+
+
+def test_edge_delta_mid_refresh(tmp_path):
+    """Drift landing between a delta run's stamp observation and its
+    commit: a further pure append must not be frozen under stale stamps
+    (midStreamAppend — the computed table is still a coherent
+    snapshot), while an OLD file moving means the retained partials
+    were stale and the result is replaced by a full recompute
+    (midStreamDrift) — never a wrong result."""
+    s, root = _edge_session(tmp_path)
+    reg = obsreg.get_registry()
+    maint = s.serve_server.maintainer
+    df = (s.read.parquet(root).group_by("k")
+          .agg(F.count("*").alias("c"), F.sum("x").alias("sx")))
+    names = tuple(df.plan.schema.names)
+    key = "edge:" + __name__
+    # capture
+    stamps = inc.current_stamps(df.plan)
+    sub, ctx = maint.prepare(df.plan, key, names, stamps)
+    assert ctx is not None and ctx.mode == "capture"
+    maint.finish(ctx, s._execute(sub))
+    # append -> delta run, but MORE data lands before finish
+    _write(root, 2, 9000, 300)
+    stamps2 = inc.current_stamps(df.plan)
+    sub2, ctx2 = maint.prepare(df.plan, key, names, stamps2)
+    assert ctx2 is not None and ctx2.mode == "delta"
+    table = s._execute(sub2)
+    snapshot_oracle = _oracle(s, root)                # at ctx2.stamps
+    _write(root, 3, 12000, 200)                       # mid-stream append
+    v = reg.view()
+    got = maint.finish(ctx2, table)
+    d = _counters(v)
+    assert d.get(
+        "serve.incremental.fullFallbacks.midStreamAppend") == 1, d
+    assert got.sort_by("k").equals(snapshot_oracle)
+    # the drifted stamps were NOT frozen: no entry under stamps2
+    assert result_cache.lookup(key, names, stamps2) is None
+    # now: delta run whose OLD file is rewritten mid-stream
+    stamps3 = inc.current_stamps(df.plan)
+    sub3, ctx3 = maint.prepare(df.plan, key, names, stamps3)
+    if ctx3.mode != "delta":       # previous commit was skipped, so
+        maint.finish(ctx3, s._execute(sub3))   # re-capture first
+        _write(root, 4, 13000, 200)
+        stamps3 = inc.current_stamps(df.plan)
+        sub3, ctx3 = maint.prepare(df.plan, key, names, stamps3)
+    assert ctx3.mode == "delta"
+    table3 = s._execute(sub3)
+    _write(root, 0, 5000, 1500)                       # rewrite OLD file
+    v = reg.view()
+    got3 = maint.finish(ctx3, table3)
+    d = _counters(v)
+    assert d.get(
+        "serve.incremental.fullFallbacks.midStreamDrift") == 1, d
+    assert got3.sort_by("k").equals(_oracle(s, root))
+    s.serve_server.shutdown()
+
+
+def test_edge_unhonored_delta_stamp(tmp_path):
+    """Ground-truth guard: a delta run whose aggregate never filled the
+    partial sink (the plan landed on an exec that ignores the
+    ``_incremental`` stamp — e.g. a CPU fallback — while the scan's
+    file_subset restriction WAS honored) covers only the delta files.
+    finish() must detect the unfilled sink, refuse to stream/cache that
+    table, and fall back to a full recompute."""
+    s, root = _edge_session(tmp_path)
+    reg = obsreg.get_registry()
+    maint = s.serve_server.maintainer
+    df = (s.read.parquet(root).group_by("k")
+          .agg(F.count("*").alias("c"), F.sum("x").alias("sx")))
+    names = tuple(df.plan.schema.names)
+    key = "unhonored:" + __name__
+    stamps = inc.current_stamps(df.plan)
+    sub, ctx = maint.prepare(df.plan, key, names, stamps)
+    maint.finish(ctx, s._execute(sub))
+    _write(root, 2, 9000, 300)
+    stamps2 = inc.current_stamps(df.plan)
+    sub2, ctx2 = maint.prepare(df.plan, key, names, stamps2)
+    assert ctx2.mode == "delta"
+    torn = s._execute(sub2)
+    # simulate an exec that ignored the stamp: the sink stays empty
+    ctx2.sink.table = None
+    v = reg.view()
+    got = maint.finish(ctx2, torn)
+    d = _counters(v)
+    assert d.get("serve.incremental.fullFallbacks.unhonored") == 1, d
+    assert got.sort_by("k").equals(_oracle(s, root))
+    # nothing was frozen under the new stamps from the refused run
+    assert result_cache.lookup(key, names, stamps2) is None
+    s.serve_server.shutdown()
+
+
+# ---------------------------------------------------------------------------
+# refresher + inspection surfaces
+# ---------------------------------------------------------------------------
+
+def test_refresher_sweep_keeps_entry_warm(tmp_path):
+    root = str(tmp_path)
+    _write(root, 0, 0, 1500)
+    s = _session()          # refreshMs=0: drive sweeps directly
+    s.register_view("t", s.read.parquet(root))
+    reg = obsreg.get_registry()
+    maint = s.serve_server.maintainer
+    with ServeClient("127.0.0.1", s.serve_server.port) as c:
+        c.sql(_Q)
+        assert maint.tracked_keys()
+        assert maint.refresh_once() == 0              # nothing drifted
+        _write(root, 1, 1500, 300)
+        v = reg.view()
+        assert maint.refresh_once() == 1
+        d = _counters(v)
+        assert d.get("serve.incremental.refreshRuns") == 1, d
+        # refresher sweeps are not client hits
+        assert d.get("serve.incremental.hits", 0) == 0, d
+        v2 = reg.view()
+        got = c.sql(_Q)                               # warm hit
+        d2 = _counters(v2)
+        assert d2.get("serve.resultCacheHits") == 1, d2
+        assert d2.get("kernel.dispatches", 0) == 0, d2
+        assert got.sort_by("k").equals(_oracle(s, root))
+    s.serve_server.shutdown()
+
+
+def test_result_cache_age_and_latest():
+    t = pa.table({"a": [1, 2, 3]})
+    result_cache.configure(True, 64 << 20)
+    stamps = (("file", "/x", 1, 10),)
+    assert result_cache.oldest_entry_age_s() == 0.0
+    result_cache.insert("d1", ("a",), stamps, t)
+    assert result_cache.lookup_latest("d1", ("a",)) == (stamps, t)
+    assert result_cache.lookup_latest("nope", ("a",)) is None
+    assert result_cache.oldest_entry_age_s() >= 0.0
+    info = result_cache.entries_info()
+    assert len(info) == 1 and info[0]["age_s"] >= 0.0
+    assert info[0]["names"] == ["a"]
+    # newer stamps repoint latest and purge the stale entry
+    stamps2 = (("file", "/x", 2, 12),)
+    result_cache.insert("d1", ("a",), stamps2, t)
+    assert result_cache.lookup_latest("d1", ("a",))[0] == stamps2
+    assert result_cache.lookup("d1", ("a",), stamps) is None
+
+
+def test_partials_share_result_cache_budget(tmp_path):
+    root = str(tmp_path)
+    _write(root, 0, 0, 1200)
+    s = _session()
+    s.register_view("t", s.read.parquet(root))
+    with ServeClient("127.0.0.1", s.serve_server.port) as c:
+        c.sql(_Q)
+    st = result_cache.stats()
+    # the capture run froze BOTH the result and its partial state in
+    # the same byte-budget LRU
+    assert st["entries"] == 2 and st["bytes"] > 0, st
+    info = result_cache.entries_info()
+    assert any(r["names"] == list(inc.PARTIAL_NAMES) for r in info)
+    s.serve_server.shutdown()
+
+
+def test_metrics_and_resultcache_route(tmp_path):
+    root = str(tmp_path)
+    _write(root, 0, 0, 1200)
+    s = _session({"spark.rapids.tpu.obs.http.enabled": True})
+    s.register_view("t", s.read.parquet(root))
+    with ServeClient("127.0.0.1", s.serve_server.port) as c:
+        c.sql(_Q)
+        p = os.path.join(root, "part-000.parquet")
+        st = os.stat(p)
+        os.utime(p, ns=(st.st_atime_ns, st.st_mtime_ns + 1_000_000))
+        base = f"http://127.0.0.1:{s.obs_server.port}"
+        with urllib.request.urlopen(base + "/metrics", timeout=10) as r:
+            text = r.read().decode()
+        assert "serve_resultCache_oldestEntryAgeSec" in text, \
+            text.splitlines()[:5]
+        with urllib.request.urlopen(base + "/resultcache",
+                                    timeout=10) as r:
+            payload = json.loads(r.read().decode())
+        rows = payload["entries"]
+        assert rows and payload["stats"]["entries"] == len(rows)
+        # the touched file shows as per-entry stamp drift
+        drifted = [r for r in rows
+                   if r["stamp_drift"]["kind"] == "rewrite"]
+        assert drifted and all(
+            r["stamp_drift"]["drifted_files"] >= 1 for r in drifted)
+    s.obs_server.shutdown()
+    s.serve_server.shutdown()
+
+
+def test_profile_incremental_section_always_present(tmp_path):
+    root = str(tmp_path)
+    _write(root, 0, 0, 600)
+    s = TpuSparkSession(
+        {"spark.rapids.tpu.sql.variableFloatAgg.enabled": True})
+    s.read.parquet(root).group_by("k").agg(
+        F.count("*").alias("c")).collect()
+    prof = s.last_query_profile()
+    assert "incremental" in prof.metrics
