@@ -497,6 +497,124 @@ print(f"serving smoke OK: 3 clients bit-identical, "
       f"chunks streamed")
 EOF
 
+echo "== serve-chaos gate (3 clients under a seeded fault plan + drain/restart, bit-identical resumes, leak gauges zero) =="
+timeout 300 python - <<'EOF'
+# the hardened serving plane under its own fault harness
+# (serve/faults.py): a seeded plan drops streamed chunks, kills
+# connections mid-stream and fails session lookups while 3 reconnecting
+# clients run repeated queries — every result must be BIT-IDENTICAL to
+# the in-process oracle (the chunk sequence numbers make resumes
+# duplicate-free by construction).  Then one graceful drain/restart
+# cycle mid-stream: the successor server answers the resume on the same
+# port, the stream completes bit-identical, and the drained server's
+# leak audit (connections / streamer threads / admission slots /
+# sessions) reads all-zero.
+import os, threading, time
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+from spark_rapids_tpu import TpuSparkSession
+from spark_rapids_tpu.obs import registry as obsreg
+from spark_rapids_tpu.serve.client import ServeClient
+
+s = TpuSparkSession({
+    "spark.rapids.tpu.sql.variableFloatAgg.enabled": True,
+    "spark.rapids.tpu.serve.enabled": True,
+    "spark.rapids.tpu.serve.stream.chunkRows": 120,
+    "spark.rapids.tpu.serve.test.faultPlan":
+        "seed=5;stream.chunk:drop@3;stream.chunk:close@9:x2;"
+        "session.lookup:fail@6"})
+df = s.create_dataframe(
+    {"k": [i % 7 for i in range(1200)],
+     "x": [float(i % 50) for i in range(1200)],
+     "v": [f"s{i % 11}" for i in range(1200)]},
+    num_partitions=3)
+s.register_view("t", df)
+
+QUERIES = [
+    "select k, x, v from t order by k, x, v",
+    "select k, count(*) as c, sum(x) as sx from t "
+    "where x > 5.0 group by k order by k",
+    "select v, count(*) as c from t group by v order by v"]
+oracles = [s.sql(q).collect() for q in QUERIES]
+port = s.serve_server.port
+results, errors = {}, []
+
+def chaos_client(i):
+    try:
+        with ServeClient("127.0.0.1", port, reconnect=True,
+                         max_reconnects=8, backoff_s=0.05) as c:
+            results[i] = [c.sql(QUERIES[i]) for _ in range(3)]
+    except Exception as e:
+        errors.append(f"client {i}: {type(e).__name__}: {e}")
+
+threads = [threading.Thread(target=chaos_client, args=(i,))
+           for i in range(3)]
+for t in threads:
+    t.start()
+for t in threads:
+    t.join(timeout=240)
+assert not errors, errors
+for i, oracle in enumerate(oracles):
+    for got in results[i]:
+        assert got.num_rows == oracle.num_rows, (
+            f"client {i}: duplicate/missing chunks "
+            f"({got.num_rows} vs {oracle.num_rows} rows)")
+        assert got.equals(oracle), f"client {i} diverges under faults"
+c0 = obsreg.get_registry().snapshot()["counters"]
+injected = int(c0.get("serve.faults.injected", 0))
+assert injected >= 1, f"fault plan never fired: {c0}"
+
+# drain/restart cycle mid-stream (the plan re-arms fresh on the
+# successor — the resumed leg runs under chaos too)
+cli = ServeClient("127.0.0.1", port, reconnect=True,
+                  max_reconnects=8, backoff_s=0.05)
+stream = cli.sql_stream(QUERIES[0], credit=2)
+it = iter(stream)
+pieces = [next(it)]
+old = s.serve_server
+
+def swap():
+    time.sleep(0.05)
+    s.restart_serve_server(drain_deadline_ms=200)
+
+t = threading.Thread(target=swap)
+t.start()
+for tbl in it:
+    pieces.append(tbl)
+t.join(60)
+import pyarrow as pa
+got = pa.concat_tables(pieces)
+assert got.num_rows == oracles[0].num_rows, "resume duplicated chunks"
+assert got.equals(oracles[0]), "resumed stream not bit-identical"
+assert s.serve_server.port == port, "successor changed ports"
+leaks = old.leak_stats()
+assert leaks["connections"] == 0, leaks
+assert leaks["streamer_threads"] == 0, leaks
+assert leaks["inflight"] == 0, leaks
+assert leaks["sessions"] == 0, leaks
+cli.close()
+# the successor's teardown is async after the client close: poll the
+# leak gauges back to zero
+deadline = time.time() + 30
+while time.time() < deadline:
+    live = s.serve_server.leak_stats()
+    if live["connections"] == 0 and live["streamer_threads"] == 0 \
+            and live["inflight"] == 0:
+        break
+    time.sleep(0.05)
+live = s.serve_server.leak_stats()
+assert live["connections"] == 0, live
+assert live["streamer_threads"] == 0, live
+assert live["inflight"] == 0, live
+c = obsreg.get_registry().snapshot()["counters"]
+assert int(c.get("serve.drains", 0)) == 1, c
+resumed = int(c.get("serve.resumedStreams", 0))
+s.serve_server.shutdown()
+print(f"serve-chaos gate OK: 3 clients x3 queries bit-identical under "
+      f"{int(c.get('serve.faults.injected', 0))} injected faults, "
+      f"drain/restart resume bit-identical ({resumed} server-side "
+      f"resumes), leak gauges zero")
+EOF
+
 echo "== incremental-maintenance gate (append probe: delta bit-identical, zero old-file walks, refresher observed) =="
 timeout 300 python - <<'EOF'
 # ISSUE 15 acceptance: after an append to a cached aggregate query's

@@ -548,6 +548,24 @@ class TpuSparkSession:
         ``serve.port=0``)."""
         return self._serve_server
 
+    def restart_serve_server(self, drain_deadline_ms=None):
+        """Drain the current serving front-end and start a successor on
+        the SAME port — the in-process replica-swap primitive the drain/
+        resume contract exists for.  The drain lets in-flight streams
+        finish (then cancels stragglers with a typed ``Draining``
+        error); resume tokens, the retained-stream window and the
+        result cache survive, so clients reconnect, re-attach their
+        sessions and resume streams against the successor.  Returns the
+        new ServeServer."""
+        from spark_rapids_tpu.serve.server import ServeServer
+        old = self._serve_server
+        port = None
+        if old is not None:
+            port = old.port
+            old.drain(drain_deadline_ms)
+        self._serve_server = ServeServer(self, port=port)
+        return self._serve_server
+
     @property
     def precompile_service(self):
         """The background AOT precompile service

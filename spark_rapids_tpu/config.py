@@ -840,6 +840,79 @@ SERVE_STREAM_CHUNK_ROWS = conf(
     "overhead against backpressure granularity (a slow consumer bounds "
     "the server's read-ahead to its credit window times this).", int)
 
+SERVE_WIRE_MAX_FRAME_BYTES = conf(
+    "spark.rapids.tpu.serve.wire.maxFrameBytes", 256 << 20,
+    "Upper bound on a single serving wire frame's declared payload "
+    "length. A frame header claiming more is a protocol violation "
+    "(a hostile or desynced length prefix): the connection is answered "
+    "with a typed ServeWireError ERR (reason 'oversized') and torn "
+    "down BEFORE any payload allocation happens — body bytes only "
+    "ever allocate after the declared length validates under this "
+    "bound.", int)
+
+SERVE_WIRE_READ_TIMEOUT_MS = conf(
+    "spark.rapids.tpu.serve.wire.readTimeoutMs", 30_000,
+    "Per-connection frame-progress deadline on the serving reader: "
+    "once the first byte of a frame has arrived, the rest of the "
+    "frame must arrive within this bound or the connection is "
+    "answered with a typed ERR (reason 'timeout') and closed — the "
+    "slowloris defense (a client holding a half-sent frame open "
+    "cannot pin a reader thread forever). A connection IDLE at a "
+    "frame boundary is never timed out by this knob; idle sessions "
+    "are serve.session.idleTimeoutMs territory.", int)
+
+SERVE_WIRE_WRITE_STALL_MS = conf(
+    "spark.rapids.tpu.serve.wire.writeStallMs", 60_000,
+    "Write-stall deadline on serving-side frame sends (result "
+    "streamers and control responses): a send that makes zero "
+    "progress for this long — a client that stopped draining its "
+    "socket — aborts the connection with a typed ServeWireError "
+    "instead of pinning a streamer thread (and its retained result) "
+    "in sendall forever. Progress resets the deadline, so a slow but "
+    "live consumer is never killed.", int)
+
+SERVE_WIRE_STORM_THRESHOLD = conf(
+    "spark.rapids.tpu.serve.wire.stormThreshold", 16,
+    "Malformed-frame storm threshold: once this server instance has "
+    "counted this many malformed wire frames "
+    "(serve.wire.malformedFrames), ONE flight-recorder bundle with "
+    "reason 'protocol' is dumped (when obs.recorder.dir is set) so a "
+    "hostile or desynced client storm is diagnosable post-hoc. 0 "
+    "disables the bundle (counters still move).", int)
+
+SERVE_DRAIN_DEADLINE_MS = conf(
+    "spark.rapids.tpu.serve.drain.deadlineMs", 10_000,
+    "Default deadline for ServeServer.drain(): the server stops "
+    "accepting connections, refuses new queries with a typed "
+    "'Draining' error, and gives in-flight result streams this long "
+    "to finish; past it they are cancelled with the same typed error "
+    "and every connection is torn down leak-audited (streamer threads "
+    "joined, admission slots released, credit state dropped). Clients "
+    "resume interrupted streams after reconnecting (resume tokens + "
+    "chunk sequence numbers).", int)
+
+SERVE_STREAM_RETAIN_BYTES = conf(
+    "spark.rapids.tpu.serve.stream.retainBytes", 128 << 20,
+    "Byte budget for the retained-stream window: materialized result "
+    "tables of in-flight and recently finished streams are retained "
+    "(LRU, process-wide — they survive a drain/restart cycle) so a "
+    "client that reconnects can resume a stream from its last "
+    "received chunk sequence number instead of re-running the query. "
+    "An entry is dropped when the client acknowledges the completed "
+    "stream, on LRU pressure, or when its session's resume token "
+    "ages out.", int)
+
+SERVE_FAULT_PLAN = conf(
+    "spark.rapids.tpu.serve.test.faultPlan", "",
+    "Deterministic fault-injection plan for serving-plane chaos "
+    "testing, e.g. 'seed=7;stream.chunk:drop@3;accept:close@2;"
+    "frame.body:corrupt@1'. Same grammar as shuffle.test.faultPlan; "
+    "see spark_rapids_tpu/serve/faults.py for the serving injection "
+    "points (accept, frame.header, frame.body, stream.chunk, "
+    "client.read, session.lookup) and actions (drop, delay, close, "
+    "corrupt, truncate, oversize, unknown, slow, fail). Empty "
+    "disables injection.")
+
 OBS_COMPILE_ENABLED = conf(
     "spark.rapids.tpu.obs.compile.enabled", True,
     "Record a CompileEvent for every first (kernel, arg-shape) call "

@@ -183,6 +183,18 @@ class ObsHttpServer:
                 reg.set_gauge("serve.resultCacheEntries", rc["entries"])
                 reg.set_gauge("serve.resultCache.oldestEntryAgeSec",
                               result_cache.oldest_entry_age_s())
+                # live leak-audit gauges: connections, streamer
+                # threads and the retained-stream resume window (the
+                # chaos gate asserts these return to zero after drain)
+                leaks = srv.leak_stats()
+                reg.set_gauge("serve.connections",
+                              leaks["connections"])
+                reg.set_gauge("serve.streamerThreads",
+                              leaks["streamer_threads"])
+                reg.set_gauge("serve.retainedStreams",
+                              leaks["retained_streams"])
+                reg.set_gauge("serve.retainedStreamBytes",
+                              leaks["retained_bytes"])
         except Exception:
             pass
         return render_prometheus(reg.snapshot())

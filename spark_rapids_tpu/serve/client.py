@@ -8,24 +8,42 @@ frames under a credit window: the client grants ``credit`` chunks up
 front and replenishes one credit per chunk it consumes — a slow
 consumer therefore bounds how far ahead the server can materialize
 into the socket (the backpressure contract in serve/wire.py).
+
+Resilience (opt-in via ``reconnect=True``): every CHUNK carries a
+sequence number, so the stream iterator is duplicate-free by
+construction — chunks at or below the last sequence it yielded are
+dropped, a sequence hole or a lost connection triggers a resume.  On
+a connection loss the client reconnects with bounded exponential
+backoff, re-attaches its session by resume token (hello ``resume``),
+replays any prepared statements the server no longer holds (aliasing
+old statement ids to their replacements), and resumes each damaged
+stream from the last chunk it yielded via ``resume_stream`` — or, if
+the server's retained window lost the stream, re-executes the original
+request and skips the already-yielded prefix by sequence number.
+Default OFF: a plain client treats a lost connection as fatal, which
+is what the disconnect-cancellation paths (and their tests) rely on.
 """
 
 from __future__ import annotations
 
+import itertools
 import queue
 import socket
 import threading
+import time
 from typing import Any, Dict, Iterator, List, Optional
 
 import pyarrow as pa
 
+from spark_rapids_tpu.serve import faults as serve_faults
 from spark_rapids_tpu.serve import wire
 
 
 class ServeError(RuntimeError):
     """Server-reported request failure (``code`` is the typed ERR
-    discriminator: FairShareExceeded, SessionExpired, StatementError,
-    or the engine exception's type name)."""
+    discriminator: FairShareExceeded, SessionExpired, Draining,
+    ProtocolError, StatementError, or the engine exception's type
+    name)."""
 
     def __init__(self, code: str, msg: str):
         super().__init__(f"[{code}] {msg}")
@@ -58,35 +76,181 @@ class PreparedHandle:
                               "statement_id": self.statement_id})
 
 
+# ERR codes that mean "the stream can be resumed after reconnecting",
+# as opposed to a genuine query failure that must surface to the caller
+_RESUMABLE_CODES = ("Draining", "ConnectionClosed")
+
+
 class ResultStream:
     """Iterator over one query's streamed result chunks; replenishes
     one credit per consumed chunk.  ``read_all()`` drains into one
-    table; ``summary`` holds the END payload afterwards."""
+    table; ``summary`` holds the END payload afterwards.
+
+    Duplicate-freedom: chunks are yielded strictly in sequence order;
+    anything at or below ``last_seq`` is dropped (a resumed or
+    re-executed stream can never double-deliver), a hole above it
+    triggers a resume."""
 
     def __init__(self, client: "ServeClient", tag: int,
-                 timeout: Optional[float]):
+                 timeout: Optional[float], msg: Dict[str, Any],
+                 stream_id: str, credit: int):
         self._client = client
         self._tag = tag
         self._timeout = timeout
+        self._msg = dict(msg)          # original request, for re-execute
+        self._stream_id = stream_id
+        self._credit = credit
         self.summary: Optional[Dict[str, Any]] = None
+        self.last_seq = 0
+        self.resumes = 0
         self._done = False
 
     def __iter__(self) -> Iterator[pa.Table]:
         while not self._done:
-            kind, payload = self._client._next_stream_item(
-                self._tag, self._timeout)
+            try:
+                kind, payload = self._client._next_stream_item(
+                    self._tag, self._timeout)
+            except _ClosedError:
+                self._resume_or_raise(
+                    ServeError("ConnectionClosed",
+                               "connection lost mid-stream"))
+                continue
             if kind == wire.CHUNK:
+                seq, arrow = wire.split_chunk(payload)
+                if seq <= self.last_seq:
+                    # replayed prefix of a re-executed stream: consumed
+                    # credit, already yielded — drop, never re-yield
+                    self._client._grant(self._tag, 1)
+                    continue
+                if seq != self.last_seq + 1:
+                    # sequence hole (a dropped frame): this attempt is
+                    # damaged; resume strictly after the last good chunk
+                    self._resume_or_raise(ServeError(
+                        "StreamDamaged",
+                        f"chunk sequence hole: got {seq}, "
+                        f"expected {self.last_seq + 1}"))
+                    continue
+                self.last_seq = seq
                 self._client._grant(self._tag, 1)
-                yield wire.decode_chunk(payload)
+                yield wire.decode_chunk(arrow)
             elif kind == wire.END:
-                self.summary = wire.decode_msg(payload)
+                s = wire.decode_msg(payload)
+                want = int(s.get("last_seq") or 0)
+                if want and self.last_seq < want:
+                    # END arrived but the tail never did (dropped
+                    # chunks right before END): fetch the rest
+                    self._resume_or_raise(ServeError(
+                        "StreamDamaged",
+                        f"stream ended at seq {self.last_seq} of "
+                        f"{want}"))
+                    continue
+                self.summary = s
                 self._done = True
+                self._client._finish_stream(self._stream_id)
             else:                      # ERR
-                self._done = True
                 err = wire.decode_msg(payload)
-                raise ServeError(err.get("type", "Error"),
+                code = err.get("type", "Error")
+                if code in _RESUMABLE_CODES:
+                    self._resume_or_raise(ServeError(
+                        code, err.get("error", "stream interrupted")))
+                    continue
+                if code == "SessionExpired" and \
+                        self._client._reconnect_enabled:
+                    # the session was evicted under us: re-attach by
+                    # resume token (a fresh hello on the live
+                    # connection), then resume/re-execute
+                    try:
+                        self._client._rehello()
+                    except ServeError:
+                        pass
+                    self._resume_or_raise(ServeError(
+                        code, err.get("error", "session expired")))
+                    continue
+                if code == "ResumeUnavailable" and \
+                        self._client._reconnect_enabled:
+                    # the retained window lost this stream (or it never
+                    # started): skip straight to re-executing the
+                    # original request — the seq filter above keeps the
+                    # replay duplicate-free
+                    self._resume_or_raise(ServeError(
+                        code, err.get("error", "resume unavailable")),
+                        try_resume=False)
+                    continue
+                self._done = True
+                raise ServeError(code,
                                  err.get("error", "query failed"))
         return
+
+    def _resume_or_raise(self, cause: ServeError,
+                         try_resume: bool = True) -> None:
+        """Re-attach this stream after an interruption: reconnect if
+        needed, try ``resume_stream`` from ``last_seq`` (served from
+        the server's retained window), and fall back to re-executing
+        the original request — the sequence filter in ``__iter__``
+        keeps either path duplicate-free.  Raises ``cause`` when the
+        client has reconnection disabled or exhausted."""
+        cli = self._client
+        if not cli._reconnect_enabled:
+            self._done = True
+            raise cause
+        if self.resumes >= 3 * cli._max_reconnects:
+            # a stream that keeps getting interrupted is a systemic
+            # failure, not a blip — stop chasing it
+            self._done = True
+            raise cause
+        cli._unregister(self._tag)
+        deadline_attempts = cli._max_reconnects + 1
+        for attempt in range(deadline_attempts if try_resume else 0):
+            try:
+                cli._ensure_alive()
+            except ServeError:
+                self._done = True
+                raise cause
+            try:
+                self._tag = cli._start_stream_attempt(
+                    {"op": "resume_stream",
+                     "stream_id": self._stream_id,
+                     "after_seq": self.last_seq}, self._credit)
+                self.resumes += 1
+                return
+            except _ClosedError:
+                continue               # lost the new connection too
+            except ServeError as e:
+                if e.code == "SessionExpired":
+                    # the re-attach hello raced an eviction: force a
+                    # fresh hello on the next loop
+                    try:
+                        cli._rehello()
+                    except ServeError:
+                        pass
+                    continue
+                if e.code == "Draining":
+                    time.sleep(min(1.0, 0.05 * (2 ** attempt)))
+                    continue
+                if e.code == "ResumeUnavailable":
+                    break              # fall through to re-execute
+                self._done = True
+                raise
+        # the retained window lost the stream: re-execute the original
+        # request under the SAME stream id; the seq filter drops the
+        # prefix the first attempt already yielded
+        for attempt in range(deadline_attempts):
+            try:
+                cli._ensure_alive()
+                self._tag = cli._start_stream_attempt(
+                    dict(self._msg), self._credit)
+                self.resumes += 1
+                return
+            except _ClosedError:
+                continue
+            except ServeError as e:
+                if e.code == "Draining":
+                    time.sleep(min(1.0, 0.05 * (2 ** attempt)))
+                    continue
+                self._done = True
+                raise
+        self._done = True
+        raise cause
 
     def read_all(self) -> pa.Table:
         tables: List[pa.Table] = list(self)
@@ -98,29 +262,52 @@ class ResultStream:
 class ServeClient:
     """See module docstring.  ``conf`` is the session overlay the
     server applies to every query this session submits:
-    ``{"priority": int, "timeoutMs": int, "estimateBytes": int}``."""
+    ``{"priority": int, "timeoutMs": int, "estimateBytes": int}``.
+
+    ``reconnect=True`` arms the resilience machinery: bounded
+    exponential backoff (``max_reconnects`` attempts, ``backoff_s``
+    base doubling per attempt), session re-attach by resume token, and
+    transparent stream resume."""
 
     def __init__(self, host: str, port: int,
                  conf: Optional[Dict[str, Any]] = None,
                  connect_timeout: float = 10.0,
-                 default_credit: int = 8):
-        self._sock = socket.create_connection((host, port),
-                                              timeout=connect_timeout)
-        self._sock.settimeout(None)
+                 default_credit: int = 8,
+                 reconnect: bool = False,
+                 max_reconnects: int = 5,
+                 backoff_s: float = 0.05):
+        self._host, self._port = host, port
+        self._connect_timeout = connect_timeout
+        self._conf = dict(conf or {})
+        self._reconnect_enabled = bool(reconnect)
+        self._max_reconnects = max(1, int(max_reconnects))
+        self._backoff_s = max(0.001, float(backoff_s))
         self._wlock = threading.Lock()
         self._tags = iter(range(1, 1 << 62))
         self._tag_lock = threading.Lock()
         self._pending: Dict[int, "queue.Queue"] = {}
         self._plock = threading.Lock()
         self._closed = False
+        self._user_closed = False
+        self._gen = 0
+        self._conn_lock = threading.RLock()
         self._default_credit = max(1, int(default_credit))
-        self._reader = threading.Thread(target=self._read_loop,
-                                        name="serve-client-reader",
-                                        daemon=True)
-        self._reader.start()
+        self._stream_seq = itertools.count(1)
+        self._stream_nonce = f"{id(self) & 0xFFFFFF:06x}"
+        # prepared-statement replay state: original text + declared
+        # types by the id WE handed out, plus old-id -> live-id aliases
+        # after a replay onto a re-minted session
+        self._prepared: Dict[str, Dict[str, Any]] = {}
+        self._stmt_alias: Dict[str, str] = {}
+        self.resume_token: Optional[str] = None
+        self.reconnects = 0
+        self._sock = socket.create_connection((host, port),
+                                              timeout=connect_timeout)
+        self._sock.settimeout(None)
+        wire.set_low_latency(self._sock)
+        self._start_reader()
         try:
-            resp = self._request({"op": "hello",
-                                  "conf": dict(conf or {})})
+            resp = self._hello()
         except BaseException:
             # a failed handshake must not leak the socket and a
             # reader thread blocked in recv() forever (abort's
@@ -129,17 +316,38 @@ class ServeClient:
             raise
         self.session_id = resp["session_id"]
 
-    # -- plumbing ----------------------------------------------------------
+    # -- connection plumbing ------------------------------------------------
     def _next_tag(self) -> int:
         with self._tag_lock:
             return next(self._tags)
 
-    def _read_loop(self) -> None:
+    def _start_reader(self) -> None:
+        self._reader = threading.Thread(
+            target=self._read_loop, args=(self._gen, self._sock),
+            name=f"serve-client-reader-g{self._gen}", daemon=True)
+        self._reader.start()
+
+    def _read_loop(self, gen: int, sock: socket.socket) -> None:
         try:
             while True:
-                frame = wire.read_frame(self._sock)
+                frame = wire.read_frame(sock)
                 if frame is None:
                     break
+                ev = serve_faults.check("client.read") \
+                    if serve_faults.get_fault_plan() is not None else None
+                if ev is not None:
+                    act = serve_faults.ServeFaultAction
+                    if ev.action is act.DROP:
+                        continue       # discard the frame on the floor
+                    if ev.action is act.CLOSE:
+                        try:
+                            sock.shutdown(socket.SHUT_RDWR)
+                        except OSError:
+                            pass
+                        sock.close()
+                        break
+                    if ev.action is act.DELAY:
+                        time.sleep(ev.delay_s)
                 kind, tag, payload = frame
                 with self._plock:
                     q = self._pending.get(tag)
@@ -148,16 +356,93 @@ class ServeClient:
         except (wire.WireError, OSError):
             pass
         finally:
-            self._fail_pending()
+            self._fail_pending(gen)
 
-    def _fail_pending(self) -> None:
+    def _fail_pending(self, gen: Optional[int] = None) -> None:
         with self._plock:
+            if gen is not None and gen != self._gen:
+                return                 # a newer connection took over
             self._closed = True
             pending = list(self._pending.values())
         err = wire.encode_msg({"type": "ConnectionClosed",
                                "error": "connection closed"})
         for q in pending:
             q.put((wire.ERR, err))
+
+    def _hello(self) -> Dict[str, Any]:
+        """Handshake on the CURRENT socket; re-attaches by resume
+        token when one is held and replays prepared statements the
+        server no longer knows."""
+        msg: Dict[str, Any] = {"op": "hello", "conf": self._conf}
+        if self.resume_token:
+            msg["resume"] = self.resume_token
+        resp = self._request_inner(msg, timeout=30.0)
+        self.session_id = resp["session_id"]
+        self.resume_token = resp.get("resume_token") or self.resume_token
+        have = set(resp.get("statements") or [])
+        for old_id, spec in list(self._prepared.items()):
+            live = self._stmt_alias.get(old_id, old_id)
+            if live in have:
+                continue
+            desc = self._request_inner(
+                {"op": "prepare", "sql": spec["sql"],
+                 "params": spec["params"]}, timeout=30.0)
+            self._stmt_alias[old_id] = desc["statement_id"]
+        return resp
+
+    def _rehello(self) -> Dict[str, Any]:
+        with self._conn_lock:
+            return self._hello()
+
+    def _ensure_alive(self) -> None:
+        """Reconnect (with bounded exponential backoff) if the current
+        connection is dead; no-op on a live one."""
+        if not self._closed:
+            return
+        with self._conn_lock:
+            if not self._closed:
+                return                 # another thread reconnected
+            if self._user_closed:
+                raise _ClosedError("client closed")
+            if not self._reconnect_enabled:
+                raise _ClosedError()
+            self._fail_pending()       # orphan anything still pending
+            last: Optional[BaseException] = None
+            for attempt in range(self._max_reconnects):
+                if attempt:
+                    time.sleep(min(2.0,
+                                   self._backoff_s * (2 ** attempt)))
+                try:
+                    sock = socket.create_connection(
+                        (self._host, self._port),
+                        timeout=self._connect_timeout)
+                except OSError as e:
+                    last = e
+                    continue
+                sock.settimeout(None)
+                wire.set_low_latency(sock)
+                with self._plock:
+                    self._gen += 1
+                    self._closed = False
+                self._sock = sock
+                self._wlock = threading.Lock()
+                self._start_reader()
+                try:
+                    self._hello()
+                except ServeError as e:
+                    last = e
+                    try:
+                        sock.close()
+                    except OSError:
+                        pass
+                    with self._plock:
+                        self._closed = True
+                    continue
+                self.reconnects += 1
+                return
+            raise _ClosedError(
+                f"reconnect failed after {self._max_reconnects} "
+                f"attempts: {last}")
 
     def _register(self, tag: int) -> "queue.Queue":
         q: "queue.Queue" = queue.Queue()
@@ -171,24 +456,32 @@ class ServeClient:
         with self._plock:
             self._pending.pop(tag, None)
 
+    def _send_frame(self, kind: int, tag: int, payload: bytes) -> None:
+        if serve_faults.get_fault_plan() is not None:
+            serve_faults.send_frame_with_faults(
+                self._sock, self._wlock, kind, tag, payload)
+        else:
+            wire.send_frame(self._sock, self._wlock, kind, tag, payload)
+
     def _send_req(self, tag: int, msg: Dict[str, Any]) -> None:
         try:
-            wire.send_frame(self._sock, self._wlock, wire.REQ, tag,
-                            wire.encode_msg(msg))
+            self._send_frame(wire.REQ, tag, wire.encode_msg(msg))
         except wire.WireError as e:
             self._unregister(tag)
+            self._fail_pending()
             raise _ClosedError(str(e)) from e
 
     def _grant(self, tag: int, n: int) -> None:
         try:
-            wire.send_frame(self._sock, self._wlock, wire.CREDIT, tag,
-                            wire.encode_msg({"n": int(n)}))
+            self._send_frame(wire.CREDIT, tag,
+                             wire.encode_msg({"n": int(n)}))
         except wire.WireError:
             pass                       # stream will fail on its own
 
-    def _request(self, msg: Dict[str, Any],
-                 timeout: Optional[float] = 60.0) -> Dict[str, Any]:
-        """One control round trip (RESP/ERR)."""
+    def _request_inner(self, msg: Dict[str, Any],
+                       timeout: Optional[float]) -> Dict[str, Any]:
+        """One control round trip on the current connection — no
+        reconnect (the reconnect path itself calls this)."""
         tag = self._next_tag()
         q = self._register(tag)
         try:
@@ -207,6 +500,23 @@ class ServeClient:
         finally:
             self._unregister(tag)
 
+    def _request(self, msg: Dict[str, Any],
+                 timeout: Optional[float] = 60.0) -> Dict[str, Any]:
+        """One control round trip (RESP/ERR), reconnecting first if
+        the connection is down and reconnection is armed."""
+        self._ensure_alive()
+        return self._request_inner(msg, timeout)
+
+    def _finish_stream(self, stream_id: str) -> None:
+        """Fire-and-forget ack that a stream was fully consumed — the
+        server drops its retained replay window for it.  Best-effort:
+        a failed ack only costs the server retention until LRU."""
+        try:
+            self._request_inner({"op": "finish_stream",
+                                 "stream_id": stream_id}, timeout=5.0)
+        except (ServeError, OSError):
+            pass
+
     def _next_stream_item(self, tag: int, timeout: Optional[float]):
         with self._plock:
             q = self._pending.get(tag)
@@ -224,19 +534,42 @@ class ServeClient:
             self._unregister(tag)
         return kind, payload
 
-    def _query(self, msg: Dict[str, Any], credit: Optional[int],
-               timeout: Optional[float]) -> ResultStream:
+    def _translate(self, msg: Dict[str, Any]) -> Dict[str, Any]:
+        """Map a prepared-statement id through the replay alias table
+        (identity for ids the server still holds)."""
+        if msg.get("op") == "execute":
+            sid = str(msg.get("statement_id", ""))
+            live = self._stmt_alias.get(sid)
+            if live is not None:
+                msg = dict(msg)
+                msg["statement_id"] = live
+        return msg
+
+    def _start_stream_attempt(self, msg: Dict[str, Any],
+                              credit: int) -> int:
+        """Register a fresh tag and send one query-shaped request
+        (initial execution, resume, or re-execution)."""
         tag = self._next_tag()
         self._register(tag)
-        msg = dict(msg)
-        msg["credit"] = int(credit if credit is not None
-                            else self._default_credit)
+        m = self._translate(dict(msg))
+        m["credit"] = int(credit)
         try:
-            self._send_req(tag, msg)
+            self._send_req(tag, m)
         except BaseException:
             self._unregister(tag)
             raise
-        return ResultStream(self, tag, timeout)
+        return tag
+
+    def _query(self, msg: Dict[str, Any], credit: Optional[int],
+               timeout: Optional[float]) -> ResultStream:
+        self._ensure_alive()
+        credit = int(credit if credit is not None
+                     else self._default_credit)
+        stream_id = f"{self._stream_nonce}-{next(self._stream_seq)}"
+        msg = dict(msg)
+        msg["stream_id"] = stream_id
+        tag = self._start_stream_attempt(msg, credit)
+        return ResultStream(self, tag, timeout, msg, stream_id, credit)
 
     # -- public surface ----------------------------------------------------
     def sql(self, sql: str, timeout: Optional[float] = None
@@ -254,8 +587,13 @@ class ServeClient:
         """Prepare a ``:name``-parameterized statement; ``params`` maps
         parameter name → SQL type name (int, bigint, double, string,
         date, timestamp, ...)."""
-        return PreparedHandle(self, self._request(
-            {"op": "prepare", "sql": sql, "params": dict(params or {})}))
+        desc = self._request(
+            {"op": "prepare", "sql": sql, "params": dict(params or {})})
+        # keep the text + declarations so a reconnect onto a re-minted
+        # session can replay the prepare and alias the id
+        self._prepared[desc["statement_id"]] = {
+            "sql": sql, "params": dict(params or {})}
+        return PreparedHandle(self, desc)
 
     def execute(self, statement_id: str,
                 params: Optional[Dict[str, Any]] = None,
@@ -285,11 +623,13 @@ class ServeClient:
     def close(self, end_session: bool = True) -> None:
         """Graceful close (server evicts the session when
         ``end_session``); idempotent."""
+        self._user_closed = True
         if self._closed:
             return
         try:
-            self._request({"op": "close", "end_session": end_session},
-                          timeout=5.0)
+            self._request_inner({"op": "close",
+                                 "end_session": end_session},
+                                timeout=5.0)
         except ServeError:
             pass
         self.abort()
@@ -299,6 +639,7 @@ class ServeClient:
         tests exercise).  shutdown() before close(): close() alone
         would neither wake this client's own blocked reader nor send
         the FIN the server's reader needs to observe the disconnect."""
+        self._user_closed = True
         try:
             self._sock.shutdown(socket.SHUT_RDWR)
         except OSError:

@@ -1,0 +1,322 @@
+"""Deterministic fault injection for the serving plane.
+
+PR 1's seeded fault-harness idiom (shuffle/faults.py) ported to the
+front door: a config-driven :class:`ServeFaultPlan`
+(``spark.rapids.tpu.serve.test.faultPlan``) that serving code consults
+at named injection points, so chaos runs against the wire protocol are
+reproducible bit-for-bit.  Every fault the plan can provoke must
+surface as a *typed, recoverable, observable* event — never a dead
+reader thread, a leaked streamer, or a stranded client.
+
+Injection points (consulted via :func:`check`):
+
+==================  ======================================================
+point               consulted
+==================  ======================================================
+``accept``          once per accepted server connection (CLOSE drops it
+                    immediately, DELAY sleeps before serving)
+``frame.header``    once per frame a :class:`ServeClient` sends — the
+                    header leg (CORRUPT garbles header bytes, OVERSIZE
+                    rewrites the u32 length past serve.wire.maxFrameBytes,
+                    UNKNOWN rewrites the kind byte, TRUNCATE sends a
+                    partial header then closes, SLOW drips the header
+                    byte-by-byte — the slowloris client)
+``frame.body``      once per nonempty frame body a client sends (CORRUPT
+                    flips a payload bit, TRUNCATE sends a partial body
+                    then closes, SLOW drips it byte-by-byte)
+``stream.chunk``    once per CHUNK frame a server streamer sends (DROP
+                    skips the send — the client sees a sequence hole and
+                    resumes, CLOSE kills the connection mid-stream,
+                    DELAY sleeps before sending)
+``client.read``     once per frame the client reader receives (DROP
+                    discards it, CLOSE drops the client's socket, DELAY
+                    sleeps before delivery)
+``session.lookup``  once per server-side session lookup (FAIL makes the
+                    lookup miss — the session vanished, as after a
+                    replica swap — forcing the client down the
+                    re-hello/resume path)
+==================  ======================================================
+
+Plan grammar is shuffle/faults.py's, verbatim::
+
+    spec      := directive (";" directive)*
+    directive := "seed=" INT
+               | point ":" action [ "@" N ] ( ":" field )*
+    field     := "x" M  max fires | "p" P  probability | "d" MS  delay
+               | "i" IDX  target index
+
+Example — drop the 3rd streamed chunk, close the 2nd accepted
+connection, and corrupt the first request body, identically every
+run::
+
+    seed=7;stream.chunk:drop@3;accept:close@2;frame.body:corrupt@1
+"""
+
+from __future__ import annotations
+
+import enum
+import random
+import re
+import socket
+import threading
+import time
+from dataclasses import dataclass
+from typing import Dict, List, Optional
+
+from spark_rapids_tpu.obs import registry as _obsreg
+
+
+class ServeFaultAction(enum.Enum):
+    DROP = "drop"
+    DELAY = "delay"
+    CLOSE = "close"
+    CORRUPT = "corrupt"
+    TRUNCATE = "truncate"
+    OVERSIZE = "oversize"
+    UNKNOWN = "unknown"
+    SLOW = "slow"
+    FAIL = "fail"
+
+
+@dataclass
+class ServeFaultRule:
+    point: str
+    action: ServeFaultAction
+    at: Optional[int] = None      # first consultation (1-based) to arm at
+    prob: float = 0.0             # alternative: seeded per-consult chance
+    delay_ms: float = 0.0
+    max_fires: int = 1
+    arg: Optional[int] = None
+    fires: int = 0
+
+
+@dataclass(frozen=True)
+class ServeFaultEvent:
+    """One fault decision returned by :func:`check`."""
+    point: str
+    action: ServeFaultAction
+    delay_s: float = 0.0
+    arg: Optional[int] = None
+
+
+class ServeFaultPlan:
+    """Seeded, deterministic fault schedule for the serving plane —
+    the FaultPlan contract from shuffle/faults.py: ``check(point)`` is
+    cheap and thread-safe, occurrence rules (``@N``) depend only on
+    consultation order at that point, probability rules draw from one
+    seeded RNG under the plan lock."""
+
+    def __init__(self, rules: List[ServeFaultRule], seed: int = 0):
+        self.rules = list(rules)
+        self.seed = seed
+        self._rng = random.Random(seed)
+        self._counts: Dict[str, int] = {}
+        self._lock = threading.Lock()
+        self.spec: Optional[str] = None
+
+    def check(self, point: str) -> Optional[ServeFaultEvent]:
+        with self._lock:
+            n = self._counts.get(point, 0) + 1
+            self._counts[point] = n
+            for r in self.rules:
+                if r.point != point or r.fires >= r.max_fires:
+                    continue
+                if r.prob > 0.0:
+                    if self._rng.random() >= r.prob:
+                        continue
+                elif r.at is not None and n < r.at:
+                    continue
+                r.fires += 1
+                _obsreg.get_registry().inc("serve.faults.injected")
+                _obsreg.get_registry().inc(f"serve.faults.injected.{point}")
+                return ServeFaultEvent(point, r.action,
+                                       r.delay_ms / 1000.0, r.arg)
+        return None
+
+    def consultations(self, point: str) -> int:
+        with self._lock:
+            return self._counts.get(point, 0)
+
+    @property
+    def total_fires(self) -> int:
+        with self._lock:
+            return sum(r.fires for r in self.rules)
+
+    @staticmethod
+    def corrupt(payload: bytes) -> bytes:
+        """Deterministically flip one bit in the middle of the payload
+        (the shuffle harness's corruption)."""
+        if not payload:
+            return payload
+        out = bytearray(payload)
+        out[len(out) // 2] ^= 0x40
+        return bytes(out)
+
+    _DIRECTIVE = re.compile(r"^(?P<point>[\w.]+):(?P<action>[a-z]+)"
+                            r"(?:@(?P<at>\d+))?$")
+
+    @classmethod
+    def parse(cls, spec: str) -> Optional["ServeFaultPlan"]:
+        """Parse the config-string grammar; None for an empty spec,
+        ValueError on a malformed one."""
+        spec = (spec or "").strip()
+        if not spec:
+            return None
+        seed = 0
+        rules: List[ServeFaultRule] = []
+        for directive in spec.split(";"):
+            directive = directive.strip()
+            if not directive:
+                continue
+            if directive.startswith("seed="):
+                seed = int(directive[len("seed="):])
+                continue
+            parts = directive.split(":")
+            head = ":".join(parts[:2])
+            m = cls._DIRECTIVE.match(head)
+            if m is None:
+                raise ValueError(f"bad fault directive {directive!r}")
+            rule = ServeFaultRule(
+                point=m.group("point"),
+                action=ServeFaultAction(m.group("action")),
+                at=int(m.group("at")) if m.group("at") else None)
+            for f in parts[2:]:
+                f = f.strip()
+                if f.startswith("x"):
+                    rule.max_fires = int(f[1:])
+                elif f.startswith("p"):
+                    rule.prob = float(f[1:])
+                elif f.startswith("d"):
+                    rule.delay_ms = float(f[1:])
+                elif f.startswith("i"):
+                    rule.arg = int(f[1:])
+                else:
+                    raise ValueError(f"bad fault field {f!r} in "
+                                     f"{directive!r}")
+            rules.append(rule)
+        plan = cls(rules, seed)
+        plan.spec = spec
+        return plan
+
+
+# ---------------------------------------------------------------------------
+# Process-wide plan (the shuffle/faults singleton idiom)
+# ---------------------------------------------------------------------------
+
+_plan: Optional[ServeFaultPlan] = None
+_lock = threading.Lock()
+
+
+def get_fault_plan() -> Optional[ServeFaultPlan]:
+    return _plan
+
+
+def set_fault_plan(plan: Optional[ServeFaultPlan]
+                   ) -> Optional[ServeFaultPlan]:
+    """Install (or clear, with None) the process-wide serving plan."""
+    global _plan
+    with _lock:
+        _plan = plan
+    return plan
+
+
+def install_plan_from_conf(conf, fresh: bool = True
+                           ) -> Optional[ServeFaultPlan]:
+    """Parse ``spark.rapids.tpu.serve.test.faultPlan`` and install it.
+
+    The shuffle install contract: an empty spec leaves a
+    directly-installed plan alone but CLEARS a previously
+    conf-installed one; ``fresh=True`` (server construction) re-arms a
+    same-spec plan so a restarted server gets fresh consultation
+    counters instead of an exhausted schedule."""
+    from spark_rapids_tpu import config as cfg
+    spec = str(conf.get(cfg.SERVE_FAULT_PLAN) or "").strip()
+    cur = get_fault_plan()
+    if not spec:
+        if cur is not None and cur.spec is not None:
+            set_fault_plan(None)
+        return None
+    if not fresh and cur is not None and cur.spec == spec:
+        return cur
+    return set_fault_plan(ServeFaultPlan.parse(spec))
+
+
+def check(point: str) -> Optional[ServeFaultEvent]:
+    """Consult the installed plan at one injection point (None when no
+    plan is installed — the production fast path is one global read)."""
+    plan = _plan
+    if plan is None:
+        return None
+    return plan.check(point)
+
+
+# ---------------------------------------------------------------------------
+# Client-side frame mangling (frame.header / frame.body)
+# ---------------------------------------------------------------------------
+
+def send_frame_with_faults(sock: socket.socket, lock: threading.Lock,
+                           kind: int, tag: int,
+                           payload: bytes = b"") -> None:
+    """The fault-injecting twin of ``wire.send_frame`` — the path
+    :class:`ServeClient` uses while a plan is installed, so chaos runs
+    can hand the server exactly the malformed bytes the hardening must
+    survive.  Consults ``frame.header`` then ``frame.body`` and
+    applies the fired mutation to the raw frame bytes; with no armed
+    rule it degenerates to a plain framed send."""
+    from spark_rapids_tpu.serve import wire
+    hdr = bytearray(wire.HDR.pack(kind, tag, len(payload)))
+    body = bytes(payload)
+    close_after, slow_s = False, 0.0
+    ev = check("frame.header")
+    if ev is not None:
+        if ev.action is ServeFaultAction.CORRUPT:
+            hdr[0] ^= 0x5A          # garbled kind byte
+            hdr[-1] ^= 0x81         # and a garbled length byte
+        elif ev.action is ServeFaultAction.OVERSIZE:
+            hdr = bytearray(wire.HDR.pack(kind, tag, 0xFFFF_FFF0))
+            body = b""              # never send a body for the lie
+            close_after = True      # the server tears the conn down
+        elif ev.action is ServeFaultAction.UNKNOWN:
+            hdr[0] = 0x7F           # unregistered frame kind
+        elif ev.action is ServeFaultAction.TRUNCATE:
+            hdr = hdr[:wire.HDR.size // 2]
+            body = b""
+            close_after = True
+        elif ev.action is ServeFaultAction.SLOW:
+            slow_s = max(ev.delay_s, 0.001)
+        elif ev.action is ServeFaultAction.DELAY:
+            time.sleep(ev.delay_s)
+        elif ev.action is ServeFaultAction.CLOSE:
+            hdr, body, close_after = bytearray(), b"", True
+    if body:
+        ev = check("frame.body")
+        if ev is not None:
+            if ev.action is ServeFaultAction.CORRUPT:
+                body = ServeFaultPlan.corrupt(body)
+            elif ev.action is ServeFaultAction.TRUNCATE:
+                body = body[: max(1, len(body) // 2)]
+                close_after = True
+            elif ev.action is ServeFaultAction.SLOW:
+                slow_s = max(slow_s, ev.delay_s, 0.001)
+            elif ev.action is ServeFaultAction.DELAY:
+                time.sleep(ev.delay_s)
+    data = bytes(hdr) + body
+    try:
+        with lock:
+            if slow_s > 0.0:
+                for i in range(len(data)):      # the slowloris drip
+                    sock.sendall(data[i:i + 1])
+                    time.sleep(slow_s)
+            elif data:
+                sock.sendall(data)
+        if close_after:
+            try:
+                sock.shutdown(socket.SHUT_RDWR)
+            except OSError:
+                pass
+            sock.close()
+            raise wire.WireError("connection closed by fault plan")
+    except wire.WireError:
+        raise
+    except OSError as e:
+        raise wire.WireError(f"send failed: {e}") from e

@@ -155,6 +155,19 @@ def lookup(digest: str, names, stamps) -> Optional[pa.Table]:
     return hit[0]
 
 
+def peek(digest: str, names, stamps) -> Optional[pa.Table]:
+    """Non-counting lookup: the cached result for (digest, names,
+    stamps) with NO hit/miss accounting and no LRU promotion.  The
+    stream-resume path uses this — a reconnecting client replaying the
+    tail of a result it already earned must not inflate the hit-rate
+    counters the zero-dispatch CI gate asserts on."""
+    if not _ENABLED or stamps is None:
+        return None
+    with _LOCK:
+        hit = _ENTRIES.get(entry_key(digest, names, stamps))
+    return hit[0] if hit is not None else None
+
+
 def lookup_latest(digest: str, names
                   ) -> Optional[Tuple[Tuple, pa.Table]]:
     """The most recently inserted (stamps, table) for (digest, names)

