@@ -752,6 +752,56 @@ def _serve_probe(root: str, n_clients: int) -> dict:
     }
 
 
+def _sharing_probe(root: str, n_clients: int = 8) -> dict:
+    """Multi-query work sharing (ISSUE 16): the SAME q6-class query
+    submitted by N concurrent clients, with sharing off (every client
+    pays a full execution) vs on (single-flight collapses the batch to
+    one execution, sched.dedup.hits = N-1).  Results bit-identical to
+    a serial run both ways; the shared batch must clear >= 3x
+    queries/sec — the redundant-traffic contract."""
+    from spark_rapids_tpu import TpuSparkSession
+    from spark_rapids_tpu.obs import registry as obsreg
+
+    def batch(extra: dict):
+        conf = {"spark.rapids.tpu.sql.variableFloatAgg.enabled": True}
+        conf.update(extra)
+        s = TpuSparkSession(conf)
+        serial = _query(s, root).collect()   # warm + parity oracle
+        view = obsreg.get_registry().view()
+        t0 = time.perf_counter()
+        futs = [_query(s, root).collect_async()
+                for _ in range(n_clients)]
+        tables = [f.result(timeout=900) for f in futs]
+        wall = time.perf_counter() - t0
+        for i, t in enumerate(tables):
+            assert t.equals(serial), \
+                f"shared client {i} diverges from the serial run"
+        return wall, view.delta()["counters"]
+
+    wall_off, _ = batch({
+        "spark.rapids.tpu.sched.dedup.enabled": False,
+        "spark.rapids.tpu.sql.scan.shared.enabled": False,
+        "spark.rapids.tpu.serve.batch.enabled": False})
+    wall_on, d = batch({})                   # sharing is the default
+    assert int(d.get("sched.dedup.flights", 0)) == 1, d
+    assert int(d.get("sched.dedup.hits", 0)) == n_clients - 1, d
+    speedup = wall_off / max(wall_on, 1e-9)
+    assert speedup >= 3.0, (
+        f"work sharing only {speedup:.2f}x faster at {n_clients} "
+        f"concurrent identical queries ({wall_off:.3f}s off vs "
+        f"{wall_on:.3f}s on)")
+    return {
+        "n_clients": n_clients,
+        "wall_off_s": round(wall_off, 3),
+        "wall_on_s": round(wall_on, 3),
+        "qps_off": round(n_clients / wall_off, 3),
+        "qps_on": round(n_clients / wall_on, 3),
+        "speedup": round(speedup, 2),
+        "dedup_hits": int(d.get("sched.dedup.hits", 0)),
+        "rows_match": True,
+    }
+
+
 def _incremental_probe(n: int = 160_000, files: int = 8,
                        append_pct: float = 0.02) -> dict:
     """Incremental result maintenance (exec/incremental.py): time a
@@ -903,6 +953,10 @@ def main() -> None:
         if serve_n:
             serve = _serve_probe(root, serve_n)
 
+        # multi-query work sharing: 8 concurrent identical clients,
+        # sharing off vs on (>= 3x asserted inside, bit-identical)
+        sharing = _sharing_probe(root, 8)
+
         e2e = None
         if not smoke:
             try:
@@ -957,6 +1011,7 @@ def main() -> None:
         "concurrent": concurrent,
         "shuffle": shuffle_probe,
         "serve": serve,
+        "sharing": sharing,
         "e2e_tunnel_wall_s": round(e2e, 2) if e2e else None,
         "vs_baseline_e2e": round(cpu_time / e2e, 4) if e2e else None,
         "profile_out": profile_out,
@@ -1086,6 +1141,16 @@ def _write_trend_file(result: dict, n: int, files: int,
             "speedup": (result.get("incremental") or {}).get("speedup"),
             "append_pct":
                 (result.get("incremental") or {}).get("append_pct"),
+        },
+        # multi-query work sharing (ISSUE 16): N concurrent identical
+        # clients, sharing off vs on, and the single-flight collapse
+        "sharing": {
+            "n_clients": (result.get("sharing") or {}).get("n_clients"),
+            "qps_off": (result.get("sharing") or {}).get("qps_off"),
+            "qps_on": (result.get("sharing") or {}).get("qps_on"),
+            "speedup": (result.get("sharing") or {}).get("speedup"),
+            "dedup_hits":
+                (result.get("sharing") or {}).get("dedup_hits"),
         },
         "compile": _compile_totals(),
         "rows_match": result.get("rows_match"),

@@ -705,6 +705,83 @@ print(f"incremental gate OK: delta walked 2/2 delta chunks "
       f"warm hit with 0 dispatches")
 EOF
 
+echo "== work-sharing gate (8 concurrent identical -> single-flight: one execution, bit-identical, zero follower dispatches) =="
+timeout 300 python - <<'EOF'
+# ISSUE 16 contract: N concurrent identical deterministic submissions
+# collapse to ONE execution.  A plan listener parks the leader at plan
+# time so all 7 followers provably join the open flight (no timing
+# luck); the followers' dispatch bill must be ZERO — the 8-way batch
+# pays exactly one serial run's kernel.dispatches.
+import os, threading, time
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+from spark_rapids_tpu import TpuSparkSession, col, functions as F
+from spark_rapids_tpu.obs import registry as obsreg
+from spark_rapids_tpu.sched import cancel as sched_cancel
+
+s = TpuSparkSession({
+    "spark.rapids.tpu.sql.variableFloatAgg.enabled": True})
+
+def query():
+    df = s.create_dataframe(
+        {"k": [i % 9 for i in range(3000)],
+         "x": [float(i % 83) for i in range(3000)]},
+        num_partitions=3)
+    return (df.filter(col("x") > 7.0).group_by("k")
+            .agg(F.sum("x").alias("sx"), F.count("*").alias("c"))
+            .sort("k"))
+
+serial = query().collect()                 # warm compiles
+view = obsreg.get_registry().view()
+serial2 = query().collect()
+one_exec = view.delta()["counters"].get("kernel.dispatches", 0)
+assert serial2.equals(serial)
+
+class Parker:
+    def __init__(self):
+        self.release = threading.Event()
+        self.parked = threading.Semaphore(0)
+    def __call__(self, result):
+        self.parked.release()
+        tok = sched_cancel.current()
+        deadline = time.time() + 60
+        while not self.release.is_set() and time.time() < deadline:
+            if tok is not None and tok.is_cancelled:
+                return
+            time.sleep(0.005)
+
+parker = Parker()
+s.add_plan_listener(parker)
+reg = obsreg.get_registry()
+view = reg.view()
+try:
+    leader = query().collect_async()
+    assert parker.parked.acquire(timeout=30), "leader never planned"
+    followers = [query().collect_async() for _ in range(7)]
+    deadline = time.time() + 20
+    while reg.counter("sched.dedup.hits") < 7 and \
+            time.time() < deadline:
+        time.sleep(0.01)
+finally:
+    parker.release.set()
+tables = [leader.result(timeout=300)] + \
+    [f.result(timeout=300) for f in followers]
+for i, t in enumerate(tables):
+    assert t.equals(serial), f"shared result {i} diverges"
+d = view.delta()["counters"]
+assert d.get("sched.dedup.flights", 0) == 1, d
+assert d.get("sched.dedup.hits", 0) == 7, d
+got = d.get("kernel.dispatches", 0)
+assert got == one_exec, (
+    f"8-way batch dispatched {got} kernels, one serial run costs "
+    f"{one_exec} — followers executed instead of subscribing")
+for f in followers:
+    assert f.profile.metrics["sharing"][
+        "sched.dedup.leaderQueryId"] == leader.query_id
+print(f"work-sharing gate OK: 8 concurrent identical -> 1 execution "
+      f"({got} dispatches == serial bill), 7 dedup hits, "
+      f"bit-identical")
+EOF
+
 echo "== shape-erased ABI collapse gate (>=4x fewer programs, bit-identical) =="
 timeout 560 python - <<'EOF'
 # the serving-shaped probe: ONE query family over 2 schemas x 2 value

@@ -411,6 +411,56 @@ class TpuSparkSession:
         except Exception:
             pass
 
+    def _record_dedup_follower(self, query_id: int, leader_qid: int,
+                               state, error: Optional[BaseException],
+                               meta: Optional[Dict[str, Any]],
+                               wall_ns: int, result) -> Any:
+        """Stub QueryProfile for a single-flight follower: the follower
+        never executed, so instead of an empty or duplicated profile it
+        records a pointer at the leader's query id
+        (``sched.dedup.leaderQueryId``) whose profile holds the real
+        execution.  Rings, notifies the listener fan-out and the
+        slow-query log (rows carry ``deduped: true``) exactly like the
+        rejection stub.  Returns the profile (None on any failure) —
+        the caller attaches it to the follower future."""
+        try:
+            from spark_rapids_tpu.obs import listener as obs_listener
+            from spark_rapids_tpu.obs.profile import QueryProfile
+            meta = dict(meta or {})
+            sched = {"sched.dedup.leaderQueryId": leader_qid,
+                     "sched.deduped": 1}
+            if meta.get("session_id") is not None:
+                sched["sched.sessionId"] = meta["session_id"]
+            status = getattr(state, "value", str(state))
+            nrows = None
+            try:
+                if result is not None:
+                    nrows = int(result.num_rows)
+            except Exception:
+                nrows = None
+            prof = QueryProfile(
+                query_id=query_id,
+                status=status,
+                error=None if error is None
+                else f"{type(error).__name__}: {error}",
+                result_rows=nrows, wall_ns=int(wall_ns), phases={},
+                plan=None,
+                metrics={"sched": sched,
+                         "sharing": {"sched.dedup.leaderQueryId":
+                                     leader_qid}},
+                wall_breakdown={}, explain_lines=[], spans=[],
+                plan_digest=meta.get("plan_digest"))
+            with self._profile_lock:
+                self._profiles[query_id] = prof
+                while len(self._profiles) > self._profile_ring:
+                    self._profiles.popitem(last=False)
+                self._last_profile = prof
+            obs_listener.notify(self._query_listeners, prof, error)
+            self._maybe_log_slow_query(prof)
+            return prof
+        except Exception:
+            return None
+
     def _maybe_log_slow_query(self, prof) -> None:
         """Structured slow-query log: one JSONL record per query at or
         over ``obs.slowQueryMs`` (failures included — a query that died
@@ -449,6 +499,11 @@ class TpuSparkSession:
                         "wall_s", "result_rows", "phases",
                         "wall_breakdown"):
                 record[key] = d[key]
+            leader = prof.metrics.get("sched", {}).get(
+                "sched.dedup.leaderQueryId")
+            if leader is not None:
+                record["deduped"] = True
+                record["leader_query_id"] = leader
             line = _json.dumps(record, default=str)
             from spark_rapids_tpu.obs import recorder as obs_recorder
             from spark_rapids_tpu.obs import registry as obsreg

@@ -234,6 +234,23 @@ SCAN_PREFETCH_DEPTH = conf(
     "k+1 overlaps decode of batch k; no device->host read happens "
     "before the terminal barrier). 0 disables pipelining.", int)
 
+SCAN_SHARED_ENABLED = conf(
+    "spark.rapids.tpu.sql.scan.shared.enabled", True,
+    "Multicast decoded scan batches across concurrent queries: when "
+    "two plans decode the same (file, row-group, column-set, stamp) "
+    "key at the same time, one decodes and every subscriber receives "
+    "the decoded batch (refcounted retention window; eviction is "
+    "always correctness-safe — a miss just re-decodes). Off reverts "
+    "to per-query decoding.", bool)
+
+SCAN_SHARED_WINDOW_BYTES = conf(
+    "spark.rapids.tpu.sql.scan.shared.windowBytes", 64 << 20,
+    "Byte budget for the shared-scan multicast retention window "
+    "(decoded batches kept briefly so a slightly-behind subscriber "
+    "still shares the decode). LRU eviction; the window also registers "
+    "as a pressure spiller so HBM pressure drops retained batches "
+    "first.", int)
+
 ORC_DEVICE_DECODE = conf(
     "spark.rapids.tpu.sql.format.orc.deviceDecode.enabled", True,
     "Decode ORC stripes on the TPU: CPU parses stripe footers and RLEv2 "
@@ -683,6 +700,17 @@ SCHED_QUERY_ESTIMATE_BYTES = conf(
     "catalog's device-bytes high-water mark of prior runs; "
     "submit(estimate_bytes=...) overrides per query.", int)
 
+SCHED_DEDUP_ENABLED = conf(
+    "spark.rapids.tpu.sched.dedup.enabled", True,
+    "Single-flight execution: concurrent submissions of the same "
+    "deterministic plan (same canonical digest + output names) join "
+    "one in-flight execution instead of running N copies — followers' "
+    "futures resolve from the leader's result, leader cancellation "
+    "promotes a follower instead of killing the flight. "
+    "Non-deterministic / uncacheable plans always bypass "
+    "(PlanFingerprint.cacheable gate). Off reverts to "
+    "one-execution-per-submission.", bool)
+
 SCHED_PROFILE_RING = conf(
     "spark.rapids.tpu.sched.profileRing", 64,
     "How many completed QueryProfiles the session retains, keyed by "
@@ -901,6 +929,28 @@ SERVE_STREAM_RETAIN_BYTES = conf(
     "An entry is dropped when the client acknowledges the completed "
     "stream, on LRU pressure, or when its session's resume token "
     "ages out.", int)
+
+SERVE_BATCH_ENABLED = conf(
+    "spark.rapids.tpu.serve.batch.enabled", True,
+    "Coalesce prepared-statement executions: when the same statement "
+    "template is bound with different parameters within the batching "
+    "window, eligible plan shapes (projection over a parameterized "
+    "filter) merge into ONE vectorized execution — each binding's "
+    "predicate rides along as a marker column and results split per "
+    "client host-side. Literal erasure in the kernel ABI means the "
+    "coalesced run is compile-free across binding values. Off reverts "
+    "to one execution per bind.", bool)
+
+SERVE_BATCH_WINDOW_MS = conf(
+    "spark.rapids.tpu.serve.batch.windowMs", 2,
+    "How long an execute of a batch-eligible prepared statement waits "
+    "for siblings before flushing (the micro-batching window). A full "
+    "batch (batch.maxStatements) flushes immediately.", int)
+
+SERVE_BATCH_MAX_STATEMENTS = conf(
+    "spark.rapids.tpu.serve.batch.maxStatements", 16,
+    "Upper bound on bindings coalesced into one vectorized execution; "
+    "arrivals past it start the next batch.", int)
 
 SERVE_FAULT_PLAN = conf(
     "spark.rapids.tpu.serve.test.faultPlan", "",

@@ -140,6 +140,21 @@ def donate_ok(child: PhysicalPlan, enabled: bool) -> bool:
         if len(set(ords)) < len(ords):
             return False
         child = child.children[0]
+    # shared-scan multicast (io/scan_share): a fused parquet scan with
+    # sharing enabled may hand the SAME decoded batch to several
+    # queries and retains it in the multicast window — donating it
+    # would invalidate every other holder's copy.  The bar is static
+    # (this predicate runs BEFORE child.execute() opens any flight),
+    # so it keys on the scan's conf, not on live sharing state.
+    if type(child).__name__ == "TpuParquetScanExec":
+        from spark_rapids_tpu import config as cfg
+        try:
+            if (child.fmt == "parquet" and child.allow_fused and
+                    bool(child.conf.get(cfg.PARQUET_FUSED_DECODE)) and
+                    bool(child.conf.get(cfg.SCAN_SHARED_ENABLED))):
+                return False
+        except Exception:
+            return False
     return type(child).__name__ in _DONATE_SAFE_PRODUCERS
 
 

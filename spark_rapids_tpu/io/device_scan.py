@@ -229,6 +229,23 @@ class TpuParquetScanExec(TpuExec):
         pushed = getattr(self, "_pushed_filter", None)
         groups = self._fused_groups()
 
+        # shared-scan multicast (io/scan_share): concurrent queries
+        # decoding the same (stamps, row-groups, columns, filter)
+        # group share ONE host prep + device decode
+        share = None
+        share_keys: List = []
+        if bool(self.conf.get(cfg.SCAN_SHARED_ENABLED)):
+            from spark_rapids_tpu.exec import kernel_cache as kc
+            from spark_rapids_tpu.io import scan_share
+            share = scan_share.get_share(
+                int(self.conf.get(cfg.SCAN_SHARED_WINDOW_BYTES)))
+            schema_sig = tuple((f.name, f.dtype.name)
+                               for f in self._schema.fields)
+            pushed_sig = kc.expr_sig(pushed)
+            share_keys = [scan_share.share_key(srcs, pv, schema_sig,
+                                               pushed_sig, backend)
+                          for srcs, pv in groups]
+
         def prepare(path_rgs):
             """Host prep + packed-page upload for one batch (NO device
             read — safe on the prefetch thread)."""
@@ -275,32 +292,103 @@ class TpuParquetScanExec(TpuExec):
                 for h in handles.values():
                     h.close()
 
+        def _prep(idx, path_rgs):
+            """Prepare with a sharing claim: markers are ("solo"/"lead"/
+            "join", entry, prepared).  A joined claim skips the host
+            prep (and so the page walks) entirely."""
+            if share is None or share_keys[idx] is None:
+                return ("solo", None, prepare(path_rgs))
+            role, entry = share.claim(share_keys[idx])
+            if role == "join":
+                return ("join", entry, None)
+            try:
+                return ("lead", entry, prepare(path_rgs))
+            except BaseException as e:
+                share.fail(entry, e)
+                share.release(entry)
+                raise
+
+        def _finish_marker(marker, pv) -> DeviceBatch:
+            """Dispatch one non-join marker's decode (caller holds the
+            TPU semaphore); a lead marker settles its flight."""
+            kind, entry, prepared = marker
+            if kind == "solo":
+                return finish(prepared, pv)
+            try:
+                out = finish(prepared, pv)
+            except BaseException as e:
+                share.fail(entry, e)
+                share.release(entry)
+                raise
+            share.publish(entry, out)
+            share.release(entry)
+            return out
+
+        def _resolve(marker, idx, path_rgs, pv) -> DeviceBatch:
+            """Marker -> decoded batch.  Takes the semaphore only for
+            real decode work — never while waiting on another query's
+            flight (the leader's decode needs a slot)."""
+            while True:
+                kind, entry, _prepared = marker
+                if kind != "join":
+                    with tpu_semaphore(self.metrics):
+                        return _finish_marker(marker, pv)
+                try:
+                    out = share.wait(entry)
+                finally:
+                    share.release(entry)
+                if out is not None:
+                    # decode skipped: account this exec's output so the
+                    # query profile still shows the rows it consumed
+                    self.metrics.num_output_rows += int(out.num_rows)
+                    self.metrics.add_batches()
+                    return out
+                # the leader failed or abandoned its flight: decode
+                # locally under a FRESH claim, so a later subscriber
+                # can still share this decode
+                marker = _prep(idx, path_rgs)
+
+        def _cleanup(marker) -> None:
+            kind, entry, prepared = marker
+            if prepared is not None:
+                for h in prepared[1].values():
+                    h.close()
+            if kind == "lead":
+                share.fail(entry,
+                           RuntimeError("scan flight abandoned"))
+                share.release(entry)
+            elif kind == "join":
+                share.release(entry)
+
         prefetcher = None
         if depth > 0 and len(groups) > 1:
             # bounded look-ahead: host prep + upload of batch k+1
             # overlaps the dispatch-only decode of batch k
             prefetcher = ScanPrefetcher(
-                [(lambda prgs=srcs: prepare(prgs))
-                 for srcs, _pv in groups],
+                [(lambda i=i, prgs=srcs: _prep(i, prgs))
+                 for i, (srcs, _pv) in enumerate(groups)],
                 depth=depth, metrics=self.metrics,
-                cleanup=lambda prepared: [
-                    h.close() for h in prepared[1].values()],
+                cleanup=_cleanup,
                 labels=[_group_label(srcs) for srcs, _pv in groups])
 
         def group_part(idx, path_rgs, pv) -> Iterator[DeviceBatch]:
             from spark_rapids_tpu.exec.context import set_input_file
             try:
                 if prefetcher is not None:
-                    prepared = prefetcher.get(idx)
-                    with tpu_semaphore(self.metrics):
-                        out = finish(prepared, pv)
+                    marker = prefetcher.get(idx)
+                    out = _resolve(marker, idx, path_rgs, pv)
                 else:
                     # no pipelining: the whole prep+upload+dispatch runs
                     # under the semaphore, preserving the pre-prefetch
-                    # concurrent-device-work bound
+                    # concurrent-device-work bound (a joined claim waits
+                    # OUTSIDE the semaphore instead)
+                    out = None
                     with tpu_semaphore(self.metrics):
-                        prepared = prepare(path_rgs)
-                        out = finish(prepared, pv)
+                        marker = _prep(idx, path_rgs)
+                        if marker[0] != "join":
+                            out = _finish_marker(marker, pv)
+                    if out is None:
+                        out = _resolve(marker, idx, path_rgs, pv)
                 paths = {p for p, _ in path_rgs}
                 # set right before the yield so the consumer evaluates
                 # input_file_name() against THIS batch's file

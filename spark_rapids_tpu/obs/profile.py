@@ -30,7 +30,14 @@ from spark_rapids_tpu.obs import trace as obstrace
 # acceptance contract is "includes scan, shuffle, semaphore, and spill
 # sections" whether or not the query touched them
 SECTIONS = ("scan", "shuffle", "semaphore", "spill", "pyworker",
-            "fusion", "sched", "kernel", "compile", "incremental")
+            "fusion", "sched", "kernel", "compile", "incremental",
+            "sharing")
+
+# work-sharing metrics routed into one "sharing" section even though
+# their names span three prefixes (sched.dedup.*, scan.shared.*,
+# serve.batch.*): the per-query work-sharing story — flights joined,
+# scan batches multicast, statements coalesced — reads as one section
+_SHARING_PREFIXES = ("sched.dedup.", "scan.shared.", "serve.batch.")
 
 # compile-observatory metrics routed into the "compile" section even
 # though their names carry the kernel. prefix: the per-query compile
@@ -43,6 +50,8 @@ _COMPILE_SECTION = ("kernel.cache.compiles", "kernel.cache.memHits",
 def _section_of(name: str) -> str:
     if name.startswith("kernel.compile.") or name in _COMPILE_SECTION:
         return "compile"
+    if name.startswith(_SHARING_PREFIXES):
+        return "sharing"
     return name.split(".", 1)[0]
 
 

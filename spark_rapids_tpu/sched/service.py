@@ -75,6 +75,13 @@ class QueryFuture:
         self._error: Optional[BaseException] = None
         self.profile = None
         self.queue_wait_ns = 0
+        # single-flight wiring (sched.dedup.*): followers carry the
+        # leader's query id; both leader and followers hold their
+        # _Flight so cancel() can route through promotion/detachment
+        self.dedup_of: Optional[int] = None
+        self._flight = None
+        self._timer = None
+        self._submitted_ns = time.monotonic_ns()
 
     # -- inspection ----------------------------------------------------------
     @property
@@ -96,9 +103,18 @@ class QueryFuture:
     def cancel(self, reason: str = "cancelled by user") -> bool:
         """Fire the query's CancelToken.  True when the query had not
         completed yet (cancellation will take effect at its next
-        checkpoint); False when it already finished."""
+        checkpoint); False when it already finished.
+
+        Deduped queries route through the flight instead: cancelling a
+        follower detaches it and leaves the flight running; cancelling
+        a leader that has followers detaches the leader and promotes a
+        follower (the execution itself is never killed while anyone
+        still wants the result)."""
         if self.done():
             return False
+        fl = self._flight
+        if fl is not None:
+            return fl.service._cancel_via_flight(self, reason)
         self.token.cancel(reason)
         return True
 
@@ -131,12 +147,41 @@ class QueryFuture:
                 error: Optional[BaseException] = None,
                 profile=None) -> None:
         with self._cond:
+            if self._state not in (QueryState.QUEUED,
+                                   QueryState.RUNNING):
+                # first terminal state wins: a leader detached by
+                # cancel() keeps CANCELLED even though its execution
+                # thread later lands SUCCESS for the flight's followers
+                return
             self._state = state
             self._result = result
             self._error = error
             if profile is not None:
                 self.profile = profile
             self._cond.notify_all()
+
+
+class _Flight:
+    """One in-flight execution of a (digest, output-names) key: the
+    leader future whose thread actually runs the plan, plus follower
+    futures that resolve from the leader's execution outcome."""
+
+    __slots__ = ("key", "leader", "exec_qid", "followers", "done",
+                 "promoted_to", "service", "settled_state",
+                 "settled_result", "settled_error")
+
+    def __init__(self, key, leader: QueryFuture, exec_qid: int,
+                 service: "QueryService"):
+        self.key = key
+        self.leader = leader
+        self.exec_qid = exec_qid
+        self.followers: list = []
+        self.done = False
+        self.promoted_to: Optional[int] = None
+        self.service = service
+        self.settled_state: Optional[QueryState] = None
+        self.settled_result = None
+        self.settled_error: Optional[BaseException] = None
 
 
 class QueryService:
@@ -168,6 +213,10 @@ class QueryService:
         self._track_lock = threading.Lock()
         self._active: Dict[int, Dict[str, Any]] = {}
         self._recent: "deque" = deque(maxlen=64)
+        # single-flight registry: (digest, output names) -> _Flight
+        self.dedup_enabled = bool(conf.get(cfg.SCHED_DEDUP_ENABLED))
+        self._flights: Dict[Any, _Flight] = {}
+        self._flights_lock = threading.Lock()
 
     @staticmethod
     def _derived_budget() -> int:
@@ -249,6 +298,9 @@ class QueryService:
             "client_addr": meta.get("client_addr"),
             "plan_digest": meta.get("plan_digest"),
         }
+        if meta.get("dedup_of") is not None:
+            row["deduped"] = True
+            row["leader_query_id"] = meta["dedup_of"]
         # compile attribution (obs/compile.py): null when zero, so
         # compile-bound outliers stand out in the table; the same
         # shared derivation feeds the slow-query JSONL
@@ -288,9 +340,16 @@ class QueryService:
         if "plan_digest" not in meta:
             # the serving tier already digested the plan for its
             # result-cache key and passes it in meta — don't walk the
-            # plan a second time on its behalf
-            from spark_rapids_tpu.plan.digest import safe_plan_digest
-            meta["plan_digest"] = safe_plan_digest(plan)
+            # plan a second time on its behalf; one fingerprint walk
+            # yields both the digest and the dedup admissibility
+            from spark_rapids_tpu.plan.digest import plan_fingerprint
+            try:
+                fp = plan_fingerprint(plan)
+                meta["plan_digest"] = fp.digest
+                meta.setdefault("plan_cacheable", fp.cacheable)
+            except Exception:
+                meta["plan_digest"] = None
+                meta["plan_cacheable"] = False
         digest = meta["plan_digest"]
         # compile observatory: bind qid -> digest so CompileEvents
         # fired on any thread carrying this query's token are stamped
@@ -326,6 +385,21 @@ class QueryService:
         reg.inc("sched.submitted")
         token = _cancel.CancelToken(qid)
         fut = QueryFuture(qid, token)
+        ms = self.default_timeout_ms if timeout_ms is None \
+            else int(timeout_ms)
+        # single-flight: identical deterministic plans already in
+        # flight are joined, not re-executed.  The key must include the
+        # output names — the digest is alias-insensitive (two queries
+        # differing only in output labels share kernels but not result
+        # schemas), exactly the result cache's (digest, names) rule.
+        if (self.dedup_enabled and digest is not None
+                and meta.get("plan_cacheable")
+                and not meta.pop("no_dedup", False)):
+            key = self._flight_key(plan, digest)
+            if key is not None:
+                if self._join_or_lead(fut, key, priority, ms, meta):
+                    return fut
+                reg.inc("sched.dedup.flights")
         req = AdmissionRequest(
             qid, self._estimate(plan, estimate_bytes),
             priority=priority, token=token)
@@ -333,8 +407,6 @@ class QueryService:
         obsrec.record_event("sched.submitted", query=qid,
                             priority=req.priority,
                             estimate_bytes=req.estimate)
-        ms = self.default_timeout_ms if timeout_ms is None \
-            else int(timeout_ms)
         timer = None
         if ms and ms > 0:
             timer = threading.Timer(
@@ -348,6 +420,149 @@ class QueryService:
                              name=f"sched-q{qid}", daemon=True)
         t.start()
         return fut
+
+    @staticmethod
+    def _flight_key(plan, digest: str):
+        try:
+            return (digest, tuple(plan.schema.names))
+        except Exception:
+            return None
+
+    def _join_or_lead(self, fut: QueryFuture, key, priority: int,
+                      ms: int, meta: Dict[str, Any]) -> bool:
+        """Atomically join an existing live flight as a follower (True)
+        or install ``fut`` as the leader of a new flight (False).
+        Follower registration — tracking included — happens under the
+        flights lock so a settling flight can never miss it."""
+        with self._flights_lock:
+            fl = self._flights.get(key)
+            if (fl is None or fl.done
+                    or fl.leader.token.is_cancelled):
+                nfl = _Flight(key, fut, fut.query_id, self)
+                fut._flight = nfl
+                self._flights[key] = nfl
+                return False
+            fut.dedup_of = fl.exec_qid
+            fut._flight = fl
+            fmeta = dict(meta)
+            fmeta["dedup_of"] = fl.exec_qid
+            # zero-estimate: a follower consumes no admission budget
+            self._track(fut, AdmissionRequest(fut.query_id, 0,
+                                              priority=priority,
+                                              token=fut.token), fmeta)
+            fl.followers.append(fut)
+        obsreg.get_registry().inc("sched.dedup.hits")
+        obsrec.record_event("sched.dedup.joined", query=fut.query_id,
+                            leader=fut.dedup_of)
+        if ms and ms > 0:
+            fut._timer = threading.Timer(
+                ms / 1e3, self._timeout_follower, args=(fut, ms))
+            fut._timer.daemon = True
+            fut._timer.start()
+        return True
+
+    def _timeout_follower(self, fut: QueryFuture, ms: int) -> None:
+        fl = fut._flight
+        with self._flights_lock:
+            if fl.done or fut not in fl.followers:
+                return
+            fl.followers.remove(fut)
+        obsreg.get_registry().inc("sched.timedOut")
+        self._finish_follower(
+            fut, QueryState.TIMED_OUT, None,
+            _cancel.QueryTimeoutError(
+                f"query {fut.query_id}: deadline {ms}ms exceeded "
+                f"waiting on deduped flight {fl.exec_qid}"))
+
+    def _cancel_via_flight(self, fut: QueryFuture, reason: str) -> bool:
+        """Flight-aware cancel (see QueryFuture.cancel)."""
+        fl = fut._flight
+        reg = obsreg.get_registry()
+        promoted = None
+        with self._flights_lock:
+            if fl.done:
+                return False
+            if fut.dedup_of is not None:
+                # follower: detach; the flight keeps running
+                if fut not in fl.followers:
+                    return False
+                fl.followers.remove(fut)
+                mode = "follower"
+            elif fl.followers:
+                # leader with followers: detach the leader, promote the
+                # first follower as the flight's nominal owner — the
+                # execution itself continues untouched
+                promoted = fl.followers[0]
+                fl.promoted_to = promoted.query_id
+                mode = "leader"
+            else:
+                mode = "kill"
+        if mode == "kill":
+            fut.token.cancel(reason)
+            return True
+        err = _cancel.QueryCancelledError(
+            f"query {fut.query_id}: {reason}")
+        if mode == "follower":
+            reg.inc("sched.cancelled")
+            self._finish_follower(fut, QueryState.CANCELLED, None, err)
+            return True
+        reg.inc("sched.cancelled")
+        reg.inc("sched.dedup.promotions")
+        obsrec.record_event("sched.dedup.promoted", query=fl.exec_qid,
+                            cancelled_leader=fut.query_id,
+                            promoted_follower=promoted.query_id)
+        # the leader future detaches (first terminal state wins); its
+        # _run thread later settles the flight with the execution's
+        # real outcome for the followers
+        fut._finish(QueryState.CANCELLED, error=err)
+        return True
+
+    def _finish_exec(self, fut: QueryFuture, state: QueryState,
+                     result=None,
+                     error: Optional[BaseException] = None,
+                     profile=None) -> None:
+        """Terminal finish on the execution (leader) path: resolve the
+        leader future (unless it detached first) and fan the execution
+        outcome to every follower of its flight."""
+        fut._finish(state, result=result, error=error, profile=profile)
+        fl = fut._flight
+        if fl is None:
+            return
+        with self._flights_lock:
+            fl.done = True
+            fl.settled_state = state
+            fl.settled_result = result
+            fl.settled_error = error
+            if self._flights.get(fl.key) is fl:
+                del self._flights[fl.key]
+            followers = list(fl.followers)
+            fl.followers = []
+        for f in followers:
+            self._finish_follower(f, state, result, error)
+
+    def _finish_follower(self, fut: QueryFuture, state: QueryState,
+                         result, error) -> None:
+        if fut._timer is not None:
+            fut._timer.cancel()
+        prof = None
+        try:
+            prof = self._session._record_dedup_follower(
+                fut.query_id, fut.dedup_of, state, error,
+                self._meta_of(fut),
+                max(0, time.monotonic_ns() - fut._submitted_ns),
+                result)
+        except Exception:
+            prof = None
+        fut._finish(state, result=result, error=error, profile=prof)
+        obscompile.finish_query(fut.query_id)
+        self._untrack(fut)
+        obsrec.record_event("sched.finished", query=fut.query_id,
+                            state=fut.state.value)
+
+    def _meta_of(self, fut: QueryFuture) -> Dict[str, Any]:
+        with self._track_lock:
+            info = self._active.get(fut.query_id)
+            return dict(info.get("meta") or {}) if info else {}
 
     @staticmethod
     def _sched_extra_base(meta: Dict[str, Any],
@@ -371,12 +586,13 @@ class QueryService:
             try:
                 slot = self.controller.acquire(req)
             except _cancel.QueryCancelledError as e:
-                fut._finish(QueryState.TIMED_OUT
-                            if isinstance(e, _cancel.QueryTimeoutError)
-                            else QueryState.CANCELLED, error=e)
+                self._finish_exec(
+                    fut, QueryState.TIMED_OUT
+                    if isinstance(e, _cancel.QueryTimeoutError)
+                    else QueryState.CANCELLED, error=e)
                 return
             except BaseException as e:   # rejected / internal
-                fut._finish(QueryState.FAILED, error=e)
+                self._finish_exec(fut, QueryState.FAILED, error=e)
                 from spark_rapids_tpu.sched.admission import \
                     QueryRejectedError
                 if isinstance(e, QueryRejectedError):
@@ -407,16 +623,16 @@ class QueryService:
                 timed = isinstance(e, _cancel.QueryTimeoutError) or \
                     fut.token.timed_out
                 reg.inc("sched.timedOut" if timed else "sched.cancelled")
-                fut._finish(QueryState.TIMED_OUT if timed
-                            else QueryState.CANCELLED, error=e,
-                            profile=self._session.query_profile(
-                                fut.query_id))
+                self._finish_exec(
+                    fut, QueryState.TIMED_OUT if timed
+                    else QueryState.CANCELLED, error=e,
+                    profile=self._session.query_profile(fut.query_id))
                 return
             except BaseException as e:
                 reg.inc("sched.failed")
-                fut._finish(QueryState.FAILED, error=e,
-                            profile=self._session.query_profile(
-                                fut.query_id))
+                self._finish_exec(
+                    fut, QueryState.FAILED, error=e,
+                    profile=self._session.query_profile(fut.query_id))
                 return
             reg.inc("sched.completed")
             if tracker is not None:
@@ -425,7 +641,8 @@ class QueryService:
             # observes result() may immediately read the corpus file,
             # and this thread's finally block runs after the wake-up
             obscompile.finish_query(fut.query_id)
-            fut._finish(QueryState.SUCCESS, result=table, profile=prof)
+            self._finish_exec(fut, QueryState.SUCCESS, result=table,
+                              profile=prof)
         finally:
             if tracker is not None:
                 tracker.close()

@@ -1,0 +1,320 @@
+"""Micro-batched prepared-statement dispatch (serve.batch.*).
+
+When several clients execute the SAME prepared-statement template with
+different bindings inside one short window, the statements coalesce
+into ONE vectorized execution: the filter becomes the OR of every
+binding's predicate, each binding rides along as a BOOL marker column
+(``statements.coalesce_bound_plans``), and the single result splits
+per client host-side.  PR 12's erased kernel ABI makes this
+compile-free across binding values — the coalesced plan compiles once
+per batch WIDTH, never per binding.
+
+Eligibility is a static property of the template
+(``statements.batch_eligible``): a projection directly over one
+parameterized filter, row-wise nodes only.  Aggregates, limits, sorts
+and joins always execute singly — an OR'd filter would mix rows
+across bindings there.
+
+Lifecycle of one execute request through the batcher::
+
+    offer (fair-share slot taken, inflight tracked)
+      -> window timer (serve.batch.windowMs) or a full batch
+        -> flush: bind each item; result-cache hits stream cached;
+           one leftover runs the normal single path; >= 2 coalesce
+             -> one scheduler.submit, split per marker, stream each
+                under its own credit window; per-item results enter
+                the result cache under the pre/post stamp pin
+
+Every path releases the item's fair-share slot through the server's
+once-only ``_releaser``.  One-knob revert: ``serve.batch.enabled``
+off bypasses the batcher entirely (the server never constructs it).
+
+Counters: ``serve.batch.coalesced`` (statements that joined a
+vectorized run), ``serve.batch.vectorizedExecutions`` (runs).
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Any, Dict, List, Optional
+
+from spark_rapids_tpu.obs import recorder as obsrec
+from spark_rapids_tpu.obs import registry as obsreg
+from spark_rapids_tpu.serve import result_cache
+from spark_rapids_tpu.serve import statements as stmts
+
+
+class _Item:
+    """One client's execute request parked in the window."""
+
+    __slots__ = ("conn", "tag", "sess", "stmt", "params", "credit",
+                 "stream_id", "infl")
+
+    def __init__(self, conn, tag, sess, stmt, params, credit,
+                 stream_id, infl):
+        self.conn = conn
+        self.tag = tag
+        self.sess = sess
+        self.stmt = stmt
+        self.params = params
+        self.credit = credit
+        self.stream_id = stream_id
+        self.infl = infl
+
+
+class _Bound:
+    """An item bound to an executable plan + its cache identity."""
+
+    __slots__ = ("item", "plan", "digest", "cacheable", "cache_key",
+                 "names", "stamps")
+
+    def __init__(self, item, plan, digest, cacheable, cache_key,
+                 names, stamps):
+        self.item = item
+        self.plan = plan
+        self.digest = digest
+        self.cacheable = cacheable
+        self.cache_key = cache_key
+        self.names = names
+        self.stamps = stamps
+
+
+class _Batch:
+    __slots__ = ("key", "items", "timer")
+
+    def __init__(self, key):
+        self.key = key
+        self.items: List[_Item] = []
+        self.timer: Optional[threading.Timer] = None
+
+
+class StatementBatcher:
+    """One per ServeServer (constructed only when serve.batch.enabled)."""
+
+    def __init__(self, server, window_ms: int, max_statements: int):
+        self._server = server
+        self._window_s = max(0.0, int(window_ms) / 1e3)
+        self._max = max(1, int(max_statements))
+        self._lock = threading.Lock()
+        self._pending: Dict[Any, _Batch] = {}
+
+    # -- intake --------------------------------------------------------------
+    def offer(self, conn, tag, sess, stmt, msg: Dict[str, Any]) -> bool:
+        """Park one execute request in the batching window.  False when
+        the template is not batch-eligible — the caller runs the normal
+        single-execution path.  On True the request is owned by the
+        batcher: fair-share slot held, inflight tracked, a response
+        (chunks or a typed ERR) guaranteed by flush."""
+        if not stmts.batch_eligible(stmt):
+            return False
+        from spark_rapids_tpu.serve.server import _Inflight
+        self._server._begin_or_raise(sess)
+        infl = _Inflight(tag, None, int(msg.get("credit", 8)))
+        conn.track(infl)
+        item = _Item(conn, tag, sess, stmt, dict(msg.get("params") or {}),
+                     int(msg.get("credit", 8)), msg.get("stream_id"),
+                     infl)
+        key = (stmt.sql, tuple(sorted(stmt.declared_types.items())))
+        flush_now = None
+        with self._lock:
+            b = self._pending.get(key)
+            if b is None:
+                b = _Batch(key)
+                self._pending[key] = b
+                b.timer = threading.Timer(self._window_s,
+                                          self._flush, args=(key, b))
+                b.timer.daemon = True
+                b.timer.start()
+            b.items.append(item)
+            if len(b.items) >= self._max:
+                self._pending.pop(key, None)
+                flush_now = b
+        if flush_now is not None:
+            if flush_now.timer is not None:
+                flush_now.timer.cancel()
+            self._spawn(flush_now.items)
+        return True
+
+    def flush_all(self) -> None:
+        """Drain/shutdown hook: flush every parked batch immediately."""
+        with self._lock:
+            batches = list(self._pending.values())
+            self._pending.clear()
+        for b in batches:
+            if b.timer is not None:
+                b.timer.cancel()
+            self._spawn(b.items)
+
+    def _flush(self, key, b: _Batch) -> None:
+        with self._lock:
+            if self._pending.get(key) is b:
+                del self._pending[key]
+            items = list(b.items)
+        if items:
+            self._run_batch(items)
+
+    def _spawn(self, items: List[_Item]) -> None:
+        if not items:
+            return
+        t = threading.Thread(target=self._run_batch, args=(items,),
+                             name="serve-batch-flush", daemon=True)
+        t.start()
+
+    # -- execution -----------------------------------------------------------
+    def _run_batch(self, items: List[_Item]) -> None:
+        srv = self._server
+        pending: List[_Bound] = []
+        for it in items:
+            try:
+                plan = it.stmt.bind(it.params)
+            except Exception as e:
+                self._fail_item(it, type(e).__name__, str(e))
+                continue
+            digest = cache_key = names = stamps = None
+            cacheable = False
+            served = False
+            try:
+                from spark_rapids_tpu.exec import incremental
+                from spark_rapids_tpu.plan.digest import plan_fingerprint
+                fp = plan_fingerprint(plan)
+                digest = fp.digest
+                cache_key = f"{srv._semantics_stamp}:{fp.digest}"
+                names = tuple(plan.schema.names)
+                if fp.cacheable and result_cache.enabled():
+                    stamps = incremental.current_stamps(plan)
+                cacheable = stamps is not None
+                if cacheable:
+                    hit = result_cache.lookup(cache_key, names, stamps,
+                                              count_miss=False)
+                    if hit is not None:
+                        srv._spawn_streamer(
+                            it.conn, it.tag, srv._stream_cached,
+                            (it.conn, it.sess, it.infl, hit,
+                             it.stream_id, (cache_key, names, stamps)))
+                        served = True
+            except Exception:
+                cacheable = False
+            if not served:
+                pending.append(_Bound(it, plan, digest, cacheable,
+                                      cache_key, names, stamps))
+        if not pending:
+            return
+        if len(pending) == 1:
+            self._run_single(pending[0])
+            return
+        try:
+            cplan, markers = stmts.coalesce_bound_plans(
+                [b.plan for b in pending])
+        except Exception:
+            # a template that slipped past the static eligibility gate
+            # (or a shape drift): run everyone singly, never fail them
+            for b in pending:
+                self._run_single(b)
+            return
+        self._run_coalesced(pending, cplan, markers)
+
+    def _run_single(self, b: _Bound) -> None:
+        """The `_start_query` submit tail for one already-bound item
+        whose fair-share slot is already held.  Batch-eligible
+        templates are maintainer-ineligible by construction (no root
+        aggregate), so inc_ctx is always None here."""
+        srv = self._server
+        it = b.item
+        try:
+            eng = srv._engine()
+            meta = {"session_id": it.sess.session_id,
+                    "client_addr": it.sess.client_addr}
+            if b.digest is not None:
+                meta["plan_digest"] = b.digest
+                meta["plan_cacheable"] = b.cacheable
+            fut = eng.scheduler.submit(
+                b.plan, priority=it.sess.priority,
+                timeout_ms=it.sess.timeout_ms,
+                estimate_bytes=it.sess.estimate_bytes, meta=meta)
+        except BaseException as e:
+            self._fail_item(it, type(e).__name__, str(e))
+            return
+        is_follower = getattr(fut, "dedup_of", None) is not None
+        if b.cacheable:
+            obsreg.get_registry().inc(
+                "serve.resultCacheDedupedFollowers"
+                if is_follower else "serve.resultCacheMisses")
+        it.infl.future = fut
+        srv._spawn_streamer(
+            it.conn, it.tag, srv._stream_result,
+            (it.conn, it.sess, it.infl, b.cache_key, b.names,
+             b.stamps, b.cacheable and not is_follower, b.plan, None,
+             it.stream_id))
+
+    def _run_coalesced(self, pending: List[_Bound], cplan,
+                       markers: List[str]) -> None:
+        srv = self._server
+        reg = obsreg.get_registry()
+        first = pending[0].item
+        try:
+            eng = srv._engine()
+            fut = eng.scheduler.submit(
+                cplan, priority=first.sess.priority,
+                timeout_ms=first.sess.timeout_ms,
+                estimate_bytes=first.sess.estimate_bytes,
+                meta={"session_id": first.sess.session_id,
+                      "client_addr": first.sess.client_addr,
+                      "batched_statements": len(pending)})
+        except BaseException as e:
+            for b in pending:
+                self._fail_item(b.item, type(e).__name__, str(e))
+            return
+        reg.inc("serve.batch.coalesced", len(pending))
+        reg.inc("serve.batch.vectorizedExecutions")
+        obsrec.record_event("serve.batchCoalesced", query=fut.query_id,
+                            statements=len(pending))
+        try:
+            table = fut.result()
+        except BaseException as e:
+            for b in pending:
+                self._fail_item(b.item, type(e).__name__, str(e))
+            return
+        marker_set = set(markers)
+        keep = [i for i, n in enumerate(table.column_names)
+                if n not in marker_set]
+        for i, b in enumerate(pending):
+            try:
+                mask = table.column(markers[i])
+                sub = table.filter(mask).select(keep)
+            except Exception as e:
+                self._fail_item(b.item, type(e).__name__, str(e))
+                continue
+            if b.cacheable:
+                reg.inc("serve.resultCacheMisses")
+                # per-item insert under the serve pre/post-stamp pin
+                try:
+                    from spark_rapids_tpu.exec import incremental
+                    post = incremental.current_stamps(b.plan)
+                    if post is not None and post == b.stamps:
+                        result_cache.insert(b.cache_key, b.names,
+                                            b.stamps, sub)
+                except Exception:
+                    pass
+            srv._spawn_streamer(b.item.conn, b.item.tag,
+                                self._stream_split,
+                                (b.item, sub, fut.query_id))
+
+    def _stream_split(self, it: _Item, table, query_id) -> None:
+        srv = self._server
+        from spark_rapids_tpu.serve.server import _retain_stream
+        release = srv._releaser(it.conn, it.sess, it.infl)
+        try:
+            _retain_stream(it.sess.resume_token, it.stream_id,
+                           table=table)
+            srv._stream_table(it.conn, it.infl, table, cache_hit=False,
+                              query_id=query_id, release=release)
+        finally:
+            release()
+
+    def _fail_item(self, it: _Item, code: str, msg: str) -> None:
+        release = self._server._releaser(it.conn, it.sess, it.infl)
+        try:
+            if it.conn.alive:
+                self._server._send_err(it.conn, it.tag, code, msg)
+        finally:
+            release()

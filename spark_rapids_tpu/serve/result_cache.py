@@ -135,13 +135,19 @@ def _evict_locked() -> None:
         reg.inc("serve.resultCacheEvictedBytes", nb)
 
 
-def lookup(digest: str, names, stamps) -> Optional[pa.Table]:
+def lookup(digest: str, names, stamps,
+           count_miss: bool = True) -> Optional[pa.Table]:
     """The cached result for (digest, names, stamps), or None.  Counts
     a hit/miss either way — the zero-dispatch claim in CI is asserted
-    on these counters plus ``kernel.dispatches``."""
+    on these counters plus ``kernel.dispatches``.  ``count_miss=False``
+    defers the miss count to the caller: the serve tier classifies a
+    miss AFTER submission, because a miss that joins an in-flight
+    single-flight execution is a dedup, not a second miss (counting it
+    twice is exactly the racing-insert double-count this fixes)."""
     reg = _obsreg.get_registry()
     if not _ENABLED or stamps is None:
-        reg.inc("serve.resultCacheMisses")
+        if count_miss:
+            reg.inc("serve.resultCacheMisses")
         return None
     key = entry_key(digest, names, stamps)
     with _LOCK:
@@ -149,7 +155,8 @@ def lookup(digest: str, names, stamps) -> Optional[pa.Table]:
         if hit is not None:
             _ENTRIES.move_to_end(key)
     if hit is None:
-        reg.inc("serve.resultCacheMisses")
+        if count_miss:
+            reg.inc("serve.resultCacheMisses")
         return None
     reg.inc("serve.resultCacheHits")
     return hit[0]

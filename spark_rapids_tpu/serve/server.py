@@ -428,6 +428,14 @@ class ServeServer:
         from spark_rapids_tpu.exec.incremental import \
             IncrementalMaintainer
         self.maintainer = IncrementalMaintainer(session)
+        # micro-batched prepared-statement dispatch (serve/batching.py);
+        # None when serve.batch.enabled is off — the one-knob revert
+        self._batcher = None
+        if bool(conf.get(cfg.SERVE_BATCH_ENABLED)):
+            from spark_rapids_tpu.serve.batching import StatementBatcher
+            self._batcher = StatementBatcher(
+                self, int(conf.get(cfg.SERVE_BATCH_WINDOW_MS)),
+                int(conf.get(cfg.SERVE_BATCH_MAX_STATEMENTS)))
         self._sessions: Dict[str, ServeSession] = {}
         self._lock = threading.Lock()
         self._session_seq = itertools.count(1)
@@ -483,6 +491,8 @@ class ServeServer:
 
     def shutdown(self) -> None:
         self._draining = True
+        if self._batcher is not None:
+            self._batcher.flush_all()
         self._static_shutdown(self._lsock, self._stop)
         self.maintainer.shutdown()
         with self._lock:
@@ -524,6 +534,10 @@ class ServeServer:
         reg.inc("serve.drains")
         obsrec.record_event("serve.drainStarted", port=self.port,
                             deadline_ms=deadline_ms)
+        # parked batch windows flush NOW: their items hold fair-share
+        # slots the phase-1 wait below watches
+        if self._batcher is not None:
+            self._batcher.flush_all()
         # shutdown() wakes a blocked accept(); without it the accept
         # thread's in-syscall reference keeps the port bound and the
         # successor server's bind fails with EADDRINUSE
@@ -865,10 +879,14 @@ class ServeServer:
                 self._send_resp(conn, tag, stmt.describe())
             elif op == "execute":
                 stmt = self._statement_of(sess, msg)
-                plan = stmt.bind(msg.get("params") or {})
-                self._start_query(conn, tag, sess, plan,
-                                  int(msg.get("credit", 8)),
-                                  stream_id=msg.get("stream_id"))
+                if self._batcher is not None and \
+                        self._batcher.offer(conn, tag, sess, stmt, msg):
+                    pass   # parked in the batching window; flush answers
+                else:
+                    plan = stmt.bind(msg.get("params") or {})
+                    self._start_query(conn, tag, sess, plan,
+                                      int(msg.get("credit", 8)),
+                                      stream_id=msg.get("stream_id"))
             elif op == "resume_stream":
                 self._start_resume(conn, tag, sess, msg)
             elif op == "finish_stream":
@@ -1030,12 +1048,14 @@ class ServeServer:
         try:
             digest = cache_key = names = stamps = None
             cacheable = False
+            fp_cacheable = False
             submit_plan, inc_ctx = plan, None
             try:
                 from spark_rapids_tpu.exec import incremental
                 from spark_rapids_tpu.plan.digest import plan_fingerprint
                 fp = plan_fingerprint(plan)
                 digest = fp.digest
+                fp_cacheable = fp.cacheable
                 # cache entries key on (semantics stamp, plan digest):
                 # the profile//queries surface the pure digest, the
                 # cache must also see the session's SQL conf
@@ -1051,7 +1071,11 @@ class ServeServer:
             except Exception:
                 cacheable = False
             if cacheable:
-                hit = result_cache.lookup(cache_key, names, stamps)
+                # miss counting is deferred to after submission: a miss
+                # that joins an in-flight single-flight execution is a
+                # dedup, not a second miss
+                hit = result_cache.lookup(cache_key, names, stamps,
+                                          count_miss=False)
                 if hit is not None:
                     infl = _Inflight(tag, None, credit)
                     conn.track(infl)
@@ -1070,17 +1094,29 @@ class ServeServer:
                     "client_addr": sess.client_addr}
             if digest is not None:
                 meta["plan_digest"] = digest  # already computed here
+                meta["plan_cacheable"] = fp_cacheable
+            if inc_ctx is not None and inc_ctx.mode == "delta":
+                # a delta run merges retained partials in finish();
+                # fanning one execution to two delta contexts would
+                # double-merge — delta runs never join a flight
+                meta["no_dedup"] = True
             fut = eng.scheduler.submit(
                 submit_plan, priority=sess.priority,
                 timeout_ms=sess.timeout_ms,
                 estimate_bytes=sess.estimate_bytes,
                 meta=meta)
+            is_follower = getattr(fut, "dedup_of", None) is not None
+            if cacheable:
+                obsreg.get_registry().inc(
+                    "serve.resultCacheDedupedFollowers"
+                    if is_follower else "serve.resultCacheMisses")
             infl = _Inflight(tag, fut, credit)
             conn.track(infl)
             self._spawn_streamer(
                 conn, tag, self._stream_result,
                 (conn, sess, infl, cache_key, names, stamps,
-                 cacheable, plan, inc_ctx, stream_id))
+                 cacheable and not is_follower, plan,
+                 None if is_follower else inc_ctx, stream_id))
         except BaseException:
             sess.end_query()
             raise

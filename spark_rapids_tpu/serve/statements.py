@@ -26,7 +26,8 @@ from typing import Any, Dict, List, Optional
 from spark_rapids_tpu import dtypes as dt
 from spark_rapids_tpu.expr import ir
 from spark_rapids_tpu.plan import logical as lp
-from spark_rapids_tpu.plan.digest import iter_plan_exprs, walk
+from spark_rapids_tpu.plan.digest import (iter_node_exprs,
+                                          iter_plan_exprs, walk)
 from spark_rapids_tpu.sql.parser import SqlParam, parse_prepared
 
 # declared-type names accepted in a prepare request (the CAST name set)
@@ -183,3 +184,90 @@ class PreparedStatement:
         with self._lock:
             self.executions += 1
         return plan
+
+
+# ---------------------------------------------------------------------------
+# Batched dispatch (serve.batch.*): same template, many bindings, one
+# vectorized execution
+# ---------------------------------------------------------------------------
+
+# the only plan nodes a coalesced execution may contain: row-wise
+# shapes where "filter by OR of the per-binding predicates, then split
+# rows per binding host-side" is exactly equivalent to running each
+# binding alone.  An aggregate/limit/sort/join anywhere would mix rows
+# across bindings, so those templates always execute singly.
+_BATCHABLE_NODES = (lp.Project, lp.Filter, lp.FileScan,
+                    lp.InMemoryScan, lp.CachedRelation)
+
+
+def batch_eligible(stmt: PreparedStatement) -> bool:
+    """True when ``stmt``'s template may join a coalesced execution:
+    a projection directly over one parameterized filter, row-wise
+    nodes only, every parameter marker inside that filter's condition,
+    nothing non-deterministic.  Computed once per statement."""
+    cached = getattr(stmt, "_batch_eligible", None)
+    if cached is None:
+        try:
+            cached = _compute_batch_eligible(stmt.plan_template,
+                                             stmt.params_used)
+        except Exception:
+            cached = False
+        stmt._batch_eligible = cached
+    return cached
+
+
+def _has_param(root: ir.Expression) -> bool:
+    return bool(ir.collect(
+        root, lambda n: isinstance(n, ir.Literal)
+        and isinstance(n.value, SqlParam)))
+
+
+def _compute_batch_eligible(template: lp.LogicalPlan,
+                            params_used) -> bool:
+    from spark_rapids_tpu.plan.digest import _NONDETERMINISTIC_EXPRS
+    if not params_used:
+        return False
+    if not isinstance(template, lp.Project):
+        return False
+    filt = template.children[0]
+    if not isinstance(filt, lp.Filter):
+        return False
+    for node in walk(template):
+        if not isinstance(node, _BATCHABLE_NODES):
+            return False
+        for root in iter_node_exprs(node):
+            if ir.collect(root, lambda n: type(n).__name__
+                          in _NONDETERMINISTIC_EXPRS):
+                return False
+            if _has_param(root) and not (
+                    node is filt and root is filt.condition):
+                return False
+    return True
+
+
+def coalesce_bound_plans(bound_plans: List[lp.LogicalPlan]):
+    """One vectorized plan answering every bound copy of one
+    batch-eligible template: the filter becomes the OR of every
+    binding's condition, and each binding contributes one BOOL marker
+    column (``__batch_m<i>``) — a per-row record of WHICH bindings
+    selected it, so the serve tier can split the single result per
+    client host-side (a row matching several bindings appears in each
+    of their splits, exactly as k separate executions would return
+    it).  Returns ``(plan, marker_names)``."""
+    first = bound_plans[0]
+    base = first.children[0].children[0]
+    conds = [p.children[0].condition for p in bound_plans]
+    or_cond = conds[0]
+    for c in conds[1:]:
+        or_cond = ir.Or(or_cond, c)
+    out_names = set(first.schema.names)
+    exprs = list(first.exprs)
+    markers: List[str] = []
+    for i, c in enumerate(conds):
+        name = f"__batch_m{i}"
+        while name in out_names:
+            name = "_" + name
+        out_names.add(name)
+        markers.append(name)
+        exprs.append(ir.Alias(copy.deepcopy(c), name))
+    return lp.Project(lp.Filter(base, or_cond), exprs), markers

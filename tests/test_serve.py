@@ -489,6 +489,9 @@ def test_rejected_queries_hit_recorder_and_slow_log(tmp_path):
         "spark.rapids.tpu.sql.variableFloatAgg.enabled": True,
         "spark.rapids.tpu.sched.maxConcurrent": 1,
         "spark.rapids.tpu.sched.maxQueued": 1,
+        # identical submissions would otherwise join the first one's
+        # single-flight instead of filling the queue
+        "spark.rapids.tpu.sched.dedup.enabled": False,
         "spark.rapids.tpu.obs.recorder.dir": rec_dir,
         "spark.rapids.tpu.obs.slowQueryMs": 60_000,
         "spark.rapids.tpu.obs.slowQueryPath": slow_path})

@@ -1,0 +1,537 @@
+"""Multi-query work sharing: single-flight execution, shared scan
+multicast, batched prepared statements.
+
+Covers the three sharing layers and their one-knob reverts:
+
+  * scheduler single-flight (sched/service.py): N concurrent identical
+    deterministic submissions execute ONCE, bit-identical to serial;
+    leader cancellation promotes a follower; a follower's cancellation
+    leaves the flight running; non-deterministic plans always bypass;
+  * shared scan multicast (io/scan_share.py): two subscribers of the
+    same scan group pay ONE decode — the page-walk probe
+    (io/parquet_meta.walk_count) proves it with the metadata cache off;
+  * batched prepared statements (serve/batching.py): same template,
+    different bindings, one vectorized execution, per-client parity.
+"""
+
+import json
+import threading
+import time
+
+import pyarrow as pa
+import pyarrow.parquet as papq
+import pytest
+
+from spark_rapids_tpu import TpuSparkSession, col, functions as F
+from spark_rapids_tpu.io import parquet_meta as pm
+from spark_rapids_tpu.io import scan_share
+from spark_rapids_tpu.obs import registry as obsreg
+from spark_rapids_tpu.sched import cancel as sched_cancel
+from spark_rapids_tpu.sched.cancel import QueryCancelledError
+from spark_rapids_tpu.sched.service import QueryState
+from spark_rapids_tpu.serve import result_cache
+from spark_rapids_tpu.serve.client import ServeClient
+
+
+@pytest.fixture(autouse=True)
+def _clean_registry():
+    obsreg.reset_registry()
+    result_cache.clear()
+    sh = scan_share.peek_share()
+    if sh is not None:
+        sh.clear()
+    yield
+    obsreg.reset_registry()
+    result_cache.clear()
+    sh = scan_share.peek_share()
+    if sh is not None:
+        sh.clear()
+
+
+def _session(extra=None):
+    conf = {"spark.rapids.tpu.sql.variableFloatAgg.enabled": True}
+    conf.update(extra or {})
+    return TpuSparkSession(conf)
+
+
+def _df(s, n=600):
+    return s.create_dataframe(
+        {"k": [i % 7 for i in range(n)],
+         "x": [float(i % 50) for i in range(n)]},
+        num_partitions=2)
+
+
+def _query(s, n=600):
+    return (_df(s, n).filter(col("x") > 3.0)
+            .group_by("k").agg(F.sum("x").alias("sx"),
+                               F.count("*").alias("c")).sort("k"))
+
+
+class Parker:
+    """Plan listener that parks queries at plan time until released
+    (the test_scheduler idiom); cancellation-aware."""
+
+    def __init__(self):
+        self.release = threading.Event()
+        self.parked = threading.Semaphore(0)
+
+    def __call__(self, result):
+        self.parked.release()
+        tok = sched_cancel.current()
+        deadline = time.time() + 60
+        while not self.release.is_set() and time.time() < deadline:
+            if tok is not None and tok.is_cancelled:
+                return
+            time.sleep(0.005)
+
+
+def _wait_counter(name, value, timeout=20.0):
+    reg = obsreg.get_registry()
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        if reg.counter(name) >= value:
+            return
+        time.sleep(0.01)
+    raise AssertionError(
+        f"{name} never reached {value} (at {reg.counter(name)})")
+
+
+# ---------------------------------------------------------------------------
+# scheduler single-flight
+# ---------------------------------------------------------------------------
+
+def test_concurrent_identical_execute_once_bit_identical():
+    s = _session()
+    serial = _query(s).collect()
+    # a second serial run's dispatch bill is the one-execution baseline
+    # (kernels are warm after the first)
+    view = obsreg.get_registry().view()
+    serial2 = _query(s).collect()
+    one_exec = view.delta()["counters"].get("kernel.dispatches", 0)
+    assert serial2.equals(serial)
+
+    parker = Parker()
+    s.add_plan_listener(parker)
+    view = obsreg.get_registry().view()
+    try:
+        leader = _query(s).collect_async()
+        assert parker.parked.acquire(timeout=30)
+        followers = [_query(s).collect_async() for _ in range(7)]
+        _wait_counter("sched.dedup.hits", 7)
+    finally:
+        parker.release.set()
+    results = [leader.result(timeout=120)] + \
+        [f.result(timeout=120) for f in followers]
+    d = view.delta()["counters"]
+    # exactly ONE execution: the 8-way run pays the serial bill
+    assert d.get("kernel.dispatches", 0) == one_exec, d
+    assert d.get("sched.dedup.flights", 0) == 1
+    assert d.get("sched.dedup.hits", 0) == 7
+    for t in results:
+        assert t.equals(serial)
+    # follower observability: stub profile with the leader's id, a
+    # /queries row flagged deduped, retrievable by query id
+    for f in followers:
+        assert f.dedup_of == leader.query_id
+        prof = f.profile
+        assert prof is not None
+        assert prof.metrics["sharing"][
+            "sched.dedup.leaderQueryId"] == leader.query_id
+        assert s.query_profile(f.query_id) is not None
+    rows = {r["query_id"]: r for r in s.scheduler.query_table()}
+    for f in followers:
+        assert rows[f.query_id].get("deduped") is True
+        assert rows[f.query_id].get(
+            "leader_query_id") == leader.query_id
+    # every profile (leader's too) carries the always-present section
+    assert "sharing" in leader.profile.metrics
+
+
+def test_leader_cancel_promotes_follower():
+    s = _session()
+    serial = _query(s).collect()
+    parker = Parker()
+    s.add_plan_listener(parker)
+    view = obsreg.get_registry().view()
+    try:
+        leader = _query(s).collect_async()
+        assert parker.parked.acquire(timeout=30)
+        followers = [_query(s).collect_async() for _ in range(2)]
+        _wait_counter("sched.dedup.hits", 2)
+        # cancelling the leader must NOT kill the flight: a follower
+        # is promoted and the execution keeps running
+        assert leader.cancel() is True
+        assert leader.state is QueryState.CANCELLED
+    finally:
+        parker.release.set()
+    for f in followers:
+        assert f.result(timeout=120).equals(serial)
+    with pytest.raises(QueryCancelledError):
+        leader.result(timeout=10)
+    d = view.delta()["counters"]
+    assert d.get("sched.dedup.promotions", 0) == 1
+    assert d.get("sched.dedup.flights", 0) == 1
+
+
+def test_follower_cancel_leaves_flight_running():
+    s = _session()
+    serial = _query(s).collect()
+    parker = Parker()
+    s.add_plan_listener(parker)
+    view = obsreg.get_registry().view()
+    try:
+        leader = _query(s).collect_async()
+        assert parker.parked.acquire(timeout=30)
+        f1 = _query(s).collect_async()
+        f2 = _query(s).collect_async()
+        _wait_counter("sched.dedup.hits", 2)
+        assert f1.cancel() is True
+        assert f1.state is QueryState.CANCELLED
+    finally:
+        parker.release.set()
+    assert leader.result(timeout=120).equals(serial)
+    assert f2.result(timeout=120).equals(serial)
+    with pytest.raises(QueryCancelledError):
+        f1.result(timeout=10)
+    d = view.delta()["counters"]
+    assert d.get("sched.dedup.promotions", 0) == 0
+
+
+def test_nondeterministic_plans_bypass_single_flight():
+    # both runs must execute at once (no dedup): a roomy admission
+    # budget keeps the second from queueing behind the parked first
+    s = _session({"spark.rapids.tpu.sched.memoryBudget": 1 << 40})
+    parker = Parker()
+    s.add_plan_listener(parker)
+
+    def q():
+        # the rand column feeds the aggregate so pruning can't drop it
+        return (_df(s).with_column("r", F.rand(7))
+                .group_by("k").agg(F.sum("r").alias("sr")).sort("k"))
+
+    view = obsreg.get_registry().view()
+    try:
+        a = q().collect_async()
+        assert parker.parked.acquire(timeout=30)
+        b = q().collect_async()
+        # the second run executes independently: it parks too
+        assert parker.parked.acquire(timeout=30)
+    finally:
+        parker.release.set()
+    a.result(timeout=120)
+    b.result(timeout=120)
+    d = view.delta()["counters"]
+    assert d.get("sched.dedup.hits", 0) == 0
+    assert d.get("sched.dedup.flights", 0) == 0
+
+
+def test_dedup_knob_off_reverts_to_independent_execution():
+    s = _session({"spark.rapids.tpu.sched.dedup.enabled": False,
+                  "spark.rapids.tpu.sched.memoryBudget": 1 << 40})
+    parker = Parker()
+    s.add_plan_listener(parker)
+    view = obsreg.get_registry().view()
+    try:
+        a = _query(s).collect_async()
+        assert parker.parked.acquire(timeout=30)
+        b = _query(s).collect_async()
+        assert parker.parked.acquire(timeout=30)
+    finally:
+        parker.release.set()
+    assert a.result(timeout=120).equals(b.result(timeout=120))
+    d = view.delta()["counters"]
+    assert d.get("sched.dedup.hits", 0) == 0
+    assert d.get("sched.dedup.flights", 0) == 0
+
+
+def test_slow_query_log_marks_deduped_followers(tmp_path):
+    log = str(tmp_path / "slow.jsonl")
+    s = _session({"spark.rapids.tpu.obs.slowQueryMs": 1,
+                  "spark.rapids.tpu.obs.slowQueryPath": log})
+    parker = Parker()
+    s.add_plan_listener(parker)
+    try:
+        leader = _query(s).collect_async()
+        assert parker.parked.acquire(timeout=30)
+        follower = _query(s).collect_async()
+        _wait_counter("sched.dedup.hits", 1)
+        time.sleep(0.05)   # follower wall must clear the 1 ms bar
+    finally:
+        parker.release.set()
+    leader.result(timeout=120)
+    follower.result(timeout=120)
+    with open(log) as f:
+        records = [json.loads(line) for line in f if line.strip()]
+    dedup_rows = [r for r in records if r.get("deduped") is True]
+    assert len(dedup_rows) == 1
+    assert dedup_rows[0]["query_id"] == follower.query_id
+    assert dedup_rows[0]["leader_query_id"] == leader.query_id
+
+
+# ---------------------------------------------------------------------------
+# shared scan multicast
+# ---------------------------------------------------------------------------
+
+def _scan_session(extra=None):
+    conf = {
+        "spark.rapids.tpu.sql.variableFloatAgg.enabled": True,
+        # isolate the scan layer: no scheduler dedup, no page-walk
+        # memoization, no admission-pressure wipe of the window
+        "spark.rapids.tpu.sched.dedup.enabled": False,
+        "spark.rapids.tpu.sql.scan.metadataCache.enabled": False,
+        "spark.rapids.tpu.memory.spill.enabled": False,
+    }
+    conf.update(extra or {})
+    return TpuSparkSession(conf)
+
+
+def _write_scan_file(tmp_path):
+    p = str(tmp_path / "s.parquet")
+    papq.write_table(pa.table(
+        {"a": list(range(4000)),
+         "b": [float(i % 97) for i in range(4000)]}), p)
+    return p
+
+
+def test_shared_scan_decodes_once_for_two_subscribers(tmp_path):
+    p = _write_scan_file(tmp_path)
+    s = _scan_session()
+    df = s.read.parquet(p)
+
+    def q():
+        return df.filter(col("a") > 10).select("a", "b").collect()
+
+    base = q()                      # warm kernels; publishes + retains
+    sh = scan_share.peek_share()
+    assert sh is not None
+    sh.clear()
+    w0 = pm.walk_count()
+    serial = q()                    # fresh decode: the one-run walk bill
+    one_run_walks = pm.walk_count() - w0
+    assert one_run_walks > 0        # metadata cache is off: real walks
+    assert serial.equals(base)
+
+    sh.clear()
+    view = obsreg.get_registry().view()
+    w1 = pm.walk_count()
+    results = [None, None]
+
+    def run(i):
+        results[i] = q()
+
+    threads = [threading.Thread(target=run, args=(i,)) for i in range(2)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    # two subscribers, ONE decode: page walks match a single run
+    # whether the second query joined the in-flight decode or the
+    # retention window
+    assert pm.walk_count() - w1 == one_run_walks
+    assert results[0].equals(base) and results[1].equals(base)
+    d = view.delta()["counters"]
+    assert d.get("scan.shared.subscribers", 0) >= 1
+    assert d.get("scan.shared.dedupedDecodes", 0) >= 1
+    assert d.get("scan.shared.multicastBatches", 0) >= 1
+
+
+def test_shared_scan_knob_off_decodes_privately(tmp_path):
+    p = _write_scan_file(tmp_path)
+    s = _scan_session({"spark.rapids.tpu.sql.scan.shared.enabled": False})
+    df = s.read.parquet(p)
+
+    def q():
+        return df.filter(col("a") > 10).select("a", "b").collect()
+
+    base = q()
+    w0 = pm.walk_count()
+    serial = q()
+    one_run_walks = pm.walk_count() - w0
+    assert one_run_walks > 0
+    view = obsreg.get_registry().view()
+    w1 = pm.walk_count()
+    q()
+    q()
+    # knob off: every run pays its own walks, no sharing counters
+    assert pm.walk_count() - w1 == 2 * one_run_walks
+    assert serial.equals(base)
+    d = view.delta()["counters"]
+    assert d.get("scan.shared.subscribers", 0) == 0
+    assert d.get("scan.shared.dedupedDecodes", 0) == 0
+
+
+# ---------------------------------------------------------------------------
+# batched prepared statements
+# ---------------------------------------------------------------------------
+
+def _serve_session(extra=None):
+    conf = {
+        "spark.rapids.tpu.sql.variableFloatAgg.enabled": True,
+        "spark.rapids.tpu.serve.enabled": True,
+    }
+    conf.update(extra or {})
+    return TpuSparkSession(conf)
+
+
+def _register_t(s, n=900):
+    df = s.create_dataframe(
+        {"k": [i % 7 for i in range(n)],
+         "x": [float(i % 50) for i in range(n)]},
+        num_partitions=2)
+    s.register_view("t", df)
+
+
+_TEMPLATE = "select k, x from t where x > :lo"
+
+
+def test_batched_prepared_statements_parity():
+    # maxStatements=3 flushes the window the moment the third binding
+    # arrives — the coalesce is deterministic, not timing-dependent
+    s = _serve_session({
+        "spark.rapids.tpu.serve.batch.windowMs": 2000,
+        "spark.rapids.tpu.serve.batch.maxStatements": 3,
+        # the serial reference runs must not satisfy the concurrent
+        # ones from the result cache — they have to reach the batcher
+        "spark.rapids.tpu.serve.resultCache.enabled": False})
+    _register_t(s)
+    try:
+        with ServeClient("127.0.0.1", s.serve_server.port) as c:
+            h = c.prepare(_TEMPLATE, {"lo": "double"})
+            refs = {lo: h.execute({"lo": lo})
+                    for lo in (5.0, 10.0, 20.0)}
+        clients = [ServeClient("127.0.0.1", s.serve_server.port)
+                   for _ in range(3)]
+        handles = [cl.prepare(_TEMPLATE, {"lo": "double"})
+                   for cl in clients]
+        view = obsreg.get_registry().view()
+        los = [5.0, 10.0, 20.0]
+        out = [None] * 3
+
+        def run(i):
+            out[i] = handles[i].execute({"lo": los[i]})
+
+        threads = [threading.Thread(target=run, args=(i,))
+                   for i in range(3)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        for i, lo in enumerate(los):
+            assert out[i].equals(refs[lo]), lo
+        d = view.delta()["counters"]
+        assert d.get("serve.batch.coalesced", 0) == 3
+        assert d.get("serve.batch.vectorizedExecutions", 0) == 1
+        for cl in clients:
+            cl.close()
+    finally:
+        s.serve_server.shutdown()
+
+
+def test_batch_knob_off_runs_statements_singly():
+    s = _serve_session({"spark.rapids.tpu.serve.batch.enabled": False})
+    _register_t(s)
+    try:
+        assert s.serve_server._batcher is None
+        view = obsreg.get_registry().view()
+        with ServeClient("127.0.0.1", s.serve_server.port) as c:
+            h = c.prepare(_TEMPLATE, {"lo": "double"})
+            a = h.execute({"lo": 5.0})
+            b = h.execute({"lo": 20.0})
+        assert a.num_rows > b.num_rows > 0
+        d = view.delta()["counters"]
+        assert d.get("serve.batch.coalesced", 0) == 0
+        assert d.get("serve.batch.vectorizedExecutions", 0) == 0
+    finally:
+        s.serve_server.shutdown()
+
+
+def test_ineligible_template_never_coalesces():
+    # an aggregate template must execute singly even when bindings
+    # arrive together — an OR'd filter would mix rows across bindings
+    s = _serve_session({
+        "spark.rapids.tpu.serve.batch.windowMs": 100,
+        "spark.rapids.tpu.serve.batch.maxStatements": 2})
+    _register_t(s)
+    sql = ("select k, count(*) as c from t where x > :lo "
+           "group by k order by k")
+    try:
+        clients = [ServeClient("127.0.0.1", s.serve_server.port)
+                   for _ in range(2)]
+        handles = [cl.prepare(sql, {"lo": "double"}) for cl in clients]
+        view = obsreg.get_registry().view()
+        out = [None] * 2
+        los = [5.0, 20.0]
+
+        def run(i):
+            out[i] = handles[i].execute({"lo": los[i]})
+
+        threads = [threading.Thread(target=run, args=(i,))
+                   for i in range(2)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        assert out[0].num_rows == out[1].num_rows == 7
+        assert view.delta()["counters"].get(
+            "serve.batch.coalesced", 0) == 0
+        for cl in clients:
+            cl.close()
+    finally:
+        s.serve_server.shutdown()
+
+
+# ---------------------------------------------------------------------------
+# result-cache interaction (the racing-insert fix)
+# ---------------------------------------------------------------------------
+
+def test_deduped_followers_count_once_and_insert_once(tmp_path):
+    p = str(tmp_path / "f.parquet")
+    papq.write_table(pa.table(
+        {"a": list(range(3000)),
+         "b": [float(i % 53) for i in range(3000)]}), p)
+    s = _serve_session()
+    s.register_view("pq", s.read.parquet(p))
+    parker = Parker()
+    s.add_plan_listener(parker)
+    sql = ("select a % 10 as g, sum(b) as sb from pq where b > 10.0 "
+           "group by g order by g")
+    try:
+        clients = [ServeClient("127.0.0.1", s.serve_server.port)
+                   for _ in range(4)]
+        view = obsreg.get_registry().view()
+        out = [None] * 4
+
+        def run(i):
+            out[i] = clients[i].sql(sql)
+
+        threads = [threading.Thread(target=run, args=(i,))
+                   for i in range(4)]
+        threads[0].start()
+        assert parker.parked.acquire(timeout=30)   # leader in flight
+        for t in threads[1:]:
+            t.start()
+        _wait_counter("sched.dedup.hits", 3)
+        parker.release.set()
+        for t in threads:
+            t.join()
+        for t in out[1:]:
+            assert t.equals(out[0])
+        d = view.delta()["counters"]
+        # four concurrent identical queries: ONE miss, ONE insert,
+        # three deduped followers — never four misses
+        assert d.get("serve.resultCacheMisses", 0) == 1, d
+        assert d.get("serve.resultCacheDedupedFollowers", 0) == 3, d
+        # one result entry, plus at most the incremental-maintenance
+        # aggregate-partials entry stored alongside it — never an
+        # entry per follower
+        assert result_cache.stats()["entries"] in (1, 2)
+        # and the cache now serves without touching the engine
+        view2 = obsreg.get_registry().view()
+        assert clients[0].sql(sql).equals(out[0])
+        d2 = view2.delta()["counters"]
+        assert d2.get("serve.resultCacheHits", 0) == 1
+        assert d2.get("sched.submitted", 0) == 0
+        for cl in clients:
+            cl.close()
+    finally:
+        s.serve_server.shutdown()
