@@ -361,10 +361,16 @@ def _concurrent_probe(root: str, n_queries: int) -> dict:
     serial = [q.collect() for q in queries]
     serial_wall = time.perf_counter() - t0
 
+    # window the SLO bucket histograms around the concurrent pass so
+    # the probe's p50/p95/p99 are ITS latencies, not the serial
+    # warm-up's (the RegistryView delta carve)
+    from spark_rapids_tpu.obs import registry as obsreg
+    view = obsreg.get_registry().view()
     t0 = time.perf_counter()
     futs = [q.collect_async() for q in queries]
     tables = [f.result(timeout=900) for f in futs]
     wall = time.perf_counter() - t0
+    lat = _window_quantiles(view.delta(), "slo.latencyMs")
 
     for i, (a, b) in enumerate(zip(serial, tables)):
         assert a.sort_by("ss_item_sk").equals(b.sort_by("ss_item_sk")), \
@@ -383,8 +389,47 @@ def _concurrent_probe(root: str, n_queries: int) -> dict:
         "queries_per_sec": round(n_queries / wall, 3),
         "queue_wait_p50_ms": round(pct(0.50), 2),
         "queue_wait_p95_ms": round(pct(0.95), 2),
+        "latency": lat,
         "rows_match": True,
     }
+
+
+def _window_quantiles(delta: dict, name: str) -> dict:
+    """p50/p95/p99 (+ sample count) of one SLO bucket histogram over a
+    RegistryView window; {} when the window saw no observations."""
+    from spark_rapids_tpu.obs import registry as obsreg
+    h = (delta.get("bucket_histograms") or {}).get(name)
+    if not h:
+        return {}
+    out = {"count": int(h["count"])}
+    for q, key in ((0.50, "p50_ms"), (0.95, "p95_ms"),
+                   (0.99, "p99_ms")):
+        v = obsreg.bucket_quantile(h["bounds"], h["counts"], q)
+        out[key] = round(v, 3) if v is not None else None
+    return out
+
+
+def _slo_quantiles() -> dict:
+    """Whole-run p50/p95/p99 per SLO bucket histogram (latency, queue
+    wait, first chunk) for the trend record — quantiles, not just
+    means."""
+    try:
+        from spark_rapids_tpu.obs import registry as obsreg
+        snap = obsreg.get_registry().snapshot()
+        out = {}
+        for name, h in sorted(
+                snap.get("bucket_histograms", {}).items()):
+            if ".tpl." in name:
+                continue      # per-template series stay on /slo
+            row = {"count": int(h["count"])}
+            for q, key in ((0.50, "p50_ms"), (0.95, "p95_ms"),
+                           (0.99, "p99_ms")):
+                v = obsreg.bucket_quantile(h["bounds"], h["counts"], q)
+                row[key] = round(v, 3) if v is not None else None
+            out[name] = row
+        return out
+    except Exception:
+        return {}
 
 
 def _shuffle_pipeline_probe(n_queries: int = 4) -> dict:
@@ -1100,6 +1145,11 @@ def _write_trend_file(result: dict, n: int, files: int,
             "p50_ms": conc.get("queue_wait_p50_ms"),
             "p95_ms": conc.get("queue_wait_p95_ms"),
         },
+        # per-probe e2e latency quantiles (concurrent window) plus the
+        # run-wide SLO histograms — the trend carries quantiles, not
+        # just means (ISSUE 18)
+        "latency": conc.get("latency") or {},
+        "slo": _slo_quantiles(),
         # per-backend kernel.backend timings (decode / aggregate) +
         # gathers-per-element accounting (the PR-9 headline) and the
         # PR-14 HBM->VMEM streaming-tiler volume (tile counts/bytes +

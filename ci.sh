@@ -243,8 +243,9 @@ timeout 300 python - <<'EOF'
 import json, os, time, urllib.request
 os.environ.setdefault("JAX_PLATFORMS", "cpu")
 from spark_rapids_tpu import TpuSparkSession, col, functions as F
-# the one exposition validator (also exercised by tests/test_obs_live.py)
-from spark_rapids_tpu.obs.server import parse_prometheus
+# the strict exposition linter runs on EVERY scrape: TYPE coverage,
+# cumulative _bucket series ending at le="+Inf", +Inf == _count
+from spark_rapids_tpu.obs.server import lint_exposition
 
 s = TpuSparkSession({
     "spark.rapids.tpu.sql.variableFloatAgg.enabled": True,
@@ -256,7 +257,7 @@ def scrape(path):
     with urllib.request.urlopen(_base_url + path, timeout=10) as r:
         return r.read().decode()
 
-parse_prometheus(scrape("/metrics"))  # serves before any query
+lint_exposition(scrape("/metrics"))  # serves before any query
 
 def base(n):
     return s.create_dataframe(
@@ -296,7 +297,7 @@ futs = [q.collect_async() for q in queries]
 # row-count assert would be flaky.
 seen_running = 0
 while not all(f.done() for f in futs):
-    live = parse_prometheus(scrape("/metrics"))
+    live = lint_exposition(scrape("/metrics"))
     running = live.get("spark_rapids_tpu_sched_running", 0)
     assert running <= 3, f"maxConcurrent=3 violated: {running}"
     seen_running = max(seen_running, int(running))
@@ -322,7 +323,7 @@ assert any(w > 0 for w in waits), (
 # post-run endpoint validation: the exposition's submitted counter and
 # the query table must both account for every submission this session
 # made (8 serial collects + 8 async = 16, no queued/running leftovers)
-metrics = parse_prometheus(scrape("/metrics"))
+metrics = lint_exposition(scrape("/metrics"))
 submitted = metrics.get("spark_rapids_tpu_sched_submitted", 0)
 assert submitted == 16, f"sched_submitted={submitted}, expected 16"
 assert metrics.get("spark_rapids_tpu_sched_running") == 0
@@ -394,7 +395,7 @@ os.environ.setdefault("JAX_PLATFORMS", "cpu")
 import pyarrow as pa, pyarrow.parquet as papq
 from spark_rapids_tpu import TpuSparkSession
 from spark_rapids_tpu.obs import registry as obsreg
-from spark_rapids_tpu.obs.server import parse_prometheus
+from spark_rapids_tpu.obs.server import lint_exposition
 from spark_rapids_tpu.serve.client import ServeClient
 
 root = tempfile.mkdtemp(prefix="serve_smoke_")
@@ -455,11 +456,11 @@ def run(fn):
 
 threads = [run(adhoc_client), run(prepared_client)]
 # live scrape while the first two clients are in flight: the
-# exposition must parse (parse_prometheus raises on a malformed line)
-# and already carry the serving gauges
+# exposition must pass the strict linter (lint_exposition raises on a
+# malformed line or family) and already carry the serving gauges
 with urllib.request.urlopen(
         f"http://127.0.0.1:{s.obs_server.port}/metrics", timeout=10) as r:
-    live = parse_prometheus(r.read().decode())
+    live = lint_exposition(r.read().decode())
 assert "spark_rapids_tpu_serve_activeSessions" in live, sorted(live)[:20]
 for t in threads:
     t.join(timeout=240)
@@ -478,7 +479,7 @@ for got in results["hot"]:
 # post-run exposition: serving counters made it to /metrics
 with urllib.request.urlopen(
         f"http://127.0.0.1:{s.obs_server.port}/metrics", timeout=10) as r:
-    m = parse_prometheus(r.read().decode())
+    m = lint_exposition(r.read().decode())
 assert m.get("spark_rapids_tpu_serve_sessions", 0) >= 3, m
 assert m.get("spark_rapids_tpu_serve_statementsPrepared", 0) >= 1
 assert m.get("spark_rapids_tpu_serve_resultCacheHits", 0) >= 1
@@ -780,6 +781,211 @@ for f in followers:
 print(f"work-sharing gate OK: 8 concurrent identical -> 1 execution "
       f"({got} dispatches == serial bill), 7 dedup hits, "
       f"bit-identical")
+EOF
+
+echo "== tenant ledger exactness gate (single-flight + batched statements -> per-tenant sum == global counter delta) =="
+timeout 300 python - <<'EOF'
+# ISSUE 18 contract: the ResourceLedger's accounting identity.  Over a
+# mixed window — an 8-way single-flight in-process batch (leader + 7
+# followers billed equal shares of ONE execution) plus one 3-way
+# batched prepared-statement execution (members billed by row share) —
+# the sum of per-tenant kernel.dispatches across /tenants rows must
+# equal the global kernel.dispatches counter delta EXACTLY: nothing
+# dropped, nothing double-billed.
+import json, os, threading, time, urllib.request
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+from spark_rapids_tpu import TpuSparkSession, col, functions as F
+from spark_rapids_tpu.obs import registry as obsreg
+from spark_rapids_tpu.sched import cancel as sched_cancel
+from spark_rapids_tpu.serve.client import ServeClient
+
+s = TpuSparkSession({
+    "spark.rapids.tpu.sql.variableFloatAgg.enabled": True,
+    "spark.rapids.tpu.obs.http.enabled": True,
+    "spark.rapids.tpu.serve.enabled": True,
+    # maxStatements=3 flushes deterministically on the third binding;
+    # the cache must not satisfy the bindings before the batcher does
+    "spark.rapids.tpu.serve.batch.windowMs": 2000,
+    "spark.rapids.tpu.serve.batch.maxStatements": 3,
+    "spark.rapids.tpu.serve.resultCache.enabled": False})
+
+def scrape(path):
+    with urllib.request.urlopen(
+            f"http://127.0.0.1:{s.obs_server.port}{path}",
+            timeout=10) as r:
+        return r.read().decode()
+
+def tenant_sum(snap, metric):
+    return sum(r["usage"].get(metric, 0.0) for r in snap["tenants"])
+
+df = s.create_dataframe(
+    {"k": [i % 7 for i in range(2400)],
+     "x": [float(i % 50) for i in range(2400)]},
+    num_partitions=3)
+s.register_view("t", df)
+
+def query():
+    return (df.filter(col("x") > 7.0).group_by("k")
+            .agg(F.sum("x").alias("sx"), F.count("*").alias("c"))
+            .sort("k"))
+
+query().collect()                          # warm compiles
+time.sleep(0.2)                            # let the warm-up bill fold
+
+reg = obsreg.get_registry()
+base_snap = json.loads(scrape("/tenants"))
+base_global = reg.counter("kernel.dispatches")
+
+# leg 1: 8-way single-flight — leader parked at plan time so all 7
+# followers provably join the open flight (the work-sharing idiom)
+class Parker:
+    def __init__(self):
+        self.release = threading.Event()
+        self.parked = threading.Semaphore(0)
+    def __call__(self, result):
+        self.parked.release()
+        tok = sched_cancel.current()
+        deadline = time.time() + 60
+        while not self.release.is_set() and time.time() < deadline:
+            if tok is not None and tok.is_cancelled:
+                return
+            time.sleep(0.005)
+
+parker = Parker()
+s.add_plan_listener(parker)
+try:
+    leader = query().collect_async()
+    assert parker.parked.acquire(timeout=30), "leader never planned"
+    followers = [query().collect_async() for _ in range(7)]
+    deadline = time.time() + 20
+    while reg.counter("sched.dedup.hits") < 7 and \
+            time.time() < deadline:
+        time.sleep(0.01)
+finally:
+    parker.release.set()
+for f in [leader] + followers:
+    assert f.result(timeout=300).num_rows
+s.remove_plan_listener(parker)
+
+# leg 2: one 3-way batched prepared-statement execution
+TEMPLATE = "select k, x from t where x > :lo"
+clients = [ServeClient("127.0.0.1", s.serve_server.port)
+           for _ in range(3)]
+handles = [cl.prepare(TEMPLATE, {"lo": "double"}) for cl in clients]
+los = [5.0, 10.0, 20.0]
+out = [None] * 3
+def run(i):
+    out[i] = handles[i].execute({"lo": los[i]})
+threads = [threading.Thread(target=run, args=(i,)) for i in range(3)]
+for t in threads:
+    t.start()
+for t in threads:
+    t.join()
+assert all(o is not None and o.num_rows for o in out)
+for cl in clients:
+    cl.close()
+time.sleep(0.2)                            # let the last bills fold
+
+snap = json.loads(scrape("/tenants"))
+global_delta = reg.counter("kernel.dispatches") - base_global
+ledger_delta = tenant_sum(snap, "kernel.dispatches") - \
+    tenant_sum(base_snap, "kernel.dispatches")
+assert global_delta > 0
+assert abs(ledger_delta - global_delta) < 1e-6, (
+    f"ledger identity broken: per-tenant sum moved {ledger_delta}, "
+    f"global kernel.dispatches moved {global_delta}")
+# the batched bindings appear as per-session template rows, and the
+# batch paid one vectorized execution between them
+tpl_rows = [r for r in snap["tenants"] if r["workload"] == TEMPLATE]
+assert len(tpl_rows) == 3, [
+    (r["session_id"], r["workload"]) for r in snap["tenants"]]
+assert reg.counter("serve.batch.vectorizedExecutions") == 1
+assert reg.counter("sched.dedup.hits") >= 7
+s.serve_server.shutdown()
+print(f"ledger exactness gate OK: per-tenant sum delta "
+      f"{ledger_delta:.3f} == global delta {global_delta:.3f} "
+      f"(8-way flight + 3-way batch), 3 template rows")
+EOF
+
+echo "== drift sentinel probe (serve-fault slow action -> exactly one slo bundle; control run silent) =="
+timeout 300 python - <<'EOF'
+# ISSUE 18 contract: the drift sentinel fires ONCE per sustained
+# episode, with flight-recorder attribution — and a healthy control
+# run fires never.  Latency degradation is injected with the serving
+# fault plan's SLOW action (a server-side per-chunk sleep), so the
+# regression the watcher sees is real wire latency, deterministic by
+# plan.  Ticks are driven synchronously — the same unit the sentinel
+# thread loops — so the windows are exact, not timing luck.
+import json, os, tempfile
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+from spark_rapids_tpu import TpuSparkSession
+from spark_rapids_tpu.obs import recorder as obsrec
+from spark_rapids_tpu.obs import registry as obsreg
+from spark_rapids_tpu.obs.sentinel import DriftSentinel
+from spark_rapids_tpu.serve.client import ServeClient
+
+bundles = tempfile.mkdtemp(prefix="sentinel_probe_")
+obsrec.configure(bundles)
+reg = obsreg.get_registry()
+SQL = ("select k, sum(x) as sx from t where x > 5.0 "
+       "group by k order by k")
+
+def make_session(fault_plan=""):
+    s = TpuSparkSession({
+        "spark.rapids.tpu.sql.variableFloatAgg.enabled": True,
+        "spark.rapids.tpu.serve.enabled": True,
+        "spark.rapids.tpu.serve.resultCache.enabled": False,
+        "spark.rapids.tpu.serve.test.faultPlan": fault_plan})
+    df = s.create_dataframe(
+        {"k": [i % 7 for i in range(900)],
+         "x": [float(i % 50) for i in range(900)]},
+        num_partitions=2)
+    s.register_view("t", df)
+    return s
+
+def traffic(s, n=4):
+    with ServeClient("127.0.0.1", s.serve_server.port) as c:
+        for _ in range(n):
+            assert c.sql(SQL).num_rows
+
+healthy = make_session()
+traffic(healthy)                           # warm compiles pre-arming
+
+# control: healthy traffic only — the watcher must stay silent
+control = DriftSentinel(rules="latency:factor=3,sustain=2,min=3")
+control.tick()                             # arming tick
+for _ in range(4):
+    traffic(healthy)
+    assert control.tick() == []
+assert reg.counter("obs.sentinel.breaches") == 0
+
+# probe: same config, healthy baseline then SLOW-degraded windows
+probe = DriftSentinel(rules="latency:factor=3,sustain=2,min=3")
+probe.tick()
+for _ in range(3):
+    traffic(healthy)
+    assert probe.tick() == []
+healthy.serve_server.shutdown()
+
+# every streamed chunk now sleeps 250ms server-side
+slow = make_session("seed=7;stream.chunk:slow:d250:x100000")
+opened = []
+for _ in range(3):                         # sustained degradation
+    traffic(slow, n=3)
+    opened += probe.tick()
+assert opened == ["latency"], opened       # exactly ONE episode
+assert reg.counter("obs.sentinel.breaches.latency") == 1
+assert reg.counter("obs.sentinel.breaches") == 1
+slo_bundles = [b for b in os.listdir(bundles) if "-slo-" in b]
+assert len(slo_bundles) == 1, slo_bundles
+with open(os.path.join(bundles, slo_bundles[0],
+                       "sentinel.json")) as f:
+    payload = json.load(f)
+assert payload["rules"] == ["latency"]
+assert payload["top_talkers"], "breach bundle lost its attribution"
+slow.serve_server.shutdown()
+print("sentinel probe OK: 1 slo bundle, breaches.latency=1, "
+      "control run silent")
 EOF
 
 echo "== shape-erased ABI collapse gate (>=4x fewer programs, bit-identical) =="

@@ -74,6 +74,9 @@ class TpuSparkSession:
                 cfg.OBS_COMPILE_CORPUS_PATH) or ""),
             corpus_replay=bool(self.conf.get(
                 cfg.OBS_COMPILE_CORPUS_REPLAY)))
+        from spark_rapids_tpu.obs import accounting as obs_accounting
+        obs_accounting.configure(
+            bool(self.conf.get(cfg.OBS_ACCOUNTING_ENABLED)))
         with TpuSparkSession._lock:
             TpuSparkSession._active = self
         self._plan_listeners: List = []
@@ -141,6 +144,21 @@ class TpuSparkSession:
         # default — replays a previous process's compile corpus through
         # lower+compile at low priority so a replica restart warms the
         # persistent XLA cache off the serving path
+        # -- drift sentinel (obs/sentinel.py): off by default — no
+        # thread runs; on, it samples the registry on an interval and
+        # emits one "slo" bundle per sustained-breach episode
+        self._sentinel = None
+        if self.conf.get(cfg.OBS_SENTINEL_ENABLED):
+            from spark_rapids_tpu.obs.sentinel import DriftSentinel
+            self._sentinel = DriftSentinel(
+                interval_ms=int(self.conf.get(
+                    cfg.OBS_SENTINEL_INTERVAL_MS)),
+                rules=str(self.conf.get(cfg.OBS_SENTINEL_RULES) or ""),
+                jsonl_path=str(self.conf.get(
+                    cfg.OBS_SENTINEL_PATH) or ""),
+                jsonl_max_bytes=int(self.conf.get(
+                    cfg.OBS_SLOW_QUERY_MAX_BYTES)))
+            self._sentinel.start()
         self._precompile_service = None
         if self.conf.get(cfg.SCHED_PRECOMPILE_ENABLED):
             from spark_rapids_tpu.sched.precompile import \
@@ -513,8 +531,10 @@ class TpuSparkSession:
                                       wall_s=record["wall_s"])
             path = str(self.conf.get(cfg.OBS_SLOW_QUERY_PATH) or "")
             if path:
-                with open(path, "a") as f:
-                    f.write(line + "\n")
+                from spark_rapids_tpu.obs import jsonl as obs_jsonl
+                obs_jsonl.rotating_append(
+                    path, line,
+                    int(self.conf.get(cfg.OBS_SLOW_QUERY_MAX_BYTES)))
             else:
                 import logging
                 logging.getLogger(
@@ -620,6 +640,15 @@ class TpuSparkSession:
             old.drain(drain_deadline_ms)
         self._serve_server = ServeServer(self, port=port)
         return self._serve_server
+
+    @property
+    def sentinel(self):
+        """The drift sentinel (obs/sentinel.DriftSentinel) when this
+        session was created with ``obs.sentinel.enabled=true``; None
+        otherwise.  ``sentinel.stats()`` reports
+        ticks/breaches/episodes; ``sentinel.stop()`` halts the
+        watcher thread."""
+        return self._sentinel
 
     @property
     def precompile_service(self):

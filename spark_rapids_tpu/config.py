@@ -774,6 +774,61 @@ OBS_SLOW_QUERY_PATH = conf(
     "Append-mode file for slow-query JSONL records (one JSON object "
     "per line). Empty routes records to the python logger instead.")
 
+OBS_SLOW_QUERY_MAX_BYTES = conf(
+    "spark.rapids.tpu.obs.slowQueryMaxBytes", 16 * 1024 * 1024,
+    "Size-based rotation for the slow-query JSONL file (and the drift "
+    "sentinel's breach log): when an append would push the file past "
+    "this many bytes, it is atomically renamed to <path>.1 (replacing "
+    "the previous .1) and a fresh file starts — the keep-1 logrotate "
+    "shape, at most 2x this size on disk per log. 0 disables rotation "
+    "(unbounded append, the pre-rotation behaviour).", int)
+
+OBS_ACCOUNTING_ENABLED = conf(
+    "spark.rapids.tpu.obs.accounting.enabled", True,
+    "Per-tenant resource metering (obs/accounting.py): attributes "
+    "kernel dispatches, compile wall, scan bytes walked/uploaded, "
+    "shuffle wire bytes, result-cache hits/misses, HBM byte-seconds "
+    "and queue wait to the owning (session, statement template | plan "
+    "digest) tenant, served on the obs endpoint's /tenants route. "
+    "Single-flight followers and batched-statement members are billed "
+    "their fair share of the execution they joined. Off: every "
+    "charging hook is one bool check (the obs.compile pattern).", bool)
+
+OBS_SENTINEL_ENABLED = conf(
+    "spark.rapids.tpu.obs.sentinel.enabled", False,
+    "Drift sentinel (obs/sentinel.py): a background watcher sampling "
+    "the metrics registry every obs.sentinel.intervalMs, comparing "
+    "windowed rates against a trailing EWMA baseline, and on a "
+    "sustained breach (p95 latency regression, slow-query spike, "
+    "result-cache hit-rate collapse, compile storm, spill surge) "
+    "emitting ONE flight-recorder bundle per episode (reason 'slo') "
+    "with per-tenant top-talkers attached, obs.sentinel.breaches[.rule]"
+    " counters, and a structured JSONL line. Off by default: no "
+    "thread runs.", bool)
+
+OBS_SENTINEL_INTERVAL_MS = conf(
+    "spark.rapids.tpu.obs.sentinel.intervalMs", 1000,
+    "Sampling window of the drift sentinel in milliseconds; each tick "
+    "evaluates the rule set against the delta since the previous "
+    "tick.", int)
+
+OBS_SENTINEL_RULES = conf(
+    "spark.rapids.tpu.obs.sentinel.rules", "",
+    "Rule spec for the drift sentinel: semicolon-separated "
+    "rule:key=val,key=val entries — e.g. "
+    "'latency:factor=2,sustain=2;slow:min=5' enables ONLY those rules "
+    "with the given overrides. Empty (default) enables every rule "
+    "(latency, slow, cacheHit, compile, spill) at its defaults; a "
+    "typo'd rule or parameter raises at session init rather than "
+    "silently disarming the watcher.")
+
+OBS_SENTINEL_PATH = conf(
+    "spark.rapids.tpu.obs.sentinel.path", "",
+    "JSONL file for the sentinel's structured breach records (rotated "
+    "by obs.slowQueryMaxBytes, the slow-query log's writer). Empty "
+    "disables the breach log; flight-recorder bundles and counters "
+    "still fire.")
+
 SERVE_ENABLED = conf(
     "spark.rapids.tpu.serve.enabled", False,
     "Start the multi-tenant SQL serving front-end (serve/server.py): a "

@@ -395,6 +395,7 @@ def _count_dispatches(key: Any, fn: Callable,
     the selection counters ``kernel.backend.pallas.hits/.fallbacks``
     (kernels/backend.py) to see whether pallas kernels actually
     engaged inside."""
+    from spark_rapids_tpu.obs import accounting as _acct
     from spark_rapids_tpu.obs import registry as _obsreg
     fam = _family(key)
     pairs = [("kernel.dispatches", 1), (f"kernel.dispatches.{fam}", 1)]
@@ -404,6 +405,9 @@ def _count_dispatches(key: Any, fn: Callable,
 
     def wrapped(*args, **kwargs):
         _obsreg.get_registry().inc_many(*pairs)
+        # ledger: every dispatch bills the owning tenant with the SAME
+        # n as the global counter — the CI exactness gate's invariant
+        _acct.charge("kernel.dispatches", 1)
         return fn(*args, **kwargs)
     return wrapped
 

@@ -1113,6 +1113,16 @@ def prepare_fused(sources: Sequence[Tuple[Any, str, int]],
     with phase("scan.uploadTime"):
         dev_arrays = {k: jnp.asarray(v) for k, v in fp.arrays.items()} \
             if fp is not None else None
+        if fp is not None:
+            # upload-byte accounting: global counter + tenant ledger,
+            # same n (the exactness invariant)
+            from spark_rapids_tpu.obs import accounting as _acct
+            from spark_rapids_tpu.obs import registry as _obsreg
+            up = sum(int(getattr(v, "nbytes", 0))
+                     for v in fp.arrays.values())
+            if up:
+                _obsreg.get_registry().inc("scan.bytesUploaded", up)
+                _acct.charge("scan.bytesUploaded", up)
 
         extra_cols: Dict[str, DeviceColumn] = dict(list_cols)
         if fallbacks:

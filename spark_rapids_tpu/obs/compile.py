@@ -477,6 +477,10 @@ def record_compile(key: Any, family: str, backend: str,
         ("kernel.compile.wallNs", int(dur_ns)),
         (tier_counter, 1))
     obsreg.get_registry().observe("kernel.compile.wallMs", dur_ns / 1e6)
+    # ledger: compile wall bills the owning tenant (same qid binding;
+    # one bool inside when accounting is off)
+    from spark_rapids_tpu.obs import accounting as _acct
+    _acct.charge_qid(qid, "kernel.compile.wallNs", int(dur_ns))
     obstrace.record("kernel.compile", t0_ns, dur_ns, cat="kernel",
                     args={"family": family, "tier": tier,
                           "backend": backend, "query": qid,

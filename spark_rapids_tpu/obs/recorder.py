@@ -161,11 +161,14 @@ class FlightRecorder:
         self.dump_bundle(profile, reason=_classify(exception))
 
     # -- the bundle --------------------------------------------------------
-    def dump_bundle(self, profile, reason: str = "failure") -> str:
+    def dump_bundle(self, profile, reason: str = "failure",
+                    extra: Optional[Dict[str, Any]] = None) -> str:
         """Write one self-contained diagnostic bundle; returns its
         directory.  An IO error here cannot fail the query: the
         listener fan-out (obs/listener.notify) swallows listener
-        exceptions by contract."""
+        exceptions by contract.  ``extra``, when given, lands in
+        ``sentinel.json`` — the drift sentinel attaches the breached
+        window and its ledger top-talkers there."""
         qid = getattr(profile, "query_id", 0)
         # name must be unique ACROSS engine restarts: query ids and the
         # bundle counter both restart at 1 per process, and a flight
@@ -190,6 +193,8 @@ class FlightRecorder:
                 f.write(json.dumps(evt, default=str) + "\n")
         dump("config.json", self._config_snapshot)
         dump("registry.json", obsreg.get_registry().snapshot())
+        if extra is not None:
+            dump("sentinel.json", extra)
         self.record("recorder.bundle", {"path": bundle,
                                         "reason": reason,
                                         "query": qid})

@@ -24,7 +24,14 @@ not accounting (the ShuffleFaultStats stamping contract).
 from __future__ import annotations
 
 import threading
-from typing import Any, Dict, Optional
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+# log-spaced millisecond boundaries for SLO bucket histograms (+Inf is
+# implicit as the final bucket) — fixed process-wide so windowed deltas
+# and Prometheus `_bucket` series are always comparable
+DEFAULT_MS_BOUNDS: Tuple[float, ...] = (
+    1.0, 2.5, 5.0, 10.0, 25.0, 50.0, 100.0, 250.0, 500.0,
+    1000.0, 2500.0, 5000.0, 10000.0, 30000.0)
 
 
 class Histogram:
@@ -51,6 +58,61 @@ class Histogram:
                 "mean": (self.sum / self.count) if self.count else None}
 
 
+class BucketHistogram:
+    """Fixed-boundary bucketed histogram (Prometheus `histogram` type:
+    cumulative ``_bucket{le=...}`` series render from it, and windowed
+    p50/p95/p99 interpolate from bucket-count deltas).  Boundaries are
+    fixed at creation — observations land in the first bucket whose
+    upper bound is >= the value; the final slot is +Inf."""
+
+    __slots__ = ("bounds", "counts", "sum", "count")
+
+    def __init__(self, bounds: Sequence[float] = DEFAULT_MS_BOUNDS):
+        self.bounds: Tuple[float, ...] = tuple(float(b) for b in bounds)
+        self.counts: List[int] = [0] * (len(self.bounds) + 1)
+        self.sum = 0.0
+        self.count = 0
+
+    def observe_locked(self, v: float) -> None:
+        self.count += 1
+        self.sum += v
+        for i, b in enumerate(self.bounds):
+            if v <= b:
+                self.counts[i] += 1
+                return
+        self.counts[-1] += 1
+
+    def to_dict(self) -> Dict[str, Any]:
+        return {"bounds": list(self.bounds),
+                "counts": list(self.counts),
+                "sum": self.sum, "count": self.count}
+
+
+def bucket_quantile(bounds: Sequence[float], counts: Sequence[int],
+                    q: float) -> Optional[float]:
+    """Quantile estimate from bucket counts (linear interpolation
+    inside the containing bucket, the Prometheus histogram_quantile
+    rule); None with no observations.  The +Inf bucket clamps to its
+    lower bound — an estimate, never an invention."""
+    total = sum(counts)
+    if total <= 0:
+        return None
+    rank = q * total
+    cum = 0.0
+    for i, c in enumerate(counts):
+        if c <= 0:
+            continue
+        if cum + c >= rank:
+            hi = bounds[i] if i < len(bounds) else None
+            lo = bounds[i - 1] if i > 0 else 0.0
+            if hi is None:
+                return float(lo)
+            frac = (rank - cum) / c
+            return float(lo + (hi - lo) * frac)
+        cum += c
+    return float(bounds[-1]) if bounds else None
+
+
 class MetricsRegistry:
     """Thread-safe registry; one per process via :func:`get_registry`."""
 
@@ -59,6 +121,7 @@ class MetricsRegistry:
         self._counters: Dict[str, float] = {}
         self._gauges: Dict[str, float] = {}
         self._hists: Dict[str, Histogram] = {}
+        self._bhists: Dict[str, BucketHistogram] = {}
 
     # -- counters ----------------------------------------------------------
     def inc(self, name: str, n: float = 1) -> None:
@@ -101,6 +164,17 @@ class MetricsRegistry:
                 h = self._hists[name] = Histogram()
             h.observe_locked(v)
 
+    def observe_bucket(self, name: str, v: float,
+                       bounds: Optional[Sequence[float]] = None) -> None:
+        """Observe into a fixed-boundary bucketed histogram (created on
+        first observation; ``bounds`` applies only then)."""
+        with self._lock:
+            h = self._bhists.get(name)
+            if h is None:
+                h = self._bhists[name] = BucketHistogram(
+                    bounds if bounds is not None else DEFAULT_MS_BOUNDS)
+            h.observe_locked(v)
+
     # -- snapshots / views -------------------------------------------------
     def snapshot(self) -> Dict[str, Any]:
         with self._lock:
@@ -109,6 +183,8 @@ class MetricsRegistry:
                 "gauges": dict(self._gauges),
                 "histograms": {k: h.to_dict()
                                for k, h in self._hists.items()},
+                "bucket_histograms": {k: h.to_dict()
+                                      for k, h in self._bhists.items()},
             }
 
     def view(self) -> "RegistryView":
@@ -140,8 +216,18 @@ class RegistryView:
             if dc:
                 hists[k] = {"count": dc, "sum": h["sum"] - b["sum"],
                             "mean": (h["sum"] - b["sum"]) / dc}
+        bhists = {}
+        for k, h in cur.get("bucket_histograms", {}).items():
+            b = base.get("bucket_histograms", {}).get(k)
+            dc = h["count"] - (b["count"] if b else 0)
+            if dc:
+                counts = list(h["counts"]) if b is None else \
+                    [c - p for c, p in zip(h["counts"], b["counts"])]
+                bhists[k] = {"bounds": h["bounds"], "counts": counts,
+                             "count": dc,
+                             "sum": h["sum"] - (b["sum"] if b else 0.0)}
         return {"counters": counters, "gauges": dict(cur["gauges"]),
-                "histograms": hists}
+                "histograms": hists, "bucket_histograms": bhists}
 
 
 _REGISTRY: Optional[MetricsRegistry] = None

@@ -34,6 +34,18 @@ run.  ``ObsHttpServer`` serves, from a background daemon thread:
                             stamp drift (rewritten/deleted file
                             counts), so operators can see what the
                             incremental refresher keeps warm.
+  ``GET /tenants``          JSON: the per-tenant ResourceLedger table
+                            (obs/accounting.py) — kernel dispatches,
+                            compile wall, scan/shuffle bytes, cache
+                            hits/misses, HBM byte-seconds and queue
+                            wait attributed to (session, workload),
+                            single-flight followers and batched
+                            members billed their fair share.
+  ``GET /slo``              JSON: p50/p95/p99 interpolated from the
+                            fixed-boundary SLO bucket histograms
+                            (e2e latency, queue wait, first chunk;
+                            global + per statement template), plus
+                            the template-key legend.
   ``GET /healthz``          liveness probe.
 
 Off by default (``obs.http.enabled=false``): nothing binds a socket
@@ -68,10 +80,19 @@ def _prom_value(v: Any) -> str:
     return repr(f)
 
 
+def _prom_le(bound: float) -> str:
+    f = float(bound)
+    if f == int(f):
+        return str(int(f))
+    return repr(f)
+
+
 def render_prometheus(snapshot: Dict[str, Any]) -> str:
     """Render a MetricsRegistry snapshot as Prometheus text exposition
-    (one ``# TYPE`` line per family; histograms surface as summaries:
-    ``_count``/``_sum`` plus ``_min``/``_max`` gauges)."""
+    (one ``# TYPE`` line per family; summary histograms surface as
+    ``_count``/``_sum`` plus ``_min``/``_max`` gauges; bucketed SLO
+    histograms render as REAL ``histogram`` families with cumulative
+    ``_bucket{le=...}`` series ending in ``le="+Inf"``)."""
     lines = []
     for name in sorted(snapshot.get("counters", {})):
         n = _prom_name(name)
@@ -91,6 +112,19 @@ def render_prometheus(snapshot: Dict[str, Any]) -> str:
             if h.get(bound) is not None:
                 lines.append(f"# TYPE {n}_{bound} gauge")
                 lines.append(f"{n}_{bound} {_prom_value(h[bound])}")
+    for name in sorted(snapshot.get("bucket_histograms", {})):
+        h = snapshot["bucket_histograms"][name]
+        n = _prom_name(name)
+        lines.append(f"# TYPE {n} histogram")
+        cum = 0
+        for bound, c in zip(h["bounds"], h["counts"]):
+            cum += c
+            lines.append(
+                f'{n}_bucket{{le="{_prom_le(bound)}"}} {cum}')
+        lines.append(
+            f'{n}_bucket{{le="+Inf"}} {_prom_value(h["count"])}')
+        lines.append(f"{n}_sum {_prom_value(h.get('sum', 0))}")
+        lines.append(f"{n}_count {_prom_value(h.get('count', 0))}")
     return "\n".join(lines) + "\n"
 
 
@@ -117,6 +151,84 @@ def parse_prometheus(text: str) -> Dict[str, float]:
             samples[name] = float(value)
     if n == 0:
         raise ValueError("empty exposition")
+    return samples
+
+
+_LE_LABEL = re.compile(r'le="([^"]+)"')
+
+
+def lint_exposition(text: str) -> Dict[str, float]:
+    """Strict structural lint of a Prometheus exposition, on top of the
+    per-line validation in :func:`parse_prometheus`:
+
+      * every sample's family has a preceding ``# TYPE`` line (bucket /
+        sum / count samples resolve to their ``histogram`` family, and
+        sum / count also to a ``summary`` family);
+      * every ``histogram`` family carries ``_bucket`` series that are
+        cumulative (monotone non-decreasing in ``le`` order), end with
+        ``le="+Inf"``, and the +Inf bucket equals ``_count``.
+
+    Raises ``ValueError`` on any violation; returns the unlabeled
+    samples like ``parse_prometheus``.  ci.sh runs this on EVERY
+    scrape so a malformed family cannot ship behind a passing smoke.
+    """
+    samples = parse_prometheus(text)
+    types: Dict[str, str] = {}
+    hist_buckets: Dict[str, list] = {}
+    hist_counts: Dict[str, float] = {}
+    for line in text.splitlines():
+        if line.startswith("# TYPE "):
+            parts = line.split()
+            if len(parts) != 4:
+                raise ValueError(f"bad TYPE line: {line!r}")
+            types[parts[2]] = parts[3]
+            continue
+        if not line or line.startswith("#"):
+            continue
+        name = line.split("{", 1)[0].split(" ", 1)[0]
+        family = name
+        for suffix in ("_bucket", "_sum", "_count"):
+            if not name.endswith(suffix):
+                continue
+            base_type = types.get(name[: -len(suffix)])
+            if base_type == "histogram" or \
+                    (base_type == "summary" and suffix != "_bucket"):
+                family = name[: -len(suffix)]
+                break
+        if family not in types:
+            raise ValueError(f"sample without # TYPE: {line!r}")
+        if types[family] == "histogram":
+            value = float(line.rsplit(" ", 1)[1])
+            if name == family + "_bucket":
+                m = _LE_LABEL.search(line)
+                if not m:
+                    raise ValueError(f"bucket without le=: {line!r}")
+                hist_buckets.setdefault(family, []).append(
+                    (m.group(1), value))
+            elif name == family + "_count":
+                hist_counts[family] = value
+    for family, t in types.items():
+        if t != "histogram":
+            continue
+        buckets = hist_buckets.get(family)
+        if not buckets:
+            raise ValueError(f"histogram {family} has no _bucket series")
+        if buckets[-1][0] != "+Inf":
+            raise ValueError(
+                f"histogram {family} buckets do not end at le=+Inf")
+        prev = -1.0
+        for le, v in buckets:
+            if v < prev:
+                raise ValueError(
+                    f"histogram {family} buckets not cumulative at "
+                    f"le={le}")
+            prev = v
+        if family not in hist_counts:
+            raise ValueError(f"histogram {family} missing _count")
+        if buckets[-1][1] != hist_counts[family]:
+            raise ValueError(
+                f"histogram {family} +Inf bucket {buckets[-1][1]} != "
+                f"_count {hist_counts[family]}")
     return samples
 
 
@@ -167,6 +279,20 @@ class ObsHttpServer:
             reg.set_gauge("sched.queued", st["queued"])
             reg.set_gauge("sched.running", st["running"])
             reg.set_gauge("sched.admittedBytes", st["admitted_bytes"])
+            # saturation gauge set — the elastic-executor input signal
+            # (ROADMAP item 2): queue depth plus admitted/running as
+            # fractions of their budgets, refreshed at scrape time so a
+            # scaler polling /metrics always sees the live level
+            ctrl = session.scheduler.controller
+            reg.set_gauge("sched.queueDepth", st["queued"])
+            budget = float(getattr(ctrl, "memory_budget", 0) or 0)
+            reg.set_gauge(
+                "sched.admittedFraction",
+                (st["admitted_bytes"] / budget) if budget > 0 else 0.0)
+            slots = float(getattr(ctrl, "max_concurrent", 0) or 0)
+            reg.set_gauge(
+                "sched.runningFraction",
+                (st["running"] / slots) if slots > 0 else 0.0)
         except Exception:
             pass
         try:
@@ -249,6 +375,39 @@ class ObsHttpServer:
         return json.dumps(payload, default=str)
 
     @staticmethod
+    def _tenants_json() -> str:
+        """Resource-ledger table: one row per (session, workload)
+        tenant, assembled under the ledger's ONE lock (the /compiles
+        idiom) so concurrent scrapes see a consistent snapshot even
+        while queries charge mid-flight."""
+        from spark_rapids_tpu.obs import accounting as acct
+        return json.dumps(acct.snapshot(), default=str)
+
+    @staticmethod
+    def _slo_json() -> str:
+        """Per-template SLO quantiles interpolated from the bucketed
+        histograms (one registry snapshot = one lock), plus the
+        template-key legend so short keys resolve back to statement
+        text."""
+        from spark_rapids_tpu.obs import accounting as acct
+        snap = obsreg.get_registry().snapshot()
+        hists = {}
+        for name, h in sorted(snap.get("bucket_histograms", {}).items()):
+            hists[name] = {
+                "count": h["count"],
+                "sum_ms": h["sum"],
+                "p50": obsreg.bucket_quantile(h["bounds"], h["counts"],
+                                              0.50),
+                "p95": obsreg.bucket_quantile(h["bounds"], h["counts"],
+                                              0.95),
+                "p99": obsreg.bucket_quantile(h["bounds"], h["counts"],
+                                              0.99),
+            }
+        return json.dumps({"histograms": hists,
+                           "bounds_ms": list(obsreg.DEFAULT_MS_BOUNDS),
+                           "templates": acct.template_labels()})
+
+    @staticmethod
     def _profile_json(session, qid: int) -> Optional[str]:
         prof = session.query_profile(qid)
         if prof is None:
@@ -297,6 +456,10 @@ class ObsHttpServer:
                         self._send(200, server._compiles_json(n))
                     elif path == "/resultcache":
                         self._send(200, server._resultcache_json())
+                    elif path == "/tenants":
+                        self._send(200, server._tenants_json())
+                    elif path == "/slo":
+                        self._send(200, server._slo_json())
                     elif path.startswith("/profiles/"):
                         tail = path.rsplit("/", 1)[1]
                         body = (server._profile_json(session, int(tail))
@@ -312,7 +475,8 @@ class ObsHttpServer:
                             {"ok": True,
                              "routes": ["/metrics", "/queries",
                                         "/profiles/<qid>", "/compiles",
-                                        "/resultcache", "/healthz"]}))
+                                        "/resultcache", "/tenants",
+                                        "/slo", "/healthz"]}))
                     else:
                         self._send(404, json.dumps(
                             {"error": f"unknown route {path!r}"}))

@@ -37,6 +37,7 @@ from typing import Any, Dict, Optional
 
 from collections import deque
 
+from spark_rapids_tpu.obs import accounting as obsacct
 from spark_rapids_tpu.obs import compile as obscompile
 from spark_rapids_tpu.obs import recorder as obsrec
 from spark_rapids_tpu.obs import registry as obsreg
@@ -356,6 +357,15 @@ class QueryService:
         # with both (obs/compile.py; compiles inside a NESTED query
         # attribute to the parent, whose token those threads carry)
         obscompile.register_query(qid, digest)
+        # resource ledger: bind qid -> tenant (session x template |
+        # digest).  A coalesced batch execution registers with
+        # hold=True so its bill stays un-folded until the batcher
+        # settles it across the member tenants (obs/accounting.py).
+        obsacct.register_query(
+            qid, session_id=meta.get("session_id"),
+            template=meta.get("statement_template"),
+            plan_digest=digest,
+            hold=bool(meta.get("batched_statements")))
         # nested collect inside a running query: execute inline under
         # the parent's slot/token (re-admission would self-deadlock)
         if getattr(self._tls, "in_query", False):
@@ -376,10 +386,12 @@ class QueryService:
                 fut._finish(QueryState.FAILED, error=e,
                             profile=self._session.query_profile(qid))
                 obscompile.finish_query(qid)
+                obsacct.finish_query(qid)
                 self._untrack(fut)
                 raise
             fut._finish(QueryState.SUCCESS, result=table, profile=prof)
             obscompile.finish_query(qid)
+            obsacct.finish_query(qid)
             self._untrack(fut)
             return fut
         reg.inc("sched.submitted")
@@ -537,6 +549,13 @@ class QueryService:
                 del self._flights[fl.key]
             followers = list(fl.followers)
             fl.followers = []
+        if followers:
+            # fair-share the leader's bill across the joined tenants
+            # BEFORE any record folds (the leader's own fold happens in
+            # _run's finally, after this) — dedup must not hide a
+            # tenant's true consumption
+            obsacct.settle_flight(fut.query_id,
+                                  [f.query_id for f in followers])
         for f in followers:
             self._finish_follower(f, state, result, error)
 
@@ -555,6 +574,7 @@ class QueryService:
             prof = None
         fut._finish(state, result=result, error=error, profile=prof)
         obscompile.finish_query(fut.query_id)
+        obsacct.finish_query(fut.query_id)
         self._untrack(fut)
         obsrec.record_event("sched.finished", query=fut.query_id,
                             state=fut.state.value)
@@ -604,6 +624,14 @@ class QueryService:
                                                     req, meta)
                 return
             fut.queue_wait_ns = req.queue_wait_ns
+            # queue wait: global counter + tenant ledger (same n) +
+            # SLO bucket observation — the saturation signals
+            reg.inc("sched.queueWaitNs", req.queue_wait_ns)
+            obsacct.charge_qid(fut.query_id, "sched.queueWaitNs",
+                               req.queue_wait_ns)
+            obsacct.observe_slo("slo.queueWaitMs",
+                                req.queue_wait_ns / 1e6,
+                                template=meta.get("statement_template"))
             fut._set_running()
             sched_extra = self._sched_extra_base(meta, {
                 "sched.queueWaitNs": req.queue_wait_ns,
@@ -636,7 +664,17 @@ class QueryService:
                 return
             reg.inc("sched.completed")
             if tracker is not None:
-                self._observe(plan, tracker.delta())
+                hw = tracker.delta()
+                self._observe(plan, hw)
+                if hw:
+                    # HBM residency bill: peak-growth bytes x query
+                    # wall — the "who parked on the chip" metric
+                    wall_s = max(0.0, (time.monotonic_ns()
+                                       - fut._submitted_ns) / 1e9)
+                    bs = float(hw) * wall_s
+                    reg.inc("hbm.byteSeconds", bs)
+                    obsacct.charge_qid(fut.query_id,
+                                       "hbm.byteSeconds", bs)
             # corpus emission BEFORE the future resolves: a caller that
             # observes result() may immediately read the corpus file,
             # and this thread's finally block runs after the wake-up
@@ -654,6 +692,15 @@ class QueryService:
             # the table row is frozen by _untrack (which reads the
             # per-query stats)
             obscompile.finish_query(fut.query_id)
+            # in-process e2e latency (serve requests observe at the
+            # serve layer with their own t0 — never both); then fold
+            # the ledger bill, AFTER _finish_exec ran settle_flight
+            if meta.get("session_id") is None:
+                obsacct.observe_slo(
+                    "slo.latencyMs",
+                    max(0, time.monotonic_ns() - fut._submitted_ns)
+                    / 1e6)
+            obsacct.finish_query(fut.query_id)
             self._untrack(fut)
             obsrec.record_event("sched.finished", query=fut.query_id,
                                 state=fut.state.value)

@@ -109,7 +109,8 @@ class StatementBatcher:
             return False
         from spark_rapids_tpu.serve.server import _Inflight
         self._server._begin_or_raise(sess)
-        infl = _Inflight(tag, None, int(msg.get("credit", 8)))
+        infl = _Inflight(tag, None, int(msg.get("credit", 8)),
+                         template=stmt.sql)
         conn.track(infl)
         item = _Item(conn, tag, sess, stmt, dict(msg.get("params") or {}),
                      int(msg.get("credit", 8)), msg.get("stream_id"),
@@ -187,6 +188,11 @@ class StatementBatcher:
                     hit = result_cache.lookup(cache_key, names, stamps,
                                               count_miss=False)
                     if hit is not None:
+                        from spark_rapids_tpu.obs import \
+                            accounting as acct
+                        acct.charge_tenant(
+                            it.sess.session_id, it.stmt.sql, digest,
+                            "serve.resultCacheHits", 1)
                         srv._spawn_streamer(
                             it.conn, it.tag, srv._stream_cached,
                             (it.conn, it.sess, it.infl, hit,
@@ -223,7 +229,8 @@ class StatementBatcher:
         try:
             eng = srv._engine()
             meta = {"session_id": it.sess.session_id,
-                    "client_addr": it.sess.client_addr}
+                    "client_addr": it.sess.client_addr,
+                    "statement_template": it.stmt.sql}
             if b.digest is not None:
                 meta["plan_digest"] = b.digest
                 meta["plan_cacheable"] = b.cacheable
@@ -236,9 +243,12 @@ class StatementBatcher:
             return
         is_follower = getattr(fut, "dedup_of", None) is not None
         if b.cacheable:
-            obsreg.get_registry().inc(
-                "serve.resultCacheDedupedFollowers"
-                if is_follower else "serve.resultCacheMisses")
+            miss_name = ("serve.resultCacheDedupedFollowers"
+                         if is_follower else "serve.resultCacheMisses")
+            obsreg.get_registry().inc(miss_name)
+            from spark_rapids_tpu.obs import accounting as acct
+            acct.charge_tenant(it.sess.session_id, it.stmt.sql,
+                               b.digest, miss_name, 1)
         it.infl.future = fut
         srv._spawn_streamer(
             it.conn, it.tag, srv._stream_result,
@@ -248,9 +258,15 @@ class StatementBatcher:
 
     def _run_coalesced(self, pending: List[_Bound], cplan,
                        markers: List[str]) -> None:
+        from spark_rapids_tpu.obs import accounting as acct
         srv = self._server
         reg = obsreg.get_registry()
         first = pending[0].item
+
+        def member_tenant(b: _Bound):
+            return acct.tenant_of(b.item.sess.session_id,
+                                  b.item.stmt.sql, b.digest)
+
         try:
             eng = srv._engine()
             fut = eng.scheduler.submit(
@@ -259,6 +275,7 @@ class StatementBatcher:
                 estimate_bytes=first.sess.estimate_bytes,
                 meta={"session_id": first.sess.session_id,
                       "client_addr": first.sess.client_addr,
+                      "statement_template": first.stmt.sql,
                       "batched_statements": len(pending)})
         except BaseException as e:
             for b in pending:
@@ -271,21 +288,31 @@ class StatementBatcher:
         try:
             table = fut.result()
         except BaseException as e:
+            # the held execution record still carries the bill — split
+            # it equally so a failed batch can't strand or lose charges
+            acct.settle_batch(fut.query_id,
+                              [(member_tenant(b), 1.0) for b in pending])
             for b in pending:
                 self._fail_item(b.item, type(e).__name__, str(e))
             return
         marker_set = set(markers)
         keep = [i for i, n in enumerate(table.column_names)
                 if n not in marker_set]
+        members = []
         for i, b in enumerate(pending):
             try:
                 mask = table.column(markers[i])
                 sub = table.filter(mask).select(keep)
             except Exception as e:
+                members.append((member_tenant(b), 0.0))
                 self._fail_item(b.item, type(e).__name__, str(e))
                 continue
+            members.append((member_tenant(b), float(sub.num_rows)))
             if b.cacheable:
                 reg.inc("serve.resultCacheMisses")
+                acct.charge_tenant(b.item.sess.session_id,
+                                   b.item.stmt.sql, b.digest,
+                                   "serve.resultCacheMisses", 1)
                 # per-item insert under the serve pre/post-stamp pin
                 try:
                     from spark_rapids_tpu.exec import incremental
@@ -298,6 +325,10 @@ class StatementBatcher:
             srv._spawn_streamer(b.item.conn, b.item.tag,
                                 self._stream_split,
                                 (b.item, sub, fut.query_id))
+        # split the coalesced execution's held bill across the member
+        # tenants by result-row share (zero rows everywhere degrades
+        # to an equal split inside settle_batch)
+        acct.settle_batch(fut.query_id, members)
 
     def _stream_split(self, it: _Item, table, query_id) -> None:
         srv = self._server

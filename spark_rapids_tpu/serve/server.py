@@ -300,13 +300,19 @@ class _Inflight:
     a result-cache hit or a resumed stream) and the client-credit
     window."""
 
-    def __init__(self, tag: int, future, credit: int):
+    def __init__(self, tag: int, future, credit: int,
+                 template: Optional[str] = None):
         self.tag = tag
         self.future = future
         self._credit = max(0, int(credit))
         self._cv = threading.Condition()
         self.aborted = False
         self.abort_code: Optional[str] = None
+        # SLO attribution: request receipt time + statement template
+        # (None for ad-hoc sql / resumes) — e2e and first-chunk
+        # latency observe against these at stream time
+        self.t0_ns = time.monotonic_ns()
+        self.template = template
 
     def add_credit(self, n: int) -> None:
         with self._cv:
@@ -886,7 +892,8 @@ class ServeServer:
                     plan = stmt.bind(msg.get("params") or {})
                     self._start_query(conn, tag, sess, plan,
                                       int(msg.get("credit", 8)),
-                                      stream_id=msg.get("stream_id"))
+                                      stream_id=msg.get("stream_id"),
+                                      template=stmt.sql)
             elif op == "resume_stream":
                 self._start_resume(conn, tag, sess, msg)
             elif op == "finish_stream":
@@ -1043,7 +1050,8 @@ class ServeServer:
 
     def _start_query(self, conn: _Conn, tag: int, sess: ServeSession,
                      plan, credit: int,
-                     stream_id: Optional[str] = None) -> None:
+                     stream_id: Optional[str] = None,
+                     template: Optional[str] = None) -> None:
         self._begin_or_raise(sess)
         try:
             digest = cache_key = names = stamps = None
@@ -1077,7 +1085,15 @@ class ServeServer:
                 hit = result_cache.lookup(cache_key, names, stamps,
                                           count_miss=False)
                 if hit is not None:
-                    infl = _Inflight(tag, None, credit)
+                    # ledger: a cache hit never passes the scheduler,
+                    # so the tenant is charged directly (same name as
+                    # the global counter result_cache.lookup bumped)
+                    from spark_rapids_tpu.obs import accounting as acct
+                    acct.charge_tenant(sess.session_id, template,
+                                       digest,
+                                       "serve.resultCacheHits", 1)
+                    infl = _Inflight(tag, None, credit,
+                                     template=template)
                     conn.track(infl)
                     self._spawn_streamer(
                         conn, tag, self._stream_cached,
@@ -1092,6 +1108,8 @@ class ServeServer:
             eng = self._engine()
             meta = {"session_id": sess.session_id,
                     "client_addr": sess.client_addr}
+            if template is not None:
+                meta["statement_template"] = template
             if digest is not None:
                 meta["plan_digest"] = digest  # already computed here
                 meta["plan_cacheable"] = fp_cacheable
@@ -1107,10 +1125,14 @@ class ServeServer:
                 meta=meta)
             is_follower = getattr(fut, "dedup_of", None) is not None
             if cacheable:
-                obsreg.get_registry().inc(
-                    "serve.resultCacheDedupedFollowers"
-                    if is_follower else "serve.resultCacheMisses")
-            infl = _Inflight(tag, fut, credit)
+                miss_name = ("serve.resultCacheDedupedFollowers"
+                             if is_follower
+                             else "serve.resultCacheMisses")
+                obsreg.get_registry().inc(miss_name)
+                from spark_rapids_tpu.obs import accounting as acct
+                acct.charge_tenant(sess.session_id, template, digest,
+                                   miss_name, 1)
+            infl = _Inflight(tag, fut, credit, template=template)
             conn.track(infl)
             self._spawn_streamer(
                 conn, tag, self._stream_result,
@@ -1287,12 +1309,22 @@ class ServeServer:
                             pass
                         infl.abort()
                         return
-                    if ev.action is ServeFaultAction.DELAY:
+                    if ev.action in (ServeFaultAction.DELAY,
+                                     ServeFaultAction.SLOW):
+                        # SLOW on the server streamer = a degraded
+                        # chunk send (the sentinel probe's latency
+                        # injection); DELAY keeps its one-shot stall
                         time.sleep(ev.delay_s)
                 wire.send_frame(conn.sock, conn.wlock, wire.CHUNK,
                                 infl.tag, wire.encode_chunk(seq, payload),
                                 stall_s=self._write_stall_s)
                 sent += 1
+                if sent == 1:
+                    from spark_rapids_tpu.obs import accounting as acct
+                    acct.observe_slo(
+                        "slo.firstChunkMs",
+                        (time.monotonic_ns() - infl.t0_ns) / 1e6,
+                        template=infl.template)
                 reg.inc("serve.streamedBatches")
             if conn.alive and not infl.aborted:
                 release()
@@ -1304,6 +1336,14 @@ class ServeServer:
                                      "query_id": query_id,
                                      "last_seq": total}),
                     stall_s=self._write_stall_s)
+                # serve-side e2e: request receipt -> END frame (the
+                # sched layer skips serve-attributed queries, so one
+                # observation per request, never two)
+                from spark_rapids_tpu.obs import accounting as acct
+                acct.observe_slo(
+                    "slo.latencyMs",
+                    (time.monotonic_ns() - infl.t0_ns) / 1e6,
+                    template=infl.template)
         except wire.ServeWireError as e:
             # a write stall is the peer's fault, and the partial frame
             # desynced the stream: typed counter, abort, close

@@ -421,6 +421,12 @@ class TcpClientConnection(ClientConnection):
                         ("shuffle.wire.compressedFrames",
                          1 if wire_len < len(payload) +
                          _WIRE_WRAP.size else 0))
+                    # tenant ledger, same n as the global counter (the
+                    # receive thread usually carries no query token, so
+                    # this typically bills "(unattributed)" — counted,
+                    # never lost)
+                    from spark_rapids_tpu.obs import accounting as _acct
+                    _acct.charge("shuffle.wire.rawBytes", len(payload))
                 # post as a "send" into the rendezvous; a dummy tx
                 # carries the completion the channel requires
                 stx = Transaction(tag)

@@ -1,0 +1,434 @@
+"""Tenant-aware resource metering, SLO histograms, and the drift
+sentinel (ISSUE 18): the ResourceLedger's accounting identity (sum
+over tenant rows == global counter deltas, through single-flight and
+batched-statement settles), bucketed histogram quantiles and the
+strict Prometheus exposition linter, size-rotated JSONL appends, the
+/tenants and /slo endpoints under concurrent scrape (in-flight batch
+and mid-drain), and the sentinel's one-bundle-per-episode breach
+semantics."""
+
+import json
+import os
+import threading
+import time
+import urllib.request
+
+import numpy as np
+import pyarrow as pa
+import pytest
+
+from spark_rapids_tpu import TpuSparkSession, functions as F
+from spark_rapids_tpu.obs import accounting as acct
+from spark_rapids_tpu.obs import jsonl as obsjsonl
+from spark_rapids_tpu.obs import recorder as obsrec
+from spark_rapids_tpu.obs import registry as obsreg
+from spark_rapids_tpu.obs import sentinel as obssent
+from spark_rapids_tpu.obs.server import (lint_exposition,
+                                         parse_prometheus,
+                                         render_prometheus)
+
+
+@pytest.fixture(autouse=True)
+def _fresh_state():
+    obsreg.reset_registry()
+    acct.reset()
+    acct.configure(True)
+    yield
+    obsreg.reset_registry()
+    acct.reset()
+    acct.configure(True)
+    obsrec.disable()
+
+
+def _get(port, path, timeout=10):
+    with urllib.request.urlopen(
+            f"http://127.0.0.1:{port}{path}", timeout=timeout) as r:
+        return r.status, r.read().decode()
+
+
+def _tenant_sum(snap, metric):
+    return sum(r["usage"].get(metric, 0.0) for r in snap["tenants"])
+
+
+# ---------------------------------------------------------------------------
+# bucketed histograms + quantiles
+# ---------------------------------------------------------------------------
+
+def test_bucket_histogram_counts_and_quantiles():
+    reg = obsreg.MetricsRegistry()
+    for v in (0.5, 2.0, 8.0, 40.0, 40.0, 9000.0, 99999.0):
+        reg.observe_bucket("slo.latencyMs", v)
+    h = reg.snapshot()["bucket_histograms"]["slo.latencyMs"]
+    assert h["count"] == 7
+    assert sum(h["counts"]) == 7
+    assert len(h["counts"]) == len(h["bounds"]) + 1
+    # 99999 > the 30000 top bound: lands in the +Inf slot
+    assert h["counts"][-1] == 1
+    p50 = obsreg.bucket_quantile(h["bounds"], h["counts"], 0.50)
+    p99 = obsreg.bucket_quantile(h["bounds"], h["counts"], 0.99)
+    assert 5.0 <= p50 <= 50.0
+    # +Inf bucket clamps to its lower bound, never invents a value
+    assert p99 == h["bounds"][-1]
+    assert obsreg.bucket_quantile(h["bounds"], [0] * len(h["counts"]),
+                                  0.5) is None
+
+
+def test_registry_view_carves_bucket_histogram_windows():
+    reg = obsreg.MetricsRegistry()
+    reg.observe_bucket("slo.latencyMs", 3.0)
+    view = reg.view()
+    reg.observe_bucket("slo.latencyMs", 700.0)
+    reg.observe_bucket("slo.latencyMs", 800.0)
+    d = view.delta()["bucket_histograms"]["slo.latencyMs"]
+    assert d["count"] == 2          # the pre-view observation excluded
+    p95 = obsreg.bucket_quantile(d["bounds"], d["counts"], 0.95)
+    assert p95 > 500.0              # the window is all-slow
+    # no new observations -> the histogram drops from the next delta
+    view2 = reg.view()
+    assert "slo.latencyMs" not in view2.delta()["bucket_histograms"]
+
+
+# ---------------------------------------------------------------------------
+# Prometheus exposition: real _bucket series + strict linter
+# ---------------------------------------------------------------------------
+
+def test_exposition_renders_real_histogram_series():
+    reg = obsreg.MetricsRegistry()
+    reg.inc("kernel.dispatches", 3)
+    reg.observe("sched.queueWait", 5.0)
+    for v in (1.0, 30.0, 30.0, 4000.0):
+        reg.observe_bucket("slo.latencyMs", v)
+    text = render_prometheus(reg.snapshot())
+    assert "# TYPE spark_rapids_tpu_slo_latencyMs histogram" in text
+    assert 'spark_rapids_tpu_slo_latencyMs_bucket{le="+Inf"} 4' in text
+    samples = lint_exposition(text)
+    assert samples["spark_rapids_tpu_slo_latencyMs_count"] == 4
+    # cumulative: the le=50 bucket holds 1+2 observations
+    assert 'slo_latencyMs_bucket{le="50"} 3' in text
+
+
+def test_exposition_linter_rejects_malformed():
+    good = ("# TYPE m histogram\n"
+            'm_bucket{le="1"} 1\nm_bucket{le="+Inf"} 2\n'
+            "m_sum 3\nm_count 2\n")
+    lint_exposition(good)
+    with pytest.raises(ValueError):        # sample without TYPE
+        lint_exposition("loose_metric 1\n")
+    with pytest.raises(ValueError):        # non-cumulative buckets
+        lint_exposition(good.replace('le="1"} 1', 'le="1"} 5'))
+    with pytest.raises(ValueError):        # +Inf != _count
+        lint_exposition(good.replace("m_count 2", "m_count 9"))
+    with pytest.raises(ValueError):        # buckets not ending at +Inf
+        lint_exposition("# TYPE m histogram\n"
+                        'm_bucket{le="1"} 1\nm_sum 1\nm_count 1\n')
+
+
+# ---------------------------------------------------------------------------
+# rotating JSONL appends
+# ---------------------------------------------------------------------------
+
+def test_rotating_append_keeps_one_generation(tmp_path):
+    path = str(tmp_path / "slow.jsonl")
+    line = json.dumps({"pad": "x" * 100})
+    cap = 3 * (len(line) + 1)
+    for _ in range(7):
+        obsjsonl.rotating_append(path, line, max_bytes=cap)
+    assert os.path.exists(path) and os.path.exists(path + ".1")
+    for p in (path, path + ".1"):
+        assert os.path.getsize(p) <= cap
+        with open(p) as f:
+            for rec in f:                  # every line intact
+                assert json.loads(rec)["pad"]
+    # max_bytes=0 disables rotation entirely
+    path2 = str(tmp_path / "raw.jsonl")
+    for _ in range(5):
+        obsjsonl.rotating_append(path2, line, max_bytes=0)
+    assert not os.path.exists(path2 + ".1")
+
+
+# ---------------------------------------------------------------------------
+# ResourceLedger: the accounting identity
+# ---------------------------------------------------------------------------
+
+def test_ledger_attributes_and_folds():
+    acct.register_query(101, "sess-a", "select 1")
+    acct.charge_qid(101, "kernel.dispatches", 4)
+    acct.charge_qid(101, "scan.bytesWalked", 1000)
+    snap = acct.snapshot()                 # live record merges in
+    assert _tenant_sum(snap, "kernel.dispatches") == 4
+    acct.finish_query(101)
+    acct.finish_query(101)                 # idempotent
+    snap = acct.snapshot()
+    row = [r for r in snap["tenants"] if r["session_id"] == "sess-a"][0]
+    assert row["workload"] == "select 1"
+    assert row["usage"]["kernel.dispatches"] == 4
+    assert row["usage"]["scan.bytesWalked"] == 1000
+    # token-less charges land on "(unattributed)" — counted, not lost
+    acct.charge("kernel.dispatches", 2)
+    assert _tenant_sum(acct.snapshot(), "kernel.dispatches") == 6
+
+
+def test_ledger_flight_settle_shares_sum_to_leader_bill():
+    acct.register_query(1, "sess-a", "q")
+    acct.register_query(2, "sess-b", "q")
+    acct.register_query(3, "sess-c", "q")
+    acct.charge_qid(1, "kernel.dispatches", 9)
+    acct.charge_qid(1, "kernel.compile.wallNs", 3_000_000)
+    acct.settle_flight(1, [2, 3])
+    for q in (1, 2, 3):
+        acct.finish_query(q)
+    snap = acct.snapshot()
+    assert _tenant_sum(snap, "kernel.dispatches") == pytest.approx(9)
+    assert _tenant_sum(snap, "kernel.compile.wallNs") == \
+        pytest.approx(3_000_000)
+    by_sess = {r["session_id"]: r["usage"] for r in snap["tenants"]}
+    for sid in ("sess-a", "sess-b", "sess-c"):
+        assert by_sess[sid]["kernel.dispatches"] == pytest.approx(3)
+
+
+def test_ledger_batch_settle_splits_by_row_share():
+    acct.register_query(7, "sess-a", "tpl", hold=True)
+    acct.charge_qid(7, "kernel.dispatches", 10)
+    acct.finish_query(7)                   # hold: bill stays un-folded
+    members = [(acct.tenant_of("sess-a", "tpl", None), 30.0),
+               (acct.tenant_of("sess-b", "tpl", None), 10.0)]
+    acct.settle_batch(7, members)
+    snap = acct.snapshot()
+    assert _tenant_sum(snap, "kernel.dispatches") == pytest.approx(10)
+    by_sess = {r["session_id"]: r["usage"] for r in snap["tenants"]}
+    assert by_sess["sess-a"]["kernel.dispatches"] == pytest.approx(7.5)
+    assert by_sess["sess-b"]["kernel.dispatches"] == pytest.approx(2.5)
+    # zero weights degrade to an equal split
+    acct.register_query(8, "sess-a", "tpl", hold=True)
+    acct.charge_qid(8, "kernel.dispatches", 4)
+    acct.settle_batch(8, [(("s1", "w"), 0.0), (("s2", "w"), 0.0)])
+    snap = acct.snapshot()
+    assert _tenant_sum(snap, "kernel.dispatches") == pytest.approx(14)
+
+
+def test_ledger_disabled_is_inert():
+    acct.configure(False)
+    acct.register_query(50, "sess-a", "q")
+    acct.charge_qid(50, "kernel.dispatches", 5)
+    acct.charge("kernel.dispatches", 5)
+    acct.finish_query(50)
+    assert acct.snapshot()["tenants"] == []
+
+
+# ---------------------------------------------------------------------------
+# end-to-end: scheduler attribution + /tenants + /slo + exactness
+# ---------------------------------------------------------------------------
+
+def _df(s, n=600, parts=2, seed=5):
+    rng = np.random.default_rng(seed)
+    t = pa.table({
+        "k": pa.array(rng.integers(0, 9, n).astype(np.int64)),
+        "v": pa.array(rng.integers(0, 500, n).astype(np.int64)),
+    })
+    return (s.create_dataframe(t, num_partitions=parts)
+            .group_by("k").agg(F.count("*").alias("c"),
+                               F.sum("v").alias("sv")))
+
+
+def test_endpoints_exactness_and_concurrent_scrape():
+    """One session, both contracts: (a) /tenants, /slo and /metrics
+    serve consistent one-lock snapshots — never a 500 — while an
+    8-query batch is in flight and while the serve tier drains;
+    (b) after the batch, the ledger identity holds: per-tenant
+    kernel.dispatches sum EXACTLY to the global counter delta.
+
+    The 8 queries share one plan shape (only the data seed varies) so
+    the batch pays one compile set, not eight."""
+    import tests.test_serve as ts
+    s = TpuSparkSession({
+        "spark.rapids.tpu.sql.variableFloatAgg.enabled": True,
+        "spark.rapids.tpu.obs.http.enabled": True,
+        "spark.rapids.tpu.serve.enabled": True,
+        # all 8 must be admitted at once: a queued query cannot reach
+        # plan time (where the Parker holds it) until a slot frees
+        "spark.rapids.tpu.sched.maxConcurrent": 8,
+    })
+    est = 64 << 20              # default estimate saturates the budget
+    port = s.obs_server.port
+    parker = ts.Parker()
+    s.add_plan_listener(parker)
+    failures = []
+    stop = threading.Event()
+
+    def scraper():
+        while not stop.is_set():
+            for path in ("/tenants", "/slo", "/metrics"):
+                try:
+                    code, body = _get(port, path)
+                    if code != 200:
+                        failures.append((path, code))
+                    elif path == "/metrics":
+                        lint_exposition(body)
+                    else:
+                        json.loads(body)
+                except Exception as e:
+                    failures.append((path, repr(e)))
+
+    threads = [threading.Thread(target=scraper, daemon=True)
+               for _ in range(3)]
+    try:
+        base = obsreg.get_registry().counter("kernel.dispatches")
+        futs = [s.submit(_df(s, seed=i), estimate_bytes=est)
+                for i in range(8)]
+        for _ in range(8):                 # all 8 parked at plan time
+            assert parker.parked.acquire(timeout=60)
+        for t in threads:
+            t.start()
+        time.sleep(0.3)                    # scrapes against live batch
+        parker.release.set()
+        for f in futs:
+            assert f.result(timeout=300).num_rows
+        # scrape straight through a serve drain too
+        drainer = threading.Thread(
+            target=lambda: s.serve_server.drain(500), daemon=True)
+        drainer.start()
+        drainer.join(timeout=60)
+        time.sleep(0.2)
+        stop.set()
+        for t in threads:
+            t.join(timeout=30)
+        assert not failures, failures[:5]
+
+        code, body = _get(port, "/tenants")
+        assert code == 200
+        snap = json.loads(body)
+        assert snap["enabled"] and snap["tenant_count"] >= 1
+        # the exactness identity: per-tenant dispatches sum EXACTLY to
+        # the global counter delta — nothing dropped, nothing doubled
+        total = obsreg.get_registry().counter("kernel.dispatches")
+        assert _tenant_sum(snap, "kernel.dispatches") == \
+            pytest.approx(total - base)
+        assert total > base
+        # in-process queries bill the "(in-process)" session
+        assert any(r["session_id"] == "(in-process)"
+                   for r in snap["tenants"])
+
+        code, body = _get(port, "/slo")
+        assert code == 200
+        slo = json.loads(body)
+        lat = slo["histograms"]["slo.latencyMs"]
+        assert lat["count"] >= 8 and lat["p95"] is not None
+        assert "slo.queueWaitMs" in slo["histograms"]
+
+        code, body = _get(port, "/metrics")
+        samples = lint_exposition(body)     # strict: TYPE + buckets
+        assert "spark_rapids_tpu_slo_latencyMs_count" in samples
+        # the saturation gauge set (elastic-executor input signal)
+        assert "spark_rapids_tpu_sched_queueDepth" in samples
+        assert "spark_rapids_tpu_sched_admittedFraction" in samples
+        assert "spark_rapids_tpu_sched_runningFraction" in samples
+        # routes list advertises the new endpoints
+        code, body = _get(port, "/healthz")
+        assert {"/tenants", "/slo"} <= set(json.loads(body)["routes"])
+    finally:
+        stop.set()
+        parker.release.set()
+        s.remove_plan_listener(parker)
+        s.serve_server.shutdown()
+        s.obs_server.shutdown()
+
+
+# ---------------------------------------------------------------------------
+# drift sentinel
+# ---------------------------------------------------------------------------
+
+def test_sentinel_rules_grammar():
+    rules = obssent.parse_rules("latency:factor=3,sustain=1;slow")
+    assert set(rules) == {"latency", "slow"}
+    assert rules["latency"]["factor"] == 3.0
+    assert rules["slow"]["min"] == obssent.DEFAULT_RULES["slow"]["min"]
+    assert set(obssent.parse_rules("")) == set(obssent.DEFAULT_RULES)
+    with pytest.raises(ValueError):
+        obssent.parse_rules("nosuchrule")
+    with pytest.raises(ValueError):
+        obssent.parse_rules("latency:bogus=1")
+
+
+def test_sentinel_latency_episode_fires_once(tmp_path):
+    """Sustained p95 regression -> exactly ONE 'slo' bundle with
+    top-talker attribution; the healthy control windows breach
+    nothing."""
+    obsrec.configure(str(tmp_path / "bundles"))
+    breach_log = str(tmp_path / "breaches.jsonl")
+    sent = obssent.DriftSentinel(
+        interval_ms=50, rules="latency:factor=2,sustain=2,min=4",
+        jsonl_path=breach_log)
+    reg = obsreg.get_registry()
+
+    def window(ms, n=6):
+        # the hog keeps consuming every window, so the breach bundle's
+        # top-talker delta has something to attribute
+        acct.charge_tenant("sess-hog", "tpl", None,
+                           "kernel.dispatches", 50)
+        for _ in range(n):
+            reg.observe_bucket("slo.latencyMs", ms)
+        return sent.tick()
+
+    assert window(10.0) == []              # arming tick
+    for _ in range(3):                     # healthy baseline windows
+        assert window(10.0) == []
+    assert window(900.0) == []             # breach 1 of sustain=2
+    fired = window(900.0)                  # breach 2: episode opens
+    assert fired == ["latency"]
+    for _ in range(3):                     # episode stays open: silent
+        assert window(900.0) == []
+    assert reg.counter("obs.sentinel.breaches.latency") == 1
+    assert reg.counter("obs.sentinel.breaches") == 1
+    # one bundle, reason "slo", with the hog tenant attached
+    bundles = sorted(os.listdir(str(tmp_path / "bundles")))
+    slo_bundles = [b for b in bundles if "-slo-" in b]
+    assert len(slo_bundles) == 1
+    with open(os.path.join(str(tmp_path / "bundles"), slo_bundles[0],
+                           "sentinel.json")) as f:
+        payload = json.load(f)
+    assert payload["rules"] == ["latency"]
+    assert any(t["session_id"] == "sess-hog"
+               for t in payload["top_talkers"])
+    with open(breach_log) as f:
+        assert len(f.readlines()) == 1
+    # recovery closes the episode; a NEW sustained breach re-fires.
+    # (8ms shares the baseline's (5,10] bucket — 12ms would interp
+    # to a ~24ms p95 in the (10,25] bucket and stay in breach)
+    for _ in range(2):
+        assert window(8.0) == []
+    assert window(900.0) == []
+    assert window(900.0) == ["latency"]
+    assert reg.counter("obs.sentinel.breaches.latency") == 2
+
+
+def test_sentinel_control_run_never_breaches():
+    sent = obssent.DriftSentinel(interval_ms=50, rules="")
+    reg = obsreg.get_registry()
+    for _ in range(10):
+        for _ in range(6):
+            reg.observe_bucket("slo.latencyMs", 10.0)
+        reg.inc("kernel.cache.compiles", 1)
+        assert sent.tick() == []
+    assert reg.counter("obs.sentinel.breaches") == 0
+
+
+def test_sentinel_session_wiring():
+    s = TpuSparkSession({
+        "spark.rapids.tpu.sql.variableFloatAgg.enabled": True,
+        "spark.rapids.tpu.obs.sentinel.enabled": True,
+        "spark.rapids.tpu.obs.sentinel.intervalMs": 60,
+        "spark.rapids.tpu.obs.sentinel.rules": "latency",
+    })
+    try:
+        assert s.sentinel is not None
+        deadline = time.time() + 10
+        while s.sentinel.stats()["ticks"] == 0 and \
+                time.time() < deadline:
+            time.sleep(0.05)
+        assert s.sentinel.stats()["ticks"] >= 1
+    finally:
+        s.sentinel.stop()
+    # off by default: no watcher constructed
+    s2 = TpuSparkSession({
+        "spark.rapids.tpu.sql.variableFloatAgg.enabled": True})
+    assert s2.sentinel is None
