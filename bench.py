@@ -847,6 +847,118 @@ def _sharing_probe(root: str, n_clients: int = 8) -> dict:
     }
 
 
+def _join_probe(n: int = 24_000) -> dict:
+    """Out-of-core + skew-resilient joins (exec/join_partition.py,
+    exec/adaptive.py): a seeded skewed fact table (~60% of probe rows
+    on one key) shuffled-hash-joined against a dim table, skew
+    splitting off vs on, plus the same join unconstrained vs under a
+    build budget ~4x smaller than the build side.
+
+    The reduce-stage metric is the CRITICAL PATH — the largest single
+    reduce unit's probe bytes (with parallel reducers, the stage wall
+    is its largest bucket; splitting the hot bucket shrinks exactly
+    that).  The acceptance contract is >= 1.5x critical-path
+    improvement with splitting on, bit-identical results all four
+    ways, and the grace counters proving the out-of-core join really
+    spilled and re-streamed."""
+    import numpy as np
+    import pyarrow as pa
+
+    from spark_rapids_tpu import TpuSparkSession, col
+    from spark_rapids_tpu.exec.adaptive import TpuSkewJoinReaderExec
+    from spark_rapids_tpu.obs import registry as obsreg
+
+    rng = np.random.default_rng(19)
+    keys = np.where(rng.random(n) < 0.6, 7,
+                    rng.integers(0, 500, n)).astype(np.int64)
+    fact = pa.table({"k": keys, "v": rng.integers(0, 1000, n)})
+    dim = pa.table({"k2": np.arange(500, dtype=np.int64),
+                    "w": rng.integers(0, 1000, 500)})
+    base_conf = {
+        "spark.rapids.tpu.sql.variableFloatAgg.enabled": True,
+        "spark.rapids.tpu.sql.autoBroadcastJoinThreshold": -1,
+        "spark.rapids.tpu.sql.shuffle.partitions": 16,
+    }
+
+    def df_of(s):
+        f = s.create_dataframe(fact, num_partitions=4)
+        d = s.create_dataframe(dim, num_partitions=4)
+        return (f.join(d, col("k") == col("k2"))
+                 .select(col("k").alias("a"), col("v").alias("b"),
+                         col("w").alias("c")))
+
+    def run(extra: dict):
+        s = TpuSparkSession(dict(base_conf, **extra))
+        df_of(s).collect()                 # warm kernels off the clock
+        view = obsreg.get_registry().view()
+        t0 = time.perf_counter()
+        out = df_of(s).collect()
+        wall = time.perf_counter() - t0
+        return s, out.sort_by([("a", "ascending"), ("b", "ascending"),
+                               ("c", "ascending")]), wall, \
+            view.delta()["counters"]
+
+    # -- skew: off vs on, critical path from the planted reader state --
+    _s0, base, wall_off, _ = run({})
+    skew_conf = {"spark.rapids.tpu.sql.join.skew.enabled": True,
+                 "spark.rapids.tpu.sql.join.skew.minBucketBytes": 1024}
+    s_on, split, wall_on, d = run(skew_conf)
+    assert split.equals(base), "skew-split result diverges"
+    assert int(d.get("shuffle.skew.detected", 0)) >= 1, d
+
+    # re-plan once more to read the reader's plan: specs + per-bucket
+    # probe totals give the exact reduce units both ways
+    df = df_of(s_on)
+    phys = s_on._plan_physical(df.plan).plan
+    readers = []
+    phys.foreach(lambda nd: readers.append(nd)
+                 if isinstance(nd, TpuSkewJoinReaderExec) else None)
+    assert readers, "skew conf planted no TpuSkewJoinReaderExec"
+    rd = readers[0]
+    for it in phys.execute():            # populate the runtime state
+        for _ in it:
+            pass
+    st = rd.state
+    totals = st.outs[st.probe].totals
+    critical_off = max(totals)
+    per_unit = {p: float(tb) for p, tb in enumerate(totals)}
+    for sp in st.specs:
+        if sp[0] == "split":
+            per_unit[sp[1]] = totals[sp[1]] / float(sp[3])
+    critical_on = max(per_unit.values())
+    balance = critical_off / max(critical_on, 1.0)
+    assert balance >= 1.5, (
+        f"hot-bucket split only {balance:.2f}x reduce-stage "
+        f"critical-path improvement ({critical_off} -> "
+        f"{int(critical_on)} bytes)")
+
+    # -- out-of-core: unconstrained oracle vs ~4x-over-budget grace ----
+    _s2, oracle, wall_free, _ = run({
+        "spark.rapids.tpu.sql.join.buildSideBudgetBytes": -1})
+    budget = max(1024, int(dim.nbytes) // 16)  # per-partition build /4
+    _s3, grace, wall_oo, dg = run({
+        "spark.rapids.tpu.sql.join.buildSideBudgetBytes": budget})
+    assert grace.equals(oracle), "grace join result diverges"
+    assert int(dg.get("join.grace.activations", 0)) >= 1, dg
+    assert int(dg.get("join.grace.restreams", 0)) >= 1, dg
+    assert int(dg.get("join.grace.spilledBuildBytes", 0)) > 0, dg
+    oo_overhead = (wall_oo - wall_free) / max(wall_free, 1e-9)
+    return {
+        "rows": n,
+        "skew_off_qps": round(1.0 / max(wall_off, 1e-9), 3),
+        "skew_on_qps": round(1.0 / max(wall_on, 1e-9), 3),
+        "reduce_critical_path_improvement": round(balance, 2),
+        "hot_buckets": int(d.get("shuffle.skew.detected", 0)),
+        "splits": int(d.get("shuffle.skew.splits", 0)),
+        "oocore_overhead_pct": round(100 * oo_overhead, 1),
+        "oocore_budget_bytes": budget,
+        "grace_partitions": int(dg.get("join.grace.partitions", 0)),
+        "grace_spilled_bytes":
+            int(dg.get("join.grace.spilledBuildBytes", 0)),
+        "rows_match": True,
+    }
+
+
 def _incremental_probe(n: int = 160_000, files: int = 8,
                        append_pct: float = 0.02) -> dict:
     """Incremental result maintenance (exec/incremental.py): time a
@@ -1002,6 +1114,11 @@ def main() -> None:
         # sharing off vs on (>= 3x asserted inside, bit-identical)
         sharing = _sharing_probe(root, 8)
 
+        # out-of-core + skew-resilient joins: seeded skewed fact join,
+        # splitting off vs on (>= 1.5x reduce-stage critical path
+        # asserted inside) and unconstrained vs 4x-over-budget grace
+        join_probe = _join_probe(12_000 if smoke else 24_000)
+
         e2e = None
         if not smoke:
             try:
@@ -1057,6 +1174,7 @@ def main() -> None:
         "shuffle": shuffle_probe,
         "serve": serve,
         "sharing": sharing,
+        "join": join_probe,
         "e2e_tunnel_wall_s": round(e2e, 2) if e2e else None,
         "vs_baseline_e2e": round(cpu_time / e2e, 4) if e2e else None,
         "profile_out": profile_out,
@@ -1201,6 +1319,26 @@ def _write_trend_file(result: dict, n: int, files: int,
             "speedup": (result.get("sharing") or {}).get("speedup"),
             "dedup_hits":
                 (result.get("sharing") or {}).get("dedup_hits"),
+        },
+        # out-of-core + skew-resilient joins (ISSUE 19): skewed-vs-
+        # uniform reduce balance with hot-bucket splitting, and the
+        # grace join's overhead at ~4x over the build budget
+        "join": {
+            "skew_off_qps":
+                (result.get("join") or {}).get("skew_off_qps"),
+            "skew_on_qps":
+                (result.get("join") or {}).get("skew_on_qps"),
+            "reduce_critical_path_improvement":
+                (result.get("join") or {}).get(
+                    "reduce_critical_path_improvement"),
+            "hot_buckets": (result.get("join") or {}).get("hot_buckets"),
+            "splits": (result.get("join") or {}).get("splits"),
+            "oocore_overhead_pct":
+                (result.get("join") or {}).get("oocore_overhead_pct"),
+            "grace_partitions":
+                (result.get("join") or {}).get("grace_partitions"),
+            "grace_spilled_bytes":
+                (result.get("join") or {}).get("grace_spilled_bytes"),
         },
         "compile": _compile_totals(),
         "rows_match": result.get("rows_match"),

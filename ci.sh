@@ -1220,6 +1220,148 @@ echo "== pipelined fault smoke (drop / kill / fallback / cancel with the pipelin
 timeout 560 python -m pytest tests/test_shuffle_pipeline.py -q \
     -k "drop or kill or fallback or cancel"
 
+echo "== out-of-core join gate (4x over budget: bit-identical, spill counters > 0, zero leaked catalog entries) =="
+timeout 560 python - <<'EOF'
+# the unconstrained gather (buildSideBudgetBytes=-1) is the grace
+# join's correctness oracle (the sql.fusion.enabled pattern): one
+# seeded zipf join runs unconstrained, then under a budget ~4x smaller
+# than its build side — bit-identical after sort-normalization, the
+# grace counters proving the partitions really spilled and
+# re-streamed, and the spill catalog owning ZERO grace-priority
+# entries after the query drains (the leak contract).
+import os
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+import numpy as np
+import pyarrow as pa
+from spark_rapids_tpu import TpuSparkSession, col
+from spark_rapids_tpu.mem import spill as spillmod
+from spark_rapids_tpu.obs import registry as obsreg
+
+rng = np.random.default_rng(11)
+n = 8000
+z = np.minimum(rng.zipf(1.3, n), 400).astype(np.int64)
+fact = pa.table({"k": z, "v": rng.integers(0, 1000, n)})
+rk = np.minimum(rng.zipf(1.3, n // 2), 400).astype(np.int64)
+dim = pa.table({"k2": rk, "w": rng.integers(0, 1000, n // 2)})
+BASE = {
+    "spark.rapids.tpu.sql.variableFloatAgg.enabled": True,
+    "spark.rapids.tpu.sql.autoBroadcastJoinThreshold": -1,
+    "spark.rapids.tpu.sql.shuffle.partitions": 4,
+}
+
+def run(budget):
+    s = TpuSparkSession(dict(BASE, **{
+        "spark.rapids.tpu.sql.join.buildSideBudgetBytes": budget}))
+    f = s.create_dataframe(fact, num_partitions=4)
+    d = s.create_dataframe(dim, num_partitions=4)
+    out = (f.join(d, col("k") == col("k2"))
+           .select(col("k").alias("a"), col("v").alias("b"),
+                   col("w").alias("c")).collect())
+    return out.sort_by([("a", "ascending"), ("b", "ascending"),
+                        ("c", "ascending")])
+
+oracle = run(-1)
+assert not any(k.startswith("join.grace.") for k in
+               obsreg.get_registry().snapshot()["counters"]), \
+    "oracle run must not activate grace"
+budget = max(1024, int(dim.nbytes) // 16)
+grace = run(budget)
+d = obsreg.get_registry().snapshot()["counters"]
+assert d.get("join.grace.activations", 0) >= 1, d
+assert d.get("join.grace.restreams", 0) >= 1, d
+assert d.get("join.grace.spilledBuildBytes", 0) > 0, d
+assert grace.equals(oracle), \
+    "grace join diverges from the unconstrained oracle"
+cat = spillmod.get_catalog()
+with cat._lock:
+    leaked = [b for b in cat._buffers.values()
+              if b.priority == spillmod.GRACE_JOIN_PARTITION_PRIORITY]
+assert not leaked, f"{len(leaked)} grace catalog entries leaked"
+print(f"out-of-core gate OK: {grace.num_rows} rows bit-identical at "
+      f"budget {budget}B, {int(d['join.grace.restreams'])} re-streams, "
+      f"{int(d['join.grace.spilledBuildBytes'])}B spilled, 0 leaks")
+EOF
+
+echo "== skew-split gate (seeded hot key: bucket split before the fetch, reduce critical path shrinks >= 1.5x, bit-identical) =="
+timeout 560 python - <<'EOF'
+# a seeded 60%-hot-key probe against a uniform dim: with
+# join.skew.enabled the map-output tracker must split the hot bucket
+# BEFORE the reduce fetch (shuffle.skew.detected/splits counters), the
+# reduce-stage critical path — the largest single reduce unit's probe
+# bytes — must shrink >= 1.5x, and the result must be bit-identical to
+# the unsplit run.
+import os
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+import numpy as np
+import pyarrow as pa
+from spark_rapids_tpu import TpuSparkSession, col
+from spark_rapids_tpu.exec.adaptive import TpuSkewJoinReaderExec
+from spark_rapids_tpu.obs import registry as obsreg
+
+rng = np.random.default_rng(13)
+n = 16000
+keys = np.where(rng.random(n) < 0.6, 7,
+                rng.integers(0, 500, n)).astype(np.int64)
+fact = pa.table({"k": keys, "v": rng.integers(0, 1000, n)})
+dim = pa.table({"k2": np.arange(500, dtype=np.int64),
+                "w": rng.integers(0, 1000, 500)})
+BASE = {
+    "spark.rapids.tpu.sql.variableFloatAgg.enabled": True,
+    "spark.rapids.tpu.sql.autoBroadcastJoinThreshold": -1,
+    "spark.rapids.tpu.sql.shuffle.partitions": 16,
+}
+
+def df_of(s):
+    f = s.create_dataframe(fact, num_partitions=4)
+    d = s.create_dataframe(dim, num_partitions=4)
+    return (f.join(d, col("k") == col("k2"))
+            .select(col("k").alias("a"), col("v").alias("b"),
+                    col("w").alias("c")))
+
+def norm(t):
+    return t.sort_by([("a", "ascending"), ("b", "ascending"),
+                      ("c", "ascending")])
+
+base = norm(df_of(TpuSparkSession(BASE)).collect())
+assert not any(k.startswith("shuffle.skew.") for k in
+               obsreg.get_registry().snapshot()["counters"]), \
+    "skew-off run must not touch the skew plane"
+s = TpuSparkSession(dict(BASE, **{
+    "spark.rapids.tpu.sql.join.skew.enabled": True,
+    "spark.rapids.tpu.sql.join.skew.minBucketBytes": 1024}))
+df = df_of(s)
+phys = s._plan_physical(df.plan).plan
+readers = []
+phys.foreach(lambda nd: readers.append(nd)
+             if isinstance(nd, TpuSkewJoinReaderExec) else None)
+assert readers, "skew conf planted no TpuSkewJoinReaderExec"
+batches = []
+for it in phys.execute():
+    for b in it:
+        batches.append(b)
+d = obsreg.get_registry().snapshot()["counters"]
+assert d.get("shuffle.skew.detected", 0) >= 1, d
+assert d.get("shuffle.skew.splits", 0) >= 2, d
+st = readers[0].state
+totals = st.outs[st.probe].totals
+critical_off = max(totals)
+per_unit = {p: float(tb) for p, tb in enumerate(totals)}
+for sp in st.specs:
+    if sp[0] == "split":
+        per_unit[sp[1]] = totals[sp[1]] / float(sp[3])
+critical_on = max(per_unit.values())
+balance = critical_off / max(critical_on, 1.0)
+assert balance >= 1.5, (
+    f"reduce critical path only improved {balance:.2f}x "
+    f"({critical_off} -> {int(critical_on)} bytes)")
+split = norm(df_of(s).collect())
+assert split.equals(base), "skew-split result diverges"
+print(f"skew-split gate OK: {int(d['shuffle.skew.detected'])} hot "
+      f"bucket(s) -> {int(d['shuffle.skew.splits'])} sub-readers, "
+      f"critical path {balance:.2f}x better, "
+      f"{split.num_rows} rows bit-identical")
+EOF
+
 echo "== smoke bench (tracing enabled) =="
 python bench.py --smoke --profile-out=/tmp/bench_profile.json
 

@@ -480,6 +480,82 @@ SHUFFLE_PARTITIONS = conf(
     "Default number of shuffle partitions (spark.sql.shuffle.partitions "
     "analog).", int)
 
+JOIN_OOCORE_ENABLED = conf(
+    "spark.rapids.tpu.sql.join.oocore.enabled", True,
+    "Out-of-core grace hash join (exec/join_partition.py): when a "
+    "join's per-partition build side exceeds join.buildSideBudgetBytes "
+    "it is hash-partitioned (a different murmur seed per recursion "
+    "level, decorrelated from the exchange's bucketing) into 2^k grace "
+    "partitions together with its probe side; build partitions spill "
+    "through the device->host->disk tiers and each grace partition is "
+    "re-streamed and joined alone, recursing on a still-oversized "
+    "partition. Under-budget joins take the unpartitioned path "
+    "byte-for-byte; off reverts entirely (the one-knob revert).", bool)
+
+JOIN_BUILD_BUDGET = conf(
+    "spark.rapids.tpu.sql.join.buildSideBudgetBytes", 0,
+    "Per-partition build-side byte budget that activates the "
+    "out-of-core grace join. 0 (default) derives it from the admission "
+    "machinery: the scheduler memory budget (sched.memoryBudget or its "
+    "HBM-pool derivation) divided by sched.maxConcurrent — one "
+    "admitted query's fair share. -1 disables the budget check "
+    "entirely (build sides gather unconditionally, today's behavior).",
+    int)
+
+JOIN_OOCORE_PARTITIONS_LOG2 = conf(
+    "spark.rapids.tpu.sql.join.oocore.partitionsLog2", 0,
+    "Explicit grace fan-out exponent: partition both sides into 2^k "
+    "pieces when the build side exceeds the budget. 0 (default) picks "
+    "the smallest k whose expected per-partition build size fits the "
+    "budget, capped at 5 (32-way).", int)
+
+JOIN_OOCORE_MAX_RECURSION = conf(
+    "spark.rapids.tpu.sql.join.oocore.maxRecursion", 3,
+    "Recursion-depth bound for grace partitions that stay over budget "
+    "after a split (duplicate-heavy keys). At the bound — or as soon "
+    "as a level fails to shrink the partition (a single hot key cannot "
+    "hash-split) — the join falls back to streaming the probe side in "
+    "chunks against the oversized build partition, which is always "
+    "correct and always terminates.", int)
+
+JOIN_SKEW_ENABLED = conf(
+    "spark.rapids.tpu.sql.join.skew.enabled", False,
+    "Runtime hot-bucket splitting at the shuffle boundary: the "
+    "map-output tracker aggregates per-(map, reduce-bucket) sizes as "
+    "map tasks complete; a probe-side bucket projected over "
+    "join.skew.bucketFactor x the median splits into sub-readers over "
+    "disjoint map-output ranges BEFORE the reduce fetch, each joined "
+    "against a replica (or broadcast, when small) of the matching "
+    "build bucket — one hot key no longer serializes the reduce stage "
+    "on a single reducer. Takes over the skew half of the adaptive "
+    "reader for eligible joins; off (default) keeps today's plan "
+    "shape exactly.", bool)
+
+JOIN_SKEW_FACTOR = conf(
+    "spark.rapids.tpu.sql.join.skew.bucketFactor", 4.0,
+    "A reduce bucket is hot when its projected probe-side bytes exceed "
+    "this multiple of the median nonzero bucket size (and the "
+    "join.skew.minBucketBytes floor).", float)
+
+JOIN_SKEW_MIN_BUCKET_BYTES = conf(
+    "spark.rapids.tpu.sql.join.skew.minBucketBytes", 4 << 20,
+    "Absolute floor for hot-bucket detection: buckets under this many "
+    "bytes are never split regardless of the factor (splitting tiny "
+    "buckets buys scheduling overhead, not wall time).", int)
+
+JOIN_SKEW_MAX_SPLITS = conf(
+    "spark.rapids.tpu.sql.join.skew.maxSplits", 8,
+    "Upper bound on the sub-readers one hot bucket splits into (the "
+    "split count otherwise targets the median bucket size).", int)
+
+JOIN_SKEW_BROADCAST_THRESHOLD = conf(
+    "spark.rapids.tpu.sql.join.skew.broadcastThresholdBytes", 8 << 20,
+    "When the hot bucket's matching build-side bucket is under this "
+    "many bytes it is broadcast (one shared device batch reused by "
+    "every sub-join, zero copies); over it the bucket is still "
+    "replicated by reference but counted as a replication so the "
+    "memory cost is observable.", int)
+
 KERNEL_BACKEND = conf(
     "spark.rapids.tpu.kernel.backend", "pallas",
     "Kernel backend for the gather-bound decode/aggregate hot paths: "

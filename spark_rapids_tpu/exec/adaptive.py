@@ -347,21 +347,229 @@ class TpuAdaptiveJoinReaderExec(TpuExec):
         return [reader(sp[side]) for sp in self.state.specs]
 
 
+class _JoinSkewState:
+    """Shared coordinator for runtime hot-bucket splitting at the
+    map-output tracker (tentpole half of OptimizeSkewedJoin).
+
+    Unlike :class:`_JoinAdaptiveState` (which materializes every reduce
+    partition to size them), this consults the per-bucket byte totals
+    the exchanges' map-output trackers aggregated as maps completed —
+    blocks are still per-(map, bucket) when the split decision lands.
+    A probe-side bucket over ``skew.bucketFactor`` × the nonzero median
+    (and over ``minBucketBytes``) is split into S contiguous row chunks
+    while the matching build-side bucket is shared across all S
+    sub-partitions: counted as a broadcast when it is under
+    ``broadcastThresholdBytes``, a replication otherwise (in-process the
+    mechanism is one refcounted buffer either way; the distinction
+    tracks which plan Spark would have picked).  Non-hot buckets stream
+    straight from the held-back map output with no extra materialization.
+
+    The probe side is the one the join type lets us split without
+    duplicating preserved rows: the left for inner/left/semi/anti, the
+    right for how='right' (its unmatched rows land in exactly one
+    chunk; the replicated side only ever emits matched rows).  Full
+    outer is ineligible and falls through to the adaptive reader."""
+
+    def __init__(self, left: PhysicalPlan, right: PhysicalPlan, how: str,
+                 conf_obj):
+        import threading
+        self._lock = threading.Lock()
+        self.children = (left, right)
+        self.how = how
+        self.probe = 1 if how == "right" else 0
+        self.factor = float(conf_obj.get(cfg.JOIN_SKEW_FACTOR))
+        self.min_bucket_bytes = int(conf_obj.get(
+            cfg.JOIN_SKEW_MIN_BUCKET_BYTES))
+        self.max_splits = int(conf_obj.get(cfg.JOIN_SKEW_MAX_SPLITS))
+        self.broadcast_threshold = int(conf_obj.get(
+            cfg.JOIN_SKEW_BROADCAST_THRESHOLD))
+        self.specs: Optional[List[Tuple]] = None
+        self.outs: List = [None, None]       # per-side SkewMapOutput
+        # hot partition -> refcounted concat handle, per side
+        self.handles: List[Dict[int, object]] = [{}, {}]
+        self._refs: List[Dict[int, int]] = [{}, {}]
+        # hot partition -> [(row_start, row_count), ...] probe chunks
+        self.chunks: Dict[int, List[Tuple[int, int]]] = {}
+
+    # skew wraps in-process transports only, but fragment shipping may
+    # still pickle the plan: the lock and pulled buffers are process-local
+    def __getstate__(self):
+        d = dict(self.__dict__)
+        d.pop("_lock", None)
+        d["specs"] = None
+        d["outs"] = [None, None]
+        d["handles"] = [{}, {}]
+        d["_refs"] = [{}, {}]
+        d["chunks"] = {}
+        return d
+
+    def __setstate__(self, d):
+        import threading
+        self.__dict__.update(d)
+        self._lock = threading.Lock()
+
+    def ensure(self) -> None:
+        with self._lock:
+            if self.specs is not None:
+                return
+            self._plan_locked()
+
+    def _plan_locked(self) -> None:
+        from spark_rapids_tpu.mem.spill import register_or_hold
+        from spark_rapids_tpu.obs import registry as obsreg
+        from spark_rapids_tpu.obs.recorder import record_event
+        outs = [c.skew_map_side() for c in self.children]
+        self.outs = outs
+        probe, build = self.probe, 1 - self.probe
+        totals = outs[probe].totals
+        rows = outs[probe].row_counts
+        nonzero = sorted(s for s in totals if s > 0)
+        median = nonzero[len(nonzero) // 2] if nonzero else 0
+        cut = max(self.factor * median, self.min_bucket_bytes)
+        hot = {p for p, s in enumerate(totals)
+               if median and s > cut and rows[p] >= 2}
+        reg = obsreg.get_registry()
+        specs: List[Tuple] = []
+        for p in range(len(totals)):
+            if p not in hot:
+                specs.append(("plain", p))
+                continue
+            n_splits = min(self.max_splits, rows[p],
+                           max(2, -(-totals[p] // max(median, 1))))
+            chunk = max(1, -(-rows[p] // n_splits))
+            self.chunks[p] = [(st, min(chunk, rows[p] - st))
+                              for st in range(0, rows[p], chunk)]
+            n_splits = len(self.chunks[p])
+            for side in (probe, build):
+                bs = outs[side].fetch(p)
+                merged = bs[0] if len(bs) == 1 else \
+                    (concat_batches(bs) if bs else None)
+                if merged is not None:
+                    self.handles[side][p] = register_or_hold(merged)
+                self._refs[side][p] = n_splits
+            bcast = outs[build].totals[p] <= self.broadcast_threshold
+            reg.inc_many(
+                ("shuffle.skew.detected", 1),
+                ("shuffle.skew.splits", n_splits),
+                (("shuffle.skew.broadcasts" if bcast
+                  else "shuffle.skew.replications"), 1))
+            record_event("shuffle.bucketSplit", partition=p,
+                         bucket_bytes=int(totals[p]),
+                         median_bytes=int(median), splits=n_splits,
+                         build_bytes=int(outs[build].totals[p]),
+                         mode="broadcast" if bcast else "replicate")
+            specs.extend(("split", p, j, n_splits)
+                         for j in range(n_splits))
+        self.specs = specs
+
+    def release(self, side: int, p: int) -> None:
+        # sub-partition readers run concurrently under the task pool
+        with self._lock:
+            self._refs[side][p] -= 1
+            if self._refs[side][p] == 0:
+                h = self.handles[side].pop(p, None)
+                if h is not None:
+                    h.close()
+
+
+class TpuSkewJoinReaderExec(TpuExec):
+    """One join side's view of the skew-split fetch plan (the
+    CustomShuffleReader node of the skew half; shows in explain)."""
+
+    def __init__(self, state: _JoinSkewState, side: int,
+                 child: PhysicalPlan, conf_obj):
+        super().__init__()
+        self.state = state
+        self.side = side
+        self.children = (child,)
+        self.min_bucket = conf_obj.get(cfg.MIN_BUCKET_ROWS)
+        self._kernels = {}
+
+    @property
+    def schema(self) -> Schema:
+        return self.children[0].schema
+
+    def simple_string(self) -> str:
+        n = len(self.state.specs) if self.state.specs is not None else "?"
+        return f"TpuSkewJoinReaderExec(side={self.side}, specs={n})"
+
+    def _row_slice(self, batch: DeviceBatch, start: int, count: int
+                   ) -> DeviceBatch:
+        from spark_rapids_tpu.exec import kernel_cache as kc
+        cap = bucket_rows(count, self.min_bucket)
+        key = ("exch_slice", cap, batch.schema_key())
+        if key not in self._kernels:
+            self._kernels[key] = kc.get_kernel(
+                key, lambda: lambda b, o, c: slice_span(b, o, c, cap))
+        return self._kernels[key](batch,
+                                  jnp.asarray(start, dtype=jnp.int32),
+                                  jnp.asarray(count, dtype=jnp.int32))
+
+    def execute(self):
+        self.state.ensure()
+        side = self.side
+        is_probe = side == self.state.probe
+        out = self.state.outs[side]
+
+        def plain(p: int) -> Iterator[DeviceBatch]:
+            for b in out.fetch(p):
+                self.metrics.add_rows(b.num_rows)
+                self.metrics.add_batches()
+                yield b
+
+        def split(p: int, j: int) -> Iterator[DeviceBatch]:
+            try:
+                h = self.state.handles[side].get(p)
+                if h is None:
+                    return
+                whole = h.get()
+                if is_probe:
+                    start, count = self.state.chunks[p][j]
+                    if count <= 0:
+                        return
+                    with timed(self.metrics, "skew.split"):
+                        b = whole if count == int(whole.num_rows) \
+                            else self._row_slice(whole, start, count)
+                else:
+                    # replicated/broadcast build bucket: every probe
+                    # chunk joins against the same shared buffer
+                    b = whole
+                self.metrics.add_rows(b.num_rows)
+                self.metrics.add_batches()
+                yield b
+            finally:
+                self.state.release(side, p)
+
+        return [plain(sp[1]) if sp[0] == "plain" else split(sp[1], sp[2])
+                for sp in self.state.specs]
+
+
 def wrap_join_children(left: PhysicalPlan, right: PhysicalPlan, how: str,
                        conf_obj) -> Tuple[PhysicalPlan, PhysicalPlan]:
     """Wrap a shuffled join's two exchange children in coordinated
-    adaptive readers (no-op unless both children are hash exchanges and
-    adaptive is enabled)."""
+    adaptive (or skew-splitting) readers — no-op unless both children
+    are hash exchanges and the respective knob is enabled."""
     from spark_rapids_tpu.shuffle.exchange import (HashPartitioning,
                                                    TpuShuffleExchangeExec)
+    eligible = (isinstance(left, TpuShuffleExchangeExec)
+                and isinstance(right, TpuShuffleExchangeExec)
+                and isinstance(left.partitioning, HashPartitioning)
+                and isinstance(right.partitioning, HashPartitioning)
+                and left.partitioning.num_partitions
+                == right.partitioning.num_partitions)
+    # skew splitting takes over the skew half of the adaptive reader for
+    # eligible joins; ineligible shapes (full outer, shipped transports)
+    # fall through to the adaptive reader
+    if (eligible and conf_obj.get(cfg.JOIN_SKEW_ENABLED)
+            and how in ("inner", "left", "right", "semi", "anti")
+            and left.transport in ("local", "device")
+            and right.transport in ("local", "device")):
+        state = _JoinSkewState(left, right, how, conf_obj)
+        return (TpuSkewJoinReaderExec(state, 0, left, conf_obj),
+                TpuSkewJoinReaderExec(state, 1, right, conf_obj))
     if not conf_obj.get(cfg.ADAPTIVE_ENABLED):
         return left, right
-    if not (isinstance(left, TpuShuffleExchangeExec)
-            and isinstance(right, TpuShuffleExchangeExec)
-            and isinstance(left.partitioning, HashPartitioning)
-            and isinstance(right.partitioning, HashPartitioning)
-            and left.partitioning.num_partitions
-            == right.partitioning.num_partitions):
+    if not eligible:
         return left, right
     state = _JoinAdaptiveState(left, right, how, conf_obj)
     return (TpuAdaptiveJoinReaderExec(state, 0, left, conf_obj),

@@ -142,20 +142,30 @@ def donate_ok(child: PhysicalPlan, enabled: bool) -> bool:
         child = child.children[0]
     # shared-scan multicast (io/scan_share): a fused parquet scan with
     # sharing enabled may hand the SAME decoded batch to several
-    # queries and retains it in the multicast window — donating it
-    # would invalidate every other holder's copy.  The bar is static
-    # (this predicate runs BEFORE child.execute() opens any flight),
-    # so it keys on the scan's conf, not on live sharing state.
-    if type(child).__name__ == "TpuParquetScanExec":
-        from spark_rapids_tpu import config as cfg
-        try:
-            if (child.fmt == "parquet" and child.allow_fused and
-                    bool(child.conf.get(cfg.PARQUET_FUSED_DECODE)) and
-                    bool(child.conf.get(cfg.SCAN_SHARED_ENABLED))):
-                return False
-        except Exception:
-            return False
+    # queries and retains it in the multicast window — donating such a
+    # batch would invalidate every other holder's copy.  The bar used
+    # to be static (any shared-capable scan barred every batch); it is
+    # now per-batch: the scan stamps each yielded batch with its share
+    # entry and ``dispatch`` donates only after ``ScanShare.try_steal``
+    # proves this pipeline is the batch's sole holder — solo scans
+    # recover donation, genuinely multicast batches stay barred.
     return type(child).__name__ in _DONATE_SAFE_PRODUCERS
+
+
+def batch_donate_ok(b: DeviceBatch, reg) -> bool:
+    """Per-batch half of the donation decision (see donate_ok): True
+    unless ``b`` is a shared-scan batch some other query holds (or may
+    yet claim from the retention window)."""
+    e = getattr(b, "_scan_share_entry", None)
+    if e is None:
+        return True
+    from spark_rapids_tpu.io import scan_share
+    share = scan_share.peek_share()
+    if share is not None and share.try_steal(e):
+        reg.inc("fusion.donationsRecovered")
+        return True
+    reg.inc("fusion.donationsBarred")
+    return False
 
 
 def rows_detached(b: DeviceBatch) -> DeviceBatch:
@@ -215,15 +225,25 @@ def build_kernel(exec_obj, key, impl_factory, donate: bool):
 
 
 def dispatch(exec_obj, label: str, donate: bool, reg,
-             b: DeviceBatch, pid: int, offset: int):
+             b: DeviceBatch, pid: int, offset: int,
+             key=None, impl_factory=None):
     """One per-batch kernel launch with the donation calling convention
     (detached row count as a separate non-donated arg), the
     shape-erased ABI (kernel_abi.erase: canonical positional names,
     bucketed hints, capacity/width padded to tier — the caller restamps
     its real schema names after), and donation bookkeeping.  The erased
     view shares the input's buffers unless padding engaged, so donation
-    still releases the producer's HBM."""
+    still releases the producer's HBM.
+
+    When ``key``/``impl_factory`` are passed and the static decision
+    allowed donation, the refcount-aware shared-scan gate runs per
+    batch: a batch another query holds dispatches through the
+    non-donating twin kernel (one cache lookup), everything else keeps
+    its donation."""
     from spark_rapids_tpu.exec import kernel_abi
+    if donate and key is not None:
+        donate = batch_donate_ok(b, reg)
+        build_kernel(exec_obj, key, impl_factory, donate)
     eb = kernel_abi.erase(b)
     nr = b.num_rows
     with timed(exec_obj.metrics, label):
@@ -305,6 +325,12 @@ class TpuFusedStageExec(TpuExec):
                 with timed(self.metrics, "fused.passthrough"):
                     out = DeviceBatch(names, [b.columns[i] for i in ords],
                                       b.num_rows)
+                e = getattr(b, "_scan_share_entry", None)
+                if e is not None:
+                    # column buffers are forwarded by reference: the
+                    # share stamp must survive for the downstream
+                    # donation gate
+                    out._scan_share_entry = e
                 reg.inc("fusion.dispatchesSaved", saved)
                 self.metrics.add_batches()
                 self.metrics.add_rows(out.num_rows)
@@ -324,10 +350,10 @@ class TpuFusedStageExec(TpuExec):
         # instance (and through it the whole child plan subtree)
         shim = types.SimpleNamespace(out_exprs=self.out_exprs,
                                      condition=self.condition)
-        build_kernel(
-            self, ("fused_stage", kc.exprs_sig(self.out_exprs),
-                   kc.expr_sig(self.condition)),
-            lambda: functools.partial(type(self)._impl, shim), donate)
+        key = ("fused_stage", kc.exprs_sig(self.out_exprs),
+               kc.expr_sig(self.condition))
+        factory = lambda: functools.partial(type(self)._impl, shim)  # noqa: E731
+        build_kernel(self, key, factory, donate)
 
         names = self._schema.names
         # dispatches saved per batch: the chain would have cost one
@@ -338,7 +364,8 @@ class TpuFusedStageExec(TpuExec):
             reg = obsreg.get_registry()
             for b in it:
                 out = dispatch(self, "fused.eval", donate, reg,
-                               b, pid, 0)
+                               b, pid, 0, key=key,
+                               impl_factory=factory)
                 out = DeviceBatch(names, out.columns, out.num_rows)
                 if saved:
                     reg.inc("fusion.dispatchesSaved", saved)

@@ -157,9 +157,9 @@ class TpuProjectExec(TpuExec):
         # detach from self: the cached closure must not pin the exec
         # instance (and through it the whole child plan subtree)
         shim = types.SimpleNamespace(exprs=self.exprs)
-        fs.build_kernel(
-            self, ("project", kc.exprs_sig(self.exprs)),
-            lambda: functools.partial(type(self)._impl, shim), donate)
+        key = ("project", kc.exprs_sig(self.exprs))
+        factory = lambda: functools.partial(type(self)._impl, shim)  # noqa: E731
+        fs.build_kernel(self, key, factory, donate)
 
         needs_ctx = any(
             ir.collect(e, lambda n: isinstance(
@@ -177,7 +177,8 @@ class TpuProjectExec(TpuExec):
                     # (read BEFORE dispatch — donation consumes b)
                     nr = int(b.num_rows)
                 out = fs.dispatch(self, "project.eval", donate, reg,
-                                  b, pid, offset)
+                                  b, pid, offset, key=key,
+                                  impl_factory=factory)
                 out = DeviceBatch(names, out.columns, out.num_rows)
                 if needs_ctx:
                     offset += nr
@@ -237,9 +238,9 @@ class TpuFilterExec(TpuExec):
         donate = fs.donate_ok(self.children[0],
                               getattr(self, "_donate_enabled", False))
         shim = types.SimpleNamespace(condition=self.condition)
-        fs.build_kernel(
-            self, ("filter", kc.expr_sig(self.condition)),
-            lambda: functools.partial(type(self)._impl, shim), donate)
+        key = ("filter", kc.expr_sig(self.condition))
+        factory = lambda: functools.partial(type(self)._impl, shim)  # noqa: E731
+        fs.build_kernel(self, key, factory, donate)
 
         needs_ctx = bool(ir.collect(
             self.condition, lambda n: isinstance(
@@ -256,7 +257,8 @@ class TpuFilterExec(TpuExec):
                     # partition-dependent path, read BEFORE dispatch
                     nr = int(b.num_rows)
                 out = fs.dispatch(self, "filter.eval", donate, reg,
-                                  b, pid, offset)
+                                  b, pid, offset, key=key,
+                                  impl_factory=factory)
                 # the kernel's compact keeps the (ABI-erased) input
                 # names; restamp the real schema host-side
                 out = DeviceBatch(names, out.columns, out.num_rows)

@@ -802,6 +802,10 @@ class TpuShuffledHashJoinExec(_HashJoinBase):
         rits = self.children[1].execute()
         assert len(lits) == len(rits), \
             f"join children not co-partitioned: {len(lits)} vs {len(rits)}"
+        # planner-stamped out-of-core resolution (join_partition.
+        # resolve_oocore); unstamped execs — hand-built tests, the
+        # knob off — keep today's unconditional gather exactly
+        oocore = getattr(self, "_oocore", None)
 
         def run_streamed(lit, rit):
             """inner/left/semi/anti: build side coalesced once, STREAM
@@ -813,7 +817,18 @@ class TpuShuffledHashJoinExec(_HashJoinBase):
             coalesce goals keep probe batches per partition few.
             """
             from spark_rapids_tpu.mem.spill import register_or_hold
-            right = _gather_partition(rit)
+            if oocore is not None:
+                rbs = [b for b in rit if int(b.num_rows)]
+                build_bytes = sum(int(b.nbytes()) for b in rbs)
+                if rbs and build_bytes > oocore["budget"]:
+                    from spark_rapids_tpu.exec import join_partition
+                    yield from join_partition.grace_join(
+                        self, lit, rbs, build_bytes, oocore,
+                        build_is_left=False, gathered=False)
+                    return
+                right = concat_batches(rbs) if rbs else None
+            else:
+                right = _gather_partition(rit)
             if right is None:
                 if self.how == "inner":
                     # nothing can match — but the stream iterator must
@@ -834,8 +849,27 @@ class TpuShuffledHashJoinExec(_HashJoinBase):
         def run_gathered(lit, rit):
             """right/full: unmatched-build emission needs every stream
             batch, so the pair joins as two single batches."""
-            left = _gather_partition(lit)
-            right = _gather_partition(rit)
+            if oocore is not None:
+                lbs = [b for b in lit if int(b.num_rows)]
+                rbs = [b for b in rit if int(b.num_rows)]
+                # _join_pair's build-side resolution: right-outer
+                # builds on the LEFT (swapped-sides left outer), full
+                # builds on the right
+                build_is_left = self.how == "right"
+                bbs = lbs if build_is_left else rbs
+                build_bytes = sum(int(b.nbytes()) for b in bbs)
+                if bbs and build_bytes > oocore["budget"]:
+                    from spark_rapids_tpu.exec import join_partition
+                    yield from join_partition.grace_join(
+                        self, rbs if build_is_left else lbs, bbs,
+                        build_bytes, oocore,
+                        build_is_left=build_is_left, gathered=True)
+                    return
+                left = concat_batches(lbs) if lbs else None
+                right = concat_batches(rbs) if rbs else None
+            else:
+                left = _gather_partition(lit)
+                right = _gather_partition(rit)
             if left is None or right is None:
                 if left is not None or right is not None:
                     left = left if left is not None else \

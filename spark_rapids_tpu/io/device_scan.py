@@ -322,6 +322,10 @@ class TpuParquetScanExec(TpuExec):
                 raise
             share.publish(entry, out)
             share.release(entry)
+            # host-side share stamp (tree_flatten drops it): downstream
+            # donation checks the entry's live refcount at dispatch
+            # time (fused_stage dispatch -> ScanShare.try_steal)
+            out._scan_share_entry = entry
             return out
 
         def _resolve(marker, idx, path_rgs, pv) -> DeviceBatch:
@@ -342,6 +346,9 @@ class TpuParquetScanExec(TpuExec):
                     # query profile still shows the rows it consumed
                     self.metrics.num_output_rows += int(out.num_rows)
                     self.metrics.add_batches()
+                    # a joined claim's batch is multicast by definition
+                    # (entry.joined > 0 bars the donation steal)
+                    out._scan_share_entry = entry
                     return out
                 # the leader failed or abandoned its flight: decode
                 # locally under a FRESH claim, so a later subscriber

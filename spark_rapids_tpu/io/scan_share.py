@@ -189,6 +189,31 @@ class ScanShare:
             e.refs -= 1
             self._maybe_release_locked(e)
 
+    def try_steal(self, e: _Entry) -> bool:
+        """Withdraw a published batch from sharing so its ONLY holder
+        may donate its buffers (the refcount-aware donation bar:
+        exec/fused_stage dispatch calls this per batch at dispatch
+        time).  Succeeds only when no other query ever received the
+        batch (``joined == 0`` — a subscriber's pipeline may hold the
+        object long after its claim released) and no claim is live
+        (``refs == 0``): the entry leaves the window and the key
+        re-opens, so a later claimant simply leads a fresh decode.
+        False means the batch is (or was) multicast and must never be
+        donated."""
+        with self._lock:
+            if e.joined > 0 or e.refs > 0 or e.released \
+                    or not e.settled:
+                return False
+            if e.in_window:
+                self._window.pop(e.key, None)
+                self._window_total -= e.nbytes
+                e.in_window = False
+            # mark released WITHOUT dropping e.batch: the caller owns
+            # the only reference and is about to consume it
+            e.released = True
+        obsreg.get_registry().inc("scan.shared.donationSteals")
+        return True
+
     # -- retention window --------------------------------------------------
     def _evict_locked(self) -> None:
         while self._window_total > self._window_bytes and self._window:

@@ -51,6 +51,9 @@ ACTIVE_ON_DECK_PRIORITY = 1 << 40
 ACTIVE_BATCHING_PRIORITY = 1 << 30
 INPUT_FROM_SHUFFLE_PRIORITY = 0
 OUTPUT_FOR_SHUFFLE_PRIORITY = -(1 << 30)
+# grace-join build/probe partitions parked while another partition is
+# being joined: the coldest data in the process — they spill first
+GRACE_JOIN_PARTITION_PRIORITY = -(1 << 31)
 
 
 @dataclass
@@ -289,6 +292,16 @@ class BufferCatalog:
     def tier_of(self, buffer_id: int) -> StorageTier:
         return self._buffers[buffer_id].tier
 
+    def spill_buffer(self, buffer_id: int) -> int:
+        """Targeted spill of ONE registered buffer device->host
+        (grace-join partitions demote themselves while parked instead
+        of waiting for global pressure).  Returns device bytes freed
+        (0 when already off-device or closed)."""
+        buf = self._buffers.get(buffer_id)
+        if buf is None:
+            return 0
+        return self._spill_one(buf)
+
     def release(self, buffer_id: int) -> None:
         buf = self._buffers.pop(buffer_id, None)
         if buf is None:
@@ -357,6 +370,13 @@ class SpillableBatch:
     def tier(self) -> StorageTier:
         return self._catalog.tier_of(self._id)
 
+    def spill(self) -> int:
+        """Demote this batch off the device tier now (see
+        :meth:`BufferCatalog.spill_buffer`)."""
+        if self._closed:
+            return 0
+        return self._catalog.spill_buffer(self._id)
+
     def close(self) -> None:
         if not self._closed:
             self._catalog.release(self._id)
@@ -386,6 +406,13 @@ class PlainBatchHandle:
 
     def get(self) -> DeviceBatch:
         return self._batch
+
+    @property
+    def tier(self) -> StorageTier:
+        return StorageTier.DEVICE
+
+    def spill(self) -> int:
+        return 0  # nowhere to go with the catalog disabled
 
     def close(self) -> None:
         self._batch = None

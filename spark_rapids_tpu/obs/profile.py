@@ -31,13 +31,21 @@ from spark_rapids_tpu.obs import trace as obstrace
 # sections" whether or not the query touched them
 SECTIONS = ("scan", "shuffle", "semaphore", "spill", "pyworker",
             "fusion", "sched", "kernel", "compile", "incremental",
-            "sharing")
+            "sharing", "join")
 
 # work-sharing metrics routed into one "sharing" section even though
 # their names span three prefixes (sched.dedup.*, scan.shared.*,
 # serve.batch.*): the per-query work-sharing story — flights joined,
 # scan batches multicast, statements coalesced — reads as one section
 _SHARING_PREFIXES = ("sched.dedup.", "scan.shared.", "serve.batch.")
+
+# the out-of-core/skew join story reads as ONE section: grace-join
+# counters (join.grace.* — activations, partitions, restreams, spilled
+# build bytes, recursion depth) route by their natural prefix, and the
+# shuffle-boundary skew-split counters (shuffle.skew.* — hot buckets
+# detected, splits, broadcast-vs-replicate decisions) are pulled in
+# beside them so a skewed join's whole mitigation record sits together
+_JOIN_PREFIXES = ("join.", "shuffle.skew.")
 
 # compile-observatory metrics routed into the "compile" section even
 # though their names carry the kernel. prefix: the per-query compile
@@ -52,6 +60,8 @@ def _section_of(name: str) -> str:
         return "compile"
     if name.startswith(_SHARING_PREFIXES):
         return "sharing"
+    if name.startswith(_JOIN_PREFIXES):
+        return "join"
     return name.split(".", 1)[0]
 
 

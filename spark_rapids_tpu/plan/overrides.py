@@ -343,13 +343,19 @@ _register_window_rule()
 
 
 def _convert_join(n: cpux.CpuJoinExec, ch, conf):
+    from spark_rapids_tpu.exec.join_partition import resolve_oocore
     from spark_rapids_tpu.exec.tpu_join import (
         TpuBroadcastNestedLoopJoinExec, TpuShuffledHashJoinExec)
     if n.how == "cross":
         return TpuBroadcastNestedLoopJoinExec(ch[0], ch[1], n.condition,
                                               n.schema)
-    return TpuShuffledHashJoinExec(ch[0], ch[1], n.left_keys, n.right_keys,
-                                   n.how, n.condition, n.schema)
+    j = TpuShuffledHashJoinExec(ch[0], ch[1], n.left_keys, n.right_keys,
+                                n.how, n.condition, n.schema)
+    # out-of-core budget resolved at conversion time (conf is a session
+    # object; execute() must not depend on it) — None = today's
+    # unconditional gather
+    j._oocore = resolve_oocore(conf)
+    return j
 
 
 def _tag_join(n: cpux.CpuJoinExec, conf) -> List[str]:
@@ -385,10 +391,13 @@ def _register_join_strategy_rules():
         # AQE analog: both exchange children share one coordinated spec
         # list (coalesce + skew split) so co-partitioning survives
         from spark_rapids_tpu.exec.adaptive import wrap_join_children
+        from spark_rapids_tpu.exec.join_partition import resolve_oocore
         left, right = wrap_join_children(ch[0], ch[1], n.how, conf)
-        return TpuShuffledHashJoinExec(
+        j = TpuShuffledHashJoinExec(
             left, right, n.left_keys, n.right_keys, n.how, n.condition,
             n.schema)
+        j._oocore = resolve_oocore(conf)
+        return j
 
     register_exec_rule(cpux.CpuShuffledHashJoinExec, ExecRule(
         "ShuffledHashJoinExec",

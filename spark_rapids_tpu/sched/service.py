@@ -169,7 +169,8 @@ class _Flight:
 
     __slots__ = ("key", "leader", "exec_qid", "followers", "done",
                  "promoted_to", "service", "settled_state",
-                 "settled_result", "settled_error")
+                 "settled_result", "settled_error", "chunk_feed",
+                 "had_followers")
 
     def __init__(self, key, leader: QueryFuture, exec_qid: int,
                  service: "QueryService"):
@@ -183,6 +184,14 @@ class _Flight:
         self.settled_state: Optional[QueryState] = None
         self.settled_result = None
         self.settled_error: Optional[BaseException] = None
+        # serving-tier chunk relay (serve/server.py _ChunkFeed): the
+        # leader's streamer publishes encoded result chunks here so
+        # follower streams send per-chunk in leader lockstep instead of
+        # re-encoding after the whole flight settles.  had_followers
+        # stays True once anyone joined — the leader only pays the
+        # chunk-buffer memory when dedup actually occurred
+        self.chunk_feed = None
+        self.had_followers = False
 
 
 class QueryService:
@@ -250,7 +259,21 @@ class QueryService:
         depth = (int(conf.get(cfg.CONCURRENT_TPU_TASKS)) +
                  int(conf.get(cfg.SCAN_PREFETCH_DEPTH)))
         derived = int(conf.get(cfg.BATCH_SIZE_BYTES)) * max(1, depth)
+        # join shapes hold a gathered build side (plus the skew/grace
+        # planes' buffered buckets) on top of the streaming working set:
+        # pad the unrefined derivation per join so first-run admission
+        # doesn't overcommit — observed high-water refinement takes over
+        # from the second run of the shape
+        joins = self._count_joins(plan)
+        if joins:
+            derived *= 1 + min(joins, 3)
         return min(derived, self.memory_budget)
+
+    @classmethod
+    def _count_joins(cls, plan) -> int:
+        n = 1 if type(plan).__name__ in ("Join", "AsOfJoin") else 0
+        return n + sum(cls._count_joins(c)
+                       for c in getattr(plan, "children", ()))
 
     def _observe(self, plan, hwm_bytes: int) -> None:
         self.book.record(plan_shape_key(plan), hwm_bytes)
@@ -463,6 +486,7 @@ class QueryService:
                                               priority=priority,
                                               token=fut.token), fmeta)
             fl.followers.append(fut)
+            fl.had_followers = True
         obsreg.get_registry().inc("sched.dedup.hits")
         obsrec.record_event("sched.dedup.joined", query=fut.query_id,
                             leader=fut.dedup_of)

@@ -343,6 +343,68 @@ class _Inflight:
                 self._cv.wait(timeout=0.25)
 
 
+class _ChunkFeed:
+    """Per-flight relay of the leader stream's ENCODED result chunks.
+
+    Single-flight followers used to block on the whole flight result and
+    then re-chunk + re-encode it per follower; subscribing here instead
+    lets a follower send chunk N the moment the leader's streamer has
+    encoded it — follower first-chunk latency tracks the leader's (both
+    observe ``slo.firstChunkMs``) and the Arrow slice+encode work is
+    paid once per flight.  Payloads are buffered, so a follower joining
+    mid-stream replays from chunk 1; a leader stream that dies before
+    publishing everything aborts the feed and followers fall back to
+    whole-result streaming from their own (settled) futures, resuming
+    after the chunks already sent."""
+
+    _STALL_S = 5.0
+
+    def __init__(self):
+        self._cond = threading.Condition()
+        self._chunks: list = []
+        self._done = False
+        self._aborted = False
+        self.rows = 0
+        self.total = 0
+
+    def publish(self, payload) -> None:
+        with self._cond:
+            if self._done or self._aborted:
+                return
+            self._chunks.append(payload)
+            self._cond.notify_all()
+
+    def finish(self, rows: int, total: int) -> None:
+        with self._cond:
+            if self._aborted:
+                return
+            self.rows, self.total = int(rows), int(total)
+            self._done = True
+            self._cond.notify_all()
+
+    def abort(self) -> None:
+        """No-op after finish(): the leader's error-path net calls this
+        unconditionally."""
+        with self._cond:
+            if not self._done:
+                self._aborted = True
+            self._cond.notify_all()
+
+    def next(self, i: int) -> Tuple[str, Any]:
+        """('chunk', payload) for index ``i``, ('done', None) past the
+        final chunk, ('abort', None) on a dead or stalled leader."""
+        with self._cond:
+            self._cond.wait_for(
+                lambda: i < len(self._chunks) or self._done
+                or self._aborted,
+                timeout=self._STALL_S)
+            if i < len(self._chunks):
+                return "chunk", self._chunks[i]
+            if self._done:
+                return "done", None
+            return "abort", None
+
+
 class _Conn:
     __slots__ = ("sock", "wlock", "addr", "alive", "session",
                  "inflight", "closed_cleanly", "streamers", "_lock")
@@ -1124,6 +1186,13 @@ class ServeServer:
                 estimate_bytes=sess.estimate_bytes,
                 meta=meta)
             is_follower = getattr(fut, "dedup_of", None) is not None
+            if not is_follower and getattr(fut, "_flight", None) \
+                    is not None:
+                # flight leader: install the chunk relay BEFORE the
+                # streamer spawns, so every follower joining after this
+                # point finds it (a follower racing this install just
+                # takes the whole-result path — slower, never wrong)
+                fut._flight.chunk_feed = _ChunkFeed()
             if cacheable:
                 miss_name = ("serve.resultCacheDedupedFollowers"
                              if is_follower
@@ -1214,7 +1283,32 @@ class ServeServer:
                        stream_id: Optional[str] = None) -> None:
         fut = infl.future
         release = self._releaser(conn, sess, infl)
+        reg = obsreg.get_registry()
+        feed = fed = None
+        fl = getattr(fut, "_flight", None)
+        is_leader = fl is not None and \
+            getattr(fut, "dedup_of", None) is None
+        if fl is not None and not is_leader:
+            # follower: subscribe per-chunk to the leader stream's feed.
+            # Nothing is retained for resume while the feed streams — a
+            # disconnect mid-feed resolves as ResumeUnavailable and the
+            # client re-executes from last_seq (its sequence filter
+            # keeps the replay duplicate-free), trading the rare
+            # disconnect's cost for first-chunk latency that tracks the
+            # leader chunk-for-chunk
+            feed = fl.chunk_feed
         try:
+            if feed is not None:
+                reg.inc("serve.dedup.chunkFeedStreams")
+                status, fed = self._stream_from_feed(conn, infl, feed,
+                                                     fut.query_id,
+                                                     release)
+                if status in ("done", "dead"):
+                    return
+                # leader stream died or stalled before finishing: fall
+                # back to whole-result streaming off this follower's own
+                # future, resuming after the chunks already sent
+                reg.inc("serve.dedup.chunkFeedFallbacks")
             try:
                 table = fut.result()
             except BaseException as e:
@@ -1264,13 +1358,95 @@ class ServeServer:
             # of the stream finds the replay source already in place
             _retain_stream(sess.resume_token, stream_id, table=table)
             self._stream_table(conn, infl, table, cache_hit=False,
-                               query_id=fut.query_id, release=release)
+                               query_id=fut.query_id, release=release,
+                               after_seq=fed or 0,
+                               observe_first=not fed,
+                               feed=fl.chunk_feed if is_leader
+                               and fl.had_followers else None)
         finally:
+            if is_leader and fl.chunk_feed is not None:
+                # error-path net: no-op when the stream finished cleanly
+                fl.chunk_feed.abort()
             release()
+
+    def _stream_from_feed(self, conn: _Conn, infl: _Inflight,
+                          feed: _ChunkFeed, query_id, release
+                          ) -> Tuple[str, int]:
+        """Stream a follower's response straight off the leader flight's
+        encoded-chunk feed (sends END itself on success).  Returns
+        ``('done', n)`` after a complete stream, ``('dead', n)`` when
+        this follower's connection/credit is gone, ``('abort', n)`` when
+        the LEADER's stream died or stalled — the caller falls back to
+        whole-result streaming with ``after_seq=n``."""
+        from spark_rapids_tpu.obs import accounting as acct
+        reg = obsreg.get_registry()
+        sent = 0
+        try:
+            while True:
+                kind, payload = feed.next(sent)
+                if kind == "abort":
+                    return "abort", sent
+                if kind == "done":
+                    break
+                if not conn.alive or not infl.take_credit():
+                    if conn.alive:
+                        code = infl.abort_code or "StreamAborted"
+                        self._send_err(
+                            conn, infl.tag, code,
+                            "server draining; reconnect and resume"
+                            if code == "Draining"
+                            else "stream cancelled or stalled")
+                    return "dead", sent
+                wire.send_frame(conn.sock, conn.wlock, wire.CHUNK,
+                                infl.tag,
+                                wire.encode_chunk(sent + 1, payload),
+                                stall_s=self._write_stall_s)
+                sent += 1
+                if sent == 1:
+                    acct.observe_slo(
+                        "slo.firstChunkMs",
+                        (time.monotonic_ns() - infl.t0_ns) / 1e6,
+                        template=infl.template)
+                reg.inc_many(("serve.streamedBatches", 1),
+                             ("serve.dedup.fedChunks", 1))
+            if conn.alive and not infl.aborted:
+                release()
+                wire.send_frame(
+                    conn.sock, conn.wlock, wire.END, infl.tag,
+                    wire.encode_msg({"rows": feed.rows,
+                                     "chunks": sent,
+                                     "cache_hit": False,
+                                     "query_id": query_id,
+                                     "last_seq": feed.total}),
+                    stall_s=self._write_stall_s)
+                acct.observe_slo(
+                    "slo.latencyMs",
+                    (time.monotonic_ns() - infl.t0_ns) / 1e6,
+                    template=infl.template)
+            return "done", sent
+        except wire.ServeWireError as e:
+            if e.reason == "writeStall":
+                reg.inc("serve.wire.writeStalls")
+                obsrec.record_event("serve.writeStall",
+                                    client=conn.addr, tag=infl.tag)
+            infl.abort()
+            try:
+                conn.sock.close()
+            except OSError:
+                pass
+            return "dead", sent
+        except wire.WireError:
+            infl.abort()
+            try:
+                conn.sock.close()
+            except OSError:
+                pass
+            return "dead", sent
 
     def _stream_table(self, conn: _Conn, infl: _Inflight, table,
                       cache_hit: bool, query_id, release,
-                      after_seq: int = 0) -> None:
+                      after_seq: int = 0, observe_first: bool = True,
+                      feed: Optional[_ChunkFeed] = None) -> None:
         reg = obsreg.get_registry()
         chunks = wire.table_chunks(table, self._chunk_rows)
         total = max(1, math.ceil(max(1, table.num_rows)
@@ -1280,6 +1456,12 @@ class ServeServer:
         try:
             for payload in chunks:
                 seq += 1
+                if feed is not None:
+                    # relay the encoded payload to flight followers
+                    # BEFORE this stream's own credit/fault gates: a
+                    # stalled leader client must not hold back chunks
+                    # already paid for
+                    feed.publish(payload)
                 if seq <= after_seq:
                     # resume replay: chunks the client already acked
                     # are skipped, never re-sent — duplicate-freedom
@@ -1319,13 +1501,15 @@ class ServeServer:
                                 infl.tag, wire.encode_chunk(seq, payload),
                                 stall_s=self._write_stall_s)
                 sent += 1
-                if sent == 1:
+                if sent == 1 and observe_first:
                     from spark_rapids_tpu.obs import accounting as acct
                     acct.observe_slo(
                         "slo.firstChunkMs",
                         (time.monotonic_ns() - infl.t0_ns) / 1e6,
                         template=infl.template)
                 reg.inc("serve.streamedBatches")
+            if feed is not None:
+                feed.finish(table.num_rows, total)
             if conn.alive and not infl.aborted:
                 release()
                 wire.send_frame(

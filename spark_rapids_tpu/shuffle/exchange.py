@@ -299,6 +299,26 @@ class _MapOutputTracker:
         self._seen = set()
         self._open_execs = 0
         self._failed: Optional[BaseException] = None
+        self._bucket_bytes: Optional[List[int]] = None
+
+    def record_sizes(self, map_id: int, sizes: Sequence[int]) -> None:
+        """Aggregate one map task's per-reduce-bucket output sizes as it
+        completes (MapOutputStatistics accumulation,
+        MapOutputTracker.registerMapOutput analog).  The running totals
+        are what skew detection consults BEFORE any reducer fetches, so
+        a hot bucket can be split while its blocks are still per-map."""
+        with self._cond:
+            if self._bucket_bytes is None:
+                self._bucket_bytes = [0] * len(sizes)
+            for i, s in enumerate(sizes):
+                self._bucket_bytes[i] += int(s)
+
+    def bucket_totals(self) -> Optional[List[int]]:
+        """Aggregated per-reduce-bucket bytes across all completed maps
+        (None until the first map reports)."""
+        with self._cond:
+            return None if self._bucket_bytes is None \
+                else list(self._bucket_bytes)
 
     def open_exec(self) -> None:
         with self._cond:
@@ -417,9 +437,50 @@ class _MapOutputTracker:
             yield batch
 
 
-# ---------------------------------------------------------------------------
-# Execs
-# ---------------------------------------------------------------------------
+# per-exchange reduce-bucket size distribution (bytes) — byte-scaled
+# bounds, not the registry's default ms bounds
+_BUCKET_BYTE_BOUNDS = (1 << 10, 16 << 10, 64 << 10, 256 << 10, 1 << 20,
+                       4 << 20, 16 << 20, 64 << 20, 256 << 20)
+
+# skew_map_side() is idempotent under this module lock (a per-instance
+# lock would break plan-fragment pickling for the process transport)
+_SKEW_MAP_LOCK = threading.Lock()
+
+
+class SkewMapOutput:
+    """Map output held back at per-(map, reduce-bucket) granularity.
+
+    The default reduce path concats every bucket before the join sees
+    it; this keeps blocks separate through the map-output tracker so a
+    hot bucket can be re-planned (split / replicated) BEFORE the reduce
+    concat — the window Spark's AQE exploits via MapOutputStatistics
+    (OptimizeSkewedJoin reads them between stages).  ``totals`` /
+    ``row_counts`` come from the tracker's aggregation, not a second
+    pass over the blocks."""
+
+    def __init__(self, exchange: "TpuShuffleExchangeExec", host: bool,
+                 store: Optional[ShuffleBlockStore],
+                 dev: Optional[List[List[DeviceBatch]]],
+                 totals: List[int], row_counts: List[int]):
+        self.exchange = exchange
+        self.host = host
+        self.store = store
+        self.dev = dev
+        self.totals = totals
+        self.row_counts = row_counts
+
+    def fetch(self, pidx: int) -> List[DeviceBatch]:
+        """All of reduce bucket ``pidx`` as device batches (one uploaded
+        batch for the host plane, the raw slices for the device plane)."""
+        ex = self.exchange
+        if self.host:
+            tables = [t for t in self.store.fetch(pidx) if t.num_rows]
+            if not tables:
+                return []
+            t = concat_tables(tables, ex.schema)
+            with timed(ex.metrics, "exchange.upload"):
+                return [from_arrow(t, ex.min_bucket)]
+        return [s for s in self.dev[pidx] if int(s.num_rows)]
 
 class CpuShuffleExchangeExec(PhysicalPlan):
     """Host-side exchange (the stock-Spark role for fallback parity)."""
@@ -577,6 +638,7 @@ class TpuShuffleExchangeExec(TpuExec):
         self.codec_name = str(conf_obj.get(cfg.SHUFFLE_COMPRESSION_CODEC))
         self.min_bucket = conf_obj.get(cfg.MIN_BUCKET_ROWS)
         self._kernels: Dict[Any, Any] = {}
+        self._skew_out: Optional[SkewMapOutput] = None
 
     @property
     def schema(self) -> Schema:
@@ -699,6 +761,67 @@ class TpuShuffleExchangeExec(TpuExec):
             for b in it:
                 if int(b.num_rows):
                     yield b
+
+    def skew_map_side(self) -> SkewMapOutput:
+        """Run this exchange's map side WITHOUT the reduce-side concat:
+        the same device partition/slice pipeline as :meth:`execute`, but
+        blocks stay per (map, reduce-bucket) and every map's per-bucket
+        sizes aggregate at a map-output tracker as it completes.  The
+        skew join reader consults the tracker's totals to split hot
+        buckets before any reduce fetch.  Supported for the in-process
+        planes only ('local', 'device') — the shipped transports fall
+        back to the adaptive reader at planning time."""
+        with _SKEW_MAP_LOCK:
+            if self._skew_out is not None:
+                return self._skew_out
+            from spark_rapids_tpu.obs import registry as obsreg
+            n_parts = self.partitioning.num_partitions
+            host = self.transport == "local"
+            store = ShuffleBlockStore(self.codec_name) if host else None
+            dev: List[List[DeviceBatch]] = [[] for _ in range(n_parts)]
+            tracker = _MapOutputTracker()
+            tracker.open_exec()
+            rows = [0] * n_parts
+            m = 0
+            rows_seen = 0
+            for batch in self._input_batches():
+                _cancel.check_current()  # per-batch map-side checkpoint
+                reordered, counts = self._partition_one(batch, rows_seen)
+                rows_seen += int(batch.num_rows)
+                off = 0
+                sizes = [0] * n_parts
+                for pidx in range(n_parts):
+                    c = int(counts[pidx])
+                    if c:
+                        s = self._slice(reordered, off, c)
+                        if host:
+                            t = to_arrow(s)
+                            store.put(m, pidx, t)
+                            sizes[pidx] = int(t.nbytes)
+                        else:
+                            dev[pidx].append(s)
+                            # occupancy-scaled: bucket padding must not
+                            # mask (or fake) a size skew
+                            sizes[pidx] = int(
+                                s.nbytes() * (c / max(int(s.capacity),
+                                                      1)))
+                        rows[pidx] += c
+                    off += c
+                tracker.record_sizes(m, sizes)
+                tracker.map_done("local", m)
+                m += 1
+            tracker.exec_done("local", range(m))
+            totals = tracker.bucket_totals() or [0] * n_parts
+            reg = obsreg.get_registry()
+            for tb in totals:
+                reg.observe_bucket("shuffle.exchange.bucketBytes",
+                                   float(tb),
+                                   bounds=_BUCKET_BYTE_BOUNDS)
+            if store is not None:
+                self.metrics.extra["bytes_written"] = store.bytes_written
+            self._skew_out = SkewMapOutput(self, host, store, dev,
+                                           totals, rows)
+            return self._skew_out
 
     # two simulated executors: map task m lands on exec-(m % 2), so every
     # read exercises both the local-catalog and the remote-fetch paths

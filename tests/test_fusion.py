@@ -768,3 +768,113 @@ def test_fused_stage_explain_names_the_collapsed_execs():
     assert stages
     ss = stages[0].simple_string()
     assert "TpuProjectExec" in ss and "TpuFilterExec" in ss
+
+
+# ---------------------------------------------------------------------------
+# refcount-aware donation bar for shared scans (io/scan_share.try_steal)
+# ---------------------------------------------------------------------------
+
+def _scan_conf(**extra):
+    conf = {
+        "spark.rapids.tpu.sql.variableFloatAgg.enabled": True,
+        "spark.rapids.tpu.sched.dedup.enabled": False,
+        "spark.rapids.tpu.sql.scan.metadataCache.enabled": False,
+        "spark.rapids.tpu.memory.spill.enabled": False,
+    }
+    conf.update(extra)
+    return conf
+
+
+def _scan_query(tmp_path, session_conf):
+    import pyarrow.parquet as papq
+    p = str(tmp_path / "donation.parquet")
+    import os
+    if not os.path.exists(p):
+        # write ONCE per test: a rewrite bumps mtime_ns and the
+        # content-addressed share key would never match again
+        papq.write_table(pa.table(
+            {"a": list(range(4000)),
+             "b": [float(i % 97) for i in range(4000)]}), p)
+    s = TpuSparkSession(session_conf)
+    df = s.read.parquet(p)
+    return lambda: df.filter(col("a") > 10).select("a", "b").collect()
+
+
+def test_solo_shared_scan_recovers_donation(tmp_path):
+    """A scan batch nobody else holds must DONATE even with sharing
+    enabled: try_steal withdraws it from the retention window and the
+    donating kernel twin dispatches (the static bar used to forfeit
+    this donation for every shared-capable scan)."""
+    from spark_rapids_tpu.io import scan_share
+    q = _scan_query(tmp_path, _scan_conf())
+    base = q()                       # warm kernels; retains the batch
+    sh = scan_share.peek_share()
+    assert sh is not None
+    sh.clear()
+    view = obsreg.get_registry().view()
+    assert q().equals(base)
+    d = view.delta()["counters"]
+    assert d.get("fusion.donationsRecovered", 0) > 0, d
+    assert d.get("scan.shared.donationSteals", 0) > 0, d
+    assert d.get("fusion.donatedDispatches", 0) > 0, d
+    assert d.get("fusion.donationsBarred", 0) == 0, d
+    # the steal re-opened the key: nothing retained, nothing leaked
+    assert sh.stats()["window_entries"] == 0
+
+
+def test_shared_scan_with_live_subscriber_stays_barred(tmp_path):
+    """While another query's pipeline holds the multicast batch
+    (joined > 0), the per-batch gate must refuse donation — the
+    consumer dispatches through the non-donating kernel twin."""
+    from spark_rapids_tpu.io import scan_share
+    # populate the retention window WITHOUT stealing: donation off
+    q_off = _scan_query(tmp_path, _scan_conf(**{
+        "spark.rapids.tpu.sql.fusion.donateInputs": False}))
+    base = q_off()
+    sh = scan_share.peek_share()
+    assert sh is not None and sh.stats()["window_entries"] >= 1
+    # a second query "holds" the batch: a live join claim on the entry
+    key = next(iter(sh._window.keys()))
+    role, held = sh.claim(key)
+    assert role == "join"
+    try:
+        q_on = _scan_query(tmp_path, _scan_conf())
+        view = obsreg.get_registry().view()
+        assert q_on().equals(base)
+        d = view.delta()["counters"]
+        assert d.get("fusion.donationsBarred", 0) > 0, d
+        assert d.get("fusion.donationsRecovered", 0) == 0, d
+        assert d.get("fusion.donatedDispatches", 0) == 0, d
+        assert d.get("scan.shared.donationSteals", 0) == 0, d
+    finally:
+        sh.release(held)
+
+
+def test_try_steal_refuses_multicast_history():
+    """joined>0 bars the steal FOREVER: a subscriber's pipeline may
+    hold the batch object long after its claim released, so a batch
+    that was EVER multicast can never be donated."""
+    from spark_rapids_tpu.io.scan_share import ScanShare
+    sh = ScanShare(1 << 20)
+    role, e = sh.claim(("k",))
+    assert role == "lead"
+
+    class _B:
+        def nbytes(self):
+            return 1024
+    sh.publish(e, _B())
+    role2, e2 = sh.claim(("k",))
+    assert role2 == "join" and e2 is e
+    sh.release(e)
+    sh.release(e2)
+    # both claims released, but the join HAPPENED: steal must refuse
+    assert e.joined == 1 and e.refs == 0
+    assert sh.try_steal(e) is False
+    # never-joined entry steals fine once its claim drops
+    role3, e3 = sh.claim(("k2",))
+    sh.publish(e3, _B())
+    sh.release(e3)
+    assert sh.try_steal(e3) is True
+    # stolen == gone: the key re-opens for a fresh lead
+    role4, _e4 = sh.claim(("k2",))
+    assert role4 == "lead"

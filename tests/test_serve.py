@@ -550,3 +550,55 @@ def test_session_info_and_conf_overlay():
         rows = [r for r in s.scheduler.query_table()
                 if r.get("session_id") == c.session_id]
         assert rows and rows[0]["priority"] == 7
+
+
+def test_dedup_followers_stream_through_chunk_feed():
+    """Single-flight followers subscribe per-chunk to the leader's
+    stream (serve/server._ChunkFeed): a follower's first chunk goes
+    out as the leader produces it — not after the whole result
+    materializes — proven by the fedChunks counter; every follower's
+    bytes match the leader's."""
+    s = _session({"spark.rapids.tpu.serve.stream.chunkRows": 64,
+                  "spark.rapids.tpu.serve.cache.enabled": False})
+    _register_t(s)
+    sql = "select k, x from t order by x, k limit 300"
+    base = s.sql(sql).collect()
+    parker = Parker()
+    s.add_plan_listener(parker)
+    results = [None] * 3
+    errs = []
+
+    def run(i):
+        try:
+            with _client(s) as c:
+                results[i] = pa.concat_tables(list(c.sql_stream(sql)))
+        except Exception as exc:             # pragma: no cover
+            errs.append(exc)
+
+    try:
+        threads = [threading.Thread(target=run, args=(i,))
+                   for i in range(3)]
+        threads[0].start()
+        assert parker.parked.acquire(timeout=30)  # leader parked
+        for t in threads[1:]:
+            t.start()
+        reg = obsreg.get_registry()
+        deadline = time.time() + 30
+        while time.time() < deadline and \
+                reg.counter("sched.dedup.hits") < 2:
+            time.sleep(0.01)
+        assert reg.counter("sched.dedup.hits") >= 2
+        parker.release.set()
+        for t in threads:
+            t.join(timeout=60)
+    finally:
+        parker.release.set()
+    assert not errs, errs
+    for r in results:
+        assert r is not None and r.equals(base)
+    d = obsreg.get_registry().snapshot()["counters"]
+    # followers rode the leader's chunk feed (multi-chunk result: the
+    # per-chunk relay, not the whole-result fallback)
+    assert d.get("serve.dedup.chunkFeedStreams", 0) >= 2, d
+    assert d.get("serve.dedup.fedChunks", 0) >= 2, d
+    assert d.get("serve.dedup.chunkFeedFallbacks", 0) == 0, d

@@ -276,10 +276,14 @@ def _scan_session(extra=None):
     conf = {
         "spark.rapids.tpu.sql.variableFloatAgg.enabled": True,
         # isolate the scan layer: no scheduler dedup, no page-walk
-        # memoization, no admission-pressure wipe of the window
+        # memoization, no admission-pressure wipe of the window, no
+        # donation steal withdrawing a solo batch from the window
+        # before the second subscriber claims (test_fusion covers the
+        # donation/sharing interplay)
         "spark.rapids.tpu.sched.dedup.enabled": False,
         "spark.rapids.tpu.sql.scan.metadataCache.enabled": False,
         "spark.rapids.tpu.memory.spill.enabled": False,
+        "spark.rapids.tpu.sql.fusion.donateInputs": False,
     }
     conf.update(extra or {})
     return TpuSparkSession(conf)
