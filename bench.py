@@ -38,6 +38,7 @@ an incorrect pipeline fails the bench instead of reporting a number.
 
 import json
 import os
+import re
 import subprocess
 import sys
 import tempfile
@@ -797,6 +798,159 @@ def _serve_probe(root: str, n_clients: int) -> dict:
     }
 
 
+def _fleet_metrics_hist(obs_port: int, name: str):
+    """(bounds, counts) of one bucket histogram scraped from a
+    replica's /metrics exposition (cumulative le buckets
+    de-cumulated), or None when the replica never observed it."""
+    import urllib.request
+    prom = "spark_rapids_tpu_" + name.replace(".", "_")
+    with urllib.request.urlopen(
+            f"http://127.0.0.1:{obs_port}/metrics", timeout=10) as r:
+        text = r.read().decode()
+    rows = re.findall(
+        rf'^{re.escape(prom)}_bucket{{le="([^"]+)"}} (\d+)$',
+        text, re.MULTILINE)
+    bounds, counts, prev = [], [], 0
+    for le, cum in rows:
+        if le == "+Inf":
+            continue
+        bounds.append(float(le))
+        counts.append(int(cum) - prev)
+        prev = int(cum)
+    return (bounds, counts) if any(counts) else None
+
+
+def _fleet_probe(root: str, n_replicas: int) -> dict:
+    """--fleet=N: the horizontally scaled serve tier (fleet/).  A
+    cache-miss-heavy prepared-statement workload — result cache OFF on
+    every replica, so each execute runs the engine; one device slot
+    per replica (sched.maxConcurrent=1), the fleet's actual topology —
+    is pushed through the router against ONE replica and against N.
+    Reports the qps scaling and the fleet-merged serve-latency p95
+    from the replicas' SLO histograms.  N>=3 must clear >= 2x the
+    single-replica qps (the PR-20 acceptance floor: linear-ish scaling
+    minus router + placement overhead)."""
+    from spark_rapids_tpu.fleet.replica import FleetManager
+    from spark_rapids_tpu.fleet.router import FleetRouter
+    from spark_rapids_tpu.obs import registry as obsreg
+    from spark_rapids_tpu.serve.client import ServeClient
+
+    sql = ("select ss_item_sk, count(*) as cnt, sum(ss_quantity) as "
+           "qty from ss where ss_sales_price > :lo group by "
+           "ss_item_sk order by ss_item_sk")
+    n_clients = max(3, n_replicas)
+    repeats = 6
+    base_conf = {
+        "spark.rapids.tpu.sql.variableFloatAgg.enabled": True,
+        "spark.rapids.tpu.serve.resultCache.enabled": False,
+        "spark.rapids.tpu.serve.incremental.enabled": False,
+        "spark.rapids.tpu.sched.maxConcurrent": 1,
+    }
+    store_root = tempfile.mkdtemp(prefix="fleet_bench_")
+
+    def run_tier(n_reps: int) -> dict:
+        mgr = FleetManager(
+            os.path.join(store_root, f"store{n_reps}"),
+            base_conf=base_conf,
+            views={"ss": {"parquet": root}})
+        router = None
+        try:
+            reps = [mgr.spawn(name=f"r{i}") for i in range(n_reps)]
+            router = FleetRouter([r.endpoint() for r in reps],
+                                 health_poll_ms=60_000).start()
+            errors: list = []
+            handles: dict = {}
+            clients: dict = {}
+            # connect + prepare + ONE warm execute per client (pays
+            # the per-replica kernel compiles outside the timed
+            # window; each client keeps its fixed binding so the warm
+            # programs are exactly the timed ones)
+            for i in range(n_clients):
+                c = ServeClient("127.0.0.1", router.port)
+                clients[i] = c
+                handles[i] = c.prepare(sql, params={"lo": "double"})
+                handles[i].execute({"lo": 150.0 + 2.0 * i})
+
+            def run(idx: int) -> None:
+                try:
+                    for _ in range(repeats):
+                        handles[idx].execute({"lo": 150.0 + 2.0 * idx})
+                except Exception as e:
+                    errors.append(
+                        f"client {idx}: {type(e).__name__}: {e}")
+
+            # pre-scrape so the merged histogram covers only the
+            # timed window (warm-round compiles would dominate p95)
+            before = {r.name: _fleet_metrics_hist(r.obs_port,
+                                                  "slo.latencyMs")
+                      for r in reps}
+            t0 = time.perf_counter()
+            threads = [threading.Thread(target=run, args=(i,))
+                       for i in range(n_clients)]
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join(timeout=900)
+            wall = time.perf_counter() - t0
+            assert not errors, errors
+            hung = [t.name for t in threads if t.is_alive()]
+            assert not hung, f"fleet clients still running: {hung}"
+            for c in clients.values():
+                c.close()
+            # fleet-merged serve-latency histogram across replicas
+            bounds, counts = None, None
+            for r in reps:
+                h = _fleet_metrics_hist(r.obs_port, "slo.latencyMs")
+                if h is None:
+                    continue
+                cts = list(h[1])
+                pre = before.get(r.name)
+                if pre is not None:
+                    cts = [a - b for a, b in zip(cts, pre[1])]
+                if bounds is None:
+                    bounds, counts = h[0], cts
+                else:
+                    counts = [a + b for a, b in zip(counts, cts)]
+            p95 = (obsreg.bucket_quantile(bounds, counts, 0.95)
+                   if bounds else None)
+            total = n_clients * repeats
+            return {"replicas": n_reps, "queries": total,
+                    "wall_s": round(wall, 3),
+                    "qps": round(total / wall, 3),
+                    "latency_p95_ms":
+                        round(p95, 3) if p95 is not None else None}
+        finally:
+            if router is not None:
+                router.shutdown()
+            mgr.stop_all()
+
+    single = run_tier(1)
+    fleet = run_tier(n_replicas)
+    speedup = round(fleet["qps"] / single["qps"], 3)
+    # the scaling floor holds when every replica can own an execution
+    # slot ("device" = a CPU core in this emulation; a TPU per replica
+    # on real hardware).  On a box with fewer cores than replicas the
+    # fleet time-slices one core and no horizontal speedup is
+    # physically possible — report the numbers, skip the floor.
+    cores = os.cpu_count() or 1
+    gated = n_replicas >= 3 and cores >= n_replicas
+    if gated:
+        assert speedup >= 2.0, (
+            f"{n_replicas} replicas only {speedup}x the single-replica "
+            f"qps ({fleet['qps']} vs {single['qps']})")
+    return {
+        "n_replicas": n_replicas,
+        "n_clients": n_clients,
+        "cores": cores,
+        "single": single,
+        "fleet": fleet,
+        "speedup": speedup,
+        "speedup_floor": ("asserted >= 2.0" if gated else
+                          f"skipped: {cores} core(s) < {n_replicas} "
+                          f"replicas, no per-replica device"),
+    }
+
+
 def _sharing_probe(root: str, n_clients: int = 8) -> dict:
     """Multi-query work sharing (ISSUE 16): the SAME q6-class query
     submitted by N concurrent clients, with sharing off (every client
@@ -1055,6 +1209,7 @@ def main() -> None:
     profile_out = None
     concurrent_n = None    # None = flag absent; 0 = explicitly off
     serve_n = 0            # --serve=N remote clients; 0 = off
+    fleet_n = 0            # --fleet=N serve replicas; 0 = off
     trend_out = "BENCH_trend.json"   # --trend-out= overrides
     for a in sys.argv[1:]:
         if a.startswith("--profile-out="):
@@ -1063,6 +1218,8 @@ def main() -> None:
             concurrent_n = int(a.split("=", 1)[1])
         elif a.startswith("--serve="):
             serve_n = int(a.split("=", 1)[1])
+        elif a.startswith("--fleet="):
+            fleet_n = int(a.split("=", 1)[1])
         elif a.startswith("--trend-out="):
             trend_out = a.split("=", 1)[1]
     if smoke:
@@ -1109,6 +1266,13 @@ def main() -> None:
         serve = None
         if serve_n:
             serve = _serve_probe(root, serve_n)
+
+        # horizontally scaled serve tier: cache-miss-heavy prepared
+        # statements, 1 replica vs N through the router (>= 2x qps at
+        # N>=3 asserted inside)
+        fleet = None
+        if fleet_n:
+            fleet = _fleet_probe(root, fleet_n)
 
         # multi-query work sharing: 8 concurrent identical clients,
         # sharing off vs on (>= 3x asserted inside, bit-identical)
@@ -1173,6 +1337,7 @@ def main() -> None:
         "concurrent": concurrent,
         "shuffle": shuffle_probe,
         "serve": serve,
+        "fleet": fleet,
         "sharing": sharing,
         "join": join_probe,
         "e2e_tunnel_wall_s": round(e2e, 2) if e2e else None,
@@ -1309,6 +1474,21 @@ def _write_trend_file(result: dict, n: int, files: int,
             "speedup": (result.get("incremental") or {}).get("speedup"),
             "append_pct":
                 (result.get("incremental") or {}).get("append_pct"),
+        },
+        # horizontally scaled serve fleet (ISSUE 20): cache-miss-heavy
+        # prepared statements through the router, 1 replica vs N —
+        # qps scaling plus the fleet-merged serve-latency p95
+        "fleet": {
+            "n_replicas": (result.get("fleet") or {}).get("n_replicas"),
+            "single_qps": ((result.get("fleet") or {}).get("single")
+                           or {}).get("qps"),
+            "fleet_qps": ((result.get("fleet") or {}).get("fleet")
+                          or {}).get("qps"),
+            "speedup": (result.get("fleet") or {}).get("speedup"),
+            "single_p95_ms": ((result.get("fleet") or {}).get("single")
+                              or {}).get("latency_p95_ms"),
+            "fleet_p95_ms": ((result.get("fleet") or {}).get("fleet")
+                             or {}).get("latency_p95_ms"),
         },
         # multi-query work sharing (ISSUE 16): N concurrent identical
         # clients, sharing off vs on, and the single-flight collapse

@@ -1362,8 +1362,153 @@ print(f"skew-split gate OK: {int(d['shuffle.skew.detected'])} hot "
       f"{split.num_rows} rows bit-identical")
 EOF
 
+echo "== fleet chaos gate (router + 3 replicas, kill one mid-stream + drain another, bit-identical, warm replacement) =="
+timeout 420 python - <<'EOF'
+# the horizontally scaled serve tier (fleet/) under chaos: a router
+# fronting 3 subprocess replicas on a shared file store, 3 reconnecting
+# clients running repeated queries through it.  Mid-run one replica is
+# SIGKILLed (no goodbye — the router must fail the affected sessions
+# over: re-hello, prepared-statement replay, resume/re-execute with
+# duplicate chunks dropped at the router) and another is gracefully
+# drained (its leak audit must read zero and the router must stop
+# placing on it).  Every client result must be BIT-IDENTICAL to the
+# in-process oracle — equal row counts prove no chunk was duplicated
+# or lost across either failure.  Finally a replacement replica joins,
+# warms from the fleet's shared precompile corpus before its ready
+# handshake, and serves with ZERO fresh kernel compiles.
+import json, os, tempfile, threading, time, urllib.request
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+os.environ["SPARK_RAPIDS_TPU_CPU_COMPILE_CACHE"] = "1"
+import pyarrow as pa, pyarrow.parquet as papq
+from spark_rapids_tpu import TpuSparkSession
+from spark_rapids_tpu.fleet.replica import FleetManager
+from spark_rapids_tpu.fleet.router import FleetRouter
+from spark_rapids_tpu.obs import registry as obsreg
+from spark_rapids_tpu.serve.client import ServeClient
+
+td = tempfile.mkdtemp(prefix="fleet_gate_")
+data = os.path.join(td, "t.parquet")
+papq.write_table(pa.table(
+    {"k": pa.array([i % 7 for i in range(1800)], type=pa.int64()),
+     "x": [float(i % 50) for i in range(1800)],
+     "v": [f"s{i % 11}" for i in range(1800)]}), data)
+
+QUERIES = [
+    "select k, x, v from t order by k, x, v",
+    "select k, count(*) as c, sum(x) as sx from t "
+    "where x > 5.0 group by k order by k",
+    "select v, count(*) as c from t group by v order by v"]
+
+# in-process oracle (serve plane off: just the engine)
+s = TpuSparkSession(
+    {"spark.rapids.tpu.sql.variableFloatAgg.enabled": True})
+s.register_view("t", s.read.parquet(data))
+oracles = [s.sql(q).collect() for q in QUERIES]
+
+env = dict(os.environ)
+mgr = FleetManager(
+    os.path.join(td, "store"),
+    base_conf={
+        "spark.rapids.tpu.sql.variableFloatAgg.enabled": True,
+        "spark.rapids.tpu.sql.fusion.donateInputs": False,
+        "spark.rapids.tpu.sched.precompile.enabled": True,
+        "spark.rapids.tpu.sched.precompile.idleWaitMs": 0,
+        "spark.rapids.tpu.serve.stream.chunkRows": 120},
+    views={"t": {"parquet": data}}, env=env)
+reps = [mgr.spawn(name=f"r{i}") for i in range(3)]
+router = FleetRouter([r.endpoint() for r in reps],
+                     health_poll_ms=200).start()
+
+results, errors = {}, []
+ROUNDS = 4
+
+def chaos_client(i):
+    try:
+        with ServeClient("127.0.0.1", router.port, reconnect=True,
+                         max_reconnects=8, backoff_s=0.05) as c:
+            out = []
+            for _ in range(ROUNDS):
+                out.append(c.sql(QUERIES[i]))
+            results[i] = out
+    except Exception as e:
+        errors.append(f"client {i}: {type(e).__name__}: {e}")
+
+threads = [threading.Thread(target=chaos_client, args=(i,))
+           for i in range(3)]
+for t in threads:
+    t.start()
+
+# chaos: SIGKILL one replica while clients stream, then drain another
+time.sleep(1.0)
+reps[1].kill()
+time.sleep(1.5)
+drain_ack = reps[2].drain()
+
+for t in threads:
+    t.join(timeout=300)
+assert not errors, errors
+hung = [t.name for t in threads if t.is_alive()]
+assert not hung, f"clients still running: {hung}"
+for i, oracle in enumerate(oracles):
+    assert len(results[i]) == ROUNDS, f"client {i} lost rounds"
+    for got in results[i]:
+        assert got.num_rows == oracle.num_rows, (
+            f"client {i}: duplicate/missing chunks "
+            f"({got.num_rows} vs {oracle.num_rows} rows)")
+        assert got.equals(oracle), \
+            f"client {i} diverges under fleet chaos"
+
+# the drained replica's leak audit is all-zero
+assert drain_ack["drained"], drain_ack
+for k in ("connections", "streamer_threads", "inflight", "sessions"):
+    assert drain_ack["leaks"][k] == 0, drain_ack["leaks"]
+
+# the surviving replica's gauges settle to zero
+def healthz(port):
+    with urllib.request.urlopen(
+            f"http://127.0.0.1:{port}/healthz", timeout=5) as r:
+        return json.loads(r.read().decode())
+deadline = time.time() + 30
+while time.time() < deadline and healthz(reps[0].obs_port)["inflight"]:
+    time.sleep(0.1)
+hz = healthz(reps[0].obs_port)
+assert hz["state"] == "serving" and hz["inflight"] == 0, hz
+
+# replacement replica: joins off the shared corpus, serves the fleet's
+# queries with zero fresh compiles
+rnew = mgr.spawn(name="r3")
+assert rnew.ready_info["precompile"].get("warmed", 0) > 0, \
+    rnew.ready_info
+router.add_replica(rnew.endpoint())
+with ServeClient("127.0.0.1", rnew.serve_port) as c:
+    for i, q in enumerate(QUERIES):
+        got = c.sql(q)
+        assert got.equals(oracles[i]), f"replacement diverges on q{i}"
+with urllib.request.urlopen(
+        f"http://127.0.0.1:{rnew.obs_port}/compiles?n=0",
+        timeout=10) as r:
+    comp = json.loads(r.read().decode())
+fresh = {q: rec for q, rec in comp.get("per_query", {}).items()
+         if rec.get("kernels_compiled")}
+assert not fresh, f"replacement compiled fresh kernels: {fresh}"
+
+c0 = obsreg.get_registry().snapshot()["counters"]
+failovers = int(c0.get("fleet.router.failovers", 0))
+assert failovers >= 1, f"kill/drain never exercised failover: {c0}"
+
+router.shutdown()
+mgr.stop_all()
+print(f"fleet chaos gate OK: 3 clients x{ROUNDS} rounds bit-identical "
+      f"through SIGKILL + drain ({failovers} failovers, "
+      f"{int(c0.get('fleet.router.droppedDuplicateChunks', 0))} "
+      f"duplicate chunks dropped at the router), drained leak audit "
+      f"zero, replacement warmed "
+      f"{rnew.ready_info['precompile']['warmed']} programs, "
+      f"zero fresh compiles")
+EOF
+
 echo "== smoke bench (tracing enabled) =="
-python bench.py --smoke --profile-out=/tmp/bench_profile.json
+python bench.py --smoke --fleet=3 --profile-out=/tmp/bench_profile.json
 
 echo "== emitted profile/trace JSON validates =="
 python - <<'EOF'

@@ -16,8 +16,12 @@ import jax as _jax
 # SQL engines need exact int64/float64; enable before anything traces.
 _jax.config.update("jax_enable_x64", True)
 
-def _enable_compile_cache() -> None:
+def _enable_compile_cache(cache_dir=None) -> None:
     """Persistent XLA compilation cache for ACCELERATOR backends.
+
+    ``cache_dir`` overrides the location (the fleet's shared
+    compile-cache directory); otherwise SPARK_RAPIDS_TPU_COMPILE_CACHE
+    or the per-user default applies.
 
     The engine plans fresh exec trees per query and fresh processes per
     benchmark run; re-loading compiled executables beats recompiling
@@ -40,7 +44,7 @@ def _enable_compile_cache() -> None:
             # (tests/conftest.py) where JAX_PLATFORMS=cpu guarantees a
             # local compile.
             return
-        cache_dir = _os.environ.get(
+        cache_dir = cache_dir or _os.environ.get(
             "SPARK_RAPIDS_TPU_COMPILE_CACHE",
             _os.path.expanduser("~/.cache/spark_rapids_tpu/xla-"
                                 + platform))

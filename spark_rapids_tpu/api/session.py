@@ -38,8 +38,34 @@ class TpuSparkSession:
     def __init__(self, conf: Optional[Dict[str, Any]] = None):
         self.conf = RapidsTpuConf(conf)
         devmgr.initialize(self.conf.get(cfg.CONCURRENT_TPU_TASKS))
+        # -- fleet shared cache plane (fleet/store.py): attach BEFORE
+        # the compile cache and compile observatory configure, so the
+        # shared compile-cache directory and corpus directory take
+        # effect for this whole session.  fleet.enabled=false (default)
+        # leaves every downstream path byte-for-byte unchanged.
+        self._fleet_store = None
+        if self.conf.get(cfg.FLEET_ENABLED):
+            from spark_rapids_tpu.fleet.store import store_from_url
+            self._fleet_store = store_from_url(
+                str(self.conf.get(cfg.FLEET_STORE_URL) or ""))
+            from spark_rapids_tpu.serve import result_cache as _rc
+            _rc.configure_store(
+                self._fleet_store,
+                int(self.conf.get(cfg.FLEET_STORE_MAX_ENTRY_BYTES)))
+            corpus_dir = self._fleet_store.corpus_dir()
+            if corpus_dir and not str(self.conf.get(
+                    cfg.OBS_COMPILE_CORPUS_PATH) or ""):
+                # each replica appends its OWN corpus file under the
+                # shared corpus/ dir; a joining replica replays the
+                # whole directory (sched/precompile.py)
+                self.conf.set(
+                    cfg.OBS_COMPILE_CORPUS_PATH.key,
+                    os.path.join(corpus_dir,
+                                 f"corpus-{os.getpid()}.jsonl"))
         import spark_rapids_tpu as _pkg
-        _pkg._enable_compile_cache()  # accelerator backends only
+        _pkg._enable_compile_cache(  # accelerator backends only
+            self._fleet_store.compile_cache_dir()
+            if self._fleet_store is not None else None)
         from spark_rapids_tpu.mem import spill
         if self.conf.get(cfg.MEM_SPILL_ENABLED):
             spill.init_catalog(
@@ -166,6 +192,14 @@ class TpuSparkSession:
             corpus = (str(self.conf.get(
                 cfg.SCHED_PRECOMPILE_CORPUS_PATH) or "") or
                 str(self.conf.get(cfg.OBS_COMPILE_CORPUS_PATH) or ""))
+            if self._fleet_store is not None:
+                # warm-join: replay the WHOLE shared corpus directory
+                # (every replica's appends), not just this replica's
+                # own emission file
+                shared = self._fleet_store.corpus_dir()
+                if shared and not str(self.conf.get(
+                        cfg.SCHED_PRECOMPILE_CORPUS_PATH) or ""):
+                    corpus = shared
             self._precompile_service = PrecompileService(
                 self, corpus,
                 idle_wait_ms=int(self.conf.get(
@@ -614,6 +648,15 @@ class TpuSparkSession:
         """The flight recorder (obs/recorder.FlightRecorder) when
         ``obs.recorder.dir`` is set; None otherwise."""
         return self._recorder
+
+    @property
+    def fleet_store(self):
+        """The shared fleet store (fleet/store.FleetStore) when this
+        session was created with ``fleet.enabled=true``; None
+        otherwise.  The serve tier shares its statement registry and
+        result cache through it; the compile cache and precompile
+        corpus ride its directories when file-backed."""
+        return self._fleet_store
 
     @property
     def serve_server(self):

@@ -1094,6 +1094,76 @@ SERVE_FAULT_PLAN = conf(
     "corrupt, truncate, oversize, unknown, slow, fail). Empty "
     "disables injection.")
 
+SERVE_AUTH_TOKENS = conf(
+    "spark.rapids.tpu.serve.auth.tokens", "",
+    "Comma-separated bearer-token allowlist for the serving wire. "
+    "Non-empty: every hello must carry an 'auth_token' field matching "
+    "one entry or the connection is refused with a typed AuthFailed "
+    "ERR (counted in serve.authFailures) before a session exists. "
+    "Empty (default) disables auth — the pre-fleet loopback posture. "
+    "The token doubles as the tenant identity the fleet router keys "
+    "its per-tenant in-flight quotas on.")
+
+SERVE_TLS_CERT_FILE = conf(
+    "spark.rapids.tpu.serve.tls.certFile", "",
+    "PEM certificate chain for TLS on the serving listener. Set "
+    "together with serve.tls.keyFile to ssl-wrap every accepted "
+    "serving connection (clients connect with tls=True); empty "
+    "(default) serves plaintext. The obs HTTP endpoint is unaffected.")
+
+SERVE_TLS_KEY_FILE = conf(
+    "spark.rapids.tpu.serve.tls.keyFile", "",
+    "PEM private key matching serve.tls.certFile. Both must be set "
+    "for TLS to engage; setting exactly one raises at server start "
+    "rather than silently serving plaintext.")
+
+FLEET_ENABLED = conf(
+    "spark.rapids.tpu.fleet.enabled", False,
+    "Join this session to a serve fleet: attach the shared cache "
+    "plane at fleet.store.url — statement-template registry, "
+    "plan-digest result cache (stamp-validated at lookup, so "
+    "catalog/file drift invalidates fleet-wide), retained aggregate "
+    "partials, and the persistent XLA compile cache directory — so N "
+    "replicas behind fleet/router.py serve as one tier. Off "
+    "(default): no store is attached and the single-process serve "
+    "path is byte-for-byte unchanged.", bool)
+
+FLEET_STORE_URL = conf(
+    "spark.rapids.tpu.fleet.store.url", "",
+    "Shared-store endpoint for the fleet cache plane: "
+    "'file:///path/to/dir' (file-backed, the default deployment "
+    "shape — atomic temp+rename puts, safe for same-host and "
+    "shared-filesystem fleets) or 'tcp://host:port' (the in-memory "
+    "fleet.store.StoreServer, for tests). Required when "
+    "fleet.enabled=true.")
+
+FLEET_STORE_MAX_ENTRY_BYTES = conf(
+    "spark.rapids.tpu.fleet.store.maxEntryBytes", 64 << 20,
+    "Largest single result-cache entry published to the shared "
+    "store; bigger results stay local-only (they still serve local "
+    "hits). Bounds both the store's disk/memory footprint and the "
+    "deserialization cost a sibling replica pays on a shared hit.",
+    int)
+
+FLEET_ROUTER_HEALTH_POLL_MS = conf(
+    "spark.rapids.tpu.fleet.router.healthPollMs", 500,
+    "How often the fleet router polls each replica's /healthz and "
+    "/metrics: drain state takes a replica out of placement rotation "
+    "(satellite: /healthz now reports "
+    "{state: serving|draining|drained, inflight}), and the sched "
+    "queued/running gauges feed least-loaded placement for new "
+    "sessions.", int)
+
+FLEET_TENANT_MAX_INFLIGHT = conf(
+    "spark.rapids.tpu.fleet.tenant.maxInFlight", 0,
+    "Router-level cap on concurrently in-flight queries per tenant "
+    "identity (the auth token, or the client address when auth is "
+    "off) ACROSS the whole fleet — a layer above the per-session "
+    "serve.session.maxInFlight each replica enforces. Past it the "
+    "router answers the request with a typed TenantQuotaExceeded ERR "
+    "without forwarding. 0 (default) disables the fleet-level "
+    "quota.", int)
+
 OBS_COMPILE_ENABLED = conf(
     "spark.rapids.tpu.obs.compile.enabled", True,
     "Record a CompileEvent for every first (kernel, arg-shape) call "
@@ -1157,8 +1227,11 @@ SCHED_PRECOMPILE_ENABLED = conf(
 SCHED_PRECOMPILE_CORPUS_PATH = conf(
     "spark.rapids.tpu.sched.precompile.corpusPath", "",
     "Corpus JSONL the precompile service replays (a file written by a "
-    "previous process via obs.compile.corpusPath). Empty: falls back "
-    "to this session's obs.compile.corpusPath.")
+    "previous process via obs.compile.corpusPath). A DIRECTORY "
+    "replays every *.jsonl inside it — the fleet warm-join shape, "
+    "where each replica appends its own corpus file under the shared "
+    "store's corpus/ directory and a joining replica replays them "
+    "all. Empty: falls back to this session's obs.compile.corpusPath.")
 
 SCHED_PRECOMPILE_IDLE_WAIT_MS = conf(
     "spark.rapids.tpu.sched.precompile.idleWaitMs", 25,

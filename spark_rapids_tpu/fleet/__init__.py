@@ -1,0 +1,43 @@
+"""Horizontally scaled serve fleet (ROADMAP item 1).
+
+Three planes turn the single-process serve tier into N replicas:
+
+  * ``fleet/store.py`` — the shared cache plane: a pluggable external
+    store (file-backed default, same-host TCP store for tests) through
+    which replicas share the statement-template registry, the
+    plan-digest result cache (including retained aggregate partials,
+    stamp-validated at lookup), the persistent XLA compile-cache
+    directory, and the precompile corpus.
+  * ``fleet/router.py`` — the wire-protocol front door: session
+    affinity by resume token, least-loaded placement from replica
+    sched gauges, token auth, per-tenant quotas, and transparent
+    failover (resume-token re-hello + prepared-statement replay +
+    ``resume_stream`` seq filtering — zero duplicate chunks).
+  * ``fleet/replica.py`` — replica lifecycle: subprocess
+    spawn/join/drain, where a joining replica warms from the shared
+    precompile corpus before serving and scale-down rides
+    ``ServeServer.drain()``.
+
+``fleet.enabled=false`` (the default) leaves the single-process serve
+path byte-for-byte unchanged — no store attaches, no hook fires.
+
+See docs/fleet.md.
+"""
+
+from spark_rapids_tpu.fleet.store import (  # noqa: F401
+    FileStore,
+    FleetStore,
+    StoreServer,
+    TcpStore,
+    store_from_url,
+)
+from spark_rapids_tpu.fleet.router import (  # noqa: F401
+    FleetRouter,
+    ReplicaEndpoint,
+    RouterError,
+)
+from spark_rapids_tpu.fleet.replica import (  # noqa: F401
+    FleetManager,
+    ReplicaError,
+    ReplicaProcess,
+)

@@ -1,0 +1,330 @@
+"""Replica lifecycle: spawn, warm-join, drain, and retire serve
+replicas as child processes.
+
+A replica is one engine process running the serving stack (ServeServer
++ obs HTTP server) against the fleet's shared store.  This module has
+two halves:
+
+* the **child entry point** (``python -m spark_rapids_tpu.fleet.
+  replica``): reads a JSON config line from stdin, builds a
+  ``TpuSparkSession`` with serving + observability forced on (ports
+  ephemeral unless pinned), and — the warm-join contract — BLOCKS the
+  ready handshake until the background precompile replay of the shared
+  corpus finishes, so by the time the router can see the replica its
+  persistent XLA cache already holds every program the fleet has ever
+  compiled and its first queries pay zero fresh compiles.  It then
+  prints one ready JSON line on stdout and serves until a ``drain`` /
+  ``stop`` command arrives on stdin (or stdin closes: the parent died,
+  exit).  stdout carries ONLY protocol lines; everything chatty goes
+  to stderr.
+
+* the **parent-side handles** (``ReplicaProcess``, ``FleetManager``):
+  spawn children, parse the ready handshake, expose
+  ``ReplicaEndpoint``s for the router, and drive scale-down — drain
+  rides ``ServeServer.drain()`` in the child (phase 1 stop intake,
+  phase 2 bounded wait, phase 3 sever + leak audit), and ``kill()``
+  is the chaos path (SIGKILL, no goodbye).
+
+Scale-out is then: ``mgr.spawn()`` → child warms from the shared
+corpus → ready line → ``router.add_replica(proc.endpoint())``.
+Scale-in: ``proc.drain()`` → router health poll sees ``draining`` and
+stops placing → ``proc.stop()``.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import signal
+import subprocess
+import sys
+import threading
+import time
+from typing import Any, Dict, List, Optional
+
+from spark_rapids_tpu.fleet.router import ReplicaEndpoint
+
+_READY_TIMEOUT_S = 180.0
+
+
+class ReplicaError(RuntimeError):
+    pass
+
+
+class ReplicaProcess:
+    """Parent-side handle on one spawned replica child."""
+
+    def __init__(self, proc: subprocess.Popen, host: str,
+                 name: str):
+        self.proc = proc
+        self.host = host
+        self.name = name
+        self.serve_port: Optional[int] = None
+        self.obs_port: Optional[int] = None
+        self.ready_info: Dict[str, Any] = {}
+        self._stdin_lock = threading.Lock()
+
+    # -- handshake ---------------------------------------------------------
+    def wait_ready(self, timeout_s: float = _READY_TIMEOUT_S
+                   ) -> Dict[str, Any]:
+        """Block until the child prints its ready line (which it only
+        does AFTER the warm-join precompile replay finished)."""
+        deadline = time.monotonic() + timeout_s
+        while time.monotonic() < deadline:
+            if self.proc.poll() is not None:
+                raise ReplicaError(
+                    f"replica {self.name} exited rc={self.proc.returncode} "
+                    f"before ready")
+            line = self.proc.stdout.readline()
+            if not line:
+                raise ReplicaError(
+                    f"replica {self.name} closed stdout before ready")
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                msg = json.loads(line)
+            except ValueError:
+                continue                   # stray non-protocol output
+            if msg.get("ready"):
+                self.serve_port = int(msg["serve_port"])
+                self.obs_port = int(msg["obs_port"])
+                self.ready_info = msg
+                return msg
+            if msg.get("fatal"):
+                raise ReplicaError(
+                    f"replica {self.name} failed to start: "
+                    f"{msg.get('error')}")
+        raise ReplicaError(f"replica {self.name} ready handshake "
+                           f"timed out after {timeout_s:.0f}s")
+
+    def endpoint(self) -> ReplicaEndpoint:
+        if self.serve_port is None:
+            raise ReplicaError(f"replica {self.name} is not ready")
+        return ReplicaEndpoint(self.host, self.serve_port,
+                               self.obs_port, name=self.name)
+
+    # -- commands ----------------------------------------------------------
+    def _command(self, cmd: str,
+                 timeout_s: float = 60.0) -> Dict[str, Any]:
+        with self._stdin_lock:
+            try:
+                self.proc.stdin.write(cmd + "\n")
+                self.proc.stdin.flush()
+            except (OSError, ValueError) as e:
+                raise ReplicaError(
+                    f"replica {self.name} stdin closed: {e}") from e
+        deadline = time.monotonic() + timeout_s
+        while time.monotonic() < deadline:
+            line = self.proc.stdout.readline()
+            if not line:
+                raise ReplicaError(
+                    f"replica {self.name} died during {cmd!r}")
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                msg = json.loads(line)
+            except ValueError:
+                continue
+            if msg.get("cmd") == cmd:
+                return msg
+        raise ReplicaError(f"replica {self.name}: {cmd!r} timed out")
+
+    def drain(self, deadline_ms: Optional[int] = None,
+              timeout_s: float = 120.0) -> Dict[str, Any]:
+        """Graceful scale-down: the child runs ServeServer.drain()
+        and answers with the leak audit."""
+        cmd = "drain" if deadline_ms is None else f"drain {deadline_ms}"
+        return self._command(cmd, timeout_s)
+
+    def stop(self, timeout_s: float = 30.0) -> int:
+        """Clean shutdown; escalates to kill on timeout."""
+        try:
+            with self._stdin_lock:
+                self.proc.stdin.write("stop\n")
+                self.proc.stdin.flush()
+        except (OSError, ValueError):
+            pass
+        try:
+            return self.proc.wait(timeout=timeout_s)
+        except subprocess.TimeoutExpired:
+            self.kill()
+            return self.proc.wait(timeout=10)
+
+    def kill(self) -> None:
+        """Chaos path: SIGKILL, no drain, no goodbye."""
+        try:
+            self.proc.send_signal(signal.SIGKILL)
+        except OSError:
+            pass
+
+    def alive(self) -> bool:
+        return self.proc.poll() is None
+
+
+class FleetManager:
+    """Spawns and tracks replica children sharing one fleet store."""
+
+    def __init__(self, store_url: str,
+                 base_conf: Optional[Dict[str, Any]] = None,
+                 host: str = "127.0.0.1",
+                 views: Optional[Dict[str, Dict[str, str]]] = None,
+                 env: Optional[Dict[str, str]] = None):
+        self.store_url = str(store_url)
+        self.base_conf = dict(base_conf or {})
+        self.host = host
+        self.views = dict(views or {})
+        self.env = env
+        self.replicas: List[ReplicaProcess] = []
+        self._seq = 0
+
+    def spawn(self, conf_overrides: Optional[Dict[str, Any]] = None,
+              wait_ready: bool = True,
+              ready_timeout_s: float = _READY_TIMEOUT_S,
+              name: Optional[str] = None) -> ReplicaProcess:
+        self._seq += 1
+        name = name or f"replica-{self._seq}"
+        conf = dict(self.base_conf)
+        conf.update(conf_overrides or {})
+        conf.setdefault("spark.rapids.tpu.fleet.enabled", True)
+        conf.setdefault("spark.rapids.tpu.fleet.store.url",
+                        self.store_url)
+        config = {"conf": conf, "host": self.host, "name": name,
+                  "views": self.views}
+        env = dict(os.environ if self.env is None else self.env)
+        proc = subprocess.Popen(
+            # -c instead of -m: the fleet package imports this module,
+            # so runpy would warn about re-executing an imported module
+            [sys.executable, "-c",
+             "from spark_rapids_tpu.fleet import replica; "
+             "raise SystemExit(replica.main())"],
+            stdin=subprocess.PIPE, stdout=subprocess.PIPE,
+            stderr=subprocess.DEVNULL if env.pop(
+                "SPARK_RAPIDS_TPU_REPLICA_QUIET", "") else None,
+            text=True, env=env)
+        proc.stdin.write(json.dumps(config) + "\n")
+        proc.stdin.flush()
+        handle = ReplicaProcess(proc, self.host, name)
+        self.replicas.append(handle)
+        if wait_ready:
+            handle.wait_ready(ready_timeout_s)
+        return handle
+
+    def endpoints(self) -> List[ReplicaEndpoint]:
+        return [r.endpoint() for r in self.replicas if r.alive()
+                and r.serve_port is not None]
+
+    def stop_all(self) -> None:
+        for r in self.replicas:
+            if r.alive():
+                try:
+                    r.stop(timeout_s=15)
+                except ReplicaError:
+                    r.kill()
+
+
+# ---------------------------------------------------------------------------
+# child entry point
+# ---------------------------------------------------------------------------
+
+def _emit(obj: Dict[str, Any]) -> None:
+    sys.stdout.write(json.dumps(obj, default=str) + "\n")
+    sys.stdout.flush()
+
+
+def _serve_forever(session, config: Dict[str, Any]) -> None:
+    """Command loop on stdin until stop/EOF."""
+    srv = session.serve_server
+    for line in sys.stdin:
+        line = line.strip()
+        if not line:
+            continue
+        parts = line.split()
+        cmd = parts[0]
+        if cmd == "drain":
+            deadline_ms = int(parts[1]) if len(parts) > 1 else None
+            ack = srv.drain(deadline_ms=deadline_ms)
+            _emit({"cmd": line, "drained": bool(ack.get("drained")),
+                   "cancelled": ack.get("cancelled"),
+                   "leaks": srv.leak_stats()})
+        elif cmd == "ping":
+            _emit({"cmd": line, "ok": True,
+                   "state": srv.state(),
+                   "inflight": srv.inflight_count()})
+        elif cmd == "stop":
+            _emit({"cmd": line, "stopping": True})
+            return
+        else:
+            _emit({"cmd": line, "error": f"unknown command {cmd!r}"})
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    line = sys.stdin.readline()
+    try:
+        config = json.loads(line) if line.strip() else {}
+    except ValueError:
+        _emit({"fatal": True, "error": "config line is not JSON"})
+        return 2
+    conf = dict(config.get("conf") or {})
+    host = str(config.get("host") or "127.0.0.1")
+    # a replica IS the serving stack: force both planes on, ports
+    # ephemeral unless the config pins them
+    conf.setdefault("spark.rapids.tpu.serve.enabled", True)
+    conf.setdefault("spark.rapids.tpu.serve.port", 0)
+    conf.setdefault("spark.rapids.tpu.obs.http.enabled", True)
+    conf.setdefault("spark.rapids.tpu.obs.http.port", 0)
+    conf.setdefault("spark.rapids.tpu.obs.http.host", host)
+    try:
+        from spark_rapids_tpu import TpuSparkSession
+        session = TpuSparkSession(conf)
+    except Exception as e:
+        _emit({"fatal": True, "error": f"{type(e).__name__}: {e}"})
+        return 2
+    try:
+        # register data views so every replica serves the same catalog
+        # ({"views": {"t": {"parquet": "/path"}}} in the config line)
+        for vname, spec in (config.get("views") or {}).items():
+            try:
+                if "parquet" in spec:
+                    session.register_view(
+                        vname, session.read.parquet(spec["parquet"]))
+                elif "csv" in spec:
+                    session.register_view(
+                        vname, session.read.csv(spec["csv"]))
+            except Exception as e:
+                _emit({"fatal": True,
+                       "error": f"view {vname!r}: "
+                                f"{type(e).__name__}: {e}"})
+                return 2
+        pre = session.precompile_service
+        pre_stats: Dict[str, Any] = {}
+        if pre is not None and config.get("wait_precompile", True):
+            # warm-join gate: do not announce ready until the shared
+            # corpus replay finished — first queries after join must
+            # pay zero fresh compiles
+            pre.wait(timeout=float(config.get("warm_timeout_s", 150)))
+            pre_stats = pre.stats()
+        srv = session.serve_server
+        obs = session.obs_server
+        if srv is None or obs is None:
+            _emit({"fatal": True,
+                   "error": "serve/obs server failed to start"})
+            return 2
+        _emit({"ready": True, "name": config.get("name"),
+               "pid": os.getpid(), "serve_port": srv.port,
+               "obs_port": obs.port, "precompile": pre_stats})
+        _serve_forever(session, config)
+    finally:
+        try:
+            if session.serve_server is not None:
+                session.serve_server.shutdown()
+            if session.obs_server is not None:
+                session.obs_server.shutdown()
+        except Exception:
+            pass
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main(sys.argv[1:]))

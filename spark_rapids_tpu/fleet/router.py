@@ -1,0 +1,865 @@
+"""Fleet front door: a wire-protocol routing proxy over N replicas.
+
+The router terminates the serve wire protocol (serve/wire.py, protocol
+2) on behalf of a replica fleet.  It is a FRAME proxy, not a query
+engine: it decodes only what routing needs — REQ control payloads (to
+learn the op, stream id, credit, and statement text) and the u64
+sequence prefix of CHUNK payloads (to know how far each stream got) —
+and forwards everything else opaquely.  Arrow bytes are never parsed.
+
+Responsibilities:
+
+* **Placement** — a new session lands on the least-loaded *serving*
+  replica, scored from each replica's ``/metrics`` sched gauges
+  (``sched.queued`` + ``sched.running``, refreshed by the health
+  poller every ``fleet.router.healthPollMs``) plus the router's own
+  placement count between polls.  A hello carrying a resume token the
+  router has seen before goes back to the replica that owns the
+  session (affinity), as long as that replica is still serving —
+  ``/healthz`` drain states (serving/draining/drained) are honored:
+  draining replicas take no new sessions and no re-homed ones.
+
+* **Auth** — when ``serve.auth.tokens`` is configured the router
+  rejects unauthenticated hellos itself with a typed ``AuthFailed``
+  ERR (counter ``fleet.router.authFailures``) before any replica
+  spends a socket on them.
+
+* **Tenant quotas** — ``fleet.tenant.maxInflight`` bounds concurrent
+  streams per tenant (the auth token, else the client IP) across the
+  whole fleet; excess requests get a typed ``TenantQuotaExceeded``
+  ERR without ever reaching a replica.
+
+* **Transparent failover** — when the upstream replica dies mid
+  connection the router re-homes the session on a survivor without
+  the client noticing: re-hello with the session's resume token,
+  replay of every prepared statement the connection created (ids are
+  re-aliased on the fly), then per in-flight stream a
+  ``resume_stream`` from the last sequence the client was sent — and
+  if the survivor's retained window doesn't have the stream, a
+  re-execution of the original request with the already-delivered
+  prefix dropped at the router (duplicate chunks are counted in
+  ``fleet.router.droppedDuplicateChunks`` and their flow-control
+  credit is re-granted upstream, so the client sees each sequence
+  number exactly once and backpressure math stays intact).
+
+The router holds no result state: with the fleet store attached the
+survivor typically answers the re-execution from the shared result
+cache, so failover costs one cache read, not a recompute.
+"""
+
+from __future__ import annotations
+
+import json
+import re
+import socket
+import threading
+import time
+import urllib.request
+from typing import Any, Dict, List, Optional, Tuple
+
+from spark_rapids_tpu.obs import recorder as obsrec
+from spark_rapids_tpu.obs import registry as obsreg
+from spark_rapids_tpu.serve import wire
+
+#: router-minted request tags live far above any client's tag counter
+#: (clients count up from 1); responses to these are consumed by the
+#: router itself and never forwarded
+_INTERNAL_TAG_BASE = 1 << 48
+
+_GAUGE_RE = re.compile(
+    r"^spark_rapids_tpu_sched_(queued|running)\s+([0-9.eE+-]+)\s*$",
+    re.MULTILINE)
+
+#: ops that open a result stream (tracked per-tag until END/ERR)
+_STREAM_OPS = frozenset(("sql", "execute", "resume_stream"))
+
+
+class RouterError(Exception):
+    """Typed routing failure surfaced to the client as an ERR frame."""
+
+    def __init__(self, code: str, msg: str):
+        super().__init__(msg)
+        self.code = code
+
+
+class ReplicaEndpoint:
+    """One replica as the router sees it: serve address, observability
+    address, and the last-polled health/load snapshot."""
+
+    def __init__(self, host: str, port: int,
+                 obs_port: Optional[int] = None,
+                 name: Optional[str] = None):
+        self.host = str(host)
+        self.port = int(port)
+        self.obs_port = int(obs_port) if obs_port else None
+        self.name = name or f"{self.host}:{self.port}"
+        self.alive = True                 # cleared on socket failure
+        self.state = "serving"            # /healthz drain state
+        self.load = 0.0                   # sched.queued + sched.running
+        self.inflight = 0                 # /healthz inflight
+        self.placed = 0                   # router placements since poll
+
+    def usable(self) -> bool:
+        return self.alive and self.state == "serving"
+
+    def describe(self) -> Dict[str, Any]:
+        return {"name": self.name, "host": self.host, "port": self.port,
+                "obs_port": self.obs_port, "alive": self.alive,
+                "state": self.state, "load": self.load,
+                "inflight": self.inflight}
+
+
+class FleetRouter:
+    """Accepts client connections and proxies each to a replica.
+
+    ``replicas`` is a list of ``ReplicaEndpoint`` (or ``(host, port)``
+    / ``(host, port, obs_port)`` tuples).  ``start()`` binds the
+    listener; ``shutdown()`` closes it and every live proxy
+    connection.  Replicas can be added/removed at runtime
+    (``add_replica`` / ``remove_replica``) — removal marks the
+    endpoint dead so existing connections fail over."""
+
+    def __init__(self, replicas: Optional[List[Any]] = None,
+                 host: str = "127.0.0.1", port: int = 0,
+                 auth_tokens: str = "",
+                 tenant_max_inflight: int = 0,
+                 health_poll_ms: int = 500,
+                 max_frame_bytes: int = wire.DEFAULT_MAX_FRAME_BYTES,
+                 failover_timeout_s: float = 30.0):
+        self._host = host
+        self._want_port = int(port)
+        self._auth_tokens = frozenset(
+            t.strip() for t in str(auth_tokens or "").split(",")
+            if t.strip())
+        self._tenant_max = max(0, int(tenant_max_inflight))
+        self._poll_s = max(0.05, int(health_poll_ms) / 1e3)
+        self._max_frame = int(max_frame_bytes)
+        self._failover_timeout_s = float(failover_timeout_s)
+        self._lock = threading.Lock()
+        self._replicas: List[ReplicaEndpoint] = []
+        for r in replicas or []:
+            self._replicas.append(self._coerce(r))
+        #: client-visible resume token -> (replica name, upstream token)
+        self._affinity: Dict[str, Tuple[str, str]] = {}
+        self._tenant_inflight: Dict[str, int] = {}
+        self._conns: List["_ProxyConn"] = []
+        self._shutdown = threading.Event()
+        self._listener: Optional[socket.socket] = None
+        self.port: Optional[int] = None
+        self._threads: List[threading.Thread] = []
+
+    @staticmethod
+    def _coerce(r: Any) -> ReplicaEndpoint:
+        if isinstance(r, ReplicaEndpoint):
+            return r
+        if isinstance(r, dict):
+            return ReplicaEndpoint(r["host"], r["port"],
+                                   r.get("obs_port"), r.get("name"))
+        return ReplicaEndpoint(*tuple(r))
+
+    # -- lifecycle ---------------------------------------------------------
+    def start(self) -> "FleetRouter":
+        if self._listener is not None:
+            return self
+        lst = socket.create_server((self._host, self._want_port),
+                                   backlog=64)
+        self._listener = lst
+        self.port = lst.getsockname()[1]
+        acc = threading.Thread(target=self._accept_loop,
+                               name="fleet-router-accept", daemon=True)
+        poll = threading.Thread(target=self._poll_loop,
+                                name="fleet-router-health", daemon=True)
+        self._threads = [acc, poll]
+        acc.start()
+        poll.start()
+        obsrec.record_event("fleet.router.started", host=self._host,
+                            port=self.port,
+                            replicas=[r.name for r in self._replicas])
+        return self
+
+    def shutdown(self) -> None:
+        self._shutdown.set()
+        lst, self._listener = self._listener, None
+        if lst is not None:
+            try:
+                lst.close()
+            except OSError:
+                pass
+        with self._lock:
+            conns = list(self._conns)
+        for c in conns:
+            c.close()
+
+    # -- replica set -------------------------------------------------------
+    def add_replica(self, r: Any) -> ReplicaEndpoint:
+        ep = self._coerce(r)
+        with self._lock:
+            self._replicas.append(ep)
+        return ep
+
+    def remove_replica(self, name: str) -> None:
+        with self._lock:
+            for r in self._replicas:
+                if r.name == name:
+                    r.alive = False
+
+    def replicas(self) -> List[Dict[str, Any]]:
+        with self._lock:
+            return [r.describe() for r in self._replicas]
+
+    def mark_dead(self, ep: ReplicaEndpoint) -> None:
+        if ep.alive:
+            ep.alive = False
+            obsreg.get_registry().inc("fleet.router.deadReplicas")
+            obsrec.record_event("fleet.router.replicaDead",
+                                replica=ep.name)
+
+    # -- placement ---------------------------------------------------------
+    def pick(self, resume_token: Optional[str] = None,
+             exclude: Tuple[ReplicaEndpoint, ...] = ()
+             ) -> Tuple[ReplicaEndpoint, Optional[str]]:
+        """Choose an upstream.  Returns ``(replica, upstream_token)``
+        where ``upstream_token`` is the token to present to THAT
+        replica (the affinity remap), or None for a fresh session."""
+        with self._lock:
+            if resume_token:
+                hit = self._affinity.get(resume_token)
+                if hit:
+                    rname, utoken = hit
+                    for r in self._replicas:
+                        if r.name == rname and r.usable() and \
+                                r not in exclude:
+                            return r, utoken
+            cands = [r for r in self._replicas
+                     if r.usable() and r not in exclude]
+            if not cands:
+                raise RouterError(
+                    "NoReplicaAvailable",
+                    "no serving replica available in the fleet")
+            best = min(cands, key=lambda r: (r.load + r.inflight
+                                             + r.placed, r.name))
+            best.placed += 1
+        obsreg.get_registry().inc("fleet.router.placements")
+        return best, resume_token
+
+    def remember(self, client_token: str, replica: ReplicaEndpoint,
+                 upstream_token: str) -> None:
+        if not client_token:
+            return
+        with self._lock:
+            if len(self._affinity) > 8192:    # bound the map
+                self._affinity.pop(next(iter(self._affinity)))
+            self._affinity[client_token] = (replica.name, upstream_token)
+
+    # -- tenant quotas -----------------------------------------------------
+    def quota_acquire(self, tenant: str) -> bool:
+        if not self._tenant_max:
+            return True
+        with self._lock:
+            n = self._tenant_inflight.get(tenant, 0)
+            if n >= self._tenant_max:
+                return False
+            self._tenant_inflight[tenant] = n + 1
+        return True
+
+    def quota_release(self, tenant: str, n: int = 1) -> None:
+        if not self._tenant_max:
+            return
+        with self._lock:
+            left = self._tenant_inflight.get(tenant, 0) - n
+            if left > 0:
+                self._tenant_inflight[tenant] = left
+            else:
+                self._tenant_inflight.pop(tenant, None)
+
+    # -- health polling ----------------------------------------------------
+    def _poll_loop(self) -> None:
+        while not self._shutdown.wait(self._poll_s):
+            self.poll_once()
+
+    def poll_once(self) -> None:
+        """One health/load sweep over every replica (also callable
+        from tests for a deterministic refresh)."""
+        with self._lock:
+            reps = list(self._replicas)
+        for r in reps:
+            if not r.obs_port:
+                continue
+            base = f"http://{r.host}:{r.obs_port}"
+            try:
+                with urllib.request.urlopen(
+                        base + "/healthz", timeout=2.0) as resp:
+                    hz = json.loads(resp.read().decode("utf-8"))
+                r.state = str(hz.get("state", "serving"))
+                r.inflight = int(hz.get("inflight", 0))
+                with urllib.request.urlopen(
+                        base + "/metrics", timeout=2.0) as resp:
+                    text = resp.read().decode("utf-8", "replace")
+                load = 0.0
+                for _name, val in _GAUGE_RE.findall(text):
+                    load += float(val)
+                r.load = load
+                r.placed = 0           # fresh gauges supersede guesses
+                if not r.alive:
+                    # a previously-dead endpoint answering health
+                    # checks again (replacement process on the same
+                    # port) rejoins the candidate set
+                    r.alive = True
+                    obsrec.record_event("fleet.router.replicaBack",
+                                        replica=r.name)
+            except Exception:
+                # an unreachable obs plane is a health signal too
+                if r.alive and r.state != "unknown":
+                    r.state = "unknown"
+
+    # -- accept loop -------------------------------------------------------
+    def _accept_loop(self) -> None:
+        reg = obsreg.get_registry()
+        while not self._shutdown.is_set():
+            lst = self._listener
+            if lst is None:
+                return
+            try:
+                sock, addr = lst.accept()
+            except OSError:
+                return
+            reg.inc("fleet.router.connections")
+            conn = _ProxyConn(self, sock, addr)
+            with self._lock:
+                self._conns = [c for c in self._conns
+                               if not c.closed] + [conn]
+            threading.Thread(target=conn.run,
+                             name=f"fleet-router-conn-{addr[1]}",
+                             daemon=True).start()
+
+    def stats(self) -> Dict[str, Any]:
+        with self._lock:
+            return {
+                "port": self.port,
+                "replicas": [r.describe() for r in self._replicas],
+                "connections": sum(1 for c in self._conns
+                                   if not c.closed),
+                "affinity_entries": len(self._affinity),
+                "tenant_inflight": dict(self._tenant_inflight),
+            }
+
+
+class _StreamState:
+    """Per-tag state for an open result stream flowing through the
+    proxy — everything failover needs to rebuild it elsewhere."""
+
+    __slots__ = ("msg", "stream_id", "last_seq", "credit", "tenant",
+                 "mode")
+
+    def __init__(self, msg: Dict[str, Any], credit: int, tenant: str):
+        self.msg = msg
+        self.stream_id = str(msg.get("stream_id") or "")
+        # resume_stream requests enter already positioned
+        self.last_seq = max(0, int(msg.get("after_seq", 0)))
+        self.credit = max(1, credit)      # outstanding window
+        self.tenant = tenant
+        self.mode = "forward"   # forward | reexec (drop dup prefix)
+
+
+class _ProxyConn:
+    """One client connection and its 1:1 upstream replica socket."""
+
+    def __init__(self, router: FleetRouter, sock: socket.socket,
+                 addr: Tuple[str, int]):
+        self.router = router
+        self.csock = sock
+        self.caddr = addr
+        self.cwlock = threading.Lock()
+        self.closed = False
+        self.ending = False          # client sent {"op": "close"}
+        wire.set_low_latency(sock)
+        sock.settimeout(1.0)
+
+        self.up: Optional[socket.socket] = None
+        self.uwlock = threading.Lock()
+        self.replica: Optional[ReplicaEndpoint] = None
+        self.up_gen = 0
+
+        self.hello_msg: Optional[Dict[str, Any]] = None
+        self.client_token = ""       # token the CLIENT knows
+        self.upstream_token = ""     # token the current REPLICA knows
+        self.tenant = f"ip:{addr[0]}"
+        #: client-visible statement id -> {"sql", "declared_types"}
+        self.statements: Dict[str, Dict[str, Any]] = {}
+        #: client-visible statement id -> current upstream id
+        self.stmt_alias: Dict[str, str] = {}
+        #: tag -> op for non-stream REQs awaiting RESP (prepare/hello)
+        self.pending_req: Dict[int, Dict[str, Any]] = {}
+        self.streams: Dict[int, _StreamState] = {}
+        self.state_lock = threading.Lock()
+        self._fo_lock = threading.Lock()
+        self._itag = _INTERNAL_TAG_BASE
+
+    # -- plumbing ----------------------------------------------------------
+    def close(self) -> None:
+        self.closed = True
+        for s in (self.csock, self.up):
+            if s is not None:
+                try:
+                    s.close()
+                except OSError:
+                    pass
+        self._release_all_quota()
+
+    def _release_all_quota(self) -> None:
+        with self.state_lock:
+            streams, self.streams = self.streams, {}
+        for st in streams.values():
+            self.router.quota_release(st.tenant)
+
+    def _err_to_client(self, tag: int, code: str, msg: str) -> None:
+        try:
+            wire.send_frame(self.csock, self.cwlock, wire.ERR, tag,
+                            wire.encode_msg({"type": code,
+                                             "error": msg}))
+        except wire.WireError:
+            pass
+
+    def _next_itag(self) -> int:
+        self._itag += 1
+        return self._itag
+
+    # -- client read loop --------------------------------------------------
+    def run(self) -> None:
+        try:
+            while not self.closed and \
+                    not self.router._shutdown.is_set():
+                try:
+                    fr = wire.read_frame(self.csock,
+                                         self.router._max_frame)
+                except wire.WireError:
+                    break
+                if fr is wire.IDLE:
+                    continue
+                if fr is None:
+                    break
+                kind, tag, payload = fr          # type: ignore[misc]
+                if not self._on_client_frame(kind, tag, payload):
+                    break
+        finally:
+            self.close()
+
+    def _on_client_frame(self, kind: int, tag: int,
+                         payload: bytes) -> bool:
+        if kind == wire.REQ:
+            try:
+                msg = wire.decode_msg(payload)
+            except wire.ServeWireError as e:
+                self._err_to_client(tag, "BadRequest", str(e))
+                return True
+            return self._on_client_req(tag, msg)
+        # CHUNK/CREDIT/other: forward opaquely; CREDIT grows the
+        # tracked outstanding window for its stream
+        if kind == wire.CREDIT:
+            try:
+                n = int(wire.decode_msg(payload).get("n", 1))
+            except Exception:
+                n = 1
+            with self.state_lock:
+                st = self.streams.get(tag)
+                if st is not None:
+                    st.credit += max(1, n)
+        return self._forward_up(kind, tag, payload)
+
+    def _on_client_req(self, tag: int, msg: Dict[str, Any]) -> bool:
+        op = str(msg.get("op", ""))
+        reg = obsreg.get_registry()
+        if op == "hello":
+            return self._on_hello(tag, msg)
+        if self.up is None:
+            self._err_to_client(tag, "BadRequest",
+                                "hello required before any request")
+            return True
+        if op == "close":
+            # a goodbye: the replica will drop the connection after its
+            # RESP — the pump must read that EOF as farewell, not death
+            self.ending = True
+        if op in _STREAM_OPS:
+            if not self.router.quota_acquire(self.tenant):
+                reg.inc("fleet.router.quotaRefusals")
+                obsrec.record_event("fleet.router.quotaRefused",
+                                    tenant=self.tenant, op=op)
+                self._err_to_client(
+                    tag, "TenantQuotaExceeded",
+                    f"tenant {self.tenant!r} is at its fleet in-flight "
+                    f"limit ({self.router._tenant_max}); retry after a "
+                    f"stream finishes")
+                return True
+            with self.state_lock:
+                self.streams[tag] = _StreamState(
+                    msg, int(msg.get("credit", 8)), self.tenant)
+        elif op == "prepare":
+            with self.state_lock:
+                self.pending_req[tag] = {
+                    "op": "prepare",
+                    "sql": str(msg.get("sql", "")),
+                    "params": dict(msg.get("params") or {})}
+        rewritten = self._rewrite_statement(msg)
+        payload = wire.encode_msg(rewritten) if rewritten is not msg \
+            else wire.encode_msg(msg)
+        return self._forward_up(wire.REQ, tag, payload)
+
+    def _rewrite_statement(self, msg: Dict[str, Any]) -> Dict[str, Any]:
+        sid = msg.get("statement_id")
+        if sid:
+            live = self.stmt_alias.get(str(sid))
+            if live and live != sid:
+                msg = dict(msg)
+                msg["statement_id"] = live
+        return msg
+
+    def _on_hello(self, tag: int, msg: Dict[str, Any]) -> bool:
+        reg = obsreg.get_registry()
+        if self.router._auth_tokens:
+            presented = str(msg.get("auth_token") or "")
+            if presented not in self.router._auth_tokens:
+                reg.inc("fleet.router.authFailures")
+                obsrec.record_event("fleet.router.authFailed",
+                                    client=self.caddr[0])
+                self._err_to_client(
+                    tag, "AuthFailed",
+                    "hello rejected: missing or unknown auth_token "
+                    "(serve.auth.tokens)")
+                return True
+        token = str(msg.get("auth_token") or "")
+        if token:
+            self.tenant = f"token:{token}"
+        self.hello_msg = dict(msg)
+        resume = str(msg.get("resume") or "")
+        forward = dict(msg)
+        if self.up is None:
+            try:
+                replica, utoken = self.router.pick(resume or None)
+            except RouterError as e:
+                self._err_to_client(tag, e.code, str(e))
+                return False
+            try:
+                self._connect_upstream(replica)
+            except OSError:
+                self.router.mark_dead(replica)
+                try:
+                    replica, utoken = self.router.pick(
+                        resume or None, exclude=(replica,))
+                    self._connect_upstream(replica)
+                except (RouterError, OSError) as e:
+                    self._err_to_client(
+                        tag, "NoReplicaAvailable",
+                        f"fleet has no reachable replica: {e}")
+                    return False
+            if utoken and utoken != resume:
+                forward["resume"] = utoken
+            self._start_pump()
+        elif resume and self.upstream_token and \
+                resume == self.client_token:
+            # re-hello on a failed-over connection: the client's token
+            # names a session this replica knows under another token
+            forward["resume"] = self.upstream_token
+        with self.state_lock:
+            self.pending_req[tag] = {"op": "hello",
+                                     "client_resume": resume}
+        return self._forward_up(wire.REQ, tag,
+                                wire.encode_msg(forward))
+
+    def _connect_upstream(self, replica: ReplicaEndpoint) -> None:
+        sock = socket.create_connection(
+            (replica.host, replica.port), timeout=10.0)
+        wire.set_low_latency(sock)
+        sock.settimeout(1.0)
+        self.up = sock
+        self.replica = replica
+        self.up_gen += 1
+
+    def _start_pump(self) -> None:
+        threading.Thread(
+            target=self._pump_upstream,
+            args=(self.up, self.up_gen),
+            name=f"fleet-router-pump-{self.caddr[1]}",
+            daemon=True).start()
+
+    def _forward_up(self, kind: int, tag: int,
+                    payload: bytes) -> bool:
+        if self.up is None:
+            self._err_to_client(tag, "BadRequest",
+                                "hello required before any request")
+            return True
+        for _attempt in (0, 1):
+            sock, gen = self.up, self.up_gen
+            try:
+                wire.send_frame(sock, self.uwlock, kind, tag, payload)
+                return True
+            except wire.WireError:
+                if not self._failover(gen):
+                    return False
+                # after failover the stream/statement state was
+                # replayed; a stream REQ must not be re-sent on top of
+                # its own replay — only non-stream frames retry
+                with self.state_lock:
+                    if tag in self.streams:
+                        return True
+        return False
+
+    # -- upstream pump -----------------------------------------------------
+    def _pump_upstream(self, sock: socket.socket, gen: int) -> None:
+        while not self.closed:
+            if gen != self.up_gen:
+                return                     # superseded by failover
+            try:
+                fr = wire.read_frame(sock, self.router._max_frame)
+            except wire.WireError:
+                fr = None
+            if fr is wire.IDLE:
+                continue
+            if fr is None:
+                if self.closed or gen != self.up_gen:
+                    return
+                if self.ending:
+                    self.close()           # farewell EOF, not death
+                    return
+                if not self._failover(gen):
+                    self.close()
+                return                     # new pump owns the new sock
+            kind, tag, payload = fr        # type: ignore[misc]
+            if tag >= _INTERNAL_TAG_BASE:
+                continue    # stray response to a failover-time request
+            res = self._on_upstream_frame(kind, tag, payload, gen)
+            if res is None:
+                return         # failed over; new pump owns the new sock
+            if not res:
+                self.close()
+                return
+
+    def _on_upstream_frame(self, kind: int, tag: int,
+                           payload: bytes, gen: int
+                           ) -> Optional[bool]:
+        reg = obsreg.get_registry()
+        if kind == wire.CHUNK:
+            try:
+                seq, _ = wire.split_chunk(payload)
+            except wire.ServeWireError:
+                seq = None
+            with self.state_lock:
+                st = self.streams.get(tag)
+                if st is not None and seq is not None:
+                    if st.mode == "reexec" and seq <= st.last_seq:
+                        # duplicate prefix of a re-executed stream:
+                        # drop here and re-grant the credit the client
+                        # will never send for it
+                        drop = True
+                    else:
+                        drop = False
+                        st.last_seq = max(st.last_seq, seq)
+                        st.credit = max(0, st.credit - 1)
+                else:
+                    drop = False
+            if drop:
+                reg.inc("fleet.router.droppedDuplicateChunks")
+                try:
+                    wire.send_frame(self.up, self.uwlock, wire.CREDIT,
+                                    tag, wire.encode_msg({"n": 1}))
+                except wire.WireError:
+                    pass   # upstream death surfaces on the next read
+                return True
+        elif kind in (wire.END, wire.ERR):
+            if kind == wire.ERR:
+                st = self.streams.get(tag)
+                if st is not None:
+                    try:
+                        err = wire.decode_msg(payload)
+                    except wire.ServeWireError:
+                        err = {}
+                    etype = err.get("type")
+                    # a typed ResumeUnavailable answering OUR failover
+                    # resume attempt falls back to re-execution
+                    # instead of reaching the client
+                    if st.mode == "resuming" and \
+                            etype in ("ResumeUnavailable",
+                                      "SessionExpired"):
+                        if self._reexec_stream(tag, st):
+                            return True
+                    # a retiring replica answers live streams with
+                    # Draining: move the session, don't surface it
+                    elif etype in ("Draining", "ConnectionClosed"):
+                        if self._failover(gen):
+                            return None
+            with self.state_lock:
+                st = self.streams.pop(tag, None)
+                self.pending_req.pop(tag, None)
+            if st is not None:
+                self.router.quota_release(st.tenant)
+        elif kind == wire.RESP:
+            self._on_upstream_resp(tag, payload)
+        try:
+            wire.send_frame(self.csock, self.cwlock, kind, tag,
+                            payload)
+        except wire.WireError:
+            return False
+        return True
+
+    def _on_upstream_resp(self, tag: int, payload: bytes) -> None:
+        with self.state_lock:
+            pend = self.pending_req.pop(tag, None)
+        if pend is None:
+            return
+        try:
+            resp = wire.decode_msg(payload)
+        except wire.ServeWireError:
+            return
+        if pend["op"] == "hello":
+            token = str(resp.get("resume_token") or "")
+            self.client_token = token
+            self.upstream_token = token
+            if token and self.replica is not None:
+                self.router.remember(token, self.replica, token)
+        elif pend["op"] == "prepare":
+            sid = str(resp.get("statement_id") or "")
+            if sid:
+                with self.state_lock:
+                    self.statements[sid] = {
+                        "sql": pend["sql"],
+                        "params": pend["params"]}
+                    self.stmt_alias[sid] = sid
+
+    # -- failover ----------------------------------------------------------
+    def _failover(self, gen: int) -> bool:
+        """Re-home this connection's session on a survivor.  Returns
+        True when the connection is usable again (possibly after
+        another thread already failed it over)."""
+        with self._fo_lock:
+            if self.closed:
+                return False
+            if gen != self.up_gen:
+                return True                # already failed over
+            dead = self.replica
+            if dead is not None:
+                self.router.mark_dead(dead)
+            try:
+                if self.up is not None:
+                    self.up.close()
+            except OSError:
+                pass
+            if self.hello_msg is None:
+                return False
+            reg = obsreg.get_registry()
+            deadline = time.monotonic() + self.router._failover_timeout_s
+            tried: List[ReplicaEndpoint] = [r for r in (dead,) if r]
+            while time.monotonic() < deadline:
+                try:
+                    replica, _ = self.router.pick(
+                        exclude=tuple(tried))
+                except RouterError:
+                    time.sleep(0.1)
+                    tried = [r for r in (dead,) if r]
+                    continue
+                try:
+                    self._connect_upstream(replica)
+                    self._rehome(replica)
+                except (OSError, wire.WireError, RouterError):
+                    self.router.mark_dead(replica)
+                    tried.append(replica)
+                    continue
+                reg.inc("fleet.router.failovers")
+                obsrec.record_event(
+                    "fleet.router.failedOver",
+                    dead=dead.name if dead else None,
+                    to=replica.name, client=self.caddr[0],
+                    streams=len(self.streams),
+                    statements=len(self.statements))
+                self._start_pump()
+                return True
+            return False
+
+    def _sync_req(self, msg: Dict[str, Any],
+                  timeout_s: float = 20.0) -> Dict[str, Any]:
+        """Internal request/response on a freshly-connected upstream
+        (no other traffic yet, so a synchronous read is safe)."""
+        tag = self._next_itag()
+        wire.send_frame(self.up, self.uwlock, wire.REQ, tag,
+                        wire.encode_msg(msg))
+        deadline = time.monotonic() + timeout_s
+        while time.monotonic() < deadline:
+            fr = wire.read_frame(self.up, self.router._max_frame)
+            if fr is wire.IDLE:
+                continue
+            if fr is None:
+                raise wire.WireError("upstream closed during failover")
+            kind, rtag, payload = fr       # type: ignore[misc]
+            if rtag != tag:
+                continue                   # stale frame from old life
+            if kind == wire.RESP:
+                return wire.decode_msg(payload)
+            if kind == wire.ERR:
+                err = wire.decode_msg(payload)
+                raise RouterError(str(err.get("type", "Error")),
+                                  str(err.get("error", "")))
+        raise wire.WireError("failover handshake timed out")
+
+    def _rehome(self, replica: ReplicaEndpoint) -> None:
+        """Synchronous re-hello + statement replay + stream recovery
+        on a just-connected upstream (called under _fo_lock)."""
+        hello = dict(self.hello_msg or {})
+        if self.upstream_token:
+            hello["resume"] = self.upstream_token
+        resp = self._sync_req(hello)
+        new_token = str(resp.get("resume_token") or "")
+        resumed = bool(resp.get("resumed"))
+        if new_token:
+            self.upstream_token = new_token
+            self.router.remember(self.client_token or new_token,
+                                 replica, new_token)
+        # replay prepared statements; the survivor may already know
+        # them (shared statement store) under their original ids, but
+        # replaying is correct either way — ids are re-aliased
+        if not resumed:
+            with self.state_lock:
+                stmts = dict(self.statements)
+            for cid, spec in stmts.items():
+                prep = {"op": "prepare", "sql": spec["sql"],
+                        "params": spec.get("params") or {}}
+                desc = self._sync_req(prep)
+                new_id = str(desc.get("statement_id") or "")
+                if new_id:
+                    with self.state_lock:
+                        self.stmt_alias[cid] = new_id
+        # rebuild every in-flight stream: resume from the retained
+        # window when the survivor has it, else re-execute and drop
+        # the already-delivered prefix at the router
+        with self.state_lock:
+            live = list(self.streams.items())
+        reg = obsreg.get_registry()
+        for tag, st in live:
+            if st.stream_id:
+                st.mode = "resuming"
+                reg.inc("fleet.router.resumedStreams")
+                wire.send_frame(
+                    self.up, self.uwlock, wire.REQ, tag,
+                    wire.encode_msg({"op": "resume_stream",
+                                     "stream_id": st.stream_id,
+                                     "after_seq": st.last_seq,
+                                     "credit": max(1, st.credit)}))
+            else:
+                self._reexec_stream(tag, st)
+
+    def _reexec_stream(self, tag: int, st: _StreamState) -> bool:
+        """Re-send a stream's original request; the dup prefix (seq <=
+        last_seq) is dropped by the CHUNK filter above."""
+        msg = dict(st.msg)
+        if str(msg.get("op")) == "resume_stream":
+            # the original request on THIS connection was already a
+            # resume; keep resuming from where the client actually is
+            msg["after_seq"] = st.last_seq
+        else:
+            msg = self._rewrite_statement(msg)
+        msg["credit"] = max(1, st.credit)
+        st.mode = "reexec"
+        obsreg.get_registry().inc("fleet.router.reexecutedStreams")
+        try:
+            wire.send_frame(self.up, self.uwlock, wire.REQ, tag,
+                            wire.encode_msg(msg))
+            return True
+        except wire.WireError:
+            return False

@@ -1,0 +1,389 @@
+"""Shared cache plane for the serve fleet: a pluggable external store.
+
+Every replica in a fleet attaches one :class:`FleetStore` (from
+``fleet.store.url``) and publishes/consumes three kinds of state
+through it:
+
+  * ``stmt`` namespace — prepared-statement specs (the
+    ``PreparedStatement.describe()`` shape), keyed by statement id, so
+    any replica can re-materialize a statement it never prepared (the
+    failover replay path and cross-replica ``execute``).
+  * ``result`` namespace — serialized result-cache entries keyed by a
+    digest of (plan digest, output names, source stamps). Because the
+    LIVE stamps are part of the key, a entry published under drifted
+    stamps is simply never looked up again — catalog/file-stamp drift
+    invalidates fleet-wide with no coordination. A ``latest`` pointer
+    namespace maps (digest, names) to the most recent stamped key so
+    the incremental maintainer can find retained partials for delta
+    refresh (exec/incremental.py's ``lookup_latest`` contract).
+  * on :class:`FileStore` only: a shared persistent **compile-cache
+    directory** (``compile_cache/``) every replica points jax's
+    compilation cache at, and a **corpus directory** (``corpus/``)
+    each replica appends its precompile corpus JSONL into — the
+    warm-join path a new replica replays before serving.
+
+Two implementations:
+
+  * :class:`FileStore` (``file:///path``) — directory-backed, atomic
+    temp+rename puts, safe for same-host fleets and shared
+    filesystems; the default deployment shape.
+  * :class:`TcpStore` + :class:`StoreServer` (``tcp://host:port``) —
+    an in-memory store behind a length-prefixed TCP protocol, for
+    tests exercising the wiring without a shared filesystem.
+
+Registry counters: ``fleet.store.gets`` / ``.hits`` / ``.puts`` /
+``.putBytes`` / ``.errors``.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import os
+import re
+import socket
+import socketserver
+import struct
+import tempfile
+import threading
+from typing import Dict, List, Optional, Tuple
+
+from spark_rapids_tpu.obs import registry as obsreg
+
+_SAFE_KEY = re.compile(r"^[A-Za-z0-9._=-]{1,200}$")
+_HDR = struct.Struct("<II")           # header_len, payload_len
+_MAX_FRAME = 512 << 20
+
+
+def _storage_name(key: str) -> str:
+    """Filesystem-/protocol-safe storage name for an arbitrary key."""
+    if _SAFE_KEY.match(key):
+        return key
+    return "h" + hashlib.sha1(key.encode("utf-8")).hexdigest()
+
+
+class FleetStore:
+    """Abstract shared store: namespaced binary key/value."""
+
+    url: str = ""
+
+    def get(self, ns: str, key: str) -> Optional[bytes]:
+        raise NotImplementedError
+
+    def put(self, ns: str, key: str, data: bytes) -> None:
+        raise NotImplementedError
+
+    def delete(self, ns: str, key: str) -> None:
+        raise NotImplementedError
+
+    def keys(self, ns: str) -> List[str]:
+        """Storage names present in a namespace (content-addressed
+        callers compare against ``_storage_name`` of their keys)."""
+        raise NotImplementedError
+
+    def close(self) -> None:
+        pass
+
+    # Directory-backed capabilities (None when the store cannot share
+    # a real filesystem path — e.g. the TCP test store).
+    def compile_cache_dir(self) -> Optional[str]:
+        return None
+
+    def corpus_dir(self) -> Optional[str]:
+        return None
+
+    # -- counter helpers ----------------------------------------------------
+    @staticmethod
+    def _count_get(found: bool) -> None:
+        reg = obsreg.get_registry()
+        reg.inc("fleet.store.gets")
+        if found:
+            reg.inc("fleet.store.hits")
+
+    @staticmethod
+    def _count_put(nbytes: int) -> None:
+        reg = obsreg.get_registry()
+        reg.inc("fleet.store.puts")
+        reg.inc("fleet.store.putBytes", nbytes)
+
+    @staticmethod
+    def _count_error() -> None:
+        obsreg.get_registry().inc("fleet.store.errors")
+
+
+class FileStore(FleetStore):
+    """Directory-backed store: ``<root>/kv/<ns>/<name>`` files with
+    atomic temp+rename puts (a reader never observes a torn value)."""
+
+    def __init__(self, root: str):
+        self.root = os.path.abspath(root)
+        self.url = "file://" + self.root
+        os.makedirs(os.path.join(self.root, "kv"), exist_ok=True)
+
+    def _path(self, ns: str, key: str) -> str:
+        return os.path.join(self.root, "kv", _storage_name(ns),
+                            _storage_name(key))
+
+    def get(self, ns: str, key: str) -> Optional[bytes]:
+        try:
+            with open(self._path(ns, key), "rb") as f:
+                data = f.read()
+            self._count_get(True)
+            return data
+        except OSError:
+            self._count_get(False)
+            return None
+
+    def put(self, ns: str, key: str, data: bytes) -> None:
+        path = self._path(ns, key)
+        try:
+            os.makedirs(os.path.dirname(path), exist_ok=True)
+            fd, tmp = tempfile.mkstemp(dir=os.path.dirname(path),
+                                       prefix=".put-")
+            try:
+                with os.fdopen(fd, "wb") as f:
+                    f.write(data)
+                os.replace(tmp, path)
+            except BaseException:
+                try:
+                    os.unlink(tmp)
+                except OSError:
+                    pass
+                raise
+            self._count_put(len(data))
+        except OSError:
+            self._count_error()       # shared store is best-effort:
+                                      # a full disk must not fail serving
+
+    def delete(self, ns: str, key: str) -> None:
+        try:
+            os.unlink(self._path(ns, key))
+        except OSError:
+            pass
+
+    def keys(self, ns: str) -> List[str]:
+        try:
+            names = os.listdir(os.path.join(self.root, "kv",
+                                            _storage_name(ns)))
+        except OSError:
+            return []
+        return sorted(n for n in names if not n.startswith(".put-"))
+
+    def compile_cache_dir(self) -> Optional[str]:
+        d = os.path.join(self.root, "compile_cache")
+        os.makedirs(d, exist_ok=True)
+        return d
+
+    def corpus_dir(self) -> Optional[str]:
+        d = os.path.join(self.root, "corpus")
+        os.makedirs(d, exist_ok=True)
+        return d
+
+
+# -- TCP store (tests) ------------------------------------------------------
+
+def _recv_exact(sock: socket.socket, n: int) -> Optional[bytes]:
+    buf = bytearray()
+    while len(buf) < n:
+        part = sock.recv(n - len(buf))
+        if not part:
+            return None
+        buf += part
+    return bytes(buf)
+
+
+def _send_msg(sock: socket.socket, header: Dict, payload: bytes) -> None:
+    hdr = json.dumps(header).encode("utf-8")
+    sock.sendall(_HDR.pack(len(hdr), len(payload)) + hdr + payload)
+
+
+def _recv_msg(sock: socket.socket) -> Optional[Tuple[Dict, bytes]]:
+    raw = _recv_exact(sock, _HDR.size)
+    if raw is None:
+        return None
+    hlen, plen = _HDR.unpack(raw)
+    if hlen > _MAX_FRAME or plen > _MAX_FRAME:
+        raise ValueError(f"store frame too large ({hlen}+{plen})")
+    hdr = _recv_exact(sock, hlen)
+    if hdr is None:
+        return None
+    payload = _recv_exact(sock, plen) if plen else b""
+    if payload is None:
+        return None
+    return json.loads(hdr.decode("utf-8")), payload
+
+
+class StoreServer:
+    """In-memory fleet store behind a TCP listener (tests).
+
+    One request/response pair per round trip; connections are
+    persistent (a client reuses its socket across requests)."""
+
+    def __init__(self, host: str = "127.0.0.1", port: int = 0):
+        self._data: Dict[Tuple[str, str], bytes] = {}
+        self._lock = threading.Lock()
+        outer = self
+
+        class Handler(socketserver.BaseRequestHandler):
+            def handle(self):
+                try:
+                    while True:
+                        msg = _recv_msg(self.request)
+                        if msg is None:
+                            return
+                        header, payload = msg
+                        outer._serve_one(self.request, header, payload)
+                except (OSError, ValueError):
+                    return
+
+        class Server(socketserver.ThreadingTCPServer):
+            allow_reuse_address = True
+            daemon_threads = True
+
+        self._server = Server((host, port), Handler)
+        self.host, self.port = self._server.server_address[:2]
+        self._thread = threading.Thread(
+            target=self._server.serve_forever,
+            name="fleet-store-server", daemon=True)
+        self._thread.start()
+
+    @property
+    def url(self) -> str:
+        return f"tcp://{self.host}:{self.port}"
+
+    def _serve_one(self, sock, header: Dict, payload: bytes) -> None:
+        op = header.get("op")
+        ns = _storage_name(str(header.get("ns", "")))
+        key = _storage_name(str(header.get("key", "")))
+        if op == "get":
+            with self._lock:
+                data = self._data.get((ns, key))
+            _send_msg(sock, {"ok": True, "found": data is not None},
+                      data or b"")
+        elif op == "put":
+            with self._lock:
+                self._data[(ns, key)] = payload
+            _send_msg(sock, {"ok": True}, b"")
+        elif op == "del":
+            with self._lock:
+                self._data.pop((ns, key), None)
+            _send_msg(sock, {"ok": True}, b"")
+        elif op == "keys":
+            with self._lock:
+                names = sorted(k for (n, k) in self._data if n == ns)
+            _send_msg(sock, {"ok": True, "keys": names}, b"")
+        elif op == "ping":
+            _send_msg(sock, {"ok": True}, b"")
+        else:
+            _send_msg(sock, {"ok": False,
+                             "error": f"unknown op {op!r}"}, b"")
+
+    def entry_count(self) -> int:
+        with self._lock:
+            return len(self._data)
+
+    def shutdown(self) -> None:
+        self._server.shutdown()
+        self._server.server_close()
+
+
+class TcpStore(FleetStore):
+    """Client of :class:`StoreServer` — one persistent socket, a lock
+    serializing round trips, one transparent reconnect per request."""
+
+    def __init__(self, host: str, port: int, timeout_s: float = 10.0):
+        self.host, self.port = host, int(port)
+        self.url = f"tcp://{self.host}:{self.port}"
+        self._timeout_s = timeout_s
+        self._lock = threading.Lock()
+        self._sock: Optional[socket.socket] = None
+
+    def _connect(self) -> socket.socket:
+        sock = socket.create_connection((self.host, self.port),
+                                        timeout=self._timeout_s)
+        sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+        return sock
+
+    def _round_trip(self, header: Dict,
+                    payload: bytes = b"") -> Tuple[Dict, bytes]:
+        with self._lock:
+            for attempt in (0, 1):
+                try:
+                    if self._sock is None:
+                        self._sock = self._connect()
+                    _send_msg(self._sock, header, payload)
+                    resp = _recv_msg(self._sock)
+                    if resp is None:
+                        raise OSError("store connection closed")
+                    return resp
+                except (OSError, ValueError):
+                    if self._sock is not None:
+                        try:
+                            self._sock.close()
+                        except OSError:
+                            pass
+                        self._sock = None
+                    if attempt:
+                        raise
+            raise OSError("unreachable")
+
+    def get(self, ns: str, key: str) -> Optional[bytes]:
+        try:
+            header, payload = self._round_trip(
+                {"op": "get", "ns": ns, "key": key})
+        except (OSError, ValueError):
+            self._count_error()
+            return None
+        found = bool(header.get("found"))
+        self._count_get(found)
+        return payload if found else None
+
+    def put(self, ns: str, key: str, data: bytes) -> None:
+        try:
+            self._round_trip({"op": "put", "ns": ns, "key": key}, data)
+            self._count_put(len(data))
+        except (OSError, ValueError):
+            self._count_error()
+
+    def delete(self, ns: str, key: str) -> None:
+        try:
+            self._round_trip({"op": "del", "ns": ns, "key": key})
+        except (OSError, ValueError):
+            self._count_error()
+
+    def keys(self, ns: str) -> List[str]:
+        try:
+            header, _ = self._round_trip({"op": "keys", "ns": ns})
+        except (OSError, ValueError):
+            self._count_error()
+            return []
+        return list(header.get("keys") or [])
+
+    def close(self) -> None:
+        with self._lock:
+            if self._sock is not None:
+                try:
+                    self._sock.close()
+                except OSError:
+                    pass
+                self._sock = None
+
+
+def store_from_url(url: str) -> FleetStore:
+    """``file:///path`` → FileStore; ``tcp://host:port`` → TcpStore.
+    A bare path (no scheme) is treated as a file root."""
+    url = (url or "").strip()
+    if not url:
+        raise ValueError("fleet.store.url is empty")
+    if url.startswith("file://"):
+        return FileStore(url[len("file://"):] or "/")
+    if url.startswith("tcp://"):
+        rest = url[len("tcp://"):]
+        host, _, port = rest.rpartition(":")
+        if not host or not port.isdigit():
+            raise ValueError(f"bad tcp store url {url!r} "
+                             "(want tcp://host:port)")
+        return TcpStore(host, int(port))
+    if "://" in url:
+        raise ValueError(f"unsupported fleet.store.url scheme: {url!r}")
+    return FileStore(url)

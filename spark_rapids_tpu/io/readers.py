@@ -29,6 +29,7 @@ import pyarrow.parquet as papq
 
 from spark_rapids_tpu import config as cfg
 from spark_rapids_tpu.config import RapidsTpuConf
+from spark_rapids_tpu.obs import registry as obsreg
 from spark_rapids_tpu.exec.base import PhysicalPlan
 from spark_rapids_tpu.plan.logical import FileScan, Schema
 
@@ -236,6 +237,61 @@ class CpuFileScanExec(PhysicalPlan):
         return self._schema
 
     def _read_one(self, file_index: int) -> pa.Table:
+        """Decode one file, multicast through the shared-scan window
+        when enabled: concurrent queries decoding the same stampable
+        file (same projection/options) share one decode — the host
+        (legacy v1) analog of device_scan's fused-scan sharing.  A
+        file that can't be stamped (vanished between plan and decode,
+        non-local path) is never shared and counts
+        ``scan.shared.ineligible.legacy``."""
+        key = self._share_key(file_index)
+        if key is None:
+            return self._decode_one(file_index)
+        from spark_rapids_tpu.io import scan_share
+        share = scan_share.get_share(
+            int(self.conf.get(cfg.SCAN_SHARED_WINDOW_BYTES)))
+        role, entry = share.claim(key)
+        if role == "join":
+            try:
+                t = share.wait(entry)
+            finally:
+                share.release(entry)
+            if t is not None:   # wait() counted the deduped decode
+                return t
+            # leader failed/was cancelled: decode locally
+            return self._decode_one(file_index)
+        try:
+            t = self._decode_one(file_index)
+        except BaseException as e:
+            share.fail(entry, e)
+            share.release(entry)
+            raise
+        share.publish(entry, t)
+        share.release(entry)
+        return t
+
+    def _share_key(self, file_index: int):
+        """Content identity of one host-scan file decode, or None when
+        sharing is off or the file can't be stamped."""
+        if not bool(self.conf.get(cfg.SCAN_SHARED_ENABLED)):
+            return None
+        from spark_rapids_tpu.io import scan_cache as sc
+        path = self.scan.paths[file_index]
+        stamp = sc.file_key(path)
+        if stamp is None:
+            obsreg.get_registry().inc("scan.shared.ineligible.legacy")
+            return None
+        pv_list = self.scan.options.get("part_values") or []
+        pv = pv_list[file_index] if file_index < len(pv_list) else {}
+        opts = {k: v for k, v in self.scan.options.items()
+                if k not in ("part_values",)}
+        return ("cpu", stamp, self.scan.fmt,
+                tuple(self.columns or ()),
+                tuple(sorted((str(k), str(v)) for k, v in pv.items())),
+                repr(sorted(opts.items(), key=lambda kv: str(kv[0]))),
+                repr(self._schema))
+
+    def _decode_one(self, file_index: int) -> pa.Table:
         path = self.scan.paths[file_index]
         fmt = self.scan.fmt
         part_fields = dict(self.scan.options.get("part_fields") or [])

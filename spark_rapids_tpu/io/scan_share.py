@@ -134,7 +134,10 @@ class ScanShare:
         """Leader settle: the decoded batch enters the retention window
         and every waiting subscriber wakes."""
         try:
-            nb = int(batch.nbytes())
+            # DeviceBatch exposes nbytes(); pa.Table exposes the
+            # property — the host-scan sharing path publishes Tables
+            nb = batch.nbytes
+            nb = int(nb() if callable(nb) else nb)
         except Exception:
             nb = 1 << 20
         with self._lock:

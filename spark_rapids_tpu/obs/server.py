@@ -408,6 +408,27 @@ class ObsHttpServer:
                            "templates": acct.template_labels()})
 
     @staticmethod
+    def _healthz_json(session) -> str:
+        """Liveness + serve-plane lifecycle: a draining or drained
+        serve tier used to answer the same body as a live one, so no
+        load balancer could take the replica out of rotation before
+        the kill — the fleet router keys placement on ``state`` and
+        falls back to in-flight draining on ``inflight``."""
+        state, inflight = "serving", 0
+        try:
+            srv = getattr(session, "serve_server", None)
+            if srv is not None:
+                state = srv.state()
+                inflight = srv.inflight_count()
+        except Exception:
+            pass
+        return json.dumps(
+            {"ok": True, "state": state, "inflight": inflight,
+             "routes": ["/metrics", "/queries", "/profiles/<qid>",
+                        "/compiles", "/resultcache", "/tenants",
+                        "/slo", "/healthz"]})
+
+    @staticmethod
     def _profile_json(session, qid: int) -> Optional[str]:
         prof = session.query_profile(qid)
         if prof is None:
@@ -471,12 +492,7 @@ class ObsHttpServer:
                         else:
                             self._send(200, body)
                     elif path in ("/", "/healthz"):
-                        self._send(200, json.dumps(
-                            {"ok": True,
-                             "routes": ["/metrics", "/queries",
-                                        "/profiles/<qid>", "/compiles",
-                                        "/resultcache", "/tenants",
-                                        "/slo", "/healthz"]}))
+                        self._send(200, server._healthz_json(session))
                     else:
                         self._send(404, json.dumps(
                             {"error": f"unknown route {path!r}"}))

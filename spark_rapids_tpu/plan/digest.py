@@ -187,8 +187,22 @@ def _node_hash(node: lp.LogicalPlan, memo: dict) -> str:
     elif isinstance(node, lp.FileScan):
         import os
         parts.append(node.fmt)
-        parts.append(tuple(os.path.abspath(p) for p in node.paths))
-        parts.append(_value_sig(node.options))
+        roots = node.options.get("source_roots")
+        if roots:
+            # watched scan: the recorded roots are the dataset's
+            # identity.  The expanded snapshot (and its per-file
+            # part_values) drifts with every append, so digesting it
+            # would hand each session its own digest for the same
+            # directory — the source stamps, which key the result
+            # cache alongside this digest, carry the content identity
+            parts.append(("roots",
+                          tuple(os.path.abspath(p) for p in roots)))
+            parts.append(_value_sig(
+                {k: v for k, v in node.options.items()
+                 if k != "part_values"}))
+        else:
+            parts.append(tuple(os.path.abspath(p) for p in node.paths))
+            parts.append(_value_sig(node.options))
         # the inferred schema participates: re-reading the same paths
         # after a rewrite with new columns must change the digest even
         # before the stamps do

@@ -39,9 +39,10 @@ Registry counters: ``sched.precompile.plans`` / ``.programs`` /
 from __future__ import annotations
 
 import json
+import os
 import threading
 import time
-from typing import Any, Dict, Optional
+from typing import Any, Dict, List, Optional
 
 from spark_rapids_tpu.obs import recorder as obsrec
 from spark_rapids_tpu.obs import registry as obsreg
@@ -119,18 +120,34 @@ class PrecompileService:
         reg = obsreg.get_registry()
         seen = set()
         records = []
-        try:
-            with open(self.corpus_path) as f:
-                for line in f:
-                    line = line.strip()
-                    if not line:
-                        continue
-                    try:
-                        records.append(json.loads(line))
-                    except Exception:
-                        continue          # torn tail line: skip
-        except OSError:
-            records = []
+        # a DIRECTORY corpus replays every *.jsonl inside it — the
+        # fleet warm-join shape, where each replica appends its own
+        # corpus file under the shared store's corpus/ dir and the
+        # (key, signature) dedup below collapses the overlap
+        paths: List[str] = []
+        if os.path.isdir(self.corpus_path):
+            try:
+                paths = sorted(
+                    os.path.join(self.corpus_path, n)
+                    for n in os.listdir(self.corpus_path)
+                    if n.endswith(".jsonl"))
+            except OSError:
+                paths = []
+        elif self.corpus_path:
+            paths = [self.corpus_path]
+        for path in paths:
+            try:
+                with open(path) as f:
+                    for line in f:
+                        line = line.strip()
+                        if not line:
+                            continue
+                        try:
+                            records.append(json.loads(line))
+                        except Exception:
+                            continue      # torn tail line: skip
+            except OSError:
+                continue
         obsrec.record_event("precompile.start",
                             corpus=self.corpus_path,
                             plans=len(records))

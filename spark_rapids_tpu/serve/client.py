@@ -275,10 +275,16 @@ class ServeClient:
                  default_credit: int = 8,
                  reconnect: bool = False,
                  max_reconnects: int = 5,
-                 backoff_s: float = 0.05):
+                 backoff_s: float = 0.05,
+                 auth_token: Optional[str] = None,
+                 tls: bool = False,
+                 tls_ca_file: Optional[str] = None):
         self._host, self._port = host, port
         self._connect_timeout = connect_timeout
         self._conf = dict(conf or {})
+        self._auth_token = auth_token
+        self._tls = bool(tls or tls_ca_file)
+        self._tls_ca_file = tls_ca_file
         self._reconnect_enabled = bool(reconnect)
         self._max_reconnects = max(1, int(max_reconnects))
         self._backoff_s = max(0.001, float(backoff_s))
@@ -301,8 +307,7 @@ class ServeClient:
         self._stmt_alias: Dict[str, str] = {}
         self.resume_token: Optional[str] = None
         self.reconnects = 0
-        self._sock = socket.create_connection((host, port),
-                                              timeout=connect_timeout)
+        self._sock = self._connect()
         self._sock.settimeout(None)
         wire.set_low_latency(self._sock)
         self._start_reader()
@@ -317,6 +322,32 @@ class ServeClient:
         self.session_id = resp["session_id"]
 
     # -- connection plumbing ------------------------------------------------
+    def _connect(self) -> socket.socket:
+        sock = socket.create_connection((self._host, self._port),
+                                        timeout=self._connect_timeout)
+        if self._tls:
+            import ssl
+            if self._tls_ca_file:
+                ctx = ssl.create_default_context(
+                    cafile=self._tls_ca_file)
+                ctx.check_hostname = False   # fleets address by IP
+            else:
+                # no CA pinned: encrypt without verifying (test
+                # convenience against self-signed listeners)
+                ctx = ssl.SSLContext(ssl.PROTOCOL_TLS_CLIENT)
+                ctx.check_hostname = False
+                ctx.verify_mode = ssl.CERT_NONE
+            try:
+                sock = ctx.wrap_socket(sock,
+                                       server_hostname=self._host)
+            except BaseException:
+                try:
+                    sock.close()
+                except OSError:
+                    pass
+                raise
+        return sock
+
     def _next_tag(self) -> int:
         with self._tag_lock:
             return next(self._tags)
@@ -374,6 +405,8 @@ class ServeClient:
         token when one is held and replays prepared statements the
         server no longer knows."""
         msg: Dict[str, Any] = {"op": "hello", "conf": self._conf}
+        if self._auth_token:
+            msg["auth_token"] = self._auth_token
         if self.resume_token:
             msg["resume"] = self.resume_token
         resp = self._request_inner(msg, timeout=30.0)
@@ -413,9 +446,7 @@ class ServeClient:
                     time.sleep(min(2.0,
                                    self._backoff_s * (2 ** attempt)))
                 try:
-                    sock = socket.create_connection(
-                        (self._host, self._port),
-                        timeout=self._connect_timeout)
+                    sock = self._connect()
                 except OSError as e:
                     last = e
                     continue

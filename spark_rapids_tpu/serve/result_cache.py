@@ -19,12 +19,29 @@ BEFORE execution still hold after it — a file rewritten mid-query must
 not freeze a half-old result under the new stamp (the scan cache's
 ``handle_key`` pin, applied to whole results).
 
+With a fleet store attached (``configure_store`` — fleet.enabled),
+the cache becomes two-level: a local miss consults the shared store
+under a digest of the SAME (plan digest, names, stamps) key, so a
+result one replica executed serves a sibling's lookup with zero
+dispatches there; because the LIVE stamps are part of the store key,
+stamp drift invalidates fleet-wide with no coordination (an entry
+published under old stamps is simply never addressed again).  A
+``latest`` pointer keyed on (digest, names) mirrors ``_STAMP_OF`` so
+``lookup_latest`` — the incremental maintainer's retained-partial
+lookup — also resolves through the store, which is what lets replica
+B delta-refresh partials replica A captured.  No store attached (the
+default): every branch below short-circuits on ``_STORE is None`` and
+behavior is byte-for-byte the single-process cache.
+
 Counters (registry → /metrics): ``serve.resultCacheHits`` /
-``Misses`` / ``evictedBytes`` / ``insertedBytes``.
+``Misses`` / ``evictedBytes`` / ``insertedBytes`` /
+``SharedHits`` (hits served from the fleet store).
 """
 
 from __future__ import annotations
 
+import hashlib
+import json
 import threading
 import time
 from collections import OrderedDict
@@ -37,6 +54,10 @@ from spark_rapids_tpu.obs import registry as _obsreg
 _LOCK = threading.Lock()
 _ENABLED = True
 _MAX_BYTES = 256 << 20
+_STORE = None                       # fleet.store.FleetStore when fleeted
+_STORE_MAX_ENTRY = 64 << 20
+_NS_RESULT = "result"
+_NS_LATEST = "latest"
 
 # key -> (table, nbytes, inserted_unix); LRU order (oldest first)
 _ENTRIES: "OrderedDict[Tuple, Tuple[pa.Table, int, float]]" = OrderedDict()
@@ -57,6 +78,20 @@ def configure(enabled: bool, max_bytes: int) -> None:
             _clear_locked()
         else:
             _evict_locked()
+
+
+def configure_store(store, max_entry_bytes: int = 64 << 20) -> None:
+    """Attach (or detach, with None) the fleet's shared store.  Local
+    semantics are unchanged; the store only adds a second-level lookup
+    and a best-effort publish on insert."""
+    global _STORE, _STORE_MAX_ENTRY
+    with _LOCK:
+        _STORE = store
+        _STORE_MAX_ENTRY = int(max_entry_bytes)
+
+
+def store_attached() -> bool:
+    return _STORE is not None
 
 
 def enabled() -> bool:
@@ -124,6 +159,100 @@ def _nbytes(table: pa.Table) -> int:
         return 1 << 20
 
 
+def _store_key(digest: str, names, stamps) -> str:
+    """Content-addressed store key: the live stamps are part of it, so
+    drifted sources change the address and the stale value is never
+    read again — invalidation by construction, fleet-wide."""
+    blob = json.dumps([str(digest), list(names),
+                       [list(s) for s in stamps]], default=str)
+    return "r" + hashlib.sha1(blob.encode("utf-8")).hexdigest()
+
+
+def _latest_key(digest: str, names) -> str:
+    blob = json.dumps([str(digest), list(names)], default=str)
+    return "l" + hashlib.sha1(blob.encode("utf-8")).hexdigest()
+
+
+def _table_to_ipc(table: pa.Table) -> bytes:
+    sink = pa.BufferOutputStream()
+    with pa.ipc.new_stream(sink, table.schema) as writer:
+        writer.write_table(table)
+    return sink.getvalue().to_pybytes()
+
+
+def _table_from_ipc(data: bytes) -> pa.Table:
+    with pa.ipc.open_stream(pa.py_buffer(data)) as reader:
+        return reader.read_all()
+
+
+def _store_fetch(store, digest: str, names, stamps) -> Optional[pa.Table]:
+    """Second-level lookup (no locks held — store IO can block)."""
+    try:
+        raw = store.get(_NS_RESULT, _store_key(digest, names, stamps))
+        if raw is None:
+            return None
+        return _table_from_ipc(raw)
+    except Exception:
+        _obsreg.get_registry().inc("fleet.store.errors")
+        return None
+
+
+def _store_publish(store, digest: str, names, stamps,
+                   table: pa.Table, nb: int) -> None:
+    """Best-effort publish after a local insert (no locks held)."""
+    if nb > _STORE_MAX_ENTRY:
+        return
+    try:
+        data = _table_to_ipc(table)
+        if len(data) > _STORE_MAX_ENTRY:
+            return
+        store.put(_NS_RESULT, _store_key(digest, names, stamps), data)
+        pointer = json.dumps({"stamps": [list(s) for s in stamps]},
+                             default=str)
+        store.put(_NS_LATEST, _latest_key(digest, names),
+                  pointer.encode("utf-8"))
+    except Exception:
+        _obsreg.get_registry().inc("fleet.store.errors")
+
+
+def _deep_tuple(v):
+    return tuple(_deep_tuple(x) for x in v) if isinstance(v, list) else v
+
+
+def _stamps_from_pointer(raw: bytes) -> Optional[Tuple]:
+    try:
+        doc = json.loads(raw.decode("utf-8"))
+        # JSON turned every nesting level into lists; stamps must come
+        # back as the hashable tuples entry_key and the incremental
+        # maintainer compare against
+        return _deep_tuple(doc["stamps"])
+    except Exception:
+        return None
+
+
+def _adopt(digest: str, names, stamps, table: pa.Table) -> None:
+    """Install a store-fetched entry locally (no re-publish)."""
+    global _TOTAL_BYTES
+    nb = _nbytes(table)
+    if nb > _MAX_BYTES:
+        return
+    key = entry_key(digest, names, stamps)
+    with _LOCK:
+        if key in _ENTRIES:
+            _ENTRIES.move_to_end(key)
+            return
+        prev_stamps = _STAMP_OF.get(key[:2])
+        if prev_stamps is not None and prev_stamps != key[2]:
+            stale = _ENTRIES.pop(entry_key(digest, names, prev_stamps),
+                                 None)
+            if stale is not None:
+                _TOTAL_BYTES -= stale[1]
+        _ENTRIES[key] = (table, nb, time.time())
+        _STAMP_OF[key[:2]] = key[2]
+        _TOTAL_BYTES += nb
+        _evict_locked()
+
+
 def _evict_locked() -> None:
     global _TOTAL_BYTES
     reg = _obsreg.get_registry()
@@ -154,6 +283,14 @@ def lookup(digest: str, names, stamps,
         hit = _ENTRIES.get(key)
         if hit is not None:
             _ENTRIES.move_to_end(key)
+        store = _STORE
+    if hit is None and store is not None:
+        shared = _store_fetch(store, digest, names, stamps)
+        if shared is not None:
+            _adopt(digest, names, stamps, shared)
+            reg.inc("serve.resultCacheHits")
+            reg.inc("serve.resultCacheSharedHits")
+            return shared
     if hit is None:
         if count_miss:
             reg.inc("serve.resultCacheMisses")
@@ -187,13 +324,34 @@ def lookup_latest(digest: str, names
         return None
     with _LOCK:
         stamps = _STAMP_OF.get((digest, tuple(names)))
-        if stamps is None:
-            return None
-        hit = _ENTRIES.get(entry_key(digest, names, stamps))
-        if hit is None:
-            return None
-        _ENTRIES.move_to_end(entry_key(digest, names, stamps))
+        hit = (_ENTRIES.get(entry_key(digest, names, stamps))
+               if stamps is not None else None)
+        if hit is not None:
+            _ENTRIES.move_to_end(entry_key(digest, names, stamps))
+        store = _STORE
+    if hit is not None:
         return stamps, hit[0]
+    if store is None:
+        return None
+    # the shared 'latest' pointer: what _STAMP_OF is locally — this is
+    # the hop that lets a replica delta-refresh partials a SIBLING
+    # captured (the maintainer keys partials digest+PARTIAL_SUFFIX)
+    try:
+        raw = store.get(_NS_LATEST, _latest_key(digest, names))
+    except Exception:
+        _obsreg.get_registry().inc("fleet.store.errors")
+        return None
+    if raw is None:
+        return None
+    pstamps = _stamps_from_pointer(raw)
+    if pstamps is None:
+        return None
+    shared = _store_fetch(store, digest, names, pstamps)
+    if shared is None:
+        return None
+    _adopt(digest, names, pstamps, shared)
+    _obsreg.get_registry().inc("serve.resultCacheSharedHits")
+    return pstamps, shared
 
 
 def insert(digest: str, names, stamps, table: pa.Table) -> bool:
@@ -224,5 +382,8 @@ def insert(digest: str, names, stamps, table: pa.Table) -> bool:
         _STAMP_OF[key[:2]] = key[2]
         _TOTAL_BYTES += nb
         _evict_locked()
+        store = _STORE
     reg.inc("serve.resultCacheInsertedBytes", nb)
+    if store is not None:
+        _store_publish(store, digest, names, stamps, table, nb)
     return True

@@ -504,6 +504,38 @@ class ServeServer:
             self._batcher = StatementBatcher(
                 self, int(conf.get(cfg.SERVE_BATCH_WINDOW_MS)),
                 int(conf.get(cfg.SERVE_BATCH_MAX_STATEMENTS)))
+        # token auth: non-empty allowlist means every hello must carry
+        # a matching auth_token or the connection gets a typed
+        # AuthFailed ERR before any session exists
+        self._auth_tokens = frozenset(
+            t.strip() for t in
+            str(conf.get(cfg.SERVE_AUTH_TOKENS) or "").split(",")
+            if t.strip())
+        # optional TLS: both PEM paths or neither — exactly one is a
+        # misconfiguration that must not silently serve plaintext
+        cert = str(conf.get(cfg.SERVE_TLS_CERT_FILE) or "").strip()
+        key = str(conf.get(cfg.SERVE_TLS_KEY_FILE) or "").strip()
+        self._ssl_ctx = None
+        if bool(cert) != bool(key):
+            raise ValueError(
+                "serve.tls.certFile and serve.tls.keyFile must be set "
+                "together (exactly one is set)")
+        if cert:
+            import ssl
+            ctx = ssl.SSLContext(ssl.PROTOCOL_TLS_SERVER)
+            ctx.load_cert_chain(certfile=cert, keyfile=key)
+            self._ssl_ctx = ctx
+        # fleet store (attached by api/session.py when fleet.enabled):
+        # prepared-statement specs publish here so ANY replica can
+        # re-materialize a statement it never prepared — the router's
+        # failover replay and cross-replica execute both lean on it
+        self._store = getattr(session, "fleet_store", None)
+        # statement ids carry a per-process nonce once a fleet store is
+        # attached: two replicas both minting "stmt-00001" would alias
+        # in the shared registry.  Storeless servers keep the legacy
+        # format (the one-knob-revert byte-for-byte contract).
+        self._stmt_nonce = os.urandom(3).hex() \
+            if self._store is not None else ""
         self._sessions: Dict[str, ServeSession] = {}
         self._lock = threading.Lock()
         self._session_seq = itertools.count(1)
@@ -692,6 +724,20 @@ class ServeServer:
                 "retained_streams": retained_stats()["entries"],
                 "retained_bytes": retained_stats()["bytes"]}
 
+    def state(self) -> str:
+        """Lifecycle state for /healthz: ``serving`` → ``draining`` →
+        ``drained``.  The fleet router polls this to take a replica
+        out of placement rotation BEFORE it stops answering."""
+        if self._drained.is_set():
+            return "drained"
+        if self._draining:
+            return "draining"
+        return "serving"
+
+    def inflight_count(self) -> int:
+        with self._conns_lock:
+            return sum(len(c.inflight) for c in self._conns)
+
     def _engine(self):
         eng = self._engine_ref()
         if eng is None:
@@ -802,6 +848,22 @@ class ServeServer:
                     pass
 
     def _serve_conn(self, sock: socket.socket, addr: str) -> None:
+        if self._ssl_ctx is not None:
+            # handshake on the per-connection thread (never the accept
+            # loop — a stalled handshake must not block other accepts),
+            # under the frame-progress deadline as its time bound
+            try:
+                sock.settimeout(self._read_timeout_s)
+                sock = self._ssl_ctx.wrap_socket(sock, server_side=True)
+            except (OSError, ValueError) as e:
+                obsreg.get_registry().inc("serve.tlsHandshakeFailures")
+                obsrec.record_event("serve.tlsHandshakeFailed",
+                                    client=addr, error=str(e))
+                try:
+                    sock.close()
+                except OSError:
+                    pass
+                return
         conn = _Conn(sock, addr)
         self._register_conn(conn)
         try:
@@ -994,6 +1056,17 @@ class ServeServer:
 
     def _handle_hello(self, conn: _Conn, tag: int,
                       msg: Dict[str, Any]) -> None:
+        if self._auth_tokens:
+            presented = str(msg.get("auth_token") or "")
+            if presented not in self._auth_tokens:
+                obsreg.get_registry().inc("serve.authFailures")
+                obsrec.record_event("serve.authFailed",
+                                    client=conn.addr,
+                                    presented=bool(presented))
+                raise ServeError(
+                    "AuthFailed",
+                    "hello rejected: missing or unknown auth_token "
+                    "(serve.auth.tokens)")
         token = str(msg.get("resume") or "") or None
         sess: Optional[ServeSession] = None
         resumed = False
@@ -1050,10 +1123,35 @@ class ServeServer:
                       msg: Dict[str, Any]) -> PreparedStatement:
         sid = str(msg.get("statement_id", ""))
         stmt = sess.statements.get(sid)
+        if stmt is None and self._store is not None:
+            stmt = self._statement_from_store(sess, sid)
         if stmt is None:
             raise ServeError("UnknownStatement",
                              f"no prepared statement {sid!r} in "
                              f"session {sess.session_id}")
+        return stmt
+
+    def _statement_from_store(self, sess: ServeSession,
+                              sid: str) -> Optional[PreparedStatement]:
+        """Re-materialize a statement a SIBLING replica prepared: the
+        fleet's shared statement-template registry means an execute
+        routed (or failed over) to a replica that never saw the prepare
+        still resolves the id."""
+        import json as _json
+        if not sid:
+            return None
+        try:
+            raw = self._store.get("stmt", sid)
+            if raw is None:
+                return None
+            spec = _json.loads(raw.decode("utf-8"))
+            stmt = PreparedStatement(sid, str(spec["sql"]),
+                                     spec.get("declared_types") or {},
+                                     self._engine().catalog)
+        except Exception:
+            return None
+        sess.statements[sid] = stmt
+        obsreg.get_registry().inc("serve.statementsAdopted")
         return stmt
 
     def _parse(self, sql: str):
@@ -1067,11 +1165,21 @@ class ServeServer:
         sql = str(msg.get("sql", ""))
         if not sql.strip():
             raise ServeError("EmptyStatement", "empty sql")
-        stmt_id = f"stmt-{next(self._stmt_seq):05d}"
+        nonce = f"{self._stmt_nonce}-" if self._stmt_nonce else ""
+        stmt_id = f"stmt-{nonce}{next(self._stmt_seq):05d}"
         stmt = PreparedStatement(stmt_id, sql, msg.get("params") or {},
                                  self._engine().catalog)
         sess.statements[stmt_id] = stmt
         obsreg.get_registry().inc("serve.statementsPrepared")
+        if self._store is not None:
+            import json as _json
+            try:
+                self._store.put("stmt", stmt_id, _json.dumps(
+                    {"sql": stmt.sql,
+                     "declared_types": dict(stmt.declared_types)}
+                ).encode("utf-8"))
+            except Exception:
+                obsreg.get_registry().inc("fleet.store.errors")
         return stmt
 
     # -- query execution + streaming ---------------------------------------
